@@ -1,0 +1,50 @@
+"""dpsvm_tpu — a TPU-native framework for distributed kernel-SVM training.
+
+A brand-new JAX/XLA implementation with the capabilities of the reference
+CUDA+OpenMPI DPSVM (binary SVM, RBF kernel, modified-SMO solver with
+Keerthi-style first-order working-set selection — see /root/reference,
+``svmTrainMain.cpp``, ``svmTrain.cu``, ``seq.cpp``).
+
+Design (TPU-first, not a port):
+
+* the entire SMO loop runs inside one compiled XLA program
+  (``lax.while_loop`` under ``jit``) — no host round-trip per iteration,
+  unlike the reference which pays kernel-launch + MPI latency every
+  iteration (``svmTrainMain.cpp:235-310``);
+* kernel rows come off the MXU as a single ``(2, d) @ (d, n)`` matmul
+  (the reference issues two ``cublasSgemv`` on separate CUDA streams,
+  ``svmTrain.cu:222,247``);
+* distribution is SPMD ``shard_map`` over a 1-D ``jax.sharding.Mesh``
+  axis; the per-iteration MPI ``Allgather`` of 4 floats per rank
+  (``svmTrainMain.cpp:244``) becomes a ``lax.all_gather`` of per-shard
+  extrema over ICI, fused into the same compiled loop;
+* the kernel-row LRU cache (``cache.cu``) becomes a fixed-shape
+  HBM-resident table updated with masked dynamic-slice writes inside jit.
+
+Public API
+----------
+``train(X, y, config)``            -> TrainResult (solver dispatch: 1 device or mesh)
+``SVMConfig``                      config dataclass (reference flag parity)
+``SVMModel``                       trained model pytree + decision function
+``load_model`` / ``save_model``    reference-compatible model file I/O
+``predict`` / ``evaluate``         batched XLA inference
+"""
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
+from dpsvm_tpu.models.io import save_model, load_model
+from dpsvm_tpu.api import train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SVMConfig",
+    "TrainResult",
+    "SVMModel",
+    "train",
+    "decision_function",
+    "predict",
+    "evaluate",
+    "save_model",
+    "load_model",
+]

@@ -1,0 +1,47 @@
+"""Library-first training entry point.
+
+The reference has no framework API — each binary's ``main()`` drives the
+solver directly (SURVEY §1). Here ``train`` is the single entry point;
+CLIs are thin wrappers over it. Dispatch: ``config.shards == 1`` runs the
+single-device solver, ``> 1`` the shard_map solver over a 1-D device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.models.svm import SVMModel
+
+
+def train(x: np.ndarray, y: np.ndarray,
+          config: Optional[SVMConfig] = None) -> TrainResult:
+    """Train a binary RBF-SVM with the modified-SMO solver.
+
+    x: (n, d) float features; y: (n,) labels in {+1, -1}.
+    """
+    config = config or SVMConfig()
+    config.validate()
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y)
+    if x.ndim != 2:
+        raise ValueError(f"x must be (n, d), got shape {x.shape}")
+    if y.shape != (x.shape[0],):
+        raise ValueError(f"y must be ({x.shape[0]},), got {y.shape}")
+    labels = np.unique(y)
+    if not np.all(np.isin(labels, (-1, 1))):
+        raise ValueError(f"labels must be +/-1, got {labels[:10]}")
+    if config.shards > 1:
+        from dpsvm_tpu.parallel.dist_smo import train_distributed
+        return train_distributed(x, y, config)
+    from dpsvm_tpu.solver.smo import train_single_device
+    return train_single_device(x, y, config)
+
+
+def fit(x: np.ndarray, y: np.ndarray,
+        config: Optional[SVMConfig] = None) -> Tuple[SVMModel, TrainResult]:
+    """train + SV compaction in one call."""
+    result = train(x, y, config)
+    return SVMModel.from_train_result(x, y, result), result

@@ -1,0 +1,118 @@
+"""Command-line entry points: ``svm-train`` and ``svm-test``.
+
+Thin wrappers over the library API, honoring the reference's flag names
+(``svmTrainMain.cpp:22-44``, ``seq_test.cpp:54-62``):
+
+    -f/--input, -m/--model, -a/--num-att, -x/--num-ex, -c/--cost,
+    -g/--gamma, -e/--epsilon, -n/--max-iter, -s/--cache-size
+
+with two deliberate fixes (SURVEY §2d): ``-a``/``-x`` are OPTIONAL (shapes
+are inferred from the file) and the default gamma is 1.0/d, not the
+reference's integer-division zero. Extra flags cover the mesh
+(``--shards`` replaces ``mpirun -np``) and layout (``--replicate-x``).
+
+Usage:
+    python -m dpsvm_tpu.cli train -f train.csv -m model.svm -c 10 -g 0.25
+    python -m dpsvm_tpu.cli test  -f test.csv  -m model.svm
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.loader import load_csv
+from dpsvm_tpu.models.io import load_model, save_model
+from dpsvm_tpu.models.svm import SVMModel, evaluate
+
+
+def _add_data_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-f", "--input", required=True, help="dense CSV dataset")
+    p.add_argument("-m", "--model", required=True, help="model file path")
+    p.add_argument("-a", "--num-att", type=int, default=None,
+                   help="attribute count (inferred when omitted)")
+    p.add_argument("-x", "--num-ex", type=int, default=None,
+                   help="example count (inferred when omitted)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    root = argparse.ArgumentParser(prog="dpsvm_tpu")
+    sub = root.add_subparsers(dest="command", required=True)
+
+    tr = sub.add_parser("train", help="train a binary RBF-SVM")
+    _add_data_flags(tr)
+    tr.add_argument("-c", "--cost", type=float, default=1.0)
+    tr.add_argument("-g", "--gamma", type=float, default=None,
+                    help="RBF gamma (default 1/num_attributes)")
+    tr.add_argument("-e", "--epsilon", type=float, default=0.001)
+    tr.add_argument("-n", "--max-iter", type=int, default=150_000)
+    tr.add_argument("-s", "--cache-size", type=int, default=0,
+                    help="kernel-row cache lines (0 = fused matmul, no cache)")
+    tr.add_argument("--shards", type=int, default=1,
+                    help="devices along the data axis (replaces mpirun -np)")
+    tr.add_argument("--replicate-x", action="store_true",
+                    help="replicate X on every shard (reference layout)")
+    tr.add_argument("-q", "--quiet", action="store_true")
+
+    te = sub.add_parser("test", help="evaluate a saved model on a dataset")
+    _add_data_flags(te)
+    te.add_argument("--no-b", action="store_true",
+                    help="drop the intercept like seq_test.cpp:197")
+    return root
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    from dpsvm_tpu.api import fit   # deferred: importing jax is slow
+    x, y = load_csv(args.input, args.num_ex, args.num_att)
+    config = SVMConfig(
+        c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
+        max_iter=args.max_iter, cache_size=args.cache_size,
+        shards=args.shards, shard_x=not args.replicate_x,
+        verbose=not args.quiet,
+    )
+    model, result = fit(x, y, config)
+    n_sv = save_model(model, args.model)
+    acc = evaluate(model, x, y)
+    # Same closing report the reference prints (svmTrainMain.cpp:313-336).
+    print(f"Number of SVs: {n_sv}")
+    print(f"b: {result.b:.6f}")
+    print(f"Training iterations: {result.n_iter}"
+          + ("" if result.converged else " (max-iter reached, NOT converged)"))
+    print(f"Training accuracy: {acc:.6f}")
+    print(f"Training time: {result.train_seconds:.3f} s")
+    return 0
+
+
+def cmd_test(args: argparse.Namespace) -> int:
+    model = load_model(args.model)
+    x, y = load_csv(args.input, args.num_ex, args.num_att)
+    if x.shape[1] != model.num_attributes:
+        print(f"error: dataset has {x.shape[1]} attributes, model has "
+              f"{model.num_attributes}", file=sys.stderr)
+        return 2
+    acc = evaluate(model, x, y, include_b=not args.no_b)
+    print(f"Number of SVs: {model.n_sv}")
+    print(f"Test accuracy: {acc:.6f}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.command == "train":
+            return cmd_train(args)
+        return cmd_test(args)
+    except FileNotFoundError as e:
+        print(f"error: file not found: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Solver configuration and result types.
+
+Replaces the reference's global mutable ``state_model`` singleton
+(``svmTrainMain.hpp:4-19``, read ambiently from deep inside the solver at
+``svmTrain.cu:309,349,361``) with one explicit, immutable dataclass shared by
+the library API and both CLIs. Field names / defaults mirror the reference
+flags (``svmTrainMain.cpp:62-71,22-44``):
+
+    -c cost (default 1.0)     -> ``c``
+    -g gamma (default 1/d)    -> ``gamma`` (None => 1.0/num_attributes; the
+                                 reference's int-division bug that yields
+                                 gamma=0 for d>1, ``svmTrainMain.cpp:133``,
+                                 is deliberately FIXED here — see SURVEY §2d)
+    -e epsilon (default 1e-3) -> ``epsilon``
+    -n max-iter (default 150000) -> ``max_iter``
+    -s cache-size (default 10 lines) -> ``cache_size`` (0 disables; on TPU the
+                                 fused matmul is usually faster than cache
+                                 bookkeeping, so 0 is the default here)
+
+Shapes (`-a` / `-x`, which the reference REQUIRES on the command line) are
+inferred from the data and never part of the config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Sentinel used by the reference for masked I-set scores
+# (svmTrain.cu:59,66 use +/-1e9); kept identical for parity.
+SENTINEL = 1.0e9
+
+
+@dataclasses.dataclass(frozen=True)
+class SVMConfig:
+    """Hyperparameters + execution options for the SMO solver."""
+
+    # --- algorithm (reference-parity) ---
+    c: float = 1.0                      # box constraint C
+    gamma: Optional[float] = None       # RBF gamma; None => 1.0 / d
+    epsilon: float = 0.001              # convergence tolerance
+    max_iter: int = 150_000             # iteration cap
+    cache_size: int = 0                 # kernel-row cache lines (0 = off)
+
+    # --- execution ---
+    shards: int = 1                     # mesh size along the data axis
+    shard_x: bool = True                # shard X rows over the mesh (v2);
+                                        # False replicates X (reference
+                                        # parity: every rank holds full X,
+                                        # svmTrainMain.cpp:180)
+    chunk_iters: int = 512              # host polls convergence every chunk
+    matmul_precision: str = "highest"   # jax.lax precision for kernel rows
+                                        # (solver dtype is float32 for
+                                        # reference parity, not configurable)
+    verbose: bool = False
+    log_every: int = 0                  # 0 = no per-chunk logging
+
+    def resolve_gamma(self, num_attributes: int) -> float:
+        if self.gamma is not None:
+            return float(self.gamma)
+        return 1.0 / float(num_attributes)
+
+    def validate(self) -> None:
+        if self.c <= 0:
+            raise ValueError(f"cost must be > 0, got {self.c}")
+        if self.epsilon <= 0:
+            raise ValueError(f"epsilon must be > 0, got {self.epsilon}")
+        if self.max_iter <= 0:
+            raise ValueError(f"max_iter must be > 0, got {self.max_iter}")
+        if self.shards < 1:
+            raise ValueError(f"shards must be >= 1, got {self.shards}")
+        if self.cache_size < 0:
+            raise ValueError(f"cache_size must be >= 0, got {self.cache_size}")
+
+
+@dataclasses.dataclass
+class TrainResult:
+    """Outcome of a training run.
+
+    Mirrors what the reference prints/writes at the end of training
+    (``svmTrainMain.cpp:313-348``): intercept b, iteration count,
+    convergence status, wall time, plus the full solver state needed to
+    build a model (alpha) and diagnostics (final optimality gap).
+    """
+
+    alpha: "object"                     # (n,) float array
+    b: float
+    n_iter: int
+    converged: bool
+    b_lo: float
+    b_hi: float
+    train_seconds: float
+    gamma: float
+    n_sv: int
+
+    @property
+    def gap(self) -> float:
+        return self.b_lo - self.b_hi

@@ -1,0 +1,6 @@
+"""Data loading: dense CSV datasets, synthetic fixtures, format converters."""
+
+from dpsvm_tpu.data.loader import load_csv, csv_shape
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor, make_mnist_like
+
+__all__ = ["load_csv", "csv_shape", "make_blobs", "make_xor", "make_mnist_like"]

@@ -1,0 +1,64 @@
+"""Dataset format converters.
+
+Python-3 equivalents of the reference's Py2 scripts:
+
+* ``libsvm_to_dense_csv`` — ``scripts/convert_adult.py:23-33``: libsvm
+  sparse lines ``<label> idx:val ...`` (1-based indices) to the dense
+  ``label,f1,...,fd`` CSV the loaders expect, labels normalized to +/-1.
+* ``mnist_to_odd_even_csv`` — ``scripts/convert_mnist_to_odd_even.py:23-29``:
+  a ``digit,p1,...,p784`` CSV to an even/odd +/-1 problem with pixels
+  scaled into [0, 1] by /255.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def libsvm_to_dense_csv(src: str, dst: str,
+                        num_attributes: Optional[int] = None) -> int:
+    """Convert a libsvm sparse file to dense CSV. Returns rows written.
+
+    When num_attributes is None it is inferred as the max feature index
+    seen in the file (the adult/a9a converter hard-codes 123).
+    """
+    rows = []
+    max_idx = 0
+    with open(src) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            label = 1 if float(parts[0]) > 0 else -1
+            feats = {}
+            for tok in parts[1:]:
+                idx_s, val_s = tok.split(":")
+                idx = int(idx_s)
+                feats[idx] = float(val_s)
+                max_idx = max(max_idx, idx)
+            rows.append((label, feats))
+    d = num_attributes if num_attributes is not None else max_idx
+    with open(dst, "w") as out:
+        for label, feats in rows:
+            dense = (repr(feats.get(j, 0.0)) for j in range(1, d + 1))
+            out.write(f"{label}," + ",".join(dense) + "\n")
+    return len(rows)
+
+
+def mnist_to_odd_even_csv(src: str, dst: str, scale: float = 255.0,
+                          has_header: bool = False) -> int:
+    """Convert a digit-labelled CSV to the even(+1)/odd(-1) binary problem."""
+    n = 0
+    with open(src) as f, open(dst, "w") as out:
+        for i, line in enumerate(f):
+            if has_header and i == 0:
+                continue
+            parts = line.strip().split(",")
+            if len(parts) < 2:
+                continue
+            digit = int(float(parts[0]))
+            label = 1 if digit % 2 == 0 else -1
+            pixels = (repr(float(p) / scale) for p in parts[1:])
+            out.write(f"{label}," + ",".join(pixels) + "\n")
+            n += 1
+    return n

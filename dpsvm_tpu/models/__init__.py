@@ -1,0 +1,13 @@
+"""Model objects and serialization."""
+
+from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
+from dpsvm_tpu.models.io import save_model, load_model
+
+__all__ = [
+    "SVMModel",
+    "decision_function",
+    "predict",
+    "evaluate",
+    "save_model",
+    "load_model",
+]

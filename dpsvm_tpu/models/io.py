@@ -1,0 +1,86 @@
+"""Model-file serialization, reference-compatible.
+
+Format (the MPI trainer's, ``svmTrainMain.cpp:386-416``):
+
+    line 1:  gamma
+    line 2:  b
+    line 3+: alpha,y,x1,...,xd        (one line per SV, alpha > 0)
+
+The reference family is internally inconsistent: ``seq.cpp`` omits the b
+line (``seq.cpp:302``) and ``seq_test.cpp`` expects only gamma before the
+SVs (``seq_test.cpp:225-226``), so the stock tester misparses the MPI
+trainer's files by one line (SURVEY §2c). This reader accepts both layouts
+by sniffing whether line 2 is a lone scalar; the writer always emits the
+full (gamma, b, SVs) form.
+
+Writing goes through the native C++ serializer when available (large
+models are many MB of text), with a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from dpsvm_tpu.models.svm import SVMModel
+from dpsvm_tpu.native import load_native_lib
+
+
+def save_model(model: SVMModel, path: str) -> int:
+    """Write the model file; returns the number of SV lines written."""
+    alpha = np.ascontiguousarray(model.alpha, np.float32)
+    y = np.ascontiguousarray(model.y_sv, np.int32)
+    x = np.ascontiguousarray(model.x_sv, np.float32)
+    n, d = x.shape
+    lib = load_native_lib()
+    if lib is not None:
+        wrote = lib.dpsvm_write_model(
+            path.encode(), float(model.gamma), float(model.b),
+            alpha.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, d)
+        if wrote >= 0:
+            return int(wrote)
+    with open(path, "w") as f:
+        f.write(f"{model.gamma:g}\n{model.b:g}\n")
+        wrote = 0
+        for i in range(n):
+            if not alpha[i] > 0:
+                continue
+            row = ",".join(f"{v:.9g}" for v in x[i])
+            f.write(f"{alpha[i]:.9g},{int(y[i])},{row}\n")
+            wrote += 1
+    return wrote
+
+
+def load_model(path: str) -> SVMModel:
+    """Read a model file (with or without the b line)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    if len(lines) < 2:
+        raise ValueError(f"{path}: not a model file (needs gamma + SVs)")
+    gamma = float(lines[0])
+    has_b = "," not in lines[1]
+    b = float(lines[1]) if has_b else 0.0
+    sv_lines = lines[2:] if has_b else lines[1:]
+    if not sv_lines:
+        raise ValueError(f"{path}: model has no support vectors")
+    n_sv = len(sv_lines)
+    d = sv_lines[0].count(",") - 1
+    alpha = np.empty((n_sv,), np.float32)
+    y = np.empty((n_sv,), np.int32)
+    x = np.empty((n_sv, d), np.float32)
+    for i, ln in enumerate(sv_lines):
+        parts = ln.split(",")
+        if len(parts) != d + 2:
+            raise ValueError(f"{path}: SV line {i} has {len(parts)} fields, "
+                             f"expected {d + 2}")
+        alpha[i] = float(parts[0])
+        y[i] = int(float(parts[1]))
+        x[i] = np.asarray(parts[2:], dtype=np.float32)
+    return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=b, gamma=gamma)

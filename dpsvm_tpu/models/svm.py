@@ -1,0 +1,113 @@
+"""Trained-model representation and batched XLA inference.
+
+The reference evaluates one test point at a time — an SGEMV against the SV
+matrix per example on GPU (``svmTrain.cu:640-652``) or a doubly-nested
+host loop with a fresh RBF per (example, SV) pair (``seq_test.cpp:187-210``).
+On TPU the whole evaluation is one ``(m, d) @ (d, n_sv)`` MXU matmul with a
+fused RBF epilogue and a reduction against alpha*y — batched, not per
+example.
+
+Decision rule parity: prediction is +1 iff dual >= 0 (``svmTrain.cu:650-656``).
+The trainer's accuracy subtracts the intercept (``dual -= b``,
+``svmTrain.cu:648``) while the standalone tester drops it
+(``seq_test.cpp:197`` commented out); ``include_b`` selects, default True.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpsvm_tpu.config import TrainResult
+from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
+
+
+@dataclasses.dataclass
+class SVMModel:
+    """Support vectors + duals: everything the model file holds
+    (gamma, b, then per-SV alpha, y, x — ``svmTrainMain.cpp:386-416``)."""
+
+    x_sv: np.ndarray      # (n_sv, d) float32
+    alpha: np.ndarray     # (n_sv,) float32, all > 0
+    y_sv: np.ndarray      # (n_sv,) int32 +/-1
+    b: float
+    gamma: float
+
+    @property
+    def n_sv(self) -> int:
+        return int(self.x_sv.shape[0])
+
+    @property
+    def num_attributes(self) -> int:
+        return int(self.x_sv.shape[1])
+
+    @classmethod
+    def from_train_result(cls, x: np.ndarray, y: np.ndarray,
+                          result: TrainResult) -> "SVMModel":
+        """Compact SVs (alpha > 0) out of the full training set — the
+        ``aggregate_sv`` step (``svmTrain.cu:595-631``) as one boolean mask."""
+        alpha = np.asarray(result.alpha, dtype=np.float32)
+        keep = alpha > 0
+        return cls(
+            x_sv=np.ascontiguousarray(np.asarray(x, np.float32)[keep]),
+            alpha=alpha[keep],
+            y_sv=np.asarray(y, np.int32)[keep],
+            b=float(result.b),
+            gamma=float(result.gamma),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("include_b",))
+def _decision_jit(x_test, x_sv, coef, sv2, b, gamma, include_b: bool):
+    t2 = row_norms_sq(x_test)
+    k = kernel_rows(x_test, t2, x_sv, sv2, gamma)     # (m, n_sv)
+    dual = k @ coef
+    if include_b:
+        dual = dual - b
+    return dual
+
+
+def decision_function(model: SVMModel, x_test: np.ndarray,
+                      include_b: bool = True,
+                      batch_size: Optional[int] = 8192) -> np.ndarray:
+    """dual_i = sum_j alpha_j y_j K(x_j, t_i) [- b], batched on the MXU."""
+    x_test = np.asarray(x_test, np.float32)
+    coef = jnp.asarray(model.alpha * model.y_sv.astype(np.float32))
+    x_sv = jnp.asarray(model.x_sv)
+    sv2 = row_norms_sq(x_sv)
+    m = x_test.shape[0]
+    if batch_size is None or m <= batch_size:
+        return np.asarray(_decision_jit(
+            jnp.asarray(x_test), x_sv, coef, sv2,
+            jnp.float32(model.b), jnp.float32(model.gamma), include_b))
+    # Pad to a full batch grid so jit compiles exactly once.
+    out = np.empty((m,), np.float32)
+    for lo in range(0, m, batch_size):
+        hi = min(lo + batch_size, m)
+        block = np.zeros((batch_size, x_test.shape[1]), np.float32)
+        block[: hi - lo] = x_test[lo:hi]
+        vals = np.asarray(_decision_jit(
+            jnp.asarray(block), x_sv, coef, sv2,
+            jnp.float32(model.b), jnp.float32(model.gamma), include_b))
+        out[lo:hi] = vals[: hi - lo]
+    return out
+
+
+def predict(model: SVMModel, x_test: np.ndarray,
+            include_b: bool = True) -> np.ndarray:
+    """+1 iff dual >= 0 (svmTrain.cu:650-656)."""
+    dual = decision_function(model, x_test, include_b=include_b)
+    return np.where(dual < 0, -1, 1).astype(np.int32)
+
+
+def evaluate(model: SVMModel, x_test: np.ndarray, y_test: np.ndarray,
+             include_b: bool = True) -> float:
+    """Fraction of correct predictions (get_train_accuracy /
+    get_test_accuracy semantics)."""
+    pred = predict(model, x_test, include_b=include_b)
+    return float(np.mean(pred == np.asarray(y_test, np.int32)))

@@ -1,0 +1,12 @@
+"""Native (C++) components of dpsvm_tpu, loaded via ctypes.
+
+The reference framework's entire run path is native C++/CUDA; here the
+compute path is XLA-compiled and the native layer covers host-side I/O
+(CSV parsing, model serialization) where the reference used ``parse.cpp``
+and ``write_out_model``. See ``build.py`` for the compile-on-first-use
+machinery and ``csv_loader.cpp`` for the exported C ABI.
+"""
+
+from dpsvm_tpu.native.build import load_native_lib
+
+__all__ = ["load_native_lib"]

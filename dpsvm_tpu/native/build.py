@@ -1,0 +1,90 @@
+"""Compile-on-first-use loader for the native C++ helpers.
+
+Builds ``csv_loader.cpp`` into a shared library with g++ the first time it
+is needed (or whenever the source is newer than the cached .so) and loads
+it via ctypes. Everything degrades gracefully: if no compiler is present
+or the build fails, callers get ``None`` and fall back to pure-NumPy
+implementations, so the framework has no hard native dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "csv_loader.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB = os.path.join(_BUILD_DIR, "libdpsvm_native.so")
+
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _compile() -> bool:
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        return False
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _LIB + ".tmp"
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, OSError):
+        return False
+    os.replace(tmp, _LIB)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_float_p = ctypes.POINTER(ctypes.c_float)
+    c_int_p = ctypes.POINTER(ctypes.c_int)
+    c_long_p = ctypes.POINTER(ctypes.c_long)
+
+    lib.dpsvm_csv_shape.argtypes = [ctypes.c_char_p, c_long_p, c_long_p]
+    lib.dpsvm_csv_shape.restype = ctypes.c_int
+
+    lib.dpsvm_parse_csv.argtypes = [
+        ctypes.c_char_p, c_float_p, c_int_p, ctypes.c_long, ctypes.c_long,
+    ]
+    lib.dpsvm_parse_csv.restype = ctypes.c_long
+
+    lib.dpsvm_write_model.argtypes = [
+        ctypes.c_char_p, ctypes.c_double, ctypes.c_double,
+        c_float_p, c_int_p, c_float_p, ctypes.c_long, ctypes.c_long,
+    ]
+    lib.dpsvm_write_model.restype = ctypes.c_long
+    return lib
+
+
+def load_native_lib() -> Optional[ctypes.CDLL]:
+    """Return the native helper library, building it if necessary.
+
+    Returns None (and remembers the failure) when the library cannot be
+    built or loaded; callers must fall back to pure-Python paths.
+    """
+    global _cached, _failed
+    if os.environ.get("DPSVM_NO_NATIVE"):
+        return None
+    if _cached is not None:
+        return _cached
+    if _failed:
+        return None
+    with _lock:
+        if _cached is not None or _failed:
+            return _cached
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+            if stale and not _compile():
+                _failed = True
+                return None
+            _cached = _bind(ctypes.CDLL(_LIB))
+        except OSError:
+            _failed = True
+            return None
+    return _cached

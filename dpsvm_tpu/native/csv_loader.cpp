@@ -1,0 +1,149 @@
+// Native CSV data loader / model writer for dpsvm_tpu.
+//
+// TPU-native equivalent of the reference's C++ data path:
+//   * parse.cpp:10-43  (populate_data: dense "label,f1,...,fd" CSV ->
+//     flat row-major float x[n*d] + int y[n])
+//   * svmTrainMain.cpp:386-416 (write_out_model: gamma line, b line,
+//     one "alpha,y,x..." line per support vector)
+//
+// Exposed as a plain C ABI consumed from Python via ctypes (no pybind11 in
+// this image). The Python wrapper in dpsvm_tpu/data/loader.py compiles this
+// file on first use with g++ and falls back to a pure-NumPy parser when no
+// compiler is available, so the framework never hard-depends on the binary.
+//
+// Unlike the reference loader, which exits the process on a missing file
+// (parse.cpp:17) and trusts the caller-supplied -a/-x shape flags, this one
+// returns error codes and can discover the shape itself (dpsvm_csv_shape).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+namespace {
+
+// Read one '\n'-terminated line of unbounded length into buf (grown as
+// needed). Returns length, or -1 on EOF with nothing read.
+long read_line(FILE* f, char** buf, size_t* cap) {
+    long len = 0;
+    for (;;) {
+        if ((size_t)len + 2 > *cap) {
+            size_t ncap = (*cap == 0) ? 1 << 16 : (*cap * 2);
+            char* nbuf = (char*)realloc(*buf, ncap);
+            if (!nbuf) return -2;
+            *buf = nbuf;
+            *cap = ncap;
+        }
+        int c = fgetc(f);
+        if (c == EOF) {
+            if (len == 0) return -1;
+            break;
+        }
+        if (c == '\n') break;
+        (*buf)[len++] = (char)c;
+    }
+    (*buf)[len] = '\0';
+    return len;
+}
+
+bool blank(const char* s) {
+    for (; *s; ++s)
+        if (*s != ' ' && *s != '\t' && *s != '\r') return false;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Discover (rows, cols) of a dense CSV. cols includes the label column.
+// Returns 0 on success, -1 if the file cannot be opened, -2 on alloc failure.
+int dpsvm_csv_shape(const char* path, long* rows, long* cols) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    char* buf = nullptr;
+    size_t cap = 0;
+    long n = 0, d = 0;
+    for (;;) {
+        long len = read_line(f, &buf, &cap);
+        if (len == -2) { fclose(f); free(buf); return -2; }
+        if (len < 0) break;
+        if (blank(buf)) continue;
+        if (n == 0) {
+            d = 1;
+            for (const char* p = buf; *p; ++p)
+                if (*p == ',') ++d;
+        }
+        ++n;
+    }
+    free(buf);
+    fclose(f);
+    *rows = n;
+    *cols = d;
+    return 0;
+}
+
+// Parse up to max_rows lines of "label,f1,...,fd" into x_out (row-major
+// n*d floats) and y_out (n ints). d = num_attributes (label not counted).
+// Returns the number of rows parsed, or a negative error code:
+//   -1 open failure, -2 alloc failure, -3 malformed row (too few fields).
+long dpsvm_parse_csv(const char* path, float* x_out, int* y_out,
+                     long max_rows, long num_attributes) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    char* buf = nullptr;
+    size_t cap = 0;
+    long n = 0;
+    while (n < max_rows) {
+        long len = read_line(f, &buf, &cap);
+        if (len == -2) { fclose(f); free(buf); return -2; }
+        if (len < 0) break;
+        if (blank(buf)) continue;
+        char* p = buf;
+        char* end = nullptr;
+        // Label: the reference stores it as int (parse.cpp reads into
+        // vector<int> y); accept float spellings like "1.0" or "+1".
+        float label = strtof(p, &end);
+        if (end == p) { fclose(f); free(buf); return -3; }
+        y_out[n] = (int)label;
+        p = end;
+        float* row = x_out + n * num_attributes;
+        for (long j = 0; j < num_attributes; ++j) {
+            while (*p == ',' || *p == ' ' || *p == '\t') ++p;
+            if (*p == '\0' || *p == '\r') { fclose(f); free(buf); return -3; }
+            row[j] = strtof(p, &end);
+            if (end == p) { fclose(f); free(buf); return -3; }
+            p = end;
+        }
+        ++n;
+    }
+    free(buf);
+    fclose(f);
+    return n;
+}
+
+// Write a model file: gamma line, b line, then one "alpha,y,x1,...,xd" line
+// per support vector (alpha > 0). Matches the (fixed) reference format of
+// svmTrainMain.cpp:386-416. Returns the number of SVs written, or -1 on
+// open failure.
+long dpsvm_write_model(const char* path, double gamma, double b,
+                       const float* alpha, const int* y, const float* x,
+                       long n, long d) {
+    FILE* f = fopen(path, "wb");
+    if (!f) return -1;
+    fprintf(f, "%g\n", gamma);
+    fprintf(f, "%g\n", b);
+    long n_sv = 0;
+    for (long i = 0; i < n; ++i) {
+        if (!(alpha[i] > 0.0f)) continue;
+        fprintf(f, "%.9g,%d", alpha[i], y[i]);
+        const float* row = x + i * d;
+        for (long j = 0; j < d; ++j) fprintf(f, ",%.9g", row[j]);
+        fputc('\n', f);
+        ++n_sv;
+    }
+    fclose(f);
+    return n_sv;
+}
+
+}  // extern "C"

@@ -1,0 +1,11 @@
+"""Device-side primitives shared by the single-device and distributed solvers."""
+
+from dpsvm_tpu.ops.kernels import row_norms_sq, rbf_rows_from_dots
+from dpsvm_tpu.ops.selection import iup_ilow_masks, masked_extrema
+
+__all__ = [
+    "row_norms_sq",
+    "rbf_rows_from_dots",
+    "iup_ilow_masks",
+    "masked_extrema",
+]

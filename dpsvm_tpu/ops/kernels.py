@@ -1,0 +1,42 @@
+"""RBF kernel math on the MXU.
+
+The reference computes kernel rows as one cuBLAS SGEMV per working-set
+index on its own CUDA stream (``svmTrain.cu:216-249``) and then applies
+exp(-gamma (|x_i|^2 + |x_a|^2 - 2 dot)) elementwise in a Thrust functor
+(``svmTrain.cu:128-135``). Here both working rows go through a single
+``(2, d) @ (d, n)`` matmul — on TPU the MXU wants one batched contraction,
+not two streamed vector products — and XLA fuses the exp/scale elementwise
+epilogue into the same kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def row_norms_sq(x: jax.Array, precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """|x_i|^2 per row, one fused reduction.
+
+    (The reference does this as n separate device-wide
+    ``thrust::inner_product`` calls in a host loop, ``svmTrain.cu:361-364``.)
+    """
+    return jnp.einsum("ij,ij->i", x, x, precision=precision)
+
+
+def rbf_rows_from_dots(dots: jax.Array, w2: jax.Array, x2: jax.Array,
+                       gamma) -> jax.Array:
+    """K(a, i) = exp(-gamma (|x_i|^2 + |x_a|^2 - 2 x_a.x_i)).
+
+    dots: (r, n) dot products of r working rows against all points;
+    w2: (r,) squared norms of the working rows; x2: (n,).
+    Exactly the ``update_functor`` expression (``svmTrain.cu:128-135``).
+    """
+    return jnp.exp(-gamma * (x2[None, :] + w2[:, None] - 2.0 * dots))
+
+
+def kernel_rows(rows: jax.Array, w2: jax.Array, x: jax.Array, x2: jax.Array,
+                gamma, precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """Full RBF kernel rows for the given working rows: (r, n)."""
+    dots = jnp.matmul(rows, x.T, precision=precision)
+    return rbf_rows_from_dots(dots, w2, x2, gamma)

@@ -1,0 +1,67 @@
+"""Fixed-shape HBM kernel-row cache.
+
+TPU-native equivalent of the reference's ``myCache`` (``cache.cu``): the
+reference keeps ``max_size`` device vectors of per-shard kernel-row *dot
+products*, a ``std::map`` key index and a ``std::list`` recency queue with
+LRU eviction (``cache.cu:49-105``). Dynamic host-side containers cannot
+exist inside a jitted loop, so here the cache is three fixed-shape arrays
+carried through ``lax.while_loop``:
+
+* ``rows``   (lines, n)  cached dot-product rows (same payload the
+                         reference caches — RBF exp is always re-applied,
+                         matching ``update_functor``),
+* ``keys``   (lines,)    which working-set index each line holds (-1 empty),
+* ``stamps`` (lines,)    last-use tick for LRU eviction,
+
+plus a scalar ``tick``. A hit skips the matmul via ``lax.cond``; a miss
+computes the row and overwrites the least-recently-used line. Unlike the
+reference's ``order.remove(key)`` linear list scan per hit
+(``cache.cu:68``), hit bookkeeping here is O(lines) vectorized compares.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class RowCache(NamedTuple):
+    keys: jax.Array     # (lines,) int32, -1 = empty
+    stamps: jax.Array   # (lines,) int32 last-use tick
+    rows: jax.Array     # (lines, n) float32 dot products
+    tick: jax.Array     # () int32
+
+
+def cache_init(lines: int, n: int, dtype=jnp.float32) -> RowCache:
+    return RowCache(
+        keys=jnp.full((lines,), -1, dtype=jnp.int32),
+        stamps=jnp.zeros((lines,), dtype=jnp.int32),
+        rows=jnp.zeros((lines, n), dtype=dtype),
+        tick=jnp.int32(0),
+    )
+
+
+def cache_fetch(cache: RowCache, key: jax.Array,
+                compute: Callable[[], jax.Array]
+                ) -> Tuple[jax.Array, RowCache]:
+    """Return the dot-product row for ``key``, from cache or computed.
+
+    ``compute`` is only executed on a miss (lax.cond), mirroring
+    ``SvmTrain::lookup_cache`` -> hit / ``get_new_cache_line`` + SGEMV
+    (``svmTrain.cu:203-222``, ``cache.cu:62-105``).
+    """
+    key = key.astype(jnp.int32)
+    hit_mask = cache.keys == key
+    hit = jnp.any(hit_mask)
+    line = jnp.where(hit, jnp.argmax(hit_mask), jnp.argmin(cache.stamps))
+    row = lax.cond(hit, lambda: cache.rows[line], compute)
+    tick = cache.tick + 1
+    return row, RowCache(
+        keys=cache.keys.at[line].set(key),
+        stamps=cache.stamps.at[line].set(tick),
+        rows=cache.rows.at[line].set(row),
+        tick=tick,
+    )

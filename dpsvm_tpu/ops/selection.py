@@ -1,0 +1,59 @@
+"""Working-set selection: Keerthi index sets + first-order extrema.
+
+XLA-native form of the reference's fused classify+reduce
+(``arbitrary_functor`` ``svmTrain.cu:41-95`` + ``my_maxmin`` reduce
+``svmTrain.cu:400-467``): membership masks become a ``jnp.where`` with the
+same +/-1e9 sentinels, and the joint (argmin, argmax) is two fused XLA
+reductions. Tie-break is first-index-wins (see oracle docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dpsvm_tpu.config import SENTINEL
+
+
+def iup_ilow_masks(alpha: jax.Array, y: jax.Array, c
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Membership in I_up / I_low (svmTrain.cu:54-91 semantics).
+
+    y is the float +/-1 label vector. Exact ==0 / ==C comparisons mirror
+    the reference; clipping writes exactly 0.0 or C so they are well posed.
+    """
+    at0 = alpha == 0.0
+    atc = alpha == c
+    interior = ~at0 & ~atc
+    pos = y > 0
+    in_up = interior | (at0 & pos) | (atc & ~pos)
+    in_low = interior | (at0 & ~pos) | (atc & pos)
+    return in_up, in_low
+
+
+def masked_scores(alpha: jax.Array, y: jax.Array, f: jax.Array, c,
+                  valid: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """(f_up, f_low): f with non-members pushed to +/-SENTINEL.
+
+    ``valid`` masks out padding rows (used when n is padded to a multiple
+    of the mesh size); padded rows belong to neither set.
+    """
+    in_up, in_low = iup_ilow_masks(alpha, y, c)
+    if valid is not None:
+        in_up = in_up & valid
+        in_low = in_low & valid
+    f_up = jnp.where(in_up, f, jnp.float32(SENTINEL))
+    f_low = jnp.where(in_low, f, jnp.float32(-SENTINEL))
+    return f_up, f_low
+
+
+def masked_extrema(alpha: jax.Array, y: jax.Array, f: jax.Array, c,
+                   valid: Optional[jax.Array] = None):
+    """(i_hi, b_hi, i_lo, b_lo): first-order working set over this block."""
+    f_up, f_low = masked_scores(alpha, y, f, c, valid)
+    i_hi = jnp.argmin(f_up)
+    i_lo = jnp.argmax(f_low)
+    return i_hi, f_up[i_hi], i_lo, f_low[i_lo]

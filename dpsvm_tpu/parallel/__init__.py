@@ -1,0 +1,6 @@
+"""Distributed execution: device mesh helpers + shard_map SMO solver."""
+
+from dpsvm_tpu.parallel.mesh import make_data_mesh
+from dpsvm_tpu.parallel.dist_smo import train_distributed
+
+__all__ = ["make_data_mesh", "train_distributed"]

@@ -1,0 +1,266 @@
+"""Distributed SMO: SPMD shard_map over a 1-D device mesh.
+
+TPU-native redesign of the reference's MPI layer (``svmTrainMain.cpp``,
+SURVEY CS-1). Mapping:
+
+* contiguous example shards, ceil(n/P) per rank with the remainder on the
+  last (``svmTrainMain.cpp:367-384``)  ->  equal shards of n padded to a
+  multiple of P, with a validity mask (padding belongs to no index set);
+* per-iteration ``MPI::Allgather`` of each rank's 4-float extreme tuple +
+  identical global scan on every rank (``svmTrainMain.cpp:244-277``)  ->
+  ``lax.all_gather`` of per-shard (b_hi, b_lo) / (i_hi, i_lo) inside the
+  compiled loop + replicated argmin/argmax (first shard wins ties, like
+  the reference's strict comparisons);
+* every rank holding the FULL dataset (``svmTrainMain.cpp:180``,
+  ``svmTrain.cu:344``)  ->  X row-sharded over the mesh (``shard_x=True``;
+  this removes the reference's O(n d) per-device memory ceiling), with the
+  two working rows broadcast by a masked ``psum`` of a (2, d+3) pack —
+  rows plus the owner's (x^2, y, alpha) scalars. ``shard_x=False``
+  reproduces the replicated layout;
+* the whole loop stays inside ONE jitted program: no per-iteration MPI or
+  host latency, the collectives ride ICI/DCN between XLA ops.
+
+alpha and f are always sharded (the reference shards f but replicates
+alpha, ``svmTrain.cu:349,374-380``; sharding both is strictly less state).
+eta's three kernel evaluations are read from the owner shards' K rows via
+a second tiny psum — the reference recomputes them on the host with CBLAS
+each iteration (``svmTrainMain.cpp:282``, a quirk this design deletes).
+
+Single-device parity: with P=1 every collective degenerates to identity
+and this program computes exactly solver/smo.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
+from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
+from dpsvm_tpu.ops.selection import masked_extrema
+from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+from dpsvm_tpu.utils.logging import log_progress
+
+
+class DistCarry(NamedTuple):
+    alpha: jax.Array    # (n_pad,) sharded over "shard"
+    f: jax.Array        # (n_pad,) sharded
+    b_hi: jax.Array     # () replicated
+    b_lo: jax.Array     # () replicated
+    n_iter: jax.Array   # () i32 replicated
+
+
+def _owner_read(arr: jax.Array, local_idx, is_owner) -> jax.Array:
+    """Value of arr[local_idx] on the owning shard, zeros elsewhere
+    (to be summed across shards by the caller's psum)."""
+    return jnp.where(is_owner, arr[local_idx], jnp.zeros_like(arr[local_idx]))
+
+
+def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
+               c: float, gamma: float, n_per_shard: int, shard_x: bool,
+               precision) -> DistCarry:
+    """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
+    slices when shard_x else full replicated arrays."""
+    alpha_s, f_s = carry.alpha, carry.f
+    rank = lax.axis_index(SHARD_AXIS)
+
+    # --- local working-set extrema (CS-2) ---
+    li_hi, lb_hi, li_lo, lb_lo = masked_extrema(alpha_s, ys, f_s, c, valid)
+    gi_hi = li_hi.astype(jnp.int32) + rank * n_per_shard
+    gi_lo = li_lo.astype(jnp.int32) + rank * n_per_shard
+
+    # --- global selection: all_gather + replicated scan (CS-1) ---
+    fv = lax.all_gather(jnp.stack([lb_hi, lb_lo]), SHARD_AXIS)     # (Pn, 2)
+    iv = lax.all_gather(jnp.stack([gi_hi, gi_lo]), SHARD_AXIS)     # (Pn, 2)
+    p_hi = jnp.argmin(fv[:, 0])
+    p_lo = jnp.argmax(fv[:, 1])
+    b_hi = fv[p_hi, 0]
+    b_lo = fv[p_lo, 1]
+    i_hi_g = iv[p_hi, 0]
+    i_lo_g = iv[p_lo, 1]
+
+    loc_hi = i_hi_g - p_hi * n_per_shard
+    loc_lo = i_lo_g - p_lo * n_per_shard
+    own_hi = rank == p_hi
+    own_lo = rank == p_lo
+
+    # --- broadcast working rows + owner scalars: one psum of (2, d+3) ---
+    if shard_x:
+        row_hi = _owner_read(xs, loc_hi, own_hi)
+        row_lo = _owner_read(xs, loc_lo, own_lo)
+        x2_hi_c = _owner_read(x2s, loc_hi, own_hi)
+        x2_lo_c = _owner_read(x2s, loc_lo, own_lo)
+    else:
+        row_hi = xs[i_hi_g]
+        row_lo = xs[i_lo_g]
+        x2_hi_c = jnp.where(own_hi, x2s[i_hi_g], 0.0)
+        x2_lo_c = jnp.where(own_lo, x2s[i_lo_g], 0.0)
+    pack = jnp.stack([
+        jnp.concatenate([
+            jnp.zeros_like(row_hi) if not shard_x else row_hi,
+            jnp.stack([x2_hi_c,
+                       _owner_read(ys, loc_hi, own_hi),
+                       _owner_read(alpha_s, loc_hi, own_hi)])]),
+        jnp.concatenate([
+            jnp.zeros_like(row_lo) if not shard_x else row_lo,
+            jnp.stack([x2_lo_c,
+                       _owner_read(ys, loc_lo, own_lo),
+                       _owner_read(alpha_s, loc_lo, own_lo)])]),
+    ])
+    pack = lax.psum(pack, SHARD_AXIS)
+    d = xs.shape[-1]
+    rows = pack[:, :d] if shard_x else jnp.stack([row_hi, row_lo])
+    w2 = pack[:, d]
+    y_hi, y_lo = pack[0, d + 1], pack[1, d + 1]
+    a_hi, a_lo = pack[0, d + 2], pack[1, d + 2]
+
+    # --- kernel rows on the local slice: (2, d) @ (d, n_s) (CS-3) ---
+    dots = jnp.matmul(rows, xs.T, precision=precision)
+    if shard_x:
+        k = rbf_rows_from_dots(dots, w2, x2s, gamma)               # (2, n_s)
+        k_pack = lax.psum(jnp.stack([
+            _owner_read(k[0], loc_hi, own_hi),   # K(hi, hi)
+            _owner_read(k[1], loc_lo, own_lo),   # K(lo, lo)
+            _owner_read(k[0], loc_lo, own_lo),   # K(hi, lo)
+        ]), SHARD_AXIS)
+        k_hh, k_ll, k_hl = k_pack[0], k_pack[1], k_pack[2]
+        k_local = k
+    else:
+        k_full = rbf_rows_from_dots(dots, w2, x2s, gamma)          # (2, n_pad)
+        k_hh = k_full[0, i_hi_g]
+        k_ll = k_full[1, i_lo_g]
+        k_hl = k_full[0, i_lo_g]
+        k_local = lax.dynamic_slice_in_dim(
+            k_full, rank * n_per_shard, n_per_shard, axis=1)
+    eta = k_hh + k_ll - 2.0 * k_hl
+
+    # --- alpha update: replicated scalar math (svmTrainMain.cpp:282-295) ---
+    s = y_lo * y_hi
+    a_lo_u = a_lo + y_lo * (b_hi - b_lo) / eta
+    a_hi_u = a_hi + s * (a_lo - a_lo_u)
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+
+    # masked writeback, lo then hi (train_step2 order, svmTrain.cu:491-492)
+    alpha_s = alpha_s.at[loc_lo].set(
+        jnp.where(own_lo, a_lo_n, alpha_s[loc_lo]))
+    alpha_s = alpha_s.at[loc_hi].set(
+        jnp.where(own_hi, a_hi_n, alpha_s[loc_hi]))
+
+    f_s = (f_s + (a_hi_n - a_hi) * y_hi * k_local[0]
+               + (a_lo_n - a_lo) * y_lo * k_local[1])
+
+    return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
+                       epsilon: float, n_per_shard: int, shard_x: bool,
+                       precision_name: str):
+    precision = getattr(lax.Precision, precision_name)
+    x_spec = P(SHARD_AXIS) if shard_x else P()
+
+    def run(carry: DistCarry, xs, ys, x2s, valid, limit):
+        def cond(s: DistCarry):
+            return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
+
+        def body(s: DistCarry):
+            return _dist_step(s, xs, ys, x2s, valid, c=c, gamma=gamma,
+                              n_per_shard=n_per_shard, shard_x=shard_x,
+                              precision=precision)
+
+        # b_hi/b_lo come out of the loop body via all_gather, which types
+        # them as axis-varying under shard_map's VMA checks; mark the
+        # initial values to match, and fold back to invariant (the values
+        # are replicated-equal by construction) with a pmax on exit.
+        carry = carry._replace(
+            b_hi=lax.pcast(carry.b_hi, (SHARD_AXIS,), to="varying"),
+            b_lo=lax.pcast(carry.b_lo, (SHARD_AXIS,), to="varying"))
+        out = lax.while_loop(cond, body, carry)
+        return out._replace(b_hi=lax.pmax(out.b_hi, SHARD_AXIS),
+                            b_lo=lax.pmax(out.b_lo, SHARD_AXIS))
+
+    carry_specs = DistCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
+                            b_hi=P(), b_lo=P(), n_iter=P())
+    mapped = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec, P(SHARD_AXIS),
+                  P()),
+        out_specs=carry_specs)
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
+                      mesh: Optional[jax.sharding.Mesh] = None) -> TrainResult:
+    """Train over a 1-D device mesh; data arrives/leaves as host NumPy."""
+    config.validate()
+    n, d = x.shape
+    if mesh is None:
+        mesh = make_data_mesh(config.shards)
+    p = mesh.devices.size      # the mesh, not config.shards, is authoritative
+    gamma = float(config.resolve_gamma(d))
+    eps = float(config.epsilon)
+
+    n_pad = ((n + p - 1) // p) * p
+    n_s = n_pad // p
+    xp = np.zeros((n_pad, d), np.float32)
+    xp[:n] = x
+    yp = np.zeros((n_pad,), np.float32)
+    yp[:n] = y
+    valid = np.arange(n_pad) < n
+
+    shard = NamedSharding(mesh, P(SHARD_AXIS))
+    repl = NamedSharding(mesh, P())
+    x_sharding = shard if config.shard_x else repl
+
+    xd = jax.device_put(jnp.asarray(xp), x_sharding)
+    yd = jax.device_put(jnp.asarray(yp), shard)
+    x2 = jax.device_put(row_norms_sq(jnp.asarray(xp)), x_sharding)
+    validd = jax.device_put(jnp.asarray(valid), shard)
+
+    carry = DistCarry(
+        alpha=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard),
+        f=jax.device_put(jnp.asarray(-yp), shard),
+        b_hi=jax.device_put(jnp.float32(-SENTINEL), repl),
+        b_lo=jax.device_put(jnp.float32(SENTINEL), repl),
+        n_iter=jax.device_put(jnp.int32(0), repl),
+    )
+
+    runner = _build_dist_runner(mesh, float(config.c), gamma, eps, n_s,
+                                bool(config.shard_x),
+                                config.matmul_precision.upper())
+
+    t0 = time.perf_counter()
+    while True:
+        limit = jax.device_put(
+            jnp.int32(min(int(carry.n_iter) + config.chunk_iters,
+                          config.max_iter)), repl)
+        carry = runner(carry, xd, yd, x2, validd, limit)
+        n_iter = int(carry.n_iter)
+        b_lo = float(carry.b_lo)
+        b_hi = float(carry.b_hi)
+        converged = not (b_lo > b_hi + 2.0 * eps)
+        done = converged or n_iter >= config.max_iter
+        log_progress(config, n_iter, b_lo, b_hi, final=done)
+        if done:
+            break
+
+    alpha = np.asarray(carry.alpha)[:n]
+    return TrainResult(
+        alpha=alpha,
+        b=(b_lo + b_hi) / 2.0,
+        n_iter=n_iter,
+        converged=converged,
+        b_lo=b_lo,
+        b_hi=b_hi,
+        train_seconds=time.perf_counter() - t0,
+        gamma=gamma,
+        n_sv=int(np.sum(alpha > 0)),
+    )

@@ -1,0 +1,34 @@
+"""Device-mesh construction for the 1-D data-parallel axis.
+
+The reference's process topology is ``mpirun -np P`` + a hostfile of
+one-GPU nodes (``hf:1-11``, ``Makefile:74``), with cluster size fixed at
+``MPI::COMM_WORLD.Get_size()`` (``svmTrainMain.cpp:153``). The TPU-native
+equivalent is a 1-D ``jax.sharding.Mesh`` over axis ``"shard"``: within a
+slice the per-iteration collectives ride ICI; across hosts/slices JAX's
+runtime routes them over DCN after ``jax.distributed.initialize`` (which
+multi-host launchers call before building the mesh — same SPMD program
+either way).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+SHARD_AXIS = "shard"
+
+
+def make_data_mesh(shards: int,
+                   devices: Optional[Sequence[jax.Device]] = None
+                   ) -> jax.sharding.Mesh:
+    """A 1-D mesh of ``shards`` devices along axis ``"shard"``."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < shards:
+        raise ValueError(
+            f"need {shards} devices for {shards} shards, have {len(devices)} "
+            f"({[d.platform for d in devices[:4]]}...). For CPU-simulated "
+            f"meshes set XLA_FLAGS=--xla_force_host_platform_device_count=N.")
+    return jax.make_mesh((shards,), (SHARD_AXIS,),
+                         devices=list(devices)[:shards])

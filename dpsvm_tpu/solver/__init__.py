@@ -1,0 +1,6 @@
+"""SMO solvers: NumPy oracle, single-device XLA, distributed shard_map."""
+
+from dpsvm_tpu.solver.oracle import smo_reference
+from dpsvm_tpu.solver.smo import train_single_device
+
+__all__ = ["smo_reference", "train_single_device"]

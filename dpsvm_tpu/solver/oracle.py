@@ -1,0 +1,138 @@
+"""NumPy golden-reference SMO solver.
+
+Role-equivalent of the reference's sequential trainer ``seq.cpp`` (the
+readable single-threaded implementation used to validate the accelerated
+path — SURVEY §4.2), with semantics matched to the *distributed* trainer,
+which is the canonical one:
+
+* index sets I_up / I_low per Keerthi (``seq.cpp:469-553``; fused GPU form
+  ``svmTrain.cu:54-91`` with the +/-1e9 sentinels reproduced here);
+* first-order working-set selection: I_hi = argmin_{I_up} f,
+  I_lo = argmax_{I_low} f (``svmTrain.cu:476-481``);
+* eta = K(hi,hi) + K(lo,lo) - 2 K(hi,lo) (``svmTrainMain.cpp:282``);
+* alpha_lo' = alpha_lo + y_lo (b_hi - b_lo)/eta;
+  alpha_hi' = alpha_hi + s (alpha_lo - alpha_lo') with s = y_lo y_hi,
+  using the UNCLIPPED alpha_lo'; then both independently clipped to [0, C]
+  (``svmTrainMain.cpp:289-295`` — deliberately not the textbook pairwise
+  box clip; reproduced bit-for-bit for parity);
+* f_i += dAlpha_hi y_hi K(hi, i) + dAlpha_lo y_lo K(lo, i) with
+  K(a, i) = exp(-gamma (|x_i|^2 + |x_a|^2 - 2 x_a.x_i))
+  (``svmTrain.cu:128-135``);
+* do-while loop: the update is applied on the iteration that detects
+  convergence, and the loop exits when NOT (b_lo > b_hi + 2 eps) or the
+  iteration cap is hit (``svmTrainMain.cpp:310``); b = (b_lo + b_hi)/2
+  (``svmTrainMain.cpp:329``).
+
+Arithmetic is float32 throughout (the reference is all-float32) so that
+the XLA solver can be compared against it tightly. Tie-breaking for
+argmin/argmax is first-index-wins — the reference's Thrust reduce order is
+nondeterministic on ties (``svmTrain.cu:400-467``), so this framework
+standardizes the rule across oracle, single-device, and distributed paths.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
+
+
+def iup_ilow_masks(alpha: np.ndarray, y: np.ndarray, c: float
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Keerthi index-set membership masks (svmTrain.cu:54-91 semantics).
+
+    alpha == 0, y == +1 -> I_up only;  alpha == 0, y == -1 -> I_low only;
+    alpha == C, y == -1 -> I_up only;  alpha == C, y == +1 -> I_low only;
+    0 < alpha < C        -> both.
+    Exact comparisons are safe: clipping writes exactly 0.0 or C.
+    """
+    at0 = alpha == 0.0
+    atc = alpha == np.float32(c)
+    interior = ~at0 & ~atc
+    pos = y > 0
+    in_up = interior | (at0 & pos) | (atc & ~pos)
+    in_low = interior | (at0 & ~pos) | (atc & pos)
+    return in_up, in_low
+
+
+def smo_reference(
+    x: np.ndarray,
+    y: np.ndarray,
+    config: SVMConfig,
+    trace: Optional[List] = None,
+) -> TrainResult:
+    """Train a binary RBF-SVM with the modified-SMO algorithm in NumPy.
+
+    When ``trace`` is a list, one tuple ``(i_hi, i_lo, b_hi, b_lo)`` is
+    appended per iteration for step-by-step parity tests against the XLA
+    solvers.
+    """
+    config.validate()
+    t0 = time.perf_counter()
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, d = x.shape
+    yf = np.asarray(y, dtype=np.float32)
+    c = np.float32(config.c)
+    gamma = np.float32(config.resolve_gamma(d))
+    eps = np.float32(config.epsilon)
+    sent = np.float32(SENTINEL)
+
+    x2 = np.einsum("ij,ij->i", x, x).astype(np.float32)
+    alpha = np.zeros(n, dtype=np.float32)
+    f = (-yf).copy()
+
+    n_iter = 0
+    b_hi = np.float32(-sent)
+    b_lo = np.float32(sent)
+    while True:
+        in_up, in_low = iup_ilow_masks(alpha, yf, c)
+        f_up = np.where(in_up, f, sent)
+        f_low = np.where(in_low, f, -sent)
+        i_hi = int(np.argmin(f_up))
+        b_hi = f_up[i_hi]
+        i_lo = int(np.argmax(f_low))
+        b_lo = f_low[i_lo]
+        if trace is not None:
+            trace.append((i_hi, i_lo, float(b_hi), float(b_lo)))
+
+        rows = x[(i_hi, i_lo), :]                       # (2, d)
+        dots = (rows @ x.T).astype(np.float32)          # (2, n)
+        w2 = x2[(i_hi, i_lo),]
+        k = np.exp((-gamma * (x2[None, :] + w2[:, None] - 2.0 * dots)
+                    ).astype(np.float32))
+        eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
+
+        y_hi = yf[i_hi]
+        y_lo = yf[i_lo]
+        a_hi = alpha[i_hi]
+        a_lo = alpha[i_lo]
+        s = y_lo * y_hi
+        a_lo_u = np.float32(a_lo + y_lo * (b_hi - b_lo) / eta)
+        a_hi_u = np.float32(a_hi + s * (a_lo - a_lo_u))
+        a_lo_n = np.float32(min(max(a_lo_u, np.float32(0.0)), c))
+        a_hi_n = np.float32(min(max(a_hi_u, np.float32(0.0)), c))
+        alpha[i_lo] = a_lo_n
+        alpha[i_hi] = a_hi_n
+        f = (f + (a_hi_n - a_hi) * y_hi * k[0]
+               + (a_lo_n - a_lo) * y_lo * k[1]).astype(np.float32)
+
+        n_iter += 1
+        if not (b_lo > b_hi + 2.0 * eps) or n_iter >= config.max_iter:
+            break
+
+    b = float((b_lo + b_hi) / 2.0)
+    converged = bool(b_lo <= b_hi + 2.0 * eps)
+    return TrainResult(
+        alpha=alpha,
+        b=b,
+        n_iter=n_iter,
+        converged=converged,
+        b_lo=float(b_lo),
+        b_hi=float(b_hi),
+        train_seconds=time.perf_counter() - t0,
+        gamma=float(gamma),
+        n_sv=int(np.sum(alpha > 0)),
+    )

@@ -1,0 +1,169 @@
+"""Single-device SMO solver: the whole loop inside one XLA program.
+
+The reference pays a host round-trip every iteration — Thrust kernel
+launches, a 16-byte device->host read, an MPI Allgather, three host-CBLAS
+RBF evaluations and four scalar device accesses per iteration
+(``svmTrainMain.cpp:235-310``, SURVEY CS-1). Tens of thousands of
+iterations each eat kernel-launch + network latency. Here the entire
+modified-SMO iteration is the body of a ``lax.while_loop`` compiled once
+under ``jit``:
+
+* working-set selection: masked argmin/argmax (ops.selection);
+* both kernel rows: one (2, d) @ (d, n) MXU matmul + fused exp epilogue
+  (ops.kernels), or the HBM row cache when enabled (ops.rowcache);
+* eta / alpha update / clip: replicated scalar math, exact reference
+  semantics (``svmTrainMain.cpp:282-295`` — see oracle.py docstring);
+* f update: fused elementwise AXPY on the two kernel rows.
+
+The host only re-enters every ``chunk_iters`` iterations to poll
+convergence and log — the carry is donated, so alpha/f update in place.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
+from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
+from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch, cache_init
+from dpsvm_tpu.ops.selection import masked_extrema
+from dpsvm_tpu.utils.logging import log_progress
+
+
+class SMOCarry(NamedTuple):
+    alpha: jax.Array    # (n,) f32
+    f: jax.Array        # (n,) f32 optimality/gradient vector
+    b_hi: jax.Array     # () f32 from the latest selection
+    b_lo: jax.Array     # () f32
+    n_iter: jax.Array   # () i32
+    cache: RowCache
+
+
+def init_carry(y: jax.Array, cache_lines: int) -> SMOCarry:
+    """alpha = 0, f = -y (svmTrain.cu:349,380); sentinels force the first
+    iteration to run, preserving the reference's do-while shape."""
+    n = y.shape[0]
+    return SMOCarry(
+        alpha=jnp.zeros((n,), jnp.float32),
+        f=(-y).astype(jnp.float32),
+        b_hi=jnp.float32(-SENTINEL),
+        b_lo=jnp.float32(SENTINEL),
+        n_iter=jnp.int32(0),
+        cache=cache_init(cache_lines, n),
+    )
+
+
+def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
+             c: float, gamma: float, *, use_cache: bool = False,
+             precision=lax.Precision.HIGHEST) -> SMOCarry:
+    """One modified-SMO iteration (select -> eta -> alpha -> f)."""
+    alpha, f = carry.alpha, carry.f
+    i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha, y, f, c)
+
+    cache = carry.cache
+    if use_cache:
+        dots_hi, cache = cache_fetch(
+            cache, i_hi,
+            lambda: jnp.matmul(x, x[i_hi], precision=precision))
+        dots_lo, cache = cache_fetch(
+            cache, i_lo,
+            lambda: jnp.matmul(x, x[i_lo], precision=precision))
+        dots = jnp.stack([dots_hi, dots_lo])
+    else:
+        rows = jnp.stack([x[i_hi], x[i_lo]])                     # (2, d)
+        dots = jnp.matmul(rows, x.T, precision=precision)        # (2, n)
+
+    w2 = jnp.stack([x2[i_hi], x2[i_lo]])
+    k = rbf_rows_from_dots(dots, w2, x2, gamma)                  # (2, n)
+    eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
+
+    y_hi, y_lo = y[i_hi], y[i_lo]
+    a_hi, a_lo = alpha[i_hi], alpha[i_lo]
+    s = y_lo * y_hi
+    a_lo_u = a_lo + y_lo * (b_hi - b_lo) / eta
+    a_hi_u = a_hi + s * (a_lo - a_lo_u)          # uses UNCLIPPED a_lo_u
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+
+    # Write order lo-then-hi mirrors train_step2 (svmTrain.cu:491-492) for
+    # the i_hi == i_lo corner.
+    alpha = alpha.at[i_lo].set(a_lo_n)
+    alpha = alpha.at[i_hi].set(a_hi_n)
+    f = f + (a_hi_n - a_hi) * y_hi * k[0] + (a_lo_n - a_lo) * y_lo * k[1]
+
+    return SMOCarry(alpha, f, b_hi, b_lo, carry.n_iter + 1, cache)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_chunk_runner(c: float, gamma: float, epsilon: float,
+                        use_cache: bool, precision_name: str):
+    """Compiled chunk runner: run SMO iterations until convergence or the
+    iteration limit, entirely on device. Cached per hyperparameter set;
+    shapes specialize via jit."""
+    precision = getattr(lax.Precision, precision_name)
+
+    def cond(carry: SMOCarry, limit):
+        return (carry.b_lo > carry.b_hi + 2.0 * epsilon) & (carry.n_iter < limit)
+
+    def run(carry: SMOCarry, x, y, x2, limit):
+        return lax.while_loop(
+            lambda s: cond(s, limit),
+            lambda s: smo_step(s, x, y, x2, c, gamma,
+                               use_cache=use_cache, precision=precision),
+            carry)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
+                        device: Optional[jax.Device] = None) -> TrainResult:
+    """Train on one device. Data arrives as host NumPy, leaves as NumPy."""
+    config.validate()
+    n, d = x.shape
+    gamma = float(config.resolve_gamma(d))
+    eps = float(config.epsilon)
+    use_cache = config.cache_size > 0
+
+    xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
+    yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
+    x2 = row_norms_sq(xd)
+    carry = init_carry(yd, config.cache_size)
+    if device is not None:
+        carry = jax.device_put(carry, device)
+
+    runner = _build_chunk_runner(float(config.c), gamma, eps, use_cache,
+                                 config.matmul_precision.upper())
+
+    t0 = time.perf_counter()
+    while True:
+        limit = jnp.int32(min(int(carry.n_iter) + config.chunk_iters,
+                              config.max_iter))
+        carry = runner(carry, xd, yd, x2, limit)
+        n_iter = int(carry.n_iter)
+        b_lo = float(carry.b_lo)
+        b_hi = float(carry.b_hi)
+        converged = not (b_lo > b_hi + 2.0 * eps)
+        done = converged or n_iter >= config.max_iter
+        log_progress(config, n_iter, b_lo, b_hi, final=done)
+        if done:
+            break
+
+    alpha = np.asarray(carry.alpha)
+    return TrainResult(
+        alpha=alpha,
+        b=(b_lo + b_hi) / 2.0,       # svmTrainMain.cpp:329
+        n_iter=n_iter,
+        converged=converged,
+        b_lo=b_lo,
+        b_hi=b_hi,
+        train_seconds=time.perf_counter() - t0,
+        gamma=gamma,
+        n_sv=int(np.sum(alpha > 0)),
+    )

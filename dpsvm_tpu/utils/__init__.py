@@ -1,0 +1,6 @@
+"""Utilities: structured logging, phase timing."""
+
+from dpsvm_tpu.utils.logging import log_progress, get_logger
+from dpsvm_tpu.utils.timing import PhaseTimer
+
+__all__ = ["log_progress", "get_logger", "PhaseTimer"]

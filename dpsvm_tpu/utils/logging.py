@@ -1,0 +1,35 @@
+"""Structured training-progress logging.
+
+Replaces the reference's raw ``cout`` milestones (device banner
+``svmTrain.cu:324-336``, shard table ``svmTrainMain.cpp:185-189``,
+b/accuracy/time dump ``svmTrainMain.cpp:313-336``) with a standard-library
+logger plus a compact per-chunk progress line: iteration count and the
+optimality gap b_lo - b_hi (convergence is gap <= 2 epsilon).
+"""
+
+from __future__ import annotations
+
+import logging
+
+_logger = logging.getLogger("dpsvm_tpu")
+
+
+def get_logger() -> logging.Logger:
+    return _logger
+
+
+def log_progress(config, n_iter: int, b_lo: float, b_hi: float,
+                 final: bool = False) -> None:
+    """final=True forces the line (convergence mid-chunk would otherwise
+    skip the one report that matters)."""
+    if not config.verbose and not config.log_every:
+        return
+    every = config.log_every or config.chunk_iters
+    if not final and n_iter % every and n_iter < config.max_iter:
+        return
+    gap = b_lo - b_hi
+    _logger.info("iter=%d gap=%.6g (b_lo=%.6g b_hi=%.6g, converged at %.3g)",
+                 n_iter, gap, b_lo, b_hi, 2 * config.epsilon)
+    if config.verbose and not _logger.handlers:
+        print(f"[dpsvm] iter={n_iter} gap={gap:.6g} "
+              f"target={2 * config.epsilon:.3g}")

@@ -1,0 +1,44 @@
+"""Phase timing.
+
+The reference times training with an rdtsc cycle counter
+(``CycleTimer.h:44-73``, used at ``svmTrainMain.cpp:206-208,312-314``) and
+left per-phase instrumentation commented out in the solver
+(``svmTrain.cu:218-293`` margins). On an async accelerator runtime,
+wall-clock around dispatch is meaningless without a fence, so PhaseTimer
+pairs ``time.perf_counter`` with ``block_until_ready`` on a sentinel value
+and accumulates named buckets (select / collective / update / io ...).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+
+class PhaseTimer:
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, fence: Optional[object] = None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence is not None:
+                jax.block_until_ready(fence)
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def summary(self) -> str:
+        total = sum(self.seconds.values()) or 1.0
+        parts = [
+            f"{k}={v:.3f}s({100 * v / total:.0f}%/{self.counts[k]}x)"
+            for k, v in sorted(self.seconds.items(), key=lambda kv: -kv[1])
+        ]
+        return " ".join(parts)

@@ -1,0 +1,44 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax initializes.
+
+This is the multi-node testing backbone the reference never had (SURVEY
+§4): the same SPMD program runs on 1 device, on an 8-device CPU mesh, and
+on real TPU slices.
+"""
+
+import os
+
+# Force CPU even when the ambient environment points at a TPU: the test
+# suite needs 8 simulated devices, and parity tolerances are tuned for f32.
+# The image's sitecustomize pre-imports jax with JAX_PLATFORMS=axon, so the
+# env var is already baked in — override through jax.config instead (before
+# any backend is initialized).
+os.environ["JAX_PLATFORMS"] = os.environ.get("DPSVM_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor
+
+
+@pytest.fixture(scope="session")
+def blobs_small():
+    return make_blobs(n=96, d=6, seed=3)
+
+
+@pytest.fixture(scope="session")
+def blobs_odd():
+    # deliberately not divisible by 8 to exercise padding
+    return make_blobs(n=101, d=5, seed=7)
+
+
+@pytest.fixture(scope="session")
+def xor_small():
+    return make_xor(n=120, seed=1)

@@ -1,0 +1,77 @@
+"""Data loaders: native C++ parser vs Python fallback, converters, fixtures."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.data.convert import libsvm_to_dense_csv, mnist_to_odd_even_csv
+from dpsvm_tpu.data.loader import csv_shape, load_csv
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor, save_csv
+from dpsvm_tpu.native import load_native_lib
+
+
+def test_roundtrip_csv(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "data.csv")
+    save_csv(path, x, y)
+    assert csv_shape(path) == x.shape
+    x2, y2 = load_csv(path)
+    np.testing.assert_allclose(x2, x, rtol=1e-6)
+    np.testing.assert_array_equal(y2, y)
+
+
+def test_explicit_shape_flags(tmp_path, blobs_small):
+    """Reference -a/-x parity: read only the requested prefix."""
+    x, y = blobs_small
+    path = str(tmp_path / "data.csv")
+    save_csv(path, x, y)
+    x2, y2 = load_csv(path, num_examples=10, num_attributes=4)
+    assert x2.shape == (10, 4)
+    np.testing.assert_allclose(x2, x[:10, :4], rtol=1e-6)
+
+
+def test_python_fallback_matches_native(tmp_path, blobs_small, monkeypatch):
+    x, y = blobs_small
+    path = str(tmp_path / "data.csv")
+    save_csv(path, x, y)
+    xa, ya = load_csv(path)
+    monkeypatch.setenv("DPSVM_NO_NATIVE", "1")
+    import dpsvm_tpu.native.build as nb
+    monkeypatch.setattr(nb, "_cached", None)
+    xb, yb = load_csv(path)
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya, yb)
+
+
+def test_missing_file_raises():
+    with pytest.raises(FileNotFoundError):
+        load_csv("/nonexistent/nope.csv")
+
+
+def test_libsvm_converter(tmp_path):
+    src = tmp_path / "sparse.libsvm"
+    src.write_text("+1 1:0.5 3:1\n-1 2:2.0\n")
+    dst = str(tmp_path / "dense.csv")
+    n = libsvm_to_dense_csv(str(src), dst)
+    assert n == 2
+    x, y = load_csv(dst)
+    np.testing.assert_array_equal(y, [1, -1])
+    np.testing.assert_allclose(x, [[0.5, 0.0, 1.0], [0.0, 2.0, 0.0]])
+
+
+def test_mnist_odd_even_converter(tmp_path):
+    src = tmp_path / "digits.csv"
+    src.write_text("7,0,128\n4,255,0\n")
+    dst = str(tmp_path / "oddeven.csv")
+    n = mnist_to_odd_even_csv(str(src), dst)
+    assert n == 2
+    x, y = load_csv(dst)
+    np.testing.assert_array_equal(y, [-1, 1])     # 7 odd, 4 even
+    np.testing.assert_allclose(x, [[0, 128 / 255], [1.0, 0]], rtol=1e-6)
+
+
+def test_synthetic_labels_are_pm1():
+    for x, y in (make_blobs(50, 3, 0), make_xor(50, 0)):
+        assert set(np.unique(y)) <= {-1, 1}
+        assert x.dtype == np.float32

@@ -1,0 +1,75 @@
+"""Model serialization: roundtrip, reference-format compatibility, eval CLI."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+from dpsvm_tpu.models.io import load_model, save_model
+from dpsvm_tpu.models.svm import SVMModel, decision_function, evaluate, predict
+from dpsvm_tpu.solver.oracle import smo_reference
+
+
+@pytest.fixture(scope="module")
+def trained(blobs_small_module=None):
+    x, y = make_blobs(n=80, d=4, seed=11)
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+    res = smo_reference(x, y, cfg)
+    return x, y, SVMModel.from_train_result(x, y, res)
+
+
+def test_roundtrip(tmp_path, trained):
+    x, y, model = trained
+    path = str(tmp_path / "model.svm")
+    n = save_model(model, path)
+    assert n == model.n_sv
+    loaded = load_model(path)
+    assert loaded.n_sv == model.n_sv
+    assert loaded.gamma == pytest.approx(model.gamma, rel=1e-6)
+    assert loaded.b == pytest.approx(model.b, rel=1e-4, abs=1e-6)
+    np.testing.assert_allclose(loaded.x_sv, model.x_sv, rtol=1e-6)
+    np.testing.assert_allclose(loaded.alpha, model.alpha, rtol=1e-6)
+    np.testing.assert_array_equal(loaded.y_sv, model.y_sv)
+    # predictions identical through the text roundtrip
+    np.testing.assert_array_equal(predict(loaded, x), predict(model, x))
+
+
+def test_reads_seq_format_without_b(tmp_path, trained):
+    """seq.cpp writes no b line (seq.cpp:302); the loader must accept it."""
+    _, _, model = trained
+    path = tmp_path / "model_nob.svm"
+    lines = [f"{model.gamma:g}"]
+    for i in range(model.n_sv):
+        row = ",".join(f"{v:.9g}" for v in model.x_sv[i])
+        lines.append(f"{model.alpha[i]:.9g},{int(model.y_sv[i])},{row}")
+    path.write_text("\n".join(lines) + "\n")
+    loaded = load_model(str(path))
+    assert loaded.b == 0.0
+    assert loaded.n_sv == model.n_sv
+
+
+def test_decision_function_batching(trained):
+    x, y, model = trained
+    full = decision_function(model, x, batch_size=None)
+    batched = decision_function(model, x, batch_size=16)
+    np.testing.assert_allclose(full, batched, rtol=1e-5, atol=1e-6)
+
+
+def test_include_b_toggle(trained):
+    x, _, model = trained
+    with_b = decision_function(model, x, include_b=True)
+    no_b = decision_function(model, x, include_b=False)
+    np.testing.assert_allclose(with_b + model.b, no_b, rtol=1e-5, atol=1e-6)
+
+
+def test_cli_train_then_test(tmp_path):
+    from dpsvm_tpu.cli import main
+    x, y = make_blobs(n=60, d=4, seed=5)
+    data = str(tmp_path / "train.csv")
+    model_path = str(tmp_path / "model.svm")
+    save_csv(data, x, y)
+    rc = main(["train", "-f", data, "-m", model_path,
+               "-c", "1", "-g", "0.5", "-q"])
+    assert rc == 0
+    rc = main(["test", "-f", data, "-m", model_path])
+    assert rc == 0

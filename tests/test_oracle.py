@@ -1,0 +1,81 @@
+"""The NumPy oracle must actually solve SVMs: KKT conditions, accuracy,
+and agreement with a trusted independent solver (sklearn-free — we check
+against the dual objective's optimality conditions instead)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.solver.oracle import smo_reference, iup_ilow_masks
+from dpsvm_tpu.models.svm import SVMModel, evaluate
+from dpsvm_tpu.config import TrainResult
+
+
+def _rbf_gram(x, gamma):
+    x = x.astype(np.float64)
+    sq = (x * x).sum(1)
+    d2 = sq[:, None] + sq[None, :] - 2 * x @ x.T
+    return np.exp(-gamma * d2)
+
+
+def test_converges_and_separates_blobs(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.25, epsilon=1e-3, max_iter=20_000)
+    res = smo_reference(x, y, cfg)
+    assert res.converged
+    assert res.n_sv > 0
+    model = SVMModel.from_train_result(x, y, res)
+    assert evaluate(model, x, y) >= 0.95
+
+
+def test_xor_needs_rbf(xor_small):
+    x, y = xor_small
+    cfg = SVMConfig(c=10.0, gamma=1.0, epsilon=1e-3, max_iter=20_000)
+    res = smo_reference(x, y, cfg)
+    assert res.converged
+    model = SVMModel.from_train_result(x, y, res)
+    assert evaluate(model, x, y) >= 0.95
+
+
+def test_kkt_conditions_hold(blobs_small):
+    """At convergence the Keerthi gap certifies eps-KKT: for all i in I_up,
+    f_i >= b_hi, and for all i in I_low, f_i <= b_lo, with
+    b_lo - b_hi <= 2 eps. Verify with an independent float64 recomputation
+    of f = K (alpha*y) - y."""
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+    res = smo_reference(x, y, cfg)
+    assert res.converged
+    k = _rbf_gram(x, res.gamma)
+    yf = y.astype(np.float64)
+    f = k @ (res.alpha.astype(np.float64) * yf) - yf
+    in_up, in_low = iup_ilow_masks(res.alpha, y.astype(np.float32),
+                                   np.float32(cfg.c))
+    b_hi = f[in_up].min()
+    b_lo = f[in_low].max()
+    # allow float32-accumulation slack on top of the 2eps certificate
+    assert b_lo - b_hi <= 2 * cfg.epsilon + 5e-3
+
+
+def test_duality_alpha_bounds(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+    res = smo_reference(x, y, cfg)
+    assert np.all(res.alpha >= 0)
+    assert np.all(res.alpha <= cfg.c)
+
+
+def test_trace_records_every_iteration(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=50)
+    trace = []
+    res = smo_reference(x, y, cfg, trace=trace)
+    assert len(trace) == res.n_iter
+
+
+def test_max_iter_cap(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-9, max_iter=10)
+    res = smo_reference(x, y, cfg)
+    assert res.n_iter == 10
+    assert not res.converged

@@ -1,0 +1,83 @@
+"""XLA single-device solver vs the NumPy oracle.
+
+Layered like the reference's own validation strategy (SURVEY §4.2: seq.cpp
+is the cross-implementation oracle for the GPU path): first an
+iteration-trajectory check on small data, then final-model agreement, then
+behavioral checks (cache on/off equivalence, convergence flags).
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm import SVMModel, evaluate
+from dpsvm_tpu.solver.oracle import smo_reference
+from dpsvm_tpu.solver.smo import train_single_device
+
+
+def _final_agreement(x, y, cfg, cfg_dev=None):
+    ref = smo_reference(x, y, cfg)
+    dev = train_single_device(x, y, cfg_dev or cfg)
+    assert dev.converged == ref.converged
+    assert dev.n_iter == ref.n_iter, (dev.n_iter, ref.n_iter)
+    np.testing.assert_allclose(dev.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+    assert abs(dev.b - ref.b) < 1e-4
+    assert dev.n_sv == ref.n_sv
+    return ref, dev
+
+
+def test_final_model_matches_oracle(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                    chunk_iters=64)
+    _final_agreement(x, y, cfg)
+
+
+def test_final_model_matches_oracle_xor(xor_small):
+    x, y = xor_small
+    cfg = SVMConfig(c=10.0, gamma=1.0, epsilon=1e-3, max_iter=20_000,
+                    chunk_iters=128)
+    _final_agreement(x, y, cfg)
+
+
+def test_cache_equivalent_to_no_cache(blobs_small):
+    """The HBM row cache stores dot products only — results must be
+    bit-compatible with the fused-matmul path (same payload the reference
+    caches, cache.cu)."""
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                    chunk_iters=64)
+    cfg_cache = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                          chunk_iters=64, cache_size=8)
+    no_cache = train_single_device(x, y, cfg)
+    cache = train_single_device(x, y, cfg_cache)
+    assert cache.n_iter == no_cache.n_iter
+    np.testing.assert_allclose(cache.alpha, no_cache.alpha,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_accuracy_end_to_end(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.25, epsilon=1e-3, max_iter=20_000)
+    res = train_single_device(x, y, cfg)
+    model = SVMModel.from_train_result(x, y, res)
+    assert evaluate(model, x, y) >= 0.95
+
+
+def test_chunking_invariant(blobs_small):
+    """Result must not depend on how the host slices the while_loop."""
+    x, y = blobs_small
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+    r1 = train_single_device(x, y, SVMConfig(**base, chunk_iters=17))
+    r2 = train_single_device(x, y, SVMConfig(**base, chunk_iters=4096))
+    assert r1.n_iter == r2.n_iter
+    np.testing.assert_array_equal(r1.alpha, r2.alpha)
+
+
+def test_max_iter_cap(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-9, max_iter=25,
+                    chunk_iters=10)
+    res = train_single_device(x, y, cfg)
+    assert res.n_iter == 25
+    assert not res.converged

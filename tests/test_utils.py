@@ -1,0 +1,43 @@
+"""Utility-layer tests: phase timer, progress logging, native kill-switch."""
+
+import logging
+
+import jax.numpy as jnp
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.utils.logging import log_progress
+from dpsvm_tpu.utils.timing import PhaseTimer
+
+
+def test_phase_timer_buckets():
+    t = PhaseTimer()
+    with t.phase("update", fence=jnp.zeros(4)):
+        pass
+    with t.phase("select"):
+        pass
+    with t.phase("select"):
+        pass
+    assert t.counts["select"] == 2
+    assert t.counts["update"] == 1
+    assert t.seconds["update"] >= 0
+    s = t.summary()
+    assert "select=" in s and "update=" in s
+
+
+def test_log_progress_final_forces_line(caplog):
+    cfg = SVMConfig(verbose=True, chunk_iters=512, max_iter=10_000)
+    with caplog.at_level(logging.INFO, logger="dpsvm_tpu"):
+        # converged mid-chunk: 1337 % 512 != 0 — only final=True may log
+        log_progress(cfg, 1337, 0.1, 0.099)
+        assert len(caplog.records) == 0
+        log_progress(cfg, 1337, 0.1, 0.099, final=True)
+        assert len(caplog.records) == 1
+
+
+def test_native_killswitch_wins_over_cache(monkeypatch):
+    from dpsvm_tpu.native import build as nb
+    # ensure a cached lib exists (or None if no compiler — still valid test)
+    nb.load_native_lib()
+    monkeypatch.setenv("DPSVM_NO_NATIVE", "1")
+    assert nb.load_native_lib() is None
