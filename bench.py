@@ -1,0 +1,93 @@
+"""Benchmark harness: steady-state SMO iteration throughput at the
+reference's headline scale.
+
+The reference's published number is MNIST even-odd (60000 x 784, RBF
+C=10 gamma=0.25 eps=1e-3) in 137 s on one GTX 780 and 46 s on a 10-GPU
+MPI cluster (README.md:23, BASELINE.md). Its iteration budget for that
+job is max_iter=100000 (Makefile:74); SMO converges within that budget,
+so the single-GPU reference throughput floor is ~100000/137 ~= 730
+iterations/second — every iteration paying kernel-launch + host + MPI
+latency (SURVEY CS-1). This harness measures our iterations/second with
+the whole loop compiled on-device, on the same problem shape, and reports
+``vs_baseline`` against that 730 it/s floor.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": "smo_iters_per_sec_mnist_scale", "value": ..., "unit":
+     "iter/s", "vs_baseline": ...}
+Diagnostics go to stderr. Override the shape with BENCH_N / BENCH_D /
+BENCH_ITERS env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+BASELINE_ITERS_PER_SEC = 100_000 / 137.0   # reference 1-GPU floor (see above)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", 60_000))
+    d = int(os.environ.get("BENCH_D", 784))
+    measure_iters = int(os.environ.get("BENCH_ITERS", 3000))
+    warmup_iters = 200
+
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+    from dpsvm_tpu.utils.timing import PhaseTimer
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({dev.platform})")
+    timer = PhaseTimer()
+
+    with timer.phase("data"):
+        x, y = make_mnist_like(n=n, d=d, seed=0)
+        xd = jnp.asarray(x)
+        yd = jnp.asarray(y, jnp.float32)
+        x2 = row_norms_sq(xd)
+        carry = init_carry(yd, cache_lines=0)
+        jax.block_until_ready((xd, x2))
+
+    # MNIST benchmark hyperparameters (README.md:23).
+    runner = _build_chunk_runner(10.0, 0.25, 1e-3, False, "HIGHEST")
+
+    with timer.phase("compile+warmup"):
+        carry = runner(carry, xd, yd, x2, jnp.int32(warmup_iters))
+        jax.block_until_ready(carry.f)
+    it0 = int(carry.n_iter)
+    if it0 < warmup_iters:
+        log(f"WARNING: converged during warmup after {it0} iters; "
+            "measuring a fresh run")
+
+    with timer.phase("measure"):
+        t0 = time.perf_counter()
+        carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+        jax.block_until_ready(carry.f)
+        dt = time.perf_counter() - t0
+    iters = int(carry.n_iter) - it0
+
+    rate = iters / dt if dt > 0 else 0.0
+    log(f"phases: {timer.summary()}")
+    log(f"{iters} iters in {dt:.3f}s on ({n}x{d}) -> {rate:.1f} iter/s "
+        f"(gap: b_lo={float(carry.b_lo):.4f} b_hi={float(carry.b_hi):.4f})")
+    print(json.dumps({
+        "metric": "smo_iters_per_sec_mnist_scale",
+        "value": round(rate, 1),
+        "unit": "iter/s",
+        "vs_baseline": round(rate / BASELINE_ITERS_PER_SEC, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,13 +22,6 @@ import argparse
 import sys
 from typing import List, Optional
 
-import numpy as np
-
-from dpsvm_tpu.config import SVMConfig
-from dpsvm_tpu.data.loader import load_csv
-from dpsvm_tpu.models.io import load_model, save_model
-from dpsvm_tpu.models.svm import SVMModel, evaluate
-
 
 def _add_data_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("-f", "--input", required=True, help="dense CSV dataset")
@@ -66,7 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def cmd_train(args: argparse.Namespace) -> int:
-    from dpsvm_tpu.api import fit   # deferred: importing jax is slow
+    # Imports deferred so --help / arg errors don't pay the jax import.
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import evaluate
+
     x, y = load_csv(args.input, args.num_ex, args.num_att)
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
@@ -88,6 +87,10 @@ def cmd_train(args: argparse.Namespace) -> int:
 
 
 def cmd_test(args: argparse.Namespace) -> int:
+    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.models.io import load_model
+    from dpsvm_tpu.models.svm import evaluate
+
     model = load_model(args.model)
     x, y = load_csv(args.input, args.num_ex, args.num_att)
     if x.shape[1] != model.num_attributes:

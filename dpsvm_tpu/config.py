@@ -71,6 +71,9 @@ class SVMConfig:
             raise ValueError(f"shards must be >= 1, got {self.shards}")
         if self.cache_size < 0:
             raise ValueError(f"cache_size must be >= 0, got {self.cache_size}")
+        if self.chunk_iters <= 0:
+            raise ValueError(
+                f"chunk_iters must be > 0, got {self.chunk_iters}")
 
 
 @dataclasses.dataclass

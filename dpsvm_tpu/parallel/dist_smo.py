@@ -91,35 +91,38 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     own_hi = rank == p_hi
     own_lo = rank == p_lo
 
-    # --- broadcast working rows + owner scalars: one psum of (2, d+3) ---
+    # --- broadcast working rows + owner scalars ---
+    # One psum: (2, d+3) when X rows live on their owner shard, (2, 3)
+    # scalars-only when X is replicated (rows readable locally).
     if shard_x:
-        row_hi = _owner_read(xs, loc_hi, own_hi)
-        row_lo = _owner_read(xs, loc_lo, own_lo)
         x2_hi_c = _owner_read(x2s, loc_hi, own_hi)
         x2_lo_c = _owner_read(x2s, loc_lo, own_lo)
     else:
-        row_hi = xs[i_hi_g]
-        row_lo = xs[i_lo_g]
         x2_hi_c = jnp.where(own_hi, x2s[i_hi_g], 0.0)
         x2_lo_c = jnp.where(own_lo, x2s[i_lo_g], 0.0)
-    pack = jnp.stack([
-        jnp.concatenate([
-            jnp.zeros_like(row_hi) if not shard_x else row_hi,
-            jnp.stack([x2_hi_c,
-                       _owner_read(ys, loc_hi, own_hi),
-                       _owner_read(alpha_s, loc_hi, own_hi)])]),
-        jnp.concatenate([
-            jnp.zeros_like(row_lo) if not shard_x else row_lo,
-            jnp.stack([x2_lo_c,
-                       _owner_read(ys, loc_lo, own_lo),
-                       _owner_read(alpha_s, loc_lo, own_lo)])]),
-    ])
-    pack = lax.psum(pack, SHARD_AXIS)
-    d = xs.shape[-1]
-    rows = pack[:, :d] if shard_x else jnp.stack([row_hi, row_lo])
-    w2 = pack[:, d]
-    y_hi, y_lo = pack[0, d + 1], pack[1, d + 1]
-    a_hi, a_lo = pack[0, d + 2], pack[1, d + 2]
+    scalars = jnp.stack([
+        jnp.stack([x2_hi_c,
+                   _owner_read(ys, loc_hi, own_hi),
+                   _owner_read(alpha_s, loc_hi, own_hi)]),
+        jnp.stack([x2_lo_c,
+                   _owner_read(ys, loc_lo, own_lo),
+                   _owner_read(alpha_s, loc_lo, own_lo)]),
+    ])                                                          # (2, 3)
+    if shard_x:
+        pack = jnp.concatenate([
+            jnp.stack([_owner_read(xs, loc_hi, own_hi),
+                       _owner_read(xs, loc_lo, own_lo)]),
+            scalars], axis=1)
+        pack = lax.psum(pack, SHARD_AXIS)
+        d = xs.shape[-1]
+        rows = pack[:, :d]
+        scalars = pack[:, d:]
+    else:
+        rows = jnp.stack([xs[i_hi_g], xs[i_lo_g]])
+        scalars = lax.psum(scalars, SHARD_AXIS)
+    w2 = scalars[:, 0]
+    y_hi, y_lo = scalars[0, 1], scalars[1, 1]
+    a_hi, a_lo = scalars[0, 2], scalars[1, 2]
 
     # --- kernel rows on the local slice: (2, d) @ (d, n_s) (CS-3) ---
     dots = jnp.matmul(rows, xs.T, precision=precision)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import contextlib
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -25,13 +25,17 @@ class PhaseTimer:
         self.counts: Dict[str, int] = defaultdict(int)
 
     @contextlib.contextmanager
-    def phase(self, name: str, fence: Optional[object] = None):
+    def phase(self, name: str, fence: Optional[Callable[[], object]] = None):
+        """fence: zero-arg callable evaluated at block exit; its result is
+        block_until_ready'd so the bucket measures completed device work,
+        not dispatch. (A callable, because the arrays to fence on are
+        usually created inside the block.)"""
         t0 = time.perf_counter()
         try:
             yield
         finally:
             if fence is not None:
-                jax.block_until_ready(fence)
+                jax.block_until_ready(fence())
             self.seconds[name] += time.perf_counter() - t0
             self.counts[name] += 1
 

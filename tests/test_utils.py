@@ -12,8 +12,9 @@ from dpsvm_tpu.utils.timing import PhaseTimer
 
 def test_phase_timer_buckets():
     t = PhaseTimer()
-    with t.phase("update", fence=jnp.zeros(4)):
-        pass
+    out = {}
+    with t.phase("update", fence=lambda: out["x"]):
+        out["x"] = jnp.zeros(4) + 1
     with t.phase("select"):
         pass
     with t.phase("select"):
