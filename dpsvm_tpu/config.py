@@ -55,6 +55,13 @@ class SVMConfig:
     verbose: bool = False
     log_every: int = 0                  # 0 = no per-chunk logging
 
+    # --- persistence / observability (reference has none — SURVEY §5) ---
+    checkpoint_path: Optional[str] = None   # .npz solver-state file
+    checkpoint_every: int = 0               # iterations between saves (0=off)
+    resume_from: Optional[str] = None       # checkpoint to resume from
+    profile_dir: Optional[str] = None       # jax.profiler trace output dir
+    debug_nans: bool = False                # jax_debug_nans during training
+
     def resolve_gamma(self, num_attributes: int) -> float:
         if self.gamma is not None:
             return float(self.gamma)
@@ -74,6 +81,11 @@ class SVMConfig:
         if self.chunk_iters <= 0:
             raise ValueError(
                 f"chunk_iters must be > 0, got {self.chunk_iters}")
+        if self.checkpoint_every < 0:
+            raise ValueError(
+                f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
+        if self.checkpoint_every and not self.checkpoint_path:
+            raise ValueError("checkpoint_every set without checkpoint_path")
 
 
 @dataclasses.dataclass

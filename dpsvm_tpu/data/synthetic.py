@@ -58,8 +58,11 @@ def make_mnist_like(n: int = 60_000, d: int = 784, seed: int = 0,
     mask = rng.random((n, d)) < 0.2
     vals = rng.random((n, d), dtype=np.float32)
     x[mask] = vals[mask]
-    # Class signal on a subset of features.
-    sig = rng.choice(d, size=max(1, d // 16), replace=False)
+    # Class signal on a fixed feature subset — chosen independently of
+    # `seed` so differently-seeded draws (train/test splits) come from the
+    # SAME underlying problem and generalization is measurable.
+    sig = np.random.default_rng(777).choice(d, size=max(1, d // 16),
+                                            replace=False)
     x[:, sig] += 0.25 * y[:, None].astype(np.float32)
     np.clip(x, 0.0, 1.0, out=x)
     return x, y

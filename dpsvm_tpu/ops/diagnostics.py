@@ -1,0 +1,91 @@
+"""Solver diagnostics: dual objective, duality gap, KKT violation.
+
+The reference carries a ``get_duality_gap`` that is dead code — defined at
+``seq.cpp:352-376`` but never called, and it reads an uninitialized
+``duality_gap`` accumulator. This is the working, XLA-batched equivalent,
+intended for validation and debugging (never the hot loop):
+
+  dual objective  D(alpha) = sum(alpha) - 1/2 sum_ij alpha_i alpha_j
+                              y_i y_j K(x_i, x_j)
+  primal (at w implied by alpha, hinge loss):
+                  P(alpha) = 1/2 |w|^2 + C sum_i max(0, 1 - y_i (f_w(x_i)))
+  gap = P - D >= 0, -> 0 at the optimum.
+
+The kernel matrix is never materialized: everything streams in row blocks
+of a (block, d) @ (d, n) matmul, so memory stays O(block * n).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
+
+
+@jax.jit
+def _block_terms(x_blk, x2_blk, coef_blk, x, x2, coef, y_blk, gamma):
+    k = kernel_rows(x_blk, x2_blk, x, x2, gamma)        # (blk, n)
+    kv = k @ coef                                       # (blk,) = (K alpha*y)_i
+    quad = coef_blk @ kv                                # alpha_i y_i K alpha y
+    hinge = jnp.sum(jnp.maximum(0.0, 1.0 - y_blk * kv))
+    return quad, hinge
+
+
+def dual_objective_and_gap(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
+                           gamma: float, c: float,
+                           block: int = 4096) -> Tuple[float, float, float]:
+    """Returns (dual_objective, primal_objective, duality_gap).
+
+    The primal uses the unbiased decision value f_w(x) = (K alpha*y)(x)
+    (no intercept), consistent with the reference evaluators that drop b.
+    """
+    x = np.asarray(x, np.float32)
+    n = x.shape[0]
+    yf = jnp.asarray(y, jnp.float32)
+    al = jnp.asarray(alpha, jnp.float32)
+    coef = al * yf
+    xd = jnp.asarray(x)
+    x2 = row_norms_sq(xd)
+
+    quad = 0.0
+    hinge = 0.0
+    for lo in range(0, n, block):
+        hi = min(lo + block, n)
+        q, h = _block_terms(xd[lo:hi], x2[lo:hi], coef[lo:hi], xd, x2, coef,
+                            yf[lo:hi], jnp.float32(gamma))
+        quad += float(q)
+        hinge += float(h)
+
+    dual = float(jnp.sum(al)) - 0.5 * quad
+    primal = 0.5 * quad + float(c) * hinge
+    return dual, primal, primal - dual
+
+
+def kkt_violation(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
+                  gamma: float, c: float) -> float:
+    """max over (min_{I_up} f - max_{I_low} f) style optimality residual:
+    b_lo - b_hi recomputed from scratch (f = K alpha*y - y), in contrast to
+    the solver's incrementally-maintained f. Useful to bound f drift."""
+    from dpsvm_tpu.solver.oracle import iup_ilow_masks
+
+    x = np.asarray(x, np.float32)
+    yf = np.asarray(y, np.float32)
+    al = np.asarray(alpha, np.float32)
+    coef = jnp.asarray(al * yf)
+    xd = jnp.asarray(x)
+    x2 = row_norms_sq(xd)
+    f = np.empty((x.shape[0],), np.float32)
+    block = 4096
+    for lo in range(0, x.shape[0], block):
+        hi = min(lo + block, x.shape[0])
+        k = kernel_rows(xd[lo:hi], x2[lo:hi], xd, x2, jnp.float32(gamma))
+        f[lo:hi] = np.asarray(k @ coef) - yf[lo:hi]
+    in_up, in_low = iup_ilow_masks(al, yf, np.float32(c))
+    b_hi = f[in_up].min() if in_up.any() else np.inf
+    b_lo = f[in_low].max() if in_low.any() else -np.inf
+    return float(b_lo - b_hi)

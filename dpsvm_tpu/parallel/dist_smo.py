@@ -33,7 +33,6 @@ and this program computes exactly solver/smo.py.
 from __future__ import annotations
 
 import functools
-import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -46,7 +45,7 @@ from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
 from dpsvm_tpu.ops.selection import masked_extrema
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
-from dpsvm_tpu.utils.logging import log_progress
+from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
 
 class DistCarry(NamedTuple):
@@ -211,6 +210,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     gamma = float(config.resolve_gamma(d))
     eps = float(config.epsilon)
 
+    ckpt = resume_state(config, n, d, gamma)
+
     n_pad = ((n + p - 1) // p) * p
     n_s = n_pad // p
     xp = np.zeros((n_pad, d), np.float32)
@@ -228,42 +229,34 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     x2 = jax.device_put(row_norms_sq(jnp.asarray(xp)), x_sharding)
     validd = jax.device_put(jnp.asarray(valid), shard)
 
+    if ckpt is not None:
+        alpha0 = np.zeros((n_pad,), np.float32)
+        alpha0[:n] = ckpt.alpha
+        f0 = np.zeros((n_pad,), np.float32)
+        f0[:n] = ckpt.f
+        init = (alpha0, f0, ckpt.b_hi, ckpt.b_lo, ckpt.n_iter)
+    else:
+        init = (np.zeros((n_pad,), np.float32), -yp,
+                -SENTINEL, SENTINEL, 0)
     carry = DistCarry(
-        alpha=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard),
-        f=jax.device_put(jnp.asarray(-yp), shard),
-        b_hi=jax.device_put(jnp.float32(-SENTINEL), repl),
-        b_lo=jax.device_put(jnp.float32(SENTINEL), repl),
-        n_iter=jax.device_put(jnp.int32(0), repl),
+        alpha=jax.device_put(jnp.asarray(init[0]), shard),
+        f=jax.device_put(jnp.asarray(init[1]), shard),
+        b_hi=jax.device_put(jnp.float32(init[2]), repl),
+        b_lo=jax.device_put(jnp.float32(init[3]), repl),
+        n_iter=jax.device_put(jnp.int32(init[4]), repl),
     )
 
     runner = _build_dist_runner(mesh, float(config.c), gamma, eps, n_s,
                                 bool(config.shard_x),
                                 config.matmul_precision.upper())
 
-    t0 = time.perf_counter()
-    while True:
-        limit = jax.device_put(
-            jnp.int32(min(int(carry.n_iter) + config.chunk_iters,
-                          config.max_iter)), repl)
-        carry = runner(carry, xd, yd, x2, validd, limit)
-        n_iter = int(carry.n_iter)
-        b_lo = float(carry.b_lo)
-        b_hi = float(carry.b_hi)
-        converged = not (b_lo > b_hi + 2.0 * eps)
-        done = converged or n_iter >= config.max_iter
-        log_progress(config, n_iter, b_lo, b_hi, final=done)
-        if done:
-            break
+    def step_chunk(c, lim):
+        limit = jax.device_put(jnp.int32(lim), repl)
+        return runner(c, xd, yd, x2, validd, limit)
 
-    alpha = np.asarray(carry.alpha)[:n]
-    return TrainResult(
-        alpha=alpha,
-        b=(b_lo + b_hi) / 2.0,
-        n_iter=n_iter,
-        converged=converged,
-        b_lo=b_lo,
-        b_hi=b_hi,
-        train_seconds=time.perf_counter() - t0,
-        gamma=gamma,
-        n_sv=int(np.sum(alpha > 0)),
+    return host_training_loop(
+        config, gamma, n, d, carry,
+        step_chunk=step_chunk,
+        carry_to_host=lambda c: (np.asarray(c.alpha)[:n],
+                                 np.asarray(c.f)[:n]),
     )

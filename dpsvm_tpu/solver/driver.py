@@ -1,0 +1,102 @@
+"""Shared host-side training driver.
+
+Both solvers (single-device, distributed) expose the same contract: a
+compiled chunk runner that advances the carry until convergence or an
+iteration limit, entirely on device. This module owns everything around
+it — the polling loop, convergence bookkeeping, progress logging,
+checkpointing, profiler tracing and NaN-debug toggles — so the behavior
+is identical across execution modes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.utils.checkpoint import (SolverCheckpoint, load_checkpoint,
+                                        maybe_checkpoint)
+from dpsvm_tpu.utils.logging import log_progress
+
+
+def resume_state(config: SVMConfig, n: int, d: int, gamma: float
+                 ) -> Optional[SolverCheckpoint]:
+    """Load + validate the resume checkpoint if one is configured."""
+    if not config.resume_from:
+        return None
+    ckpt = load_checkpoint(config.resume_from)
+    ckpt.validate_against(n, d, config, gamma)
+    return ckpt
+
+
+@contextlib.contextmanager
+def _debug_nans(enabled: bool):
+    if not enabled:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def host_training_loop(
+    config: SVMConfig,
+    gamma: float,
+    n: int,
+    d: int,
+    carry,
+    step_chunk: Callable,                      # (carry, limit:int) -> carry
+    carry_to_host: Callable,                   # carry -> (alpha, f) np arrays
+    carry_iter: Callable = lambda c: int(c.n_iter),
+    carry_gap: Callable = lambda c: (float(c.b_lo), float(c.b_hi)),
+) -> TrainResult:
+    """Run chunks until convergence / max_iter; return the TrainResult."""
+    eps = float(config.epsilon)
+    last_saved = carry_iter(carry)
+
+    profile = (jax.profiler.trace(config.profile_dir)
+               if config.profile_dir else contextlib.nullcontext())
+
+    t0 = time.perf_counter()
+    with profile, _debug_nans(config.debug_nans):
+        while True:
+            limit = min(carry_iter(carry) + config.chunk_iters,
+                        config.max_iter)
+            carry = step_chunk(carry, limit)
+            n_iter = carry_iter(carry)
+            b_lo, b_hi = carry_gap(carry)
+            converged = not (b_lo > b_hi + 2.0 * eps)
+            done = converged or n_iter >= config.max_iter
+
+            log_progress(config, n_iter, b_lo, b_hi, final=done)
+
+            def make() -> SolverCheckpoint:
+                alpha, f = carry_to_host(carry)
+                return SolverCheckpoint(
+                    alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo, b_hi=b_hi,
+                    c=float(config.c), gamma=gamma,
+                    epsilon=float(config.epsilon), n=n, d=d)
+
+            last_saved = maybe_checkpoint(config, last_saved, n_iter, make)
+            if done:
+                break
+
+    alpha, _ = carry_to_host(carry)
+    return TrainResult(
+        alpha=alpha,
+        b=(b_lo + b_hi) / 2.0,           # svmTrainMain.cpp:329
+        n_iter=n_iter,
+        converged=converged,
+        b_lo=b_lo,
+        b_hi=b_hi,
+        train_seconds=time.perf_counter() - t0,
+        gamma=gamma,
+        n_sv=int(np.sum(alpha > 0)),
+    )

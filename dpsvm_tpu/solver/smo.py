@@ -22,7 +22,6 @@ convergence and log — the carry is donated, so alpha/f update in place.
 from __future__ import annotations
 
 import functools
-import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -34,7 +33,7 @@ from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch, cache_init
 from dpsvm_tpu.ops.selection import masked_extrema
-from dpsvm_tpu.utils.logging import log_progress
+from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
 
 class SMOCarry(NamedTuple):
@@ -128,42 +127,28 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     config.validate()
     n, d = x.shape
     gamma = float(config.resolve_gamma(d))
-    eps = float(config.epsilon)
     use_cache = config.cache_size > 0
 
     xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
     yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
     x2 = row_norms_sq(xd)
     carry = init_carry(yd, config.cache_size)
+
+    ckpt = resume_state(config, n, d, gamma)
+    if ckpt is not None:
+        carry = carry._replace(
+            alpha=jnp.asarray(ckpt.alpha), f=jnp.asarray(ckpt.f),
+            b_hi=jnp.float32(ckpt.b_hi), b_lo=jnp.float32(ckpt.b_lo),
+            n_iter=jnp.int32(ckpt.n_iter))
     if device is not None:
         carry = jax.device_put(carry, device)
 
-    runner = _build_chunk_runner(float(config.c), gamma, eps, use_cache,
+    runner = _build_chunk_runner(float(config.c), gamma,
+                                 float(config.epsilon), use_cache,
                                  config.matmul_precision.upper())
 
-    t0 = time.perf_counter()
-    while True:
-        limit = jnp.int32(min(int(carry.n_iter) + config.chunk_iters,
-                              config.max_iter))
-        carry = runner(carry, xd, yd, x2, limit)
-        n_iter = int(carry.n_iter)
-        b_lo = float(carry.b_lo)
-        b_hi = float(carry.b_hi)
-        converged = not (b_lo > b_hi + 2.0 * eps)
-        done = converged or n_iter >= config.max_iter
-        log_progress(config, n_iter, b_lo, b_hi, final=done)
-        if done:
-            break
-
-    alpha = np.asarray(carry.alpha)
-    return TrainResult(
-        alpha=alpha,
-        b=(b_lo + b_hi) / 2.0,       # svmTrainMain.cpp:329
-        n_iter=n_iter,
-        converged=converged,
-        b_lo=b_lo,
-        b_hi=b_hi,
-        train_seconds=time.perf_counter() - t0,
-        gamma=gamma,
-        n_sv=int(np.sum(alpha > 0)),
+    return host_training_loop(
+        config, gamma, n, d, carry,
+        step_chunk=lambda c, lim: runner(c, xd, yd, x2, jnp.int32(lim)),
+        carry_to_host=lambda c: (np.asarray(c.alpha), np.asarray(c.f)),
     )

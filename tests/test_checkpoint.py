@@ -1,0 +1,73 @@
+"""Checkpoint/resume: a resumed run must reproduce the uninterrupted
+trajectory exactly (the full solver state is saved)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.parallel.dist_smo import train_distributed
+from dpsvm_tpu.solver.smo import train_single_device
+from dpsvm_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def _base(**kw):
+    kw.setdefault("c", 1.0)
+    kw.setdefault("gamma", 0.5)
+    kw.setdefault("epsilon", 1e-3)
+    kw.setdefault("max_iter", 20_000)
+    kw.setdefault("chunk_iters", 50)
+    return SVMConfig(**kw)
+
+
+def test_resume_reproduces_uninterrupted_run(tmp_path, blobs_small):
+    x, y = blobs_small
+    ckpt = str(tmp_path / "state.npz")
+
+    full = train_single_device(x, y, _base())
+
+    # Phase 1: stop early at 100 iterations, checkpointing every 50.
+    part1 = train_single_device(
+        x, y, _base(max_iter=100, checkpoint_path=ckpt, checkpoint_every=50))
+    assert part1.n_iter == 100
+    saved = load_checkpoint(ckpt)
+    assert saved.n_iter == 100
+
+    # Phase 2: resume to convergence.
+    part2 = train_single_device(x, y, _base(resume_from=ckpt))
+    assert part2.converged
+    assert part2.n_iter == full.n_iter
+    np.testing.assert_array_equal(part2.alpha, full.alpha)
+    assert part2.b == pytest.approx(full.b, abs=1e-7)
+
+
+def test_resume_distributed_from_single_device_checkpoint(tmp_path,
+                                                          blobs_small):
+    """Checkpoints are layout-independent: state saved by the single-device
+    solver resumes on a mesh (and must follow the same trajectory)."""
+    x, y = blobs_small
+    ckpt = str(tmp_path / "state.npz")
+    full = train_single_device(x, y, _base())
+    train_single_device(
+        x, y, _base(max_iter=100, checkpoint_path=ckpt, checkpoint_every=100))
+    dist = train_distributed(
+        x, y, _base(resume_from=ckpt, shards=4, chunk_iters=128))
+    assert dist.n_iter == full.n_iter
+    np.testing.assert_allclose(dist.alpha, full.alpha, rtol=1e-4, atol=1e-5)
+
+
+def test_checkpoint_validation(tmp_path, blobs_small):
+    x, y = blobs_small
+    ckpt = str(tmp_path / "state.npz")
+    train_single_device(
+        x, y, _base(max_iter=60, checkpoint_path=ckpt, checkpoint_every=50))
+
+    with pytest.raises(ValueError, match="checkpoint c="):
+        train_single_device(x, y, _base(c=2.0, resume_from=ckpt))
+
+    with pytest.raises(ValueError, match="problem"):
+        train_single_device(x[:, :3], y, _base(gamma=0.5, resume_from=ckpt))
+
+
+def test_checkpoint_every_requires_path():
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        SVMConfig(checkpoint_every=10).validate()

@@ -1,0 +1,45 @@
+"""Diagnostics: duality gap shrinks to ~0 at the optimum, KKT residual
+agrees with the solver's internal certificate."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.diagnostics import dual_objective_and_gap, kkt_violation
+from dpsvm_tpu.solver.smo import train_single_device
+
+
+@pytest.fixture(scope="module")
+def solved():
+    from dpsvm_tpu.data.synthetic import make_blobs
+    x, y = make_blobs(n=120, d=5, seed=9)
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+    res = train_single_device(x, y, cfg)
+    assert res.converged
+    return x, y, cfg, res
+
+
+def test_gap_small_at_optimum(solved):
+    x, y, cfg, res = solved
+    dual, primal, gap = dual_objective_and_gap(
+        x, y, res.alpha, res.gamma, cfg.c)
+    assert dual > 0
+    assert primal >= dual - 1e-3          # weak duality (fp slack)
+    # eps-converged SMO leaves a small but bounded gap
+    assert gap / max(1.0, abs(primal)) < 0.05
+
+
+def test_gap_large_at_start(solved):
+    x, y, cfg, _ = solved
+    alpha0 = np.zeros(x.shape[0], np.float32)
+    dual, primal, gap = dual_objective_and_gap(x, y, alpha0, cfg.gamma, cfg.c)
+    assert dual == 0.0
+    assert gap == pytest.approx(cfg.c * x.shape[0], rel=1e-5)
+
+
+def test_kkt_residual_matches_solver_certificate(solved):
+    x, y, cfg, res = solved
+    viol = kkt_violation(x, y, res.alpha, res.gamma, cfg.c)
+    # fresh-f residual within fp slack of the solver's converged b_lo - b_hi
+    assert viol <= 2 * cfg.epsilon + 5e-3
+    assert viol == pytest.approx(res.b_lo - res.b_hi, abs=5e-3)
