@@ -1,0 +1,85 @@
+"""Reproduce the reference's benchmark configurations (SURVEY §6).
+
+The reference's Makefile run targets define three jobs (``Makefile:74-86``);
+the datasets themselves were stripped from the snapshot, so each job runs
+on a synthetic stand-in of the same shape. Per job this prints an it/s
+measurement and the projected wall-clock for the reference's iteration
+budget, as one JSON line each.
+
+    adult:   32561 x 123, C=100,  gamma=0.5,     eps=1e-3, budget 150k
+    mnist:   60000 x 784, C=10,   gamma=0.25,    eps=1e-3, budget 100k
+    covtype: 500000 x 54, C=2048, gamma=0.03125, eps=1e-3, budget 3M
+
+Usage:  python benchmarks/run_configs.py [adult mnist covtype]
+        env: BENCH_MEASURE_ITERS (default 2000), BENCH_PRECISION
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+CONFIGS = {
+    "adult":   dict(n=32_561, d=123, c=100.0, gamma=0.5, budget=150_000),
+    "mnist":   dict(n=60_000, d=784, c=10.0, gamma=0.25, budget=100_000),
+    "covtype": dict(n=500_000, d=54, c=2048.0, gamma=0.03125,
+                    budget=3_000_000),
+}
+
+
+def measure(name: str, spec: dict, measure_iters: int, precision: str):
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+
+    x, y = make_mnist_like(n=spec["n"], d=spec["d"], seed=0)
+    xd = jnp.asarray(x)
+    yd = jnp.asarray(y, jnp.float32)
+    x2 = row_norms_sq(xd)
+    jax.block_until_ready(x2)
+
+    runner = _build_chunk_runner(spec["c"], spec["gamma"], 1e-3, False,
+                                 precision)
+    carry = init_carry(yd, 0)
+    carry = runner(carry, xd, yd, x2, jnp.int32(200))
+    jax.block_until_ready(carry.f)
+    it0 = int(carry.n_iter)
+    if it0 < 200:
+        carry = init_carry(yd, 0)
+        it0 = 0
+    t0 = time.perf_counter()
+    carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+    jax.block_until_ready(carry.f)
+    dt = time.perf_counter() - t0
+    iters = int(carry.n_iter) - it0
+    rate = iters / dt if dt else 0.0
+    print(json.dumps({
+        "config": name,
+        "shape": [spec["n"], spec["d"]],
+        "iters_per_sec": round(rate, 1),
+        "projected_seconds_for_budget": round(spec["budget"] / rate, 1)
+        if rate else None,
+        "budget_iters": spec["budget"],
+        "precision": precision,
+    }), flush=True)
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(CONFIGS)
+    measure_iters = int(os.environ.get("BENCH_MEASURE_ITERS", 2000))
+    precision = os.environ.get("BENCH_PRECISION", "HIGHEST").upper()
+    for name in names:
+        if name not in CONFIGS:
+            print(f"unknown config {name!r}; choices: {list(CONFIGS)}",
+                  file=sys.stderr)
+            sys.exit(2)
+        measure(name, CONFIGS[name], measure_iters, precision)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,7 @@ Public API
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
-from dpsvm_tpu.api import train
+from dpsvm_tpu.api import train, fit
 
 __version__ = "0.1.0"
 
@@ -42,6 +42,7 @@ __all__ = [
     "TrainResult",
     "SVMModel",
     "train",
+    "fit",
     "decision_function",
     "predict",
     "evaluate",

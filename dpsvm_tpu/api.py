@@ -33,6 +33,9 @@ def train(x: np.ndarray, y: np.ndarray,
     labels = np.unique(y)
     if not np.all(np.isin(labels, (-1, 1))):
         raise ValueError(f"labels must be +/-1, got {labels[:10]}")
+    if config.backend == "numpy":
+        from dpsvm_tpu.solver.oracle import smo_reference
+        return smo_reference(x, y, config)
     if config.shards > 1:
         from dpsvm_tpu.parallel.dist_smo import train_distributed
         return train_distributed(x, y, config)

@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="kernel-row cache lines (0 = fused matmul, no cache)")
     tr.add_argument("--shards", type=int, default=1,
                     help="devices along the data axis (replaces mpirun -np)")
+    tr.add_argument("--backend", default="xla", choices=["xla", "numpy"],
+                    help="'numpy' runs the golden-reference CPU solver "
+                         "(the reference's seq binary equivalent)")
     tr.add_argument("--replicate-x", action="store_true",
                     help="replicate X on every shard (reference layout)")
     tr.add_argument("--checkpoint", default=None,
@@ -85,6 +88,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
         max_iter=args.max_iter, cache_size=args.cache_size,
+        backend=args.backend,
         shards=args.shards, shard_x=not args.replicate_x,
         verbose=not args.quiet,
         checkpoint_path=args.checkpoint,

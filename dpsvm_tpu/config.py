@@ -43,6 +43,9 @@ class SVMConfig:
     cache_size: int = 0                 # kernel-row cache lines (0 = off)
 
     # --- execution ---
+    backend: str = "xla"                # "xla" (compiled) or "numpy" (the
+                                        # golden-reference solver, the
+                                        # seq.cpp-equivalent path)
     shards: int = 1                     # mesh size along the data axis
     shard_x: bool = True                # shard X rows over the mesh (v2);
                                         # False replicates X (reference
@@ -86,6 +89,21 @@ class SVMConfig:
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
         if self.checkpoint_every and not self.checkpoint_path:
             raise ValueError("checkpoint_every set without checkpoint_path")
+        if self.backend not in ("xla", "numpy"):
+            raise ValueError(f"backend must be 'xla' or 'numpy', "
+                             f"got {self.backend!r}")
+        if self.backend == "numpy":
+            if self.shards > 1:
+                raise ValueError("the numpy golden-reference backend is "
+                                 "single-process only (shards must be 1)")
+            unsupported = [name for name, v in (
+                ("checkpoint_path", self.checkpoint_path),
+                ("checkpoint_every", self.checkpoint_every),
+                ("resume_from", self.resume_from),
+                ("profile_dir", self.profile_dir)) if v]
+            if unsupported:
+                raise ValueError(
+                    f"the numpy backend does not support: {unsupported}")
 
 
 @dataclasses.dataclass

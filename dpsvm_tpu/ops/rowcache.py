@@ -65,3 +65,55 @@ def cache_fetch(cache: RowCache, key: jax.Array,
         rows=cache.rows.at[line].set(row),
         tick=tick,
     )
+
+
+def cache_fetch_pair(cache: RowCache, key_a: jax.Array, key_b: jax.Array,
+                     compute_both: Callable[[], jax.Array]
+                     ) -> Tuple[jax.Array, RowCache]:
+    """Fetch the dot-product rows for BOTH working-set keys at once.
+
+    The reference streams the two SGEMVs on separate CUDA streams
+    (``svmTrain.cu:216-249``); on TPU each full pass over X is an HBM
+    stream, so two sequential misses would cost two passes. Instead: if
+    either key misses, ONE ``(2, d) @ (d, n)`` matmul recomputes both rows
+    (a mixed hit/miss wastes one already-cached row's FLOPs but saves a
+    second full pass over X); only a double hit skips the matmul entirely.
+
+    ``compute_both`` returns the stacked (2, n) dot rows. Eviction is LRU
+    over last-use ticks; the two lines are always distinct (key_a's line
+    is patched out of key_b's eviction candidates).
+    """
+    key_a = key_a.astype(jnp.int32)
+    key_b = key_b.astype(jnp.int32)
+    intmax = jnp.iinfo(jnp.int32).max
+
+    same = key_b == key_a          # i_hi == i_lo corner: share one line
+    hit_mask_a = cache.keys == key_a
+    hit_mask_b = cache.keys == key_b
+    hit_a = jnp.any(hit_mask_a)
+    hit_b = jnp.any(hit_mask_b) | same
+
+    # a's eviction scan must not victimize b's hit line (and vice versa):
+    # each side's scan masks out the other's resolved/hit line.
+    line_b_hit = jnp.argmax(hit_mask_b)
+    stamps_a = jnp.where(jnp.any(hit_mask_b) & ~same,
+                         cache.stamps.at[line_b_hit].set(intmax),
+                         cache.stamps)
+    line_a = jnp.where(hit_a, jnp.argmax(hit_mask_a), jnp.argmin(stamps_a))
+
+    stamps_b = cache.stamps.at[line_a].set(intmax)
+    line_b = jnp.where(same, line_a,
+                       jnp.where(jnp.any(hit_mask_b),
+                                 line_b_hit,
+                                 jnp.argmin(stamps_b)))
+
+    def from_cache():
+        return jnp.stack([cache.rows[line_a], cache.rows[line_b]])
+
+    rows = lax.cond(hit_a & hit_b, from_cache, compute_both)     # (2, n)
+
+    tick = cache.tick + 1
+    keys = cache.keys.at[line_a].set(key_a).at[line_b].set(key_b)
+    stamps = cache.stamps.at[line_a].set(tick).at[line_b].set(tick)
+    new_rows = cache.rows.at[line_a].set(rows[0]).at[line_b].set(rows[1])
+    return rows, RowCache(keys=keys, stamps=stamps, rows=new_rows, tick=tick)

@@ -1,0 +1,66 @@
+"""Multi-host (multi-process) initialization.
+
+The reference scales across machines with `mpirun --hostfile hf` and MPI
+process management (``svmTrainMain.cpp:144-159``, ``Makefile:74``). The
+JAX-native equivalent is one process per host calling
+``jax.distributed.initialize`` before any device use; afterwards
+``jax.devices()`` spans every host's chips, the data mesh covers the full
+slice/pod, and the SAME shard_map program runs unchanged — per-iteration
+collectives ride ICI within a slice and DCN across slices. There is no
+MPI anywhere.
+
+Typical launch (one command per host, or via your cluster scheduler):
+
+    python -c "import dpsvm_tpu.parallel.multihost as mh; \
+               mh.initialize(coordinator='host0:8476', num_processes=4, \
+                             process_id=$RANK)" ...
+
+On Cloud TPU VMs all three arguments are discovered from the metadata
+server, so ``initialize()`` with no arguments suffices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join (or create) the multi-host runtime. Idempotent."""
+    global _initialized
+    if is_initialized():
+        return
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # Someone else (a launcher) already initialized this process.
+        if "already" not in str(e).lower():
+            raise
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    # jax exposes no public "is the distributed client up" predicate
+    # (jax.distributed.global_state is gone in 0.9), so track our own
+    # calls and fall back to the observable multi-process signal.
+    return _initialized or jax.process_count() > 1
+
+
+def process_info() -> str:
+    """Rank banner, the reference's Get_rank/Get_processor_name analog
+    (``svmTrainMain.cpp:154-167``)."""
+    return (f"process {jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local / "
+            f"{jax.device_count()} global devices")

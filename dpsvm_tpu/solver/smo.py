@@ -31,7 +31,7 @@ from jax import lax
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
-from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch, cache_init
+from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import masked_extrema
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
@@ -68,13 +68,10 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 
     cache = carry.cache
     if use_cache:
-        dots_hi, cache = cache_fetch(
-            cache, i_hi,
-            lambda: jnp.matmul(x, x[i_hi], precision=precision))
-        dots_lo, cache = cache_fetch(
-            cache, i_lo,
-            lambda: jnp.matmul(x, x[i_lo], precision=precision))
-        dots = jnp.stack([dots_hi, dots_lo])
+        dots, cache = cache_fetch_pair(
+            cache, i_hi, i_lo,
+            lambda: jnp.matmul(jnp.stack([x[i_hi], x[i_lo]]), x.T,
+                               precision=precision))
     else:
         rows = jnp.stack([x[i_hi], x[i_lo]])                     # (2, d)
         dots = jnp.matmul(rows, x.T, precision=precision)        # (2, n)

@@ -1,0 +1,51 @@
+"""Public API: dispatch across backends/meshes, input validation."""
+
+import numpy as np
+import pytest
+
+import dpsvm_tpu as dt
+
+
+def test_numpy_backend_dispatch(blobs_small):
+    x, y = blobs_small
+    cfg = dt.SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                       backend="numpy")
+    ref = dt.train(x, y, cfg)
+    xla = dt.train(x, y, dt.SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3,
+                                      max_iter=20_000))
+    assert ref.n_iter == xla.n_iter
+    np.testing.assert_allclose(ref.alpha, xla.alpha, rtol=1e-4, atol=1e-5)
+
+
+def test_fit_returns_model_and_result(blobs_small):
+    x, y = blobs_small
+    model, result = dt.fit(x, y, dt.SVMConfig(c=1.0, gamma=0.25,
+                                              epsilon=1e-3, max_iter=20_000))
+    assert model.n_sv == result.n_sv
+    assert dt.evaluate(model, x, y) >= 0.95
+
+
+def test_label_validation():
+    x = np.zeros((4, 2), np.float32)
+    with pytest.raises(ValueError, match="labels"):
+        dt.train(x, np.array([0, 1, 2, 3]))
+
+
+def test_shape_validation(blobs_small):
+    x, y = blobs_small
+    with pytest.raises(ValueError, match=r"y must be"):
+        dt.train(x, y[:-1])
+    with pytest.raises(ValueError, match=r"x must be"):
+        dt.train(x.ravel(), y)
+
+
+def test_numpy_backend_rejects_shards():
+    with pytest.raises(ValueError, match="single-process"):
+        dt.SVMConfig(backend="numpy", shards=2).validate()
+
+
+def test_multihost_helpers_single_process():
+    from dpsvm_tpu.parallel import multihost
+    assert not multihost.is_initialized()
+    info = multihost.process_info()
+    assert "process 0/1" in info

@@ -1,0 +1,87 @@
+"""HBM row-cache unit tests: pair fetch semantics, LRU eviction, the
+i_hi == i_lo corner, and that a double hit really skips recompute."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dpsvm_tpu.ops.rowcache import cache_fetch_pair, cache_init
+
+
+def test_pair_fetch_basic_and_hit():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
+    cache = cache_init(4, 10)
+
+    rows1, cache = cache_fetch_pair(cache, jnp.int32(2), jnp.int32(5),
+                                    lambda: jnp.stack([x @ x[2], x @ x[5]]))
+    np.testing.assert_allclose(np.asarray(rows1[0]), np.asarray(x @ x[2]),
+                               rtol=1e-6)
+    assert set(np.asarray(cache.keys)[np.asarray(cache.keys) >= 0]) == {2, 5}
+
+    # double hit: compute must NOT run (poisoned compute would corrupt rows)
+    poison = lambda: jnp.full((2, 10), jnp.nan)
+    rows2, cache = cache_fetch_pair(cache, jnp.int32(2), jnp.int32(5), poison)
+    assert not np.any(np.isnan(np.asarray(rows2)))
+    np.testing.assert_array_equal(np.asarray(rows2), np.asarray(rows1))
+
+
+def test_same_key_shares_line():
+    x = jnp.asarray(np.eye(6, dtype=np.float32))
+    cache = cache_init(4, 6)
+    rows, cache = cache_fetch_pair(cache, jnp.int32(3), jnp.int32(3),
+                                   lambda: jnp.stack([x @ x[3], x @ x[3]]))
+    keys = np.asarray(cache.keys)
+    assert (keys == 3).sum() == 1          # one line, not two
+
+
+def test_lru_eviction_prefers_oldest():
+    x = jnp.asarray(np.eye(8, dtype=np.float32))
+    cache = cache_init(4, 8)
+
+    def rows_for(a, b):
+        return lambda: jnp.stack([x @ x[a], x @ x[b]])
+
+    _, cache = cache_fetch_pair(cache, jnp.int32(0), jnp.int32(1),
+                                rows_for(0, 1))
+    _, cache = cache_fetch_pair(cache, jnp.int32(2), jnp.int32(3),
+                                rows_for(2, 3))
+    # touch 0/1 so 2/3 become LRU
+    _, cache = cache_fetch_pair(cache, jnp.int32(0), jnp.int32(1),
+                                rows_for(0, 1))
+    # new pair must evict 2 and 3
+    _, cache = cache_fetch_pair(cache, jnp.int32(4), jnp.int32(5),
+                                rows_for(4, 5))
+    keys = set(np.asarray(cache.keys).tolist())
+    assert keys == {0, 1, 4, 5}
+
+
+def test_miss_a_must_not_evict_bs_hit_line():
+    """Regression: with lines [key0(oldest), key1], fetching (miss=5, hit=0)
+    must evict key1's line for 5 — not victimize the very line key0 hits."""
+    x = jnp.asarray(np.eye(8, dtype=np.float32))
+    cache = cache_init(2, 8)
+    _, cache = cache_fetch_pair(cache, jnp.int32(0), jnp.int32(1),
+                                lambda: jnp.stack([x @ x[0], x @ x[1]]))
+    rows, cache = cache_fetch_pair(cache, jnp.int32(5), jnp.int32(0),
+                                   lambda: jnp.stack([x @ x[5], x @ x[0]]))
+    keys = set(np.asarray(cache.keys).tolist())
+    assert keys == {0, 5}
+    np.testing.assert_allclose(np.asarray(rows[0]), np.asarray(x @ x[5]))
+    # and 5 is now a hit (poisoned compute must not run)
+    rows2, cache = cache_fetch_pair(cache, jnp.int32(5), jnp.int32(0),
+                                    lambda: jnp.full((2, 8), jnp.nan))
+    assert not np.any(np.isnan(np.asarray(rows2)))
+
+
+def test_mixed_hit_miss_recomputes_both_correctly():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(12, 5)).astype(np.float32))
+    cache = cache_init(3, 12)
+    _, cache = cache_fetch_pair(cache, jnp.int32(1), jnp.int32(2),
+                                lambda: jnp.stack([x @ x[1], x @ x[2]]))
+    # 1 hits, 7 misses -> one batched recompute of both
+    rows, cache = cache_fetch_pair(cache, jnp.int32(1), jnp.int32(7),
+                                   lambda: jnp.stack([x @ x[1], x @ x[7]]))
+    np.testing.assert_allclose(np.asarray(rows[1]), np.asarray(x @ x[7]),
+                               rtol=1e-6)
+    assert 7 in set(np.asarray(cache.keys).tolist())
