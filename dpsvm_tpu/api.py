@@ -39,6 +39,9 @@ def train(x: np.ndarray, y: np.ndarray,
     if config.shards > 1:
         from dpsvm_tpu.parallel.dist_smo import train_distributed
         return train_distributed(x, y, config)
+    from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
+    if use_fused(config):
+        return train_single_device_fused(x, y, config)
     from dpsvm_tpu.solver.smo import train_single_device
     return train_single_device(x, y, config)
 

@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="MXU matmul precision: 'highest'=exact f32 "
                          "(reference parity), 'default'=bf16-multiply "
                          "(~3.6x faster, K within ~1e-2)")
+    tr.add_argument("--pallas", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fused Pallas iteration kernel: 'auto' uses it "
+                         "on real TPU when compatible; 'off' keeps the "
+                         "plain XLA path (A/B escape hatch)")
     tr.add_argument("-q", "--quiet", action="store_true")
 
     te = sub.add_parser("test", help="evaluate a saved model on a dataset")
@@ -97,6 +102,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         profile_dir=args.profile_dir,
         debug_nans=args.debug_nans,
         matmul_precision=args.precision,
+        use_pallas=args.pallas,
     )
     model, result = fit(x, y, config)
     n_sv = save_model(model, args.model)

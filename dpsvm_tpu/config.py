@@ -52,6 +52,11 @@ class SVMConfig:
                                         # parity: every rank holds full X,
                                         # svmTrainMain.cpp:180)
     chunk_iters: int = 512              # host polls convergence every chunk
+    use_pallas: str = "auto"            # fused Pallas iteration kernel:
+                                        # "auto" = on real TPU when
+                                        # compatible (no row cache, no
+                                        # sharding), "on" = force (interpret
+                                        # mode off-TPU), "off" = never
     matmul_precision: str = "highest"   # jax.lax precision for kernel rows
                                         # (solver dtype is float32 for
                                         # reference parity, not configurable)
@@ -64,6 +69,18 @@ class SVMConfig:
     resume_from: Optional[str] = None       # checkpoint to resume from
     profile_dir: Optional[str] = None       # jax.profiler trace output dir
     debug_nans: bool = False                # jax_debug_nans during training
+
+    def fused_incompatibility(self) -> Optional[str]:
+        """Why the fused Pallas kernel cannot run this config (None if it
+        can). Single source of truth for validate() and the dispatch
+        policy in solver/fused.use_fused."""
+        if self.backend != "xla":
+            return f"backend {self.backend!r}"
+        if self.shards > 1:
+            return "shards > 1"
+        if self.cache_size > 0:
+            return "the kernel-row cache (cache_size > 0)"
+        return None
 
     def resolve_gamma(self, num_attributes: int) -> float:
         if self.gamma is not None:
@@ -89,6 +106,13 @@ class SVMConfig:
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
         if self.checkpoint_every and not self.checkpoint_path:
             raise ValueError("checkpoint_every set without checkpoint_path")
+        if self.use_pallas not in ("auto", "on", "off"):
+            raise ValueError(f"use_pallas must be 'auto', 'on' or 'off', "
+                             f"got {self.use_pallas!r}")
+        if self.use_pallas == "on" and self.fused_incompatibility():
+            raise ValueError("the fused Pallas kernel does not support "
+                             f"{self.fused_incompatibility()}; use "
+                             "use_pallas='auto' or 'off'")
         if self.backend not in ("xla", "numpy"):
             raise ValueError(f"backend must be 'xla' or 'numpy', "
                              f"got {self.backend!r}")

@@ -1,0 +1,242 @@
+"""Fused SMO iteration as one Pallas TPU kernel.
+
+The XLA path (solver/smo.py) lowers one SMO iteration to several HLO
+ops — working-row gather, (2, d) @ (d, n) matmul, RBF epilogue, f AXPY,
+masked argmin/argmax — each making its own pass over HBM. This kernel
+fuses everything that touches O(n) data into a SINGLE pass over X per
+iteration (the reference's equivalent span is ``train_step2`` +
+``train_step1``, svmTrain.cu:485-497/469-483, which launches five device
+kernels and crosses the host boundary each iteration):
+
+    grid over row-blocks of X; for block k:
+      dots  = rows @ X[k]^T                  (MXU)
+      K     = exp(-gamma (x2 + w2 - 2 dots)) (VPU, svmTrain.cu:128-135)
+      f[k] += dhi*K_hi + dlo*K_lo            (update_functor semantics)
+      block-local Keerthi-masked argmin/argmax of the NEW f
+      sequential SMEM scan -> next iteration's working set
+
+so the next selection comes out of the same HBM pass that updates f.
+The scalar prologue (eta from the two working rows, alpha updates with
+the reference's independent clip, svmTrainMain.cpp:282-295) runs in XLA
+before the kernel; for the RBF kernel eta depends only on the two rows,
+never on the full K rows, which is what makes the fusion legal.
+
+Padding contract: arrays are padded to a multiple of the block size with
+x = 0, y = 0, alpha = 0. Padded rows classify into neither I_up nor
+I_low (the ``valid = y != 0`` guard below), so selection can never
+return one.
+
+Outside TPU the kernel runs in Pallas interpret mode, which is what the
+CPU test-suite exercises.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dpsvm_tpu.ops.selection import masked_scores
+
+# Row-block size: X block (BLOCK_N, d) f32 must fit in VMEM twice
+# (double buffering). 512 rows x 784 feats x 4 B = 1.6 MB.
+DEFAULT_BLOCK_N = 512
+
+
+def pad_to_block(n: int, block_n: int) -> int:
+    return ((n + block_n - 1) // block_n) * block_n
+
+
+def _fused_iter_kernel(scal_ref, rows_ref, x_ref, x2_ref, y_ref, alpha_ref,
+                       f_ref, fout_ref, sel_i_ref, sel_v_ref,
+                       best_i, best_v, *, block_n: int, mxu_precision):
+    """One grid step: process rows [k*block_n, (k+1)*block_n) of X."""
+    k = pl.program_id(0)
+
+    d_hi = scal_ref[0]      # (alpha_hi' - alpha_hi) * y_hi
+    d_lo = scal_ref[1]      # (alpha_lo' - alpha_lo) * y_lo
+    gamma = scal_ref[2]
+    w2_hi = scal_ref[3]     # |x_hi|^2
+    w2_lo = scal_ref[4]
+    c = scal_ref[5]
+
+    # (2, block_n) dot products of both working rows against this block.
+    dots = lax.dot_general(
+        rows_ref[:], x_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=mxu_precision)
+
+    x2b = x2_ref[0]
+    k_hi = jnp.exp(-gamma * (x2b + w2_hi - 2.0 * dots[0]))
+    k_lo = jnp.exp(-gamma * (x2b + w2_lo - 2.0 * dots[1]))
+    fnew = f_ref[0] + d_hi * k_hi + d_lo * k_lo
+    fout_ref[0] = fnew
+
+    # Keerthi-masked scores on the POST-update (alpha, f); padding rows
+    # (y == 0) belong to neither set. Same helper as the XLA path so the
+    # svmTrain.cu:54-91 semantics live in exactly one place.
+    yb = y_ref[0]
+    f_up, f_low = masked_scores(alpha_ref[0], yb, fnew, c, valid=yb != 0.0)
+
+    bmin = jnp.min(f_up)
+    imin = jnp.argmin(f_up).astype(jnp.int32) + k * block_n
+    bmax = jnp.max(f_low)
+    imax = jnp.argmax(f_low).astype(jnp.int32) + k * block_n
+
+    # Sequential cross-block scan (TPU grid steps run in order). Strict
+    # </> keeps the first-index-wins tie-break of jnp.argmin/argmax.
+    @pl.when(k == 0)
+    def _():
+        best_v[0] = bmin
+        best_i[0] = imin
+        best_v[1] = bmax
+        best_i[1] = imax
+
+    @pl.when((k > 0) & (bmin < best_v[0]))
+    def _():
+        best_v[0] = bmin
+        best_i[0] = imin
+
+    @pl.when((k > 0) & (bmax > best_v[1]))
+    def _():
+        best_v[1] = bmax
+        best_i[1] = imax
+
+    @pl.when(k == pl.num_programs(0) - 1)
+    def _():
+        sel_i_ref[0] = best_i[0]
+        sel_i_ref[1] = best_i[1]
+        sel_v_ref[0] = best_v[0]
+        sel_v_ref[1] = best_v[1]
+
+
+def fused_update_select(rows, scalars, x, x2, y, alpha, f, *,
+                        block_n: int = DEFAULT_BLOCK_N,
+                        mxu_precision=lax.Precision.HIGHEST,
+                        interpret: bool = False):
+    """f update + next working-set selection in one pass over X.
+
+    rows: (2, d) working rows [x_hi, x_lo] (same dtype as x);
+    scalars: (8,) f32 [d_hi, d_lo, gamma, w2_hi, w2_lo, c, 0, 0];
+    x: (n_pad, d); x2/y/alpha/f: (1, n_pad) f32, padded as per module
+    docstring. Returns (f_new (1, n_pad), sel_i (2,) i32, sel_v (2,) f32)
+    where sel_i = [i_hi, i_lo] and sel_v = [b_hi, b_lo].
+    """
+    n_pad, d = x.shape
+    assert n_pad % block_n == 0, (n_pad, block_n)
+    nb = n_pad // block_n
+
+    vec = lambda: pl.BlockSpec((1, block_n), lambda k: (0, k),
+                               memory_space=pltpu.VMEM)
+    kernel = functools.partial(_fused_iter_kernel, block_n=block_n,
+                               mxu_precision=mxu_precision)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # scalars
+            pl.BlockSpec((2, d), lambda k: (0, 0),
+                         memory_space=pltpu.VMEM),                 # rows
+            pl.BlockSpec((block_n, d), lambda k: (k, 0),
+                         memory_space=pltpu.VMEM),                 # x block
+            vec(),                                                 # x2
+            vec(),                                                 # y
+            vec(),                                                 # alpha
+            vec(),                                                 # f
+        ],
+        out_specs=[
+            vec(),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.SMEM((2,), jnp.int32),
+                        pltpu.SMEM((2,), jnp.float32)],
+        input_output_aliases={6: 0},
+        interpret=interpret,
+    )(scalars, rows, x, x2, y, alpha, f)
+
+
+class FusedCarry(NamedTuple):
+    """While-loop carry for the fused path. Selection lives in the carry:
+    each body consumes the working set chosen at the tail of the previous
+    iteration (the semantics are identical to select-then-update — the
+    selection has just moved across the loop back-edge)."""
+    alpha: jax.Array   # (1, n_pad) f32
+    f: jax.Array       # (1, n_pad) f32
+    i_hi: jax.Array    # () i32
+    i_lo: jax.Array    # () i32
+    b_hi: jax.Array    # () f32
+    b_lo: jax.Array    # () f32
+    n_iter: jax.Array  # () i32
+
+
+def fused_smo_body(carry: FusedCarry, x, x2, y, c: float, gamma: float, *,
+                   block_n: int = DEFAULT_BLOCK_N,
+                   mxu_precision=lax.Precision.HIGHEST,
+                   interpret: bool = False) -> FusedCarry:
+    """One SMO iteration: scalar prologue in XLA, O(n) work in Pallas.
+
+    Same math as solver/smo.py::smo_step (svmTrainMain.cpp:282-299):
+    eta from the two working rows (K(a,a) uses the same exp form as the
+    reference's host rbf_kernel, svmTrain.cu:696-714), alpha updates
+    independently clipped to [0, C], lo written before hi.
+    """
+    i_hi, i_lo = carry.i_hi, carry.i_lo
+    b_hi, b_lo = carry.b_hi, carry.b_lo
+    alpha, f = carry.alpha, carry.f
+    d = x.shape[1]
+
+    row_hi = lax.dynamic_slice(x, (i_hi, 0), (1, d))
+    row_lo = lax.dynamic_slice(x, (i_lo, 0), (1, d))
+    rows = jnp.concatenate([row_hi, row_lo], axis=0)          # (2, d)
+    rows32 = rows.astype(jnp.float32)
+
+    x2_hi = x2[0, i_hi]
+    x2_lo = x2[0, i_lo]
+    pair = jnp.matmul(rows32, rows32.T,
+                      precision=lax.Precision.HIGHEST)        # (2, 2)
+    k_hh = jnp.exp(-gamma * (2.0 * x2_hi - 2.0 * pair[0, 0]))
+    k_ll = jnp.exp(-gamma * (2.0 * x2_lo - 2.0 * pair[1, 1]))
+    k_hl = jnp.exp(-gamma * (x2_hi + x2_lo - 2.0 * pair[0, 1]))
+    eta = k_hh + k_ll - 2.0 * k_hl
+
+    y_hi = y[0, i_hi]
+    y_lo = y[0, i_lo]
+    a_hi = alpha[0, i_hi]
+    a_lo = alpha[0, i_lo]
+    s = y_lo * y_hi
+    a_lo_u = a_lo + y_lo * (b_hi - b_lo) / eta
+    a_hi_u = a_hi + s * (a_lo - a_lo_u)
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+
+    # lo written before hi (svmTrain.cu:491-492); the f-update deltas use
+    # the computed values, not a re-read, matching svmTrain.cu:485-497.
+    alpha = alpha.at[0, i_lo].set(a_lo_n)
+    alpha = alpha.at[0, i_hi].set(a_hi_n)
+
+    scalars = jnp.stack([
+        (a_hi_n - a_hi) * y_hi,
+        (a_lo_n - a_lo) * y_lo,
+        jnp.float32(gamma),
+        x2_hi, x2_lo, jnp.float32(c),
+        jnp.float32(0.0), jnp.float32(0.0),
+    ]).astype(jnp.float32)
+
+    f_new, sel_i, sel_v = fused_update_select(
+        rows, scalars, x, x2, y, alpha, f,
+        block_n=block_n, mxu_precision=mxu_precision, interpret=interpret)
+
+    return FusedCarry(alpha=alpha, f=f_new,
+                      i_hi=sel_i[0], i_lo=sel_i[1],
+                      b_hi=sel_v[0], b_lo=sel_v[1],
+                      n_iter=carry.n_iter + 1)

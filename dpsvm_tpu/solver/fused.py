@@ -1,0 +1,165 @@
+"""Single-device SMO with the fused Pallas iteration kernel.
+
+Same algorithm and driver contract as solver/smo.py, but each iteration's
+O(n) work — kernel rows, f update, next working-set selection — is one
+Pallas pass over X (ops/fused_step.py) instead of several XLA ops. The
+whole loop still lives in one ``lax.while_loop`` under ``jit``; only the
+state layout differs (vectors are (1, n_pad) so the kernel can slice them
+on the 128-lane axis, and the working set rides in the carry across the
+loop back-edge).
+
+When ``matmul_precision == "default"`` X is stored bfloat16, halving the
+per-iteration HBM traffic that dominates the iteration; f/alpha/x2 stay
+float32 (the accumulators and all scalar math are always float32).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.ops.fused_step import (DEFAULT_BLOCK_N, FusedCarry,
+                                      fused_smo_body, pad_to_block)
+from dpsvm_tpu.ops.kernels import row_norms_sq
+from dpsvm_tpu.ops.selection import masked_extrema
+from dpsvm_tpu.solver.driver import host_training_loop, resume_state
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def use_fused(config: SVMConfig) -> bool:
+    """Dispatch policy for api.train: 'auto' takes the fused path on real
+    TPU when nothing incompatible (row cache, numpy backend, sharding) is
+    requested; 'on' forces it anywhere via interpret mode (tests)."""
+    if config.use_pallas == "off":
+        return False
+    if config.fused_incompatibility() is not None:
+        return False
+    if config.use_pallas == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("c", "gamma", "epsilon",
+                                             "max_iter", "block_n",
+                                             "precision_name", "interpret"),
+                   donate_argnums=(0,))
+def _run_chunk(carry: FusedCarry, x, x2, y, limit, *, c, gamma, epsilon,
+               max_iter, block_n, precision_name, interpret):
+    precision = getattr(lax.Precision, precision_name)
+    entry_iter = carry.n_iter
+
+    def cond(s: FusedCarry):
+        return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
+
+    def body(s: FusedCarry):
+        return fused_smo_body(s, x, x2, y, c, gamma, block_n=block_n,
+                              mxu_precision=precision, interpret=interpret)
+
+    final = lax.while_loop(cond, body, carry)
+
+    # Reference do-while parity (svmTrainMain.cpp:235-310): the body whose
+    # selection first satisfies the gap still performs its alpha/f update
+    # before the loop condition is evaluated. Our while checks the gap
+    # before the update, so on a convergence exit apply that one trailing
+    # update (keeping the converged b_hi/b_lo, which are what the
+    # reference reports and derives b from). Gates: the reference only
+    # runs that body while iter < max_iter, and a chunk that made no
+    # progress (already-converged carry, e.g. resuming a finished run)
+    # must not re-apply it.
+    def trailing(s: FusedCarry):
+        t = body(s)
+        return t._replace(b_hi=s.b_hi, b_lo=s.b_lo)
+
+    converged = ~(final.b_lo > final.b_hi + 2.0 * epsilon)
+    # Fire when this call discovered convergence: after making progress,
+    # or at program start (entry_iter == 0) when even the very first
+    # selection satisfies the gap — the reference's do-while still runs
+    # one body there. Resuming a finished run (entry_iter > 0, zero
+    # bodies) must not re-apply it.
+    discovered = (final.n_iter > entry_iter) | (entry_iter == 0)
+    do_trailing = converged & (final.n_iter < max_iter) & discovered
+    return lax.cond(do_trailing, trailing, lambda s: s, final)
+
+
+def init_fused_carry(alpha, f, y, c: float) -> FusedCarry:
+    """Selection for the first iteration from current (alpha, f); also the
+    resume path — the working set is a pure function of solver state."""
+    valid = y[0] != 0.0
+    i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha[0], y[0], f[0], c,
+                                            valid=valid)
+    return FusedCarry(alpha=alpha, f=f,
+                      i_hi=i_hi.astype(jnp.int32),
+                      i_lo=i_lo.astype(jnp.int32),
+                      b_hi=b_hi, b_lo=b_lo, n_iter=jnp.int32(0))
+
+
+def train_single_device_fused(x: np.ndarray, y: np.ndarray,
+                              config: SVMConfig,
+                              device: Optional[jax.Device] = None,
+                              block_n: int = DEFAULT_BLOCK_N) -> TrainResult:
+    """Train on one device via the fused Pallas iteration kernel."""
+    config.validate()
+    n, d = x.shape
+    gamma = float(config.resolve_gamma(d))
+    interpret = _should_interpret()
+    precision_name = config.matmul_precision.upper()
+
+    n_pad = pad_to_block(n, block_n)
+    xp = np.zeros((n_pad, d), np.float32)
+    xp[:n] = x
+    yp = np.zeros((1, n_pad), np.float32)
+    yp[0, :n] = y
+
+    x_dtype = (jnp.bfloat16 if config.matmul_precision == "default"
+               else jnp.float32)
+    xd = jax.device_put(jnp.asarray(xp), device).astype(x_dtype)
+    # x2 from the STORED (possibly bf16-cast) X so that K(a, a) computed
+    # from bf16 dot products stays ~1 and eta stays positive; in f32 mode
+    # this is the plain row-norm.
+    x2 = row_norms_sq(xd.astype(jnp.float32))[None, :]       # (1, n_pad) f32
+    yd = jax.device_put(jnp.asarray(yp), device)
+
+    alpha = jnp.zeros((1, n_pad), jnp.float32)
+    f = -yd                                                  # f = -y, pad 0
+
+    ckpt = resume_state(config, n, d, gamma)
+    if ckpt is not None:
+        alpha = alpha.at[0, :n].set(jnp.asarray(ckpt.alpha))
+        f = f.at[0, :n].set(jnp.asarray(ckpt.f))
+    carry = init_fused_carry(alpha, f, yd, float(config.c))
+    if ckpt is not None:
+        carry = carry._replace(n_iter=jnp.int32(ckpt.n_iter))
+        # A finished-run checkpoint (gap closed) must exit immediately
+        # without re-applying the trailing do-while update, so keep its
+        # recorded gap. A mid-training checkpoint gets the freshly
+        # recomputed selection instead: its b's must come from the
+        # CURRENT (alpha, f) because the fused body feeds b_hi - b_lo
+        # into the alpha step (checkpoints written by the smo path store
+        # the previous body's selection there, which would be stale).
+        if not (ckpt.b_lo > ckpt.b_hi + 2.0 * float(config.epsilon)):
+            carry = carry._replace(b_hi=jnp.float32(ckpt.b_hi),
+                                   b_lo=jnp.float32(ckpt.b_lo))
+    if device is not None:
+        carry = jax.device_put(carry, device)
+
+    run = functools.partial(
+        _run_chunk, c=float(config.c), gamma=gamma,
+        epsilon=float(config.epsilon), max_iter=int(config.max_iter),
+        block_n=block_n, precision_name=precision_name,
+        interpret=interpret)
+
+    return host_training_loop(
+        config, gamma, n, d, carry,
+        step_chunk=lambda s, lim: run(s, xd, x2, yd, jnp.int32(lim)),
+        carry_to_host=lambda s: (np.asarray(s.alpha[0, :n]),
+                                 np.asarray(s.f[0, :n])),
+    )

@@ -1,0 +1,139 @@
+"""Fused Pallas iteration kernel vs the NumPy oracle and the XLA path.
+
+Runs in Pallas interpret mode on the CPU test platform (the kernel's
+compiled form is exercised on real TPU by bench.py and the driver's
+compile check). Padding is covered by sizes far from the 512-row block
+and by an odd feature count that does not fill the 128-lane tile.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor
+from dpsvm_tpu.models.svm import SVMModel, evaluate
+from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
+from dpsvm_tpu.solver.oracle import smo_reference
+from dpsvm_tpu.solver.smo import train_single_device
+
+
+def _cfg(**kw):
+    kw.setdefault("use_pallas", "on")
+    kw.setdefault("epsilon", 1e-3)
+    kw.setdefault("max_iter", 20_000)
+    kw.setdefault("chunk_iters", 64)
+    return SVMConfig(**kw)
+
+
+def test_fused_matches_oracle(blobs_small):
+    x, y = blobs_small
+    cfg = _cfg(c=1.0, gamma=0.5)
+    ref = smo_reference(x, y, SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3,
+                                        max_iter=20_000))
+    dev = train_single_device_fused(x, y, cfg)
+    assert dev.converged == ref.converged
+    assert dev.n_iter == ref.n_iter, (dev.n_iter, ref.n_iter)
+    np.testing.assert_allclose(dev.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+    assert abs(dev.b - ref.b) < 1e-4
+    assert dev.n_sv == ref.n_sv
+
+
+def test_fused_matches_xla_path_xor(xor_small):
+    x, y = xor_small
+    cfg = _cfg(c=10.0, gamma=1.0)
+    xla = train_single_device(x, y, SVMConfig(c=10.0, gamma=1.0,
+                                              epsilon=1e-3, max_iter=20_000,
+                                              chunk_iters=64))
+    fused = train_single_device_fused(x, y, cfg)
+    assert fused.n_iter == xla.n_iter
+    np.testing.assert_allclose(fused.alpha, xla.alpha, rtol=1e-4, atol=1e-5)
+    assert fused.n_sv == xla.n_sv
+
+
+def test_fused_odd_feature_count():
+    """d = 130 spills one element into a second 128-lane tile; catches
+    any garbage contribution from lane padding in the block matmul."""
+    x, y = make_blobs(n=90, d=130, seed=5)
+    cfg = _cfg(c=1.0, gamma=1.0 / 130)
+    ref = smo_reference(x, y, SVMConfig(c=1.0, gamma=1.0 / 130,
+                                        epsilon=1e-3, max_iter=20_000))
+    dev = train_single_device_fused(x, y, cfg)
+    assert dev.n_iter == ref.n_iter
+    np.testing.assert_allclose(dev.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_padding_never_selected():
+    """n = 100 pads to 512: 80% padding rows must stay out of the model."""
+    x, y = make_blobs(n=100, d=7, seed=11)
+    res = train_single_device_fused(x, y, _cfg(c=1.0, gamma=0.3))
+    assert res.alpha.shape == (100,)
+    assert res.converged
+    model = SVMModel.from_train_result(x, y, res)
+    assert evaluate(model, x, y) > 0.95
+
+
+def test_fused_bf16_mode_trains(blobs_small):
+    """matmul_precision='default' stores X in bfloat16; model quality must
+    hold even though the iteration path may differ from f32."""
+    x, y = blobs_small
+    res = train_single_device_fused(x, y, _cfg(c=1.0, gamma=0.5,
+                                               matmul_precision="default"))
+    assert res.converged
+    model = SVMModel.from_train_result(x, y, res)
+    assert evaluate(model, x, y) > 0.95
+
+
+def test_fused_resume_checkpoint(tmp_path, blobs_small):
+    x, y = blobs_small
+    ck = str(tmp_path / "state.npz")
+    full = train_single_device_fused(x, y, _cfg(c=1.0, gamma=0.5))
+    partial_cfg = _cfg(c=1.0, gamma=0.5, max_iter=5,
+                       checkpoint_path=ck, checkpoint_every=1,
+                       chunk_iters=5)
+    train_single_device_fused(x, y, partial_cfg)
+    resumed = train_single_device_fused(
+        x, y, _cfg(c=1.0, gamma=0.5, resume_from=ck))
+    assert resumed.n_iter == full.n_iter
+    np.testing.assert_allclose(resumed.alpha, full.alpha,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_convergence_on_chunk_boundary(blobs_small):
+    """If the gap closes exactly when a chunk's iteration limit is hit,
+    the trailing do-while update must still be applied (reference runs
+    the update of the converged selection before checking the loop
+    condition, svmTrainMain.cpp:235-310)."""
+    x, y = blobs_small
+    full = train_single_device_fused(x, y, _cfg(c=1.0, gamma=0.5))
+    # Convergence is discovered at the end of body n_iter-1; make that
+    # the chunk boundary.
+    boundary = _cfg(c=1.0, gamma=0.5, chunk_iters=full.n_iter - 1)
+    res = train_single_device_fused(x, y, boundary)
+    assert res.n_iter == full.n_iter
+    np.testing.assert_allclose(res.alpha, full.alpha, rtol=1e-6, atol=1e-7)
+
+
+def test_fused_converged_at_start_runs_one_body(blobs_small):
+    """epsilon >= 1 closes the initial gap (f = -y gives gap exactly 2);
+    the reference's do-while still runs one body. Both paths must agree."""
+    x, y = blobs_small
+    xla = train_single_device(x, y, SVMConfig(c=1.0, gamma=0.5, epsilon=1.0,
+                                              max_iter=100, chunk_iters=16))
+    fused = train_single_device_fused(x, y, _cfg(c=1.0, gamma=0.5,
+                                                 epsilon=1.0, max_iter=100,
+                                                 chunk_iters=16))
+    assert fused.n_iter == xla.n_iter == 1
+    np.testing.assert_allclose(fused.alpha, xla.alpha, rtol=1e-5, atol=1e-6)
+
+
+def test_use_fused_dispatch_policy():
+    assert use_fused(_cfg())                                # forced on
+    assert not use_fused(SVMConfig(use_pallas="off"))
+    assert not use_fused(SVMConfig(use_pallas="auto"))      # CPU tests
+    assert not use_fused(SVMConfig(use_pallas="auto", cache_size=4))
+    with pytest.raises(ValueError):
+        SVMConfig(use_pallas="on", cache_size=4).validate()
+    with pytest.raises(ValueError):
+        SVMConfig(use_pallas="maybe").validate()
+    with pytest.raises(ValueError):
+        SVMConfig(use_pallas="on", backend="numpy").validate()
