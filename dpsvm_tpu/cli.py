@@ -67,11 +67,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="MXU matmul precision: 'highest'=exact f32 "
                          "(reference parity), 'default'=bf16-multiply "
                          "(~3.6x faster, K within ~1e-2)")
+    tr.add_argument("--selection", default="first-order",
+                    choices=["first-order", "second-order"],
+                    help="working-set rule: 'first-order' = reference "
+                         "parity; 'second-order' = LIBSVM WSS2 (usually "
+                         "far fewer iterations)")
     tr.add_argument("--pallas", default="auto",
                     choices=["auto", "on", "off"],
-                    help="fused Pallas iteration kernel: 'auto' uses it "
-                         "on real TPU when compatible; 'off' keeps the "
-                         "plain XLA path (A/B escape hatch)")
+                    help="fused Pallas iteration kernel: 'on' forces it; "
+                         "'auto' currently prefers the XLA path (faster "
+                         "on measured hardware, see solver/fused.py)")
     tr.add_argument("-q", "--quiet", action="store_true")
 
     te = sub.add_parser("test", help="evaluate a saved model on a dataset")
@@ -103,6 +108,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         debug_nans=args.debug_nans,
         matmul_precision=args.precision,
         use_pallas=args.pallas,
+        selection=args.selection,
     )
     model, result = fit(x, y, config)
     n_sv = save_model(model, args.model)

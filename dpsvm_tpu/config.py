@@ -41,6 +41,11 @@ class SVMConfig:
     epsilon: float = 0.001              # convergence tolerance
     max_iter: int = 150_000             # iteration cap
     cache_size: int = 0                 # kernel-row cache lines (0 = off)
+    selection: str = "first-order"      # working-set rule: "first-order"
+                                        # (reference parity, svmTrain.cu:
+                                        # 476-481) or "second-order" (the
+                                        # LIBSVM WSS2 rule — usually far
+                                        # fewer iterations to convergence)
 
     # --- execution ---
     backend: str = "xla"                # "xla" (compiled) or "numpy" (the
@@ -53,10 +58,10 @@ class SVMConfig:
                                         # svmTrainMain.cpp:180)
     chunk_iters: int = 512              # host polls convergence every chunk
     use_pallas: str = "auto"            # fused Pallas iteration kernel:
-                                        # "auto" = on real TPU when
-                                        # compatible (no row cache, no
-                                        # sharding), "on" = force (interpret
-                                        # mode off-TPU), "off" = never
+                                        # "on" = force (interpret mode
+                                        # off-TPU); "auto"/"off" = plain
+                                        # XLA path (faster on measured
+                                        # hardware — see fused.use_fused)
     matmul_precision: str = "highest"   # jax.lax precision for kernel rows
                                         # (solver dtype is float32 for
                                         # reference parity, not configurable)
@@ -80,6 +85,8 @@ class SVMConfig:
             return "shards > 1"
         if self.cache_size > 0:
             return "the kernel-row cache (cache_size > 0)"
+        if self.selection != "first-order":
+            return f"selection {self.selection!r}"
         return None
 
     def resolve_gamma(self, num_attributes: int) -> float:
@@ -106,6 +113,20 @@ class SVMConfig:
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
         if self.checkpoint_every and not self.checkpoint_path:
             raise ValueError("checkpoint_every set without checkpoint_path")
+        if self.selection not in ("first-order", "second-order"):
+            raise ValueError(f"selection must be 'first-order' or "
+                             f"'second-order', got {self.selection!r}")
+        if self.selection == "second-order":
+            if self.cache_size > 0:
+                raise ValueError("second-order selection needs the hi row "
+                                 "before the lo index is known; the pair "
+                                 "row-cache does not apply (cache_size=0)")
+            if self.shards > 1:
+                raise ValueError("second-order selection is single-device "
+                                 "for now (shards must be 1)")
+            if self.use_pallas == "on":
+                raise ValueError("the fused Pallas kernel implements "
+                                 "first-order selection only")
         if self.use_pallas not in ("auto", "on", "off"):
             raise ValueError(f"use_pallas must be 'auto', 'on' or 'off', "
                              f"got {self.use_pallas!r}")

@@ -36,16 +36,18 @@ def _should_interpret() -> bool:
 
 
 def use_fused(config: SVMConfig) -> bool:
-    """Dispatch policy for api.train: 'auto' takes the fused path on real
-    TPU when nothing incompatible (row cache, numpy backend, sharding) is
-    requested; 'on' forces it anywhere via interpret mode (tests)."""
-    if config.use_pallas == "off":
+    """Dispatch policy for api.train.
+
+    'auto' currently resolves to the plain XLA path: measured on a v5e
+    chip at the MNIST benchmark shape (60000x784), XLA keeps bf16 X
+    pinned in VMEM across while-loop iterations (~64 us/iter) while a
+    pallas_call re-stages X from HBM every invocation (~200 us/iter), so
+    the hand-fused kernel only matches XLA at f32 and loses at bf16.
+    'on' forces the fused kernel (interpret mode off-TPU — how the CPU
+    test suite runs it)."""
+    if config.use_pallas != "on":
         return False
-    if config.fused_incompatibility() is not None:
-        return False
-    if config.use_pallas == "on":
-        return True
-    return jax.default_backend() == "tpu"
+    return config.fused_incompatibility() is None
 
 
 @functools.partial(jax.jit, static_argnames=("c", "gamma", "epsilon",
@@ -55,7 +57,6 @@ def use_fused(config: SVMConfig) -> bool:
 def _run_chunk(carry: FusedCarry, x, x2, y, limit, *, c, gamma, epsilon,
                max_iter, block_n, precision_name, interpret):
     precision = getattr(lax.Precision, precision_name)
-    entry_iter = carry.n_iter
 
     def cond(s: FusedCarry):
         return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
@@ -79,15 +80,16 @@ def _run_chunk(carry: FusedCarry, x, x2, y, limit, *, c, gamma, epsilon,
         t = body(s)
         return t._replace(b_hi=s.b_hi, b_lo=s.b_lo)
 
+    # Fire whenever this call ends converged below the iteration cap.
+    # Chunks are only entered with an open gap (the host breaks on done,
+    # and train_single_device_fused returns finished-run resumes without
+    # entering the loop), so a zero-body converged exit can only mean the
+    # program-initial or freshly-recomputed-resume selection already
+    # satisfied the gap — exactly the cases where the reference's
+    # do-while still runs one body.
     converged = ~(final.b_lo > final.b_hi + 2.0 * epsilon)
-    # Fire when this call discovered convergence: after making progress,
-    # or at program start (entry_iter == 0) when even the very first
-    # selection satisfies the gap — the reference's do-while still runs
-    # one body there. Resuming a finished run (entry_iter > 0, zero
-    # bodies) must not re-apply it.
-    discovered = (final.n_iter > entry_iter) | (entry_iter == 0)
-    do_trailing = converged & (final.n_iter < max_iter) & discovered
-    return lax.cond(do_trailing, trailing, lambda s: s, final)
+    return lax.cond(converged & (final.n_iter < max_iter),
+                    trailing, lambda s: s, final)
 
 
 def init_fused_carry(alpha, f, y, c: float) -> FusedCarry:
@@ -135,19 +137,26 @@ def train_single_device_fused(x: np.ndarray, y: np.ndarray,
     if ckpt is not None:
         alpha = alpha.at[0, :n].set(jnp.asarray(ckpt.alpha))
         f = f.at[0, :n].set(jnp.asarray(ckpt.f))
+    if ckpt is not None and not (ckpt.b_lo >
+                                 ckpt.b_hi + 2.0 * float(config.epsilon)):
+        # Finished-run checkpoint: return it as-is instead of entering
+        # the loop (where the trailing do-while update would be
+        # re-applied). Mirrors the smo path, whose first chunk exits
+        # immediately on the restored converged gap.
+        return TrainResult(
+            alpha=np.asarray(ckpt.alpha), b=(ckpt.b_lo + ckpt.b_hi) / 2.0,
+            n_iter=ckpt.n_iter, converged=True, b_lo=ckpt.b_lo,
+            b_hi=ckpt.b_hi, train_seconds=0.0, gamma=gamma,
+            n_sv=int(np.sum(np.asarray(ckpt.alpha) > 0)))
+
     carry = init_fused_carry(alpha, f, yd, float(config.c))
     if ckpt is not None:
+        # Mid-training resume: the freshly recomputed selection is the
+        # correct working set — its b's come from the CURRENT (alpha, f),
+        # which the fused body feeds into the alpha step (checkpoints
+        # written by the smo path record the previous body's selection,
+        # which would be stale here).
         carry = carry._replace(n_iter=jnp.int32(ckpt.n_iter))
-        # A finished-run checkpoint (gap closed) must exit immediately
-        # without re-applying the trailing do-while update, so keep its
-        # recorded gap. A mid-training checkpoint gets the freshly
-        # recomputed selection instead: its b's must come from the
-        # CURRENT (alpha, f) because the fused body feeds b_hi - b_lo
-        # into the alpha step (checkpoints written by the smo path store
-        # the previous body's selection there, which would be stale).
-        if not (ckpt.b_lo > ckpt.b_hi + 2.0 * float(config.epsilon)):
-            carry = carry._replace(b_hi=jnp.float32(ckpt.b_hi),
-                                   b_lo=jnp.float32(ckpt.b_lo))
     if device is not None:
         carry = jax.device_put(carry, device)
 

@@ -84,6 +84,8 @@ def smo_reference(
     alpha = np.zeros(n, dtype=np.float32)
     f = (-yf).copy()
 
+    second_order = config.selection == "second-order"
+
     n_iter = 0
     b_hi = np.float32(-sent)
     b_lo = np.float32(sent)
@@ -93,24 +95,54 @@ def smo_reference(
         f_low = np.where(in_low, f, -sent)
         i_hi = int(np.argmin(f_up))
         b_hi = f_up[i_hi]
-        i_lo = int(np.argmax(f_low))
-        b_lo = f_low[i_lo]
+        # b_lo (the max violator) is always the STOPPING gap and the
+        # source of the intercept, regardless of selection rule.
+        b_lo = f_low[int(np.argmax(f_low))]
+
+        k_hi = None
+        if second_order:
+            # WSS2 (Fan/Chen/Lin 2005, the LIBSVM rule): among violators
+            # j in I_low with f_j > b_hi, maximize (f_j - b_hi)^2 / a_j
+            # with a_j = K_ii + K_jj - 2 K_ij = 2 - 2 K(hi, j) for RBF.
+            dots_hi = (x[i_hi] @ x.T).astype(np.float32)
+            k_hi = np.exp((-gamma * (x2 + x2[i_hi] - 2.0 * dots_hi)
+                           ).astype(np.float32))
+            bb = f_low - b_hi
+            a = np.maximum(2.0 - 2.0 * k_hi, np.float32(1e-12))
+            obj = np.where(in_low & (bb > 0), bb * bb / a, np.float32(-1.0))
+            i_lo = int(np.argmax(obj))
+        else:
+            i_lo = int(np.argmax(f_low))
         if trace is not None:
             trace.append((i_hi, i_lo, float(b_hi), float(b_lo)))
 
-        rows = x[(i_hi, i_lo), :]                       # (2, d)
-        dots = (rows @ x.T).astype(np.float32)          # (2, n)
-        w2 = x2[(i_hi, i_lo),]
-        k = np.exp((-gamma * (x2[None, :] + w2[:, None] - 2.0 * dots)
-                    ).astype(np.float32))
+        if second_order:
+            dots_lo = (x[i_lo] @ x.T).astype(np.float32)
+            k_lo = np.exp((-gamma * (x2 + x2[i_lo] - 2.0 * dots_lo)
+                           ).astype(np.float32))
+            k = np.stack([k_hi, k_lo])
+        else:
+            rows = x[(i_hi, i_lo), :]                   # (2, d)
+            dots = (rows @ x.T).astype(np.float32)      # (2, n)
+            w2 = x2[(i_hi, i_lo),]
+            k = np.exp((-gamma * (x2[None, :] + w2[:, None] - 2.0 * dots)
+                        ).astype(np.float32))
         eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
+        if second_order:
+            # Clamped like the WSS2 selection denominator (and LIBSVM);
+            # first-order keeps the reference's raw division.
+            eta = np.float32(max(eta, 1e-12))
 
         y_hi = yf[i_hi]
         y_lo = yf[i_lo]
         a_hi = alpha[i_hi]
         a_lo = alpha[i_lo]
         s = y_lo * y_hi
-        a_lo_u = np.float32(a_lo + y_lo * (b_hi - b_lo) / eta)
+        # The alpha step uses the SELECTED pair's f values; under
+        # first-order selection f_low[i_lo] == b_lo, under second-order
+        # the chosen violator may not be the max one.
+        b_lo_sel = f_low[i_lo]
+        a_lo_u = np.float32(a_lo + y_lo * (b_hi - b_lo_sel) / eta)
         a_hi_u = np.float32(a_hi + s * (a_lo - a_lo_u))
         a_lo_n = np.float32(min(max(a_lo_u, np.float32(0.0)), c))
         a_hi_n = np.float32(min(max(a_hi_u, np.float32(0.0)), c))

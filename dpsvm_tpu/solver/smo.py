@@ -32,7 +32,7 @@ from jax import lax
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
-from dpsvm_tpu.ops.selection import masked_extrema
+from dpsvm_tpu.ops.selection import masked_extrema, masked_scores
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
 
@@ -61,29 +61,66 @@ def init_carry(y: jax.Array, cache_lines: int) -> SMOCarry:
 
 def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              c: float, gamma: float, *, use_cache: bool = False,
+             second_order: bool = False,
              precision=lax.Precision.HIGHEST) -> SMOCarry:
-    """One modified-SMO iteration (select -> eta -> alpha -> f)."""
+    """One modified-SMO iteration (select -> eta -> alpha -> f).
+
+    ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
+    (Fan/Chen/Lin 2005): among violators j in I_low with f_j > b_hi,
+    maximize (f_j - b_hi)^2 / (2 - 2 K(hi, j)). The stopping gap and the
+    intercept still come from the max violator (b_lo), matching the
+    reference's convergence rule (svmTrainMain.cpp:310,329).
+    """
     alpha, f = carry.alpha, carry.f
-    i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha, y, f, c)
 
-    cache = carry.cache
-    if use_cache:
-        dots, cache = cache_fetch_pair(
-            cache, i_hi, i_lo,
-            lambda: jnp.matmul(jnp.stack([x[i_hi], x[i_lo]]), x.T,
-                               precision=precision))
+    if second_order:
+        f_up, f_low = masked_scores(alpha, y, f, c)
+        i_hi = jnp.argmin(f_up)
+        b_hi = f_up[i_hi]
+        b_lo = jnp.max(f_low)                       # stopping gap only
+        dots_hi = jnp.matmul(x[i_hi][None, :], x.T,
+                             precision=precision)              # (1, n)
+        k_hi = rbf_rows_from_dots(dots_hi, x2[i_hi][None], x2, gamma)[0]
+        bb = f_low - b_hi
+        a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
+        in_low = f_low > jnp.float32(-SENTINEL) / 2
+        obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
+        i_lo = jnp.argmax(obj)
+        dots_lo = jnp.matmul(x[i_lo][None, :], x.T,
+                             precision=precision)
+        k_lo = rbf_rows_from_dots(dots_lo, x2[i_lo][None], x2, gamma)[0]
+        k = jnp.stack([k_hi, k_lo])
+        b_lo_sel = f_low[i_lo]                      # alpha step uses the
+        cache = carry.cache                         # SELECTED violator
     else:
-        rows = jnp.stack([x[i_hi], x[i_lo]])                     # (2, d)
-        dots = jnp.matmul(rows, x.T, precision=precision)        # (2, n)
+        i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha, y, f, c)
+        b_lo_sel = b_lo
 
-    w2 = jnp.stack([x2[i_hi], x2[i_lo]])
-    k = rbf_rows_from_dots(dots, w2, x2, gamma)                  # (2, n)
+        cache = carry.cache
+        if use_cache:
+            dots, cache = cache_fetch_pair(
+                cache, i_hi, i_lo,
+                lambda: jnp.matmul(jnp.stack([x[i_hi], x[i_lo]]), x.T,
+                                   precision=precision))
+        else:
+            rows = jnp.stack([x[i_hi], x[i_lo]])                 # (2, d)
+            dots = jnp.matmul(rows, x.T, precision=precision)    # (2, n)
+
+        w2 = jnp.stack([x2[i_hi], x2[i_lo]])
+        k = rbf_rows_from_dots(dots, w2, x2, gamma)              # (2, n)
+
     eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
+    if second_order:
+        # WSS2 steers toward small-eta pairs (the selection objective
+        # divides by the clamped a_j), so clamp the update denominator
+        # the same way LIBSVM does; first-order keeps the reference's
+        # raw division (svmTrainMain.cpp:289).
+        eta = jnp.maximum(eta, 1e-12)
 
     y_hi, y_lo = y[i_hi], y[i_lo]
     a_hi, a_lo = alpha[i_hi], alpha[i_lo]
     s = y_lo * y_hi
-    a_lo_u = a_lo + y_lo * (b_hi - b_lo) / eta
+    a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
     a_hi_u = a_hi + s * (a_lo - a_lo_u)          # uses UNCLIPPED a_lo_u
     a_lo_n = jnp.clip(a_lo_u, 0.0, c)
     a_hi_n = jnp.clip(a_hi_u, 0.0, c)
@@ -99,7 +136,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 
 @functools.lru_cache(maxsize=32)
 def _build_chunk_runner(c: float, gamma: float, epsilon: float,
-                        use_cache: bool, precision_name: str):
+                        use_cache: bool, precision_name: str,
+                        second_order: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit."""
@@ -112,7 +150,9 @@ def _build_chunk_runner(c: float, gamma: float, epsilon: float,
         return lax.while_loop(
             lambda s: cond(s, limit),
             lambda s: smo_step(s, x, y, x2, c, gamma,
-                               use_cache=use_cache, precision=precision),
+                               use_cache=use_cache,
+                               second_order=second_order,
+                               precision=precision),
             carry)
 
     return jax.jit(run, donate_argnums=(0,))
@@ -142,7 +182,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     runner = _build_chunk_runner(float(config.c), gamma,
                                  float(config.epsilon), use_cache,
-                                 config.matmul_precision.upper())
+                                 config.matmul_precision.upper(),
+                                 config.selection == "second-order")
 
     return host_training_loop(
         config, gamma, n, d, carry,
