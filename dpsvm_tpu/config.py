@@ -121,9 +121,6 @@ class SVMConfig:
                 raise ValueError("second-order selection needs the hi row "
                                  "before the lo index is known; the pair "
                                  "row-cache does not apply (cache_size=0)")
-            if self.shards > 1:
-                raise ValueError("second-order selection is single-device "
-                                 "for now (shards must be 1)")
             if self.use_pallas == "on":
                 raise ValueError("the fused Pallas kernel implements "
                                  "first-order selection only")

@@ -43,7 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
-from dpsvm_tpu.ops.selection import masked_extrema
+from dpsvm_tpu.ops.selection import masked_extrema, masked_scores
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
@@ -60,6 +60,115 @@ def _owner_read(arr: jax.Array, local_idx, is_owner) -> jax.Array:
     """Value of arr[local_idx] on the owning shard, zeros elsewhere
     (to be summed across shards by the caller's psum)."""
     return jnp.where(is_owner, arr[local_idx], jnp.zeros_like(arr[local_idx]))
+
+
+def _broadcast_row(xs, ys, x2s, alpha_s, loc, own, gi, *, shard_x: bool):
+    """(row, x2, y, alpha) of global index gi, replicated on every shard
+    via one masked psum (the owner contributes, everyone sums)."""
+    if shard_x:
+        pack = jnp.concatenate([
+            _owner_read(xs, loc, own),
+            jnp.stack([_owner_read(x2s, loc, own),
+                       _owner_read(ys, loc, own),
+                       _owner_read(alpha_s, loc, own)])])
+        pack = lax.psum(pack, SHARD_AXIS)
+        d = xs.shape[-1]
+        return pack[:d], pack[d], pack[d + 1], pack[d + 2]
+    scal = jnp.stack([jnp.where(own, x2s[gi], 0.0),
+                      _owner_read(ys, loc, own),
+                      _owner_read(alpha_s, loc, own)])
+    scal = lax.psum(scal, SHARD_AXIS)
+    return xs[gi], scal[0], scal[1], scal[2]
+
+
+def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
+                    c: float, gamma: float, n_per_shard: int, shard_x: bool,
+                    precision) -> DistCarry:
+    """One second-order (WSS2) iteration over the mesh: the hi row is
+    broadcast first, every shard scores its local violators against it,
+    and the lo index comes from a second tiny all_gather. Two row
+    broadcasts instead of first-order's packed one."""
+    alpha_s, f_s = carry.alpha, carry.f
+    rank = lax.axis_index(SHARD_AXIS)
+
+    f_up_l, f_low_l = masked_scores(alpha_s, ys, f_s, c, valid)
+
+    # --- phase 1: global i_hi (argmin f over I_up) + stopping b_lo ---
+    li_hi = jnp.argmin(f_up_l)
+    lb_hi = f_up_l[li_hi]
+    lb_lo = jnp.max(f_low_l)                       # stopping gap only
+    gi_hi = li_hi.astype(jnp.int32) + rank * n_per_shard
+    fv = lax.all_gather(jnp.stack([lb_hi, lb_lo]), SHARD_AXIS)     # (P, 2)
+    iv = lax.all_gather(gi_hi, SHARD_AXIS)                         # (P,)
+    p_hi = jnp.argmin(fv[:, 0])
+    b_hi = fv[p_hi, 0]
+    b_lo = fv[jnp.argmax(fv[:, 1]), 1]
+    i_hi_g = iv[p_hi]
+    loc_hi = i_hi_g - p_hi * n_per_shard
+    own_hi = rank == p_hi
+
+    row_hi, x2_hi, y_hi, a_hi = _broadcast_row(
+        xs, ys, x2s, alpha_s, loc_hi, own_hi, i_hi_g, shard_x=shard_x)
+
+    # --- phase 2: WSS2 lo choice against the hi kernel row ---
+    # WSS2 consumes K only shard-locally (scores + owner reads), so with
+    # replicated X slice this shard's rows BEFORE the matmul — unlike
+    # first-order, which reads K at global indices.
+    if shard_x:
+        xs_l, x2s_l = xs, x2s
+    else:
+        xs_l = lax.dynamic_slice_in_dim(xs, rank * n_per_shard, n_per_shard)
+        x2s_l = lax.dynamic_slice_in_dim(x2s, rank * n_per_shard,
+                                         n_per_shard)
+
+    def local_k_row(row, w2):
+        dots = jnp.matmul(row[None, :], xs_l.T, precision=precision)
+        return rbf_rows_from_dots(dots, w2[None], x2s_l, gamma)[0]
+
+    k_hi = local_k_row(row_hi, x2_hi)                              # (n_s,)
+    bb = f_low_l - b_hi
+    a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
+    in_low = f_low_l > jnp.float32(-SENTINEL) / 2
+    obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
+    li_lo = jnp.argmax(obj)
+    lo_pack = jnp.stack([obj[li_lo], f_low_l[li_lo]])
+    gi_lo = li_lo.astype(jnp.int32) + rank * n_per_shard
+    ov = lax.all_gather(lo_pack, SHARD_AXIS)                       # (P, 2)
+    ig = lax.all_gather(gi_lo, SHARD_AXIS)
+    p_lo = jnp.argmax(ov[:, 0])
+    b_lo_sel = ov[p_lo, 1]
+    i_lo_g = ig[p_lo]
+    loc_lo = i_lo_g - p_lo * n_per_shard
+    own_lo = rank == p_lo
+
+    row_lo, x2_lo, y_lo, a_lo = _broadcast_row(
+        xs, ys, x2s, alpha_s, loc_lo, own_lo, i_lo_g, shard_x=shard_x)
+    k_lo = local_k_row(row_lo, x2_lo)
+
+    # --- eta from the owner shards' K values, clamped (WSS2 steers
+    # toward small-eta pairs; see solver/smo.py) ---
+    k_pack = lax.psum(jnp.stack([
+        _owner_read(k_hi, loc_hi, own_hi),     # K(hi, hi)
+        _owner_read(k_lo, loc_lo, own_lo),     # K(lo, lo)
+        _owner_read(k_hi, loc_lo, own_lo),     # K(hi, lo)
+    ]), SHARD_AXIS)
+    eta = jnp.maximum(k_pack[0] + k_pack[1] - 2.0 * k_pack[2], 1e-12)
+
+    s = y_lo * y_hi
+    a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
+    a_hi_u = a_hi + s * (a_lo - a_lo_u)
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+
+    alpha_s = alpha_s.at[loc_lo].set(
+        jnp.where(own_lo, a_lo_n, alpha_s[loc_lo]))
+    alpha_s = alpha_s.at[loc_hi].set(
+        jnp.where(own_hi, a_hi_n, alpha_s[loc_hi]))
+
+    f_s = (f_s + (a_hi_n - a_hi) * y_hi * k_hi
+               + (a_lo_n - a_lo) * y_lo * k_lo)
+
+    return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1)
 
 
 def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
@@ -165,18 +274,19 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
 @functools.lru_cache(maxsize=16)
 def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
                        epsilon: float, n_per_shard: int, shard_x: bool,
-                       precision_name: str):
+                       precision_name: str, second_order: bool = False):
     precision = getattr(lax.Precision, precision_name)
     x_spec = P(SHARD_AXIS) if shard_x else P()
+    step = _dist_step_wss2 if second_order else _dist_step
 
     def run(carry: DistCarry, xs, ys, x2s, valid, limit):
         def cond(s: DistCarry):
             return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
 
         def body(s: DistCarry):
-            return _dist_step(s, xs, ys, x2s, valid, c=c, gamma=gamma,
-                              n_per_shard=n_per_shard, shard_x=shard_x,
-                              precision=precision)
+            return step(s, xs, ys, x2s, valid, c=c, gamma=gamma,
+                        n_per_shard=n_per_shard, shard_x=shard_x,
+                        precision=precision)
 
         # b_hi/b_lo come out of the loop body via all_gather, which types
         # them as axis-varying under shard_map's VMA checks; mark the
@@ -248,7 +358,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     runner = _build_dist_runner(mesh, float(config.c), gamma, eps, n_s,
                                 bool(config.shard_x),
-                                config.matmul_precision.upper())
+                                config.matmul_precision.upper(),
+                                config.selection == "second-order")
 
     def step_chunk(c, lim):
         limit = jax.device_put(jnp.int32(lim), repl)
