@@ -83,6 +83,19 @@ def build_parser() -> argparse.ArgumentParser:
     _add_data_flags(te)
     te.add_argument("--no-b", action="store_true",
                     help="drop the intercept like seq_test.cpp:197")
+
+    cv = sub.add_parser(
+        "convert", help="dataset converters (the reference's scripts/)")
+    cv.add_argument("format", choices=["libsvm", "mnist-odd-even"],
+                    help="libsvm: sparse 'label idx:val ...' -> dense CSV "
+                         "(scripts/convert_adult.py); mnist-odd-even: "
+                         "'digit,p1,...' -> +/-1 even/odd with /255 pixels "
+                         "(scripts/convert_mnist_to_odd_even.py)")
+    cv.add_argument("src", help="input file")
+    cv.add_argument("dst", help="output CSV")
+    cv.add_argument("-a", "--num-att", type=int, default=None,
+                    help="libsvm only: force the dense width (default: "
+                         "max feature index seen)")
     return root
 
 
@@ -140,11 +153,25 @@ def cmd_test(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_convert(args: argparse.Namespace) -> int:
+    from dpsvm_tpu.data.convert import (libsvm_to_dense_csv,
+                                        mnist_to_odd_even_csv)
+
+    if args.format == "libsvm":
+        rows = libsvm_to_dense_csv(args.src, args.dst, args.num_att)
+    else:
+        rows = mnist_to_odd_even_csv(args.src, args.dst)
+    print(f"Wrote {rows} rows to {args.dst}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
         if args.command == "train":
             return cmd_train(args)
+        if args.command == "convert":
+            return cmd_convert(args)
         return cmd_test(args)
     except FileNotFoundError as e:
         print(f"error: file not found: {e}", file=sys.stderr)

@@ -146,7 +146,12 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     k_lo = local_k_row(row_lo, x2_lo)
 
     # --- eta from the owner shards' K values, clamped (WSS2 steers
-    # toward small-eta pairs; see solver/smo.py) ---
+    # toward small-eta pairs; see solver/smo.py). Deliberately a third
+    # psum: recomputing the pair kernels replicated from the broadcast
+    # rows would avoid it but gives a different reduction order than the
+    # oracle's K-row reads, breaking the bit-level trajectory parity the
+    # tests assert — and one ~µs scalar collective is noise next to the
+    # two serial (1,d)@(d,n_s) matmuls in this body. ---
     k_pack = lax.psum(jnp.stack([
         _owner_read(k_hi, loc_hi, own_hi),     # K(hi, hi)
         _owner_read(k_lo, loc_lo, own_lo),     # K(lo, lo)

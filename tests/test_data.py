@@ -75,3 +75,23 @@ def test_synthetic_labels_are_pm1():
     for x, y in (make_blobs(50, 3, 0), make_xor(50, 0)):
         assert set(np.unique(y)) <= {-1, 1}
         assert x.dtype == np.float32
+
+
+def test_cli_convert_subcommand(tmp_path):
+    """CLI parity with the reference's scripts/ directory."""
+    from dpsvm_tpu.cli import main
+
+    src = tmp_path / "a.libsvm"
+    dst = tmp_path / "a.csv"
+    src.write_text("+1 1:0.5 3:1.0\n-1 2:0.25\n")
+    assert main(["convert", "libsvm", str(src), str(dst)]) == 0
+    lines = dst.read_text().strip().splitlines()
+    assert lines[0] == "1,0.5,0.0,1.0"
+    assert lines[1] == "-1,0.0,0.25,0.0"
+
+    msrc = tmp_path / "m.csv"
+    mdst = tmp_path / "m_oe.csv"
+    msrc.write_text("3,128,0\n4,255,64\n")
+    assert main(["convert", "mnist-odd-even", str(msrc), str(mdst)]) == 0
+    out = mdst.read_text().strip().splitlines()
+    assert out[0].startswith("-1,") and out[1].startswith("1,")
