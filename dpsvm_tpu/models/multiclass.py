@@ -1,0 +1,112 @@
+"""Multi-class classification: one-vs-one on the binary SMO trainer.
+
+Beyond-reference capability (the reference is strictly binary): the
+LIBSVM construction — K(K-1)/2 pairwise binary problems, each trained on
+the examples of its two classes with labels remapped to +/-1 (first
+class of the pair = +1), prediction by majority vote with ties going to
+the earlier class in sorted order.
+
+Persistence is a directory: ``index.json`` (classes + pair file names)
+plus one reference-format model file per pair, so every sub-model stays
+individually loadable by the binary tooling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.models.io import load_model, save_model
+from dpsvm_tpu.models.svm import SVMModel, decision_function
+
+
+@dataclasses.dataclass
+class MulticlassModel:
+    classes: np.ndarray                    # (k,) sorted original labels
+    pairs: List[Tuple[int, int]]           # index pairs into classes
+    models: List[SVMModel]                 # one per pair
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.classes)
+
+
+def train_multiclass(x: np.ndarray, y: np.ndarray,
+                     config: Optional[SVMConfig] = None,
+                     ) -> Tuple[MulticlassModel, List[TrainResult]]:
+    """Train OvO; y may hold any integer labels (2 classes work too)."""
+    from dpsvm_tpu.api import fit
+
+    config = config or SVMConfig()
+    y = np.asarray(y)
+    classes = np.unique(y)
+    if len(classes) < 2:
+        raise ValueError(f"need at least 2 classes, got {classes}")
+    pairs, models, results = [], [], []
+    for ai in range(len(classes)):
+        for bi in range(ai + 1, len(classes)):
+            sel = (y == classes[ai]) | (y == classes[bi])
+            xs = np.ascontiguousarray(x[sel])
+            ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
+            model, result = fit(xs, ys, config)
+            pairs.append((ai, bi))
+            models.append(model)
+            results.append(result)
+    return MulticlassModel(classes=classes, pairs=pairs,
+                           models=models), results
+
+
+def predict_multiclass(model: MulticlassModel, x: np.ndarray,
+                       include_b: bool = True) -> np.ndarray:
+    """Majority vote over pairwise decisions; ties -> earlier class.
+
+    include_b=False drops the intercept like seq_test.cpp:197, matching
+    the binary evaluator's --no-b."""
+    n = x.shape[0]
+    votes = np.zeros((n, model.n_classes), dtype=np.int32)
+    for (ai, bi), m in zip(model.pairs, model.models):
+        dec = decision_function(m, x, include_b=include_b)
+        votes[:, ai] += dec >= 0
+        votes[:, bi] += dec < 0
+    return model.classes[np.argmax(votes, axis=1)]
+
+
+def evaluate_multiclass(model: MulticlassModel, x: np.ndarray,
+                        y: np.ndarray, include_b: bool = True) -> float:
+    return float(np.mean(predict_multiclass(model, x, include_b)
+                         == np.asarray(y)))
+
+
+def save_multiclass(model: MulticlassModel, dirpath: str) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    entries = []
+    for (ai, bi), m in zip(model.pairs, model.models):
+        name = f"pair_{int(model.classes[ai])}_{int(model.classes[bi])}.svm"
+        save_model(m, os.path.join(dirpath, name))
+        entries.append({"a": int(ai), "b": int(bi), "file": name})
+    with open(os.path.join(dirpath, "index.json"), "w") as f:
+        json.dump({"format": "dpsvm_tpu-ovo-v1",
+                   "classes": [int(c) for c in model.classes],
+                   "pairs": entries}, f, indent=1)
+
+
+def load_multiclass(dirpath: str) -> MulticlassModel:
+    index_path = os.path.join(dirpath, "index.json")
+    if not os.path.exists(index_path):
+        raise FileNotFoundError(index_path)
+    with open(index_path) as f:
+        index = json.load(f)
+    if index.get("format") != "dpsvm_tpu-ovo-v1":
+        raise ValueError(f"{index_path}: unknown format "
+                         f"{index.get('format')!r}")
+    classes = np.asarray(index["classes"])
+    pairs, models = [], []
+    for e in index["pairs"]:
+        pairs.append((int(e["a"]), int(e["b"])))
+        models.append(load_model(os.path.join(dirpath, e["file"])))
+    return MulticlassModel(classes=classes, pairs=pairs, models=models)

@@ -1,0 +1,83 @@
+"""One-vs-one multi-class on top of the binary solver."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.multiclass import (MulticlassModel, evaluate_multiclass,
+                                         load_multiclass, predict_multiclass,
+                                         save_multiclass, train_multiclass)
+
+
+def make_three_class(n_per: int = 60, d: int = 4, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[2.0] * d, [-2.0] * d, [2.0] * (d // 2) + [-2.0] *
+                        (d - d // 2)], dtype=np.float32)
+    xs, ys = [], []
+    for label, c in zip((0, 3, 7), centers):       # non-contiguous labels
+        xs.append(rng.normal(loc=c, scale=0.8, size=(n_per, d)))
+        ys.append(np.full(n_per, label))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int32)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.fixture(scope="module")
+def three_class():
+    return make_three_class()
+
+
+def _cfg():
+    return SVMConfig(c=1.0, gamma=0.25, epsilon=1e-3, max_iter=20_000,
+                     chunk_iters=64)
+
+
+def test_ovo_train_predict(three_class):
+    x, y = three_class
+    model, results = train_multiclass(x, y, _cfg())
+    assert model.n_classes == 3
+    assert len(model.models) == 3                  # 3 choose 2
+    assert all(r.converged for r in results)
+    assert evaluate_multiclass(model, x, y) > 0.95
+    assert set(np.unique(predict_multiclass(model, x))) <= {0, 3, 7}
+
+
+def test_ovo_save_load_roundtrip(tmp_path, three_class):
+    x, y = three_class
+    model, _ = train_multiclass(x, y, _cfg())
+    save_multiclass(model, str(tmp_path / "mc"))
+    loaded = load_multiclass(str(tmp_path / "mc"))
+    np.testing.assert_array_equal(loaded.classes, model.classes)
+    np.testing.assert_array_equal(predict_multiclass(loaded, x),
+                                  predict_multiclass(model, x))
+
+
+def test_ovo_two_classes_degenerates_to_binary(three_class):
+    x, y = three_class
+    sel = y != 7
+    model, _ = train_multiclass(x[sel], y[sel], _cfg())
+    assert len(model.models) == 1
+    assert evaluate_multiclass(model, x[sel], y[sel]) > 0.95
+
+
+def test_ovo_rejects_single_class():
+    x = np.zeros((10, 3), np.float32)
+    y = np.ones(10, np.int32)
+    with pytest.raises(ValueError):
+        train_multiclass(x, y)
+
+
+def test_ovo_cli_roundtrip(tmp_path, three_class):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = three_class
+    train_csv = str(tmp_path / "t.csv")
+    save_csv(train_csv, x, y)
+    model_dir = str(tmp_path / "model_mc")
+    rc = main(["train", "-f", train_csv, "-m", model_dir,
+               "--multiclass", "-q"])
+    assert rc == 0
+    rc = main(["test", "-f", train_csv, "-m", model_dir])
+    assert rc == 0
