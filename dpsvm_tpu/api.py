@@ -32,7 +32,10 @@ def train(x: np.ndarray, y: np.ndarray,
         raise ValueError(f"y must be ({x.shape[0]},), got {y.shape}")
     labels = np.unique(y)
     if not np.all(np.isin(labels, (-1, 1))):
-        raise ValueError(f"labels must be +/-1, got {labels[:10]}")
+        raise ValueError(
+            f"labels must be +/-1 for binary training, got {labels[:10]} — "
+            "for multi-class data use models.multiclass.train_multiclass "
+            "(CLI: train --multiclass)")
     if config.backend == "numpy":
         from dpsvm_tpu.solver.oracle import smo_reference
         return smo_reference(x, y, config)

@@ -86,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
     _add_data_flags(te)
     te.add_argument("--no-b", action="store_true",
                     help="drop the intercept like seq_test.cpp:197")
+    te.add_argument("--predictions", default=None, metavar="PATH",
+                    help="also write one predicted label per line "
+                         "(binary models: 'label,decision_value')")
 
     cv = sub.add_parser(
         "convert", help="dataset converters (the reference's scripts/)")
@@ -165,13 +168,13 @@ def cmd_train(args: argparse.Namespace) -> int:
 def cmd_test(args: argparse.Namespace) -> int:
     import os
 
+    import numpy as np
+
     from dpsvm_tpu.data.loader import load_csv
     from dpsvm_tpu.models.io import load_model
-    from dpsvm_tpu.models.svm import evaluate
 
     if os.path.isdir(args.model):
-        from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
-                                                 load_multiclass)
+        from dpsvm_tpu.models.multiclass import load_multiclass
         mc = load_multiclass(args.model)
         x, y = load_csv(args.input, args.num_ex, args.num_att)
         d_model = mc.models[0].num_attributes
@@ -179,7 +182,12 @@ def cmd_test(args: argparse.Namespace) -> int:
             print(f"error: dataset has {x.shape[1]} attributes, model has "
                   f"{d_model}", file=sys.stderr)
             return 2
-        acc = evaluate_multiclass(mc, x, y, include_b=not args.no_b)
+        from dpsvm_tpu.models.multiclass import predict_multiclass
+        pred = predict_multiclass(mc, x, include_b=not args.no_b)
+        acc = float(np.mean(pred == y))
+        if args.predictions:
+            with open(args.predictions, "w") as f:
+                f.writelines(f"{int(p)}\n" for p in pred)
         print(f"Classes: {[int(c) for c in mc.classes]}")
         print(f"Test accuracy: {acc:.6f}")
         return 0
@@ -190,7 +198,13 @@ def cmd_test(args: argparse.Namespace) -> int:
         print(f"error: dataset has {x.shape[1]} attributes, model has "
               f"{model.num_attributes}", file=sys.stderr)
         return 2
-    acc = evaluate(model, x, y, include_b=not args.no_b)
+    from dpsvm_tpu.models.svm import decision_function
+    dec = decision_function(model, x, include_b=not args.no_b)
+    pred = np.where(dec < 0, -1, 1)                    # svmTrain.cu:650-656
+    acc = float(np.mean(pred == np.asarray(y, np.int32)))
+    if args.predictions:
+        with open(args.predictions, "w") as f:
+            f.writelines(f"{int(p)},{v:.6g}\n" for p, v in zip(pred, dec))
     print(f"Number of SVs: {model.n_sv}")
     print(f"Test accuracy: {acc:.6f}")
     return 0

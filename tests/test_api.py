@@ -49,3 +49,22 @@ def test_multihost_helpers_single_process():
     assert not multihost.is_initialized()
     info = multihost.process_info()
     assert "process 0/1" in info
+
+
+def test_cli_test_predictions_output(tmp_path):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+
+    x, y = make_blobs(n=80, d=5, seed=2)
+    csv = str(tmp_path / "d.csv")
+    save_csv(csv, x, y)
+    model = str(tmp_path / "m.svm")
+    assert main(["train", "-f", csv, "-m", model, "-q"]) == 0
+    pred_path = str(tmp_path / "pred.txt")
+    assert main(["test", "-f", csv, "-m", model,
+                 "--predictions", pred_path]) == 0
+    lines = open(pred_path).read().strip().splitlines()
+    assert len(lines) == 80
+    label, dec = lines[0].split(",")
+    assert int(label) in (-1, 1)
+    float(dec)   # parses
