@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="MXU matmul precision: 'highest'=exact f32 "
                          "(reference parity), 'default'=bf16-multiply "
                          "(~5x faster, same model quality in A/B runs)")
+    tr.add_argument("--weight-pos", type=float, default=1.0,
+                    help="cost weight for y=+1 examples (box bound "
+                         "C*weight; LIBSVM -w1)")
+    tr.add_argument("--weight-neg", type=float, default=1.0,
+                    help="cost weight for y=-1 examples (LIBSVM -w-1)")
     tr.add_argument("--selection", default="first-order",
                     choices=["first-order", "second-order"],
                     help="working-set rule: 'first-order' = reference "
@@ -128,6 +133,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         matmul_precision=args.precision,
         use_pallas=args.pallas,
         selection=args.selection,
+        weight_pos=args.weight_pos,
+        weight_neg=args.weight_neg,
     )
     if args.multiclass:
         from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
@@ -137,6 +144,14 @@ def cmd_train(args: argparse.Namespace) -> int:
             print("error: --checkpoint/--resume are single-model flags; "
                   "they cannot be shared across the pairwise multiclass "
                   "subproblems", file=sys.stderr)
+            return 2
+        if args.weight_pos != 1.0 or args.weight_neg != 1.0:
+            # In OvO, '+1' is just the lower-sorted label of each pair —
+            # a +/-1 weight would attach to an arbitrary pseudo-label,
+            # not to any actual data class (LIBSVM -wi maps by label).
+            print("error: --weight-pos/--weight-neg are binary-problem "
+                  "flags; per-label weighting of multiclass pairs is not "
+                  "supported", file=sys.stderr)
             return 2
         mc, results = train_multiclass(x, y, config)
         save_multiclass(mc, args.model)

@@ -41,6 +41,10 @@ class SVMConfig:
     epsilon: float = 0.001              # convergence tolerance
     max_iter: int = 150_000             # iteration cap
     cache_size: int = 0                 # kernel-row cache lines (0 = off)
+    weight_pos: float = 1.0             # class-weighted costs: the box
+    weight_neg: float = 1.0             # bound is C*weight_pos for y=+1
+                                        # examples, C*weight_neg for y=-1
+                                        # (LIBSVM -wi; imbalanced data)
     selection: str = "first-order"      # working-set rule: "first-order"
                                         # (reference parity, svmTrain.cu:
                                         # 476-481) or "second-order" (the
@@ -87,6 +91,8 @@ class SVMConfig:
             return "the kernel-row cache (cache_size > 0)"
         if self.selection != "first-order":
             return f"selection {self.selection!r}"
+        if self.weight_pos != 1.0 or self.weight_neg != 1.0:
+            return "class-weighted costs"
         return None
 
     def resolve_gamma(self, num_attributes: int) -> float:
@@ -113,6 +119,9 @@ class SVMConfig:
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
         if self.checkpoint_every and not self.checkpoint_path:
             raise ValueError("checkpoint_every set without checkpoint_path")
+        if self.weight_pos <= 0 or self.weight_neg <= 0:
+            raise ValueError("class weights must be > 0, got "
+                             f"({self.weight_pos}, {self.weight_neg})")
         if self.selection not in ("first-order", "second-order"):
             raise ValueError(f"selection must be 'first-order' or "
                              f"'second-order', got {self.selection!r}")

@@ -84,7 +84,7 @@ def load_csv(
             n, d,
         )
         if got == n:
-            return x, y
+            return _check_finite(x, path), y
         # Malformed / short file: fall through to the Python parser for a
         # readable error.
 
@@ -107,4 +107,16 @@ def load_csv(
             i += 1
     if i < n:
         raise ValueError(f"{path}: expected {n} rows, found {i}")
-    return xs, ys
+    return _check_finite(xs, path), ys
+
+
+def _check_finite(x: np.ndarray, path: str) -> np.ndarray:
+    """NaN/Inf features would silently poison f and never converge
+    (the solver is exp/argmin-based); fail at load time instead."""
+    if not np.isfinite(x).all():
+        bad = np.argwhere(~np.isfinite(x))[0]
+        raise ValueError(
+            f"{path}: non-finite feature value at row {int(bad[0])}, "
+            f"column {int(bad[1])} (x[{int(bad[0])},{int(bad[1])}] = "
+            f"{x[bad[0], bad[1]]})")
+    return x

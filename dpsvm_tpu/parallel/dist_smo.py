@@ -62,6 +62,19 @@ def _owner_read(arr: jax.Array, local_idx, is_owner) -> jax.Array:
     return jnp.where(is_owner, arr[local_idx], jnp.zeros_like(arr[local_idx]))
 
 
+def _weighted_box(c: float, weights, ys):
+    """(c_box for the masks, c(y) for the clips): scalar when the class
+    weights are (1, 1) — the exact reference path — else derived from y
+    (the working indices' y values are already broadcast, so weighted
+    clips need no extra collective)."""
+    wp, wn = weights
+    if wp == 1.0 and wn == 1.0:
+        return c, lambda y_sel: jnp.float32(c)
+    c_box = jnp.where(ys > 0, jnp.float32(c * wp), jnp.float32(c * wn))
+    return c_box, lambda y_sel: jnp.where(y_sel > 0, jnp.float32(c * wp),
+                                          jnp.float32(c * wn))
+
+
 def _broadcast_row(xs, ys, x2s, alpha_s, loc, own, gi, *, shard_x: bool):
     """(row, x2, y, alpha) of global index gi, replicated on every shard
     via one masked psum (the owner contributes, everyone sums)."""
@@ -83,15 +96,16 @@ def _broadcast_row(xs, ys, x2s, alpha_s, loc, own, gi, *, shard_x: bool):
 
 def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
                     c: float, gamma: float, n_per_shard: int, shard_x: bool,
-                    precision) -> DistCarry:
+                    precision, weights=(1.0, 1.0)) -> DistCarry:
     """One second-order (WSS2) iteration over the mesh: the hi row is
     broadcast first, every shard scores its local violators against it,
     and the lo index comes from a second tiny all_gather. Two row
     broadcasts instead of first-order's packed one."""
     alpha_s, f_s = carry.alpha, carry.f
     rank = lax.axis_index(SHARD_AXIS)
+    c_box, c_of_y = _weighted_box(c, weights, ys)
 
-    f_up_l, f_low_l = masked_scores(alpha_s, ys, f_s, c, valid)
+    f_up_l, f_low_l = masked_scores(alpha_s, ys, f_s, c_box, valid)
 
     # --- phase 1: global i_hi (argmin f over I_up) + stopping b_lo ---
     li_hi = jnp.argmin(f_up_l)
@@ -162,8 +176,8 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     s = y_lo * y_hi
     a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
     a_hi_u = a_hi + s * (a_lo - a_lo_u)
-    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
-    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c_of_y(y_lo))
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c_of_y(y_hi))
 
     alpha_s = alpha_s.at[loc_lo].set(
         jnp.where(own_lo, a_lo_n, alpha_s[loc_lo]))
@@ -178,14 +192,16 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
 
 def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
                c: float, gamma: float, n_per_shard: int, shard_x: bool,
-               precision) -> DistCarry:
+               precision, weights=(1.0, 1.0)) -> DistCarry:
     """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
     slices when shard_x else full replicated arrays."""
     alpha_s, f_s = carry.alpha, carry.f
     rank = lax.axis_index(SHARD_AXIS)
+    c_box, c_of_y = _weighted_box(c, weights, ys)
 
     # --- local working-set extrema (CS-2) ---
-    li_hi, lb_hi, li_lo, lb_lo = masked_extrema(alpha_s, ys, f_s, c, valid)
+    li_hi, lb_hi, li_lo, lb_lo = masked_extrema(alpha_s, ys, f_s, c_box,
+                                                valid)
     gi_hi = li_hi.astype(jnp.int32) + rank * n_per_shard
     gi_lo = li_lo.astype(jnp.int32) + rank * n_per_shard
 
@@ -261,8 +277,8 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     s = y_lo * y_hi
     a_lo_u = a_lo + y_lo * (b_hi - b_lo) / eta
     a_hi_u = a_hi + s * (a_lo - a_lo_u)
-    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
-    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c_of_y(y_lo))
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c_of_y(y_hi))
 
     # masked writeback, lo then hi (train_step2 order, svmTrain.cu:491-492)
     alpha_s = alpha_s.at[loc_lo].set(
@@ -279,7 +295,8 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
 @functools.lru_cache(maxsize=16)
 def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
                        epsilon: float, n_per_shard: int, shard_x: bool,
-                       precision_name: str, second_order: bool = False):
+                       precision_name: str, second_order: bool = False,
+                       weights=(1.0, 1.0)):
     precision = getattr(lax.Precision, precision_name)
     x_spec = P(SHARD_AXIS) if shard_x else P()
     step = _dist_step_wss2 if second_order else _dist_step
@@ -291,7 +308,7 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
         def body(s: DistCarry):
             return step(s, xs, ys, x2s, valid, c=c, gamma=gamma,
                         n_per_shard=n_per_shard, shard_x=shard_x,
-                        precision=precision)
+                        precision=precision, weights=weights)
 
         # b_hi/b_lo come out of the loop body via all_gather, which types
         # them as axis-varying under shard_map's VMA checks; mark the
@@ -364,7 +381,9 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     runner = _build_dist_runner(mesh, float(config.c), gamma, eps, n_s,
                                 bool(config.shard_x),
                                 config.matmul_precision.upper(),
-                                config.selection == "second-order")
+                                config.selection == "second-order",
+                                (float(config.weight_pos),
+                                 float(config.weight_neg)))
 
     def step_chunk(c, lim):
         limit = jax.device_put(jnp.int32(lim), repl)

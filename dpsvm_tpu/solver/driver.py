@@ -82,7 +82,9 @@ def host_training_loop(
                 return SolverCheckpoint(
                     alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo, b_hi=b_hi,
                     c=float(config.c), gamma=gamma,
-                    epsilon=float(config.epsilon), n=n, d=d)
+                    epsilon=float(config.epsilon), n=n, d=d,
+                    weight_pos=float(config.weight_pos),
+                    weight_neg=float(config.weight_neg))
 
             last_saved = maybe_checkpoint(config, last_saved, n_iter, make)
             if done:

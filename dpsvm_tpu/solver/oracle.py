@@ -40,7 +40,7 @@ import numpy as np
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 
 
-def iup_ilow_masks(alpha: np.ndarray, y: np.ndarray, c: float
+def iup_ilow_masks(alpha: np.ndarray, y: np.ndarray, c
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """Keerthi index-set membership masks (svmTrain.cu:54-91 semantics).
 
@@ -48,9 +48,10 @@ def iup_ilow_masks(alpha: np.ndarray, y: np.ndarray, c: float
     alpha == C, y == -1 -> I_up only;  alpha == C, y == +1 -> I_low only;
     0 < alpha < C        -> both.
     Exact comparisons are safe: clipping writes exactly 0.0 or C.
+    c may be a scalar or a per-example array (class-weighted costs).
     """
     at0 = alpha == 0.0
-    atc = alpha == np.float32(c)
+    atc = alpha == np.float32(c) if np.isscalar(c) else alpha == c
     interior = ~at0 & ~atc
     pos = y > 0
     in_up = interior | (at0 & pos) | (atc & ~pos)
@@ -75,7 +76,14 @@ def smo_reference(
     x = np.ascontiguousarray(x, dtype=np.float32)
     n, d = x.shape
     yf = np.asarray(y, dtype=np.float32)
-    c = np.float32(config.c)
+    # Per-example box bound: C * class weight (scalar stays scalar for
+    # exact parity with the unweighted reference path).
+    if config.weight_pos == 1.0 and config.weight_neg == 1.0:
+        c = np.float32(config.c)
+    else:
+        c = np.where(np.asarray(y) > 0,
+                     np.float32(config.c * config.weight_pos),
+                     np.float32(config.c * config.weight_neg))
     gamma = np.float32(config.resolve_gamma(d))
     eps = np.float32(config.epsilon)
     sent = np.float32(SENTINEL)
@@ -144,8 +152,10 @@ def smo_reference(
         b_lo_sel = f_low[i_lo]
         a_lo_u = np.float32(a_lo + y_lo * (b_hi - b_lo_sel) / eta)
         a_hi_u = np.float32(a_hi + s * (a_lo - a_lo_u))
-        a_lo_n = np.float32(min(max(a_lo_u, np.float32(0.0)), c))
-        a_hi_n = np.float32(min(max(a_hi_u, np.float32(0.0)), c))
+        c_lo = np.float32(c if np.isscalar(c) else c[i_lo])
+        c_hi = np.float32(c if np.isscalar(c) else c[i_hi])
+        a_lo_n = np.float32(min(max(a_lo_u, np.float32(0.0)), c_lo))
+        a_hi_n = np.float32(min(max(a_hi_u, np.float32(0.0)), c_hi))
         alpha[i_lo] = a_lo_n
         alpha[i_hi] = a_hi_n
         f = (f + (a_hi_n - a_hi) * y_hi * k[0]

@@ -61,7 +61,7 @@ def init_carry(y: jax.Array, cache_lines: int) -> SMOCarry:
 
 def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              c: float, gamma: float, *, use_cache: bool = False,
-             second_order: bool = False,
+             second_order: bool = False, weights=(1.0, 1.0),
              precision=lax.Precision.HIGHEST) -> SMOCarry:
     """One modified-SMO iteration (select -> eta -> alpha -> f).
 
@@ -70,11 +70,24 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
     maximize (f_j - b_hi)^2 / (2 - 2 K(hi, j)). The stopping gap and the
     intercept still come from the max violator (b_lo), matching the
     reference's convergence rule (svmTrainMain.cpp:310,329).
+
+    ``weights`` = (w_pos, w_neg) class-weights the box bound per example
+    (C_i = C * w(y_i)); (1, 1) keeps the exact scalar reference path.
     """
     alpha, f = carry.alpha, carry.f
+    wp, wn = weights
+    weighted = wp != 1.0 or wn != 1.0
+    if weighted:
+        # Per-example box bound, derived from y on the fly (XLA fuses
+        # this into the mask computation).
+        c_box = jnp.where(y > 0, jnp.float32(c * wp), jnp.float32(c * wn))
+        c_of = lambda i: c_box[i]
+    else:
+        c_box = c
+        c_of = lambda i: jnp.float32(c)
 
     if second_order:
-        f_up, f_low = masked_scores(alpha, y, f, c)
+        f_up, f_low = masked_scores(alpha, y, f, c_box)
         i_hi = jnp.argmin(f_up)
         b_hi = f_up[i_hi]
         b_lo = jnp.max(f_low)                       # stopping gap only
@@ -93,7 +106,7 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         b_lo_sel = f_low[i_lo]                      # alpha step uses the
         cache = carry.cache                         # SELECTED violator
     else:
-        i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha, y, f, c)
+        i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha, y, f, c_box)
         b_lo_sel = b_lo
 
         cache = carry.cache
@@ -122,8 +135,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
     s = y_lo * y_hi
     a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
     a_hi_u = a_hi + s * (a_lo - a_lo_u)          # uses UNCLIPPED a_lo_u
-    a_lo_n = jnp.clip(a_lo_u, 0.0, c)
-    a_hi_n = jnp.clip(a_hi_u, 0.0, c)
+    a_lo_n = jnp.clip(a_lo_u, 0.0, c_of(i_lo))
+    a_hi_n = jnp.clip(a_hi_u, 0.0, c_of(i_hi))
 
     # Write order lo-then-hi mirrors train_step2 (svmTrain.cu:491-492) for
     # the i_hi == i_lo corner.
@@ -137,7 +150,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 @functools.lru_cache(maxsize=32)
 def _build_chunk_runner(c: float, gamma: float, epsilon: float,
                         use_cache: bool, precision_name: str,
-                        second_order: bool = False):
+                        second_order: bool = False,
+                        weights=(1.0, 1.0)):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit."""
@@ -152,6 +166,7 @@ def _build_chunk_runner(c: float, gamma: float, epsilon: float,
             lambda s: smo_step(s, x, y, x2, c, gamma,
                                use_cache=use_cache,
                                second_order=second_order,
+                               weights=weights,
                                precision=precision),
             carry)
 
@@ -183,7 +198,9 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     runner = _build_chunk_runner(float(config.c), gamma,
                                  float(config.epsilon), use_cache,
                                  config.matmul_precision.upper(),
-                                 config.selection == "second-order")
+                                 config.selection == "second-order",
+                                 (float(config.weight_pos),
+                                  float(config.weight_neg)))
 
     return host_training_loop(
         config, gamma, n, d, carry,

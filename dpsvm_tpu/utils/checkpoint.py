@@ -37,6 +37,8 @@ class SolverCheckpoint:
     epsilon: float
     n: int
     d: int
+    weight_pos: float = 1.0
+    weight_neg: float = 1.0
 
     def validate_against(self, n: int, d: int, config: SVMConfig,
                          gamma: float) -> None:
@@ -44,9 +46,12 @@ class SolverCheckpoint:
             raise ValueError(
                 f"checkpoint is for a ({self.n}, {self.d}) problem, "
                 f"data is ({n}, {d})")
-        for name, mine, theirs in (("c", self.c, config.c),
-                                   ("gamma", self.gamma, gamma),
-                                   ("epsilon", self.epsilon, config.epsilon)):
+        for name, mine, theirs in (
+                ("c", self.c, config.c),
+                ("gamma", self.gamma, gamma),
+                ("epsilon", self.epsilon, config.epsilon),
+                ("weight_pos", self.weight_pos, config.weight_pos),
+                ("weight_neg", self.weight_neg, config.weight_neg)):
             if abs(mine - theirs) > 1e-12 * max(1.0, abs(mine)):
                 raise ValueError(
                     f"checkpoint {name}={mine} != configured {name}={theirs}")
@@ -66,7 +71,8 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint) -> None:
                 f=np.asarray(ckpt.f, np.float32),
                 scalars=np.asarray(
                     [ckpt.n_iter, ckpt.b_lo, ckpt.b_hi, ckpt.c, ckpt.gamma,
-                     ckpt.epsilon, ckpt.n, ckpt.d], np.float64),
+                     ckpt.epsilon, ckpt.n, ckpt.d, ckpt.weight_pos,
+                     ckpt.weight_neg], np.float64),
             )
         os.replace(tmp, path)
     except BaseException:
@@ -83,6 +89,9 @@ def load_checkpoint(path: str) -> SolverCheckpoint:
             n_iter=int(s[0]), b_lo=float(s[1]), b_hi=float(s[2]),
             c=float(s[3]), gamma=float(s[4]), epsilon=float(s[5]),
             n=int(s[6]), d=int(s[7]),
+            # files from before class weights existed carry 8 scalars
+            weight_pos=float(s[8]) if len(s) > 8 else 1.0,
+            weight_neg=float(s[9]) if len(s) > 9 else 1.0,
         )
 
 

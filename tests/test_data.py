@@ -95,3 +95,14 @@ def test_cli_convert_subcommand(tmp_path):
     assert main(["convert", "mnist-odd-even", str(msrc), str(mdst)]) == 0
     out = mdst.read_text().strip().splitlines()
     assert out[0].startswith("-1,") and out[1].startswith("1,")
+
+
+def test_loader_rejects_non_finite(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,0.5,2.0\n-1,nan,1.0\n")
+    with pytest.raises(ValueError, match="non-finite"):
+        load_csv(str(p))
+    p2 = tmp_path / "bad2.csv"
+    p2.write_text("1,0.5,inf\n")
+    with pytest.raises(ValueError, match="non-finite"):
+        load_csv(str(p2))

@@ -1,0 +1,121 @@
+"""Class-weighted costs (per-class box bounds, LIBSVM -wi style)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.models.svm import SVMModel, predict
+from dpsvm_tpu.solver.oracle import smo_reference
+from dpsvm_tpu.solver.smo import train_single_device
+
+
+def _imbalanced(n_pos=20, n_neg=180, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    xp = rng.normal(loc=0.8, scale=1.0, size=(n_pos, d))
+    xn = rng.normal(loc=-0.8, scale=1.0, size=(n_neg, d))
+    x = np.concatenate([xp, xn]).astype(np.float32)
+    y = np.concatenate([np.ones(n_pos), -np.ones(n_neg)]).astype(np.int32)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+def _cfg(**kw):
+    kw.setdefault("epsilon", 1e-3)
+    kw.setdefault("max_iter", 20_000)
+    kw.setdefault("chunk_iters", 64)
+    return SVMConfig(**kw)
+
+
+def test_weighted_xla_matches_oracle():
+    x, y = _imbalanced()
+    cfg = _cfg(c=1.0, gamma=0.2, weight_pos=8.0, weight_neg=1.0)
+    ref = smo_reference(x, y, cfg)
+    dev = train_single_device(x, y, cfg)
+    assert dev.n_iter == ref.n_iter
+    np.testing.assert_allclose(dev.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+    assert dev.n_sv == ref.n_sv
+
+
+def test_weighted_alpha_respects_per_class_bounds():
+    x, y = _imbalanced()
+    cfg = _cfg(c=1.0, gamma=0.2, weight_pos=8.0, weight_neg=0.5)
+    res = train_single_device(x, y, cfg)
+    assert np.all(res.alpha[y > 0] <= 8.0 + 1e-6)
+    assert np.all(res.alpha[y < 0] <= 0.5 + 1e-6)
+    # the positive bound is actually exercised
+    assert res.alpha[y > 0].max() > 0.5 + 1e-6
+
+
+def test_weighted_improves_minority_recall():
+    """Upweighting the rare class must raise its recall vs unweighted."""
+    x, y = _imbalanced(n_pos=15, n_neg=185, seed=3)
+    plain = train_single_device(x, y, _cfg(c=1.0, gamma=0.2))
+    up = train_single_device(x, y, _cfg(c=1.0, gamma=0.2, weight_pos=12.0))
+
+    def pos_recall(res):
+        m = SVMModel.from_train_result(x, y, res)
+        pred = predict(m, x)
+        return float(np.mean(pred[y > 0] == 1))
+
+    assert pos_recall(up) >= pos_recall(plain)
+    assert pos_recall(up) > 0.9
+
+
+def test_weighted_distributed_matches_oracle():
+    from dpsvm_tpu.parallel.dist_smo import train_distributed
+
+    x, y = _imbalanced(seed=5)
+    cfg = _cfg(c=1.0, gamma=0.2, weight_pos=4.0, weight_neg=0.7, shards=8)
+    ref = smo_reference(x, y, _cfg(c=1.0, gamma=0.2, weight_pos=4.0,
+                                   weight_neg=0.7))
+    dist = train_distributed(x, y, cfg)
+    assert dist.n_iter == ref.n_iter, (dist.n_iter, ref.n_iter)
+    np.testing.assert_allclose(dist.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+
+
+def test_weighted_wss2_converges():
+    x, y = _imbalanced(seed=7)
+    cfg = _cfg(c=1.0, gamma=0.2, weight_pos=6.0, selection="second-order")
+    ref = smo_reference(x, y, cfg)
+    dev = train_single_device(x, y, cfg)
+    assert ref.converged and dev.converged
+    assert dev.n_iter == ref.n_iter
+    np.testing.assert_allclose(dev.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+
+
+def test_weighted_config_validation():
+    with pytest.raises(ValueError):
+        SVMConfig(weight_pos=0.0).validate()
+    with pytest.raises(ValueError):
+        SVMConfig(weight_neg=-1.0).validate()
+    with pytest.raises(ValueError):
+        SVMConfig(weight_pos=2.0, use_pallas="on").validate()
+    SVMConfig(weight_pos=2.0, weight_neg=0.5).validate()
+
+def test_weighted_resume_mismatch_rejected(tmp_path):
+    """Resuming with different class weights must fail loudly — the
+    feasible region changed (checkpoint validate_against contract)."""
+    x, y = _imbalanced(seed=9)
+    ck = str(tmp_path / "w.npz")
+    train_single_device(x, y, _cfg(c=1.0, gamma=0.2, weight_pos=8.0,
+                                   max_iter=10, chunk_iters=5,
+                                   checkpoint_path=ck, checkpoint_every=1))
+    with pytest.raises(ValueError, match="weight_pos"):
+        train_single_device(x, y, _cfg(c=1.0, gamma=0.2, resume_from=ck))
+    # matching weights resume fine
+    train_single_device(x, y, _cfg(c=1.0, gamma=0.2, weight_pos=8.0,
+                                   max_iter=20, chunk_iters=5,
+                                   resume_from=ck))
+
+
+def test_weighted_multiclass_cli_rejected(tmp_path):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = _imbalanced(seed=11)
+    csv = str(tmp_path / "d.csv")
+    save_csv(csv, x, y)
+    rc = main(["train", "-f", csv, "-m", str(tmp_path / "m"),
+               "--multiclass", "--weight-pos", "4", "-q"])
+    assert rc == 2
