@@ -1,0 +1,143 @@
+"""External-oracle parity vs LibSVM (via scikit-learn's SVC wrapper).
+
+The reference's headline quality claim is SV-count parity with LibSVM on
+its benchmark job (/root/reference/README.md:27). The reference itself has
+no automated check for it (SURVEY §4 layer 4); here it is a real test:
+train `sklearn.svm.SVC` — which wraps libsvm — with the same (C, gamma,
+tol) and assert that our solver finds a model with
+
+  * SV count within 2% (+/- a small absolute slack on tiny problems),
+  * identical train accuracy and held-out accuracy (within one example),
+
+for both first-order (reference-parity) and second-order (WSS2) working
+set selection, on blobs, XOR, and an adult-shaped dense fixture
+(123 features like the reference's adult run, Makefile:86).
+
+Note on tolerances: libsvm's stopping rule is m(alpha) - M(alpha) <= eps
+while ours (the reference's, svmTrainMain.cpp:310) is b_lo > b_hi + 2*eps,
+i.e. the same gap criterion up to the factor of 2; we pass epsilon/2 to
+our solver so both stop at the same KKT gap. Different solvers at the
+same gap legitimately differ in borderline alphas ~ 0, hence the 2%
+SV-count band rather than equality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import fit
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor
+from dpsvm_tpu.models.svm import decision_function, evaluate, predict
+
+sklearn_svm = pytest.importorskip("sklearn.svm")
+
+
+def _adult_like(n: int = 400, d: int = 123, seed: int = 3):
+    """Dense adult-shaped fixture: mostly-binary features, imbalanced-ish
+    classes (the real a9a is 123 binary features, Makefile:86)."""
+    rng = np.random.default_rng(seed)
+    y = np.where(rng.random(n) < 0.35, 1, -1).astype(np.int32)
+    x = (rng.random((n, d)) < 0.1).astype(np.float32)
+    sig = rng.choice(d, size=12, replace=False)
+    flip = rng.random((n, len(sig))) < 0.35
+    x[:, sig] = np.where(flip, (y[:, None] > 0).astype(np.float32),
+                         x[:, sig])
+    return x, y
+
+
+CASES = [
+    # (name, (x, y) builder, C, gamma, tol)
+    ("blobs", lambda: make_blobs(n=300, d=6, seed=1), 1.0, 0.25, 1e-3),
+    ("xor", lambda: make_xor(n=300, seed=2), 10.0, 1.0, 1e-3),
+    ("adult-like", lambda: _adult_like(), 100.0, 0.5, 1e-3),
+]
+
+
+def _split(x, y, frac=0.25, seed=0):
+    rng = np.random.default_rng(seed)
+    n = len(y)
+    perm = rng.permutation(n)
+    k = int(n * frac)
+    te, tr = perm[:k], perm[k:]
+    return x[tr], y[tr], x[te], y[te]
+
+
+@pytest.mark.parametrize("selection", ["first-order", "second-order"])
+@pytest.mark.parametrize("name,build,C,gamma,tol",
+                         CASES, ids=[c[0] for c in CASES])
+def test_sv_count_and_accuracy_parity(name, build, C, gamma, tol,
+                                      selection):
+    x, y = build()
+    xtr, ytr, xte, yte = _split(x, y)
+
+    ref = sklearn_svm.SVC(C=C, kernel="rbf", gamma=gamma, tol=tol)
+    ref.fit(xtr, ytr)
+    ref_nsv = int(ref.n_support_.sum())
+    ref_train_acc = float(ref.score(xtr, ytr))
+    ref_test_acc = float(ref.score(xte, yte))
+
+    cfg = SVMConfig(c=C, gamma=gamma, epsilon=tol / 2.0,
+                    selection=selection)
+    model, result = fit(xtr, ytr, cfg)
+    assert result.converged, (
+        f"{name}/{selection}: no convergence in {result.n_iter} iters "
+        f"(gap={result.gap:.5f})")
+
+    # SV-count parity: the reference's own quality bar (README.md:27).
+    slack = max(0.02 * ref_nsv, 3.0)
+    assert abs(model.n_sv - ref_nsv) <= slack, (
+        f"{name}/{selection}: n_sv={model.n_sv} vs libsvm {ref_nsv}")
+
+    # Accuracy parity within one example each way.
+    train_acc = evaluate(model, xtr, ytr)
+    test_acc = evaluate(model, xte, yte)
+    assert abs(train_acc - ref_train_acc) <= 1.0 / len(ytr) + 1e-9, (
+        f"{name}/{selection}: train acc {train_acc:.4f} vs "
+        f"libsvm {ref_train_acc:.4f}")
+    assert abs(test_acc - ref_test_acc) <= 1.0 / len(yte) + 1e-9, (
+        f"{name}/{selection}: test acc {test_acc:.4f} vs "
+        f"libsvm {ref_test_acc:.4f}")
+
+
+def test_decision_values_match_libsvm_on_blobs():
+    """Beyond counts: the decision functions themselves should agree.
+
+    At the same KKT gap the dual solutions are near-identical, so the
+    decision values should match to ~tol everywhere, not just in sign.
+    """
+    x, y = make_blobs(n=240, d=5, seed=7)
+    xtr, ytr, xte, yte = _split(x, y, seed=7)
+    C, gamma, tol = 5.0, 0.5, 1e-4
+
+    ref = sklearn_svm.SVC(C=C, kernel="rbf", gamma=gamma, tol=tol)
+    ref.fit(xtr, ytr)
+    ref_dec = ref.decision_function(xte)
+
+    cfg = SVMConfig(c=C, gamma=gamma, epsilon=tol / 2.0)
+    model, result = fit(xtr, ytr, cfg)
+    assert result.converged
+
+    ours = np.asarray(decision_function(model, xte))
+    # Sign convention: ours is sum(alpha_j y_j K) - b with b=(b_lo+b_hi)/2
+    # (svmTrainMain.cpp:329); libsvm's rho is the same intercept.
+    atol = 5e-3
+    np.testing.assert_allclose(ours, ref_dec, atol=atol)
+    # Signs must agree away from the margin; inside +/-atol a tie may flip.
+    clear = np.abs(ref_dec) >= atol
+    assert np.array_equal(np.sign(ours[clear]), np.sign(ref_dec[clear]))
+
+
+def test_predict_agrees_with_libsvm_labels():
+    x, y = make_xor(n=200, seed=11)
+    C, gamma, tol = 10.0, 1.0, 1e-3
+    ref = sklearn_svm.SVC(C=C, kernel="rbf", gamma=gamma, tol=tol)
+    ref.fit(x, y)
+    cfg = SVMConfig(c=C, gamma=gamma, epsilon=tol / 2.0)
+    model, result = fit(x, y, cfg)
+    assert result.converged
+    ours = np.asarray(predict(model, x))
+    theirs = ref.predict(x)
+    # Identical labels on >=99% of points (ties at the margin may flip).
+    assert float(np.mean(ours == theirs)) >= 0.99
