@@ -85,6 +85,15 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--multiclass", action="store_true",
                     help="one-vs-one multi-class training (labels may be "
                          "any integers; -m becomes a model DIRECTORY)")
+    tr.add_argument("-b", "--probability", action="store_true",
+                    help="fit Platt-scaled probabilities on the training "
+                         "decision values (LIBSVM -b 1 analog) and save "
+                         "them as a <model>.platt.json sidecar")
+    tr.add_argument("--check-kkt", action="store_true",
+                    help="post-train optimality report: dual/primal "
+                         "objective, duality gap, and the KKT residual "
+                         "recomputed from scratch (bounds the solver's "
+                         "incremental-f drift)")
     tr.add_argument("-q", "--quiet", action="store_true")
 
     te = sub.add_parser("test", help="evaluate a saved model on a dataset")
@@ -94,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
     te.add_argument("--predictions", default=None, metavar="PATH",
                     help="also write one predicted label per line "
                          "(binary models: 'label,decision_value')")
+    te.add_argument("--proba", default=None, metavar="PATH",
+                    help="write Platt-calibrated P(y=+1|x) per line and "
+                         "print Brier score / log-loss (needs the "
+                         "<model>.platt.json sidecar from train "
+                         "--probability)")
 
     cv = sub.add_parser(
         "convert", help="dataset converters (the reference's scripts/)")
@@ -112,11 +126,46 @@ def build_parser() -> argparse.ArgumentParser:
 
 def cmd_train(args: argparse.Namespace) -> int:
     # Imports deferred so --help / arg errors don't pay the jax import.
+    import numpy as np
+
     from dpsvm_tpu.api import fit
     from dpsvm_tpu.config import SVMConfig
     from dpsvm_tpu.data.loader import load_csv
     from dpsvm_tpu.models.io import save_model
     from dpsvm_tpu.models.svm import evaluate
+
+    if args.multiclass:
+        # Flag conflicts are detectable from args alone — fail before
+        # the (possibly huge) CSV parse.
+        import os
+        if os.path.isfile(args.model):
+            print(f"error: -m {args.model} is an existing file; "
+                  "--multiclass writes a model DIRECTORY",
+                  file=sys.stderr)
+            return 2
+        if args.probability:
+            print("error: --probability calibrates a binary decision "
+                  "value; it does not apply to one-vs-one multiclass "
+                  "models", file=sys.stderr)
+            return 2
+        if args.check_kkt:
+            print("error: --check-kkt reports on a single binary "
+                  "subproblem; it does not apply to --multiclass runs",
+                  file=sys.stderr)
+            return 2
+        if args.checkpoint or args.resume:
+            print("error: --checkpoint/--resume are single-model flags; "
+                  "they cannot be shared across the pairwise multiclass "
+                  "subproblems", file=sys.stderr)
+            return 2
+        if args.weight_pos != 1.0 or args.weight_neg != 1.0:
+            # In OvO, '+1' is just the lower-sorted label of each pair —
+            # a +/-1 weight would attach to an arbitrary pseudo-label,
+            # not to any actual data class (LIBSVM -wi maps by label).
+            print("error: --weight-pos/--weight-neg are binary-problem "
+                  "flags; per-label weighting of multiclass pairs is not "
+                  "supported", file=sys.stderr)
+            return 2
 
     x, y = load_csv(args.input, args.num_ex, args.num_att)
     config = SVMConfig(
@@ -140,19 +189,6 @@ def cmd_train(args: argparse.Namespace) -> int:
         from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
                                                  save_multiclass,
                                                  train_multiclass)
-        if args.checkpoint or args.resume:
-            print("error: --checkpoint/--resume are single-model flags; "
-                  "they cannot be shared across the pairwise multiclass "
-                  "subproblems", file=sys.stderr)
-            return 2
-        if args.weight_pos != 1.0 or args.weight_neg != 1.0:
-            # In OvO, '+1' is just the lower-sorted label of each pair —
-            # a +/-1 weight would attach to an arbitrary pseudo-label,
-            # not to any actual data class (LIBSVM -wi maps by label).
-            print("error: --weight-pos/--weight-neg are binary-problem "
-                  "flags; per-label weighting of multiclass pairs is not "
-                  "supported", file=sys.stderr)
-            return 2
         mc, results = train_multiclass(x, y, config)
         save_multiclass(mc, args.model)
         acc = evaluate_multiclass(mc, x, y)
@@ -177,6 +213,32 @@ def cmd_train(args: argparse.Namespace) -> int:
           + ("" if result.converged else " (max-iter reached, NOT converged)"))
     print(f"Training accuracy: {acc:.6f}")
     print(f"Training time: {result.train_seconds:.3f} s")
+    if args.probability:
+        from dpsvm_tpu.models.calibration import fit_platt, save_platt
+        from dpsvm_tpu.models.svm import decision_function
+        dec = np.asarray(decision_function(model, x))
+        pa, pb = fit_platt(dec, y)
+        save_platt(args.model, pa, pb)
+        print(f"Platt calibration: A={pa:.6f} B={pb:.6f} "
+              f"(saved {args.model}.platt.json)")
+    if args.check_kkt:
+        from dpsvm_tpu.ops.diagnostics import optimality_report
+        # One streamed kernel pass yields every metric; box_bound gives
+        # the same C_i the solver used when class weights are in play.
+        rep = optimality_report(x, y, result.alpha, result.gamma,
+                                config.box_bound(y), b=result.b)
+        # The solver maintains f incrementally across every iteration;
+        # kkt_residual recomputes the same b_lo - b_hi from scratch, so
+        # the difference vs the solver's final gap bounds accumulated
+        # drift.
+        print(f"Dual objective: {rep.dual:.6f}")
+        print(f"Primal objective: {rep.primal:.6f}")
+        print(f"Duality gap: {rep.gap:.6f}")
+        print(f"Equality residual sum(alpha*y): {rep.eq_residual:.6f} "
+              "(nonzero = the reference's independent-clip drift)")
+        print(f"KKT residual (recomputed): {rep.kkt_residual:.6f} "
+              f"(solver's incremental gap: {result.gap:.6f}, "
+              f"drift {abs(rep.kkt_residual - result.gap):.2e})")
     return 0
 
 
@@ -190,6 +252,11 @@ def cmd_test(args: argparse.Namespace) -> int:
 
     if os.path.isdir(args.model):
         from dpsvm_tpu.models.multiclass import load_multiclass
+        if args.proba:
+            print("error: --proba applies to binary models only; "
+                  "one-vs-one multiclass models have no calibrated "
+                  "sidecar", file=sys.stderr)
+            return 2
         mc = load_multiclass(args.model)
         x, y = load_csv(args.input, args.num_ex, args.num_att)
         d_model = mc.models[0].num_attributes
@@ -222,6 +289,26 @@ def cmd_test(args: argparse.Namespace) -> int:
             f.writelines(f"{int(p)},{v:.6g}\n" for p, v in zip(pred, dec))
     print(f"Number of SVs: {model.n_sv}")
     print(f"Test accuracy: {acc:.6f}")
+    if args.proba:
+        from dpsvm_tpu.models.calibration import load_platt, sigmoid_proba
+        try:
+            pa, pb = load_platt(args.model)
+        except FileNotFoundError:
+            print(f"error: no Platt sidecar {args.model}.platt.json — "
+                  "train with --probability first", file=sys.stderr)
+            return 2
+        # The sigmoid was fit on intercept-included decision values;
+        # recompute them if --no-b dropped b from the accuracy pass.
+        dec_b = (decision_function(model, x) if args.no_b else dec)
+        proba = sigmoid_proba(dec_b, pa, pb)
+        with open(args.proba, "w") as f:
+            f.writelines(f"{p:.6g}\n" for p in proba)
+        t = (np.asarray(y) > 0).astype(np.float64)
+        brier = float(np.mean((proba - t) ** 2))
+        pc = np.clip(proba, 1e-12, 1.0 - 1e-12)
+        logloss = float(-np.mean(t * np.log(pc) + (1 - t) * np.log(1 - pc)))
+        print(f"Brier score: {brier:.6f}")
+        print(f"Log-loss: {logloss:.6f}")
     return 0
 
 

@@ -95,6 +95,19 @@ class SVMConfig:
             return "class-weighted costs"
         return None
 
+    def box_bound(self, y):
+        """Per-example box bound C_i = C * w(y_i), or the scalar C when
+        unweighted. The single host-side source of the float32 rounding
+        that the solver's exact ``alpha == C`` membership tests rely on
+        (the in-trace solvers compute the same jnp.float32(c * w)
+        values — solver/smo.py, parallel/dist_smo.py)."""
+        import numpy as np
+        if self.weight_pos == 1.0 and self.weight_neg == 1.0:
+            return self.c
+        return np.where(np.asarray(y) > 0,
+                        np.float32(self.c * self.weight_pos),
+                        np.float32(self.c * self.weight_neg))
+
     def resolve_gamma(self, num_attributes: int) -> float:
         if self.gamma is not None:
             return float(self.gamma)

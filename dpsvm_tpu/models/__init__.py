@@ -2,6 +2,8 @@
 
 from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
+from dpsvm_tpu.models.calibration import (fit_platt, predict_proba,
+                                          save_platt, load_platt)
 
 __all__ = [
     "SVMModel",
@@ -10,4 +12,8 @@ __all__ = [
     "evaluate",
     "save_model",
     "load_model",
+    "fit_platt",
+    "predict_proba",
+    "save_platt",
+    "load_platt",
 ]

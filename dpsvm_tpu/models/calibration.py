@@ -43,10 +43,7 @@ def fit_platt(dec: np.ndarray, y: np.ndarray,
     a, b = 0.0, float(np.log((n_neg + 1.0) / (n_pos + 1.0)))
     sigma = 1e-12
     for _ in range(max_iter):
-        z = a * dec + b
-        # p = 1/(1+e^z) computed stably either side of z = 0.
-        ez = np.exp(-np.abs(z))
-        p = np.where(z >= 0, ez / (1.0 + ez), 1.0 / (1.0 + ez))
+        p = sigmoid_proba(dec, a, b)
         # gradient of the negative log-likelihood wrt (a, b)
         d1 = t - p
         g1 = float(np.dot(dec, d1))
@@ -79,13 +76,19 @@ def fit_platt(dec: np.ndarray, y: np.ndarray,
     return float(a), float(b)
 
 
-def predict_proba(model: SVMModel, x: np.ndarray, a: float, b: float,
-                  include_b: bool = True) -> np.ndarray:
-    """P(y = +1 | x) under the fitted sigmoid."""
-    dec = decision_function(model, x, include_b=include_b)
+def sigmoid_proba(dec: np.ndarray, a: float, b: float) -> np.ndarray:
+    """P(y = +1 | dec) = 1/(1 + exp(a*dec + b)), computed stably on
+    either side of z = 0."""
     z = a * np.asarray(dec, np.float64) + b
     ez = np.exp(-np.abs(z))
     return np.where(z >= 0, ez / (1.0 + ez), 1.0 / (1.0 + ez))
+
+
+def predict_proba(model: SVMModel, x: np.ndarray, a: float, b: float,
+                  include_b: bool = True) -> np.ndarray:
+    """P(y = +1 | x) under the fitted sigmoid."""
+    return sigmoid_proba(decision_function(model, x, include_b=include_b),
+                         a, b)
 
 
 def sidecar_path(model_path: str) -> str:

@@ -43,6 +43,13 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     from dpsvm_tpu.api import fit
 
     config = config or SVMConfig()
+    if config.checkpoint_path or config.resume_from:
+        # Every pairwise fit would share the one checkpoint file —
+        # overwriting each other or failing shape validation mid-run.
+        raise ValueError(
+            "checkpoint_path/resume_from are single-model options; "
+            "they cannot be shared across the pairwise multiclass "
+            "subproblems")
     y = np.asarray(y)
     classes = np.unique(y)
     if len(classes) < 2:

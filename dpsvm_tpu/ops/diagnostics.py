@@ -8,16 +8,19 @@ intended for validation and debugging (never the hot loop):
   dual objective  D(alpha) = sum(alpha) - 1/2 sum_ij alpha_i alpha_j
                               y_i y_j K(x_i, x_j)
   primal (at w implied by alpha, hinge loss):
-                  P(alpha) = 1/2 |w|^2 + C sum_i max(0, 1 - y_i (f_w(x_i)))
+                  P(alpha) = 1/2 |w|^2 + sum_i C_i max(0, 1 - y_i (f_w(x_i) - b))
   gap = P - D >= 0, -> 0 at the optimum.
 
 The kernel matrix is never materialized: everything streams in row blocks
-of a (block, d) @ (d, n) matmul, so memory stays O(block * n).
+of a (block, d) @ (d, n) matmul, so memory stays O(block * n). The
+streamed ``kv = K @ (alpha*y)`` vector is computed ONCE and shared by
+every metric (``optimality_report``); the standalone functions remain as
+thin wrappers.
 """
 
 from __future__ import annotations
 
-import functools
+import dataclasses
 from typing import Tuple
 
 import jax
@@ -28,64 +31,102 @@ from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
 
 
 @jax.jit
-def _block_terms(x_blk, x2_blk, coef_blk, x, x2, coef, y_blk, gamma):
+def _block_kv(x_blk, x2_blk, x, x2, coef, gamma):
     k = kernel_rows(x_blk, x2_blk, x, x2, gamma)        # (blk, n)
-    kv = k @ coef                                       # (blk,) = (K alpha*y)_i
-    quad = coef_blk @ kv                                # alpha_i y_i K alpha y
-    hinge = jnp.sum(jnp.maximum(0.0, 1.0 - y_blk * kv))
-    return quad, hinge
+    return k @ coef                                     # (blk,) = (K alpha*y)_i
 
 
-def dual_objective_and_gap(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
-                           gamma: float, c: float,
-                           block: int = 4096) -> Tuple[float, float, float]:
-    """Returns (dual_objective, primal_objective, duality_gap).
-
-    The primal uses the unbiased decision value f_w(x) = (K alpha*y)(x)
-    (no intercept), consistent with the reference evaluators that drop b.
-    """
-    x = np.asarray(x, np.float32)
-    n = x.shape[0]
-    yf = jnp.asarray(y, jnp.float32)
-    al = jnp.asarray(alpha, jnp.float32)
-    coef = al * yf
+def _stream_kv(x: np.ndarray, coef: np.ndarray, gamma: float,
+               block: int) -> np.ndarray:
+    """kv = K @ coef in row blocks; O(block * n) device memory."""
     xd = jnp.asarray(x)
     x2 = row_norms_sq(xd)
-
-    quad = 0.0
-    hinge = 0.0
+    cf = jnp.asarray(coef)
+    n = x.shape[0]
+    kv = np.empty((n,), np.float32)
     for lo in range(0, n, block):
         hi = min(lo + block, n)
-        q, h = _block_terms(xd[lo:hi], x2[lo:hi], coef[lo:hi], xd, x2, coef,
-                            yf[lo:hi], jnp.float32(gamma))
-        quad += float(q)
-        hinge += float(h)
-
-    dual = float(jnp.sum(al)) - 0.5 * quad
-    primal = 0.5 * quad + float(c) * hinge
-    return dual, primal, primal - dual
+        kv[lo:hi] = np.asarray(_block_kv(xd[lo:hi], x2[lo:hi], xd, x2, cf,
+                                         jnp.float32(gamma)))
+    return kv
 
 
-def kkt_violation(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
-                  gamma: float, c: float) -> float:
-    """max over (min_{I_up} f - max_{I_low} f) style optimality residual:
-    b_lo - b_hi recomputed from scratch (f = K alpha*y - y), in contrast to
-    the solver's incrementally-maintained f. Useful to bound f drift."""
+@dataclasses.dataclass
+class OptimalityReport:
+    dual: float            # Lagrangian L(alpha, b) — see notes below
+    primal: float          # P at (w(alpha), b)
+    gap: float             # primal - dual
+    kkt_residual: float    # b_lo - b_hi recomputed from fresh f
+    eq_residual: float     # sum(alpha * y) — the independent-clip drift
+
+
+def optimality_report(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
+                      gamma: float, c, b: float = 0.0,
+                      block: int = 4096) -> OptimalityReport:
+    """All post-train optimality metrics from ONE streamed kernel pass.
+
+    ``c`` may be a scalar or a per-example (n,) array (class-weighted
+    costs: C_i = C * w(y_i)); the primal weights each hinge term by its
+    example's box bound.
+
+    The primal evaluates the hinge at f_w(x) - b. Pass the solver's
+    intercept for a tight certificate: the bias is a free primal variable,
+    so P(w, b*) = D(alpha*) at the optimum, while b=0 (the default, and
+    what the reference evaluators use when they drop b, seq_test.cpp:197)
+    systematically overstates the gap by up to C * sum_i |b| at large C.
+
+    Equality-constraint correction: the reference clips the two updated
+    alphas INDEPENDENTLY to their boxes (svmTrainMain.cpp:294-295 — not
+    the textbook pairwise clip), so its iterates drift off the dual
+    manifold sum_i alpha_i y_i = 0 (visibly so with class weights). The
+    textbook dual value is then off by exactly b * sum(alpha*y) relative
+    to the primal at the same KKT point, which is an artifact of the
+    algorithm's parametrization, not suboptimality. When ``b`` is given,
+    the reported dual is the Lagrangian value L(alpha, b) =
+    sum(alpha) - 1/2 quad + b*sum(alpha*y), which removes that artifact
+    and makes gap -> 0 at eps-KKT convergence regardless of the drift.
+
+    ``kkt_residual`` is b_lo - b_hi with f = kv - y recomputed from
+    scratch, in contrast to the solver's incrementally-maintained f —
+    comparing the two bounds accumulated f drift.
+    """
     from dpsvm_tpu.solver.oracle import iup_ilow_masks
 
     x = np.asarray(x, np.float32)
     yf = np.asarray(y, np.float32)
     al = np.asarray(alpha, np.float32)
-    coef = jnp.asarray(al * yf)
-    xd = jnp.asarray(x)
-    x2 = row_norms_sq(xd)
-    f = np.empty((x.shape[0],), np.float32)
-    block = 4096
-    for lo in range(0, x.shape[0], block):
-        hi = min(lo + block, x.shape[0])
-        k = kernel_rows(xd[lo:hi], x2[lo:hi], xd, x2, jnp.float32(gamma))
-        f[lo:hi] = np.asarray(k @ coef) - yf[lo:hi]
-    in_up, in_low = iup_ilow_masks(al, yf, np.float32(c))
+    c_vec = np.asarray(c, np.float32)
+    coef = al * yf
+
+    kv = _stream_kv(x, coef, gamma, block)
+
+    quad = float(coef @ kv)
+    hinge = float(np.sum(np.broadcast_to(c_vec, yf.shape)
+                         * np.maximum(0.0, 1.0 - yf * (kv - b))))
+    eq_residual = float(np.sum(coef))
+    dual = float(np.sum(al)) - 0.5 * quad + float(b) * eq_residual
+    primal = 0.5 * quad + hinge
+
+    f = kv - yf
+    in_up, in_low = iup_ilow_masks(al, yf, c_vec)
     b_hi = f[in_up].min() if in_up.any() else np.inf
     b_lo = f[in_low].max() if in_low.any() else -np.inf
-    return float(b_lo - b_hi)
+
+    return OptimalityReport(dual=dual, primal=primal, gap=primal - dual,
+                            kkt_residual=float(b_lo - b_hi),
+                            eq_residual=eq_residual)
+
+
+def dual_objective_and_gap(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
+                           gamma: float, c, b: float = 0.0,
+                           block: int = 4096) -> Tuple[float, float, float]:
+    """(dual_objective, primal_objective, duality_gap) — see
+    ``optimality_report`` for the semantics of ``c`` and ``b``."""
+    r = optimality_report(x, y, alpha, gamma, c, b, block)
+    return r.dual, r.primal, r.gap
+
+
+def kkt_violation(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
+                  gamma: float, c) -> float:
+    """b_lo - b_hi recomputed from fresh f — see ``optimality_report``."""
+    return optimality_report(x, y, alpha, gamma, c).kkt_residual

@@ -33,6 +33,28 @@ def iup_ilow_masks(alpha: jax.Array, y: jax.Array, c
     return in_up, in_low
 
 
+def masked_scores_and_masks(alpha: jax.Array, y: jax.Array, f: jax.Array,
+                            c, valid: Optional[jax.Array] = None
+                            ) -> Tuple[jax.Array, jax.Array,
+                                       jax.Array, jax.Array]:
+    """(f_up, f_low, in_up, in_low): sentinel-masked scores plus the
+    boolean membership masks themselves.
+
+    Consumers that need membership (e.g. WSS2's violator filter) must use
+    the returned masks, NOT a ``f_low > -SENTINEL/2`` style test on the
+    scores — a genuine violator with f < -SENTINEL/2 (reachable with
+    extreme but legal C*weight and n, since |f| <= n*C_max + 1) would be
+    misclassified by the sentinel inference.
+    """
+    in_up, in_low = iup_ilow_masks(alpha, y, c)
+    if valid is not None:
+        in_up = in_up & valid
+        in_low = in_low & valid
+    f_up = jnp.where(in_up, f, jnp.float32(SENTINEL))
+    f_low = jnp.where(in_low, f, jnp.float32(-SENTINEL))
+    return f_up, f_low, in_up, in_low
+
+
 def masked_scores(alpha: jax.Array, y: jax.Array, f: jax.Array, c,
                   valid: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array]:
@@ -41,13 +63,7 @@ def masked_scores(alpha: jax.Array, y: jax.Array, f: jax.Array, c,
     ``valid`` masks out padding rows (used when n is padded to a multiple
     of the mesh size); padded rows belong to neither set.
     """
-    in_up, in_low = iup_ilow_masks(alpha, y, c)
-    if valid is not None:
-        in_up = in_up & valid
-        in_low = in_low & valid
-    f_up = jnp.where(in_up, f, jnp.float32(SENTINEL))
-    f_low = jnp.where(in_low, f, jnp.float32(-SENTINEL))
-    return f_up, f_low
+    return masked_scores_and_masks(alpha, y, f, c, valid)[:2]
 
 
 def masked_extrema(alpha: jax.Array, y: jax.Array, f: jax.Array, c,

@@ -43,7 +43,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
-from dpsvm_tpu.ops.selection import masked_extrema, masked_scores
+from dpsvm_tpu.ops.selection import (masked_extrema,
+                                     masked_scores_and_masks)
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
@@ -105,7 +106,8 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     rank = lax.axis_index(SHARD_AXIS)
     c_box, c_of_y = _weighted_box(c, weights, ys)
 
-    f_up_l, f_low_l = masked_scores(alpha_s, ys, f_s, c_box, valid)
+    f_up_l, f_low_l, _, in_low = masked_scores_and_masks(
+        alpha_s, ys, f_s, c_box, valid)
 
     # --- phase 1: global i_hi (argmin f over I_up) + stopping b_lo ---
     li_hi = jnp.argmin(f_up_l)
@@ -142,7 +144,6 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     k_hi = local_k_row(row_hi, x2_hi)                              # (n_s,)
     bb = f_low_l - b_hi
     a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
-    in_low = f_low_l > jnp.float32(-SENTINEL) / 2
     obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
     li_lo = jnp.argmax(obj)
     lo_pack = jnp.stack([obj[li_lo], f_low_l[li_lo]])

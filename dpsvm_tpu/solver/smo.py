@@ -32,7 +32,7 @@ from jax import lax
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
-from dpsvm_tpu.ops.selection import masked_extrema, masked_scores
+from dpsvm_tpu.ops.selection import masked_extrema, masked_scores_and_masks
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
 
@@ -87,7 +87,7 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         c_of = lambda i: jnp.float32(c)
 
     if second_order:
-        f_up, f_low = masked_scores(alpha, y, f, c_box)
+        f_up, f_low, _, in_low = masked_scores_and_masks(alpha, y, f, c_box)
         i_hi = jnp.argmin(f_up)
         b_hi = f_up[i_hi]
         b_lo = jnp.max(f_low)                       # stopping gap only
@@ -96,7 +96,6 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         k_hi = rbf_rows_from_dots(dots_hi, x2[i_hi][None], x2, gamma)[0]
         bb = f_low - b_hi
         a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
-        in_low = f_low > jnp.float32(-SENTINEL) / 2
         obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
         i_lo = jnp.argmax(obj)
         dots_lo = jnp.matmul(x[i_lo][None, :], x.T,

@@ -1,0 +1,126 @@
+"""Platt calibration: fit convergence, sidecar roundtrip, CLI wiring.
+
+Covers the LIBSVM ``-b 1`` analog end to end: ``fit_platt`` recovers a
+known sigmoid, probabilities are monotone in the decision value and
+better-calibrated than the raw sign, the sidecar round-trips, and the
+CLI path (``train --probability`` -> ``test --proba``) produces a
+probability file plus Brier/log-loss output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import fit
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+from dpsvm_tpu.models.calibration import (fit_platt, load_platt,
+                                          predict_proba, save_platt,
+                                          sidecar_path)
+
+
+def test_fit_platt_recovers_known_sigmoid():
+    """Labels drawn from a known sigmoid of dec -> fit recovers (A, B)."""
+    rng = np.random.default_rng(0)
+    dec = rng.normal(size=5000) * 2.0
+    a_true, b_true = -1.7, 0.4
+    p = 1.0 / (1.0 + np.exp(a_true * dec + b_true))
+    y = np.where(rng.random(5000) < p, 1, -1)
+    a, b = fit_platt(dec, y)
+    assert abs(a - a_true) < 0.15
+    assert abs(b - b_true) < 0.15
+
+
+def test_fit_platt_requires_both_classes():
+    with pytest.raises(ValueError):
+        fit_platt(np.array([1.0, 2.0]), np.array([1, 1]))
+
+
+def test_proba_monotone_and_calibrated_on_blobs():
+    x, y = make_blobs(n=300, d=4, seed=5, separation=1.2)
+    model, result = fit(x, y, SVMConfig(c=1.0, gamma=0.5))
+    assert result.converged
+
+    from dpsvm_tpu.models.svm import decision_function
+    dec = np.asarray(decision_function(model, x))
+    a, b = fit_platt(dec, y)
+    assert a < 0, "larger decision value must mean larger P(y=+1)"
+
+    proba = predict_proba(model, x, a, b)
+    assert np.all((proba > 0) & (proba < 1))
+    # Monotone in dec.
+    order = np.argsort(dec)
+    assert np.all(np.diff(proba[order]) >= -1e-12)
+    # Probabilities track the labels better than a coin flip: mean
+    # P(correct class) clearly above 0.5.
+    p_correct = np.where(y > 0, proba, 1.0 - proba)
+    assert float(p_correct.mean()) > 0.7
+
+
+def test_sidecar_roundtrip(tmp_path):
+    mp = str(tmp_path / "m.svm")
+    save_platt(mp, -1.25, 0.5)
+    assert os.path.exists(sidecar_path(mp))
+    a, b = load_platt(mp)
+    assert (a, b) == (-1.25, 0.5)
+
+
+def test_sidecar_rejects_unknown_format(tmp_path):
+    mp = str(tmp_path / "m.svm")
+    with open(sidecar_path(mp), "w") as f:
+        json.dump({"format": "something-else", "A": 1, "B": 2}, f)
+    with pytest.raises(ValueError):
+        load_platt(mp)
+
+
+def test_cli_probability_roundtrip(tmp_path):
+    from dpsvm_tpu.cli import main
+
+    x, y = make_blobs(n=120, d=3, seed=9)
+    csv = str(tmp_path / "train.csv")
+    save_csv(csv, x, y)
+    model = str(tmp_path / "model.svm")
+
+    assert main(["train", "-f", csv, "-m", model, "-c", "1", "-g", "0.5",
+                 "--probability", "-q"]) == 0
+    assert os.path.exists(model + ".platt.json")
+
+    proba_file = str(tmp_path / "proba.txt")
+    assert main(["test", "-f", csv, "-m", model,
+                 "--proba", proba_file]) == 0
+    probs = np.loadtxt(proba_file)
+    assert probs.shape == (120,)
+    assert np.all((probs > 0) & (probs < 1))
+    # Calibrated probabilities agree with the labels on separable blobs.
+    assert float(np.mean((probs > 0.5) == (y > 0))) > 0.9
+
+
+def test_cli_proba_without_sidecar_errors(tmp_path, capsys):
+    from dpsvm_tpu.cli import main
+
+    x, y = make_blobs(n=80, d=3, seed=2)
+    csv = str(tmp_path / "train.csv")
+    save_csv(csv, x, y)
+    model = str(tmp_path / "model.svm")
+    assert main(["train", "-f", csv, "-m", model, "-q"]) == 0
+    assert main(["test", "-f", csv, "-m", model,
+                 "--proba", str(tmp_path / "p.txt")]) == 2
+    assert "platt" in capsys.readouterr().err.lower()
+
+
+def test_cli_probability_rejected_for_multiclass(tmp_path, capsys):
+    from dpsvm_tpu.cli import main
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(60, 3)).astype(np.float32)
+    y = rng.integers(0, 3, size=60)
+    x += y[:, None].astype(np.float32)
+    csv = str(tmp_path / "mc.csv")
+    save_csv(csv, x, y)
+    assert main(["train", "-f", csv, "-m", str(tmp_path / "mcmodel"),
+                 "--multiclass", "--probability", "-q"]) == 2
+    assert "probability" in capsys.readouterr().err

@@ -43,3 +43,86 @@ def test_kkt_residual_matches_solver_certificate(solved):
     # fresh-f residual within fp slack of the solver's converged b_lo - b_hi
     assert viol <= 2 * cfg.epsilon + 5e-3
     assert viol == pytest.approx(res.b_lo - res.b_hi, abs=5e-3)
+
+
+def test_cli_check_kkt_reports(tmp_path, capsys):
+    """--check-kkt surfaces the diagnostics from the product CLI
+    (the reference's analog, get_duality_gap at seq.cpp:352-376, is
+    dead code; ours is user-visible)."""
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+
+    x, y = make_blobs(n=100, d=3, seed=4)
+    csv = str(tmp_path / "t.csv")
+    save_csv(csv, x, y)
+    assert main(["train", "-f", csv, "-m", str(tmp_path / "m.svm"),
+                 "--check-kkt", "-q"]) == 0
+    out = capsys.readouterr().out
+    assert "Dual objective:" in out
+    assert "Duality gap:" in out
+    assert "KKT residual" in out
+    # the printed gap must be sane (float32 rounding can leave it a
+    # hair negative at convergence, like test_gap_tight_with_solver_intercept)
+    gap = float(out.split("Duality gap:")[1].split()[0])
+    assert -1e-3 <= gap < 100.0
+
+
+def test_cli_multiclass_rejects_existing_file_model(tmp_path, capsys):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+
+    x, y = make_blobs(n=60, d=3, seed=1)
+    csv = str(tmp_path / "t.csv")
+    save_csv(csv, x, y)
+    target = tmp_path / "already_a_file"
+    target.write_text("occupied")
+    assert main(["train", "-f", csv, "-m", str(target),
+                 "--multiclass", "-q"]) == 2
+    assert "DIRECTORY" in capsys.readouterr().err
+
+
+def test_train_multiclass_api_rejects_checkpoint_config():
+    import pytest as _pytest
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.models.multiclass import train_multiclass
+
+    x, y = make_blobs(n=40, d=3, seed=0)
+    with _pytest.raises(ValueError, match="single-model"):
+        train_multiclass(x, np.asarray(y) + 2,
+                         SVMConfig(checkpoint_path="x.npz"))
+
+
+def test_gap_tight_with_solver_intercept(solved):
+    """Passing the solver's b makes the certificate tight: gap with b*
+    is far below the b=0 gap and a small fraction of the primal."""
+    x, y, cfg, res = solved
+    _, primal0, gap0 = dual_objective_and_gap(
+        x, y, res.alpha, res.gamma, cfg.c)
+    _, primal_b, gap_b = dual_objective_and_gap(
+        x, y, res.alpha, res.gamma, cfg.c, b=res.b)
+    assert gap_b >= -1e-3
+    assert gap_b <= gap0 + 1e-6
+    assert gap_b / max(1.0, abs(primal_b)) < 0.02
+
+
+def test_kkt_and_gap_with_class_weights():
+    """Per-example C: at a weighted optimum the array-c diagnostics
+    certify convergence where scalar-c masks would report a spurious
+    violation (alpha == C*w examples misclassified as interior)."""
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.solver.smo import train_single_device
+
+    x, y = make_blobs(n=120, d=3, seed=8, separation=0.8)
+    cfg = SVMConfig(c=1.0, gamma=0.5, weight_pos=4.0, weight_neg=1.0,
+                    epsilon=1e-3, max_iter=20_000)
+    res = train_single_device(x, y, cfg)
+    assert res.converged
+    c_box = np.where(np.asarray(y) > 0, np.float32(4.0), np.float32(1.0))
+    viol = kkt_violation(x, y, res.alpha, res.gamma, c_box)
+    assert viol <= 2 * cfg.epsilon + 5e-3
+    dual, primal, gap = dual_objective_and_gap(
+        x, y, res.alpha, res.gamma, c_box, b=res.b)
+    assert gap >= -1e-3
+    assert gap / max(1.0, abs(primal)) < 0.05
