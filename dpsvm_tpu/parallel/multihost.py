@@ -31,9 +31,15 @@ _initialized = False
 def initialize(coordinator: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
-    """Join (or create) the multi-host runtime. Idempotent."""
+    """Join (or create) the multi-host runtime. Idempotent.
+
+    Deliberately checks only the local flag, NOT ``is_initialized()``:
+    that helper may consult ``jax.process_count()``, and any such call
+    initializes the XLA backend — after which
+    ``jax.distributed.initialize`` refuses to run at all.
+    """
     global _initialized
-    if is_initialized():
+    if _initialized:
         return
     kwargs = {}
     if coordinator is not None:
@@ -54,8 +60,19 @@ def initialize(coordinator: Optional[str] = None,
 def is_initialized() -> bool:
     # jax exposes no public "is the distributed client up" predicate
     # (jax.distributed.global_state is gone in 0.9), so track our own
-    # calls and fall back to the observable multi-process signal.
-    return _initialized or jax.process_count() > 1
+    # calls and fall back to the observable multi-process signal — but
+    # never touch jax.process_count() while the backend is still cold,
+    # since that call would itself initialize it (and permanently block
+    # a later jax.distributed.initialize in this process).
+    if _initialized:
+        return True
+    try:
+        from jax._src import xla_bridge
+        if not xla_bridge.backends_are_initialized():
+            return False
+    except (ImportError, AttributeError):    # private API moved: assume
+        pass                                 # warm and fall through
+    return jax.process_count() > 1
 
 
 def process_info() -> str:
