@@ -46,6 +46,12 @@ def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "DEFAULT").upper()
     warmup_iters = 200
 
+    from dpsvm_tpu.utils.backend_guard import require_devices
+
+    # Fail fast (clear stderr line, rc=1) instead of hanging the driver
+    # if the TPU tunnel is wedged — see backend_guard docstring.
+    dev = require_devices()[0]
+
     import jax
     import jax.numpy as jnp
 
@@ -54,7 +60,6 @@ def main() -> None:
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
     from dpsvm_tpu.utils.timing import PhaseTimer
 
-    dev = jax.devices()[0]
     log(f"device: {dev} ({dev.platform})")
     timer = PhaseTimer()
 

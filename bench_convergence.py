@@ -1,0 +1,103 @@
+"""Run the reference's north-star job TO CONVERGENCE and report wall-clock.
+
+The reference's published benchmark is MNIST even-odd (60000 x 784, RBF
+C=10 gamma=0.25 eps=1e-3) trained to convergence: 137 s on one GTX 780,
+46 s on its 10-GPU cluster (/root/reference/README.md:23). ``bench.py``
+measures steady-state iteration throughput; THIS harness measures the
+actual deliverable — seconds to convergence, iterations, and the final
+SV count — for a single run configuration.
+
+Prints ONE JSON line:
+    {"metric": "mnist_scale_seconds_to_convergence", "value": <s>,
+     "unit": "s", "vs_baseline": <46 / s>,
+     "n_iter": ..., "n_sv": ..., "converged": ..., "precision": ...,
+     "train_accuracy": ...}
+
+``vs_baseline`` > 1 means faster than the reference's 10-GPU cluster.
+
+Environment:
+    BENCH_PRECISION   DEFAULT (bf16-multiply MXU, the headline) | HIGHEST
+    BENCH_DATA        path to a real train CSV (label,f1,...,fd). When
+                      unset, uses the synthetic MNIST-shaped stand-in.
+    BENCH_N/BENCH_D   synthetic shape override  (default 60000 x 784)
+    BENCH_C/BENCH_GAMMA/BENCH_EPS/BENCH_MAX_ITER
+                      hyperparameters (default 10 / 0.25 / 1e-3 / 100000,
+                      the README benchmark config)
+    BENCH_SELECTION   first-order (reference parity) | second-order
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_SECONDS = 46.0     # reference 10-GPU cluster (README.md:23)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import require_devices
+
+    dev = require_devices()[0]
+    log(f"device: {dev} ({dev.platform})")
+
+    import numpy as np
+
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.svm import SVMModel, evaluate
+
+    precision = os.environ.get("BENCH_PRECISION", "DEFAULT").lower()
+    selection = os.environ.get("BENCH_SELECTION", "first-order")
+    c = float(os.environ.get("BENCH_C", 10.0))
+    gamma = float(os.environ.get("BENCH_GAMMA", 0.25))
+    eps = float(os.environ.get("BENCH_EPS", 1e-3))
+    max_iter = int(os.environ.get("BENCH_MAX_ITER", 100_000))
+
+    data = os.environ.get("BENCH_DATA")
+    if data:
+        from dpsvm_tpu.data.loader import load_csv
+        x, y = load_csv(data, None, None)
+        log(f"data: {data} ({x.shape[0]}x{x.shape[1]})")
+    else:
+        from dpsvm_tpu.data.synthetic import make_mnist_like
+        n = int(os.environ.get("BENCH_N", 60_000))
+        d = int(os.environ.get("BENCH_D", 784))
+        x, y = make_mnist_like(n=n, d=d, seed=0)
+        log(f"data: synthetic mnist-like ({n}x{d})")
+
+    config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
+                       matmul_precision=precision, selection=selection,
+                       chunk_iters=2048)
+
+    t0 = time.perf_counter()
+    result = train(x, y, config)
+    seconds = time.perf_counter() - t0
+
+    model = SVMModel.from_train_result(x, y, result)
+    acc = evaluate(model, x, y)
+    log(f"{result.n_iter} iters in {seconds:.2f}s, converged="
+        f"{result.converged}, n_sv={result.n_sv}, train_acc={acc:.4f}")
+
+    print(json.dumps({
+        "metric": "mnist_scale_seconds_to_convergence",
+        "value": round(seconds, 2),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / seconds, 3)
+        if seconds > 0 else 0.0,
+        "n_iter": int(result.n_iter),
+        "n_sv": int(result.n_sv),
+        "converged": bool(result.converged),
+        "precision": precision,
+        "selection": selection,
+        "train_accuracy": round(float(acc), 6),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Fail-fast backend initialization for benchmark entry points.
+
+The axon-tunneled TPU backend can wedge in a state where
+``jax.devices()`` blocks forever rather than raising (observed after
+Pallas in-kernel-loop compile hangs — round-1 ``BENCH_r01.json`` died
+with an UNAVAILABLE error; a wedged tunnel just hangs). A benchmark
+harness must never hang the driver: device discovery runs in a daemon
+thread with a deadline, and on timeout or error the process exits with
+a one-line diagnostic on stderr and a nonzero code instead of a stack
+trace (or silence).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import List, Optional
+
+
+def require_devices(timeout_s: Optional[float] = None) -> List:
+    """Return ``jax.devices()`` or exit(1) with a clear one-line error.
+
+    Timeout default: BENCH_BACKEND_TIMEOUT env var, else 180 s (first
+    contact with the tunneled TPU can legitimately take tens of
+    seconds; a healthy backend never takes minutes).
+    """
+    if timeout_s is None:
+        raw = os.environ.get("BENCH_BACKEND_TIMEOUT", "180")
+        try:
+            timeout_s = float(raw)
+        except ValueError:
+            print(f"error: BENCH_BACKEND_TIMEOUT={raw!r} is not a number "
+                  "of seconds", file=sys.stderr, flush=True)
+            sys.exit(1)
+
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+            result["devices"] = jax.devices()
+        except Exception as e:      # backend raised (e.g. UNAVAILABLE)
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+
+    if t.is_alive():
+        print(f"error: jax backend initialization hung for >{timeout_s:.0f}s "
+              f"(platform={os.environ.get('JAX_PLATFORMS', 'default')!r}); "
+              "the TPU tunnel is unresponsive — not producing a number "
+              "rather than a bogus one", file=sys.stderr, flush=True)
+        # The hung thread holds jax's init lock; a normal exit could
+        # block on atexit hooks that touch the backend.
+        os._exit(1)
+    if "error" in result:
+        print(f"error: jax backend unavailable: {result['error']}",
+              file=sys.stderr, flush=True)
+        os._exit(1)
+    return result["devices"]
