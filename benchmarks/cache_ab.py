@@ -1,0 +1,102 @@
+"""A/B: kernel-row cache on vs off, single-device and distributed.
+
+The reference defaults its per-rank cache to 10 lines (`-s`,
+svmTrainMain.cpp:70) because on its GPUs a cache hit saves an SGEMV
+launch + HBM pass. On TPU the (2, d) @ (d, n) matmul is a single fused
+MXU op and XLA may keep X VMEM-resident, so whether cache bookkeeping
+(O(lines) compares + lax.cond + row table updates per iteration) pays is
+an empirical, shape-dependent question. This harness answers it with
+numbers instead of assumption: for each config it measures steady-state
+it/s with cache off and with the reference's 10 lines, and prints one
+JSON line per (config, arm).
+
+SMO's working set revisits indices heavily near convergence (the
+reference's hit rate is what made its cache worthwhile), so the measured
+window is run from a warm state, not from alpha=0.
+
+Usage:  python benchmarks/cache_ab.py [adult mnist]
+        env: BENCH_MEASURE_ITERS (default 2000), BENCH_PRECISION
+             (default HIGHEST), BENCH_SHARDS (default 1)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+CONFIGS = {
+    "adult": dict(n=32_561, d=123, c=100.0, gamma=0.5),
+    "mnist": dict(n=60_000, d=784, c=10.0, gamma=0.25),
+}
+
+
+def measure(name: str, spec: dict, cache_lines: int, measure_iters: int,
+            precision: str, shards: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.data.synthetic import make_mnist_like
+
+    x, y = make_mnist_like(n=spec["n"], d=spec["d"], seed=0)
+
+    # Warm + measure through the production chunk runner (the same
+    # compiled program train_single_device drives).
+    if shards > 1:
+        from dpsvm_tpu.parallel.dist_smo import train_distributed as _  # noqa
+        raise SystemExit("distributed A/B: use BENCH_SHARDS=1 per chip "
+                         "today; the multi-chip arm needs real ICI")
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+
+    xd = jnp.asarray(x)
+    yd = jnp.asarray(y, jnp.float32)
+    x2 = row_norms_sq(xd)
+    jax.block_until_ready(x2)
+
+    runner = _build_chunk_runner(spec["c"], spec["gamma"], 1e-3,
+                                 cache_lines > 0, precision.upper())
+    carry = init_carry(yd, cache_lines)
+    warm = 500
+    carry = runner(carry, xd, yd, x2, jnp.int32(warm))
+    jax.block_until_ready(carry.f)
+    it0 = int(carry.n_iter)
+    if it0 < warm:
+        print(f"# {name}: converged during warmup ({it0} iters); "
+              "shape too easy for a throughput window", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+    jax.block_until_ready(carry.f)
+    dt = time.perf_counter() - t0
+    iters = int(carry.n_iter) - it0
+    rate = iters / dt if dt > 0 else 0.0
+    print(json.dumps({
+        "metric": f"cache_ab_{name}",
+        "cache_lines": cache_lines,
+        "value": round(rate, 1),
+        "unit": "iter/s",
+        "iters": iters,
+        "precision": precision.upper(),
+    }), flush=True)
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import require_devices
+
+    dev = require_devices()[0]
+    print(f"# device: {dev}", file=sys.stderr)
+
+    names = sys.argv[1:] or list(CONFIGS)
+    measure_iters = int(os.environ.get("BENCH_MEASURE_ITERS", 2000))
+    precision = os.environ.get("BENCH_PRECISION", "HIGHEST")
+    shards = int(os.environ.get("BENCH_SHARDS", 1))
+    for name in names:
+        for lines in (0, 10):
+            measure(name, CONFIGS[name], lines, measure_iters, precision,
+                    shards)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,9 +13,13 @@ flags (``svmTrainMain.cpp:62-71,22-44``):
                                  is deliberately FIXED here — see SURVEY §2d)
     -e epsilon (default 1e-3) -> ``epsilon``
     -n max-iter (default 150000) -> ``max_iter``
-    -s cache-size (default 10 lines) -> ``cache_size`` (0 disables; on TPU the
-                                 fused matmul is usually faster than cache
-                                 bookkeeping, so 0 is the default here)
+    -s cache-size (default 10 lines) -> ``cache_size`` (0 disables — the
+                                 default here. Works on the single-device
+                                 AND distributed first-order paths (per
+                                 shard, like the reference's per-rank
+                                 myCache). Whether it pays on TPU is
+                                 shape-dependent and measured by
+                                 benchmarks/cache_ab.py, not assumed.)
 
 Shapes (`-a` / `-x`, which the reference REQUIRES on the command line) are
 inferred from the data and never part of the config.

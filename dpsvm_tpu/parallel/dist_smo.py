@@ -43,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
+from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema,
                                      masked_scores_and_masks)
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
@@ -55,6 +56,13 @@ class DistCarry(NamedTuple):
     b_hi: jax.Array     # () replicated
     b_lo: jax.Array     # () replicated
     n_iter: jax.Array   # () i32 replicated
+    # Per-shard kernel-row cache (the reference's cache is a component of
+    # the MPI trainer's hot path, one myCache per rank caching the
+    # shard's dot-product segment keyed by global working index —
+    # svmTrain.cu:142-156, cache.cu:49-60). Empty (0 lines) when off.
+    ck: jax.Array       # (P*lines,) i32 keys, sharded; -1 = empty line
+    cs: jax.Array       # (P*lines,) i32 last-use stamps, sharded
+    cr: jax.Array       # (P*lines, n_s) f32 dot rows, sharded on axis 0
 
 
 def _owner_read(arr: jax.Array, local_idx, is_owner) -> jax.Array:
@@ -74,6 +82,26 @@ def _weighted_box(c: float, weights, ys):
     c_box = jnp.where(ys > 0, jnp.float32(c * wp), jnp.float32(c * wn))
     return c_box, lambda y_sel: jnp.where(y_sel > 0, jnp.float32(c * wp),
                                           jnp.float32(c * wn))
+
+
+def _local_slice(xs, x2s, rank, n_per_shard, shard_x: bool):
+    """This shard's (n_s, d) X slice and x^2 segment: identity when X is
+    already sharded, a dynamic row-slice when X is replicated."""
+    if shard_x:
+        return xs, x2s
+    return (lax.dynamic_slice_in_dim(xs, rank * n_per_shard, n_per_shard),
+            lax.dynamic_slice_in_dim(x2s, rank * n_per_shard, n_per_shard))
+
+
+def _eta_kernel_entries(k_local, loc_hi, own_hi, loc_lo, own_lo):
+    """(K(hi,hi), K(lo,lo), K(hi,lo)) from the shards' local kernel rows
+    via one masked-psum of the owners' reads."""
+    k_pack = lax.psum(jnp.stack([
+        _owner_read(k_local[0], loc_hi, own_hi),
+        _owner_read(k_local[1], loc_lo, own_lo),
+        _owner_read(k_local[0], loc_lo, own_lo),
+    ]), SHARD_AXIS)
+    return k_pack[0], k_pack[1], k_pack[2]
 
 
 def _broadcast_row(xs, ys, x2s, alpha_s, loc, own, gi, *, shard_x: bool):
@@ -130,12 +158,7 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     # WSS2 consumes K only shard-locally (scores + owner reads), so with
     # replicated X slice this shard's rows BEFORE the matmul — unlike
     # first-order, which reads K at global indices.
-    if shard_x:
-        xs_l, x2s_l = xs, x2s
-    else:
-        xs_l = lax.dynamic_slice_in_dim(xs, rank * n_per_shard, n_per_shard)
-        x2s_l = lax.dynamic_slice_in_dim(x2s, rank * n_per_shard,
-                                         n_per_shard)
+    xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
 
     def local_k_row(row, w2):
         dots = jnp.matmul(row[None, :], xs_l.T, precision=precision)
@@ -167,12 +190,9 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     # oracle's K-row reads, breaking the bit-level trajectory parity the
     # tests assert — and one ~µs scalar collective is noise next to the
     # two serial (1,d)@(d,n_s) matmuls in this body. ---
-    k_pack = lax.psum(jnp.stack([
-        _owner_read(k_hi, loc_hi, own_hi),     # K(hi, hi)
-        _owner_read(k_lo, loc_lo, own_lo),     # K(lo, lo)
-        _owner_read(k_hi, loc_lo, own_lo),     # K(hi, lo)
-    ]), SHARD_AXIS)
-    eta = jnp.maximum(k_pack[0] + k_pack[1] - 2.0 * k_pack[2], 1e-12)
+    k_hh, k_ll, k_hl = _eta_kernel_entries((k_hi, k_lo), loc_hi, own_hi,
+                                           loc_lo, own_lo)
+    eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, 1e-12)
 
     s = y_lo * y_hi
     a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
@@ -188,12 +208,14 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     f_s = (f_s + (a_hi_n - a_hi) * y_hi * k_hi
                + (a_lo_n - a_lo) * y_lo * k_lo)
 
-    return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1)
+    return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1,
+                     carry.ck, carry.cs, carry.cr)
 
 
 def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
                c: float, gamma: float, n_per_shard: int, shard_x: bool,
-               precision, weights=(1.0, 1.0)) -> DistCarry:
+               precision, weights=(1.0, 1.0),
+               use_cache: bool = False) -> DistCarry:
     """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
     slices when shard_x else full replicated arrays."""
     alpha_s, f_s = carry.alpha, carry.f
@@ -255,17 +277,31 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     a_hi, a_lo = scalars[0, 2], scalars[1, 2]
 
     # --- kernel rows on the local slice: (2, d) @ (d, n_s) (CS-3) ---
-    dots = jnp.matmul(rows, xs.T, precision=precision)
-    if shard_x:
-        k = rbf_rows_from_dots(dots, w2, x2s, gamma)               # (2, n_s)
-        k_pack = lax.psum(jnp.stack([
-            _owner_read(k[0], loc_hi, own_hi),   # K(hi, hi)
-            _owner_read(k[1], loc_lo, own_lo),   # K(lo, lo)
-            _owner_read(k[0], loc_lo, own_lo),   # K(hi, lo)
-        ]), SHARD_AXIS)
-        k_hh, k_ll, k_hl = k_pack[0], k_pack[1], k_pack[2]
-        k_local = k
+    cache_out = (carry.ck, carry.cs, carry.cr)
+    if use_cache:
+        # Per-shard dot-row cache keyed on GLOBAL working index, exactly
+        # the reference's per-rank layout (cache line = this shard's
+        # segment, key = global index — svmTrain.cu:142-156). The key
+        # sequence is replicated, so hit/miss is uniform across shards
+        # and the miss matmul has no collective inside the lax.cond.
+        # n_iter is the LRU tick (one fetch per iteration).
+        xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
+        cache = RowCache(keys=carry.ck, stamps=carry.cs, rows=carry.cr,
+                         tick=carry.n_iter)
+        dots, cache = cache_fetch_pair(
+            cache, i_hi_g, i_lo_g,
+            lambda: jnp.matmul(rows, xs_l.T, precision=precision))
+        cache_out = (cache.keys, cache.stamps, cache.rows)
+        k_local = rbf_rows_from_dots(dots, w2, x2s_l, gamma)       # (2, n_s)
+        k_hh, k_ll, k_hl = _eta_kernel_entries(k_local, loc_hi, own_hi,
+                                               loc_lo, own_lo)
+    elif shard_x:
+        dots = jnp.matmul(rows, xs.T, precision=precision)
+        k_local = rbf_rows_from_dots(dots, w2, x2s, gamma)         # (2, n_s)
+        k_hh, k_ll, k_hl = _eta_kernel_entries(k_local, loc_hi, own_hi,
+                                               loc_lo, own_lo)
     else:
+        dots = jnp.matmul(rows, xs.T, precision=precision)
         k_full = rbf_rows_from_dots(dots, w2, x2s, gamma)          # (2, n_pad)
         k_hh = k_full[0, i_hi_g]
         k_ll = k_full[1, i_lo_g]
@@ -290,17 +326,23 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     f_s = (f_s + (a_hi_n - a_hi) * y_hi * k_local[0]
                + (a_lo_n - a_lo) * y_lo * k_local[1])
 
-    return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1)
+    return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1,
+                     *cache_out)
 
 
 @functools.lru_cache(maxsize=16)
 def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
                        epsilon: float, n_per_shard: int, shard_x: bool,
                        precision_name: str, second_order: bool = False,
-                       weights=(1.0, 1.0)):
+                       weights=(1.0, 1.0), use_cache: bool = False):
     precision = getattr(lax.Precision, precision_name)
     x_spec = P(SHARD_AXIS) if shard_x else P()
-    step = _dist_step_wss2 if second_order else _dist_step
+    if second_order:
+        step = _dist_step_wss2
+        extra = {}
+    else:
+        step = _dist_step
+        extra = {"use_cache": use_cache}
 
     def run(carry: DistCarry, xs, ys, x2s, valid, limit):
         def cond(s: DistCarry):
@@ -309,7 +351,7 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
         def body(s: DistCarry):
             return step(s, xs, ys, x2s, valid, c=c, gamma=gamma,
                         n_per_shard=n_per_shard, shard_x=shard_x,
-                        precision=precision, weights=weights)
+                        precision=precision, weights=weights, **extra)
 
         # b_hi/b_lo come out of the loop body via all_gather, which types
         # them as axis-varying under shard_map's VMA checks; mark the
@@ -323,7 +365,9 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
                             b_lo=lax.pmax(out.b_lo, SHARD_AXIS))
 
     carry_specs = DistCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
-                            b_hi=P(), b_lo=P(), n_iter=P())
+                            b_hi=P(), b_lo=P(), n_iter=P(),
+                            ck=P(SHARD_AXIS), cs=P(SHARD_AXIS),
+                            cr=P(SHARD_AXIS, None))
     mapped = jax.shard_map(
         run, mesh=mesh,
         in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec, P(SHARD_AXIS),
@@ -371,12 +415,22 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     else:
         init = (np.zeros((n_pad,), np.float32), -yp,
                 -SENTINEL, SENTINEL, 0)
+    # Per-shard row cache: `lines` lines per shard (the reference's -s is
+    # per-rank lines too, svmTrainMain.cpp:70); 0 disables. Resume starts
+    # cold — the checkpoint holds only (alpha, f), like the reference's
+    # model file holds no cache.
+    lines = int(config.cache_size)
+    row_shard = NamedSharding(mesh, P(SHARD_AXIS, None))
     carry = DistCarry(
         alpha=jax.device_put(jnp.asarray(init[0]), shard),
         f=jax.device_put(jnp.asarray(init[1]), shard),
         b_hi=jax.device_put(jnp.float32(init[2]), repl),
         b_lo=jax.device_put(jnp.float32(init[3]), repl),
         n_iter=jax.device_put(jnp.int32(init[4]), repl),
+        ck=jax.device_put(jnp.full((p * lines,), -1, jnp.int32), shard),
+        cs=jax.device_put(jnp.zeros((p * lines,), jnp.int32), shard),
+        cr=jax.device_put(jnp.zeros((p * lines, n_s), jnp.float32),
+                          row_shard),
     )
 
     runner = _build_dist_runner(mesh, float(config.c), gamma, eps, n_s,
@@ -384,7 +438,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                 config.matmul_precision.upper(),
                                 config.selection == "second-order",
                                 (float(config.weight_pos),
-                                 float(config.weight_neg)))
+                                 float(config.weight_neg)),
+                                use_cache=lines > 0)
 
     def step_chunk(c, lim):
         limit = jax.device_put(jnp.int32(lim), repl)

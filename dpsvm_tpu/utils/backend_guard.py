@@ -30,8 +30,10 @@ def require_devices(timeout_s: Optional[float] = None) -> List:
         try:
             timeout_s = float(raw)
         except ValueError:
-            print(f"error: BENCH_BACKEND_TIMEOUT={raw!r} is not a number "
-                  "of seconds", file=sys.stderr, flush=True)
+            timeout_s = -1.0
+        if timeout_s <= 0:
+            print(f"error: BENCH_BACKEND_TIMEOUT={raw!r} must be a "
+                  "positive number of seconds", file=sys.stderr, flush=True)
             sys.exit(1)
 
     result: dict = {}

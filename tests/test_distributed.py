@@ -89,3 +89,35 @@ def test_explicit_mesh_overrides_config_shards(blobs_small):
 def test_mesh_size_validation():
     with pytest.raises(ValueError, match="need 64 devices"):
         make_data_mesh(64)
+
+
+@pytest.mark.parametrize("shards,shard_x", [(2, True), (4, True),
+                                            (4, False), (8, True)])
+def test_distributed_row_cache_bit_equal(blobs_small, shards, shard_x):
+    """Per-shard kernel-row cache (reference: one myCache per MPI rank,
+    svmTrain.cu:142-156): cached and uncached runs must follow the
+    IDENTICAL trajectory — same iteration count, bitwise-equal alpha —
+    since a cache hit returns exactly the dot row a miss would compute."""
+    x, y = blobs_small
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                shards=shards, shard_x=shard_x, chunk_iters=128)
+    plain = train_distributed(x, y, SVMConfig(**base))
+    cached = train_distributed(x, y, SVMConfig(cache_size=8, **base))
+    assert cached.n_iter == plain.n_iter
+    assert cached.converged == plain.converged
+    np.testing.assert_array_equal(np.asarray(cached.alpha),
+                                  np.asarray(plain.alpha))
+    assert cached.b == plain.b
+
+
+def test_distributed_row_cache_min_capacity_eviction(blobs_small):
+    """cache_size=2 (the pair-fetch minimum) forces an eviction nearly
+    every fetch — the stress case for the LRU bookkeeping."""
+    x, y = blobs_small
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                shards=4, chunk_iters=128)
+    plain = train_distributed(x, y, SVMConfig(**base))
+    cached = train_distributed(x, y, SVMConfig(cache_size=2, **base))
+    assert cached.n_iter == plain.n_iter
+    np.testing.assert_array_equal(np.asarray(cached.alpha),
+                                  np.asarray(plain.alpha))
