@@ -21,6 +21,10 @@ import os
 import sys
 import time
 
+# Runnable as `python benchmarks/<name>.py` from the repo root: the
+# package lives one directory up from this script.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 CONFIGS = {
     "adult":   dict(n=32_561, d=123, c=100.0, gamma=0.5, budget=150_000),
     "mnist":   dict(n=60_000, d=784, c=10.0, gamma=0.25, budget=100_000),

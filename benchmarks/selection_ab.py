@@ -1,0 +1,85 @@
+"""A/B: argminmax vs packed single-reduce working-set selection.
+
+SURVEY §7 hard part (b): the per-iteration serial chain of small ops —
+not the (2, d) @ (d, n) matmul (~19 us alone at MNIST shape) — dominates
+the measured ~64 us bf16 iteration. Selection is two masked argmin/argmax
+reductions plus two gathers; ``masked_extrema_packed`` lowers the whole
+thing to one 4-operand lax.reduce (the reference's fused my_maxmin
+shape, svmTrain.cu:400-467). Whether XLA's fusion already achieves the
+same schedule is an empirical question; this harness answers it with
+steady-state it/s for both lowerings at the benchmark shape, one JSON
+line per arm.
+
+Usage:  python benchmarks/selection_ab.py
+        env: BENCH_N/BENCH_D (default 60000 x 784),
+             BENCH_MEASURE_ITERS (default 3000),
+             BENCH_PRECISION (default DEFAULT = bf16-multiply)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# Runnable as `python benchmarks/<name>.py` from the repo root: the
+# package lives one directory up from this script.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(packed: bool, n: int, d: int, measure_iters: int,
+            precision: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+
+    x, y = make_mnist_like(n=n, d=d, seed=0)
+    xd = jnp.asarray(x)
+    yd = jnp.asarray(y, jnp.float32)
+    x2 = row_norms_sq(xd)
+    jax.block_until_ready(x2)
+
+    runner = _build_chunk_runner(10.0, 0.25, 1e-3, False,
+                                 precision.upper(),
+                                 packed_select=packed)
+    carry = init_carry(yd, 0)
+    warm = 200
+    carry = runner(carry, xd, yd, x2, jnp.int32(warm))
+    jax.block_until_ready(carry.f)
+    it0 = int(carry.n_iter)
+
+    t0 = time.perf_counter()
+    carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+    jax.block_until_ready(carry.f)
+    dt = time.perf_counter() - t0
+    iters = int(carry.n_iter) - it0
+    print(json.dumps({
+        "metric": "selection_ab",
+        "select_impl": "packed" if packed else "argminmax",
+        "value": round(iters / dt, 1) if dt > 0 else 0.0,
+        "unit": "iter/s",
+        "iters": iters,
+        "precision": precision.upper(),
+        "shape": [n, d],
+    }), flush=True)
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import require_devices
+
+    dev = require_devices()[0]
+    print(f"# device: {dev}", file=sys.stderr)
+    n = int(os.environ.get("BENCH_N", 60_000))
+    d = int(os.environ.get("BENCH_D", 784))
+    measure_iters = int(os.environ.get("BENCH_MEASURE_ITERS", 3000))
+    precision = os.environ.get("BENCH_PRECISION", "DEFAULT")
+    for packed in (False, True):
+        measure(packed, n, d, measure_iters, precision)
+
+
+if __name__ == "__main__":
+    main()

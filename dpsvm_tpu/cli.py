@@ -77,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="working-set rule: 'first-order' = reference "
                          "parity; 'second-order' = LIBSVM WSS2 (usually "
                          "far fewer iterations)")
+    tr.add_argument("--select-impl", default="argminmax",
+                    choices=["argminmax", "packed"],
+                    help="first-order selection lowering: 'packed' = one "
+                         "4-operand lax.reduce (bit-identical results; "
+                         "see benchmarks/selection_ab.py)")
     tr.add_argument("--pallas", default="auto",
                     choices=["auto", "on", "off"],
                     help="fused Pallas iteration kernel: 'on' forces it; "
@@ -182,6 +187,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         matmul_precision=args.precision,
         use_pallas=args.pallas,
         selection=args.selection,
+        select_impl=args.select_impl,
         weight_pos=args.weight_pos,
         weight_neg=args.weight_neg,
     )

@@ -54,6 +54,14 @@ class SVMConfig:
                                         # 476-481) or "second-order" (the
                                         # LIBSVM WSS2 rule — usually far
                                         # fewer iterations to convergence)
+    select_impl: str = "argminmax"      # first-order selection lowering:
+                                        # "argminmax" (two jnp.arg* +
+                                        # gathers, XLA fuses) or "packed"
+                                        # (one 4-operand lax.reduce, the
+                                        # reference's my_maxmin shape —
+                                        # bit-identical; relative speed is
+                                        # measured by benchmarks/
+                                        # selection_ab.py)
 
     # --- execution ---
     backend: str = "xla"                # "xla" (compiled) or "numpy" (the
@@ -142,6 +150,9 @@ class SVMConfig:
         if self.selection not in ("first-order", "second-order"):
             raise ValueError(f"selection must be 'first-order' or "
                              f"'second-order', got {self.selection!r}")
+        if self.select_impl not in ("argminmax", "packed"):
+            raise ValueError(f"select_impl must be 'argminmax' or "
+                             f"'packed', got {self.select_impl!r}")
         if self.selection == "second-order":
             if self.cache_size > 0:
                 raise ValueError("second-order selection needs the hi row "
@@ -150,6 +161,10 @@ class SVMConfig:
             if self.use_pallas == "on":
                 raise ValueError("the fused Pallas kernel implements "
                                  "first-order selection only")
+            if self.select_impl != "argminmax":
+                raise ValueError("select_impl applies to first-order "
+                                 "selection only (WSS2's argmax-over-"
+                                 "objective has no packed lowering)")
         if self.use_pallas not in ("auto", "on", "off"):
             raise ValueError(f"use_pallas must be 'auto', 'on' or 'off', "
                              f"got {self.use_pallas!r}")

@@ -73,3 +73,39 @@ def masked_extrema(alpha: jax.Array, y: jax.Array, f: jax.Array, c,
     i_hi = jnp.argmin(f_up)
     i_lo = jnp.argmax(f_low)
     return i_hi, f_up[i_hi], i_lo, f_low[i_lo]
+
+
+def masked_extrema_packed(alpha: jax.Array, y: jax.Array, f: jax.Array, c,
+                          valid: Optional[jax.Array] = None):
+    """Same contract as ``masked_extrema`` via ONE variadic lax.reduce.
+
+    The reference fuses I-set classification and the joint (argmin,
+    argmax) into a single Thrust reduce pass (``my_maxmin``,
+    ``svmTrain.cu:400-467,476``). The default implementation leaves the
+    fusion of its two argmin/argmax reductions + two gathers to XLA;
+    this variant expresses the whole selection as one 4-operand
+    ``lax.reduce`` carrying (f_up, idx, f_low, idx) with explicit
+    first-index tie-breaks — the SURVEY §7(b) packed value-index
+    reduction. Bit-identical results; which lowers faster is measured by
+    benchmarks/selection_ab.py, not assumed.
+    """
+    f_up, f_low = masked_scores(alpha, y, f, c, valid)
+    n = f.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    def comp(acc, val):
+        au, ai, al, aj = acc
+        bu, bi, bl, bj = val
+        # strict-compare + lower-index wins, matching jnp.argmin/argmax's
+        # first-occurrence rule whatever order XLA reduces in
+        up_b = (bu < au) | ((bu == au) & (bi < ai))
+        lo_b = (bl > al) | ((bl == al) & (bj < aj))
+        return (jnp.where(up_b, bu, au), jnp.where(up_b, bi, ai),
+                jnp.where(lo_b, bl, al), jnp.where(lo_b, bj, aj))
+
+    b_hi, i_hi, b_lo, i_lo = jax.lax.reduce(
+        (f_up, idx, f_low, idx),
+        (jnp.float32(SENTINEL), jnp.int32(jnp.iinfo(jnp.int32).max),
+         jnp.float32(-SENTINEL), jnp.int32(jnp.iinfo(jnp.int32).max)),
+        comp, (0,))
+    return i_hi, b_hi, i_lo, b_lo

@@ -44,7 +44,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
-from dpsvm_tpu.ops.selection import (masked_extrema,
+from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
@@ -215,7 +215,8 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
 def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
                c: float, gamma: float, n_per_shard: int, shard_x: bool,
                precision, weights=(1.0, 1.0),
-               use_cache: bool = False) -> DistCarry:
+               use_cache: bool = False,
+               packed_select: bool = False) -> DistCarry:
     """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
     slices when shard_x else full replicated arrays."""
     alpha_s, f_s = carry.alpha, carry.f
@@ -223,8 +224,8 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     c_box, c_of_y = _weighted_box(c, weights, ys)
 
     # --- local working-set extrema (CS-2) ---
-    li_hi, lb_hi, li_lo, lb_lo = masked_extrema(alpha_s, ys, f_s, c_box,
-                                                valid)
+    select = masked_extrema_packed if packed_select else masked_extrema
+    li_hi, lb_hi, li_lo, lb_lo = select(alpha_s, ys, f_s, c_box, valid)
     gi_hi = li_hi.astype(jnp.int32) + rank * n_per_shard
     gi_lo = li_lo.astype(jnp.int32) + rank * n_per_shard
 
@@ -334,7 +335,8 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
 def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
                        epsilon: float, n_per_shard: int, shard_x: bool,
                        precision_name: str, second_order: bool = False,
-                       weights=(1.0, 1.0), use_cache: bool = False):
+                       weights=(1.0, 1.0), use_cache: bool = False,
+                       packed_select: bool = False):
     precision = getattr(lax.Precision, precision_name)
     x_spec = P(SHARD_AXIS) if shard_x else P()
     if second_order:
@@ -342,7 +344,7 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
         extra = {}
     else:
         step = _dist_step
-        extra = {"use_cache": use_cache}
+        extra = {"use_cache": use_cache, "packed_select": packed_select}
 
     def run(carry: DistCarry, xs, ys, x2s, valid, limit):
         def cond(s: DistCarry):
@@ -439,7 +441,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                 config.selection == "second-order",
                                 (float(config.weight_pos),
                                  float(config.weight_neg)),
-                                use_cache=lines > 0)
+                                use_cache=lines > 0,
+                                packed_select=config.select_impl == "packed")
 
     def step_chunk(c, lim):
         limit = jax.device_put(jnp.int32(lim), repl)

@@ -32,7 +32,8 @@ from jax import lax
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
-from dpsvm_tpu.ops.selection import masked_extrema, masked_scores_and_masks
+from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
+                                     masked_scores_and_masks)
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
 
@@ -62,7 +63,8 @@ def init_carry(y: jax.Array, cache_lines: int) -> SMOCarry:
 def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              c: float, gamma: float, *, use_cache: bool = False,
              second_order: bool = False, weights=(1.0, 1.0),
-             precision=lax.Precision.HIGHEST) -> SMOCarry:
+             precision=lax.Precision.HIGHEST,
+             packed_select: bool = False) -> SMOCarry:
     """One modified-SMO iteration (select -> eta -> alpha -> f).
 
     ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
@@ -105,7 +107,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         b_lo_sel = f_low[i_lo]                      # alpha step uses the
         cache = carry.cache                         # SELECTED violator
     else:
-        i_hi, b_hi, i_lo, b_lo = masked_extrema(alpha, y, f, c_box)
+        select = masked_extrema_packed if packed_select else masked_extrema
+        i_hi, b_hi, i_lo, b_lo = select(alpha, y, f, c_box)
         b_lo_sel = b_lo
 
         cache = carry.cache
@@ -150,7 +153,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 def _build_chunk_runner(c: float, gamma: float, epsilon: float,
                         use_cache: bool, precision_name: str,
                         second_order: bool = False,
-                        weights=(1.0, 1.0)):
+                        weights=(1.0, 1.0),
+                        packed_select: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit."""
@@ -166,7 +170,8 @@ def _build_chunk_runner(c: float, gamma: float, epsilon: float,
                                use_cache=use_cache,
                                second_order=second_order,
                                weights=weights,
-                               precision=precision),
+                               precision=precision,
+                               packed_select=packed_select),
             carry)
 
     return jax.jit(run, donate_argnums=(0,))
@@ -199,7 +204,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                  config.matmul_precision.upper(),
                                  config.selection == "second-order",
                                  (float(config.weight_pos),
-                                  float(config.weight_neg)))
+                                  float(config.weight_neg)),
+                                 config.select_impl == "packed")
 
     return host_training_loop(
         config, gamma, n, d, carry,

@@ -1,0 +1,84 @@
+"""Packed single-reduce working-set selection: bit parity with argminmax.
+
+``masked_extrema_packed`` expresses the reference's fused my_maxmin
+reduce (svmTrain.cu:400-467) as one variadic lax.reduce; it must return
+exactly what the two-argmin/argmax form returns — including ties (lowest
+index wins) and the padding mask — and full training runs must be
+bitwise identical under either lowering, single-device and distributed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.selection import masked_extrema, masked_extrema_packed
+from dpsvm_tpu.solver.smo import train_single_device
+
+
+def _random_state(rng, n, c):
+    # alpha in {0, C, interior}, f arbitrary incl. repeated values
+    kind = rng.integers(0, 3, n)
+    alpha = np.where(kind == 0, 0.0,
+                     np.where(kind == 1, c,
+                              rng.uniform(0.01, c - 0.01, n)))
+    y = np.where(rng.random(n) < 0.5, 1.0, -1.0).astype(np.float32)
+    f = rng.choice(np.linspace(-3, 3, 13), size=n).astype(np.float32)
+    return alpha.astype(np.float32), y, f
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_packed_matches_argminmax_randomized(seed):
+    rng = np.random.default_rng(seed)
+    n, c = 257, 2.0
+    alpha, y, f = _random_state(rng, n, c)
+    valid = (np.arange(n) < n - rng.integers(0, 9)).astype(bool)
+    i_hi_a, b_hi_a, i_lo_a, b_lo_a = masked_extrema(alpha, y, f, c, valid)
+    i_hi_b, b_hi_b, i_lo_b, b_lo_b = masked_extrema_packed(
+        alpha, y, f, c, valid)
+    assert int(i_hi_b) == int(i_hi_a)
+    assert int(i_lo_b) == int(i_lo_a)
+    assert float(b_hi_b) == float(b_hi_a)     # exact: same f32 values
+    assert float(b_lo_b) == float(b_lo_a)
+
+
+def test_packed_tie_break_lowest_index():
+    n = 16
+    alpha = np.zeros(n, np.float32)
+    y = np.ones(n, np.float32)          # everyone in I_up only
+    f = np.zeros(n, np.float32)         # all tied
+    i_hi, b_hi, _, _ = masked_extrema_packed(alpha, y, f, 1.0)
+    assert int(i_hi) == 0
+    # flip labels: everyone in I_low only, again all tied
+    i, b, i_lo, b_lo = masked_extrema_packed(alpha, -y, f, 1.0)
+    assert int(i_lo) == 0
+
+
+def test_training_bitwise_identical_under_packed(blobs_small):
+    x, y = blobs_small
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+    r1 = train_single_device(x, y, SVMConfig(**base))
+    r2 = train_single_device(x, y, SVMConfig(select_impl="packed", **base))
+    assert r2.n_iter == r1.n_iter
+    np.testing.assert_array_equal(np.asarray(r2.alpha),
+                                  np.asarray(r1.alpha))
+    assert r2.b == r1.b
+
+
+def test_distributed_bitwise_identical_under_packed(blobs_small):
+    from dpsvm_tpu.parallel.dist_smo import train_distributed
+
+    x, y = blobs_small
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                shards=4, chunk_iters=128)
+    r1 = train_distributed(x, y, SVMConfig(**base))
+    r2 = train_distributed(x, y, SVMConfig(select_impl="packed", **base))
+    assert r2.n_iter == r1.n_iter
+    np.testing.assert_array_equal(np.asarray(r2.alpha),
+                                  np.asarray(r1.alpha))
+
+
+def test_packed_rejected_for_second_order():
+    with pytest.raises(ValueError, match="first-order"):
+        SVMConfig(selection="second-order", select_impl="packed").validate()

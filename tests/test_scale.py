@@ -1,0 +1,62 @@
+"""Scale proof: the covtype-shaped job actually executes at n=500,000.
+
+The reference's biggest benchmark is covtype (500000 x 54, C=2048,
+gamma=0.03125, 3M-iteration budget — /root/reference/Makefile:77). The
+``shard_x=True`` layout claims to remove the reference's O(n*d)
+per-device replication ceiling (every MPI rank held the full dataset,
+svmTrainMain.cpp:180); this test proves the claim structurally — each
+device holds exactly a (n/P, d) slice — and runs the real distributed
+solver at the full n=500k on the 8-device mesh (a bounded iteration
+budget: completion evidence, not convergence, which needs the real
+chip's throughput).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_mnist_like
+from dpsvm_tpu.parallel.dist_smo import train_distributed
+from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+
+COVTYPE_N, COVTYPE_D = 500_000, 54
+
+
+def test_shard_x_layout_holds_slice_not_replica():
+    """Structural memory claim: under shard_x the per-device X block is
+    (n/P, d) — 1/P of the reference's per-rank footprint."""
+    mesh = make_data_mesh(8)
+    x = np.zeros((COVTYPE_N, COVTYPE_D), np.float32)
+    xd = jax.device_put(jnp.asarray(x),
+                        NamedSharding(mesh, P(SHARD_AXIS)))
+    shapes = {s.data.shape for s in xd.addressable_shards}
+    assert shapes == {(COVTYPE_N // 8, COVTYPE_D)}
+    # Replicated layout (the reference's) holds the full array per device.
+    xr = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P()))
+    assert {s.data.shape for s in xr.addressable_shards} == {
+        (COVTYPE_N, COVTYPE_D)}
+
+
+@pytest.mark.slow
+def test_covtype_scale_distributed_runs():
+    x, y = make_mnist_like(n=COVTYPE_N, d=COVTYPE_D, seed=0)
+    cfg = SVMConfig(c=2048.0, gamma=0.03125, epsilon=1e-3, max_iter=512,
+                    shards=8, shard_x=True, chunk_iters=256)
+    res = train_distributed(x, y, cfg)
+    # A 512-iteration budget cannot converge covtype-scale data; the
+    # point is that the full-n program compiles, runs, and maintains a
+    # sane optimality state.
+    assert res.n_iter == 512
+    assert not res.converged
+    assert np.isfinite(res.gap)
+    assert res.gap > 0
+    alpha = np.asarray(res.alpha)
+    assert alpha.shape == (COVTYPE_N,)
+    assert np.all(alpha >= 0) and np.all(alpha <= cfg.c)
+    assert np.count_nonzero(alpha) > 0        # the solver is making moves
