@@ -64,7 +64,17 @@ def main() -> None:
     timer = PhaseTimer()
 
     with timer.phase("data"):
-        x, y = make_mnist_like(n=n, d=d, seed=0)
+        data = os.environ.get("BENCH_DATA")
+        if data:
+            # Measure on a real dataset when one is on disk (e.g. the
+            # output of `cli convert mnist-odd-even`); synthetic MNIST
+            # stand-in otherwise.
+            from dpsvm_tpu.data.loader import load_csv
+            x, y = load_csv(data, None, None)
+            n, d = x.shape
+            log(f"data: {data} ({n}x{d})")
+        else:
+            x, y = make_mnist_like(n=n, d=d, seed=0)
         xd = jnp.asarray(x)
         yd = jnp.asarray(y, jnp.float32)
         x2 = row_norms_sq(xd)

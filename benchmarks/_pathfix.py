@@ -1,0 +1,8 @@
+"""Make the repo root importable when a benchmark runs as
+``python benchmarks/<name>.py`` (the script's own directory — this one —
+is already on sys.path, the package's parent is not)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

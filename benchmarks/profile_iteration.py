@@ -1,0 +1,122 @@
+"""Per-phase timing of one SMO iteration at the benchmark shape.
+
+SURVEY §7 calls the iteration-latency chain the hard part: selection
+(masks + argmin/argmax + gathers), the (2, d) @ (d, n) kernel-row matmul
++ RBF epilogue, and the f-update AXPY. This harness times each phase as
+its own jitted scan over the same data, plus the full production
+iteration, so the gap between sum-of-phases and the full step exposes
+what fusion saves (or serialization costs). One JSON line per phase.
+
+Method: each phase runs inside lax.fori_loop with a data dependence
+threaded through (selection feeds indices, matmul feeds a row element,
+update feeds f) so XLA cannot dead-code or hoist it; timed over REPS
+iterations after a warmup, reported as microseconds per iteration.
+
+Usage:  python benchmarks/profile_iteration.py
+        env: BENCH_N/BENCH_D (default 60000 x 784),
+             BENCH_REPS (default 2000),
+             BENCH_PRECISION (DEFAULT | HIGHEST, default DEFAULT)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import require_devices
+
+    dev = require_devices()[0]
+    print(f"# device: {dev}", file=sys.stderr)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
+    from dpsvm_tpu.ops.selection import masked_extrema
+    from dpsvm_tpu.solver.smo import init_carry, smo_step
+
+    n = int(os.environ.get("BENCH_N", 60_000))
+    d = int(os.environ.get("BENCH_D", 784))
+    reps = int(os.environ.get("BENCH_REPS", 2000))
+    prec_name = os.environ.get("BENCH_PRECISION", "DEFAULT").upper()
+    precision = getattr(lax.Precision, prec_name)
+    c, gamma = 10.0, 0.25
+
+    x, y = make_mnist_like(n=n, d=d, seed=0)
+    xd = jnp.asarray(x)
+    yd = jnp.asarray(y, jnp.float32)
+    x2 = row_norms_sq(xd)
+    alpha = jnp.clip(jnp.abs(jnp.sin(jnp.arange(n) * 0.37)) * c, 0.0, c)
+    f = jnp.sin(jnp.arange(n) * 0.11).astype(jnp.float32)
+    jax.block_until_ready((xd, x2, alpha, f))
+
+    def timed(name, fn, *args):
+        out = fn(*args)                       # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "profile_phase",
+            "phase": name,
+            "value": round(dt / reps * 1e6, 2),
+            "unit": "us/iter",
+            "reps": reps,
+            "precision": prec_name,
+            "shape": [n, d],
+        }), flush=True)
+
+    @jax.jit
+    def loop_select(alpha, f):
+        def body(i, s):
+            a, ff = s
+            i_hi, b_hi, i_lo, b_lo = masked_extrema(a, yd, ff, c)
+            # thread a dependence so iterations serialize like the solver
+            return a, ff + (b_hi - b_lo) * 1e-20 * (i_hi != i_lo)
+        return lax.fori_loop(0, reps, body, (alpha, f))
+
+    @jax.jit
+    def loop_matmul(f):
+        def body(i, ff):
+            rows = jnp.stack([xd[i % n], xd[(i * 7) % n]])
+            dots = jnp.matmul(rows, xd.T, precision=precision)
+            w2 = jnp.stack([x2[i % n], x2[(i * 7) % n]])
+            k = rbf_rows_from_dots(dots, w2, x2, gamma)
+            return ff + k[0] * 1e-20
+        return lax.fori_loop(0, reps, body, f)
+
+    k_fixed = rbf_rows_from_dots(
+        jnp.matmul(jnp.stack([xd[0], xd[1]]), xd.T, precision=precision),
+        jnp.stack([x2[0], x2[1]]), x2, gamma)
+    jax.block_until_ready(k_fixed)
+
+    @jax.jit
+    def loop_update(f):
+        def body(i, ff):
+            da = ff[i % n] * 1e-20            # serializing dependence
+            return ff + da * k_fixed[0] + (da + 1e-20) * k_fixed[1]
+        return lax.fori_loop(0, reps, body, f)
+
+    @jax.jit
+    def loop_full(carry):
+        def body(i, s):
+            return smo_step(s, xd, yd, x2, c, gamma, precision=precision)
+        return lax.fori_loop(0, reps, body, carry)
+
+    timed("selection", loop_select, alpha, f)
+    timed("kernel_rows_matmul", loop_matmul, f)
+    timed("f_update_axpy", loop_update, f)
+    timed("full_iteration", loop_full, init_carry(yd, 0))
+
+
+if __name__ == "__main__":
+    main()

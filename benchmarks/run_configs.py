@@ -21,9 +21,7 @@ import os
 import sys
 import time
 
-# Runnable as `python benchmarks/<name>.py` from the repo root: the
-# package lives one directory up from this script.
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
 
 CONFIGS = {
     "adult":   dict(n=32_561, d=123, c=100.0, gamma=0.5, budget=150_000),

@@ -23,9 +23,7 @@ import os
 import sys
 import time
 
-# Runnable as `python benchmarks/<name>.py` from the repo root: the
-# package lives one directory up from this script.
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
 
 
 def measure(packed: bool, n: int, d: int, measure_iters: int,
@@ -51,6 +49,14 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
     carry = runner(carry, xd, yd, x2, jnp.int32(warm))
     jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
+    if it0 < warm:
+        # Tiny problems converge inside warmup: measure a fresh full run
+        # to convergence instead of a no-op window (same guard as
+        # bench.py).
+        print(f"# warning: converged during warmup ({it0} iters); "
+              "measuring a fresh run", file=sys.stderr)
+        carry = init_carry(yd, 0)
+        it0 = 0
 
     t0 = time.perf_counter()
     carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))

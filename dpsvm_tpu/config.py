@@ -153,6 +153,17 @@ class SVMConfig:
         if self.select_impl not in ("argminmax", "packed"):
             raise ValueError(f"select_impl must be 'argminmax' or "
                              f"'packed', got {self.select_impl!r}")
+        if self.select_impl != "argminmax":
+            # Reject every path that would silently ignore the flag, so
+            # an A/B run can't attribute default-lowering numbers to it.
+            if self.use_pallas == "on":
+                raise ValueError("the fused Pallas kernel has its own "
+                                 "in-kernel selection; select_impl does "
+                                 "not apply (use_pallas='on')")
+            if self.backend == "numpy":
+                raise ValueError("the numpy golden-reference backend has "
+                                 "no XLA lowerings; select_impl does not "
+                                 "apply")
         if self.selection == "second-order":
             if self.cache_size > 0:
                 raise ValueError("second-order selection needs the hi row "

@@ -28,8 +28,12 @@ def log_progress(config, n_iter: int, b_lo: float, b_hi: float,
     if not final and n_iter % every and n_iter < config.max_iter:
         return
     gap = b_lo - b_hi
+    # Will the logging hierarchy actually EMIT this INFO record? Not just
+    # "does a handler exist": a root handler at the default WARNING level
+    # swallows it, and --verbose must never silently produce nothing.
+    emitted = _logger.isEnabledFor(logging.INFO) and _logger.hasHandlers()
     _logger.info("iter=%d gap=%.6g (b_lo=%.6g b_hi=%.6g, converged at %.3g)",
                  n_iter, gap, b_lo, b_hi, 2 * config.epsilon)
-    if config.verbose and not _logger.handlers:
+    if config.verbose and not emitted:
         print(f"[dpsvm] iter={n_iter} gap={gap:.6g} "
               f"target={2 * config.epsilon:.3g}")
