@@ -28,11 +28,13 @@ Public API
 ``SVMModel``                       trained model pytree + decision function
 ``load_model`` / ``save_model``    reference-compatible model file I/O
 ``predict`` / ``evaluate``         batched XLA inference
+``DPSVMClassifier``                sklearn-protocol estimator facade
 """
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
+from dpsvm_tpu.models.estimator import DPSVMClassifier
 from dpsvm_tpu.api import train, fit
 
 __version__ = "0.1.0"
@@ -48,4 +50,5 @@ __all__ = [
     "evaluate",
     "save_model",
     "load_model",
+    "DPSVMClassifier",
 ]

@@ -1,0 +1,158 @@
+"""scikit-learn-style estimator facade: SVC-shaped fit/predict/score.
+
+The reference is driven only through its CLI binaries; this framework is
+library-first, and the natural Python idiom for an SVM trainer is the
+sklearn estimator protocol — so `DPSVMClassifier` adapts `api.fit` to
+it (duck-typed: no sklearn import or dependency; it simply follows the
+fit/predict/score conventions, get_params/set_params included, so it
+drops into sklearn pipelines and CV utilities when sklearn is present).
+
+Labels may be ANY two values (sklearn-style), not just +/-1: classes_
+is the sorted unique pair, mapped internally onto the solver's -1/+1.
+More than two classes dispatches to the one-vs-one trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+
+try:
+    # Optional: inheriting sklearn's mixins provides the estimator-tag
+    # protocol its meta-utilities (clone, cross_val_score, pipelines)
+    # check for. Everything else here is self-contained, so without
+    # sklearn the class is a plain object with the same duck-typed API.
+    from sklearn.base import BaseEstimator as _SkBase
+    from sklearn.base import ClassifierMixin as _SkClassifier
+    _BASES = (_SkClassifier, _SkBase)
+except ImportError:                                   # pragma: no cover
+    _BASES = (object,)
+
+
+class DPSVMClassifier(*_BASES):
+    """RBF-SVM classifier on the modified-SMO TPU solver.
+
+    Parameters mirror ``sklearn.svm.SVC`` where they overlap (C, gamma,
+    tol, max_iter) plus this framework's execution knobs. ``gamma=None``
+    means 1/n_features (the reference's intended default, SURVEY §2d).
+    """
+
+    def __init__(self, C: float = 1.0, gamma: Optional[float] = None,
+                 tol: float = 1e-3, max_iter: int = 150_000,
+                 selection: str = "first-order", shards: int = 1,
+                 matmul_precision: str = "highest",
+                 probability: bool = False):
+        self.C = C
+        self.gamma = gamma
+        self.tol = tol
+        self.max_iter = max_iter
+        self.selection = selection
+        self.shards = shards
+        self.matmul_precision = matmul_precision
+        self.probability = probability
+
+    # --- sklearn protocol: params ---
+
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in (
+            "C", "gamma", "tol", "max_iter", "selection", "shards",
+            "matmul_precision", "probability")}
+
+    def set_params(self, **params) -> "DPSVMClassifier":
+        for k, v in params.items():
+            if k not in self.get_params():
+                raise ValueError(f"invalid parameter {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def _config(self) -> SVMConfig:
+        return SVMConfig(c=self.C, gamma=self.gamma, epsilon=self.tol,
+                         max_iter=self.max_iter, selection=self.selection,
+                         shards=self.shards,
+                         matmul_precision=self.matmul_precision)
+
+    # --- sklearn protocol: fit/predict/score ---
+
+    def fit(self, X, y) -> "DPSVMClassifier":
+        """Train; fitted state is assigned only after training succeeds,
+        so a failed refit leaves the previous fit fully intact (and every
+        optional attribute — _platt, intercept_, n_support_ — is reset,
+        never stale from an earlier fit with different params)."""
+        from dpsvm_tpu.api import fit as _fit
+
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        classes = np.unique(y)
+        if len(classes) < 2:
+            raise ValueError(f"need at least 2 classes, got {classes}")
+        state: Dict[str, Any] = {
+            "classes_": classes, "_model": None, "_multi": None,
+            "_platt": None, "intercept_": None, "n_support_": None,
+        }
+        if len(classes) == 2:
+            ypm = np.where(y == classes[1], 1, -1).astype(np.int32)
+            model, result = _fit(X, ypm, self._config())
+            state.update(
+                _model=model,
+                n_iter_=result.n_iter,
+                converged_=result.converged,
+                intercept_=np.array([-result.b]),
+                n_support_=np.array([int(np.sum(model.y_sv < 0)),
+                                     int(np.sum(model.y_sv > 0))]))
+            if self.probability:
+                from dpsvm_tpu.models.calibration import fit_platt
+                from dpsvm_tpu.models.svm import decision_function
+                dec = np.asarray(decision_function(model, X))
+                state["_platt"] = fit_platt(dec, ypm)
+        else:
+            from dpsvm_tpu.models.multiclass import train_multiclass
+            if self.probability:
+                raise ValueError("probability=True is binary-only "
+                                 "(one-vs-one voting has no calibrated "
+                                 "decision value)")
+            multi, results = train_multiclass(X, y, self._config())
+            state.update(
+                _multi=multi,
+                n_iter_=int(sum(r.n_iter for r in results)),
+                converged_=all(r.converged for r in results))
+        for k, v in state.items():
+            setattr(self, k, v)
+        return self
+
+    def _check_fitted(self) -> None:
+        if not hasattr(self, "classes_"):
+            raise RuntimeError("this DPSVMClassifier is not fitted yet; "
+                               "call fit(X, y) first")
+
+    def decision_function(self, X) -> np.ndarray:
+        self._check_fitted()
+        if self._model is None:
+            raise ValueError("decision_function is binary-only; use "
+                             "predict for multiclass models")
+        from dpsvm_tpu.models.svm import decision_function as _dec
+        return np.asarray(_dec(self._model, np.asarray(X, np.float32)))
+
+    def predict(self, X) -> np.ndarray:
+        self._check_fitted()
+        X = np.asarray(X, np.float32)
+        if self._model is not None:
+            dec = self.decision_function(X)
+            return np.where(dec < 0, self.classes_[0], self.classes_[1])
+        from dpsvm_tpu.models.multiclass import predict_multiclass
+        return predict_multiclass(self._multi, X)
+
+    def predict_proba(self, X) -> np.ndarray:
+        """(n, 2) [P(class0), P(class1)]; needs probability=True."""
+        self._check_fitted()
+        if getattr(self, "_platt", None) is None:
+            raise RuntimeError("fit with probability=True to enable "
+                               "predict_proba")
+        from dpsvm_tpu.models.calibration import sigmoid_proba
+        p1 = sigmoid_proba(self.decision_function(X), *self._platt)
+        return np.stack([1.0 - p1, p1], axis=1)
+
+    def score(self, X, y) -> float:
+        return float(np.mean(self.predict(X) == np.asarray(y)))

@@ -1,0 +1,110 @@
+"""DPSVMClassifier: the sklearn-protocol facade over api.fit.
+
+Covers binary fit/predict/score with arbitrary label values, the
+decision-function sign convention, predict_proba under probability=True,
+multiclass dispatch, params round-trip, and (when sklearn is installed)
+actual interop: cross_val_score and clone() accept the estimator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor
+from dpsvm_tpu.models.estimator import DPSVMClassifier
+
+
+def test_binary_fit_predict_score_arbitrary_labels():
+    x, y = make_blobs(n=200, d=4, seed=0)
+    y01 = np.where(y > 0, 7, 3)               # labels need not be +/-1
+    clf = DPSVMClassifier(C=1.0, gamma=0.5).fit(x, y01)
+    assert set(clf.classes_) == {3, 7}
+    assert clf.converged_
+    pred = clf.predict(x)
+    assert set(np.unique(pred)) <= {3, 7}
+    assert clf.score(x, y01) > 0.97
+    assert clf.n_support_.sum() > 0
+    # decision_function sign maps to classes_[1] (the larger label)
+    dec = clf.decision_function(x)
+    np.testing.assert_array_equal(pred, np.where(dec < 0, 3, 7))
+
+
+def test_predict_proba_requires_probability_flag():
+    x, y = make_blobs(n=120, d=3, seed=1)
+    clf = DPSVMClassifier().fit(x, y)
+    with pytest.raises(RuntimeError, match="probability=True"):
+        clf.predict_proba(x)
+
+
+def test_predict_proba_rows_sum_to_one():
+    x, y = make_blobs(n=150, d=3, seed=2)
+    clf = DPSVMClassifier(probability=True).fit(x, y)
+    p = clf.predict_proba(x)
+    assert p.shape == (150, 2)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-12)
+    # column 1 is P(classes_[1] = +1 here); should track the labels
+    assert float(np.mean((p[:, 1] > 0.5) == (y > 0))) > 0.9
+
+
+def test_multiclass_dispatch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(90, 3)).astype(np.float32)
+    y = rng.integers(0, 3, size=90)
+    x += 1.5 * y[:, None].astype(np.float32)
+    clf = DPSVMClassifier(C=1.0, gamma=0.5).fit(x, y)
+    assert len(clf.classes_) == 3
+    assert clf.score(x, y) > 0.9
+    with pytest.raises(ValueError, match="binary-only"):
+        clf.decision_function(x)
+
+
+def test_unfitted_raises():
+    with pytest.raises(RuntimeError, match="not fitted"):
+        DPSVMClassifier().predict(np.zeros((2, 2), np.float32))
+
+
+def test_params_roundtrip():
+    clf = DPSVMClassifier(C=5.0, gamma=0.1)
+    params = clf.get_params()
+    assert params["C"] == 5.0
+    clf.set_params(C=2.0, selection="second-order")
+    assert clf.C == 2.0 and clf.selection == "second-order"
+    with pytest.raises(ValueError, match="invalid parameter"):
+        clf.set_params(nope=1)
+
+
+def test_sklearn_interop_clone_and_cv():
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.base import clone
+    from sklearn.model_selection import cross_val_score
+
+    x, y = make_xor(n=200, seed=3)
+    clf = DPSVMClassifier(C=10.0, gamma=1.0)
+    c2 = clone(clf)                        # needs get_params/set_params
+    assert c2.get_params() == clf.get_params()
+    scores = cross_val_score(clf, x, y, cv=3)
+    assert scores.mean() > 0.9
+
+
+def test_failed_refit_preserves_previous_fit():
+    x1, y1 = make_blobs(n=100, d=3, seed=4)
+    y17 = np.where(y1 > 0, 7, 3)
+    clf = DPSVMClassifier(probability=True).fit(x1, y17)
+    p_before = clf.predict_proba(x1)
+    # invalid refit: training must fail BEFORE any state changes
+    clf.set_params(C=-1.0)
+    with pytest.raises(ValueError):
+        clf.fit(x1, np.where(y1 > 0, 1, 0))
+    assert set(clf.classes_) == {3, 7}          # old fit intact
+    np.testing.assert_array_equal(clf.predict_proba(x1), p_before)
+
+
+def test_refit_without_probability_clears_calibration():
+    x, y = make_blobs(n=100, d=3, seed=5)
+    clf = DPSVMClassifier(probability=True).fit(x, y)
+    clf.predict_proba(x)                        # works
+    clf.set_params(probability=False)
+    clf.fit(x, y)
+    with pytest.raises(RuntimeError, match="probability=True"):
+        clf.predict_proba(x)
