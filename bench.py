@@ -72,8 +72,8 @@ def main() -> None:
             # Measure on a real dataset when one is on disk (e.g. the
             # output of `cli convert mnist-odd-even`); synthetic MNIST
             # stand-in otherwise.
-            from dpsvm_tpu.data.loader import load_csv
-            x, y = load_csv(data, None, None)
+            from dpsvm_tpu.data.loader import load_dataset
+            x, y = load_dataset(data, None, None)
             n, d = x.shape
             log(f"data: {data} ({n}x{d})")
         else:

@@ -61,8 +61,8 @@ def main() -> None:
 
     data = os.environ.get("BENCH_DATA")
     if data:
-        from dpsvm_tpu.data.loader import load_csv
-        x, y = load_csv(data, None, None)
+        from dpsvm_tpu.data.loader import load_dataset
+        x, y = load_dataset(data, None, None)
         log(f"data: {data} ({x.shape[0]}x{x.shape[1]})")
     else:
         from dpsvm_tpu.data.synthetic import make_mnist_like

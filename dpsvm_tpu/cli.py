@@ -24,7 +24,8 @@ from typing import List, Optional
 
 
 def _add_data_flags(p: argparse.ArgumentParser) -> None:
-    p.add_argument("-f", "--input", required=True, help="dense CSV dataset")
+    p.add_argument("-f", "--input", required=True, help="dataset: dense CSV 'label,f1,...' or libsvm "
+                        "sparse 'label idx:val ...' (format sniffed)")
     p.add_argument("-m", "--model", required=True, help="model file path")
     p.add_argument("-a", "--num-att", type=int, default=None,
                    help="attribute count (inferred when omitted)")
@@ -135,7 +136,7 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     from dpsvm_tpu.api import fit
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.data.loader import load_dataset
     from dpsvm_tpu.models.io import save_model
     from dpsvm_tpu.models.svm import evaluate
 
@@ -172,7 +173,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "supported", file=sys.stderr)
             return 2
 
-    x, y = load_csv(args.input, args.num_ex, args.num_att)
+    x, y = load_dataset(args.input, args.num_ex, args.num_att)
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
         max_iter=args.max_iter, cache_size=args.cache_size,
@@ -253,8 +254,17 @@ def cmd_test(args: argparse.Namespace) -> int:
 
     import numpy as np
 
-    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.data.loader import load_dataset, sniff_format
     from dpsvm_tpu.models.io import load_model
+
+    def _width_hint(d_model):
+        # libsvm files have no explicit width: a test split whose max
+        # feature index is below the model's width (a9a.t is 122 vs
+        # 123) must be loaded AT the model's width. CSV files carry
+        # their width; leave them alone so mismatches surface below.
+        if args.num_att is None and sniff_format(args.input) == "libsvm":
+            return d_model
+        return args.num_att
 
     if os.path.isdir(args.model):
         from dpsvm_tpu.models.multiclass import load_multiclass
@@ -264,8 +274,8 @@ def cmd_test(args: argparse.Namespace) -> int:
                   "sidecar", file=sys.stderr)
             return 2
         mc = load_multiclass(args.model)
-        x, y = load_csv(args.input, args.num_ex, args.num_att)
         d_model = mc.models[0].num_attributes
+        x, y = load_dataset(args.input, args.num_ex, _width_hint(d_model))
         if x.shape[1] != d_model:
             print(f"error: dataset has {x.shape[1]} attributes, model has "
                   f"{d_model}", file=sys.stderr)
@@ -281,7 +291,8 @@ def cmd_test(args: argparse.Namespace) -> int:
         return 0
 
     model = load_model(args.model)
-    x, y = load_csv(args.input, args.num_ex, args.num_att)
+    x, y = load_dataset(args.input, args.num_ex,
+                        _width_hint(model.num_attributes))
     if x.shape[1] != model.num_attributes:
         print(f"error: dataset has {x.shape[1]} attributes, model has "
               f"{model.num_attributes}", file=sys.stderr)

@@ -1,6 +1,8 @@
-"""Data loading: dense CSV datasets, synthetic fixtures, format converters."""
+"""Data loading: dense CSV / libsvm datasets, synthetic fixtures, converters."""
 
-from dpsvm_tpu.data.loader import load_csv, csv_shape
+from dpsvm_tpu.data.loader import (load_csv, load_libsvm, load_dataset,
+                                   sniff_format, csv_shape)
 from dpsvm_tpu.data.synthetic import make_blobs, make_xor, make_mnist_like
 
-__all__ = ["load_csv", "csv_shape", "make_blobs", "make_xor", "make_mnist_like"]
+__all__ = ["load_csv", "load_libsvm", "load_dataset", "sniff_format",
+           "csv_shape", "make_blobs", "make_xor", "make_mnist_like"]

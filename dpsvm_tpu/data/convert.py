@@ -20,29 +20,21 @@ def libsvm_to_dense_csv(src: str, dst: str,
     """Convert a libsvm sparse file to dense CSV. Returns rows written.
 
     When num_attributes is None it is inferred as the max feature index
-    seen in the file (the adult/a9a converter hard-codes 123).
+    seen in the file (the adult/a9a converter hard-codes 123). Labels
+    are normalized to +/-1 by sign, exactly like the reference script
+    (``convert_adult.py:23``); loading without that normalization is
+    what ``loader.load_libsvm`` (the shared parser used here) is for.
     """
-    rows = []
-    max_idx = 0
-    with open(src) as f:
-        for line in f:
-            parts = line.split()
-            if not parts:
-                continue
-            label = 1 if float(parts[0]) > 0 else -1
-            feats = {}
-            for tok in parts[1:]:
-                idx_s, val_s = tok.split(":")
-                idx = int(idx_s)
-                feats[idx] = float(val_s)
-                max_idx = max(max_idx, idx)
-            rows.append((label, feats))
-    d = num_attributes if num_attributes is not None else max_idx
+    import numpy as np
+
+    from dpsvm_tpu.data.loader import load_libsvm
+
+    x, y = load_libsvm(src, num_attributes=num_attributes)
+    y = np.where(y > 0, 1, -1)
     with open(dst, "w") as out:
-        for label, feats in rows:
-            dense = (repr(feats.get(j, 0.0)) for j in range(1, d + 1))
-            out.write(f"{label}," + ",".join(dense) + "\n")
-    return len(rows)
+        for label, row in zip(y, x):
+            out.write(f"{int(label)}," + ",".join(map(str, row)) + "\n")
+    return len(y)
 
 
 def mnist_to_odd_even_csv(src: str, dst: str, scale: float = 255.0,

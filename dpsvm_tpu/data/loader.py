@@ -110,6 +110,119 @@ def load_csv(
     return _check_finite(xs, path), ys
 
 
+def load_libsvm(
+    path: str,
+    num_examples: Optional[int] = None,
+    num_attributes: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Load a libsvm/svmlight sparse file ``<label> idx:val ...`` directly.
+
+    The reference could only consume this format via an offline convert
+    step (``scripts/convert_adult.py``); here the train/test CLIs accept
+    it natively. Indices are 1-based; absent features are 0. Labels are
+    preserved as integers, exactly like the CSV loader — so multiclass
+    sets (labels 0..k) load faithfully and the binary trainer's own
+    +/-1 validation still applies; non-integer labels (regression-format
+    files) error loudly rather than being silently truncated. An explicit
+    ``num_attributes`` fixes the feature count: wider pads with zeros (a
+    test file whose max index is below the model's width loads at the
+    model's width), narrower silently drops higher-indexed features —
+    the same semantics as ``-a`` column narrowing on the CSV path and as
+    the reference's converter (``convert_adult.py:31`` keeps only
+    indices ≤ d). ``num_examples`` reads only that many rows and, like
+    ``load_csv``, errors if the file is shorter.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    labels = []
+    rows = []          # list of (idx_array, val_array), 1-based indices
+    max_idx = 0
+    with open(path, "r") as f:
+        for lineno, line in enumerate(f, 1):
+            if num_examples is not None and len(rows) >= num_examples:
+                break
+            parts = line.split()
+            if not parts or parts[0].startswith("#"):
+                continue
+            try:
+                lab_f = float(parts[0])
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: bad label {parts[0]!r}") from e
+            lab = int(lab_f)
+            if lab != lab_f:
+                raise ValueError(
+                    f"{path}:{lineno}: non-integer label {parts[0]!r} "
+                    "(classification labels must be integers)")
+            labels.append(lab)
+            idxs = np.empty(len(parts) - 1, dtype=np.int64)
+            vals = np.empty(len(parts) - 1, dtype=np.float32)
+            for k, tok in enumerate(parts[1:]):
+                try:
+                    idx_s, val_s = tok.split(":", 1)
+                    idxs[k] = int(idx_s)
+                    vals[k] = float(val_s)
+                except ValueError as e:
+                    raise ValueError(
+                        f"{path}:{lineno}: bad feature token {tok!r}") from e
+            if len(idxs) and idxs.min() < 1:
+                raise ValueError(
+                    f"{path}:{lineno}: feature indices are 1-based")
+            if len(idxs):
+                max_idx = max(max_idx, int(idxs.max()))
+            rows.append((idxs, vals))
+    n = len(rows)
+    if n == 0:
+        raise ValueError(f"empty dataset: {path!r}")
+    if num_examples is not None and n < num_examples:
+        raise ValueError(f"{path}: expected {num_examples} rows, found {n}")
+    d = num_attributes if num_attributes is not None else max_idx
+    if d <= 0:
+        raise ValueError(f"{path}: no features found")
+    x = np.zeros((n, d), dtype=np.float32)
+    for i, (idxs, vals) in enumerate(rows):
+        keep = idxs <= d
+        x[i, idxs[keep] - 1] = vals[keep]
+    return _check_finite(x, path), np.asarray(labels, dtype=np.int32)
+
+
+def sniff_format(path: str) -> str:
+    """Return "libsvm" or "csv" from the first non-empty data line.
+
+    A dense-CSV data line always contains commas (label plus at least
+    one feature); a libsvm line never does — it is whitespace-separated
+    ``idx:val`` tokens, possibly zero of them (a label-only line is a
+    legal all-zeros example).
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            return "csv" if "," in line else "libsvm"
+    return "csv"
+
+
+def load_dataset(
+    path: str,
+    num_examples: Optional[int] = None,
+    num_attributes: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Load a dataset in either supported format (sniffed per file).
+
+    Dense CSV ``label,f1,...,fd`` (the reference's format, parse.cpp:10)
+    or libsvm sparse ``label idx:val ...`` (the format the reference's
+    datasets ship in upstream). Returns (x float32 (n, d), y int32).
+    Both paths honor the reference's explicit ``-x``/``-a`` shape
+    overrides with identical semantics (short files error).
+    """
+    if sniff_format(path) == "libsvm":
+        return load_libsvm(path, num_examples, num_attributes)
+    return load_csv(path, num_examples, num_attributes)
+
+
 def _check_finite(x: np.ndarray, path: str) -> np.ndarray:
     """NaN/Inf features would silently poison f and never converge
     (the solver is exp/argmin-based); fail at load time instead."""

@@ -106,3 +106,158 @@ def test_loader_rejects_non_finite(tmp_path):
     p2.write_text("1,0.5,inf\n")
     with pytest.raises(ValueError, match="non-finite"):
         load_csv(str(p2))
+
+
+def test_load_libsvm_direct(tmp_path):
+    """Sparse libsvm files load natively — the reference needed an
+    offline convert step (scripts/convert_adult.py)."""
+    from dpsvm_tpu.data.loader import load_libsvm
+
+    p = tmp_path / "a.libsvm"
+    p.write_text("+1 1:0.5 3:1.0\n-1 2:0.25\n# comment\n\n+1 3:2.5\n")
+    x, y = load_libsvm(str(p))
+    assert x.shape == (3, 3) and x.dtype == np.float32
+    assert y.tolist() == [1, -1, 1]
+    assert x[0].tolist() == [0.5, 0.0, 1.0]
+    assert x[1].tolist() == [0.0, 0.25, 0.0]
+    assert x[2].tolist() == [0.0, 0.0, 2.5]
+
+    # explicit width pads; narrowing silently drops higher indices
+    # (same semantics as -a column narrowing on the CSV path and the
+    # reference converter's feats.get(j) for j <= d)
+    xw, _ = load_libsvm(str(p), num_attributes=5)
+    assert xw.shape == (3, 5) and xw[2, 2] == 2.5
+    xn, _ = load_libsvm(str(p), num_attributes=2)
+    assert xn.shape == (3, 2)
+    assert xn[0].tolist() == [0.5, 0.0]      # 3:1.0 dropped
+    assert xn[2].tolist() == [0.0, 0.0]      # 3:2.5 dropped
+
+
+def test_load_libsvm_rejects_fractional_labels(tmp_path):
+    from dpsvm_tpu.data.loader import load_libsvm
+
+    p = tmp_path / "r.libsvm"
+    p.write_text("0.7 1:1.0\n")
+    with pytest.raises(ValueError, match="non-integer label"):
+        load_libsvm(str(p))
+
+
+def test_cli_test_libsvm_width_hint(tmp_path, blobs_small):
+    """A libsvm test split whose max feature index is below the model
+    width (the a9a.t case) loads at the model's width."""
+    from dpsvm_tpu.cli import main
+
+    x, y = blobs_small
+    d = x.shape[1]
+    train = tmp_path / "t.libsvm"
+    with open(train, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j + 1}:{v}" for j, v in enumerate(xi))
+            f.write(f"{int(yi)} {feats}\n")
+    test = tmp_path / "t_test.libsvm"
+    with open(test, "w") as f:
+        for xi, yi in zip(x[:20], y[:20]):
+            # drop the last feature column entirely: max index = d-1
+            feats = " ".join(f"{j + 1}:{v}" for j, v in enumerate(xi[:-1]))
+            f.write(f"{int(yi)} {feats}\n")
+    model = tmp_path / "m.svm"
+    assert main(["train", "-f", str(train), "-m", str(model), "-c", "10",
+                 "-q"]) == 0
+    assert main(["test", "-f", str(test), "-m", str(model)]) == 0
+
+
+def test_load_libsvm_errors(tmp_path):
+    from dpsvm_tpu.data.loader import load_libsvm
+
+    bad_idx = tmp_path / "z.libsvm"
+    bad_idx.write_text("+1 0:1.0\n")
+    with pytest.raises(ValueError, match="1-based"):
+        load_libsvm(str(bad_idx))
+
+    bad_tok = tmp_path / "t.libsvm"
+    bad_tok.write_text("+1 1:x\n")
+    with pytest.raises(ValueError, match="bad feature token"):
+        load_libsvm(str(bad_tok))
+
+    short = tmp_path / "s.libsvm"
+    short.write_text("+1 1:1.0\n-1 1:2.0\n")
+    with pytest.raises(ValueError, match="expected 5 rows, found 2"):
+        load_libsvm(str(short), num_examples=5)
+
+
+def test_load_libsvm_preserves_int_labels(tmp_path):
+    """Arbitrary integer labels survive (multiclass parity with the CSV
+    loader); sign normalization belongs to the converter only."""
+    from dpsvm_tpu.data.loader import load_libsvm
+
+    p = tmp_path / "mc.libsvm"
+    p.write_text("0 1:1.0\n2 2:1.0\n7 1:0.5 2:0.5\n")
+    x, y = load_libsvm(str(p))
+    assert y.tolist() == [0, 2, 7]
+    assert x.shape == (3, 2)
+
+
+def test_sniff_label_only_first_line(tmp_path):
+    """A label-only first row (legal all-zeros libsvm example) must not
+    be misread as CSV."""
+    from dpsvm_tpu.data.loader import load_dataset, sniff_format
+
+    p = tmp_path / "z.libsvm"
+    p.write_text("+1\n-1 2:0.5\n")
+    assert sniff_format(str(p)) == "libsvm"
+    x, y = load_dataset(str(p))
+    assert x.shape == (2, 2)
+    assert x[0].tolist() == [0.0, 0.0] and x[1].tolist() == [0.0, 0.5]
+
+
+def test_load_dataset_sniffs_format(tmp_path, blobs_small):
+    from dpsvm_tpu.data.loader import load_dataset, sniff_format
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = blobs_small
+    csvp = tmp_path / "d.csv"
+    save_csv(str(csvp), x, y)
+    assert sniff_format(str(csvp)) == "csv"
+    xc, yc = load_dataset(str(csvp))
+    np.testing.assert_allclose(xc, x.astype(np.float32), rtol=1e-6)
+
+    svp = tmp_path / "d.libsvm"
+    svp.write_text("+1 1:1.0 2:2.0\n-1 1:3.0 2:4.0\n-1 2:1.5\n")
+    assert sniff_format(str(svp)) == "libsvm"
+    xs, ys = load_dataset(str(svp), num_examples=2)
+    assert xs.shape == (2, 2) and ys.tolist() == [1, -1]
+
+
+def test_cli_train_test_on_libsvm_input(tmp_path, blobs_small):
+    """End-to-end: the train/test CLIs consume libsvm files directly."""
+    from dpsvm_tpu.cli import main
+
+    x, y = blobs_small
+    p = tmp_path / "train.libsvm"
+    with open(p, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j + 1}:{v}" for j, v in enumerate(xi))
+            f.write(f"{int(yi)} {feats}\n")
+    model = tmp_path / "m.svm"
+    assert main(["train", "-f", str(p), "-m", str(model), "-c", "10",
+                 "-q"]) == 0
+    assert main(["test", "-f", str(p), "-m", str(model)]) == 0
+
+
+def test_cli_multiclass_on_libsvm_input(tmp_path):
+    """Multiclass training consumes libsvm labels faithfully (0..k)."""
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=120, d=4, seed=3)
+    lab = np.where(y > 0, 2, 0)            # classes {0, 2}
+    lab[::5] = 1                           # and a third class
+    p = tmp_path / "mc.libsvm"
+    with open(p, "w") as f:
+        for xi, li in zip(x, lab):
+            feats = " ".join(f"{j + 1}:{v}" for j, v in enumerate(xi))
+            f.write(f"{int(li)} {feats}\n")
+    mdir = tmp_path / "mc_model"
+    assert main(["train", "-f", str(p), "-m", str(mdir), "--multiclass",
+                 "-c", "10", "-q"]) == 0
+    assert main(["test", "-f", str(p), "-m", str(mdir)]) == 0
