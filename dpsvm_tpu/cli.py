@@ -37,11 +37,20 @@ def build_parser() -> argparse.ArgumentParser:
     root = argparse.ArgumentParser(prog="dpsvm_tpu")
     sub = root.add_subparsers(dest="command", required=True)
 
-    tr = sub.add_parser("train", help="train a binary RBF-SVM")
+    tr = sub.add_parser("train", help="train a binary SVM (RBF default)")
     _add_data_flags(tr)
     tr.add_argument("-c", "--cost", type=float, default=1.0)
     tr.add_argument("-g", "--gamma", type=float, default=None,
-                    help="RBF gamma (default 1/num_attributes)")
+                    help="kernel gamma (default 1/num_attributes)")
+    tr.add_argument("-t", "--kernel", default="rbf",
+                    type=_kernel_name,
+                    help="kernel: linear | poly | rbf | sigmoid, or the "
+                         "LIBSVM -t integer 0..3 (default rbf — the "
+                         "reference's only kernel)")
+    tr.add_argument("-d", "--degree", type=int, default=3,
+                    help="poly kernel degree (LIBSVM -d)")
+    tr.add_argument("-r", "--coef0", type=float, default=0.0,
+                    help="poly/sigmoid coef0 (LIBSVM -r)")
     tr.add_argument("-e", "--epsilon", type=float, default=0.001)
     tr.add_argument("-n", "--max-iter", type=int, default=150_000)
     tr.add_argument("-s", "--cache-size", type=int, default=0,
@@ -130,6 +139,20 @@ def build_parser() -> argparse.ArgumentParser:
     return root
 
 
+_KERNEL_BY_T = {"0": "linear", "1": "poly", "2": "rbf", "3": "sigmoid"}
+
+
+def _kernel_name(v: str) -> str:
+    """Accept LIBSVM -t integers as aliases for the kernel names; reject
+    anything else at parse time (before the dataset is loaded)."""
+    name = _KERNEL_BY_T.get(v, v)
+    if name not in _KERNEL_BY_T.values():
+        raise argparse.ArgumentTypeError(
+            f"{v!r} is not a kernel (linear | poly | rbf | sigmoid, "
+            "or LIBSVM -t 0..3)")
+    return name
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     # Imports deferred so --help / arg errors don't pay the jax import.
     import numpy as np
@@ -175,7 +198,8 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     x, y = load_dataset(args.input, args.num_ex, args.num_att)
     config = SVMConfig(
-        c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
+        c=args.cost, gamma=args.gamma, kernel=args.kernel,
+        degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
         max_iter=args.max_iter, cache_size=args.cache_size,
         backend=args.backend,
         shards=args.shards, shard_x=not args.replicate_x,
@@ -232,7 +256,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         from dpsvm_tpu.ops.diagnostics import optimality_report
         # One streamed kernel pass yields every metric; box_bound gives
         # the same C_i the solver used when class weights are in play.
-        rep = optimality_report(x, y, result.alpha, result.gamma,
+        rep = optimality_report(x, y, result.alpha,
+                                config.kernel_spec(x.shape[1]),
                                 config.box_bound(y), b=result.b)
         # The solver maintains f incrementally across every iteration;
         # kkt_residual recomputes the same b_lo - b_hi from scratch, so

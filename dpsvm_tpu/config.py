@@ -41,7 +41,14 @@ class SVMConfig:
 
     # --- algorithm (reference-parity) ---
     c: float = 1.0                      # box constraint C
-    gamma: Optional[float] = None       # RBF gamma; None => 1.0 / d
+    gamma: Optional[float] = None       # kernel gamma; None => 1.0 / d
+    kernel: str = "rbf"                 # LIBSVM -t family: "linear" (u.v),
+                                        # "poly" ((g u.v + r)^deg), "rbf"
+                                        # (the reference's only kernel,
+                                        # exact parity path), "sigmoid"
+                                        # (tanh(g u.v + r))
+    degree: int = 3                     # poly degree (LIBSVM -d)
+    coef0: float = 0.0                  # poly/sigmoid coef0 (LIBSVM -r)
     epsilon: float = 0.001              # convergence tolerance
     max_iter: int = 150_000             # iteration cap
     cache_size: int = 0                 # kernel-row cache lines (0 = off)
@@ -99,6 +106,8 @@ class SVMConfig:
             return f"backend {self.backend!r}"
         if self.shards > 1:
             return "shards > 1"
+        if self.kernel != "rbf":
+            return f"kernel {self.kernel!r} (RBF only)"
         if self.cache_size > 0:
             return "the kernel-row cache (cache_size > 0)"
         if self.selection != "first-order":
@@ -125,6 +134,14 @@ class SVMConfig:
             return float(self.gamma)
         return 1.0 / float(num_attributes)
 
+    def kernel_spec(self, num_attributes: int):
+        """The static KernelSpec every solver path consumes."""
+        from dpsvm_tpu.ops.kernels import KernelSpec
+        return KernelSpec(kind=self.kernel,
+                          gamma=self.resolve_gamma(num_attributes),
+                          coef0=float(self.coef0),
+                          degree=int(self.degree))
+
     def validate(self) -> None:
         if self.c <= 0:
             raise ValueError(f"cost must be > 0, got {self.c}")
@@ -147,6 +164,11 @@ class SVMConfig:
         if self.weight_pos <= 0 or self.weight_neg <= 0:
             raise ValueError("class weights must be > 0, got "
                              f"({self.weight_pos}, {self.weight_neg})")
+        if self.kernel not in ("linear", "poly", "rbf", "sigmoid"):
+            raise ValueError(f"kernel must be 'linear', 'poly', 'rbf' or "
+                             f"'sigmoid', got {self.kernel!r}")
+        if self.kernel == "poly" and self.degree < 1:
+            raise ValueError(f"poly degree must be >= 1, got {self.degree}")
         if self.selection not in ("first-order", "second-order"):
             raise ValueError(f"selection must be 'first-order' or "
                              f"'second-order', got {self.selection!r}")
@@ -219,6 +241,9 @@ class TrainResult:
     train_seconds: float
     gamma: float
     n_sv: int
+    kernel: str = "rbf"                 # LIBSVM -t family (see SVMConfig)
+    coef0: float = 0.0
+    degree: int = 3
 
     @property
     def gap(self) -> float:

@@ -33,20 +33,26 @@ except ImportError:                                   # pragma: no cover
 
 
 class DPSVMClassifier(*_BASES):
-    """RBF-SVM classifier on the modified-SMO TPU solver.
+    """SVM classifier on the modified-SMO TPU solver (LIBSVM kernel family).
 
-    Parameters mirror ``sklearn.svm.SVC`` where they overlap (C, gamma,
-    tol, max_iter) plus this framework's execution knobs. ``gamma=None``
+    Parameters mirror ``sklearn.svm.SVC`` where they overlap (C, kernel,
+    degree, gamma, coef0, tol, max_iter) plus this framework's execution
+    knobs. ``gamma=None``
     means 1/n_features (the reference's intended default, SURVEY §2d).
     """
 
-    def __init__(self, C: float = 1.0, gamma: Optional[float] = None,
+    def __init__(self, C: float = 1.0, kernel: str = "rbf",
+                 degree: int = 3, gamma: Optional[float] = None,
+                 coef0: float = 0.0,
                  tol: float = 1e-3, max_iter: int = 150_000,
                  selection: str = "first-order", shards: int = 1,
                  matmul_precision: str = "highest",
                  probability: bool = False):
         self.C = C
+        self.kernel = kernel
+        self.degree = degree
         self.gamma = gamma
+        self.coef0 = coef0
         self.tol = tol
         self.max_iter = max_iter
         self.selection = selection
@@ -58,8 +64,8 @@ class DPSVMClassifier(*_BASES):
 
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
         return {k: getattr(self, k) for k in (
-            "C", "gamma", "tol", "max_iter", "selection", "shards",
-            "matmul_precision", "probability")}
+            "C", "kernel", "degree", "gamma", "coef0", "tol", "max_iter",
+            "selection", "shards", "matmul_precision", "probability")}
 
     def set_params(self, **params) -> "DPSVMClassifier":
         for k, v in params.items():
@@ -69,7 +75,9 @@ class DPSVMClassifier(*_BASES):
         return self
 
     def _config(self) -> SVMConfig:
-        return SVMConfig(c=self.C, gamma=self.gamma, epsilon=self.tol,
+        return SVMConfig(c=self.C, kernel=self.kernel, degree=self.degree,
+                         gamma=self.gamma, coef0=self.coef0,
+                         epsilon=self.tol,
                          max_iter=self.max_iter, selection=self.selection,
                          shards=self.shards,
                          matmul_precision=self.matmul_precision)

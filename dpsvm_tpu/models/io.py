@@ -15,6 +15,16 @@ full (gamma, b, SVs) form.
 
 Writing goes through the native C++ serializer when available (large
 models are many MB of text), with a pure-Python fallback.
+
+Non-RBF kernels (beyond the reference, which is RBF-only): the file
+gains a self-describing first line
+
+    kernel <kind> <gamma> <coef0> <degree>
+
+before the b line. RBF models keep the exact reference layout so the
+reference's own tools can still read them; the "kernel" word cannot be
+confused with the reference's bare-float gamma line, so the reader
+dispatches on it safely.
 """
 
 from __future__ import annotations
@@ -34,6 +44,21 @@ def save_model(model: SVMModel, path: str) -> int:
     y = np.ascontiguousarray(model.y_sv, np.int32)
     x = np.ascontiguousarray(model.x_sv, np.float32)
     n, d = x.shape
+    if model.kernel != "rbf":
+        # Self-describing header; SV lines via the same Python fallback
+        # (the native writer emits the reference's RBF-only layout).
+        with open(path, "w") as f:
+            f.write(f"kernel {model.kernel} {model.gamma:g} "
+                    f"{model.coef0:g} {int(model.degree)}\n")
+            f.write(f"{model.b:g}\n")
+            wrote = 0
+            for i in range(n):
+                if not alpha[i] > 0:
+                    continue
+                row = ",".join(f"{v:.9g}" for v in x[i])
+                f.write(f"{alpha[i]:.9g},{int(y[i])},{row}\n")
+                wrote += 1
+        return wrote
     lib = load_native_lib()
     if lib is not None:
         wrote = lib.dpsvm_write_model(
@@ -64,8 +89,19 @@ def load_model(path: str) -> SVMModel:
         lines = [ln.strip() for ln in f if ln.strip()]
     if len(lines) < 2:
         raise ValueError(f"{path}: not a model file (needs gamma + SVs)")
-    gamma = float(lines[0])
-    has_b = "," not in lines[1]
+    kernel, coef0, degree = "rbf", 0.0, 3
+    if lines[0].startswith("kernel "):
+        parts = lines[0].split()
+        if len(parts) != 5:
+            raise ValueError(f"{path}: bad kernel header {lines[0]!r} "
+                             "(want: kernel <kind> <gamma> <coef0> <degree>)")
+        kernel, gamma, coef0, degree = (parts[1], float(parts[2]),
+                                        float(parts[3]), int(parts[4]))
+    else:
+        gamma = float(lines[0])
+    # After the header line: an optional lone-scalar b line, then SVs
+    # (the reference's seq.cpp layout omits b — SURVEY §2c).
+    has_b = len(lines) > 1 and "," not in lines[1]
     b = float(lines[1]) if has_b else 0.0
     sv_lines = lines[2:] if has_b else lines[1:]
     if not sv_lines:
@@ -83,4 +119,5 @@ def load_model(path: str) -> SVMModel:
         alpha[i] = float(parts[0])
         y[i] = int(float(parts[1]))
         x[i] = np.asarray(parts[2:], dtype=np.float32)
-    return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=b, gamma=gamma)
+    return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=b, gamma=gamma,
+                    kernel=kernel, coef0=coef0, degree=degree)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.config import TrainResult
-from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
+from dpsvm_tpu.ops.kernels import KernelSpec, kernel_rows, row_norms_sq
 
 
 @dataclasses.dataclass
@@ -37,6 +37,14 @@ class SVMModel:
     y_sv: np.ndarray      # (n_sv,) int32 +/-1
     b: float
     gamma: float
+    kernel: str = "rbf"   # LIBSVM -t family; "rbf" = reference parity
+    coef0: float = 0.0
+    degree: int = 3
+
+    @property
+    def kernel_spec(self) -> KernelSpec:
+        return KernelSpec(kind=self.kernel, gamma=float(self.gamma),
+                          coef0=float(self.coef0), degree=int(self.degree))
 
     @property
     def n_sv(self) -> int:
@@ -59,13 +67,20 @@ class SVMModel:
             y_sv=np.asarray(y, np.int32)[keep],
             b=float(result.b),
             gamma=float(result.gamma),
+            kernel=result.kernel,
+            coef0=float(result.coef0),
+            degree=int(result.degree),
         )
 
 
-@functools.partial(jax.jit, static_argnames=("include_b",))
-def _decision_jit(x_test, x_sv, coef, sv2, b, gamma, include_b: bool):
+@functools.partial(jax.jit, static_argnames=("kind", "degree", "include_b"))
+def _decision_jit(x_test, x_sv, coef, sv2, b, gamma, coef0,
+                  kind: str, degree: int, include_b: bool):
+    # kind/degree select the program (static); gamma/coef0 are traced so
+    # a hyperparameter sweep reuses one compilation per kernel kind.
+    spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
     t2 = row_norms_sq(x_test)
-    k = kernel_rows(x_test, t2, x_sv, sv2, gamma)     # (m, n_sv)
+    k = kernel_rows(x_test, t2, x_sv, sv2, spec)      # (m, n_sv)
     dual = k @ coef
     if include_b:
         dual = dual - b
@@ -84,7 +99,9 @@ def decision_function(model: SVMModel, x_test: np.ndarray,
     if batch_size is None or m <= batch_size:
         return np.asarray(_decision_jit(
             jnp.asarray(x_test), x_sv, coef, sv2,
-            jnp.float32(model.b), jnp.float32(model.gamma), include_b))
+            jnp.float32(model.b), jnp.float32(model.gamma),
+            jnp.float32(model.coef0), model.kernel, int(model.degree),
+            include_b))
     # Pad to a full batch grid so jit compiles exactly once.
     out = np.empty((m,), np.float32)
     for lo in range(0, m, batch_size):
@@ -93,7 +110,9 @@ def decision_function(model: SVMModel, x_test: np.ndarray,
         block[: hi - lo] = x_test[lo:hi]
         vals = np.asarray(_decision_jit(
             jnp.asarray(block), x_sv, coef, sv2,
-            jnp.float32(model.b), jnp.float32(model.gamma), include_b))
+            jnp.float32(model.b), jnp.float32(model.gamma),
+            jnp.float32(model.coef0), model.kernel, int(model.degree),
+            include_b))
         out[lo:hi] = vals[: hi - lo]
     return out
 

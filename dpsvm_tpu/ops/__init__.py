@@ -1,11 +1,17 @@
 """Device-side primitives shared by the single-device and distributed solvers."""
 
-from dpsvm_tpu.ops.kernels import row_norms_sq, rbf_rows_from_dots
+from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms, kernel_rows,
+                                   rbf_rows_from_dots, row_norms_sq,
+                                   rows_from_dots)
 from dpsvm_tpu.ops.selection import iup_ilow_masks, masked_extrema
 
 __all__ = [
+    "KernelSpec",
     "row_norms_sq",
     "rbf_rows_from_dots",
+    "rows_from_dots",
+    "kdiag_from_norms",
+    "kernel_rows",
     "iup_ilow_masks",
     "masked_extrema",
 ]

@@ -21,24 +21,26 @@ thin wrappers.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
+from dpsvm_tpu.ops.kernels import KernelSpec, kernel_rows, row_norms_sq
 
 
-@jax.jit
-def _block_kv(x_blk, x2_blk, x, x2, coef, gamma):
-    k = kernel_rows(x_blk, x2_blk, x, x2, gamma)        # (blk, n)
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _block_kv(x_blk, x2_blk, x, x2, coef, spec: KernelSpec):
+    k = kernel_rows(x_blk, x2_blk, x, x2, spec)         # (blk, n)
     return k @ coef                                     # (blk,) = (K alpha*y)_i
 
 
-def _stream_kv(x: np.ndarray, coef: np.ndarray, gamma: float,
-               block: int) -> np.ndarray:
+def _stream_kv(x: np.ndarray, coef: np.ndarray, spec, block: int
+               ) -> np.ndarray:
     """kv = K @ coef in row blocks; O(block * n) device memory."""
+    spec = KernelSpec.coerce(spec)
     xd = jnp.asarray(x)
     x2 = row_norms_sq(xd)
     cf = jnp.asarray(coef)
@@ -47,7 +49,7 @@ def _stream_kv(x: np.ndarray, coef: np.ndarray, gamma: float,
     for lo in range(0, n, block):
         hi = min(lo + block, n)
         kv[lo:hi] = np.asarray(_block_kv(xd[lo:hi], x2[lo:hi], xd, x2, cf,
-                                         jnp.float32(gamma)))
+                                         spec))
     return kv
 
 
@@ -61,9 +63,12 @@ class OptimalityReport:
 
 
 def optimality_report(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
-                      gamma: float, c, b: float = 0.0,
+                      gamma, c, b: float = 0.0,
                       block: int = 4096) -> OptimalityReport:
     """All post-train optimality metrics from ONE streamed kernel pass.
+
+    ``gamma`` is a bare float (RBF shorthand) or a KernelSpec for the
+    other LIBSVM kernels.
 
     ``c`` may be a scalar or a per-example (n,) array (class-weighted
     costs: C_i = C * w(y_i)); the primal weights each hinge term by its
@@ -98,7 +103,7 @@ def optimality_report(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
     c_vec = np.asarray(c, np.float32)
     coef = al * yf
 
-    kv = _stream_kv(x, coef, gamma, block)
+    kv = _stream_kv(x, coef, gamma, block)   # gamma may be a spec
 
     quad = float(coef @ kv)
     hinge = float(np.sum(np.broadcast_to(c_vec, yf.shape)
@@ -118,7 +123,7 @@ def optimality_report(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
 
 
 def dual_objective_and_gap(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
-                           gamma: float, c, b: float = 0.0,
+                           gamma, c, b: float = 0.0,
                            block: int = 4096) -> Tuple[float, float, float]:
     """(dual_objective, primal_objective, duality_gap) — see
     ``optimality_report`` for the semantics of ``c`` and ``b``."""
@@ -127,6 +132,6 @@ def dual_objective_and_gap(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
 
 
 def kkt_violation(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
-                  gamma: float, c) -> float:
+                  gamma, c) -> float:
     """b_lo - b_hi recomputed from fresh f — see ``optimality_report``."""
     return optimality_report(x, y, alpha, gamma, c).kkt_residual

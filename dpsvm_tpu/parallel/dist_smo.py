@@ -42,7 +42,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
+from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
+                                   row_norms_sq, rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
@@ -124,8 +125,9 @@ def _broadcast_row(xs, ys, x2s, alpha_s, loc, own, gi, *, shard_x: bool):
 
 
 def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
-                    c: float, gamma: float, n_per_shard: int, shard_x: bool,
-                    precision, weights=(1.0, 1.0)) -> DistCarry:
+                    c: float, kspec: KernelSpec, n_per_shard: int,
+                    shard_x: bool, precision,
+                    weights=(1.0, 1.0)) -> DistCarry:
     """One second-order (WSS2) iteration over the mesh: the hi row is
     broadcast first, every shard scores its local violators against it,
     and the lo index comes from a second tiny all_gather. Two row
@@ -162,11 +164,19 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
 
     def local_k_row(row, w2):
         dots = jnp.matmul(row[None, :], xs_l.T, precision=precision)
-        return rbf_rows_from_dots(dots, w2[None], x2s_l, gamma)[0]
+        return rows_from_dots(dots, w2[None], x2s_l, kspec)[0]
 
     k_hi = local_k_row(row_hi, x2_hi)                              # (n_s,)
     bb = f_low_l - b_hi
-    a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
+    if kspec.is_rbf:
+        a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
+    else:
+        # a_j = K(hi,hi) + K(jj) - 2 K(hi,j); the hi diagonal comes from
+        # the already-broadcast x2_hi, the local diagonal from this
+        # shard's norms — no extra collective.
+        a = jnp.maximum(kdiag_from_norms(x2_hi, kspec)
+                        + kdiag_from_norms(x2s_l, kspec) - 2.0 * k_hi,
+                        1e-12)
     obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
     li_lo = jnp.argmax(obj)
     lo_pack = jnp.stack([obj[li_lo], f_low_l[li_lo]])
@@ -213,8 +223,8 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
 
 
 def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
-               c: float, gamma: float, n_per_shard: int, shard_x: bool,
-               precision, weights=(1.0, 1.0),
+               c: float, kspec: KernelSpec, n_per_shard: int,
+               shard_x: bool, precision, weights=(1.0, 1.0),
                use_cache: bool = False,
                packed_select: bool = False) -> DistCarry:
     """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
@@ -293,17 +303,17 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
             cache, i_hi_g, i_lo_g,
             lambda: jnp.matmul(rows, xs_l.T, precision=precision))
         cache_out = (cache.keys, cache.stamps, cache.rows)
-        k_local = rbf_rows_from_dots(dots, w2, x2s_l, gamma)       # (2, n_s)
+        k_local = rows_from_dots(dots, w2, x2s_l, kspec)           # (2, n_s)
         k_hh, k_ll, k_hl = _eta_kernel_entries(k_local, loc_hi, own_hi,
                                                loc_lo, own_lo)
     elif shard_x:
         dots = jnp.matmul(rows, xs.T, precision=precision)
-        k_local = rbf_rows_from_dots(dots, w2, x2s, gamma)         # (2, n_s)
+        k_local = rows_from_dots(dots, w2, x2s, kspec)             # (2, n_s)
         k_hh, k_ll, k_hl = _eta_kernel_entries(k_local, loc_hi, own_hi,
                                                loc_lo, own_lo)
     else:
         dots = jnp.matmul(rows, xs.T, precision=precision)
-        k_full = rbf_rows_from_dots(dots, w2, x2s, gamma)          # (2, n_pad)
+        k_full = rows_from_dots(dots, w2, x2s, kspec)              # (2, n_pad)
         k_hh = k_full[0, i_hi_g]
         k_ll = k_full[1, i_lo_g]
         k_hl = k_full[0, i_lo_g]
@@ -332,12 +342,13 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
 
 
 @functools.lru_cache(maxsize=16)
-def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
+def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
                        epsilon: float, n_per_shard: int, shard_x: bool,
                        precision_name: str, second_order: bool = False,
                        weights=(1.0, 1.0), use_cache: bool = False,
                        packed_select: bool = False):
     precision = getattr(lax.Precision, precision_name)
+    kspec = KernelSpec.coerce(kspec)
     x_spec = P(SHARD_AXIS) if shard_x else P()
     if second_order:
         step = _dist_step_wss2
@@ -351,7 +362,7 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, gamma: float,
             return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
 
         def body(s: DistCarry):
-            return step(s, xs, ys, x2s, valid, c=c, gamma=gamma,
+            return step(s, xs, ys, x2s, valid, c=c, kspec=kspec,
                         n_per_shard=n_per_shard, shard_x=shard_x,
                         precision=precision, weights=weights, **extra)
 
@@ -387,6 +398,7 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         mesh = make_data_mesh(config.shards)
     p = mesh.devices.size      # the mesh, not config.shards, is authoritative
     gamma = float(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
     eps = float(config.epsilon)
 
     ckpt = resume_state(config, n, d, gamma)
@@ -435,7 +447,7 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                           row_shard),
     )
 
-    runner = _build_dist_runner(mesh, float(config.c), gamma, eps, n_s,
+    runner = _build_dist_runner(mesh, float(config.c), kspec, eps, n_s,
                                 bool(config.shard_x),
                                 config.matmul_precision.upper(),
                                 config.selection == "second-order",

@@ -84,7 +84,9 @@ def host_training_loop(
                     c=float(config.c), gamma=gamma,
                     epsilon=float(config.epsilon), n=n, d=d,
                     weight_pos=float(config.weight_pos),
-                    weight_neg=float(config.weight_neg))
+                    weight_neg=float(config.weight_neg),
+                    kernel=config.kernel, coef0=float(config.coef0),
+                    degree=int(config.degree))
 
             last_saved = maybe_checkpoint(config, last_saved, n_iter, make)
             if done:
@@ -101,4 +103,7 @@ def host_training_loop(
         train_seconds=time.perf_counter() - t0,
         gamma=gamma,
         n_sv=int(np.sum(alpha > 0)),
+        kernel=config.kernel,
+        coef0=float(config.coef0),
+        degree=int(config.degree),
     )

@@ -40,6 +40,40 @@ import numpy as np
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 
 
+def _np_rows_from_dots(dots: np.ndarray, w2, x2: np.ndarray,
+                       spec) -> np.ndarray:
+    """NumPy mirror of ops.kernels.rows_from_dots (float32 throughout).
+
+    The RBF branch keeps the oracle's original expression byte-for-byte;
+    the other LIBSVM kernels share the iteration with it.
+    """
+    if spec.kind == "rbf":
+        return np.exp((-np.float32(spec.gamma)
+                       * (x2 + w2 - 2.0 * dots)).astype(np.float32))
+    if spec.kind == "linear":
+        return dots
+    if spec.kind == "poly":
+        return ((np.float32(spec.gamma) * dots + np.float32(spec.coef0))
+                ** spec.degree).astype(np.float32)
+    if spec.kind == "sigmoid":
+        return np.tanh(np.float32(spec.gamma) * dots
+                       + np.float32(spec.coef0)).astype(np.float32)
+    raise ValueError(f"unknown kernel kind {spec.kind!r}")
+
+
+def _np_kdiag(x2: np.ndarray, spec) -> np.ndarray:
+    """K(i, i) per example (non-RBF kernels; RBF keeps the literal 2-2K)."""
+    if spec.kind == "linear":
+        return x2
+    if spec.kind == "poly":
+        return ((np.float32(spec.gamma) * x2 + np.float32(spec.coef0))
+                ** spec.degree).astype(np.float32)
+    if spec.kind == "sigmoid":
+        return np.tanh(np.float32(spec.gamma) * x2
+                       + np.float32(spec.coef0)).astype(np.float32)
+    raise ValueError(f"unknown kernel kind {spec.kind!r}")
+
+
 def iup_ilow_masks(alpha: np.ndarray, y: np.ndarray, c
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """Keerthi index-set membership masks (svmTrain.cu:54-91 semantics).
@@ -85,6 +119,7 @@ def smo_reference(
                      np.float32(config.c * config.weight_pos),
                      np.float32(config.c * config.weight_neg))
     gamma = np.float32(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
     eps = np.float32(config.epsilon)
     sent = np.float32(SENTINEL)
 
@@ -113,10 +148,14 @@ def smo_reference(
             # j in I_low with f_j > b_hi, maximize (f_j - b_hi)^2 / a_j
             # with a_j = K_ii + K_jj - 2 K_ij = 2 - 2 K(hi, j) for RBF.
             dots_hi = (x[i_hi] @ x.T).astype(np.float32)
-            k_hi = np.exp((-gamma * (x2 + x2[i_hi] - 2.0 * dots_hi)
-                           ).astype(np.float32))
+            k_hi = _np_rows_from_dots(dots_hi, x2[i_hi], x2, kspec)
             bb = f_low - b_hi
-            a = np.maximum(2.0 - 2.0 * k_hi, np.float32(1e-12))
+            if kspec.kind == "rbf":
+                a = np.maximum(2.0 - 2.0 * k_hi, np.float32(1e-12))
+            else:
+                kd = _np_kdiag(x2, kspec)
+                a = np.maximum(kd[i_hi] + kd - 2.0 * k_hi,
+                               np.float32(1e-12))
             obj = np.where(in_low & (bb > 0), bb * bb / a, np.float32(-1.0))
             i_lo = int(np.argmax(obj))
         else:
@@ -126,15 +165,13 @@ def smo_reference(
 
         if second_order:
             dots_lo = (x[i_lo] @ x.T).astype(np.float32)
-            k_lo = np.exp((-gamma * (x2 + x2[i_lo] - 2.0 * dots_lo)
-                           ).astype(np.float32))
+            k_lo = _np_rows_from_dots(dots_lo, x2[i_lo], x2, kspec)
             k = np.stack([k_hi, k_lo])
         else:
             rows = x[(i_hi, i_lo), :]                   # (2, d)
             dots = (rows @ x.T).astype(np.float32)      # (2, n)
             w2 = x2[(i_hi, i_lo),]
-            k = np.exp((-gamma * (x2[None, :] + w2[:, None] - 2.0 * dots)
-                        ).astype(np.float32))
+            k = _np_rows_from_dots(dots, w2[:, None], x2[None, :], kspec)
         eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
         if second_order:
             # Clamped like the WSS2 selection denominator (and LIBSVM);
@@ -177,4 +214,7 @@ def smo_reference(
         train_seconds=time.perf_counter() - t0,
         gamma=float(gamma),
         n_sv=int(np.sum(alpha > 0)),
+        kernel=config.kernel,
+        coef0=float(config.coef0),
+        degree=int(config.degree),
     )

@@ -30,7 +30,8 @@ import numpy as np
 from jax import lax
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
+from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
+                                   row_norms_sq, rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
@@ -61,7 +62,7 @@ def init_carry(y: jax.Array, cache_lines: int) -> SMOCarry:
 
 
 def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
-             c: float, gamma: float, *, use_cache: bool = False,
+             c: float, kspec: KernelSpec, *, use_cache: bool = False,
              second_order: bool = False, weights=(1.0, 1.0),
              precision=lax.Precision.HIGHEST,
              packed_select: bool = False) -> SMOCarry:
@@ -69,9 +70,15 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 
     ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
     (Fan/Chen/Lin 2005): among violators j in I_low with f_j > b_hi,
-    maximize (f_j - b_hi)^2 / (2 - 2 K(hi, j)). The stopping gap and the
-    intercept still come from the max violator (b_lo), matching the
-    reference's convergence rule (svmTrainMain.cpp:310,329).
+    maximize (f_j - b_hi)^2 / a_j with a_j = K_ii + K_jj - 2 K(hi, j)
+    (= 2 - 2 K(hi, j) for RBF — the literal kept on that path for bit
+    parity). The stopping gap and the intercept still come from the max
+    violator (b_lo), matching the reference's convergence rule
+    (svmTrainMain.cpp:310,329).
+
+    ``kspec`` statically selects the kernel family; "rbf" is the exact
+    reference-parity path, the rest (linear/poly/sigmoid — LIBSVM -t)
+    share every other line of the iteration.
 
     ``weights`` = (w_pos, w_neg) class-weights the box bound per example
     (C_i = C * w(y_i)); (1, 1) keeps the exact scalar reference path.
@@ -95,14 +102,18 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         b_lo = jnp.max(f_low)                       # stopping gap only
         dots_hi = jnp.matmul(x[i_hi][None, :], x.T,
                              precision=precision)              # (1, n)
-        k_hi = rbf_rows_from_dots(dots_hi, x2[i_hi][None], x2, gamma)[0]
+        k_hi = rows_from_dots(dots_hi, x2[i_hi][None], x2, kspec)[0]
         bb = f_low - b_hi
-        a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
+        if kspec.is_rbf:
+            a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
+        else:
+            kd = kdiag_from_norms(x2, kspec)
+            a = jnp.maximum(kd[i_hi] + kd - 2.0 * k_hi, 1e-12)
         obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
         i_lo = jnp.argmax(obj)
         dots_lo = jnp.matmul(x[i_lo][None, :], x.T,
                              precision=precision)
-        k_lo = rbf_rows_from_dots(dots_lo, x2[i_lo][None], x2, gamma)[0]
+        k_lo = rows_from_dots(dots_lo, x2[i_lo][None], x2, kspec)[0]
         k = jnp.stack([k_hi, k_lo])
         b_lo_sel = f_low[i_lo]                      # alpha step uses the
         cache = carry.cache                         # SELECTED violator
@@ -122,7 +133,7 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
             dots = jnp.matmul(rows, x.T, precision=precision)    # (2, n)
 
         w2 = jnp.stack([x2[i_hi], x2[i_lo]])
-        k = rbf_rows_from_dots(dots, w2, x2, gamma)              # (2, n)
+        k = rows_from_dots(dots, w2, x2, kspec)                  # (2, n)
 
     eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
     if second_order:
@@ -150,15 +161,20 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_chunk_runner(c: float, gamma: float, epsilon: float,
+def _build_chunk_runner(c: float, kspec, epsilon: float,
                         use_cache: bool, precision_name: str,
                         second_order: bool = False,
                         weights=(1.0, 1.0),
                         packed_select: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
-    shapes specialize via jit."""
+    shapes specialize via jit.
+
+    ``kspec`` is a KernelSpec, or a bare gamma float as RBF shorthand
+    (the original call convention, kept for the benchmark harnesses).
+    """
     precision = getattr(lax.Precision, precision_name)
+    kspec = KernelSpec.coerce(kspec)
 
     def cond(carry: SMOCarry, limit):
         return (carry.b_lo > carry.b_hi + 2.0 * epsilon) & (carry.n_iter < limit)
@@ -166,7 +182,7 @@ def _build_chunk_runner(c: float, gamma: float, epsilon: float,
     def run(carry: SMOCarry, x, y, x2, limit):
         return lax.while_loop(
             lambda s: cond(s, limit),
-            lambda s: smo_step(s, x, y, x2, c, gamma,
+            lambda s: smo_step(s, x, y, x2, c, kspec,
                                use_cache=use_cache,
                                second_order=second_order,
                                weights=weights,
@@ -183,6 +199,7 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     config.validate()
     n, d = x.shape
     gamma = float(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
     use_cache = config.cache_size > 0
 
     xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
@@ -199,7 +216,7 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     if device is not None:
         carry = jax.device_put(carry, device)
 
-    runner = _build_chunk_runner(float(config.c), gamma,
+    runner = _build_chunk_runner(float(config.c), kspec,
                                  float(config.epsilon), use_cache,
                                  config.matmul_precision.upper(),
                                  config.selection == "second-order",

@@ -24,6 +24,9 @@ import numpy as np
 
 from dpsvm_tpu.config import SVMConfig
 
+# LIBSVM -t order; index = the integer stored in the checkpoint scalars.
+_KERNEL_T = ("linear", "poly", "rbf", "sigmoid")
+
 
 @dataclasses.dataclass
 class SolverCheckpoint:
@@ -39,6 +42,9 @@ class SolverCheckpoint:
     d: int
     weight_pos: float = 1.0
     weight_neg: float = 1.0
+    kernel: str = "rbf"
+    coef0: float = 0.0
+    degree: int = 3
 
     def validate_against(self, n: int, d: int, config: SVMConfig,
                          gamma: float) -> None:
@@ -46,9 +52,14 @@ class SolverCheckpoint:
             raise ValueError(
                 f"checkpoint is for a ({self.n}, {self.d}) problem, "
                 f"data is ({n}, {d})")
+        if self.kernel != config.kernel:
+            raise ValueError(f"checkpoint kernel={self.kernel!r} != "
+                             f"configured kernel={config.kernel!r}")
         for name, mine, theirs in (
                 ("c", self.c, config.c),
                 ("gamma", self.gamma, gamma),
+                ("coef0", self.coef0, config.coef0),
+                ("degree", self.degree, config.degree),
                 ("epsilon", self.epsilon, config.epsilon),
                 ("weight_pos", self.weight_pos, config.weight_pos),
                 ("weight_neg", self.weight_neg, config.weight_neg)):
@@ -72,7 +83,10 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint) -> None:
                 scalars=np.asarray(
                     [ckpt.n_iter, ckpt.b_lo, ckpt.b_hi, ckpt.c, ckpt.gamma,
                      ckpt.epsilon, ckpt.n, ckpt.d, ckpt.weight_pos,
-                     ckpt.weight_neg], np.float64),
+                     ckpt.weight_neg,
+                     # kernel family encoded as the LIBSVM -t integer
+                     _KERNEL_T.index(ckpt.kernel), ckpt.coef0,
+                     ckpt.degree], np.float64),
             )
         os.replace(tmp, path)
     except BaseException:
@@ -89,9 +103,13 @@ def load_checkpoint(path: str) -> SolverCheckpoint:
             n_iter=int(s[0]), b_lo=float(s[1]), b_hi=float(s[2]),
             c=float(s[3]), gamma=float(s[4]), epsilon=float(s[5]),
             n=int(s[6]), d=int(s[7]),
-            # files from before class weights existed carry 8 scalars
+            # files from before class weights existed carry 8 scalars;
+            # from before kernel families, 10
             weight_pos=float(s[8]) if len(s) > 8 else 1.0,
             weight_neg=float(s[9]) if len(s) > 9 else 1.0,
+            kernel=_KERNEL_T[int(s[10])] if len(s) > 10 else "rbf",
+            coef0=float(s[11]) if len(s) > 11 else 0.0,
+            degree=int(s[12]) if len(s) > 12 else 3,
         )
 
 
