@@ -17,10 +17,13 @@ from dpsvm_tpu.models.svm import SVMModel
 
 
 def train(x: np.ndarray, y: np.ndarray,
-          config: Optional[SVMConfig] = None) -> TrainResult:
-    """Train a binary RBF-SVM with the modified-SMO solver.
+          config: Optional[SVMConfig] = None,
+          f_init: Optional[np.ndarray] = None) -> TrainResult:
+    """Train a binary SVM with the modified-SMO solver.
 
     x: (n, d) float features; y: (n,) labels in {+1, -1}.
+    ``f_init`` overrides the f = -y initialization (the SVR wrapper's
+    hook — users train regressors through models.svr.train_svr).
     """
     config = config or SVMConfig()
     config.validate()
@@ -38,15 +41,16 @@ def train(x: np.ndarray, y: np.ndarray,
             "(CLI: train --multiclass)")
     if config.backend == "numpy":
         from dpsvm_tpu.solver.oracle import smo_reference
-        return smo_reference(x, y, config)
+        return smo_reference(x, y, config, f_init=f_init)
     if config.shards > 1:
         from dpsvm_tpu.parallel.dist_smo import train_distributed
-        return train_distributed(x, y, config)
+        return train_distributed(x, y, config, f_init=f_init)
     from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
-    if use_fused(config):
+    if f_init is None and use_fused(config):
+        # the fused kernel hard-codes the classification f = -y init
         return train_single_device_fused(x, y, config)
     from dpsvm_tpu.solver.smo import train_single_device
-    return train_single_device(x, y, config)
+    return train_single_device(x, y, config, f_init=f_init)
 
 
 def fit(x: np.ndarray, y: np.ndarray,

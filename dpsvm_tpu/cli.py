@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fused Pallas iteration kernel: 'on' forces it; "
                          "'auto' currently prefers the XLA path (faster "
                          "on measured hardware, see solver/fused.py)")
+    tr.add_argument("--svr", action="store_true",
+                    help="epsilon-SVR regression (float targets; LIBSVM "
+                         "svm-train -s 3 analog)")
+    tr.add_argument("-p", "--svr-epsilon", type=float, default=0.1,
+                    help="SVR tube half-width (LIBSVM -p, default 0.1)")
     tr.add_argument("--multiclass", action="store_true",
                     help="one-vs-one multi-class training (labels may be "
                          "any integers; -m becomes a model DIRECTORY)")
@@ -196,10 +201,25 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "supported", file=sys.stderr)
             return 2
 
-    x, y = load_dataset(args.input, args.num_ex, args.num_att)
+    if args.svr:
+        conflicts = [("--multiclass", args.multiclass),
+                     ("--probability", args.probability),
+                     ("--check-kkt", args.check_kkt),
+                     ("--pallas on", args.pallas == "on"),
+                     ("--weight-pos/--weight-neg",
+                      args.weight_pos != 1.0 or args.weight_neg != 1.0)]
+        for flag, on in conflicts:
+            if on:
+                print(f"error: {flag} is a classification flag; it does "
+                      "not apply to --svr", file=sys.stderr)
+                return 2
+
+    x, y = load_dataset(args.input, args.num_ex, args.num_att,
+                        float_labels=args.svr)
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, kernel=args.kernel,
         degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
+        svr_epsilon=args.svr_epsilon,
         max_iter=args.max_iter, cache_size=args.cache_size,
         backend=args.backend,
         shards=args.shards, shard_x=not args.replicate_x,
@@ -232,6 +252,26 @@ def cmd_train(args: argparse.Namespace) -> int:
         print(f"Training accuracy: {acc:.6f}")
         print(f"Training time: "
               f"{sum(r.train_seconds for r in results):.3f} s")
+        return 0
+
+    if args.svr:
+        from dpsvm_tpu.models.svr import evaluate_svr, train_svr
+        model, result = train_svr(x, y, config)
+        if model.n_sv == 0:
+            print("error: the fitted tube contains every target "
+                  f"(svr_epsilon={config.svr_epsilon}) — the model has no "
+                  "support vectors and predicts the constant "
+                  f"{-result.b:.6g}; decrease -p", file=sys.stderr)
+            return 1
+        n_sv = save_model(model, args.model)
+        m = evaluate_svr(model, x, y)
+        print(f"Number of SVs: {n_sv}")
+        print(f"b: {result.b:.6f}")
+        print(f"Training iterations: {result.n_iter}"
+              + ("" if result.converged else " (NOT converged)"))
+        print(f"Training MSE: {m['mse']:.6f}  MAE: {m['mae']:.6f}  "
+              f"R^2: {m['r2']:.6f}")
+        print(f"Training time: {result.train_seconds:.3f} s")
         return 0
 
     model, result = fit(x, y, config)
@@ -317,11 +357,27 @@ def cmd_test(args: argparse.Namespace) -> int:
 
     model = load_model(args.model)
     x, y = load_dataset(args.input, args.num_ex,
-                        _width_hint(model.num_attributes))
+                        _width_hint(model.num_attributes),
+                        float_labels=model.task == "svr")
     if x.shape[1] != model.num_attributes:
         print(f"error: dataset has {x.shape[1]} attributes, model has "
               f"{model.num_attributes}", file=sys.stderr)
         return 2
+    if model.task == "svr":
+        if args.proba:
+            print("error: --proba applies to classifiers only",
+                  file=sys.stderr)
+            return 2
+        from dpsvm_tpu.models.svr import evaluate_svr, predict_svr
+        pred = predict_svr(model, x, include_b=not args.no_b)
+        if args.predictions:
+            with open(args.predictions, "w") as f:
+                f.writelines(f"{float(v):.9g}\n" for v in pred)
+        m = evaluate_svr(model, x, y, include_b=not args.no_b)
+        print(f"Number of SVs: {model.n_sv}")
+        print(f"Test MSE: {m['mse']:.6f}  MAE: {m['mae']:.6f}  "
+              f"R^2: {m['r2']:.6f}")
+        return 0
     from dpsvm_tpu.models.svm import decision_function
     dec = decision_function(model, x, include_b=not args.no_b)
     pred = np.where(dec < 0, -1, 1)                    # svmTrain.cu:650-656

@@ -50,6 +50,8 @@ class SVMConfig:
     degree: int = 3                     # poly degree (LIBSVM -d)
     coef0: float = 0.0                  # poly/sigmoid coef0 (LIBSVM -r)
     epsilon: float = 0.001              # convergence tolerance
+    svr_epsilon: float = 0.1            # epsilon-SVR tube half-width
+                                        # (LIBSVM -p; regression only)
     max_iter: int = 150_000             # iteration cap
     cache_size: int = 0                 # kernel-row cache lines (0 = off)
     weight_pos: float = 1.0             # class-weighted costs: the box
@@ -164,6 +166,9 @@ class SVMConfig:
         if self.weight_pos <= 0 or self.weight_neg <= 0:
             raise ValueError("class weights must be > 0, got "
                              f"({self.weight_pos}, {self.weight_neg})")
+        if self.svr_epsilon < 0:
+            raise ValueError(
+                f"svr_epsilon must be >= 0, got {self.svr_epsilon}")
         if self.kernel not in ("linear", "poly", "rbf", "sigmoid"):
             raise ValueError(f"kernel must be 'linear', 'poly', 'rbf' or "
                              f"'sigmoid', got {self.kernel!r}")

@@ -55,12 +55,15 @@ def load_csv(
     path: str,
     num_examples: Optional[int] = None,
     num_attributes: Optional[int] = None,
+    float_labels: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load a dense ``label,f1,...,fd`` CSV into (x, y) NumPy arrays.
 
     x: (n, d) float32, y: (n,) int32 with values +/-1. When the explicit
     shape arguments are given (reference ``-a``/``-x`` flag parity), only
-    that many rows/columns are read.
+    that many rows/columns are read. ``float_labels=True`` keeps y as
+    float32 (regression targets; the pure-Python parse path — the native
+    fast path emits int labels).
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
@@ -73,7 +76,7 @@ def load_csv(
     if n <= 0 or d <= 0:
         raise ValueError(f"empty dataset: {path!r} has shape ({n}, {d})")
 
-    lib = load_native_lib()
+    lib = None if float_labels else load_native_lib()
     if lib is not None:
         x = np.empty((n, d), dtype=np.float32)
         y = np.empty((n,), dtype=np.int32)
@@ -89,7 +92,7 @@ def load_csv(
         # readable error.
 
     xs = np.empty((n, d), dtype=np.float32)
-    ys = np.empty((n,), dtype=np.int32)
+    ys = np.empty((n,), dtype=np.float32 if float_labels else np.int32)
     i = 0
     with open(path, "r") as f:
         for lineno, line in enumerate(f, 1):
@@ -102,7 +105,8 @@ def load_csv(
             if len(parts) < d + 1:
                 raise ValueError(
                     f"{path}:{lineno}: expected {d + 1} fields, got {len(parts)}")
-            ys[i] = int(float(parts[0]))
+            lab = float(parts[0])
+            ys[i] = lab if float_labels else int(lab)
             xs[i] = np.asarray(parts[1:d + 1], dtype=np.float32)
             i += 1
     if i < n:
@@ -114,6 +118,7 @@ def load_libsvm(
     path: str,
     num_examples: Optional[int] = None,
     num_attributes: Optional[int] = None,
+    float_labels: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load a libsvm/svmlight sparse file ``<label> idx:val ...`` directly.
 
@@ -149,12 +154,16 @@ def load_libsvm(
             except ValueError as e:
                 raise ValueError(
                     f"{path}:{lineno}: bad label {parts[0]!r}") from e
-            lab = int(lab_f)
-            if lab != lab_f:
-                raise ValueError(
-                    f"{path}:{lineno}: non-integer label {parts[0]!r} "
-                    "(classification labels must be integers)")
-            labels.append(lab)
+            if float_labels:
+                labels.append(lab_f)
+            else:
+                lab = int(lab_f)
+                if lab != lab_f:
+                    raise ValueError(
+                        f"{path}:{lineno}: non-integer label {parts[0]!r} "
+                        "(classification labels must be integers; "
+                        "regression loads with float_labels=True)")
+                labels.append(lab)
             idxs = np.empty(len(parts) - 1, dtype=np.int64)
             vals = np.empty(len(parts) - 1, dtype=np.float32)
             for k, tok in enumerate(parts[1:]):
@@ -183,7 +192,8 @@ def load_libsvm(
     for i, (idxs, vals) in enumerate(rows):
         keep = idxs <= d
         x[i, idxs[keep] - 1] = vals[keep]
-    return _check_finite(x, path), np.asarray(labels, dtype=np.int32)
+    return _check_finite(x, path), np.asarray(
+        labels, dtype=np.float32 if float_labels else np.int32)
 
 
 def sniff_format(path: str) -> str:
@@ -209,6 +219,7 @@ def load_dataset(
     path: str,
     num_examples: Optional[int] = None,
     num_attributes: Optional[int] = None,
+    float_labels: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load a dataset in either supported format (sniffed per file).
 
@@ -219,8 +230,8 @@ def load_dataset(
     overrides with identical semantics (short files error).
     """
     if sniff_format(path) == "libsvm":
-        return load_libsvm(path, num_examples, num_attributes)
-    return load_csv(path, num_examples, num_attributes)
+        return load_libsvm(path, num_examples, num_attributes, float_labels)
+    return load_csv(path, num_examples, num_attributes, float_labels)
 
 
 def _check_finite(x: np.ndarray, path: str) -> np.ndarray:

@@ -44,12 +44,15 @@ def save_model(model: SVMModel, path: str) -> int:
     y = np.ascontiguousarray(model.y_sv, np.int32)
     x = np.ascontiguousarray(model.x_sv, np.float32)
     n, d = x.shape
-    if model.kernel != "rbf":
-        # Self-describing header; SV lines via the same Python fallback
-        # (the native writer emits the reference's RBF-only layout).
+    if model.task == "svr" or model.kernel != "rbf":
+        # Beyond-reference models (regression, or non-RBF kernels) use
+        # the self-describing header; the native writer emits only the
+        # reference's RBF layout, so SV lines go through Python here.
         with open(path, "w") as f:
             f.write(f"kernel {model.kernel} {model.gamma:g} "
                     f"{model.coef0:g} {int(model.degree)}\n")
+            if model.task == "svr":
+                f.write("task svr\n")
             f.write(f"{model.b:g}\n")
             wrote = 0
             for i in range(n):
@@ -99,7 +102,13 @@ def load_model(path: str) -> SVMModel:
                                         float(parts[3]), int(parts[4]))
     else:
         gamma = float(lines[0])
-    # After the header line: an optional lone-scalar b line, then SVs
+    task = "svc"
+    if len(lines) > 1 and lines[1].startswith("task "):
+        task = lines[1].split()[1]
+        if task not in ("svc", "svr"):
+            raise ValueError(f"{path}: unknown task {task!r}")
+        lines = [lines[0]] + lines[2:]
+    # After the header line(s): an optional lone-scalar b line, then SVs
     # (the reference's seq.cpp layout omits b — SURVEY §2c).
     has_b = len(lines) > 1 and "," not in lines[1]
     b = float(lines[1]) if has_b else 0.0
@@ -120,4 +129,4 @@ def load_model(path: str) -> SVMModel:
         y[i] = int(float(parts[1]))
         x[i] = np.asarray(parts[2:], dtype=np.float32)
     return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=b, gamma=gamma,
-                    kernel=kernel, coef0=coef0, degree=degree)
+                    kernel=kernel, coef0=coef0, degree=degree, task=task)

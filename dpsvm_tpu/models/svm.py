@@ -40,6 +40,8 @@ class SVMModel:
     kernel: str = "rbf"   # LIBSVM -t family; "rbf" = reference parity
     coef0: float = 0.0
     degree: int = 3
+    task: str = "svc"     # "svc" (classification) | "svr" (regression,
+                          # coefficients encode delta = a - a*)
 
     @property
     def kernel_spec(self) -> KernelSpec:

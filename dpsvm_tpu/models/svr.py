@@ -1,0 +1,105 @@
+"""epsilon-SVR (support vector regression) on the classification solver.
+
+The reference is a binary classifier only; this framework also offers
+LIBSVM's epsilon-SVR (``svm-train -s 3``) — and it costs almost no new
+solver code, because the SVR dual IS a classification-shaped SMO problem
+over 2n variables (LIBSVM solves it with the same Solver class):
+
+    min  1/2 (a - a*)' K (a - a*) + p sum(a + a*) - y'(a - a*)
+    s.t. sum(a - a*) = 0,  0 <= a, a* <= C
+
+Stack beta = [a; a*] with pseudo-labels z = [+1...; -1...]: the dual
+gradient in Keerthi form is exactly the solver's f vector with
+initialization f0 = [p - y; -p - y] (classification's f0 = -z is the
+special case p=0, y=z), kernel rows taken at base indices, and the very
+same I_up/I_low masks, first/second-order selection, eta and
+independent-clip alpha step. So ``train_svr`` duplicates the rows,
+seeds f via the solvers' ``f_init`` hook, and runs the unmodified
+compiled paths — single-device, distributed, oracle, any kernel.
+
+The fitted regressor is an ``SVMModel`` with task="svr" whose
+coefficients encode delta_i = a_i - a*_i as (alpha=|delta|,
+y=sign(delta)): the existing batched decision function then computes
+the regression prediction  y(x) = sum_i delta_i K(x_i, x) - b  with no
+changes. (Sign check: an interior a_i has f_i = w.x_i + p - y_i = b at
+KKT, so the tube center is w.x - b.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.models.svm import SVMModel, decision_function
+
+
+def train_svr(x: np.ndarray, y: np.ndarray,
+              config: Optional[SVMConfig] = None
+              ) -> Tuple[SVMModel, TrainResult]:
+    """Fit an epsilon-SVR. y: (n,) float targets; tube half-width =
+    ``config.svr_epsilon`` (LIBSVM -p, default 0.1)."""
+    from dpsvm_tpu.api import train
+
+    config = config or SVMConfig()
+    config.validate()
+    if config.weight_pos != 1.0 or config.weight_neg != 1.0:
+        raise ValueError("class weights are a classification concept; "
+                         "they would weight the two SVR dual halves "
+                         "asymmetrically (use a per-sample-weight "
+                         "formulation instead)")
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.float32)
+    if x.ndim != 2:
+        raise ValueError(f"x must be (n, d), got shape {x.shape}")
+    if y.shape != (x.shape[0],):
+        raise ValueError(f"y must be ({x.shape[0]},), got {y.shape}")
+    n = x.shape[0]
+    p = np.float32(config.svr_epsilon)
+
+    x2n = np.vstack([x, x])
+    z = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
+    f0 = np.concatenate([p - y, -p - y]).astype(np.float32)
+
+    result = train(x2n, z, config, f_init=f0)
+
+    beta = np.asarray(result.alpha, np.float32)
+    delta = beta[:n] - beta[n:]
+    keep = delta != 0
+    model = SVMModel(
+        x_sv=np.ascontiguousarray(x[keep]),
+        alpha=np.abs(delta[keep]),
+        y_sv=np.sign(delta[keep]).astype(np.int32),
+        b=float(result.b),
+        gamma=float(result.gamma),
+        kernel=result.kernel,
+        coef0=float(result.coef0),
+        degree=int(result.degree),
+        task="svr",
+    )
+    return model, result
+
+
+def predict_svr(model: SVMModel, x_test: np.ndarray,
+                include_b: bool = True) -> np.ndarray:
+    """Continuous predictions y(x) = sum_i delta_i K(x_i, x) - b."""
+    if model.task != "svr":
+        raise ValueError("predict_svr needs a task='svr' model; use "
+                         "models.svm.predict for classifiers")
+    return decision_function(model, x_test, include_b=include_b)
+
+
+def evaluate_svr(model: SVMModel, x_test: np.ndarray, y_test: np.ndarray,
+                 include_b: bool = True) -> dict:
+    """MSE / MAE / R^2 on held-out targets."""
+    pred = predict_svr(model, x_test, include_b=include_b)
+    y_test = np.asarray(y_test, np.float32)
+    err = pred - y_test
+    ss_res = float(np.sum(err * err))
+    ss_tot = float(np.sum((y_test - y_test.mean()) ** 2))
+    return {
+        "mse": float(np.mean(err * err)),
+        "mae": float(np.mean(np.abs(err))),
+        "r2": 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0,
+    }

@@ -390,8 +390,13 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
 
 
 def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
-                      mesh: Optional[jax.sharding.Mesh] = None) -> TrainResult:
-    """Train over a 1-D device mesh; data arrives/leaves as host NumPy."""
+                      mesh: Optional[jax.sharding.Mesh] = None,
+                      f_init: Optional[np.ndarray] = None) -> TrainResult:
+    """Train over a 1-D device mesh; data arrives/leaves as host NumPy.
+
+    ``f_init`` overrides the classification f = -y initialization (SVR
+    seeding — see solver/smo.py); checkpoint resume takes precedence.
+    """
     config.validate()
     n, d = x.shape
     if mesh is None:
@@ -427,7 +432,11 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         f0[:n] = ckpt.f
         init = (alpha0, f0, ckpt.b_hi, ckpt.b_lo, ckpt.n_iter)
     else:
-        init = (np.zeros((n_pad,), np.float32), -yp,
+        f0 = -yp
+        if f_init is not None:
+            f0 = np.zeros((n_pad,), np.float32)
+            f0[:n] = np.asarray(f_init, np.float32)
+        init = (np.zeros((n_pad,), np.float32), f0,
                 -SENTINEL, SENTINEL, 0)
     # Per-shard row cache: `lines` lines per shard (the reference's -s is
     # per-rank lines too, svmTrainMain.cpp:70); 0 disables. Resume starts

@@ -98,6 +98,7 @@ def smo_reference(
     y: np.ndarray,
     config: SVMConfig,
     trace: Optional[List] = None,
+    f_init: Optional[np.ndarray] = None,
 ) -> TrainResult:
     """Train a binary RBF-SVM with the modified-SMO algorithm in NumPy.
 
@@ -125,7 +126,8 @@ def smo_reference(
 
     x2 = np.einsum("ij,ij->i", x, x).astype(np.float32)
     alpha = np.zeros(n, dtype=np.float32)
-    f = (-yf).copy()
+    f = ((-yf) if f_init is None
+         else np.asarray(f_init, np.float32)).copy()
 
     second_order = config.selection == "second-order"
 

@@ -194,8 +194,15 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
 
 
 def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
-                        device: Optional[jax.Device] = None) -> TrainResult:
-    """Train on one device. Data arrives as host NumPy, leaves as NumPy."""
+                        device: Optional[jax.Device] = None,
+                        f_init: Optional[np.ndarray] = None) -> TrainResult:
+    """Train on one device. Data arrives as host NumPy, leaves as NumPy.
+
+    ``f_init`` overrides the classification initialization f = -y; the
+    SVR wrapper uses it to seed the 2n-variable regression dual
+    (models/svr.py). A checkpoint resume takes precedence (the saved f
+    continues the identical trajectory).
+    """
     config.validate()
     n, d = x.shape
     gamma = float(config.resolve_gamma(d))
@@ -206,6 +213,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
     x2 = row_norms_sq(xd)
     carry = init_carry(yd, config.cache_size)
+    if f_init is not None:
+        carry = carry._replace(f=jnp.asarray(f_init, jnp.float32))
 
     ckpt = resume_state(config, n, d, gamma)
     if ckpt is not None:
