@@ -29,12 +29,13 @@ Public API
 ``load_model`` / ``save_model``    reference-compatible model file I/O
 ``predict`` / ``evaluate``         batched XLA inference
 ``DPSVMClassifier``                sklearn-protocol estimator facade
+``DPSVMRegressor``                 epsilon-SVR facade (models/svr.py)
 """
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
-from dpsvm_tpu.models.estimator import DPSVMClassifier
+from dpsvm_tpu.models.estimator import DPSVMClassifier, DPSVMRegressor
 from dpsvm_tpu.api import train, fit
 
 __version__ = "0.1.0"
@@ -51,4 +52,5 @@ __all__ = [
     "save_model",
     "load_model",
     "DPSVMClassifier",
+    "DPSVMRegressor",
 ]

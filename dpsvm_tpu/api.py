@@ -18,7 +18,8 @@ from dpsvm_tpu.models.svm import SVMModel
 
 def train(x: np.ndarray, y: np.ndarray,
           config: Optional[SVMConfig] = None,
-          f_init: Optional[np.ndarray] = None) -> TrainResult:
+          f_init: Optional[np.ndarray] = None,
+          alpha_init: Optional[np.ndarray] = None) -> TrainResult:
     """Train a binary SVM with the modified-SMO solver.
 
     x: (n, d) float features; y: (n,) labels in {+1, -1}.
@@ -41,16 +42,19 @@ def train(x: np.ndarray, y: np.ndarray,
             "(CLI: train --multiclass)")
     if config.backend == "numpy":
         from dpsvm_tpu.solver.oracle import smo_reference
-        return smo_reference(x, y, config, f_init=f_init)
+        return smo_reference(x, y, config, f_init=f_init,
+                             alpha_init=alpha_init)
     if config.shards > 1:
         from dpsvm_tpu.parallel.dist_smo import train_distributed
-        return train_distributed(x, y, config, f_init=f_init)
+        return train_distributed(x, y, config, f_init=f_init,
+                                 alpha_init=alpha_init)
     from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
-    if f_init is None and use_fused(config):
-        # the fused kernel hard-codes the classification f = -y init
+    if f_init is None and alpha_init is None and use_fused(config):
+        # the fused kernel hard-codes the classification init
         return train_single_device_fused(x, y, config)
     from dpsvm_tpu.solver.smo import train_single_device
-    return train_single_device(x, y, config, f_init=f_init)
+    return train_single_device(x, y, config, f_init=f_init,
+                               alpha_init=alpha_init)
 
 
 def fit(x: np.ndarray, y: np.ndarray,

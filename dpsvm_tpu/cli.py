@@ -97,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fused Pallas iteration kernel: 'on' forces it; "
                          "'auto' currently prefers the XLA path (faster "
                          "on measured hardware, see solver/fused.py)")
+    tr.add_argument("--one-class", action="store_true",
+                    help="one-class SVM / novelty detection on unlabeled "
+                         "rows (LIBSVM svm-train -s 2 analog; the label "
+                         "column is ignored)")
+    tr.add_argument("--nu", type=float, default=0.5,
+                    help="one-class outlier-fraction bound (LIBSVM -n)")
     tr.add_argument("--svr", action="store_true",
                     help="epsilon-SVR regression (float targets; LIBSVM "
                          "svm-train -s 3 analog)")
@@ -201,7 +207,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "supported", file=sys.stderr)
             return 2
 
-    if args.svr:
+    if args.svr and args.one_class:
+        print("error: --svr and --one-class are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if args.svr or args.one_class:
+        mode = "--svr" if args.svr else "--one-class"
         conflicts = [("--multiclass", args.multiclass),
                      ("--probability", args.probability),
                      ("--check-kkt", args.check_kkt),
@@ -211,11 +222,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         for flag, on in conflicts:
             if on:
                 print(f"error: {flag} is a classification flag; it does "
-                      "not apply to --svr", file=sys.stderr)
+                      f"not apply to {mode}", file=sys.stderr)
                 return 2
 
     x, y = load_dataset(args.input, args.num_ex, args.num_att,
-                        float_labels=args.svr)
+                        float_labels=args.svr or args.one_class)
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, kernel=args.kernel,
         degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
@@ -252,6 +263,20 @@ def cmd_train(args: argparse.Namespace) -> int:
         print(f"Training accuracy: {acc:.6f}")
         print(f"Training time: "
               f"{sum(r.train_seconds for r in results):.3f} s")
+        return 0
+
+    if args.one_class:
+        from dpsvm_tpu.models.oneclass import predict_oneclass, train_oneclass
+        model, result = train_oneclass(x, args.nu, config)
+        n_sv = save_model(model, args.model)
+        inlier = predict_oneclass(model, x)
+        print(f"Number of SVs: {n_sv}")
+        print(f"rho: {result.b:.6f}")
+        print(f"Training iterations: {result.n_iter}"
+              + ("" if result.converged else " (NOT converged)"))
+        print(f"Training inlier fraction: {float(np.mean(inlier > 0)):.6f} "
+              f"(nu = {args.nu})")
+        print(f"Training time: {result.train_seconds:.3f} s")
         return 0
 
     if args.svr:
@@ -363,6 +388,24 @@ def cmd_test(args: argparse.Namespace) -> int:
         print(f"error: dataset has {x.shape[1]} attributes, model has "
               f"{model.num_attributes}", file=sys.stderr)
         return 2
+    if model.task == "oneclass":
+        if args.proba:
+            print("error: --proba applies to classifiers only",
+                  file=sys.stderr)
+            return 2
+        from dpsvm_tpu.models.oneclass import predict_oneclass
+        pred = predict_oneclass(model, x)
+        if args.predictions:
+            with open(args.predictions, "w") as f:
+                f.writelines(f"{int(v)}\n" for v in pred)
+        print(f"Number of SVs: {model.n_sv}")
+        print(f"Inlier fraction: {float(np.mean(pred > 0)):.6f}")
+        labs = np.asarray(y)
+        if set(np.unique(labs.astype(np.int64))) <= {-1, 1}:
+            acc = float(np.mean(pred == labs.astype(np.int32)))
+            print(f"Test accuracy (+1 inlier / -1 outlier labels): "
+                  f"{acc:.6f}")
+        return 0
     if model.task == "svr":
         if args.proba:
             print("error: --proba applies to classifiers only",

@@ -63,6 +63,17 @@ class SVMConfig:
                                         # 476-481) or "second-order" (the
                                         # LIBSVM WSS2 rule — usually far
                                         # fewer iterations to convergence)
+    clip: str = "independent"           # alpha-step clip rule:
+                                        # "independent" (the reference's,
+                                        # svmTrainMain.cpp:294-295 — both
+                                        # alphas clipped separately, lets
+                                        # sum(alpha*y) drift) or
+                                        # "pairwise" (textbook/LIBSVM
+                                        # joint box — conserves the
+                                        # equality constraint exactly;
+                                        # required by one-class, where
+                                        # the constraint value nu*n is
+                                        # part of the model)
     select_impl: str = "argminmax"      # first-order selection lowering:
                                         # "argminmax" (two jnp.arg* +
                                         # gathers, XLA fuses) or "packed"
@@ -110,6 +121,8 @@ class SVMConfig:
             return "shards > 1"
         if self.kernel != "rbf":
             return f"kernel {self.kernel!r} (RBF only)"
+        if self.clip != "independent":
+            return f"clip {self.clip!r} (reference clip only)"
         if self.cache_size > 0:
             return "the kernel-row cache (cache_size > 0)"
         if self.selection != "first-order":
@@ -169,6 +182,9 @@ class SVMConfig:
         if self.svr_epsilon < 0:
             raise ValueError(
                 f"svr_epsilon must be >= 0, got {self.svr_epsilon}")
+        if self.clip not in ("independent", "pairwise"):
+            raise ValueError(f"clip must be 'independent' or 'pairwise', "
+                             f"got {self.clip!r}")
         if self.kernel not in ("linear", "poly", "rbf", "sigmoid"):
             raise ValueError(f"kernel must be 'linear', 'poly', 'rbf' or "
                              f"'sigmoid', got {self.kernel!r}")

@@ -22,17 +22,45 @@ from dpsvm_tpu.config import SVMConfig
 
 try:
     # Optional: inheriting sklearn's mixins provides the estimator-tag
-    # protocol its meta-utilities (clone, cross_val_score, pipelines)
-    # check for. Everything else here is self-contained, so without
-    # sklearn the class is a plain object with the same duck-typed API.
+    # protocol its meta-utilities (clone, cross_val_score, pipelines,
+    # is_classifier/is_regressor) check for. Everything else here is
+    # self-contained, so without sklearn the classes are plain objects
+    # with the same duck-typed API.
     from sklearn.base import BaseEstimator as _SkBase
     from sklearn.base import ClassifierMixin as _SkClassifier
-    _BASES = (_SkClassifier, _SkBase)
+    from sklearn.base import RegressorMixin as _SkRegressor
+    _CLF_BASES = (_SkClassifier, _SkBase)
+    _REG_BASES = (_SkRegressor, _SkBase)
 except ImportError:                                   # pragma: no cover
-    _BASES = (object,)
+    _CLF_BASES = (object,)
+    _REG_BASES = (object,)
 
 
-class DPSVMClassifier(*_BASES):
+class _ParamsMixin:
+    """get_params/set_params/_check_fitted derived from one per-class
+    ``_PARAM_NAMES`` tuple, so each hyperparameter is declared exactly
+    twice (init signature + tuple) instead of four times."""
+
+    _PARAM_NAMES: tuple = ()
+    _FITTED_ATTR: str = "_model"
+
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._PARAM_NAMES}
+
+    def set_params(self, **params):
+        for k, v in params.items():
+            if k not in self._PARAM_NAMES:
+                raise ValueError(f"invalid parameter {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def _check_fitted(self) -> None:
+        if not hasattr(self, self._FITTED_ATTR):
+            raise RuntimeError(f"this {type(self).__name__} is not "
+                               "fitted yet; call fit(X, y) first")
+
+
+class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
     """SVM classifier on the modified-SMO TPU solver (LIBSVM kernel family).
 
     Parameters mirror ``sklearn.svm.SVC`` where they overlap (C, kernel,
@@ -60,19 +88,10 @@ class DPSVMClassifier(*_BASES):
         self.matmul_precision = matmul_precision
         self.probability = probability
 
-    # --- sklearn protocol: params ---
-
-    def get_params(self, deep: bool = True) -> Dict[str, Any]:
-        return {k: getattr(self, k) for k in (
-            "C", "kernel", "degree", "gamma", "coef0", "tol", "max_iter",
-            "selection", "shards", "matmul_precision", "probability")}
-
-    def set_params(self, **params) -> "DPSVMClassifier":
-        for k, v in params.items():
-            if k not in self.get_params():
-                raise ValueError(f"invalid parameter {k!r}")
-            setattr(self, k, v)
-        return self
+    _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "tol",
+                    "max_iter", "selection", "shards", "matmul_precision",
+                    "probability")
+    _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
         return SVMConfig(c=self.C, kernel=self.kernel, degree=self.degree,
@@ -130,11 +149,6 @@ class DPSVMClassifier(*_BASES):
             setattr(self, k, v)
         return self
 
-    def _check_fitted(self) -> None:
-        if not hasattr(self, "classes_"):
-            raise RuntimeError("this DPSVMClassifier is not fitted yet; "
-                               "call fit(X, y) first")
-
     def decision_function(self, X) -> np.ndarray:
         self._check_fitted()
         if self._model is None:
@@ -164,3 +178,71 @@ class DPSVMClassifier(*_BASES):
 
     def score(self, X, y) -> float:
         return float(np.mean(self.predict(X) == np.asarray(y)))
+
+
+class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
+    """epsilon-SVR on the modified-SMO TPU solver, sklearn-SVR-shaped.
+
+    Parameters mirror ``sklearn.svm.SVR`` where they overlap (C, kernel,
+    degree, gamma, coef0, epsilon = tube half-width, tol, max_iter) plus
+    this framework's execution knobs. See models/svr.py for the
+    2n-variable mapping onto the classification solver.
+    """
+
+    def __init__(self, C: float = 1.0, kernel: str = "rbf",
+                 degree: int = 3, gamma: Optional[float] = None,
+                 coef0: float = 0.0, epsilon: float = 0.1,
+                 tol: float = 1e-3, max_iter: int = 150_000,
+                 selection: str = "first-order", shards: int = 1,
+                 matmul_precision: str = "highest"):
+        self.C = C
+        self.kernel = kernel
+        self.degree = degree
+        self.gamma = gamma
+        self.coef0 = coef0
+        self.epsilon = epsilon
+        self.tol = tol
+        self.max_iter = max_iter
+        self.selection = selection
+        self.shards = shards
+        self.matmul_precision = matmul_precision
+
+    _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "epsilon",
+                    "tol", "max_iter", "selection", "shards",
+                    "matmul_precision")
+
+    def _config(self) -> SVMConfig:
+        return SVMConfig(c=self.C, kernel=self.kernel, degree=self.degree,
+                         gamma=self.gamma, coef0=self.coef0,
+                         epsilon=self.tol, svr_epsilon=self.epsilon,
+                         max_iter=self.max_iter, selection=self.selection,
+                         shards=self.shards,
+                         matmul_precision=self.matmul_precision)
+
+    def fit(self, X, y) -> "DPSVMRegressor":
+        from dpsvm_tpu.models.svr import train_svr
+
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        model, result = train_svr(X, y, self._config())
+        self._model = model
+        self.n_iter_ = result.n_iter
+        self.converged_ = result.converged
+        self.intercept_ = np.array([-result.b])
+        self.n_support_ = np.array([model.n_sv])
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        from dpsvm_tpu.models.svr import predict_svr
+
+        self._check_fitted()
+        return np.asarray(predict_svr(self._model,
+                                      np.asarray(X, np.float32)))
+
+    def score(self, X, y) -> float:
+        """R^2, the sklearn regressor convention."""
+        from dpsvm_tpu.models.svr import evaluate_svr
+
+        self._check_fitted()
+        return float(evaluate_svr(self._model, np.asarray(X, np.float32),
+                                  np.asarray(y, np.float32))["r2"])

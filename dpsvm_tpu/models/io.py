@@ -44,16 +44,16 @@ def save_model(model: SVMModel, path: str) -> int:
     y = np.ascontiguousarray(model.y_sv, np.int32)
     x = np.ascontiguousarray(model.x_sv, np.float32)
     n, d = x.shape
-    if model.task == "svr" or model.kernel != "rbf":
+    if model.task != "svc" or model.kernel != "rbf":
         # Beyond-reference models (regression, or non-RBF kernels) use
         # the self-describing header; the native writer emits only the
         # reference's RBF layout, so SV lines go through Python here.
         with open(path, "w") as f:
-            f.write(f"kernel {model.kernel} {model.gamma:g} "
-                    f"{model.coef0:g} {int(model.degree)}\n")
-            if model.task == "svr":
-                f.write("task svr\n")
-            f.write(f"{model.b:g}\n")
+            f.write(f"kernel {model.kernel} {model.gamma:.9g} "
+                    f"{model.coef0:.9g} {int(model.degree)}\n")
+            if model.task != "svc":
+                f.write(f"task {model.task}\n")
+            f.write(f"{model.b:.9g}\n")
             wrote = 0
             for i in range(n):
                 if not alpha[i] > 0:
@@ -73,7 +73,7 @@ def save_model(model: SVMModel, path: str) -> int:
         if wrote >= 0:
             return int(wrote)
     with open(path, "w") as f:
-        f.write(f"{model.gamma:g}\n{model.b:g}\n")
+        f.write(f"{model.gamma:.9g}\n{model.b:.9g}\n")
         wrote = 0
         for i in range(n):
             if not alpha[i] > 0:
@@ -105,7 +105,7 @@ def load_model(path: str) -> SVMModel:
     task = "svc"
     if len(lines) > 1 and lines[1].startswith("task "):
         task = lines[1].split()[1]
-        if task not in ("svc", "svr"):
+        if task not in ("svc", "svr", "oneclass"):
             raise ValueError(f"{path}: unknown task {task!r}")
         lines = [lines[0]] + lines[2:]
     # After the header line(s): an optional lone-scalar b line, then SVs

@@ -131,8 +131,10 @@ long dpsvm_write_model(const char* path, double gamma, double b,
                        long n, long d) {
     FILE* f = fopen(path, "wb");
     if (!f) return -1;
-    fprintf(f, "%g\n", gamma);
-    fprintf(f, "%g\n", b);
+    // %.9g: float32 round-trips exactly; %g (6 digits) loses
+    // ~1e-5 absolute on O(1) intercepts (one-class rho).
+    fprintf(f, "%.9g\n", gamma);
+    fprintf(f, "%.9g\n", b);
     long n_sv = 0;
     for (long i = 0; i < n; ++i) {
         if (!(alpha[i] > 0.0f)) continue;

@@ -1,0 +1,54 @@
+"""The SMO alpha pair step, shared by the single-device and distributed
+solvers.
+
+Two clip rules:
+
+* "independent" — the reference's (``svmTrainMain.cpp:289-295``):
+  a_hi' computed from the UNCLIPPED a_lo', then both clipped to their
+  boxes separately. Lets sum(alpha*y) drift off the dual manifold
+  (documented in ops/diagnostics.py); reproduced bit-for-bit for parity.
+* "pairwise" — the textbook/LIBSVM joint box: a_lo' clipped to the
+  feasible segment of the equality-constraint line through the pair,
+  a_hi' moved along it. Conserves sum(alpha*y) exactly; one-class
+  training requires it (its constraint value nu*n is part of the
+  model — models/oneclass.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi, b_lo_sel, eta,
+                    c_hi, c_lo, pairwise: bool):
+    """Returns (a_hi_new, a_lo_new). ``pairwise`` is static."""
+    s = y_lo * y_hi
+    a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
+    if pairwise:
+        # The I-set masks test alpha == 0 / alpha == C EXACTLY
+        # (ops/selection.py, matching the reference's clip outputs), so
+        # when the joint clip binds, the partner alpha must land on the
+        # LITERAL corner value — computing it arithmetically as
+        # a_hi + s*(a_lo - bound) leaves it 1 ulp off the box and the
+        # pair freezes: it keeps being selected but cannot move
+        # (observed: alpha = 0.99999994 stuck in I_up forever).
+        pos = s > 0
+        ssum = a_lo + a_hi                   # conserved when s > 0
+        diff = a_hi - a_lo                   # conserved when s < 0
+        lo_b = jnp.maximum(0.0, jnp.where(pos, ssum - c_hi, a_lo - a_hi))
+        hi_b = jnp.minimum(c_lo, jnp.where(pos, ssum, a_lo + c_hi - a_hi))
+        a_lo_n = jnp.clip(a_lo_u, lo_b, hi_b)
+        hi_at_lo = jnp.where(pos,
+                             jnp.where(lo_b > 0, c_hi, ssum),
+                             jnp.where(lo_b > 0, 0.0, diff))
+        hi_at_hi = jnp.where(pos,
+                             jnp.where(hi_b < c_lo, 0.0, ssum - c_lo),
+                             jnp.where(hi_b < c_lo, c_hi, diff + c_lo))
+        a_hi_n = jnp.where(a_lo_u <= lo_b, hi_at_lo,
+                           jnp.where(a_lo_u >= hi_b, hi_at_hi,
+                                     a_hi + s * (a_lo - a_lo_u)))
+    else:
+        a_hi_u = a_hi + s * (a_lo - a_lo_u)      # uses UNCLIPPED a_lo'
+        a_lo_n = jnp.clip(a_lo_u, 0.0, c_lo)
+        a_hi_n = jnp.clip(a_hi_u, 0.0, c_hi)
+    return a_hi_n, a_lo_n

@@ -47,6 +47,7 @@ from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
+from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
@@ -127,7 +128,8 @@ def _broadcast_row(xs, ys, x2s, alpha_s, loc, own, gi, *, shard_x: bool):
 def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
                     c: float, kspec: KernelSpec, n_per_shard: int,
                     shard_x: bool, precision,
-                    weights=(1.0, 1.0)) -> DistCarry:
+                    weights=(1.0, 1.0),
+                    pairwise_clip: bool = False) -> DistCarry:
     """One second-order (WSS2) iteration over the mesh: the hi row is
     broadcast first, every shard scores its local violators against it,
     and the lo index comes from a second tiny all_gather. Two row
@@ -204,11 +206,9 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
                                            loc_lo, own_lo)
     eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, 1e-12)
 
-    s = y_lo * y_hi
-    a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
-    a_hi_u = a_hi + s * (a_lo - a_lo_u)
-    a_lo_n = jnp.clip(a_lo_u, 0.0, c_of_y(y_lo))
-    a_hi_n = jnp.clip(a_hi_u, 0.0, c_of_y(y_hi))
+    a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi,
+                                     b_lo_sel, eta, c_of_y(y_hi),
+                                     c_of_y(y_lo), pairwise_clip)
 
     alpha_s = alpha_s.at[loc_lo].set(
         jnp.where(own_lo, a_lo_n, alpha_s[loc_lo]))
@@ -226,7 +226,8 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
                c: float, kspec: KernelSpec, n_per_shard: int,
                shard_x: bool, precision, weights=(1.0, 1.0),
                use_cache: bool = False,
-               packed_select: bool = False) -> DistCarry:
+               packed_select: bool = False,
+               pairwise_clip: bool = False) -> DistCarry:
     """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
     slices when shard_x else full replicated arrays."""
     alpha_s, f_s = carry.alpha, carry.f
@@ -322,11 +323,9 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     eta = k_hh + k_ll - 2.0 * k_hl
 
     # --- alpha update: replicated scalar math (svmTrainMain.cpp:282-295) ---
-    s = y_lo * y_hi
-    a_lo_u = a_lo + y_lo * (b_hi - b_lo) / eta
-    a_hi_u = a_hi + s * (a_lo - a_lo_u)
-    a_lo_n = jnp.clip(a_lo_u, 0.0, c_of_y(y_lo))
-    a_hi_n = jnp.clip(a_hi_u, 0.0, c_of_y(y_hi))
+    a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi, b_lo,
+                                     eta, c_of_y(y_hi), c_of_y(y_lo),
+                                     pairwise_clip)
 
     # masked writeback, lo then hi (train_step2 order, svmTrain.cu:491-492)
     alpha_s = alpha_s.at[loc_lo].set(
@@ -346,16 +345,18 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
                        epsilon: float, n_per_shard: int, shard_x: bool,
                        precision_name: str, second_order: bool = False,
                        weights=(1.0, 1.0), use_cache: bool = False,
-                       packed_select: bool = False):
+                       packed_select: bool = False,
+                       pairwise_clip: bool = False):
     precision = getattr(lax.Precision, precision_name)
     kspec = KernelSpec.coerce(kspec)
     x_spec = P(SHARD_AXIS) if shard_x else P()
     if second_order:
         step = _dist_step_wss2
-        extra = {}
+        extra = {"pairwise_clip": pairwise_clip}
     else:
         step = _dist_step
-        extra = {"use_cache": use_cache, "packed_select": packed_select}
+        extra = {"use_cache": use_cache, "packed_select": packed_select,
+                 "pairwise_clip": pairwise_clip}
 
     def run(carry: DistCarry, xs, ys, x2s, valid, limit):
         def cond(s: DistCarry):
@@ -391,7 +392,8 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
 
 def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                       mesh: Optional[jax.sharding.Mesh] = None,
-                      f_init: Optional[np.ndarray] = None) -> TrainResult:
+                      f_init: Optional[np.ndarray] = None,
+                      alpha_init: Optional[np.ndarray] = None) -> TrainResult:
     """Train over a 1-D device mesh; data arrives/leaves as host NumPy.
 
     ``f_init`` overrides the classification f = -y initialization (SVR
@@ -436,8 +438,10 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         if f_init is not None:
             f0 = np.zeros((n_pad,), np.float32)
             f0[:n] = np.asarray(f_init, np.float32)
-        init = (np.zeros((n_pad,), np.float32), f0,
-                -SENTINEL, SENTINEL, 0)
+        a0 = np.zeros((n_pad,), np.float32)
+        if alpha_init is not None:
+            a0[:n] = np.asarray(alpha_init, np.float32)
+        init = (a0, f0, -SENTINEL, SENTINEL, 0)
     # Per-shard row cache: `lines` lines per shard (the reference's -s is
     # per-rank lines too, svmTrainMain.cpp:70); 0 disables. Resume starts
     # cold — the checkpoint holds only (alpha, f), like the reference's
@@ -463,7 +467,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                 (float(config.weight_pos),
                                  float(config.weight_neg)),
                                 use_cache=lines > 0,
-                                packed_select=config.select_impl == "packed")
+                                packed_select=config.select_impl == "packed",
+                                pairwise_clip=config.clip == "pairwise")
 
     def step_chunk(c, lim):
         limit = jax.device_put(jnp.int32(lim), repl)

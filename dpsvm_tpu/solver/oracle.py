@@ -99,6 +99,7 @@ def smo_reference(
     config: SVMConfig,
     trace: Optional[List] = None,
     f_init: Optional[np.ndarray] = None,
+    alpha_init: Optional[np.ndarray] = None,
 ) -> TrainResult:
     """Train a binary RBF-SVM with the modified-SMO algorithm in NumPy.
 
@@ -125,11 +126,13 @@ def smo_reference(
     sent = np.float32(SENTINEL)
 
     x2 = np.einsum("ij,ij->i", x, x).astype(np.float32)
-    alpha = np.zeros(n, dtype=np.float32)
+    alpha = (np.zeros(n, dtype=np.float32) if alpha_init is None
+             else np.asarray(alpha_init, np.float32).copy())
     f = ((-yf) if f_init is None
          else np.asarray(f_init, np.float32)).copy()
 
     second_order = config.selection == "second-order"
+    pairwise_clip = config.clip == "pairwise"
 
     n_iter = 0
     b_hi = np.float32(-sent)
@@ -190,11 +193,44 @@ def smo_reference(
         # the chosen violator may not be the max one.
         b_lo_sel = f_low[i_lo]
         a_lo_u = np.float32(a_lo + y_lo * (b_hi - b_lo_sel) / eta)
-        a_hi_u = np.float32(a_hi + s * (a_lo - a_lo_u))
         c_lo = np.float32(c if np.isscalar(c) else c[i_lo])
         c_hi = np.float32(c if np.isscalar(c) else c[i_hi])
-        a_lo_n = np.float32(min(max(a_lo_u, np.float32(0.0)), c_lo))
-        a_hi_n = np.float32(min(max(a_hi_u, np.float32(0.0)), c_hi))
+        if pairwise_clip:
+            # textbook/LIBSVM joint box; bound hits set the partner to
+            # the LITERAL corner value (exact-comparison masks — see
+            # ops/update.py for the full rationale)
+            if s > 0:
+                ssum = np.float32(a_lo + a_hi)
+                lo_b = max(np.float32(0.0), np.float32(ssum - c_hi))
+                hi_b = min(c_lo, ssum)
+                if a_lo_u <= lo_b:
+                    a_lo_n = lo_b
+                    a_hi_n = c_hi if lo_b > 0 else ssum
+                elif a_lo_u >= hi_b:
+                    a_lo_n = hi_b
+                    a_hi_n = (np.float32(ssum - c_lo) if hi_b == c_lo
+                              else np.float32(0.0))
+                else:
+                    a_lo_n = a_lo_u
+                    a_hi_n = np.float32(a_hi + s * (a_lo - a_lo_u))
+            else:
+                diff = np.float32(a_hi - a_lo)
+                lo_b = max(np.float32(0.0), np.float32(a_lo - a_hi))
+                hi_b = min(c_lo, np.float32(a_lo + c_hi - a_hi))
+                if a_lo_u <= lo_b:
+                    a_lo_n = lo_b
+                    a_hi_n = np.float32(0.0) if lo_b > 0 else diff
+                elif a_lo_u >= hi_b:
+                    a_lo_n = hi_b
+                    a_hi_n = (np.float32(diff + c_lo) if hi_b == c_lo
+                              else c_hi)
+                else:
+                    a_lo_n = a_lo_u
+                    a_hi_n = np.float32(a_hi + s * (a_lo - a_lo_u))
+        else:
+            a_hi_u = np.float32(a_hi + s * (a_lo - a_lo_u))
+            a_lo_n = np.float32(min(max(a_lo_u, np.float32(0.0)), c_lo))
+            a_hi_n = np.float32(min(max(a_hi_u, np.float32(0.0)), c_hi))
         alpha[i_lo] = a_lo_n
         alpha[i_hi] = a_hi_n
         f = (f + (a_hi_n - a_hi) * y_hi * k[0]

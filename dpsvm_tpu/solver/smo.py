@@ -35,6 +35,7 @@ from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
+from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.solver.driver import host_training_loop, resume_state
 
 
@@ -65,7 +66,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              c: float, kspec: KernelSpec, *, use_cache: bool = False,
              second_order: bool = False, weights=(1.0, 1.0),
              precision=lax.Precision.HIGHEST,
-             packed_select: bool = False) -> SMOCarry:
+             packed_select: bool = False,
+             pairwise_clip: bool = False) -> SMOCarry:
     """One modified-SMO iteration (select -> eta -> alpha -> f).
 
     ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
@@ -145,11 +147,9 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 
     y_hi, y_lo = y[i_hi], y[i_lo]
     a_hi, a_lo = alpha[i_hi], alpha[i_lo]
-    s = y_lo * y_hi
-    a_lo_u = a_lo + y_lo * (b_hi - b_lo_sel) / eta
-    a_hi_u = a_hi + s * (a_lo - a_lo_u)          # uses UNCLIPPED a_lo_u
-    a_lo_n = jnp.clip(a_lo_u, 0.0, c_of(i_lo))
-    a_hi_n = jnp.clip(a_hi_u, 0.0, c_of(i_hi))
+    a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi,
+                                     b_lo_sel, eta, c_of(i_hi), c_of(i_lo),
+                                     pairwise_clip)
 
     # Write order lo-then-hi mirrors train_step2 (svmTrain.cu:491-492) for
     # the i_hi == i_lo corner.
@@ -165,7 +165,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                         use_cache: bool, precision_name: str,
                         second_order: bool = False,
                         weights=(1.0, 1.0),
-                        packed_select: bool = False):
+                        packed_select: bool = False,
+                        pairwise_clip: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit.
@@ -187,7 +188,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                                second_order=second_order,
                                weights=weights,
                                precision=precision,
-                               packed_select=packed_select),
+                               packed_select=packed_select,
+                               pairwise_clip=pairwise_clip),
             carry)
 
     return jax.jit(run, donate_argnums=(0,))
@@ -195,13 +197,17 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
 
 def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                         device: Optional[jax.Device] = None,
-                        f_init: Optional[np.ndarray] = None) -> TrainResult:
+                        f_init: Optional[np.ndarray] = None,
+                        alpha_init: Optional[np.ndarray] = None
+                        ) -> TrainResult:
     """Train on one device. Data arrives as host NumPy, leaves as NumPy.
 
-    ``f_init`` overrides the classification initialization f = -y; the
-    SVR wrapper uses it to seed the 2n-variable regression dual
-    (models/svr.py). A checkpoint resume takes precedence (the saved f
-    continues the identical trajectory).
+    ``f_init`` / ``alpha_init`` override the classification
+    initialization (f = -y, alpha = 0); the SVR and one-class wrappers
+    use them to seed their duals (models/svr.py, models/oneclass.py —
+    the caller is responsible for a consistent pair: f must equal the
+    dual gradient at alpha). A checkpoint resume takes precedence (the
+    saved state continues the identical trajectory).
     """
     config.validate()
     n, d = x.shape
@@ -215,6 +221,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     carry = init_carry(yd, config.cache_size)
     if f_init is not None:
         carry = carry._replace(f=jnp.asarray(f_init, jnp.float32))
+    if alpha_init is not None:
+        carry = carry._replace(alpha=jnp.asarray(alpha_init, jnp.float32))
 
     ckpt = resume_state(config, n, d, gamma)
     if ckpt is not None:
@@ -231,7 +239,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                  config.selection == "second-order",
                                  (float(config.weight_pos),
                                   float(config.weight_neg)),
-                                 config.select_impl == "packed")
+                                 config.select_impl == "packed",
+                                 config.clip == "pairwise")
 
     return host_training_loop(
         config, gamma, n, d, carry,

@@ -1,0 +1,125 @@
+"""One-class SVM: the nu-seeded run of the classification solver.
+
+See models/oneclass.py — LIBSVM's one-class dual (box [0,1],
+sum(alpha) = nu*n, all labels +1) runs on the unmodified solvers via
+the alpha_init/f_init hooks and the pairwise clip (which conserves the
+constraint value exactly; the reference's independent clip drifts it).
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.io import load_model, save_model
+from dpsvm_tpu.models.oneclass import (predict_oneclass, score_oneclass,
+                                       train_oneclass)
+
+
+@pytest.fixture(scope="module")
+def cloud():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(300, 4)).astype(np.float32)
+
+
+def test_oneclass_constraint_and_outlier_fraction(cloud):
+    model, result = train_oneclass(cloud, nu=0.2,
+                                   config=SVMConfig(max_iter=50000))
+    assert result.converged
+    # pairwise clip conserves the constraint exactly
+    assert abs(float(np.sum(result.alpha)) - 0.2 * len(cloud)) < 1e-3
+    out_frac = float(np.mean(predict_oneclass(model, cloud) < 0))
+    # nu bounds the outlier fraction (within boundary slack)
+    assert abs(out_frac - 0.2) < 0.05
+
+
+def test_oneclass_matches_sklearn(cloud):
+    sklearn_svm = pytest.importorskip("sklearn.svm")
+    model, _ = train_oneclass(cloud, nu=0.2,
+                              config=SVMConfig(max_iter=50000))
+    sk = sklearn_svm.OneClassSVM(nu=0.2, gamma=1 / cloud.shape[1]).fit(cloud)
+    assert abs(model.b - float(np.ravel(sk.offset_)[0])) < 1e-3
+    np.testing.assert_allclose(score_oneclass(model, cloud),
+                               sk.decision_function(cloud), atol=2e-3)
+    agree = np.mean(predict_oneclass(model, cloud) == sk.predict(cloud))
+    assert agree >= 0.98                      # boundary ties only
+
+
+def test_oneclass_flags_outliers(cloud):
+    model, _ = train_oneclass(cloud, nu=0.1,
+                              config=SVMConfig(max_iter=50000))
+    far = np.full((5, cloud.shape[1]), 25.0, np.float32)
+    assert (predict_oneclass(model, far) == -1).all()
+    center = np.zeros((3, cloud.shape[1]), np.float32)
+    assert (predict_oneclass(model, center) == 1).all()
+
+
+def test_oneclass_model_roundtrip(tmp_path, cloud):
+    model, _ = train_oneclass(cloud, nu=0.3,
+                              config=SVMConfig(max_iter=50000))
+    p = str(tmp_path / "m.oc")
+    save_model(model, p)
+    back = load_model(p)
+    assert back.task == "oneclass"
+    np.testing.assert_allclose(score_oneclass(back, cloud),
+                               score_oneclass(model, cloud),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_oneclass_distributed_parity(cloud):
+    m1, _ = train_oneclass(cloud, nu=0.2, config=SVMConfig(max_iter=50000))
+    m8, r8 = train_oneclass(cloud, nu=0.2,
+                            config=SVMConfig(shards=8, max_iter=50000))
+    assert r8.converged
+    np.testing.assert_allclose(score_oneclass(m8, cloud),
+                               score_oneclass(m1, cloud), atol=2e-3)
+
+
+def test_oneclass_numpy_backend(cloud):
+    m, r = train_oneclass(cloud, nu=0.2,
+                          config=SVMConfig(backend="numpy",
+                                           max_iter=50000))
+    assert r.converged
+    assert abs(float(np.sum(r.alpha)) - 0.2 * len(cloud)) < 1e-3
+
+
+def test_oneclass_bad_nu(cloud):
+    with pytest.raises(ValueError, match="nu"):
+        train_oneclass(cloud, nu=0.0)
+    with pytest.raises(ValueError, match="nu"):
+        train_oneclass(cloud, nu=1.0)
+
+
+def test_pairwise_clip_classification_still_converges(blobs_small):
+    """clip='pairwise' is a user-selectable variant on the classifier
+    too; it must reach the same solution quality as the reference clip."""
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.models.svm import evaluate
+
+    x, y = blobs_small
+    m_ref, r_ref = fit(x, y, SVMConfig(c=4.0, max_iter=5000))
+    m_pw, r_pw = fit(x, y, SVMConfig(c=4.0, max_iter=5000,
+                                     clip="pairwise"))
+    assert r_ref.converged and r_pw.converged
+    assert evaluate(m_pw, x, y) == evaluate(m_ref, x, y)
+    # pairwise conserves the dual equality exactly
+    assert abs(float(np.sum(np.asarray(r_pw.alpha) * y))) < 1e-4
+
+
+def test_cli_oneclass(tmp_path, cloud):
+    from dpsvm_tpu.cli import main
+
+    data = str(tmp_path / "oc.csv")
+    with open(data, "w") as f:
+        for xi in cloud:
+            f.write("0," + ",".join(f"{v:.6f}" for v in xi) + "\n")
+    model = str(tmp_path / "m.oc")
+    assert main(["train", "-f", data, "-m", model, "--one-class",
+                 "--nu", "0.2", "-q"]) == 0
+    assert load_model(model).task == "oneclass"
+    preds = str(tmp_path / "p.txt")
+    assert main(["test", "-f", data, "-m", model,
+                 "--predictions", preds]) == 0
+    vals = np.loadtxt(preds)
+    assert set(np.unique(vals)) <= {-1.0, 1.0}
+    assert main(["train", "-f", data, "-m", model, "--one-class",
+                 "--svr"]) == 2

@@ -167,3 +167,14 @@ def test_cli_svr_zero_sv_tube(tmp_path, reg_data):
                  "-q"]) == 1
     import os
     assert not os.path.exists(model)
+
+
+def test_regressor_estimator(reg_data):
+    from dpsvm_tpu.models.estimator import DPSVMRegressor
+
+    x, y = reg_data
+    reg = DPSVMRegressor(C=10.0, epsilon=0.05, max_iter=20000).fit(x, y)
+    assert reg.converged_
+    assert reg.score(x, y) > 0.99
+    assert reg.predict(x[:7]).shape == (7,)
+    assert reg.get_params()["epsilon"] == 0.05
