@@ -23,10 +23,14 @@ import sys
 from typing import List, Optional
 
 
-def _add_data_flags(p: argparse.ArgumentParser) -> None:
+def _add_data_flags(p: argparse.ArgumentParser,
+                    model_required: bool = True) -> None:
     p.add_argument("-f", "--input", required=True, help="dataset: dense CSV 'label,f1,...' or libsvm "
                         "sparse 'label idx:val ...' (format sniffed)")
-    p.add_argument("-m", "--model", required=True, help="model file path")
+    p.add_argument("-m", "--model", required=model_required,
+                   default=None, help="model file path"
+                   + ("" if model_required
+                      else " (unused in --cv mode)"))
     p.add_argument("-a", "--num-att", type=int, default=None,
                    help="attribute count (inferred when omitted)")
     p.add_argument("-x", "--num-ex", type=int, default=None,
@@ -38,7 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = root.add_subparsers(dest="command", required=True)
 
     tr = sub.add_parser("train", help="train a binary SVM (RBF default)")
-    _add_data_flags(tr)
+    _add_data_flags(tr, model_required=False)
     tr.add_argument("-c", "--cost", type=float, default=1.0)
     tr.add_argument("-g", "--gamma", type=float, default=None,
                     help="kernel gamma (default 1/num_attributes)")
@@ -97,6 +101,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fused Pallas iteration kernel: 'on' forces it; "
                          "'auto' currently prefers the XLA path (faster "
                          "on measured hardware, see solver/fused.py)")
+    tr.add_argument("-v", "--cv", type=int, default=0, metavar="K",
+                    help="k-fold cross-validation mode (LIBSVM -v): "
+                         "report pooled held-out accuracy (or MSE for "
+                         "--svr) instead of writing a model")
     tr.add_argument("--one-class", action="store_true",
                     help="one-class SVM / novelty detection on unlabeled "
                          "rows (LIBSVM svm-train -s 2 analog; the label "
@@ -178,7 +186,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         # Flag conflicts are detectable from args alone — fail before
         # the (possibly huge) CSV parse.
         import os
-        if os.path.isfile(args.model):
+        if args.model and os.path.isfile(args.model):
             print(f"error: -m {args.model} is an existing file; "
                   "--multiclass writes a model DIRECTORY",
                   file=sys.stderr)
@@ -207,6 +215,28 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "supported", file=sys.stderr)
             return 2
 
+    if not args.cv and not args.model:
+        print("error: -m/--model is required (or pass --cv K for "
+              "cross-validation)", file=sys.stderr)
+        return 2
+    if args.cv:
+        if args.cv < 2:
+            print(f"error: --cv needs K >= 2, got {args.cv}",
+                  file=sys.stderr)
+            return 2
+        for flag, on, hint in (
+                ("--one-class", args.one_class, ""),
+                ("--probability", args.probability, ""),
+                ("--check-kkt", args.check_kkt, ""),
+                ("--multiclass", args.multiclass,
+                 " (CV dispatches to one-vs-one automatically when the "
+                 "labels have more than two classes)"),
+                ("--checkpoint/--resume",
+                 bool(args.checkpoint or args.resume), "")):
+            if on:
+                print(f"error: {flag} does not apply to --cv mode{hint}",
+                      file=sys.stderr)
+                return 2
     if args.svr and args.one_class:
         print("error: --svr and --one-class are mutually exclusive",
               file=sys.stderr)
@@ -263,6 +293,20 @@ def cmd_train(args: argparse.Namespace) -> int:
         print(f"Training accuracy: {acc:.6f}")
         print(f"Training time: "
               f"{sum(r.train_seconds for r in results):.3f} s")
+        return 0
+
+    if args.cv:
+        from dpsvm_tpu.models.cv import cross_validate
+        r = cross_validate(x, y, args.cv, config,
+                           task="svr" if args.svr else "svc")
+        if args.svr:
+            print(f"Cross Validation ({args.cv}-fold) MSE: "
+                  f"{r['mse']:.6f}  MAE: {r['mae']:.6f}  "
+                  f"R^2: {r['r2']:.6f}")
+        else:
+            # LIBSVM's svm-train -v output shape
+            print(f"Cross Validation Accuracy = "
+                  f"{r['accuracy'] * 100:.4f}%")
         return 0
 
     if args.one_class:

@@ -90,16 +90,22 @@ def predict_svr(model: SVMModel, x_test: np.ndarray,
     return decision_function(model, x_test, include_b=include_b)
 
 
-def evaluate_svr(model: SVMModel, x_test: np.ndarray, y_test: np.ndarray,
-                 include_b: bool = True) -> dict:
-    """MSE / MAE / R^2 on held-out targets."""
-    pred = predict_svr(model, x_test, include_b=include_b)
-    y_test = np.asarray(y_test, np.float32)
-    err = pred - y_test
+def regression_metrics(pred: np.ndarray, y: np.ndarray) -> dict:
+    """MSE / MAE / R^2 — the one definition shared by the training
+    report, the test CLI and cross-validation."""
+    y = np.asarray(y, np.float32)
+    err = np.asarray(pred, np.float32) - y
     ss_res = float(np.sum(err * err))
-    ss_tot = float(np.sum((y_test - y_test.mean()) ** 2))
+    ss_tot = float(np.sum((y - y.mean()) ** 2))
     return {
         "mse": float(np.mean(err * err)),
         "mae": float(np.mean(np.abs(err))),
         "r2": 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0,
     }
+
+
+def evaluate_svr(model: SVMModel, x_test: np.ndarray, y_test: np.ndarray,
+                 include_b: bool = True) -> dict:
+    """MSE / MAE / R^2 on held-out targets."""
+    return regression_metrics(
+        predict_svr(model, x_test, include_b=include_b), y_test)

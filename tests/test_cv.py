@@ -1,0 +1,85 @@
+"""k-fold cross-validation (LIBSVM svm-train -v analog)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.cv import cross_validate, kfold_assignment
+
+
+def test_kfold_assignment_stratified():
+    y = np.array([0] * 40 + [1] * 24 + [2] * 8)
+    fold = kfold_assignment(y, 4, seed=1)
+    for cls, count in ((0, 40), (1, 24), (2, 8)):
+        per_fold = np.bincount(fold[y == cls], minlength=4)
+        assert per_fold.max() - per_fold.min() <= 1    # balanced
+    # deterministic
+    np.testing.assert_array_equal(fold, kfold_assignment(y, 4, seed=1))
+    assert not np.array_equal(fold, kfold_assignment(y, 4, seed=2))
+
+
+def test_kfold_bad_k():
+    y = np.zeros(10)
+    with pytest.raises(ValueError, match="folds"):
+        kfold_assignment(y, 1)
+    with pytest.raises(ValueError, match="folds"):
+        kfold_assignment(y, 11)
+
+
+def test_cv_binary(blobs_small):
+    x, y = blobs_small
+    r = cross_validate(x, y, 4, SVMConfig(c=4.0, max_iter=3000))
+    assert r["accuracy"] >= 0.9
+    assert r["predictions"].shape == y.shape
+    assert set(np.unique(r["folds"])) == set(range(4))
+
+
+def test_cv_multiclass(blobs_small):
+    x, y = blobs_small
+    y3 = np.where(y > 0, 2, 0)
+    y3[::5] = 1
+    r = cross_validate(x, y3, 3, SVMConfig(c=4.0, max_iter=3000))
+    assert r["accuracy"] >= 0.7
+
+
+def test_cv_svr():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(150, 5)).astype(np.float32)
+    y = (0.5 * x[:, 1] - x[:, 2]).astype(np.float32)
+    r = cross_validate(x, y, 3, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                          max_iter=20000), task="svr")
+    assert r["r2"] > 0.9
+
+
+def test_cv_rejects_checkpoint(blobs_small):
+    x, y = blobs_small
+    with pytest.raises(ValueError, match="single-run"):
+        cross_validate(x, y, 3, SVMConfig(checkpoint_path="/tmp/x.npz",
+                                          checkpoint_every=10))
+
+
+def test_cli_cv(tmp_path, blobs_small, capsys):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = blobs_small
+    data = str(tmp_path / "d.csv")
+    save_csv(data, x, y)
+    assert main(["train", "-f", data, "--cv", "4", "-c", "4", "-q"]) == 0
+    out = capsys.readouterr().out
+    assert "Cross Validation Accuracy" in out
+    # no model flag AND no cv -> clean error
+    assert main(["train", "-f", data, "-c", "4"]) == 2
+    # cv conflicts
+    assert main(["train", "-f", data, "--cv", "4", "--one-class"]) == 2
+    assert main(["train", "-f", data, "--cv", "1"]) == 2
+
+
+def test_cli_cv_rejects_multiclass(tmp_path, blobs_small):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = blobs_small
+    data = str(tmp_path / "d.csv")
+    save_csv(data, x, y)
+    assert main(["train", "-f", data, "--cv", "3", "--multiclass"]) == 2
