@@ -155,6 +155,19 @@ def build_parser() -> argparse.ArgumentParser:
     cv.add_argument("-a", "--num-att", type=int, default=None,
                     help="libsvm only: force the dense width (default: "
                          "max feature index seen)")
+
+    sc = sub.add_parser(
+        "scale", help="feature scaling (svm-scale analog; LIBSVM-"
+                      "compatible .range parameter files)")
+    sc.add_argument("src", help="input dataset (CSV or libsvm)")
+    sc.add_argument("dst", help="output CSV (scaled)")
+    sc.add_argument("-l", "--lower", type=float, default=-1.0)
+    sc.add_argument("-u", "--upper", type=float, default=1.0)
+    sc.add_argument("-s", "--save-range", default=None, metavar="PATH",
+                    help="write fitted scaling params (svm-scale -s)")
+    sc.add_argument("-r", "--restore-range", default=None, metavar="PATH",
+                    help="apply previously saved params (svm-scale -r; "
+                         "use for test files)")
     return root
 
 
@@ -497,6 +510,18 @@ def cmd_test(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_scale(args: argparse.Namespace) -> int:
+    from dpsvm_tpu.data.scale import scale_file
+
+    n, d = scale_file(args.src, args.dst, lower=args.lower,
+                      upper=args.upper, save_params=args.save_range,
+                      restore_params=args.restore_range)
+    print(f"Scaled {n} rows x {d} features to {args.dst}")
+    if args.save_range:
+        print(f"Range file: {args.save_range}")
+    return 0
+
+
 def cmd_convert(args: argparse.Namespace) -> int:
     from dpsvm_tpu.data.convert import (libsvm_to_dense_csv,
                                         mnist_to_odd_even_csv)
@@ -516,6 +541,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_train(args)
         if args.command == "convert":
             return cmd_convert(args)
+        if args.command == "scale":
+            return cmd_scale(args)
         return cmd_test(args)
     except FileNotFoundError as e:
         print(f"error: file not found: {e}", file=sys.stderr)

@@ -69,8 +69,12 @@ def make_mnist_like(n: int = 60_000, d: int = 784, seed: int = 0,
 
 
 def save_csv(path: str, x: np.ndarray, y: np.ndarray) -> None:
-    """Write (x, y) in the reference's dense CSV format (parse.cpp)."""
+    """Write (x, y) in the reference's dense CSV format (parse.cpp).
+    Integer labels write as ints (reference parity); float labels
+    (regression targets) keep their value."""
+    int_labels = np.issubdtype(np.asarray(y).dtype, np.integer)
     with open(path, "w") as f:
         for i in range(x.shape[0]):
             row = ",".join(repr(float(v)) for v in x[i])
-            f.write(f"{int(y[i])},{row}\n")
+            lab = int(y[i]) if int_labels else repr(float(y[i]))
+            f.write(f"{lab},{row}\n")

@@ -1,0 +1,128 @@
+"""svm-scale analog: LIBSVM-compatible feature scaling."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.data.scale import ScaleParams, scale_file
+
+
+def test_fit_transform_range():
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 10.0, size=(100, 5)).astype(np.float32)
+    p = ScaleParams.fit(x, -1.0, 1.0)
+    xs = p.transform(x)
+    np.testing.assert_allclose(xs.min(axis=0), -1.0, atol=1e-6)
+    np.testing.assert_allclose(xs.max(axis=0), 1.0, atol=1e-6)
+
+
+def test_constant_feature_no_nan():
+    x = np.ones((10, 3), np.float32)
+    x[:, 1] = np.arange(10)
+    p = ScaleParams.fit(x, -1.0, 1.0)
+    xs = p.transform(x)
+    assert np.isfinite(xs).all()
+    assert (xs[:, 0] == 0.0).all()          # constant -> 0 (stock output())
+    assert (xs[:, 2] == 0.0).all()
+
+
+def test_range_file_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-5, 7, size=(50, 4)).astype(np.float32)
+    p = ScaleParams.fit(x, -1.0, 1.0)
+    rp = str(tmp_path / "train.range")
+    p.save(rp)
+    # exact LIBSVM format
+    lines = open(rp).read().splitlines()
+    assert lines[0] == "x"
+    assert lines[1].split() == ["-1", "1"]
+    assert len(lines) == 2 + 4
+    back = ScaleParams.load(rp)
+    np.testing.assert_allclose(back.transform(x), p.transform(x),
+                               rtol=1e-6)
+
+
+def test_load_rejects_y_scaling(tmp_path):
+    bad = tmp_path / "y.range"
+    bad.write_text("y\n-1 1\n")
+    with pytest.raises(ValueError, match="range file"):
+        ScaleParams.load(str(bad))
+
+
+def test_train_params_applied_to_test(tmp_path):
+    """The svm-scale workflow: fit on train, restore on test — test
+    values outside the train range extrapolate, never refit."""
+    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    rng = np.random.default_rng(2)
+    xtr = rng.uniform(0, 10, size=(60, 3)).astype(np.float32)
+    xte = rng.uniform(-5, 15, size=(20, 3)).astype(np.float32)
+    ytr = np.where(xtr[:, 0] > 5, 1, -1)
+    yte = np.where(xte[:, 0] > 5, 1, -1)
+    tr, te = str(tmp_path / "tr.csv"), str(tmp_path / "te.csv")
+    save_csv(tr, xtr, ytr)
+    save_csv(te, xte, yte)
+
+    rp = str(tmp_path / "r.range")
+    scale_file(tr, str(tmp_path / "tr_s.csv"), save_params=rp)
+    scale_file(te, str(tmp_path / "te_s.csv"), restore_params=rp)
+
+    xs, _ = load_csv(str(tmp_path / "te_s.csv"))
+    p = ScaleParams.load(rp)
+    np.testing.assert_allclose(xs, p.transform(xte), rtol=1e-5, atol=1e-6)
+    assert xs.min() < -1.0 and xs.max() > 1.0      # extrapolation kept
+
+
+def test_cli_scale_pipeline(tmp_path, blobs_small):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = blobs_small
+    data = str(tmp_path / "d.csv")
+    save_csv(data, 10.0 * x + 100.0, y)
+    scaled = str(tmp_path / "d_s.csv")
+    rp = str(tmp_path / "d.range")
+    assert main(["scale", data, scaled, "-s", rp]) == 0
+    model = str(tmp_path / "m.svm")
+    assert main(["train", "-f", scaled, "-m", model, "-c", "10",
+                 "-q"]) == 0
+    assert main(["test", "-f", scaled, "-m", model]) == 0
+    # restore path + conflict
+    assert main(["scale", data, scaled, "-r", rp]) == 0
+    assert main(["scale", data, scaled, "-r", rp, "-s", rp]) == 2
+
+
+def test_restore_stock_file_with_omitted_features(tmp_path):
+    """Stock svm-scale omits constant features from its range files —
+    both middle and trailing omissions restore correctly given the
+    data's width, and the omitted columns scale to 0 (stock output())."""
+    rp = tmp_path / "stock.range"
+    rp.write_text("x\n-1 1\n1 0 10\n3 -5 5\n")      # features 2, 4 omitted
+    p = ScaleParams.load(str(rp), num_features=4)
+    x = np.array([[5.0, 9.9, 0.0, 7.7]], np.float32)
+    out = p.transform(x)
+    np.testing.assert_allclose(out[0], [0.0, 0.0, 0.0, 0.0], atol=1e-6)
+    with pytest.raises(ValueError, match="omits"):
+        ScaleParams.load(str(rp))                   # width unknowable
+    with pytest.raises(ValueError, match="feature index"):
+        ScaleParams.load(str(rp), num_features=2)
+
+
+def test_truncated_range_file(tmp_path):
+    rp = tmp_path / "t.range"
+    rp.write_text("x\n")
+    with pytest.raises(ValueError, match="truncated"):
+        ScaleParams.load(str(rp))
+
+
+def test_scale_preserves_regression_targets(tmp_path):
+    """Labels pass through verbatim (stock svm-scale never touches
+    them) — float targets survive untruncated with no flag needed."""
+    from dpsvm_tpu.data.loader import load_csv
+
+    src = tmp_path / "reg.csv"
+    src.write_text("3.7,1.0,2.0\n-0.25,5.0,6.0\n")
+    dst = str(tmp_path / "reg_s.csv")
+    scale_file(str(src), dst)
+    _, y = load_csv(dst, float_labels=True)
+    np.testing.assert_allclose(y, [3.7, -0.25], rtol=1e-6)
