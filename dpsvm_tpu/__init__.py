@@ -30,6 +30,9 @@ Public API
 ``predict`` / ``evaluate``         batched XLA inference
 ``DPSVMClassifier``                sklearn-protocol estimator facade
 ``DPSVMRegressor``                 epsilon-SVR facade (models/svr.py)
+``train_svr`` / ``predict_svr``    epsilon-SVR (LIBSVM -s 3)
+``train_oneclass`` / ``predict_oneclass``  one-class SVM (LIBSVM -s 2)
+``cross_validate``                 k-fold CV (LIBSVM -v)
 """
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
@@ -37,6 +40,10 @@ from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
 from dpsvm_tpu.models.estimator import DPSVMClassifier, DPSVMRegressor
 from dpsvm_tpu.api import train, fit
+from dpsvm_tpu.models.svr import train_svr, predict_svr, evaluate_svr
+from dpsvm_tpu.models.oneclass import (train_oneclass, predict_oneclass,
+                                       score_oneclass)
+from dpsvm_tpu.models.cv import cross_validate
 
 __version__ = "0.1.0"
 
@@ -53,4 +60,11 @@ __all__ = [
     "load_model",
     "DPSVMClassifier",
     "DPSVMRegressor",
+    "train_svr",
+    "predict_svr",
+    "evaluate_svr",
+    "train_oneclass",
+    "predict_oneclass",
+    "score_oneclass",
+    "cross_validate",
 ]

@@ -1,0 +1,99 @@
+"""Feature-interaction matrix: options that compose must actually work.
+
+Each solver option (cache, packed selection, pairwise clip, WSS2,
+kernels, class weights, shards) was validated on its own suite; these
+tests pin the cross-products users will reach for.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import fit
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm import evaluate
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(150, 5)).astype(np.float32)
+    y = (np.sin(x[:, 0]) + 0.5 * x[:, 1]).astype(np.float32)
+    return x, y
+
+
+def test_svr_with_cache(reg_data):
+    from dpsvm_tpu.models.svr import evaluate_svr, train_svr
+
+    x, y = reg_data
+    base = dict(c=10.0, svr_epsilon=0.05, max_iter=20000)
+    m0, r0 = train_svr(x, y, SVMConfig(**base))
+    m1, r1 = train_svr(x, y, SVMConfig(cache_size=10, **base))
+    assert r0.converged and r1.converged
+    assert abs(evaluate_svr(m1, x, y)["r2"]
+               - evaluate_svr(m0, x, y)["r2"]) < 1e-3
+
+
+def test_svr_packed_select(reg_data):
+    from dpsvm_tpu.models.svr import evaluate_svr, train_svr
+
+    x, y = reg_data
+    m, r = train_svr(x, y, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                     max_iter=20000,
+                                     select_impl="packed"))
+    assert r.converged and evaluate_svr(m, x, y)["r2"] > 0.99
+
+
+def test_oneclass_wss2():
+    from dpsvm_tpu.models.oneclass import predict_oneclass, train_oneclass
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    m, r = train_oneclass(x, nu=0.2,
+                          config=SVMConfig(max_iter=50000,
+                                           selection="second-order"))
+    assert r.converged
+    assert abs(float(np.mean(predict_oneclass(m, x) < 0)) - 0.2) < 0.06
+
+
+@pytest.mark.parametrize("kw", [dict(kernel="linear"),
+                                dict(cache_size=10),
+                                dict(weight_pos=2.0, weight_neg=0.5),
+                                dict(selection="second-order"),
+                                dict(shards=4)])
+def test_pairwise_clip_composes(kw, blobs_small):
+    x, y = blobs_small
+    m, r = fit(x, y, SVMConfig(c=4.0, max_iter=5000, clip="pairwise",
+                               **kw))
+    assert r.converged
+    assert evaluate(m, x, y) >= 0.95
+    # the invariant pairwise buys: exact equality-constraint conservation
+    assert abs(float(np.sum(np.asarray(r.alpha) * y))) < 1e-3
+    if "weight_pos" in kw:
+        # alphas honor the per-class box C * w(y)
+        box = SVMConfig(c=4.0, **kw).box_bound(y)
+        assert np.all(np.asarray(r.alpha) <= np.asarray(box) + 1e-6)
+
+
+def test_svr_distributed_nonrbf(reg_data):
+    """shards x kernel x svr all at once."""
+    from dpsvm_tpu.models.svr import predict_svr, train_svr
+
+    x, _ = reg_data
+    y = (0.5 * x[:, 1] - x[:, 2]).astype(np.float32)
+    m1, _ = train_svr(x, y, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                      kernel="linear", max_iter=40000))
+    m8, r8 = train_svr(x, y, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                       kernel="linear", max_iter=40000,
+                                       shards=8))
+    assert r8.converged
+    np.testing.assert_allclose(predict_svr(m8, x), predict_svr(m1, x),
+                               atol=2e-2)
+
+
+def test_fused_rejects_new_modes():
+    cfg = SVMConfig(use_pallas="on", clip="pairwise")
+    with pytest.raises(ValueError, match="clip"):
+        cfg.validate()
+    cfg = SVMConfig(use_pallas="on", kernel="linear")
+    with pytest.raises(ValueError, match="kernel"):
+        cfg.validate()
