@@ -33,13 +33,14 @@ Public API
 ``train_svr`` / ``predict_svr``    epsilon-SVR (LIBSVM -s 3)
 ``train_oneclass`` / ``predict_oneclass``  one-class SVM (LIBSVM -s 2)
 ``cross_validate``                 k-fold CV (LIBSVM -v)
+``warm_start``                     continue training from a previous alpha
 """
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
 from dpsvm_tpu.models.estimator import DPSVMClassifier, DPSVMRegressor
-from dpsvm_tpu.api import train, fit
+from dpsvm_tpu.api import train, fit, warm_start
 from dpsvm_tpu.models.svr import train_svr, predict_svr, evaluate_svr
 from dpsvm_tpu.models.oneclass import (train_oneclass, predict_oneclass,
                                        score_oneclass)
@@ -53,6 +54,7 @@ __all__ = [
     "SVMModel",
     "train",
     "fit",
+    "warm_start",
     "decision_function",
     "predict",
     "evaluate",

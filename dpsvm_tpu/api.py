@@ -16,18 +16,9 @@ from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.models.svm import SVMModel
 
 
-def train(x: np.ndarray, y: np.ndarray,
-          config: Optional[SVMConfig] = None,
-          f_init: Optional[np.ndarray] = None,
-          alpha_init: Optional[np.ndarray] = None) -> TrainResult:
-    """Train a binary SVM with the modified-SMO solver.
-
-    x: (n, d) float features; y: (n,) labels in {+1, -1}.
-    ``f_init`` overrides the f = -y initialization (the SVR wrapper's
-    hook — users train regressors through models.svr.train_svr).
-    """
-    config = config or SVMConfig()
-    config.validate()
+def _check_xy(x, y):
+    """The cheap shape/label validation shared by train and warm_start
+    (warm_start must run it BEFORE its O(n^2) kernel pass)."""
     x = np.asarray(x, np.float32)
     y = np.asarray(y)
     if x.ndim != 2:
@@ -40,6 +31,22 @@ def train(x: np.ndarray, y: np.ndarray,
             f"labels must be +/-1 for binary training, got {labels[:10]} — "
             "for multi-class data use models.multiclass.train_multiclass "
             "(CLI: train --multiclass)")
+    return x, y
+
+
+def train(x: np.ndarray, y: np.ndarray,
+          config: Optional[SVMConfig] = None,
+          f_init: Optional[np.ndarray] = None,
+          alpha_init: Optional[np.ndarray] = None) -> TrainResult:
+    """Train a binary SVM with the modified-SMO solver.
+
+    x: (n, d) float features; y: (n,) labels in {+1, -1}.
+    ``f_init`` overrides the f = -y initialization (the SVR wrapper's
+    hook — users train regressors through models.svr.train_svr).
+    """
+    config = config or SVMConfig()
+    config.validate()
+    x, y = _check_xy(x, y)
     if config.backend == "numpy":
         from dpsvm_tpu.solver.oracle import smo_reference
         return smo_reference(x, y, config, f_init=f_init,
@@ -62,3 +69,45 @@ def fit(x: np.ndarray, y: np.ndarray,
     """train + SV compaction in one call."""
     result = train(x, y, config)
     return SVMModel.from_train_result(x, y, result), result
+
+
+def warm_start(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
+               config: Optional[SVMConfig] = None) -> TrainResult:
+    """Continue training from a previous solution's alpha.
+
+    Recomputes the gradient f = K (alpha*y) - y from scratch in one
+    streamed kernel pass and resumes the SMO loop — so a capped run can
+    be continued with a larger ``max_iter`` (or a tighter ``epsilon``)
+    without a checkpoint file, and an already-converged alpha returns
+    after the first convergence poll. Unlike checkpoint resume (which
+    replays the incrementally-maintained f for an identical trajectory),
+    the fresh f also discards any accumulated float drift.
+
+    The alphas must come from a run with the same C/weights: box-bound
+    membership is tested by exact comparison against THIS config's
+    bounds, so alphas clipped at a different C are treated as interior.
+    """
+    from dpsvm_tpu.ops.diagnostics import _stream_kv
+
+    config = config or SVMConfig()
+    config.validate()
+    if config.resume_from:
+        raise ValueError("config.resume_from would override the given "
+                         "alpha (checkpoint resume takes precedence in "
+                         "the solvers) — clear it, or resume the "
+                         "checkpoint via train() instead")
+    x, y = _check_xy(x, y)
+    yf = np.asarray(y, np.float32)
+    alpha = np.asarray(alpha, np.float32)
+    if alpha.shape != (x.shape[0],):
+        raise ValueError(f"alpha must be ({x.shape[0]},), got {alpha.shape}")
+    box = np.broadcast_to(np.asarray(config.box_bound(y), np.float32),
+                          alpha.shape)
+    if (not np.isfinite(alpha).all() or (alpha < 0).any()
+            or (alpha > box).any()):
+        raise ValueError("alpha outside [0, C] (or non-finite) — not a "
+                         "feasible dual point for this config")
+    spec = config.kernel_spec(x.shape[1])
+    kv = _stream_kv(x, alpha * yf, spec, block=4096)
+    return train(x, y, config, f_init=(kv - yf).astype(np.float32),
+                 alpha_init=alpha)

@@ -68,3 +68,62 @@ def test_cli_test_predictions_output(tmp_path):
     label, dec = lines[0].split(",")
     assert int(label) in (-1, 1)
     float(dec)   # parses
+
+
+def test_warm_start_continues_capped_run(blobs_small):
+    import numpy as np
+
+    from dpsvm_tpu.api import train, warm_start
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = blobs_small
+    full = train(x, y, SVMConfig(c=4.0, max_iter=5000))
+    assert full.converged
+
+    capped = train(x, y, SVMConfig(c=4.0, max_iter=20))
+    assert not capped.converged
+    cont = warm_start(x, y, capped.alpha, SVMConfig(c=4.0, max_iter=5000))
+    assert cont.converged
+    # same optimum as the uninterrupted run (solution-level: the fresh-f
+    # restart can reorder ties)
+    assert abs(cont.b - full.b) < 5e-3
+
+    # an already-converged alpha needs at most a few touch-up
+    # iterations: the recomputed f exposes the incremental f's
+    # accumulated drift, so warm_start may legitimately tighten the
+    # true KKT point slightly rather than exiting on iteration one
+    again = warm_start(x, y, full.alpha, SVMConfig(c=4.0, max_iter=5000))
+    assert again.converged and again.n_iter <= 10
+
+
+def test_warm_start_rejects_infeasible_alpha(blobs_small):
+    import numpy as np
+    import pytest
+
+    from dpsvm_tpu.api import warm_start
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = blobs_small
+    bad = np.full(len(y), 99.0, np.float32)
+    with pytest.raises(ValueError, match="feasible"):
+        warm_start(x, y, bad, SVMConfig(c=4.0))
+
+
+def test_warm_start_guards(blobs_small):
+    import numpy as np
+    import pytest
+
+    from dpsvm_tpu.api import warm_start
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = blobs_small
+    a = np.zeros(len(y), np.float32)
+    a[0] = np.nan
+    with pytest.raises(ValueError, match="feasible"):
+        warm_start(x, y, a, SVMConfig(c=4.0))
+    with pytest.raises(ValueError, match="resume_from"):
+        warm_start(x, y, np.zeros(len(y), np.float32),
+                   SVMConfig(c=4.0, resume_from="/tmp/ck.npz"))
+    with pytest.raises(ValueError, match=r"x must be \(n, d\)"):
+        warm_start(x[:, 0], y, np.zeros(len(y), np.float32),
+                   SVMConfig(c=4.0))
