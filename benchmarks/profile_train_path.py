@@ -1,0 +1,137 @@
+"""Per-phase wall-clock breakdown of the end-to-end train() path.
+
+Round-2 measured a 3-8x gap between the steady-state iteration rate
+(~15-17k it/s bf16 at 60000x784, bench.py) and the end-to-end
+deliverable (59,392 iterations in 21.8-28.1 s, bench_convergence.py).
+This harness times every phase of the exact same path so the difference
+is *explained* rather than advertised around:
+
+    data-gen | device_put + norms | chunk[0] (compile+run) | chunk[i]...
+
+Usage:  python benchmarks/profile_train_path.py
+Env:    BENCH_N/BENCH_D/BENCH_C/BENCH_GAMMA/BENCH_EPS (as bench_convergence)
+        BENCH_CHUNK  chunk_iters (default 2048)
+        BENCH_PRECISION  DEFAULT | HIGHEST
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import _pathfix  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    from dpsvm_tpu.utils.backend_guard import require_devices
+    dev = require_devices()[0]
+    log(f"device: {dev}")
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+
+    n = int(os.environ.get("BENCH_N", 60_000))
+    d = int(os.environ.get("BENCH_D", 784))
+    c = float(os.environ.get("BENCH_C", 10.0))
+    gamma = float(os.environ.get("BENCH_GAMMA", 0.25))
+    eps = float(os.environ.get("BENCH_EPS", 1e-3))
+    chunk = int(os.environ.get("BENCH_CHUNK", 2048))
+    precision = os.environ.get("BENCH_PRECISION", "DEFAULT").upper()
+    max_iter = int(os.environ.get("BENCH_MAX_ITER", 100_000))
+
+    t = time.perf_counter()
+    x, y = make_mnist_like(n=n, d=d, seed=0)
+    t_gen = time.perf_counter() - t
+    log(f"data-gen: {t_gen:.3f}s")
+
+    t = time.perf_counter()
+    xd = jax.device_put(jnp.asarray(x, jnp.float32))
+    yd = jax.device_put(jnp.asarray(y, jnp.float32))
+    x2 = row_norms_sq(xd)
+    x2.block_until_ready()
+    t_put = time.perf_counter() - t
+    log(f"device_put + norms: {t_put:.3f}s")
+
+    config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
+                       matmul_precision=precision.lower(), chunk_iters=chunk)
+    kspec = config.kernel_spec(d)
+
+    runner = _build_chunk_runner(float(c), kspec, eps, False, precision)
+
+    # Explicit AOT split: trace+compile time vs execute time.
+    carry = init_carry(yd, 0)
+    t = time.perf_counter()
+    lowered = runner.lower(carry, xd, yd, x2, jnp.int32(chunk))
+    t_trace = time.perf_counter() - t
+    t = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t
+    log(f"trace: {t_trace:.3f}s  compile: {t_compile:.3f}s")
+
+    # Measure the device->host poll round-trip (the round-2 hot spot:
+    # three separate blocking scalar reads per chunk paid this three
+    # times; the driver now packs them into one transfer per chunk).
+    from dpsvm_tpu.solver.driver import _pack_stats, _read_stats
+    tiny = jnp.float32(1.0) + jnp.float32(1.0)
+    tiny.block_until_ready()
+    rtts = []
+    for _ in range(5):
+        a = jnp.float32(1.0) + tiny
+        t = time.perf_counter()
+        np.asarray(a)
+        rtts.append(time.perf_counter() - t)
+    log(f"poll RTT (blocking scalar D2H): min {min(rtts) * 1e3:.1f}ms, "
+        f"median {sorted(rtts)[2] * 1e3:.1f}ms")
+
+    # Run chunks to convergence, timing each (full-carry barrier inside
+    # the timed region, packed single-transfer poll like the driver).
+    chunk_times = []
+    t_total = time.perf_counter()
+    it = 0
+    while True:
+        limit = min(it + chunk, max_iter)
+        t = time.perf_counter()
+        carry = compiled(carry, xd, yd, x2, jnp.int32(limit))
+        it_new, b_lo, b_hi = _read_stats(
+            _pack_stats(carry.n_iter, carry.b_lo, carry.b_hi))
+        dt = time.perf_counter() - t
+        chunk_times.append((it_new - it, dt))
+        it = it_new
+        if not (b_lo > b_hi + 2 * eps) or it >= max_iter:
+            break
+    t_loop = time.perf_counter() - t_total
+
+    total_iters = sum(k for k, _ in chunk_times)
+    full = [(k, dt) for k, dt in chunk_times if k == chunk]
+    log(f"chunks: {len(chunk_times)}, iters: {total_iters}, "
+        f"loop wall: {t_loop:.3f}s")
+    if full:
+        per = sorted(dt for _, dt in full)
+        med = per[len(per) // 2]
+        log(f"full-chunk time: median {med * 1e3:.1f}ms "
+            f"({chunk / med:.0f} it/s), min {per[0] * 1e3:.1f}ms, "
+            f"max {per[-1] * 1e3:.1f}ms")
+        # fixed overhead estimate: median chunk time - iters*marginal
+        log(f"first 5 chunks (iters, ms): "
+            f"{[(k, round(dt * 1e3, 1)) for k, dt in chunk_times[:5]]}")
+        log(f"last 5 chunks (iters, ms): "
+            f"{[(k, round(dt * 1e3, 1)) for k, dt in chunk_times[-5:]]}")
+
+    total = t_gen + t_put + t_trace + t_compile + t_loop
+    log(f"TOTAL: {total:.2f}s = gen {t_gen:.2f} + put {t_put:.2f} + "
+        f"trace {t_trace:.2f} + compile {t_compile:.2f} + loop {t_loop:.2f}")
+
+
+if __name__ == "__main__":
+    main()

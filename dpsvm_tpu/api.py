@@ -37,12 +37,18 @@ def _check_xy(x, y):
 def train(x: np.ndarray, y: np.ndarray,
           config: Optional[SVMConfig] = None,
           f_init: Optional[np.ndarray] = None,
-          alpha_init: Optional[np.ndarray] = None) -> TrainResult:
+          alpha_init: Optional[np.ndarray] = None,
+          guard_eta: bool = False) -> TrainResult:
     """Train a binary SVM with the modified-SMO solver.
 
     x: (n, d) float features; y: (n,) labels in {+1, -1}.
     ``f_init`` overrides the f = -y initialization (the SVR wrapper's
     hook — users train regressors through models.svr.train_svr).
+    ``guard_eta`` clamps the update denominator to LIBSVM's TAU (1e-12)
+    on the first-order path. The SVR/one-class wrappers set it (their
+    duplicated rows make eta == 0 reachable); it stays off by default so
+    plain classification — including warm_start continuations — keeps
+    the reference's raw division bit-for-bit (svmTrainMain.cpp:289).
     """
     config = config or SVMConfig()
     config.validate()
@@ -50,18 +56,18 @@ def train(x: np.ndarray, y: np.ndarray,
     if config.backend == "numpy":
         from dpsvm_tpu.solver.oracle import smo_reference
         return smo_reference(x, y, config, f_init=f_init,
-                             alpha_init=alpha_init)
+                             alpha_init=alpha_init, guard_eta=guard_eta)
     if config.shards > 1:
         from dpsvm_tpu.parallel.dist_smo import train_distributed
         return train_distributed(x, y, config, f_init=f_init,
-                                 alpha_init=alpha_init)
+                                 alpha_init=alpha_init, guard_eta=guard_eta)
     from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
     if f_init is None and alpha_init is None and use_fused(config):
         # the fused kernel hard-codes the classification init
         return train_single_device_fused(x, y, config)
     from dpsvm_tpu.solver.smo import train_single_device
     return train_single_device(x, y, config, f_init=f_init,
-                               alpha_init=alpha_init)
+                               alpha_init=alpha_init, guard_eta=guard_eta)
 
 
 def fit(x: np.ndarray, y: np.ndarray,

@@ -76,6 +76,14 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
             from dpsvm_tpu.api import fit
             from dpsvm_tpu.models.svm import predict
             classes = np.unique(y[tr])
+            if len(classes) < 2:
+                # A fold whose train split holds one class would pass
+                # _check_xy (all-+1 is a subset of {-1,+1}) and train a
+                # degenerate model; fail loudly instead.
+                raise ValueError(
+                    f"CV fold {f}: training split has a single class "
+                    f"({classes!r}) — a class has fewer than {k} members; "
+                    "reduce k or rebalance the data")
             ypm = np.where(y[tr] == classes[-1], 1, -1).astype(np.int32)
             model, _ = fit(x[tr], ypm, config)
             p = predict(model, x[te])

@@ -69,7 +69,10 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
     # independent clip lets it drift ~1%, which shifts rho visibly
     # (measured: rho 6.67 vs libsvm's 6.57 on a 300-point fixture).
     config = SVMConfig(**{**config.__dict__, "c": 1.0, "clip": "pairwise"})
-    result = train(x, z, config, f_init=f0, alpha_init=alpha0)
+    # guard_eta: duplicate rows in unlabeled data make eta == 0
+    # reachable; clamp like LIBSVM's TAU (see solver/smo.py).
+    result = train(x, z, config, f_init=f0, alpha_init=alpha0,
+                   guard_eta=True)
 
     alpha = np.asarray(result.alpha, np.float32)
     keep = alpha > 0

@@ -27,6 +27,7 @@ KKT, so the tube center is w.x - b.)
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Tuple
 
 import numpy as np
@@ -58,11 +59,22 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     n = x.shape[0]
     p = np.float32(config.svr_epsilon)
 
+    # The SVR dual carries the equality constraint sum(a - a*) = 0; the
+    # reference's independent clip lets it drift, shifting the intercept
+    # off the true optimum in long runs (one-class forces pairwise for
+    # the same reason — the constraint is part of the model). Default to
+    # the conserving clip; an explicit clip='pairwise' is a no-op, and
+    # the classification parity path is unaffected.
+    if config.clip == "independent":
+        config = dataclasses.replace(config, clip="pairwise")
+
     x2n = np.vstack([x, x])
     z = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
     f0 = np.concatenate([p - y, -p - y]).astype(np.float32)
 
-    result = train(x2n, z, config, f_init=f0)
+    # guard_eta: the stacked twin rows make eta == 0 reachable if a
+    # twin pair is ever selected; clamp like LIBSVM's TAU (ADVICE r2).
+    result = train(x2n, z, config, f_init=f0, guard_eta=True)
 
     beta = np.asarray(result.alpha, np.float32)
     delta = beta[:n] - beta[n:]

@@ -227,7 +227,8 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
                shard_x: bool, precision, weights=(1.0, 1.0),
                use_cache: bool = False,
                packed_select: bool = False,
-               pairwise_clip: bool = False) -> DistCarry:
+               pairwise_clip: bool = False,
+               guard_eta: bool = False) -> DistCarry:
     """One SMO iteration, SPMD over the mesh axis. xs/x2s are per-shard
     slices when shard_x else full replicated arrays."""
     alpha_s, f_s = carry.alpha, carry.f
@@ -321,6 +322,11 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
         k_local = lax.dynamic_slice_in_dim(
             k_full, rank * n_per_shard, n_per_shard, axis=1)
     eta = k_hh + k_ll - 2.0 * k_hl
+    if guard_eta:
+        # TAU clamp for f_init-seeded problems (SVR twin rows make
+        # eta == 0 reachable — see solver/smo.py); the classification
+        # path keeps the reference's raw division for bit parity.
+        eta = jnp.maximum(eta, 1e-12)
 
     # --- alpha update: replicated scalar math (svmTrainMain.cpp:282-295) ---
     a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi, b_lo,
@@ -346,7 +352,8 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
                        precision_name: str, second_order: bool = False,
                        weights=(1.0, 1.0), use_cache: bool = False,
                        packed_select: bool = False,
-                       pairwise_clip: bool = False):
+                       pairwise_clip: bool = False,
+                       guard_eta: bool = False):
     precision = getattr(lax.Precision, precision_name)
     kspec = KernelSpec.coerce(kspec)
     x_spec = P(SHARD_AXIS) if shard_x else P()
@@ -356,7 +363,7 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
     else:
         step = _dist_step
         extra = {"use_cache": use_cache, "packed_select": packed_select,
-                 "pairwise_clip": pairwise_clip}
+                 "pairwise_clip": pairwise_clip, "guard_eta": guard_eta}
 
     def run(carry: DistCarry, xs, ys, x2s, valid, limit):
         def cond(s: DistCarry):
@@ -393,7 +400,8 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
 def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                       mesh: Optional[jax.sharding.Mesh] = None,
                       f_init: Optional[np.ndarray] = None,
-                      alpha_init: Optional[np.ndarray] = None) -> TrainResult:
+                      alpha_init: Optional[np.ndarray] = None,
+                      guard_eta: bool = False) -> TrainResult:
     """Train over a 1-D device mesh; data arrives/leaves as host NumPy.
 
     ``f_init`` overrides the classification f = -y initialization (SVR
@@ -468,7 +476,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                  float(config.weight_neg)),
                                 use_cache=lines > 0,
                                 packed_select=config.select_impl == "packed",
-                                pairwise_clip=config.clip == "pairwise")
+                                pairwise_clip=config.clip == "pairwise",
+                                guard_eta=guard_eta)
 
     def step_chunk(c, lim):
         limit = jax.device_put(jnp.int32(lim), repl)

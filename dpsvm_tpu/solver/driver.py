@@ -6,6 +6,24 @@ iteration limit, entirely on device. This module owns everything around
 it — the polling loop, convergence bookkeeping, progress logging,
 checkpointing, profiler tracing and NaN-debug toggles — so the behavior
 is identical across execution modes.
+
+Poll economics (measured on the v5e tunnel, benchmarks/
+profile_train_path.py): a blocking device->host scalar read costs
+~100 ms of round-trip latency, so the round-2 loop — three separate
+``int()``/``float()`` reads per chunk — spent ~10 s of a 15 s training
+run waiting on polls. Two fixes live here:
+
+* **packed stats**: the three poll scalars (n_iter, b_lo, b_hi) are
+  packed into ONE (3,) device array by a tiny jitted gather and fetched
+  with a single transfer per chunk;
+* **pipelined dispatch**: the next chunk is dispatched BEFORE the
+  previous chunk's stats are read. The device-side ``lax.while_loop``
+  checks convergence every iteration, so a speculative chunk dispatched
+  after the converged one is a no-op (its cond fails immediately) — the
+  poll latency and the dispatch gap both overlap real compute, and the
+  device never idles between chunks. Disabled while checkpointing
+  (the checkpoint must read the carry at the polled iteration, and the
+  donated carry has already been handed to the speculative chunk).
 """
 
 from __future__ import annotations
@@ -15,6 +33,7 @@ import time
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
@@ -46,6 +65,23 @@ def _debug_nans(enabled: bool):
         jax.config.update("jax_debug_nans", prev)
 
 
+@jax.jit
+def _pack_stats(n_iter, b_lo, b_hi):
+    """(n_iter, b_lo, b_hi) as one (3,) i32 device array — one D2H
+    transfer instead of three blocking scalar reads. The floats ride as
+    bit patterns so every field is exact (an f32 lane would corrupt
+    n_iter above 2^24 and stall the max_iter exit check — reference
+    covtype budget is 3e6 and nothing validates an upper bound)."""
+    bits = jax.lax.bitcast_convert_type(jnp.stack([b_lo, b_hi]), jnp.int32)
+    return jnp.concatenate([n_iter.reshape(1), bits])
+
+
+def _read_stats(stats) -> tuple:
+    s = np.asarray(stats)
+    b = s[1:].view(np.float32)
+    return int(s[0]), float(b[0]), float(b[1])
+
+
 def host_training_loop(
     config: SVMConfig,
     gamma: float,
@@ -54,24 +90,36 @@ def host_training_loop(
     carry,
     step_chunk: Callable,                      # (carry, limit:int) -> carry
     carry_to_host: Callable,                   # carry -> (alpha, f) np arrays
-    carry_iter: Callable = lambda c: int(c.n_iter),
-    carry_gap: Callable = lambda c: (float(c.b_lo), float(c.b_hi)),
 ) -> TrainResult:
     """Run chunks until convergence / max_iter; return the TrainResult."""
     eps = float(config.epsilon)
-    last_saved = carry_iter(carry)
+    chunk = config.chunk_iters
+    # Pipelining changes WHEN the carry is read, not what is computed:
+    # with checkpointing on, fall back to the strictly-sequential order
+    # so maybe_checkpoint sees the carry at the polled iteration.
+    pipeline = config.checkpoint_every == 0
+
+    it0, _, _ = _read_stats(_pack_stats(carry.n_iter, carry.b_lo, carry.b_hi))
+    last_saved = it0
 
     profile = (jax.profiler.trace(config.profile_dir)
                if config.profile_dir else contextlib.nullcontext())
 
     t0 = time.perf_counter()
     with profile, _debug_nans(config.debug_nans):
+        limit = min(it0 + chunk, config.max_iter)
+        carry = step_chunk(carry, limit)
         while True:
-            limit = min(carry_iter(carry) + config.chunk_iters,
-                        config.max_iter)
-            carry = step_chunk(carry, limit)
-            n_iter = carry_iter(carry)
-            b_lo, b_hi = carry_gap(carry)
+            stats = _pack_stats(carry.n_iter, carry.b_lo, carry.b_hi)
+            if pipeline:
+                # Dispatch the next chunk before the poll blocks. The
+                # stats gather was dispatched first, so it reads the
+                # pre-donation buffers; the speculative chunk is free
+                # when this one converged (device cond exits instantly).
+                limit = min(limit + chunk, config.max_iter)
+                carry = step_chunk(carry, limit)
+
+            n_iter, b_lo, b_hi = _read_stats(stats)
             converged = not (b_lo > b_hi + 2.0 * eps)
             done = converged or n_iter >= config.max_iter
 
@@ -91,7 +139,12 @@ def host_training_loop(
             last_saved = maybe_checkpoint(config, last_saved, n_iter, make)
             if done:
                 break
-
+            if not pipeline:
+                limit = min(n_iter + chunk, config.max_iter)
+                carry = step_chunk(carry, limit)
+    # In pipelined mode `carry` is the speculative chunk dispatched after
+    # the final poll; it was a no-op (converged => cond false on entry;
+    # max_iter => limit == n_iter), so its state equals the final state.
     alpha, _ = carry_to_host(carry)
     return TrainResult(
         alpha=alpha,

@@ -80,15 +80,19 @@ def _run_chunk(carry: FusedCarry, x, x2, y, limit, *, c, gamma, epsilon,
         t = body(s)
         return t._replace(b_hi=s.b_hi, b_lo=s.b_lo)
 
-    # Fire whenever this call ends converged below the iteration cap.
-    # Chunks are only entered with an open gap (the host breaks on done,
-    # and train_single_device_fused returns finished-run resumes without
-    # entering the loop), so a zero-body converged exit can only mean the
-    # program-initial or freshly-recomputed-resume selection already
-    # satisfied the gap — exactly the cases where the reference's
-    # do-while still runs one body.
+    # Fire when this call ends converged below the iteration cap AND the
+    # trailing body has not already been applied to this carry: either
+    # bodies ran in this call (n_iter advanced past the entry value), or
+    # this is the program-initial selection (n_iter == 0) that already
+    # satisfies the gap — the reference's do-while runs one body in both.
+    # The progress gate makes the trailing update idempotent, which the
+    # host driver relies on: its pipelined poll speculatively re-enters
+    # the runner with a finished carry (a zero-body no-op that must not
+    # re-apply the update — trailing itself bumps n_iter, closing the
+    # gate after the first application).
     converged = ~(final.b_lo > final.b_hi + 2.0 * epsilon)
-    return lax.cond(converged & (final.n_iter < max_iter),
+    progressed = (final.n_iter > carry.n_iter) | (final.n_iter == 0)
+    return lax.cond(converged & progressed & (final.n_iter < max_iter),
                     trailing, lambda s: s, final)
 
 
@@ -157,6 +161,23 @@ def train_single_device_fused(x: np.ndarray, y: np.ndarray,
         # written by the smo path record the previous body's selection,
         # which would be stale here).
         carry = carry._replace(n_iter=jnp.int32(ckpt.n_iter))
+        if not (float(carry.b_lo) > float(carry.b_hi)
+                + 2.0 * float(config.epsilon)):
+            # The recomputed selection already satisfies the gap. The smo
+            # path's resumed loop still runs one body here (its cond saw
+            # the checkpoint's stale open gap, and the body both computes
+            # this selection and applies its update — reference do-while,
+            # svmTrainMain.cpp:235-310). Mirror it once, host-side,
+            # keeping this selection's b's; the chunk loop then exits on
+            # its first poll without re-firing the trailing update
+            # (_run_chunk's progress gate sees n_iter already advanced).
+            body = jax.jit(functools.partial(
+                fused_smo_body, c=float(config.c), gamma=gamma,
+                block_n=block_n,
+                mxu_precision=getattr(lax.Precision, precision_name),
+                interpret=interpret))
+            stepped = body(carry, xd, x2, yd)
+            carry = stepped._replace(b_hi=carry.b_hi, b_lo=carry.b_lo)
     if device is not None:
         carry = jax.device_put(carry, device)
 

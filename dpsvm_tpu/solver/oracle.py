@@ -100,6 +100,7 @@ def smo_reference(
     trace: Optional[List] = None,
     f_init: Optional[np.ndarray] = None,
     alpha_init: Optional[np.ndarray] = None,
+    guard_eta: bool = False,
 ) -> TrainResult:
     """Train a binary RBF-SVM with the modified-SMO algorithm in NumPy.
 
@@ -178,9 +179,13 @@ def smo_reference(
             w2 = x2[(i_hi, i_lo),]
             k = _np_rows_from_dots(dots, w2[:, None], x2[None, :], kspec)
         eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
-        if second_order:
-            # Clamped like the WSS2 selection denominator (and LIBSVM);
-            # first-order keeps the reference's raw division.
+        if second_order or guard_eta:
+            # Clamped like the WSS2 selection denominator (and LIBSVM's
+            # TAU). ``guard_eta`` (set by the SVR/one-class wrappers)
+            # applies the same clamp under first-order: SVR's stacked
+            # twin rows make eta == 0 reachable (see solver/smo.py). The
+            # plain classification path keeps the reference's raw
+            # division.
             eta = np.float32(max(eta, 1e-12))
 
         y_hi = yf[i_hi]

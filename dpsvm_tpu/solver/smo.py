@@ -67,7 +67,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              second_order: bool = False, weights=(1.0, 1.0),
              precision=lax.Precision.HIGHEST,
              packed_select: bool = False,
-             pairwise_clip: bool = False) -> SMOCarry:
+             pairwise_clip: bool = False,
+             guard_eta: bool = False) -> SMOCarry:
     """One modified-SMO iteration (select -> eta -> alpha -> f).
 
     ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
@@ -138,11 +139,16 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         k = rows_from_dots(dots, w2, x2, kspec)                  # (2, n)
 
     eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
-    if second_order:
+    if second_order or guard_eta:
         # WSS2 steers toward small-eta pairs (the selection objective
         # divides by the clamped a_j), so clamp the update denominator
-        # the same way LIBSVM does; first-order keeps the reference's
-        # raw division (svmTrainMain.cpp:289).
+        # the same way LIBSVM does (TAU). ``guard_eta`` applies the same
+        # clamp to first-order on f_init-seeded problems (SVR/one-class):
+        # SVR stacks every row twice with opposite pseudo-labels
+        # (models/svr.py), so a selected twin pair has eta exactly 0 and
+        # the raw division would slam both alphas to box corners via inf.
+        # The plain classification path keeps the reference's raw
+        # division (svmTrainMain.cpp:289) for bit parity.
         eta = jnp.maximum(eta, 1e-12)
 
     y_hi, y_lo = y[i_hi], y[i_lo]
@@ -166,7 +172,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                         second_order: bool = False,
                         weights=(1.0, 1.0),
                         packed_select: bool = False,
-                        pairwise_clip: bool = False):
+                        pairwise_clip: bool = False,
+                        guard_eta: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit.
@@ -189,7 +196,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                                weights=weights,
                                precision=precision,
                                packed_select=packed_select,
-                               pairwise_clip=pairwise_clip),
+                               pairwise_clip=pairwise_clip,
+                               guard_eta=guard_eta),
             carry)
 
     return jax.jit(run, donate_argnums=(0,))
@@ -198,8 +206,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
 def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                         device: Optional[jax.Device] = None,
                         f_init: Optional[np.ndarray] = None,
-                        alpha_init: Optional[np.ndarray] = None
-                        ) -> TrainResult:
+                        alpha_init: Optional[np.ndarray] = None,
+                        guard_eta: bool = False) -> TrainResult:
     """Train on one device. Data arrives as host NumPy, leaves as NumPy.
 
     ``f_init`` / ``alpha_init`` override the classification
@@ -240,7 +248,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                  (float(config.weight_pos),
                                   float(config.weight_neg)),
                                  config.select_impl == "packed",
-                                 config.clip == "pairwise")
+                                 config.clip == "pairwise",
+                                 guard_eta=guard_eta)
 
     return host_training_loop(
         config, gamma, n, d, carry,

@@ -83,3 +83,20 @@ def test_cli_cv_rejects_multiclass(tmp_path, blobs_small):
     data = str(tmp_path / "d.csv")
     save_csv(data, x, y)
     assert main(["train", "-f", data, "--cv", "3", "--multiclass"]) == 2
+
+
+def test_cv_single_class_fold_raises():
+    """ADVICE r2: a binary CV fold whose train split ends up one-class
+    must fail loudly, not silently train a degenerate model. With one
+    -1 example and stratified assignment, that example sits in exactly
+    one fold; training on the k-1 folds that exclude it is all-+1."""
+    import pytest as _pytest
+
+    from dpsvm_tpu.models.cv import cross_validate
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(30, 4)).astype(np.float32)
+    y = np.full(30, 1, np.int32)
+    y[0] = -1
+    with _pytest.raises(ValueError, match="single class"):
+        cross_validate(x, y, 3, SVMConfig(max_iter=500))

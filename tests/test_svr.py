@@ -178,3 +178,76 @@ def test_regressor_estimator(reg_data):
     assert reg.score(x, y) > 0.99
     assert reg.predict(x[:7]).shape == (7,)
     assert reg.get_params()["epsilon"] == 0.05
+
+
+def test_guard_eta_twin_pair_finite():
+    """ADVICE r2 (medium): with duplicate rows (SVR stacks every row
+    twice), a selected twin pair has eta exactly 0; the f_init-seeded
+    paths clamp eta (LIBSVM TAU) so the step stays finite and lands on
+    the box like LIBSVM's max-step rule — on every backend, and
+    bit-identically between XLA and the oracle."""
+    from dpsvm_tpu.api import train
+
+    # Two identical rows with pseudo-labels +1/-1 and an f_init that
+    # makes them the first selected pair: eta = K00 + K11 - 2 K01 = 0.
+    x = np.array([[1.0, 0.0], [1.0, 0.0]], np.float32)
+    z = np.array([1, -1], np.int32)
+    f0 = np.array([-1.0, 1.0], np.float32)
+
+    results = {}
+    for backend in ("xla", "numpy"):
+        cfg = SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=50,
+                        backend=backend)
+        r = train(x, z, cfg, f_init=f0, guard_eta=True)
+        a = np.asarray(r.alpha, np.float32)
+        assert np.isfinite(a).all()
+        assert np.isfinite([r.b, r.b_lo, r.b_hi]).all()
+        assert (a >= 0).all() and (a <= 2.0).all()
+        # TAU clamp takes the maximal step: both alphas hit the box.
+        np.testing.assert_allclose(a, [2.0, 2.0])
+        results[backend] = (a, r.n_iter)
+    np.testing.assert_array_equal(results["xla"][0], results["numpy"][0])
+    assert results["xla"][1] == results["numpy"][1]
+
+
+def test_guard_eta_twin_pair_distributed():
+    """Same twin-pair hazard through the shard_map path (guard_eta is
+    threaded into _dist_step when f_init is given)."""
+    from dpsvm_tpu.api import train
+
+    x = np.tile(np.array([[1.0, 0.0]], np.float32), (8, 1))
+    z = np.array([1, 1, 1, 1, -1, -1, -1, -1], np.int32)
+    f0 = np.array([-1.0] * 4 + [1.0] * 4, np.float32)
+    cfg = SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=50, shards=4)
+    r = train(x, z, cfg, f_init=f0, guard_eta=True)
+    a = np.asarray(r.alpha, np.float32)
+    assert np.isfinite(a).all() and np.isfinite([r.b, r.b_lo, r.b_hi]).all()
+    assert (a >= 0).all() and (a <= 2.0).all()
+
+
+def test_svr_duplicate_training_points(reg_data):
+    """Exact duplicate x rows (common in real data) quadruple the twin
+    hazard; training must stay finite and accurate, and the pairwise
+    default keeps the equality constraint sum(a - a*) = 0 exact."""
+    x, y = reg_data
+    xd = np.vstack([x[:50], x[:50]])
+    yd = np.concatenate([y[:50], y[:50]])
+    model, result = train_svr(xd, yd, SVMConfig(c=10.0, svr_epsilon=0.02,
+                                                max_iter=40000))
+    assert result.converged
+    assert np.isfinite(np.asarray(result.alpha)).all()
+    m = evaluate_svr(model, xd, yd)
+    assert m["r2"] > 0.98
+
+
+def test_svr_pairwise_default_conserves_constraint(reg_data):
+    """train_svr defaults clip to 'pairwise' (ADVICE r2): the recovered
+    deltas satisfy sum(a - a*) = 0 exactly, so the intercept cannot
+    drift off the equality constraint."""
+    x, y = reg_data
+    model, result = train_svr(x, y, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                              max_iter=20000))
+    n = len(y)
+    beta = np.asarray(result.alpha, np.float32)
+    delta = beta[:n] - beta[n:]
+    assert abs(float(np.sum(delta))) < 1e-4

@@ -42,3 +42,22 @@ def test_native_killswitch_wins_over_cache(monkeypatch):
     nb.load_native_lib()
     monkeypatch.setenv("DPSVM_NO_NATIVE", "1")
     assert nb.load_native_lib() is None
+
+
+def test_driver_stats_pack_roundtrip_exact():
+    """The per-chunk poll packs (n_iter i32, b_lo f32, b_hi f32) into one
+    i32 array via bitcast; every field must round-trip exactly — n_iter
+    above 2^24 included (an f32 lane would round it and stall the
+    max_iter exit check)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dpsvm_tpu.solver.driver import _pack_stats, _read_stats
+
+    for it, lo, hi in [(0, 1.0, -1.0), (59_392, 0.25, -0.125),
+                       (16_777_217, 3.14159, -2.71828),
+                       (2_000_000_000, 1e-30, -1e30)]:
+        n, l, h = _read_stats(_pack_stats(jnp.int32(it), jnp.float32(lo),
+                                          jnp.float32(hi)))
+        assert n == it
+        assert l == np.float32(lo) and h == np.float32(hi)
