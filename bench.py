@@ -58,6 +58,15 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    try:        # persistent XLA compile cache (see bench_convergence.py)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_CACHE_DIR",
+                                         "/tmp/dpsvm_jaxcache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:
+        log(f"persistent compile cache unavailable: {e}")
+
     from dpsvm_tpu.data.synthetic import make_mnist_like
     from dpsvm_tpu.ops.kernels import row_norms_sq
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
@@ -88,7 +97,7 @@ def main() -> None:
     runner = _build_chunk_runner(10.0, 0.25, 1e-3, False, precision)
 
     with timer.phase("compile+warmup"):
-        carry = runner(carry, xd, yd, x2, jnp.int32(warmup_iters))
+        carry, _ = runner(carry, xd, yd, x2, jnp.int32(warmup_iters))
         jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
     if it0 < warmup_iters:
@@ -101,7 +110,7 @@ def main() -> None:
 
     with timer.phase("measure"):
         t0 = time.perf_counter()
-        carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+        carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
         jax.block_until_ready(carry.f)
         dt = time.perf_counter() - t0
     iters = int(carry.n_iter) - it0

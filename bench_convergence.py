@@ -46,6 +46,19 @@ def main() -> None:
     dev = require_devices()[0]
     log(f"device: {dev} ({dev.platform})")
 
+    # Persistent XLA compile cache: saves ~1.4 s of the per-process
+    # first-execution cost on the tunneled TPU (measured; the remaining
+    # ~4.4 s is server-side program load we cannot cache from here).
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_CACHE_DIR",
+                                         "/tmp/dpsvm_jaxcache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:          # cache flags vary across jax versions
+        log(f"persistent compile cache unavailable: {e}")
+
     import numpy as np
 
     from dpsvm_tpu.api import train
@@ -71,9 +84,12 @@ def main() -> None:
         x, y = make_mnist_like(n=n, d=d, seed=0)
         log(f"data: synthetic mnist-like ({n}x{d})")
 
+    # Large chunks cost nothing (the device-side while_loop exits the
+    # moment the gap closes — the limit is only a host-poll cadence) and
+    # each poll round pays a ~65 ms tunnel round-trip, so poll rarely.
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
-                       chunk_iters=2048)
+                       chunk_iters=8192)
 
     t0 = time.perf_counter()
     result = train(x, y, config)
@@ -83,6 +99,9 @@ def main() -> None:
     acc = evaluate(model, x, y)
     log(f"{result.n_iter} iters in {seconds:.2f}s, converged="
         f"{result.converged}, n_sv={result.n_sv}, train_acc={acc:.4f}")
+    log(f"split: loop {result.train_seconds:.2f}s (chunk runner, compile "
+        f"included) + setup {seconds - result.train_seconds:.2f}s "
+        f"(H2D transfer, host norms, alpha readback)")
 
     print(json.dumps({
         "metric": "mnist_scale_seconds_to_convergence",

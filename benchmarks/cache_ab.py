@@ -74,7 +74,7 @@ def measure(name: str, spec: dict, cache_lines: int, measure_iters: int,
     # default 500-iteration warm measures the early/mid-training regime.
     # Set BENCH_WARM_ITERS high to measure the near-convergence regime.
     warm = int(os.environ.get("BENCH_WARM_ITERS", 500))
-    carry = runner(carry, xd, yd, x2, jnp.int32(warm))
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(warm))
     jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
     if it0 < warm:
@@ -82,7 +82,7 @@ def measure(name: str, spec: dict, cache_lines: int, measure_iters: int,
               "shape too easy for a throughput window", file=sys.stderr)
 
     t0 = time.perf_counter()
-    carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
     jax.block_until_ready(carry.f)
     dt = time.perf_counter() - t0
     iters = int(carry.n_iter) - it0

@@ -82,7 +82,7 @@ def main():
     # Measure the device->host poll round-trip (the round-2 hot spot:
     # three separate blocking scalar reads per chunk paid this three
     # times; the driver now packs them into one transfer per chunk).
-    from dpsvm_tpu.solver.driver import _pack_stats, _read_stats
+    from dpsvm_tpu.solver.driver import _read_stats
     tiny = jnp.float32(1.0) + jnp.float32(1.0)
     tiny.block_until_ready()
     rtts = []
@@ -102,9 +102,8 @@ def main():
     while True:
         limit = min(it + chunk, max_iter)
         t = time.perf_counter()
-        carry = compiled(carry, xd, yd, x2, jnp.int32(limit))
-        it_new, b_lo, b_hi = _read_stats(
-            _pack_stats(carry.n_iter, carry.b_lo, carry.b_hi))
+        carry, stats = compiled(carry, xd, yd, x2, jnp.int32(limit))
+        it_new, b_lo, b_hi = _read_stats(stats)
         dt = time.perf_counter() - t
         chunk_times.append((it_new - it, dt))
         it = it_new

@@ -55,14 +55,14 @@ def measure(name: str, spec: dict, measure_iters: int, precision: str):
     runner = _build_chunk_runner(spec["c"], spec["gamma"], 1e-3, False,
                                  precision)
     carry = init_carry(yd, 0)
-    carry = runner(carry, xd, yd, x2, jnp.int32(200))
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(200))
     jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
     if it0 < 200:
         carry = init_carry(yd, 0)
         it0 = 0
     t0 = time.perf_counter()
-    carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
     jax.block_until_ready(carry.f)
     dt = time.perf_counter() - t0
     iters = int(carry.n_iter) - it0

@@ -46,7 +46,7 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
                                  packed_select=packed)
     carry = init_carry(yd, 0)
     warm = 200
-    carry = runner(carry, xd, yd, x2, jnp.int32(warm))
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(warm))
     jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
     if it0 < warm:
@@ -59,7 +59,7 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
         it0 = 0
 
     t0 = time.perf_counter()
-    carry = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
     jax.block_until_ready(carry.f)
     dt = time.perf_counter() - t0
     iters = int(carry.n_iter) - it0

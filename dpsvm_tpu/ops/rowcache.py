@@ -35,12 +35,15 @@ class RowCache(NamedTuple):
     tick: jax.Array     # () int32
 
 
-def cache_init(lines: int, n: int, dtype=jnp.float32) -> RowCache:
+def cache_init(lines: int, n: int, dtype=None) -> RowCache:
+    """Host-side NumPy init (no XLA programs — see solver/smo.init_carry;
+    the arrays move to the device with the first runner call)."""
+    import numpy as np
     return RowCache(
-        keys=jnp.full((lines,), -1, dtype=jnp.int32),
-        stamps=jnp.zeros((lines,), dtype=jnp.int32),
-        rows=jnp.zeros((lines, n), dtype=dtype),
-        tick=jnp.int32(0),
+        keys=np.full((lines,), -1, dtype=np.int32),
+        stamps=np.zeros((lines,), dtype=np.int32),
+        rows=np.zeros((lines, n), dtype=np.dtype(dtype or np.float32)),
+        tick=np.int32(0),
     )
 
 
@@ -53,7 +56,8 @@ def cache_fetch(cache: RowCache, key: jax.Array,
     ``SvmTrain::lookup_cache`` -> hit / ``get_new_cache_line`` + SGEMV
     (``svmTrain.cu:203-222``, ``cache.cu:62-105``).
     """
-    key = key.astype(jnp.int32)
+    key = jnp.asarray(key, jnp.int32)
+    cache = RowCache(*(jnp.asarray(v) for v in cache))   # see cache_fetch_pair
     hit_mask = cache.keys == key
     hit = jnp.any(hit_mask)
     line = jnp.where(hit, jnp.argmax(hit_mask), jnp.argmin(cache.stamps))
@@ -83,8 +87,11 @@ def cache_fetch_pair(cache: RowCache, key_a: jax.Array, key_b: jax.Array,
     over last-use ticks; the two lines are always distinct (key_a's line
     is patched out of key_b's eviction candidates).
     """
-    key_a = key_a.astype(jnp.int32)
-    key_b = key_b.astype(jnp.int32)
+    key_a = jnp.asarray(key_a, jnp.int32)
+    key_b = jnp.asarray(key_b, jnp.int32)
+    # cache_init builds host NumPy arrays (no init-time XLA programs);
+    # promote so eager (non-jit) callers get .at[] — a no-op under trace.
+    cache = RowCache(*(jnp.asarray(v) for v in cache))
     intmax = jnp.iinfo(jnp.int32).max
 
     same = key_b == key_a          # i_hi == i_lo corner: share one line

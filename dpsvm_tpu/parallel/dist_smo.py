@@ -43,13 +43,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
-                                   row_norms_sq, rows_from_dots)
+                                   rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
 from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
-from dpsvm_tpu.solver.driver import host_training_loop, resume_state
+from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
+                                     resume_state)
 
 
 class DistCarry(NamedTuple):
@@ -394,7 +395,15 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
         in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec, P(SHARD_AXIS),
                   P()),
         out_specs=carry_specs)
-    return jax.jit(mapped, donate_argnums=(0,))
+
+    def run_with_stats(carry, xs, ys, x2s, valid, limit):
+        final = mapped(carry, xs, ys, x2s, valid, limit)
+        # Packed poll scalars as a second output of the SAME compiled
+        # program — one D2H transfer per chunk, no auxiliary XLA
+        # program (solver/driver.py "Poll economics").
+        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+
+    return jax.jit(run_with_stats, donate_argnums=(0,))
 
 
 def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
@@ -430,10 +439,13 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     repl = NamedSharding(mesh, P())
     x_sharding = shard if config.shard_x else repl
 
-    xd = jax.device_put(jnp.asarray(xp), x_sharding)
-    yd = jax.device_put(jnp.asarray(yp), shard)
-    x2 = jax.device_put(row_norms_sq(jnp.asarray(xp)), x_sharding)
-    validd = jax.device_put(jnp.asarray(valid), shard)
+    xd = jax.device_put(xp, x_sharding)
+    yd = jax.device_put(yp, shard)
+    # Host einsum (the oracle's exact x2 expression) + sharded put: no
+    # device-side row-norm program, no replicated-then-resharded copy.
+    x2 = jax.device_put(np.einsum("ij,ij->i", xp, xp).astype(np.float32),
+                        x_sharding)
+    validd = jax.device_put(valid, shard)
 
     if ckpt is not None:
         alpha0 = np.zeros((n_pad,), np.float32)
@@ -456,15 +468,17 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     # model file holds no cache.
     lines = int(config.cache_size)
     row_shard = NamedSharding(mesh, P(SHARD_AXIS, None))
+    # Host NumPy + device_put: no per-constructor XLA programs (see
+    # solver/smo.init_carry on tunneled-TPU first-compile costs).
     carry = DistCarry(
-        alpha=jax.device_put(jnp.asarray(init[0]), shard),
-        f=jax.device_put(jnp.asarray(init[1]), shard),
-        b_hi=jax.device_put(jnp.float32(init[2]), repl),
-        b_lo=jax.device_put(jnp.float32(init[3]), repl),
-        n_iter=jax.device_put(jnp.int32(init[4]), repl),
-        ck=jax.device_put(jnp.full((p * lines,), -1, jnp.int32), shard),
-        cs=jax.device_put(jnp.zeros((p * lines,), jnp.int32), shard),
-        cr=jax.device_put(jnp.zeros((p * lines, n_s), jnp.float32),
+        alpha=jax.device_put(np.asarray(init[0], np.float32), shard),
+        f=jax.device_put(np.asarray(init[1], np.float32), shard),
+        b_hi=jax.device_put(np.float32(init[2]), repl),
+        b_lo=jax.device_put(np.float32(init[3]), repl),
+        n_iter=jax.device_put(np.int32(init[4]), repl),
+        ck=jax.device_put(np.full((p * lines,), -1, np.int32), shard),
+        cs=jax.device_put(np.zeros((p * lines,), np.int32), shard),
+        cr=jax.device_put(np.zeros((p * lines, n_s), np.float32),
                           row_shard),
     )
 
@@ -480,7 +494,7 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                 guard_eta=guard_eta)
 
     def step_chunk(c, lim):
-        limit = jax.device_put(jnp.int32(lim), repl)
+        limit = jax.device_put(np.int32(lim), repl)
         return runner(c, xd, yd, x2, validd, limit)
 
     return host_training_loop(
@@ -488,4 +502,5 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         step_chunk=step_chunk,
         carry_to_host=lambda c: (np.asarray(c.alpha)[:n],
                                  np.asarray(c.f)[:n]),
+        it0=int(init[4]),
     )

@@ -65,15 +65,16 @@ def _debug_nans(enabled: bool):
         jax.config.update("jax_debug_nans", prev)
 
 
-@jax.jit
-def _pack_stats(n_iter, b_lo, b_hi):
-    """(n_iter, b_lo, b_hi) as one (3,) i32 device array — one D2H
-    transfer instead of three blocking scalar reads. The floats ride as
-    bit patterns so every field is exact (an f32 lane would corrupt
-    n_iter above 2^24 and stall the max_iter exit check — reference
-    covtype budget is 3e6 and nothing validates an upper bound)."""
+def pack_stats(n_iter, b_lo, b_hi):
+    """(n_iter, b_lo, b_hi) as one (3,) i32 array — one D2H transfer
+    instead of three blocking scalar reads. The floats ride as bit
+    patterns so every field is exact (an f32 lane would corrupt n_iter
+    above 2^24 and stall the max_iter exit check — reference covtype
+    budget is 3e6 and nothing validates an upper bound). Called INSIDE
+    each solver's compiled chunk runner, so no auxiliary XLA program
+    exists to pay the per-program first-compile overhead."""
     bits = jax.lax.bitcast_convert_type(jnp.stack([b_lo, b_hi]), jnp.int32)
-    return jnp.concatenate([n_iter.reshape(1), bits])
+    return jnp.concatenate([jnp.reshape(n_iter, (1,)), bits])
 
 
 def _read_stats(stats) -> tuple:
@@ -88,8 +89,9 @@ def host_training_loop(
     n: int,
     d: int,
     carry,
-    step_chunk: Callable,                      # (carry, limit:int) -> carry
-    carry_to_host: Callable,                   # carry -> (alpha, f) np arrays
+    step_chunk: Callable,           # (carry, limit:int) -> (carry, stats)
+    carry_to_host: Callable,        # carry -> (alpha, f) np arrays
+    it0: int = 0,                   # carry's entry iteration (0 or resume)
 ) -> TrainResult:
     """Run chunks until convergence / max_iter; return the TrainResult."""
     eps = float(config.epsilon)
@@ -98,8 +100,6 @@ def host_training_loop(
     # with checkpointing on, fall back to the strictly-sequential order
     # so maybe_checkpoint sees the carry at the polled iteration.
     pipeline = config.checkpoint_every == 0
-
-    it0, _, _ = _read_stats(_pack_stats(carry.n_iter, carry.b_lo, carry.b_hi))
     last_saved = it0
 
     profile = (jax.profiler.trace(config.profile_dir)
@@ -108,16 +108,15 @@ def host_training_loop(
     t0 = time.perf_counter()
     with profile, _debug_nans(config.debug_nans):
         limit = min(it0 + chunk, config.max_iter)
-        carry = step_chunk(carry, limit)
+        carry, stats = step_chunk(carry, limit)
         while True:
-            stats = _pack_stats(carry.n_iter, carry.b_lo, carry.b_hi)
             if pipeline:
-                # Dispatch the next chunk before the poll blocks. The
-                # stats gather was dispatched first, so it reads the
-                # pre-donation buffers; the speculative chunk is free
-                # when this one converged (device cond exits instantly).
+                # Dispatch the next chunk before the poll blocks; the
+                # speculative chunk is free when this one converged
+                # (the device cond exits instantly), and the poll's
+                # round-trip latency overlaps its execution.
                 limit = min(limit + chunk, config.max_iter)
-                carry = step_chunk(carry, limit)
+                carry, next_stats = step_chunk(carry, limit)
 
             n_iter, b_lo, b_hi = _read_stats(stats)
             converged = not (b_lo > b_hi + 2.0 * eps)
@@ -139,9 +138,11 @@ def host_training_loop(
             last_saved = maybe_checkpoint(config, last_saved, n_iter, make)
             if done:
                 break
-            if not pipeline:
+            if pipeline:
+                stats = next_stats
+            else:
                 limit = min(n_iter + chunk, config.max_iter)
-                carry = step_chunk(carry, limit)
+                carry, stats = step_chunk(carry, limit)
     # In pipelined mode `carry` is the speculative chunk dispatched after
     # the final poll; it was a no-op (converged => cond false on entry;
     # max_iter => limit == n_iter), so its state equals the final state.

@@ -28,7 +28,8 @@ from dpsvm_tpu.ops.fused_step import (DEFAULT_BLOCK_N, FusedCarry,
                                       fused_smo_body, pad_to_block)
 from dpsvm_tpu.ops.kernels import row_norms_sq
 from dpsvm_tpu.ops.selection import masked_extrema
-from dpsvm_tpu.solver.driver import host_training_loop, resume_state
+from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
+                                     resume_state)
 
 
 def _should_interpret() -> bool:
@@ -92,8 +93,9 @@ def _run_chunk(carry: FusedCarry, x, x2, y, limit, *, c, gamma, epsilon,
     # gate after the first application).
     converged = ~(final.b_lo > final.b_hi + 2.0 * epsilon)
     progressed = (final.n_iter > carry.n_iter) | (final.n_iter == 0)
-    return lax.cond(converged & progressed & (final.n_iter < max_iter),
-                    trailing, lambda s: s, final)
+    out = lax.cond(converged & progressed & (final.n_iter < max_iter),
+                   trailing, lambda s: s, final)
+    return out, pack_stats(out.n_iter, out.b_lo, out.b_hi)
 
 
 def init_fused_carry(alpha, f, y, c: float) -> FusedCarry:
@@ -189,7 +191,8 @@ def train_single_device_fused(x: np.ndarray, y: np.ndarray,
 
     return host_training_loop(
         config, gamma, n, d, carry,
-        step_chunk=lambda s, lim: run(s, xd, x2, yd, jnp.int32(lim)),
+        step_chunk=lambda s, lim: run(s, xd, x2, yd, np.int32(lim)),
         carry_to_host=lambda s: (np.asarray(s.alpha[0, :n]),
                                  np.asarray(s.f[0, :n])),
+        it0=int(ckpt.n_iter) if ckpt is not None else 0,
     )

@@ -31,12 +31,13 @@ from jax import lax
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
-                                   row_norms_sq, rows_from_dots)
+                                   rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
 from dpsvm_tpu.ops.update import alpha_pair_step
-from dpsvm_tpu.solver.driver import host_training_loop, resume_state
+from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
+                                     resume_state)
 
 
 class SMOCarry(NamedTuple):
@@ -48,16 +49,24 @@ class SMOCarry(NamedTuple):
     cache: RowCache
 
 
-def init_carry(y: jax.Array, cache_lines: int) -> SMOCarry:
+def init_carry(y, cache_lines: int) -> SMOCarry:
     """alpha = 0, f = -y (svmTrain.cu:349,380); sentinels force the first
-    iteration to run, preserving the reference's do-while shape."""
+    iteration to run, preserving the reference's do-while shape.
+
+    Built host-side in NumPy on purpose: every distinct tiny XLA program
+    costs ~0.5-3 s of first-compile overhead per process on the tunneled
+    TPU (measured, benchmarks/profile_train_path.py), and the jnp
+    zeros/neg/full constructors here used to be 3-4 such programs. The
+    NumPy pytree transfers to the device at the first runner call with
+    zero compiles."""
     n = y.shape[0]
+    y_np = np.asarray(y, np.float32)
     return SMOCarry(
-        alpha=jnp.zeros((n,), jnp.float32),
-        f=(-y).astype(jnp.float32),
-        b_hi=jnp.float32(-SENTINEL),
-        b_lo=jnp.float32(SENTINEL),
-        n_iter=jnp.int32(0),
+        alpha=np.zeros((n,), np.float32),
+        f=-y_np,
+        b_hi=np.float32(-SENTINEL),
+        b_lo=np.float32(SENTINEL),
+        n_iter=np.int32(0),
         cache=cache_init(cache_lines, n),
     )
 
@@ -188,7 +197,7 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
         return (carry.b_lo > carry.b_hi + 2.0 * epsilon) & (carry.n_iter < limit)
 
     def run(carry: SMOCarry, x, y, x2, limit):
-        return lax.while_loop(
+        final = lax.while_loop(
             lambda s: cond(s, limit),
             lambda s: smo_step(s, x, y, x2, c, kspec,
                                use_cache=use_cache,
@@ -199,6 +208,11 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                                pairwise_clip=pairwise_clip,
                                guard_eta=guard_eta),
             carry)
+        # Poll stats packed inside the same program: the host reads one
+        # (3,) array per chunk instead of three blocking scalars, and no
+        # auxiliary XLA program exists to pay first-compile overhead
+        # (solver/driver.py "Poll economics").
+        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
 
     return jax.jit(run, donate_argnums=(0,))
 
@@ -225,19 +239,23 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
     yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
-    x2 = row_norms_sq(xd)
-    carry = init_carry(yd, config.cache_size)
+    # x2 on the host with the oracle's exact expression (oracle.py) — one
+    # fewer first-compile on the tunneled TPU (see init_carry) and the
+    # bit-identical input the parity tests compare against.
+    xf = np.ascontiguousarray(x, dtype=np.float32)
+    x2 = np.einsum("ij,ij->i", xf, xf).astype(np.float32)
+    carry = init_carry(np.asarray(y, np.float32), config.cache_size)
     if f_init is not None:
-        carry = carry._replace(f=jnp.asarray(f_init, jnp.float32))
+        carry = carry._replace(f=np.asarray(f_init, np.float32))
     if alpha_init is not None:
-        carry = carry._replace(alpha=jnp.asarray(alpha_init, jnp.float32))
+        carry = carry._replace(alpha=np.asarray(alpha_init, np.float32))
 
     ckpt = resume_state(config, n, d, gamma)
     if ckpt is not None:
         carry = carry._replace(
-            alpha=jnp.asarray(ckpt.alpha), f=jnp.asarray(ckpt.f),
-            b_hi=jnp.float32(ckpt.b_hi), b_lo=jnp.float32(ckpt.b_lo),
-            n_iter=jnp.int32(ckpt.n_iter))
+            alpha=np.asarray(ckpt.alpha), f=np.asarray(ckpt.f),
+            b_hi=np.float32(ckpt.b_hi), b_lo=np.float32(ckpt.b_lo),
+            n_iter=np.int32(ckpt.n_iter))
     if device is not None:
         carry = jax.device_put(carry, device)
 
@@ -253,6 +271,7 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     return host_training_loop(
         config, gamma, n, d, carry,
-        step_chunk=lambda c, lim: runner(c, xd, yd, x2, jnp.int32(lim)),
+        step_chunk=lambda c, lim: runner(c, xd, yd, x2, np.int32(lim)),
         carry_to_host=lambda c: (np.asarray(c.alpha), np.asarray(c.f)),
+        it0=int(ckpt.n_iter) if ckpt is not None else 0,
     )

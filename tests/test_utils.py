@@ -52,12 +52,12 @@ def test_driver_stats_pack_roundtrip_exact():
     import jax.numpy as jnp
     import numpy as np
 
-    from dpsvm_tpu.solver.driver import _pack_stats, _read_stats
+    from dpsvm_tpu.solver.driver import _read_stats, pack_stats
 
     for it, lo, hi in [(0, 1.0, -1.0), (59_392, 0.25, -0.125),
                        (16_777_217, 3.14159, -2.71828),
                        (2_000_000_000, 1e-30, -1e30)]:
-        n, l, h = _read_stats(_pack_stats(jnp.int32(it), jnp.float32(lo),
-                                          jnp.float32(hi)))
+        n, l, h = _read_stats(pack_stats(jnp.int32(it), jnp.float32(lo),
+                                         jnp.float32(hi)))
         assert n == it
         assert l == np.float32(lo) and h == np.float32(hi)
