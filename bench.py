@@ -58,14 +58,8 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    try:        # persistent XLA compile cache (see bench_convergence.py)
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_CACHE_DIR",
-                                         "/tmp/dpsvm_jaxcache"))
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:
-        log(f"persistent compile cache unavailable: {e}")
+    from dpsvm_tpu.utils.backend_guard import enable_compile_cache
+    enable_compile_cache()
 
     from dpsvm_tpu.data.synthetic import make_mnist_like
     from dpsvm_tpu.ops.kernels import row_norms_sq
@@ -90,7 +84,7 @@ def main() -> None:
         xd = jnp.asarray(x)
         yd = jnp.asarray(y, jnp.float32)
         x2 = row_norms_sq(xd)
-        carry = init_carry(yd, cache_lines=0)
+        carry = init_carry(y, cache_lines=0)
         jax.block_until_ready((xd, x2))
 
     # MNIST benchmark hyperparameters (README.md:23).
@@ -105,7 +99,7 @@ def main() -> None:
         # to convergence instead of an already-exhausted carry.
         log(f"WARNING: converged during warmup after {it0} iters; "
             "measuring a fresh run to convergence")
-        carry = init_carry(yd, cache_lines=0)
+        carry = init_carry(y, cache_lines=0)
         it0 = 0
 
     with timer.phase("measure"):

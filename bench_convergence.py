@@ -46,18 +46,8 @@ def main() -> None:
     dev = require_devices()[0]
     log(f"device: {dev} ({dev.platform})")
 
-    # Persistent XLA compile cache: saves ~1.4 s of the per-process
-    # first-execution cost on the tunneled TPU (measured; the remaining
-    # ~4.4 s is server-side program load we cannot cache from here).
-    try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_CACHE_DIR",
-                                         "/tmp/dpsvm_jaxcache"))
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:          # cache flags vary across jax versions
-        log(f"persistent compile cache unavailable: {e}")
+    from dpsvm_tpu.utils.backend_guard import enable_compile_cache
+    enable_compile_cache()
 
     import numpy as np
 

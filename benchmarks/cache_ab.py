@@ -68,7 +68,7 @@ def measure(name: str, spec: dict, cache_lines: int, measure_iters: int,
 
     runner = _build_chunk_runner(spec["c"], spec["gamma"], 1e-3,
                                  cache_lines > 0, precision.upper())
-    carry = init_carry(yd, cache_lines)
+    carry = init_carry(y, cache_lines)
     # SMO's index-revisit rate (and so the cache hit rate) rises as the
     # working set narrows toward the boundary set near convergence; the
     # default 500-iteration warm measures the early/mid-training regime.
@@ -98,9 +98,12 @@ def measure(name: str, spec: dict, cache_lines: int, measure_iters: int,
 
 
 def main() -> None:
-    from dpsvm_tpu.utils.backend_guard import require_devices
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                            require_devices)
 
     dev = require_devices()[0]
+
+    enable_compile_cache()
     print(f"# device: {dev}", file=sys.stderr)
 
     names = sys.argv[1:] or ["adult", "mnist"]

@@ -29,9 +29,12 @@ import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
 
 
 def main() -> None:
-    from dpsvm_tpu.utils.backend_guard import require_devices
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                            require_devices)
 
     dev = require_devices()[0]
+
+    enable_compile_cache()
     print(f"# device: {dev}", file=sys.stderr)
 
     import jax
@@ -115,7 +118,7 @@ def main() -> None:
     timed("selection", loop_select, alpha, f)
     timed("kernel_rows_matmul", loop_matmul, f)
     timed("f_update_axpy", loop_update, f)
-    timed("full_iteration", loop_full, init_carry(yd, 0))
+    timed("full_iteration", loop_full, init_carry(y, 0))
 
 
 if __name__ == "__main__":

@@ -32,8 +32,10 @@ def log(msg):
 
 
 def main():
-    from dpsvm_tpu.utils.backend_guard import require_devices
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                            require_devices)
     dev = require_devices()[0]
+    enable_compile_cache()
     log(f"device: {dev}")
 
     from dpsvm_tpu.config import SVMConfig
@@ -70,7 +72,7 @@ def main():
     runner = _build_chunk_runner(float(c), kspec, eps, False, precision)
 
     # Explicit AOT split: trace+compile time vs execute time.
-    carry = init_carry(yd, 0)
+    carry = init_carry(y, 0)
     t = time.perf_counter()
     lowered = runner.lower(carry, xd, yd, x2, jnp.int32(chunk))
     t_trace = time.perf_counter() - t

@@ -54,12 +54,12 @@ def measure(name: str, spec: dict, measure_iters: int, precision: str):
 
     runner = _build_chunk_runner(spec["c"], spec["gamma"], 1e-3, False,
                                  precision)
-    carry = init_carry(yd, 0)
+    carry = init_carry(y, 0)
     carry, _ = runner(carry, xd, yd, x2, jnp.int32(200))
     jax.block_until_ready(carry.f)
     it0 = int(carry.n_iter)
     if it0 < 200:
-        carry = init_carry(yd, 0)
+        carry = init_carry(y, 0)
         it0 = 0
     t0 = time.perf_counter()
     carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
@@ -79,6 +79,8 @@ def measure(name: str, spec: dict, measure_iters: int, precision: str):
 
 
 def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import enable_compile_cache
+    enable_compile_cache()
     # default = the three reference-Makefile jobs; the extended
     # shapes (ijcnn1, epsilon — 3.2 GB X) must be asked for.
     names = sys.argv[1:] or ["adult", "mnist", "covtype"]

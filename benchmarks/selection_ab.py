@@ -44,7 +44,7 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
     runner = _build_chunk_runner(10.0, 0.25, 1e-3, False,
                                  precision.upper(),
                                  packed_select=packed)
-    carry = init_carry(yd, 0)
+    carry = init_carry(y, 0)
     warm = 200
     carry, _ = runner(carry, xd, yd, x2, jnp.int32(warm))
     jax.block_until_ready(carry.f)
@@ -55,7 +55,7 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
         # bench.py).
         print(f"# warning: converged during warmup ({it0} iters); "
               "measuring a fresh run", file=sys.stderr)
-        carry = init_carry(yd, 0)
+        carry = init_carry(y, 0)
         it0 = 0
 
     t0 = time.perf_counter()
@@ -75,9 +75,12 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
 
 
 def main() -> None:
-    from dpsvm_tpu.utils.backend_guard import require_devices
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                            require_devices)
 
     dev = require_devices()[0]
+
+    enable_compile_cache()
     print(f"# device: {dev}", file=sys.stderr)
     n = int(os.environ.get("BENCH_N", 60_000))
     d = int(os.environ.get("BENCH_D", 784))

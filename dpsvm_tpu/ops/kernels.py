@@ -60,6 +60,17 @@ def row_norms_sq(x: jax.Array, precision=jax.lax.Precision.HIGHEST) -> jax.Array
     return jnp.einsum("ij,ij->i", x, x, precision=precision)
 
 
+def host_row_norms_sq(x) -> "np.ndarray":
+    """|x_i|^2 per row on the HOST, with the oracle's exact expression
+    (solver/oracle.py) — the single source of the bit-parity row norms
+    both solver front-ends feed the device. Host-side on purpose: a
+    device-side norm program is one more tiny XLA compile per process
+    on the tunneled TPU (see solver/smo.init_carry)."""
+    import numpy as np
+    xf = np.ascontiguousarray(x, dtype=np.float32)
+    return np.einsum("ij,ij->i", xf, xf).astype(np.float32)
+
+
 def rbf_rows_from_dots(dots: jax.Array, w2: jax.Array, x2: jax.Array,
                        gamma) -> jax.Array:
     """K(a, i) = exp(-gamma (|x_i|^2 + |x_a|^2 - 2 x_a.x_i)).

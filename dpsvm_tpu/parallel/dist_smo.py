@@ -42,8 +42,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
-                                   rows_from_dots)
+from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_norms_sq,
+                                   kdiag_from_norms, rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
@@ -441,10 +441,7 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     xd = jax.device_put(xp, x_sharding)
     yd = jax.device_put(yp, shard)
-    # Host einsum (the oracle's exact x2 expression) + sharded put: no
-    # device-side row-norm program, no replicated-then-resharded copy.
-    x2 = jax.device_put(np.einsum("ij,ij->i", xp, xp).astype(np.float32),
-                        x_sharding)
+    x2 = jax.device_put(host_row_norms_sq(xp), x_sharding)
     validd = jax.device_put(valid, shard)
 
     if ckpt is not None:

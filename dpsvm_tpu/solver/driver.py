@@ -14,8 +14,11 @@ profile_train_path.py): a blocking device->host scalar read costs
 run waiting on polls. Two fixes live here:
 
 * **packed stats**: the three poll scalars (n_iter, b_lo, b_hi) are
-  packed into ONE (3,) device array by a tiny jitted gather and fetched
-  with a single transfer per chunk;
+  packed into ONE (3,) device array INSIDE each solver's compiled chunk
+  runner (``pack_stats`` is traced into the same program, returned as a
+  second output) and fetched with a single transfer per chunk. No
+  auxiliary jitted gather exists — a separate tiny program would pay
+  its own ~0.5-3 s per-process first-compile on the tunneled TPU;
 * **pipelined dispatch**: the next chunk is dispatched BEFORE the
   previous chunk's stats are read. The device-side ``lax.while_loop``
   checks convergence every iteration, so a speculative chunk dispatched

@@ -30,8 +30,8 @@ import numpy as np
 from jax import lax
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import (KernelSpec, kdiag_from_norms,
-                                   rows_from_dots)
+from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_norms_sq,
+                                   kdiag_from_norms, rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
@@ -239,11 +239,7 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
     yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
-    # x2 on the host with the oracle's exact expression (oracle.py) — one
-    # fewer first-compile on the tunneled TPU (see init_carry) and the
-    # bit-identical input the parity tests compare against.
-    xf = np.ascontiguousarray(x, dtype=np.float32)
-    x2 = np.einsum("ij,ij->i", xf, xf).astype(np.float32)
+    x2 = jax.device_put(host_row_norms_sq(x), device)
     carry = init_carry(np.asarray(y, np.float32), config.cache_size)
     if f_init is not None:
         carry = carry._replace(f=np.asarray(f_init, np.float32))
