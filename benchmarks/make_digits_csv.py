@@ -1,0 +1,35 @@
+"""Write sklearn's bundled digits dataset as an odd/even train CSV.
+
+The reference's MNIST benchmark transform (/root/reference/scripts/
+convert_mnist_to_odd_even.py:23-29: label +1 if the digit is even else
+-1, pixels scaled to [0,1]) applied to the real 1797x64 digits that
+scikit-learn bundles offline. Produces the CSV behind the real-data row
+in docs/PERF.md:
+
+    python benchmarks/make_digits_csv.py /tmp/digits_oe.csv
+    BENCH_C=10 BENCH_GAMMA=0.125 BENCH_DATA=/tmp/digits_oe.csv \
+        python bench_convergence.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+import numpy as np
+
+
+def main(dst: str) -> None:
+    from sklearn.datasets import load_digits
+
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    ds = load_digits()
+    x = (ds.data / 16.0).astype(np.float32)
+    y = np.where(ds.target % 2 == 0, 1, -1).astype(np.int32)
+    save_csv(dst, x, y)
+    print(f"wrote {x.shape[0]}x{x.shape[1]} -> {dst}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "digits_oe.csv")

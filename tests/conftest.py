@@ -28,6 +28,58 @@ import pytest
 from dpsvm_tpu.data.synthetic import make_blobs, make_xor
 
 
+def split_train_test(x, y, frac=0.25, seed=0):
+    """Shared train/test split for the LibSVM-parity suites
+    (test_libsvm_parity.py, test_realdata.py)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(y))
+    k = int(len(y) * frac)
+    te, tr = perm[:k], perm[k:]
+    return x[tr], y[tr], x[te], y[te]
+
+
+def assert_libsvm_parity(x, y, C, gamma, tol, name,
+                         selection="first-order"):
+    """The parity bar shared by the synthetic and real-data suites:
+    train sklearn's SVC (libsvm) and our solver at the same (C, gamma,
+    tol) and assert SV count within 2% (+/- 3 absolute on tiny
+    problems) and train/test accuracy within one example each way —
+    the reference's own quality claim (README.md:27). Returns
+    (model, result) for extra assertions."""
+    from sklearn import svm as sklearn_svm
+
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.svm import evaluate
+
+    xtr, ytr, xte, yte = split_train_test(x, y)
+
+    ref = sklearn_svm.SVC(C=C, kernel="rbf", gamma=gamma, tol=tol)
+    ref.fit(xtr, ytr)
+    ref_nsv = int(ref.n_support_.sum())
+
+    # libsvm stops at m(alpha) - M(alpha) <= eps; ours at
+    # b_lo > b_hi + 2*eps — pass eps/2 so both stop at the same gap.
+    cfg = SVMConfig(c=C, gamma=gamma, epsilon=tol / 2.0,
+                    selection=selection)
+    model, result = fit(xtr, ytr, cfg)
+    assert result.converged, (
+        f"{name}: no convergence in {result.n_iter} iters "
+        f"(gap={result.gap:.5f})")
+
+    slack = max(0.02 * ref_nsv, 3.0)
+    assert abs(model.n_sv - ref_nsv) <= slack, (
+        f"{name}: n_sv={model.n_sv} vs libsvm {ref_nsv}")
+
+    train_acc = evaluate(model, xtr, ytr)
+    test_acc = evaluate(model, xte, yte)
+    assert abs(train_acc - float(ref.score(xtr, ytr))) <= (
+        1.0 / len(ytr) + 1e-9), f"{name}: train acc {train_acc:.4f}"
+    assert abs(test_acc - float(ref.score(xte, yte))) <= (
+        1.0 / len(yte) + 1e-9), f"{name}: test acc {test_acc:.4f}"
+    return model, result
+
+
 @pytest.fixture(scope="session")
 def blobs_small():
     return make_blobs(n=96, d=6, seed=3)

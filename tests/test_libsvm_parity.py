@@ -26,10 +26,12 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from conftest import assert_libsvm_parity, split_train_test
+
 from dpsvm_tpu.api import fit
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.data.synthetic import make_blobs, make_xor
-from dpsvm_tpu.models.svm import decision_function, evaluate, predict
+from dpsvm_tpu.models.svm import decision_function, predict
 
 sklearn_svm = pytest.importorskip("sklearn.svm")
 
@@ -55,50 +57,17 @@ CASES = [
 ]
 
 
-def _split(x, y, frac=0.25, seed=0):
-    rng = np.random.default_rng(seed)
-    n = len(y)
-    perm = rng.permutation(n)
-    k = int(n * frac)
-    te, tr = perm[:k], perm[k:]
-    return x[tr], y[tr], x[te], y[te]
-
-
 @pytest.mark.parametrize("selection", ["first-order", "second-order"])
 @pytest.mark.parametrize("name,build,C,gamma,tol",
                          CASES, ids=[c[0] for c in CASES])
 def test_sv_count_and_accuracy_parity(name, build, C, gamma, tol,
                                       selection):
     x, y = build()
-    xtr, ytr, xte, yte = _split(x, y)
-
-    ref = sklearn_svm.SVC(C=C, kernel="rbf", gamma=gamma, tol=tol)
-    ref.fit(xtr, ytr)
-    ref_nsv = int(ref.n_support_.sum())
-    ref_train_acc = float(ref.score(xtr, ytr))
-    ref_test_acc = float(ref.score(xte, yte))
-
-    cfg = SVMConfig(c=C, gamma=gamma, epsilon=tol / 2.0,
-                    selection=selection)
-    model, result = fit(xtr, ytr, cfg)
-    assert result.converged, (
-        f"{name}/{selection}: no convergence in {result.n_iter} iters "
-        f"(gap={result.gap:.5f})")
-
-    # SV-count parity: the reference's own quality bar (README.md:27).
-    slack = max(0.02 * ref_nsv, 3.0)
-    assert abs(model.n_sv - ref_nsv) <= slack, (
-        f"{name}/{selection}: n_sv={model.n_sv} vs libsvm {ref_nsv}")
-
-    # Accuracy parity within one example each way.
-    train_acc = evaluate(model, xtr, ytr)
-    test_acc = evaluate(model, xte, yte)
-    assert abs(train_acc - ref_train_acc) <= 1.0 / len(ytr) + 1e-9, (
-        f"{name}/{selection}: train acc {train_acc:.4f} vs "
-        f"libsvm {ref_train_acc:.4f}")
-    assert abs(test_acc - ref_test_acc) <= 1.0 / len(yte) + 1e-9, (
-        f"{name}/{selection}: test acc {test_acc:.4f} vs "
-        f"libsvm {ref_test_acc:.4f}")
+    # The parity bar itself (SV count within 2%, accuracy within one
+    # example) lives in conftest.assert_libsvm_parity, shared with the
+    # real-data suite (test_realdata.py) so the two stay on one bar.
+    assert_libsvm_parity(x, y, C, gamma, tol,
+                         name=f"{name}/{selection}", selection=selection)
 
 
 def test_decision_values_match_libsvm_on_blobs():
@@ -108,7 +77,7 @@ def test_decision_values_match_libsvm_on_blobs():
     decision values should match to ~tol everywhere, not just in sign.
     """
     x, y = make_blobs(n=240, d=5, seed=7)
-    xtr, ytr, xte, yte = _split(x, y, seed=7)
+    xtr, ytr, xte, yte = split_train_test(x, y, seed=7)
     C, gamma, tol = 5.0, 0.5, 1e-4
 
     ref = sklearn_svm.SVC(C=C, kernel="rbf", gamma=gamma, tol=tol)
