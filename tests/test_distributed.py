@@ -16,7 +16,7 @@ from dpsvm_tpu.solver.oracle import smo_reference
 from dpsvm_tpu.solver.smo import train_single_device
 
 
-def _check_vs_single(x, y, cfg_dist):
+def _check_vs_single(x, y, cfg_dist, rtol=1e-4, atol=1e-5, b_tol=1e-4):
     cfg_single = SVMConfig(c=cfg_dist.c, gamma=cfg_dist.gamma,
                            epsilon=cfg_dist.epsilon,
                            max_iter=cfg_dist.max_iter)
@@ -25,8 +25,8 @@ def _check_vs_single(x, y, cfg_dist):
     assert dist.converged == single.converged
     assert dist.n_iter == single.n_iter, (dist.n_iter, single.n_iter)
     np.testing.assert_allclose(dist.alpha, single.alpha,
-                               rtol=1e-4, atol=1e-5)
-    assert abs(dist.b - single.b) < 1e-4
+                               rtol=rtol, atol=atol)
+    assert abs(dist.b - single.b) < b_tol
     return single, dist
 
 
@@ -108,6 +108,37 @@ def test_distributed_row_cache_bit_equal(blobs_small, shards, shard_x):
     np.testing.assert_array_equal(np.asarray(cached.alpha),
                                   np.asarray(plain.alpha))
     assert cached.b == plain.b
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shard_x", [True, False],
+                         ids=["shard_x", "replicated_x"])
+def test_midscale_distributed_parity(shard_x):
+    """Mid-scale model equality: 8 shards vs single device at n=8,192.
+
+    The fast trajectory-exact tests top out at n~120 and the n=500,000
+    scale test asserts only completion — this closes the gap between
+    them: at a shape where thousands of iterations of f32 drift could
+    accumulate, the 8-shard program (both X layouts) must converge in
+    the IDENTICAL number of iterations and produce the same model as
+    one device. The reference's own validation ran real 10-rank jobs
+    (Makefile:74-77) but could never compare them against a
+    single-device trajectory; the SPMD design makes that an assertable
+    property."""
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=8192, d=16, seed=5, separation=1.0)
+    cfg = SVMConfig(c=4.0, gamma=0.125, epsilon=1e-3, max_iter=60_000,
+                    shards=8, shard_x=shard_x, chunk_iters=1024)
+    single, dist = _check_vs_single(x, y, cfg, rtol=1e-4, atol=1e-4,
+                                    b_tol=1e-3)
+    assert single.converged
+    # Same support set, judged above the admitted f32 drift: membership
+    # exactly at zero is drift-ambiguous (an alpha can land at 0.0 on
+    # one path and ~1e-5 on the other), so compare at 10x the atol.
+    thresh = 1e-3
+    assert np.array_equal(np.asarray(dist.alpha) > thresh,
+                          np.asarray(single.alpha) > thresh)
 
 
 def test_distributed_row_cache_min_capacity_eviction(blobs_small):
