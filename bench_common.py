@@ -1,0 +1,34 @@
+"""Shared synthetic stand-in choice for every benchmark harness.
+
+The reference's datasets were stripped from its snapshot and this
+environment is zero-egress, so benchmarks run on synthetic stand-ins of
+the exact shapes/hyperparameters. The default generator is
+``make_planted`` — calibrated against real image data so the kernel
+matrix has realistic off-diagonal mass and every reference config can
+actually converge (the round-2 verdict showed ``make_mnist_like``'s
+i.i.d. features make K near-identity at benchmark gammas, stalling
+global progress). Set ``BENCH_GEN=mnist-like`` to reproduce the older
+rounds' numbers on the legacy generator.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def standin(n: int, d: int, gamma: float, seed: int = 0):
+    """(x, y) stand-in for an (n, d) benchmark trained at ``gamma``."""
+    gen = os.environ.get("BENCH_GEN", "planted")
+    if gen == "planted":
+        from dpsvm_tpu.data.synthetic import make_planted
+        x, y = make_planted(n=n, d=d, gamma=gamma, seed=seed)
+    elif gen == "mnist-like":
+        from dpsvm_tpu.data.synthetic import make_mnist_like
+        x, y = make_mnist_like(n=n, d=d, seed=seed)
+    else:
+        raise SystemExit(f"BENCH_GEN must be 'planted' or 'mnist-like', "
+                         f"got {gen!r}")
+    print(f"data: synthetic {gen} ({n}x{d}, gamma={gamma})",
+          file=sys.stderr, flush=True)
+    return x, y

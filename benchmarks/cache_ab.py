@@ -48,9 +48,9 @@ def measure(name: str, spec: dict, cache_lines: int, measure_iters: int,
     import jax
     import jax.numpy as jnp
 
-    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from bench_common import standin
 
-    x, y = make_mnist_like(n=spec["n"], d=spec["d"], seed=0)
+    x, y = standin(n=spec["n"], d=spec["d"], gamma=spec["gamma"], seed=0)
 
     # Warm + measure through the production chunk runner (the same
     # compiled program train_single_device drives).

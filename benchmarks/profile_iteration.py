@@ -41,7 +41,7 @@ def main() -> None:
     import jax.numpy as jnp
     from jax import lax
 
-    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from bench_common import standin
     from dpsvm_tpu.ops.kernels import rbf_rows_from_dots, row_norms_sq
     from dpsvm_tpu.ops.selection import masked_extrema
     from dpsvm_tpu.solver.smo import init_carry, smo_step
@@ -53,7 +53,7 @@ def main() -> None:
     precision = getattr(lax.Precision, prec_name)
     c, gamma = 10.0, 0.25
 
-    x, y = make_mnist_like(n=n, d=d, seed=0)
+    x, y = standin(n=n, d=d, gamma=0.25, seed=0)
     xd = jnp.asarray(x)
     yd = jnp.asarray(y, jnp.float32)
     x2 = row_norms_sq(xd)

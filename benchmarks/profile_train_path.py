@@ -39,7 +39,7 @@ def main():
     log(f"device: {dev}")
 
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from bench_common import standin
     from dpsvm_tpu.ops.kernels import row_norms_sq
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
 
@@ -53,7 +53,7 @@ def main():
     max_iter = int(os.environ.get("BENCH_MAX_ITER", 100_000))
 
     t = time.perf_counter()
-    x, y = make_mnist_like(n=n, d=d, seed=0)
+    x, y = standin(n=n, d=d, gamma=gamma, seed=0)
     t_gen = time.perf_counter() - t
     log(f"data-gen: {t_gen:.3f}s")
 

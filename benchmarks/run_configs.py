@@ -42,11 +42,11 @@ def measure(name: str, spec: dict, measure_iters: int, precision: str):
     import jax
     import jax.numpy as jnp
 
-    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from bench_common import standin
     from dpsvm_tpu.ops.kernels import row_norms_sq
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
 
-    x, y = make_mnist_like(n=spec["n"], d=spec["d"], seed=0)
+    x, y = standin(n=spec["n"], d=spec["d"], gamma=spec["gamma"], seed=0)
     xd = jnp.asarray(x)
     yd = jnp.asarray(y, jnp.float32)
     x2 = row_norms_sq(xd)

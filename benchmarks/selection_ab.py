@@ -31,11 +31,11 @@ def measure(packed: bool, n: int, d: int, measure_iters: int,
     import jax
     import jax.numpy as jnp
 
-    from dpsvm_tpu.data.synthetic import make_mnist_like
+    from bench_common import standin
     from dpsvm_tpu.ops.kernels import row_norms_sq
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
 
-    x, y = make_mnist_like(n=n, d=d, seed=0)
+    x, y = standin(n=n, d=d, gamma=0.25, seed=0)
     xd = jnp.asarray(x)
     yd = jnp.asarray(y, jnp.float32)
     x2 = row_norms_sq(xd)

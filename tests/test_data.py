@@ -5,6 +5,7 @@ import os
 import numpy as np
 import pytest
 
+from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.data.convert import libsvm_to_dense_csv, mnist_to_odd_even_csv
 from dpsvm_tpu.data.loader import csv_shape, load_csv
 from dpsvm_tpu.data.synthetic import make_blobs, make_xor, save_csv
@@ -261,3 +262,69 @@ def test_cli_multiclass_on_libsvm_input(tmp_path):
     assert main(["train", "-f", str(p), "-m", str(mdir), "--multiclass",
                  "-c", "10", "-q"]) == 0
     assert main(["test", "-f", str(p), "-m", str(mdir)]) == 0
+
+
+class TestMakePlanted:
+    """The planted-boundary benchmark generator: every property the
+    round-2 verdict found missing from make_mnist_like."""
+
+    def test_balanced_and_deterministic(self):
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        x, y = make_planted(2000, 64, gamma=0.5, seed=4)
+        x2, y2 = make_planted(2000, 64, gamma=0.5, seed=4)
+        np.testing.assert_array_equal(x, x2)
+        np.testing.assert_array_equal(y, y2)
+        assert x.dtype == np.float32 and x.shape == (2000, 64)
+        assert set(np.unique(y)) == {-1, 1}
+        assert 0.4 <= float(np.mean(y > 0)) <= 0.6
+
+    def test_kernel_has_real_structure_at_its_gamma(self):
+        """The generator's whole point: at the gamma it was built for,
+        K must NOT be near-identity (make_mnist_like's failure mode —
+        i.i.d. high-dim features make all off-diagonals ~0)."""
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        for gamma, d in [(0.25, 784), (2.0, 22)]:
+            x, _ = make_planted(600, d, gamma=gamma, seed=0)
+            x2 = (x.astype(np.float64) ** 2).sum(1)
+            d2 = x2[:, None] + x2[None, :] - 2.0 * (
+                x.astype(np.float64) @ x.astype(np.float64).T)
+            k = np.exp(-gamma * np.maximum(d2, 0.0))
+            off = k[~np.eye(len(x), dtype=bool)]
+            # Calibration target: real digits at its benchmark gamma has
+            # off-diag median ~0.3 (see generator docstring).
+            assert 0.1 <= float(np.median(off)) <= 0.5, (
+                f"gamma={gamma}: median K {np.median(off):.4f}")
+            assert float(np.percentile(off, 99)) >= 0.4
+
+    def test_converges_at_reference_hyperparameters(self):
+        """CI-scale version of the PERF claim: the stand-in converges at
+        each reference config's own (C, gamma) — including the two
+        configs the old generator could not converge (ijcnn1's C=32
+        gamma=2 and covtype's C=2048)."""
+        from dpsvm_tpu.api import train
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        for d, gamma, c in [(784, 0.25, 10.0), (22, 2.0, 32.0),
+                            (54, 0.03125, 2048.0)]:
+            x, y = make_planted(1500, d, gamma=gamma, seed=0)
+            r = train(x, y, SVMConfig(c=c, gamma=gamma, epsilon=1e-3,
+                                      max_iter=100_000))
+            assert r.converged, (d, gamma, c, r.n_iter, r.gap)
+
+    def test_noise_controls_bounded_sv_fraction(self):
+        """Label noise plants bounded SVs: more noise => more SVs at the
+        box, the controllability knob the verdict asked for."""
+        from dpsvm_tpu.api import train
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        nsv_at = {}
+        for noise in (0.0, 0.10):
+            x, y = make_planted(1200, 32, gamma=0.5, seed=2, noise=noise)
+            r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                                      max_iter=100_000))
+            assert r.converged
+            alpha = np.asarray(r.alpha)
+            nsv_at[noise] = int(np.sum(alpha >= 10.0 - 1e-4))
+        assert nsv_at[0.10] > nsv_at[0.0] + 50, nsv_at
