@@ -61,6 +61,12 @@ def train(x: np.ndarray, y: np.ndarray,
         from dpsvm_tpu.parallel.dist_smo import train_distributed
         return train_distributed(x, y, config, f_init=f_init,
                                  alpha_init=alpha_init, guard_eta=guard_eta)
+    if config.working_set > 2:
+        # Large-working-set decomposition (solver/decomp.py). Eta is
+        # always TAU-clamped there, so guard_eta is subsumed.
+        from dpsvm_tpu.solver.decomp import train_single_device_decomp
+        return train_single_device_decomp(x, y, config, f_init=f_init,
+                                          alpha_init=alpha_init)
     from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
     if f_init is None and alpha_init is None and use_fused(config):
         # the fused kernel hard-codes the classification init

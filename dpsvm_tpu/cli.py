@@ -91,6 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="working-set rule: 'first-order' = reference "
                          "parity; 'second-order' = LIBSVM WSS2 (usually "
                          "far fewer iterations)")
+    tr.add_argument("--working-set", type=int, default=2, metavar="Q",
+                    help="violators optimized per kernel fetch: 2 = the "
+                         "reference's SMO pair; even Q > 2 = large-"
+                         "working-set decomposition (one (Q,d)@(d,n) "
+                         "MXU pass per outer round + an inner subsolve "
+                         "— usually much faster to convergence on TPU)")
+    tr.add_argument("--inner-iters", type=int, default=0,
+                    help="decomposition inner-step cap per round "
+                         "(0 = auto: 4*Q; only with --working-set > 2)")
     tr.add_argument("--select-impl", default="argminmax",
                     choices=["argminmax", "packed"],
                     help="first-order selection lowering: 'packed' = one "
@@ -287,6 +296,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         use_pallas=args.pallas,
         selection=args.selection,
         select_impl=args.select_impl,
+        working_set=args.working_set,
+        inner_iters=args.inner_iters,
         weight_pos=args.weight_pos,
         weight_neg=args.weight_neg,
     )

@@ -63,6 +63,20 @@ class SVMConfig:
                                         # 476-481) or "second-order" (the
                                         # LIBSVM WSS2 rule — usually far
                                         # fewer iterations to convergence)
+    working_set: int = 2                # violators optimized per kernel
+                                        # fetch: 2 = the reference's SMO
+                                        # pair (parity path); q > 2 (even)
+                                        # = large-working-set
+                                        # decomposition — top-q/2
+                                        # violators per side, one
+                                        # (q,d)@(d,n) MXU pass, an inner
+                                        # SMO subsolve on the (q,q) block
+                                        # (solver/decomp.py; the
+                                        # ThunderSVM-style MXU path)
+    inner_iters: int = 0                # decomposition inner-step cap per
+                                        # outer round (0 = auto: 4*q).
+                                        # The subsolve also exits early
+                                        # when its own gap closes.
     clip: str = "independent"           # alpha-step clip rule:
                                         # "independent" (the reference's,
                                         # svmTrainMain.cpp:294-295 — both
@@ -127,6 +141,8 @@ class SVMConfig:
             return "the kernel-row cache (cache_size > 0)"
         if self.selection != "first-order":
             return f"selection {self.selection!r}"
+        if self.working_set != 2:
+            return "working_set > 2 (decomposition)"
         if self.weight_pos != 1.0 or self.weight_neg != 1.0:
             return "class-weighted costs"
         return None
@@ -219,6 +235,38 @@ class SVMConfig:
                 raise ValueError("select_impl applies to first-order "
                                  "selection only (WSS2's argmax-over-"
                                  "objective has no packed lowering)")
+        if self.working_set != 2:
+            if (self.working_set < 4 or self.working_set % 2
+                    or self.working_set > 8192):
+                raise ValueError("working_set must be 2 (classic SMO "
+                                 "pair) or an even value in [4, 8192], "
+                                 f"got {self.working_set}")
+            # Reject every path that would silently ignore q, so results
+            # can't be misattributed (same policy as select_impl).
+            for field, bad, what in (
+                    ("selection", self.selection != "first-order",
+                     "the decomposition subsolve is first-order"),
+                    ("cache_size", self.cache_size > 0,
+                     "the block fetch replaces the pair row-cache"),
+                    ("use_pallas", self.use_pallas == "on",
+                     "the fused Pallas kernel is the 2-violator shape"),
+                    ("select_impl", self.select_impl != "argminmax",
+                     "outer selection is top_k, not packed extrema"),
+                    ("backend", self.backend == "numpy",
+                     "the golden oracle keeps the reference's pair "
+                     "iteration"),
+                    ("shards", self.shards > 1,
+                     "decomposition is single-device today (the "
+                     "distributed path keeps the reference's pair "
+                     "protocol)")):
+                if bad:
+                    raise ValueError(
+                        f"working_set > 2 does not support {field}: {what}")
+        if self.inner_iters < 0:
+            raise ValueError(
+                f"inner_iters must be >= 0, got {self.inner_iters}")
+        if self.inner_iters and self.working_set == 2:
+            raise ValueError("inner_iters applies only to working_set > 2")
         if self.use_pallas not in ("auto", "on", "off"):
             raise ValueError(f"use_pallas must be 'auto', 'on' or 'off', "
                              f"got {self.use_pallas!r}")

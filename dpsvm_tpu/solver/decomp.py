@@ -1,0 +1,289 @@
+"""Large-working-set SMO decomposition: the MXU-utilization path.
+
+The 2-violator iteration (solver/smo.py) is latency-bound by design:
+each step moves two kernel rows, ~188 MFLOP at the MNIST shape, leaving
+the MXU ~99% idle (docs/PERF.md "Per-phase cost"). The classic remedy —
+what SVMlight/LIBSVM call *decomposition* and GPU solvers (ThunderSVM,
+the GPU-SMO literature) run with large q — is to amortize one big
+kernel-block fetch over many cheap pair updates:
+
+  1. select the top q/2 violators from I_up (smallest f) and top q/2
+     from I_low (largest f) with ``lax.top_k`` — the globally
+     most-violating pair is always slots 0 of each half, which is the
+     condition decomposition convergence proofs need;
+  2. ONE ``(q, d) @ (d, n)`` MXU matmul + fused kernel epilogue yields
+     the working-set block K_WN; its column gather K_WW = K_WN[:, W] is
+     the (q, q) subproblem kernel;
+  3. an inner ``lax.while_loop`` runs plain SMO pair steps entirely on
+     (q,)-sized state (alpha_W, f_W maintained via K_WW rows) until the
+     subproblem's own gap closes to the global tolerance or
+     ``inner_cap`` steps — no O(n) traffic per inner step;
+  4. one fused rank-q update applies the block's total change:
+     f += (dalpha * y_W) @ K_WN, alpha scattered back by index.
+
+Everything — outer selection, top_k, matmul, the inner loop, the rank-q
+update — lives inside ONE ``lax.while_loop`` under jit, chunk-polled by
+the same host driver as the 2-violator path.
+
+This is *not* a reference-parity path (the reference has nothing like
+it — its iteration is svmTrain.cu:469-497's single pair). The model it
+converges to is the same dual optimum, checked against the oracle and
+LibSVM by tests/test_decomp.py; the trajectory is intentionally
+different. ``n_iter`` counts inner pair-updates so budgets and logs stay
+comparable with the 2-violator solvers. Eta is always TAU-clamped (the
+subproblem block can contain duplicate-geometry rows; there is no raw-
+division parity contract to preserve here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
+from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_norms_sq,
+                                   rows_from_dots)
+from dpsvm_tpu.ops.selection import masked_scores_and_masks
+from dpsvm_tpu.ops.update import alpha_pair_step
+from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
+                                     resume_state)
+
+
+class DecompCarry(NamedTuple):
+    alpha: jax.Array    # (n,) f32
+    f: jax.Array        # (n,) f32
+    b_hi: jax.Array     # () f32 latest global selection
+    b_lo: jax.Array     # () f32
+    n_iter: jax.Array   # () i32 cumulative INNER pair-updates
+
+
+def init_carry(y) -> DecompCarry:
+    """Same state/convention as smo.init_carry (host NumPy, zero extra
+    XLA programs); sentinels force the first outer round."""
+    y_np = np.asarray(y, np.float32)
+    return DecompCarry(
+        alpha=np.zeros_like(y_np),
+        f=-y_np,
+        b_hi=np.float32(-SENTINEL),
+        b_lo=np.float32(SENTINEL),
+        n_iter=np.int32(0),
+    )
+
+
+class _InnerState(NamedTuple):
+    a: jax.Array        # (q,) alphas of the working set
+    f: jax.Array        # (q,) subproblem gradient (exact, via K_WW)
+    b_hi: jax.Array
+    b_lo: jax.Array
+    t: jax.Array        # () i32 inner steps taken
+
+
+def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
+                x2: jax.Array, c: float, kspec: KernelSpec, *,
+                q: int, inner_cap: int, epsilon: float,
+                limit=None, weights=(1.0, 1.0),
+                precision=lax.Precision.HIGHEST,
+                pairwise_clip: bool = False) -> DecompCarry:
+    """One outer decomposition round (select-q -> block -> subsolve ->
+    rank-q update). ``limit`` (traced) caps the round's inner steps so
+    ``n_iter`` stops exactly at the budget like every other solver."""
+    alpha, f = carry.alpha, carry.f
+    wp, wn = weights
+    if wp != 1.0 or wn != 1.0:
+        c_box = jnp.where(y > 0, jnp.float32(c * wp), jnp.float32(c * wn))
+    else:
+        c_box = c
+
+    # --- outer selection: top q/2 violators per side --------------------
+    f_up, f_low, in_up, in_low = masked_scores_and_masks(alpha, y, f, c_box)
+    _, up_idx = lax.top_k(-f_up, q // 2)        # ascending f: worst first
+    _, low_idx = lax.top_k(f_low, q // 2)       # descending f
+    b_hi = f_up[up_idx[0]]
+    b_lo = f_low[low_idx[0]]
+
+    # Dedup (an interior alpha is in both sets): fixed-shape jnp.unique,
+    # padding with -1. Padded/non-member slots join the subproblem as
+    # permanently-masked entries.
+    w_idx = jnp.unique(jnp.concatenate([up_idx, low_idx]),
+                       size=q, fill_value=jnp.int32(-1))
+    active = w_idx >= 0
+    wi = jnp.where(active, w_idx, 0)
+    # (Every point with alpha in [0, C] is in I_up or I_low, so beyond
+    # the -1 padding no further membership masking is needed.)
+
+    # --- the block fetch: ONE (q, d) @ (d, n) MXU pass ------------------
+    rows = x[wi]
+    dots = jnp.matmul(rows, x.T, precision=precision)        # (q, n)
+    k_wn = rows_from_dots(dots, x2[wi], x2, kspec)           # (q, n)
+    # The subproblem kernel K_WW is computed EXACTLY (f32 HIGHEST), not
+    # gathered from the possibly-bf16 K_WN: in DEFAULT precision the
+    # gathered block is only bf16-accurate, which breaks its positive
+    # semidefiniteness for near-duplicate rows — the inner SMO then sees
+    # negative-eta pairs, the TAU clamp turns them into huge corner
+    # steps, and the subsolve thrashes instead of converging (measured:
+    # the MNIST-shape run stalls at 2M inner steps, train_acc 0.73-0.87).
+    # The extra (q, d) @ (d, q) pass is O(q^2 d) — noise next to the
+    # (q, n) fetch.
+    dots_ww = jnp.matmul(rows, rows.T, precision=lax.Precision.HIGHEST)
+    k_ww = rows_from_dots(dots_ww, x2[wi], x2[wi], kspec)    # (q, q)
+
+    y_w = y[wi]
+    a_w0 = alpha[wi]
+    f_w0 = f[wi]
+    if isinstance(c_box, jnp.ndarray):
+        c_w = c_box[wi]
+    else:
+        c_w = jnp.full((q,), jnp.float32(c))
+
+    # --- inner subsolve: plain SMO on (q,)-sized state ------------------
+    step_cap = jnp.int32(inner_cap)
+    if limit is not None:
+        step_cap = jnp.minimum(step_cap, limit - carry.n_iter)
+
+    def inner_cond(s: _InnerState):
+        return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.t < step_cap)
+
+    kdiag_w = jnp.diagonal(k_ww)
+
+    def inner_body(s: _InnerState):
+        fu, fl, _, in_low_w = masked_scores_and_masks(s.a, y_w, s.f, c_w,
+                                                      valid=active)
+        i_hi = jnp.argmin(fu)
+        bh = fu[i_hi]
+        bl = jnp.max(fl)                    # stopping gap: max violator
+        # Second-order (LIBSVM WSS2) choice of the partner — free here,
+        # because the exact kernel column K_WW[i_hi] is already on hand
+        # (the 2-violator solver pays a serial (1,d)@(d,n) matmul for
+        # this, solver/smo.py second_order). First-order inner steps
+        # need ~10-20x more of them at benchmark shapes, and on TPU an
+        # inner step costs ~22 us of fixed latency regardless of q, so
+        # step QUALITY is everything (measured: first-order inner stalls
+        # the MNIST shape at 2M steps; WSS2 inner converges it).
+        bb = fl - bh
+        aa = jnp.maximum(kdiag_w[i_hi] + kdiag_w - 2.0 * k_ww[i_hi],
+                         1e-12)
+        obj = jnp.where(in_low_w & (bb > 0), bb * bb / aa, -1.0)
+        i_lo = jnp.argmax(obj)
+        bl_sel = fl[i_lo]
+        eta = jnp.maximum(k_ww[i_hi, i_hi] + k_ww[i_lo, i_lo]
+                          - 2.0 * k_ww[i_hi, i_lo], 1e-12)
+        a_hi, a_lo = s.a[i_hi], s.a[i_lo]
+        a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_w[i_hi], y_w[i_lo],
+                                         bh, bl_sel, eta,
+                                         c_w[i_hi], c_w[i_lo],
+                                         pairwise_clip)
+        a = s.a.at[i_lo].set(a_lo_n)
+        a = a.at[i_hi].set(a_hi_n)
+        fsub = (s.f + (a_hi_n - a_hi) * y_w[i_hi] * k_ww[i_hi]
+                + (a_lo_n - a_lo) * y_w[i_lo] * k_ww[i_lo])
+        return _InnerState(a, fsub, bh, bl, s.t + 1)
+
+    # Seed the inner stopping state with the block's REAL entry extrema,
+    # not do-while sentinels: when the subproblem enters already at its
+    # optimum (reachable — the outer loop's trailing round, or a
+    # warm-start from the solved model), a sentinel-forced first step
+    # would find no positive violator, argmax over an all(-1) objective
+    # would fall to slot 0, and bl_sel = -SENTINEL would slam that alpha
+    # to a box corner while still reporting convergence. With the real
+    # entry gap the loop simply never starts (zero-step no-op round).
+    # Whenever the global gap is open the block's entry gap is >= it
+    # (the global pair is in W), so >= 1 inner step still happens and
+    # every non-trailing round makes strict progress.
+    fu0, fl0, _, _ = masked_scores_and_masks(a_w0, y_w, f_w0, c_w,
+                                             valid=active)
+    inner0 = _InnerState(a_w0, f_w0, jnp.min(fu0), jnp.max(fl0),
+                         jnp.int32(0))
+    inner = lax.while_loop(inner_cond, inner_body, inner0)
+
+    # --- rank-q application --------------------------------------------
+    dalpha = jnp.where(active, inner.a - a_w0, 0.0)
+    # Padding slots carry dalpha == 0, so duplicate index-0 adds are
+    # no-ops; real slots are unique by construction.
+    alpha = alpha.at[wi].add(dalpha)
+    f = f + jnp.matmul((dalpha * y_w)[None, :], k_wn,
+                       precision=precision)[0]
+    return DecompCarry(alpha, f, b_hi, b_lo, carry.n_iter + inner.t)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
+                         inner_cap: int, precision_name: str,
+                         weights=(1.0, 1.0), pairwise_clip: bool = False):
+    """Compiled chunk runner with the decomposition outer loop inside;
+    same contract as smo._build_chunk_runner."""
+    precision = getattr(lax.Precision, precision_name)
+    kspec = KernelSpec.coerce(kspec)
+
+    def run(carry: DecompCarry, x, y, x2, limit):
+        final = lax.while_loop(
+            lambda s: (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit),
+            lambda s: decomp_step(s, x, y, x2, c, kspec, q=q,
+                                  inner_cap=inner_cap, epsilon=epsilon,
+                                  limit=limit, weights=weights,
+                                  precision=precision,
+                                  pairwise_clip=pairwise_clip),
+            carry)
+        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
+                               config: SVMConfig,
+                               device: Optional[jax.Device] = None,
+                               f_init: Optional[np.ndarray] = None,
+                               alpha_init: Optional[np.ndarray] = None
+                               ) -> TrainResult:
+    """Train with working_set = q > 2. Same host contract as
+    smo.train_single_device (NumPy in/out, chunk polling, checkpoints)."""
+    config.validate()
+    n, d = x.shape
+    # top_k needs k <= n; tiny problems degrade gracefully to a smaller
+    # (even) block.
+    q = 2 * min(int(config.working_set) // 2, n)
+    # Auto cap q/4: SHORT subsolves win. Only the first ~q/4 steps of a
+    # round act on large violations; letting the subsolve run to its own
+    # convergence (cap 4q) grinds on tiny block-local violations while
+    # the global picture is stale (measured, CI scale: q=512 cap=2048
+    # needs 20.7k inner steps to converge what cap=64 does in 7.0k; the
+    # MNIST shape with cap=4q stalls entirely at the 2M budget).
+    inner_cap = int(config.inner_iters) or max(32, q // 4)
+    gamma = float(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
+
+    xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
+    yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
+    x2 = jax.device_put(host_row_norms_sq(x), device)
+    carry = init_carry(np.asarray(y, np.float32))
+    if f_init is not None:
+        carry = carry._replace(f=np.asarray(f_init, np.float32))
+    if alpha_init is not None:
+        carry = carry._replace(alpha=np.asarray(alpha_init, np.float32))
+
+    ckpt = resume_state(config, n, d, gamma)
+    if ckpt is not None:
+        carry = carry._replace(
+            alpha=np.asarray(ckpt.alpha), f=np.asarray(ckpt.f),
+            b_hi=np.float32(ckpt.b_hi), b_lo=np.float32(ckpt.b_lo),
+            n_iter=np.int32(ckpt.n_iter))
+    if device is not None:
+        carry = jax.device_put(carry, device)
+
+    runner = _build_decomp_runner(float(config.c), kspec,
+                                  float(config.epsilon), q, inner_cap,
+                                  config.matmul_precision.upper(),
+                                  (float(config.weight_pos),
+                                   float(config.weight_neg)),
+                                  config.clip == "pairwise")
+
+    return host_training_loop(
+        config, gamma, n, d, carry,
+        step_chunk=lambda cr, lim: runner(cr, xd, yd, x2, np.int32(lim)),
+        carry_to_host=lambda cr: (np.asarray(cr.alpha), np.asarray(cr.f)),
+        it0=int(ckpt.n_iter) if ckpt is not None else 0,
+    )

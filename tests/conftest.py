@@ -39,7 +39,7 @@ def split_train_test(x, y, frac=0.25, seed=0):
 
 
 def assert_libsvm_parity(x, y, C, gamma, tol, name,
-                         selection="first-order"):
+                         selection="first-order", **config_overrides):
     """The parity bar shared by the synthetic and real-data suites:
     train sklearn's SVC (libsvm) and our solver at the same (C, gamma,
     tol) and assert SV count within 2% (+/- 3 absolute on tiny
@@ -61,7 +61,7 @@ def assert_libsvm_parity(x, y, C, gamma, tol, name,
     # libsvm stops at m(alpha) - M(alpha) <= eps; ours at
     # b_lo > b_hi + 2*eps — pass eps/2 so both stop at the same gap.
     cfg = SVMConfig(c=C, gamma=gamma, epsilon=tol / 2.0,
-                    selection=selection)
+                    selection=selection, **config_overrides)
     model, result = fit(xtr, ytr, cfg)
     assert result.converged, (
         f"{name}: no convergence in {result.n_iter} iters "
