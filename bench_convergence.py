@@ -28,7 +28,9 @@ Environment:
     BENCH_SELECTION   first-order (reference parity) | second-order
     BENCH_WORKING_SET 2 (classic pair SMO) | even q > 2 (large-working-
                       set decomposition, solver/decomp.py)
-    BENCH_INNER_ITERS decomposition inner-step cap (0 = auto 4*q)
+    BENCH_INNER_ITERS decomposition inner-step cap (0 = auto q/4)
+    BENCH_SHRINKING   1 = LIBSVM-style active-set training
+                      (solver/shrink.py; composes with the above)
 """
 
 from __future__ import annotations
@@ -83,10 +85,11 @@ def main() -> None:
     # each poll round pays a ~65 ms tunnel round-trip, so poll rarely.
     working_set = int(os.environ.get("BENCH_WORKING_SET", 2))
     inner_iters = int(os.environ.get("BENCH_INNER_ITERS", 0))
+    shrinking = os.environ.get("BENCH_SHRINKING", "") == "1"
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
-                       chunk_iters=8192)
+                       shrinking=shrinking, chunk_iters=8192)
 
     t0 = time.perf_counter()
     result = train(x, y, config)
@@ -112,6 +115,7 @@ def main() -> None:
         "precision": precision,
         "selection": selection,
         "working_set": working_set,
+        "shrinking": shrinking,
         "train_accuracy": round(float(acc), 6),
     }), flush=True)
 

@@ -99,7 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "— usually much faster to convergence on TPU)")
     tr.add_argument("--inner-iters", type=int, default=0,
                     help="decomposition inner-step cap per round "
-                         "(0 = auto: 4*Q; only with --working-set > 2)")
+                         "(0 = auto: Q/4; only with --working-set > 2)")
+    tr.add_argument("--shrinking", action="store_true",
+                    help="LIBSVM -h analog: active-set training — "
+                         "periodically drop rows that are provably "
+                         "stuck at their bound, validate on the full "
+                         "problem at the end (big win when few rows "
+                         "are SVs)")
     tr.add_argument("--select-impl", default="argminmax",
                     choices=["argminmax", "packed"],
                     help="first-order selection lowering: 'packed' = one "
@@ -298,6 +304,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         select_impl=args.select_impl,
         working_set=args.working_set,
         inner_iters=args.inner_iters,
+        shrinking=args.shrinking,
         weight_pos=args.weight_pos,
         weight_neg=args.weight_neg,
     )

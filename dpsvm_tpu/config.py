@@ -74,9 +74,18 @@ class SVMConfig:
                                         # (solver/decomp.py; the
                                         # ThunderSVM-style MXU path)
     inner_iters: int = 0                # decomposition inner-step cap per
-                                        # outer round (0 = auto: 4*q).
+                                        # outer round (0 = auto: q/4).
                                         # The subsolve also exits early
                                         # when its own gap closes.
+    shrinking: bool = False             # LIBSVM -h: active-set training
+                                        # (solver/shrink.py) — compact
+                                        # the problem to the rows that
+                                        # can still move, validate on
+                                        # the full problem at the end.
+                                        # Off by default (the reference
+                                        # has no shrinking; the unshrunk
+                                        # path is the parity path).
+                                        # Composes with working_set.
     clip: str = "independent"           # alpha-step clip rule:
                                         # "independent" (the reference's,
                                         # svmTrainMain.cpp:294-295 — both
@@ -262,6 +271,33 @@ class SVMConfig:
                 if bad:
                     raise ValueError(
                         f"working_set > 2 does not support {field}: {what}")
+        if self.shrinking:
+            # Reject paths that would silently ignore or fight the
+            # active-set manager (same no-silent-ignore policy).
+            for field, bad, what in (
+                    ("shards", self.shards > 1,
+                     "shrinking is single-device today"),
+                    ("backend", self.backend == "numpy",
+                     "the golden oracle keeps the reference's full-set "
+                     "iteration"),
+                    ("cache_size", self.cache_size > 0,
+                     "cached row indices would dangle across "
+                     "compactions"),
+                    ("use_pallas", self.use_pallas == "on",
+                     "the fused kernel hard-codes the full-problem "
+                     "init"),
+                    ("checkpoint_path", bool(self.checkpoint_path),
+                     "checkpoint/resume does not capture active-set "
+                     "state"),
+                    ("resume_from", bool(self.resume_from),
+                     "checkpoint/resume does not capture active-set "
+                     "state"),
+                    ("profile_dir", bool(self.profile_dir),
+                     "the shrinking loop manages its own dispatch; "
+                     "profile the unshrunk path")):
+                if bad:
+                    raise ValueError(
+                        f"shrinking does not support {field}: {what}")
         if self.inner_iters < 0:
             raise ValueError(
                 f"inner_iters must be >= 0, got {self.inner_iters}")

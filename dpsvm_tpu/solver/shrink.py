@@ -1,0 +1,269 @@
+"""Shrinking (active-set) training: LIBSVM's -h heuristic, TPU-shaped.
+
+LIBSVM shrinks the optimization to the rows that can still move: a
+bound variable whose gradient says it will stay at its bound at the
+optimum is removed from selection and gradient maintenance, and the
+full problem is only revisited to validate convergence (svm.cpp's
+be_shrunk / reconstruct_gradient). The reference has nothing like it —
+its per-iteration cost is O(n_shard * d) forever.
+
+XLA cannot reshape arrays inside a compiled loop, so shrinking here is a
+HOST-level active-set manager around the existing compiled chunk
+runners (the 2-violator program, solver/smo.py, or the decomposition
+program, solver/decomp.py — both share the chunk contract):
+
+  * train in chunks on the ACTIVE subproblem (x/y/x2/alpha/f compacted
+    to the active rows — SMO on that subproblem is exact because
+    inactive alphas are frozen and their contribution is baked into the
+    active rows' f);
+  * at each chunk poll, apply LIBSVM's rule to the pulled (alpha, f):
+    an I_up-only row with f > b_lo, or an I_low-only row with f < b_hi,
+    can no longer join a violating pair — shrink it. Compact only when
+    the active set at least halves, so at most log2(n) XLA programs are
+    ever compiled;
+  * when the subproblem converges, scatter alpha back, reconstruct the
+    inactive rows' f EXACTLY in one streamed MXU pass over the support
+    vectors (f_i = sum_j alpha_j y_j K_ij - y_i; the active rows keep
+    their incrementally-maintained f, exactly like LIBSVM's
+    reconstruct_gradient), and re-check optimality on the FULL problem
+    on the host. Converged => done; otherwise training continues
+    unshrunk (and may shrink again).
+
+The final model therefore satisfies the same stopping criterion as the
+unshrunk path on the full problem — shrinking changes the trajectory,
+never the convergence contract. Quality is held to the LibSVM parity
+bar by tests/test_shrink.py.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
+from dpsvm_tpu.solver.driver import _read_stats
+from dpsvm_tpu.utils.logging import log_progress
+
+
+def _host_extrema(alpha, y, f, c_box):
+    """(b_hi, b_lo) from host arrays — the full-problem optimality check
+    at unshrink time, no device program needed."""
+    at0 = alpha == 0.0
+    atc = alpha == c_box
+    interior = ~at0 & ~atc
+    pos = y > 0
+    in_up = interior | (at0 & pos) | (atc & ~pos)
+    in_low = interior | (at0 & ~pos) | (atc & pos)
+    b_hi = float(f[in_up].min()) if in_up.any() else np.inf
+    b_lo = float(f[in_low].max()) if in_low.any() else -np.inf
+    return b_hi, b_lo
+
+
+def _shrinkable(alpha, y, f, c_box, b_hi, b_lo):
+    """LIBSVM's be_shrunk on our f convention: a row that can no longer
+    be either side of a violating pair (I_up-only with f >= b_lo can
+    never beat the current max-violator as argmin side, and vice
+    versa)."""
+    at0 = alpha == 0.0
+    atc = alpha == c_box
+    pos = y > 0
+    up_only = (at0 & pos) | (atc & ~pos)
+    low_only = (at0 & ~pos) | (atc & pos)
+    return (up_only & (f > b_lo)) | (low_only & (f < b_hi))
+
+
+def _reconstruct_inactive_f(x, y, alpha, f, active_mask, spec: KernelSpec,
+                            block: int = 8192) -> np.ndarray:
+    """Exact f for the inactive rows from scratch (one streamed kernel
+    pass against the support vectors); active rows keep their maintained
+    values — LIBSVM's reconstruct_gradient split."""
+    inactive = ~active_mask
+    if not inactive.any():
+        return f
+    coef = (alpha * y).astype(np.float32)
+    sv = coef != 0.0
+    xi = x[inactive]
+    if not sv.any():
+        kv = np.zeros(int(inactive.sum()), np.float32)
+    else:
+        kv = _stream_kv_against(xi, x[sv], coef[sv], spec, block)
+    f = f.copy()
+    f[inactive] = kv - y[inactive]
+    return f
+
+
+def _stream_kv_against(x_rows: np.ndarray, x_sv: np.ndarray,
+                       coef_sv: np.ndarray, spec: KernelSpec,
+                       block: int) -> np.ndarray:
+    """K(x_rows, x_sv) @ coef_sv in row blocks on device."""
+    from dpsvm_tpu.ops.diagnostics import _block_kv
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+
+    xs = jnp.asarray(x_sv)
+    s2 = row_norms_sq(xs)
+    cf = jnp.asarray(coef_sv)
+    m = x_rows.shape[0]
+    out = np.empty((m,), np.float32)
+    for lo in range(0, m, block):
+        hi = min(lo + block, m)
+        xb = jnp.asarray(x_rows[lo:hi])
+        out[lo:hi] = np.asarray(
+            _block_kv(xb, row_norms_sq(xb), xs, s2, cf, spec))
+    return out
+
+
+def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
+                                  config: SVMConfig,
+                                  device: Optional[jax.Device] = None,
+                                  f_init: Optional[np.ndarray] = None,
+                                  alpha_init: Optional[np.ndarray] = None,
+                                  guard_eta: bool = False) -> TrainResult:
+    """Active-set training loop. Same NumPy-in/NumPy-out contract as the
+    other solvers."""
+    config.validate()
+    t0 = time.perf_counter()
+    n, d = x.shape
+    gamma = float(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
+    eps = float(config.epsilon)
+    chunk = int(config.chunk_iters)
+
+    x = np.ascontiguousarray(np.asarray(x, np.float32))
+    y_np = np.asarray(y, np.float32)
+    x2_np = np.asarray(host_row_norms_sq(x))
+    c_box = np.broadcast_to(
+        np.asarray(config.box_bound(y_np), np.float32), y_np.shape)
+
+    alpha = (np.zeros(n, np.float32) if alpha_init is None
+             else np.asarray(alpha_init, np.float32).copy())
+    f = (-y_np.copy() if f_init is None
+         else np.asarray(f_init, np.float32).copy())
+
+    decomp = config.working_set > 2
+    min_active = 1
+    if decomp:
+        from dpsvm_tpu.solver.decomp import (_build_decomp_runner,
+                                             init_carry)
+        q = 2 * min(int(config.working_set) // 2, n)
+        # The decomp runner's top_k needs q//2 <= len(active); never
+        # compact below the block size (review finding: a few-SV
+        # problem could otherwise shrink the active set under q and
+        # crash the re-trace).
+        min_active = q
+        runner = _build_decomp_runner(
+            float(config.c), kspec, eps, q,
+            int(config.inner_iters) or max(32, q // 4),
+            config.matmul_precision.upper(),
+            (float(config.weight_pos), float(config.weight_neg)),
+            config.clip == "pairwise")
+    else:
+        from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+        runner = _build_chunk_runner(
+            float(config.c), kspec, eps, False,
+            config.matmul_precision.upper(),
+            config.selection == "second-order",
+            (float(config.weight_pos), float(config.weight_neg)),
+            config.select_impl == "packed",
+            config.clip == "pairwise", guard_eta=guard_eta)
+
+    xd_full = jax.device_put(jnp.asarray(x), device)
+
+    def make_active(idx: np.ndarray):
+        """Device arrays + fresh carry for the active subproblem (all
+        placed on ``device``, like the other solvers — a carry left on
+        the default device would clash with xa in the jitted runner)."""
+        if len(idx) == n:
+            xa = xd_full
+        else:
+            xa = jnp.take(xd_full, jax.device_put(jnp.asarray(idx),
+                                                  device), axis=0)
+        ya = jax.device_put(jnp.asarray(y_np[idx]), device)
+        x2a = jax.device_put(jnp.asarray(x2_np[idx]), device)
+        carry = init_carry(y_np[idx]) if decomp else init_carry(
+            y_np[idx], cache_lines=0)
+        carry = carry._replace(alpha=alpha[idx].copy(), f=f[idx].copy())
+        if device is not None:
+            carry = jax.device_put(carry, device)
+        return xa, ya, x2a, carry
+
+    active = np.arange(n)
+    xa, ya, x2a, carry = make_active(active)
+    it = 0
+    while True:
+        limit = np.int32(min(it + chunk, config.max_iter))
+        carry, stats = runner(carry, xa, ya, x2a, limit)
+        it, b_lo, b_hi = _read_stats(stats)
+        sub_converged = not (b_lo > b_hi + 2.0 * eps)
+        capped = it >= config.max_iter
+        log_progress(config, it, b_lo, b_hi, final=False)
+
+        if sub_converged or capped:
+            # Scatter the subproblem's state back.
+            alpha[active] = np.asarray(carry.alpha)
+            f[active] = np.asarray(carry.f)
+            if len(active) == n:
+                converged = sub_converged
+                break
+            # Unshrink: exact f for the frozen rows, then the REAL
+            # optimality check on the full problem.
+            mask = np.zeros(n, bool)
+            mask[active] = True
+            f = _reconstruct_inactive_f(x, y_np, alpha, f, mask, kspec)
+            b_hi, b_lo = _host_extrema(alpha, y_np, f, c_box)
+            converged = not (b_lo > b_hi + 2.0 * eps)
+            if converged or capped:
+                break
+            # Not there yet: continue on the full problem (and allow
+            # re-shrinking as the new tail converges). The iteration
+            # count must survive the rebuild — a fresh carry's
+            # n_iter=0 would grant the loop a whole new max_iter
+            # budget. The reconstructed extrema ride along so the next
+            # chunk's entry state is the real one.
+            active = np.arange(n)
+            xa, ya, x2a, carry = make_active(active)
+            carry = carry._replace(n_iter=np.int32(it),
+                                   b_hi=np.float32(b_hi),
+                                   b_lo=np.float32(b_lo))
+            continue
+
+        # Mid-training shrink check at the chunk boundary (LIBSVM
+        # checks every min(n,1000) iterations; our chunk is the poll
+        # cadence). Compact only when the active set halves — each
+        # distinct active size is its own XLA program.
+        a_act = np.asarray(carry.alpha)
+        f_act = np.asarray(carry.f)
+        shrink = _shrinkable(a_act, y_np[active], f_act, c_box[active],
+                             b_hi, b_lo)
+        keep = int(len(active) - shrink.sum())
+        if keep <= len(active) // 2 and keep >= min_active:
+            alpha[active] = a_act
+            f[active] = f_act
+            active = active[~shrink]
+            xa, ya, x2a, new_carry = make_active(active)
+            # Preserve the loop bookkeeping (n_iter and the stopping
+            # state survive the compaction; selection state is
+            # recomputed next chunk anyway).
+            carry = new_carry._replace(
+                n_iter=carry.n_iter,
+                b_hi=carry.b_hi, b_lo=carry.b_lo)
+
+    log_progress(config, it, b_lo, b_hi, final=True)
+    return TrainResult(
+        alpha=alpha,
+        b=(b_lo + b_hi) / 2.0,
+        n_iter=it,
+        converged=converged,
+        b_lo=b_lo,
+        b_hi=b_hi,
+        train_seconds=time.perf_counter() - t0,
+        gamma=gamma,
+        n_sv=int(np.sum(alpha > 0)),
+        kernel=config.kernel,
+        coef0=float(config.coef0),
+        degree=int(config.degree),
+    )

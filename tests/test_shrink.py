@@ -1,0 +1,132 @@
+"""Shrinking / active-set training (solver/shrink.py, config.shrinking).
+
+Shrinking changes the trajectory but never the convergence contract:
+the final model must satisfy the SAME full-problem stopping criterion
+as the unshrunk path. Tests assert the exact f64 KKT gap of the final
+model, the LibSVM parity bar, composition with working_set, warm-start
+seeding, and the guard rails.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from conftest import assert_libsvm_parity
+from test_decomp import true_gap_and_b
+
+from dpsvm_tpu.api import train, warm_start
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs, make_planted, make_xor
+
+
+@pytest.mark.parametrize("working_set", [2, 64])
+def test_true_kkt_gap_closes(working_set):
+    x, y = make_planted(2000, 24, gamma=0.5, seed=5, noise=0.01)
+    eps = 1e-3
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=eps,
+                              max_iter=400_000, shrinking=True,
+                              working_set=working_set, chunk_iters=512))
+    assert r.converged
+    gap, b = true_gap_and_b(x, y, r.alpha, C=10.0, gamma=0.5)
+    assert gap <= 2.0 * eps + 5e-4, gap
+    assert abs(b - r.b) <= 1e-3
+    alpha = np.asarray(r.alpha)
+    assert np.all(alpha >= 0) and np.all(alpha <= 10.0)
+
+
+def test_matches_unshrunk_quality():
+    """Same problem, shrink on/off: equal convergence, near-equal SV
+    sets (the trajectories differ, the optimum is shared)."""
+    x, y = make_planted(3000, 32, gamma=0.5, seed=1, noise=0.01)
+    base = dict(c=10.0, gamma=0.5, epsilon=1e-3, max_iter=400_000)
+    plain = train(x, y, SVMConfig(**base))
+    shr = train(x, y, SVMConfig(shrinking=True, chunk_iters=512, **base))
+    assert plain.converged and shr.converged
+    assert abs(shr.n_sv - plain.n_sv) <= max(3, 0.02 * plain.n_sv)
+    assert abs(shr.b - plain.b) <= 0.05
+
+
+def test_libsvm_parity():
+    x, y = make_blobs(n=300, d=6, seed=1)
+    assert_libsvm_parity(x, y, 1.0, 0.25, 1e-3, name="blobs/shrink",
+                         shrinking=True, chunk_iters=256)
+    x, y = make_xor(n=300, seed=2)
+    assert_libsvm_parity(x, y, 10.0, 1.0, 1e-3, name="xor/shrink",
+                         shrinking=True, chunk_iters=256)
+
+
+def test_small_chunks_force_many_shrink_checks():
+    """chunk_iters=64 makes the manager evaluate the shrink rule dozens
+    of times (and re-expand at least once at the end) — the bookkeeping
+    must never lose iterations or corrupt alpha."""
+    x, y = make_planted(1500, 16, gamma=0.5, seed=3, noise=0.01)
+    eps = 1e-3
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=eps,
+                              max_iter=400_000, shrinking=True,
+                              chunk_iters=64))
+    assert r.converged
+    gap, _ = true_gap_and_b(x, y, r.alpha, C=10.0, gamma=0.5)
+    assert gap <= 2.0 * eps + 5e-4
+
+
+def test_max_iter_cap_respected():
+    x, y = make_planted(1500, 16, gamma=0.5, seed=4)
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-7,
+                              max_iter=300, shrinking=True,
+                              chunk_iters=128))
+    assert not r.converged
+    assert r.n_iter == 300
+
+
+def test_weighted_costs():
+    x, y = make_blobs(n=400, d=5, seed=6)
+    r = train(x, y, SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=200_000, shrinking=True,
+                              weight_pos=2.0, weight_neg=0.5,
+                              chunk_iters=256))
+    assert r.converged
+    alpha = np.asarray(r.alpha)
+    assert np.all(alpha[y > 0] <= 4.0 + 1e-6)
+    assert np.all(alpha[y < 0] <= 1.0 + 1e-6)
+
+
+def test_warm_start_seeding():
+    x, y = make_planted(1200, 16, gamma=0.5, seed=8, noise=0.01)
+    cfg = SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3, max_iter=400_000,
+                    shrinking=True, chunk_iters=512)
+    first = train(x, y, cfg)
+    assert first.converged
+    again = warm_start(x, y, np.asarray(first.alpha), cfg)
+    assert again.converged
+    # warm_start recomputes f from scratch, so the continuation may take
+    # a few legitimate trailing pair steps before the poll sees the
+    # closed gap — the model must stay put up to those micro-steps.
+    np.testing.assert_allclose(np.asarray(again.alpha),
+                               np.asarray(first.alpha),
+                               rtol=0, atol=5e-3)
+
+
+def test_few_sv_problem_never_compacts_below_block_size():
+    """Regression (round-3 review): a well-separated problem where
+    almost every row is shrinkable must not compact the active set
+    below the decomposition block q — top_k(q//2) would crash on the
+    smaller re-traced shape."""
+    x, y = make_blobs(n=600, d=8, seed=9, separation=6.0)
+    r = train(x, y, SVMConfig(c=1.0, gamma=0.25, epsilon=1e-3,
+                              max_iter=200_000, shrinking=True,
+                              working_set=512, chunk_iters=128))
+    assert r.converged
+    assert r.n_sv < 512          # the hazard was real: fewer SVs than q
+
+
+def test_config_guard_rails():
+    for bad in (dict(shards=2), dict(backend="numpy"), dict(cache_size=4),
+                dict(checkpoint_path="/tmp/x.npz"),
+                dict(resume_from="/tmp/x.npz"),
+                dict(profile_dir="/tmp/prof")):
+        with pytest.raises(ValueError, match="shrinking"):
+            SVMConfig(shrinking=True, **bad).validate()
+    # compositions that must remain legal
+    SVMConfig(shrinking=True, working_set=64).validate()
+    SVMConfig(shrinking=True, selection="second-order").validate()
