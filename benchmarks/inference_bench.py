@@ -1,0 +1,75 @@
+"""Batched inference throughput at the reference's MNIST eval shape.
+
+The reference evaluates one example at a time — for each test row it
+loops over every SV computing an RBF term on the host CPU
+(seq_test.cpp:187-210: get_test_accuracy -> cblas calls per SV pair).
+Here evaluation is one (m, d) @ (d, n_sv) MXU pass per batch
+(models/svm.py decision_function). This harness measures steady-state
+eval throughput at the reference's MNIST test shape (10000 x 784,
+Makefile:81-83) against a model with an MNIST-scale SV set.
+
+Prints one JSON line:
+  {"metric": "inference_examples_per_sec", "value": ..., "unit": "ex/s",
+   "n_sv": ..., "m": ..., "seconds_per_pass": ...}
+
+Env: BENCH_NSV (default 8000), BENCH_M (default 10000), BENCH_D (784),
+     BENCH_PASSES (default 5 timed passes after 1 warmup).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+
+    dev = require_devices()[0]
+    print(f"device: {dev} ({dev.platform})", file=sys.stderr)
+    enable_compile_cache()
+
+    import numpy as np
+
+    from dpsvm_tpu.data.synthetic import make_planted
+    from dpsvm_tpu.models.svm import SVMModel, decision_function
+
+    n_sv = int(os.environ.get("BENCH_NSV", 8000))
+    m = int(os.environ.get("BENCH_M", 10000))
+    d = int(os.environ.get("BENCH_D", 784))
+    passes = int(os.environ.get("BENCH_PASSES", 5))
+
+    # A synthetic model with a realistic SV set: planted rows as SVs,
+    # random-ish duals in (0, C]. Inference cost depends only on shapes.
+    x_sv, y_sv = make_planted(n_sv, d, gamma=0.25, seed=1)
+    rng = np.random.default_rng(0)
+    alpha = rng.uniform(0.01, 10.0, n_sv).astype(np.float32)
+    model = SVMModel(alpha=alpha, y_sv=y_sv.astype(np.int32), x_sv=x_sv,
+                     b=0.1, gamma=0.25)
+    x_test, _ = make_planted(m, d, gamma=0.25, seed=2)
+
+    decision_function(model, x_test)           # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(passes):
+        decision_function(model, x_test)
+    dt = (time.perf_counter() - t0) / passes
+
+    rate = m / dt
+    print(f"{m} examples vs {n_sv} SVs (d={d}): {dt * 1e3:.1f} ms/pass "
+          f"-> {rate:,.0f} ex/s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "inference_examples_per_sec",
+        "value": round(rate, 1),
+        "unit": "ex/s",
+        "n_sv": n_sv, "m": m, "d": d,
+        "seconds_per_pass": round(dt, 5),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -496,8 +496,12 @@ def cmd_test(args: argparse.Namespace) -> int:
         print(f"Test MSE: {m['mse']:.6f}  MAE: {m['mae']:.6f}  "
               f"R^2: {m['r2']:.6f}")
         return 0
+    import time
+
     from dpsvm_tpu.models.svm import decision_function
+    t_eval = time.perf_counter()
     dec = decision_function(model, x, include_b=not args.no_b)
+    t_eval = time.perf_counter() - t_eval
     pred = np.where(dec < 0, -1, 1)                    # svmTrain.cu:650-656
     acc = float(np.mean(pred == np.asarray(y, np.int32)))
     if args.predictions:
@@ -505,6 +509,11 @@ def cmd_test(args: argparse.Namespace) -> int:
             f.writelines(f"{int(p)},{v:.6g}\n" for p, v in zip(pred, dec))
     print(f"Number of SVs: {model.n_sv}")
     print(f"Test accuracy: {acc:.6f}")
+    # One batched (m,d)@(d,n_sv) MXU pass — vs the reference's
+    # per-example host loop (seq_test.cpp:187-210). Includes compile on
+    # first use; benchmarks/inference_bench.py isolates steady state.
+    print(f"Evaluation time: {t_eval:.3f} s "
+          f"({len(pred)} examples, {len(pred) / t_eval:,.0f} ex/s)")
     if args.proba:
         from dpsvm_tpu.models.calibration import load_platt, sigmoid_proba
         try:

@@ -77,15 +77,25 @@ def _shrinkable(alpha, y, f, c_box, b_hi, b_lo):
     return (up_only & (f > b_lo)) | (low_only & (f < b_hi))
 
 
-def _reconstruct_inactive_f(x, y, alpha, f, active_mask, spec: KernelSpec,
+def _reconstruct_inactive_f(x, y, alpha, f, alpha0, f0, active_mask,
+                            spec: KernelSpec,
                             block: int = 8192) -> np.ndarray:
-    """Exact f for the inactive rows from scratch (one streamed kernel
-    pass against the support vectors); active rows keep their maintained
-    values — LIBSVM's reconstruct_gradient split."""
+    """Exact f for the inactive rows (one streamed kernel pass); active
+    rows keep their maintained values — LIBSVM's reconstruct_gradient
+    split.
+
+    Reconstructed RELATIVE to the run's initial state:
+    f_i = f0_i + sum_j (alpha_j - alpha0_j) y_j K_ij. For plain
+    classification (f0 = -y, alpha0 = 0) this is the textbook
+    K(alpha*y) - y; for seeded duals (SVR's tube-offset f_init,
+    one-class's K alpha0 seed — models/svr.py, models/oneclass.py) the
+    absolute formula would silently rebuild the WRONG gradient and
+    corrupt the model at unshrink (caught by
+    tests/test_combinations.py::test_svr_with_shrinking)."""
     inactive = ~active_mask
     if not inactive.any():
         return f
-    coef = (alpha * y).astype(np.float32)
+    coef = ((alpha - alpha0) * y).astype(np.float32)
     sv = coef != 0.0
     xi = x[inactive]
     if not sv.any():
@@ -93,7 +103,7 @@ def _reconstruct_inactive_f(x, y, alpha, f, active_mask, spec: KernelSpec,
     else:
         kv = _stream_kv_against(xi, x[sv], coef[sv], spec, block)
     f = f.copy()
-    f[inactive] = kv - y[inactive]
+    f[inactive] = f0[inactive] + kv
     return f
 
 
@@ -143,6 +153,8 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
              else np.asarray(alpha_init, np.float32).copy())
     f = (-y_np.copy() if f_init is None
          else np.asarray(f_init, np.float32).copy())
+    alpha0 = alpha.copy()       # the initial state anchors the exact
+    f0 = f.copy()               # relative f reconstruction at unshrink
 
     decomp = config.working_set > 2
     min_active = 1
@@ -213,7 +225,8 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
             # optimality check on the full problem.
             mask = np.zeros(n, bool)
             mask[active] = True
-            f = _reconstruct_inactive_f(x, y_np, alpha, f, mask, kspec)
+            f = _reconstruct_inactive_f(x, y_np, alpha, f, alpha0, f0,
+                                        mask, kspec)
             b_hi, b_lo = _host_extrema(alpha, y_np, f, c_box)
             converged = not (b_lo > b_hi + 2.0 * eps)
             if converged or capped:

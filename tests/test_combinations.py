@@ -97,3 +97,84 @@ def test_fused_rejects_new_modes():
     cfg = SVMConfig(use_pallas="on", kernel="linear")
     with pytest.raises(ValueError, match="kernel"):
         cfg.validate()
+
+
+def test_svr_with_decomposition(reg_data):
+    """SVR's duplicated-row dual on the working_set > 2 path: the
+    decomposition always TAU-clamps eta, so the twin-pair hazard the
+    2-violator path needs guard_eta for cannot trigger here."""
+    from dpsvm_tpu.models.svr import evaluate_svr, train_svr
+
+    x, y = reg_data
+    m, r = train_svr(x, y, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                     max_iter=200_000, working_set=32))
+    assert r.converged
+    assert evaluate_svr(m, x, y)["r2"] > 0.99
+
+
+def test_svr_with_shrinking(reg_data):
+    from dpsvm_tpu.models.svr import evaluate_svr, train_svr
+
+    x, y = reg_data
+    m, r = train_svr(x, y, SVMConfig(c=10.0, svr_epsilon=0.05,
+                                     max_iter=200_000, shrinking=True,
+                                     chunk_iters=256))
+    assert r.converged
+    assert evaluate_svr(m, x, y)["r2"] > 0.99
+
+
+def test_oneclass_with_decomposition():
+    """One-class seeds alpha/f and REQUIRES the pairwise clip — the
+    equality constraint's value is part of the model; the decomposition
+    must honor both through its f_init/alpha_init path."""
+    from dpsvm_tpu.models.oneclass import predict_oneclass, train_oneclass
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    m, r = train_oneclass(x, nu=0.2,
+                          config=SVMConfig(max_iter=200_000,
+                                           working_set=16))
+    assert r.converged
+    assert abs(float(np.mean(predict_oneclass(m, x) < 0)) - 0.2) < 0.06
+
+
+def test_kernel_family_on_decomposition():
+    """Non-RBF kernels ride the decomposition unchanged (kdiag comes
+    from the generic epilogue, not the RBF literal)."""
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=240, d=5, seed=2)
+    for kernel in ("linear", "poly", "sigmoid"):
+        cfg = SVMConfig(c=1.0, gamma=0.2, kernel=kernel, coef0=0.5,
+                        epsilon=1e-3, max_iter=100_000, working_set=16)
+        model, r = fit(x, y, cfg)
+        assert r.converged, kernel
+        assert evaluate(model, x, y) >= 0.9, kernel
+
+
+def test_weighted_wss2_shrinking():
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=300, d=5, seed=4)
+    cfg = SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=100_000,
+                    shrinking=True, selection="second-order",
+                    weight_pos=2.0, weight_neg=0.5, chunk_iters=128)
+    model, r = fit(x, y, cfg)
+    assert r.converged
+    assert evaluate(model, x, y) >= 0.95
+
+
+def test_oneclass_with_shrinking():
+    """One-class's seeded (alpha0, f0 = K alpha0) dual through the
+    shrinking manager: the relative-f reconstruction must anchor on the
+    seed, not the classification init."""
+    from dpsvm_tpu.models.oneclass import predict_oneclass, train_oneclass
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    m, r = train_oneclass(x, nu=0.2,
+                          config=SVMConfig(max_iter=200_000,
+                                           shrinking=True,
+                                           chunk_iters=128))
+    assert r.converged
+    assert abs(float(np.mean(predict_oneclass(m, x) < 0)) - 0.2) < 0.06
