@@ -1,0 +1,108 @@
+"""Settle the fused Pallas kernel: steady-state it/s past the VMEM cliff.
+
+At the headline shape (60000x784 bf16) XLA keeps the cast X VMEM-
+resident across while-loop iterations (~64 us/iter) and the Pallas
+kernel loses (~200 us/iter, HBM re-staging per pallas_call). Past the
+v5e's VMEM capacity (n=120k: 188 MB bf16 X) BOTH paths must stream X
+from HBM every iteration — the one regime where the hand-fused
+block-pipelined kernel could plausibly win. This harness measures
+exactly that head-to-head.
+
+Usage: python benchmarks/pallas_cliff.py          (n=120000, d=784, bf16)
+Env:   BENCH_N / BENCH_D / BENCH_ITERS / BENCH_PRECISION
+
+Prints one JSON line per arm:
+  {"arm": "xla"|"pallas", "n": ..., "iters_per_sec": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
+
+C, GAMMA, EPS = 10.0, 0.25, 1e-3
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+
+    dev = require_devices()[0]
+    print(f"device: {dev} ({dev.platform})", file=sys.stderr)
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench_common import standin
+    from dpsvm_tpu.ops.kernels import row_norms_sq
+
+    n = int(os.environ.get("BENCH_N", 120_000))
+    d = int(os.environ.get("BENCH_D", 784))
+    iters = int(os.environ.get("BENCH_ITERS", 2000))
+    precision = os.environ.get("BENCH_PRECISION", "DEFAULT").upper()
+    warm = 200
+
+    x, y = standin(n=n, d=d, gamma=GAMMA, seed=0)
+
+    def report(arm, rate):
+        print(json.dumps({"arm": arm, "n": n, "d": d,
+                          "precision": precision,
+                          "iters_per_sec": round(rate, 1)}), flush=True)
+
+    # --- XLA arm (the production path) ---------------------------------
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+
+    xd = jnp.asarray(x)
+    yd = jnp.asarray(y, jnp.float32)
+    x2 = row_norms_sq(xd)
+    runner = _build_chunk_runner(C, GAMMA, EPS, False, precision)
+    carry = init_carry(y, cache_lines=0)
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(warm))
+    jax.block_until_ready(carry.f)
+    it0 = int(carry.n_iter)
+    t0 = time.perf_counter()
+    carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + iters))
+    jax.block_until_ready(carry.f)
+    done = int(carry.n_iter) - it0
+    report("xla", done / (time.perf_counter() - t0))
+
+    # --- Pallas arm ----------------------------------------------------
+    import functools
+
+    import numpy as np
+
+    from dpsvm_tpu.ops.fused_step import DEFAULT_BLOCK_N, pad_to_block
+    from dpsvm_tpu.solver.fused import _run_chunk, init_fused_carry
+
+    n_pad = pad_to_block(n, DEFAULT_BLOCK_N)
+    xp = np.zeros((n_pad, d), np.float32)
+    xp[:n] = x
+    yp = np.zeros((1, n_pad), np.float32)
+    yp[0, :n] = y
+    x_dtype = jnp.bfloat16 if precision == "DEFAULT" else jnp.float32
+    xf = jnp.asarray(xp).astype(x_dtype)
+    x2f = row_norms_sq(xf.astype(jnp.float32))[None, :]
+    yf = jnp.asarray(yp)
+    alpha = jnp.zeros((1, n_pad), jnp.float32)
+    fc = init_fused_carry(alpha, -yf, yf, C)
+    run = functools.partial(_run_chunk, c=C, gamma=GAMMA, epsilon=EPS,
+                            max_iter=10_000_000,
+                            block_n=DEFAULT_BLOCK_N,
+                            precision_name=precision, interpret=False)
+    fc, _ = run(fc, xf, x2f, yf, jnp.int32(warm))
+    jax.block_until_ready(fc.f)
+    it0 = int(fc.n_iter)
+    t0 = time.perf_counter()
+    fc, _ = run(fc, xf, x2f, yf, jnp.int32(it0 + iters))
+    jax.block_until_ready(fc.f)
+    done = int(fc.n_iter) - it0
+    report("pallas", done / (time.perf_counter() - t0))
+
+
+if __name__ == "__main__":
+    main()
