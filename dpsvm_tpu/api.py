@@ -58,6 +58,11 @@ def train(x: np.ndarray, y: np.ndarray,
         return smo_reference(x, y, config, f_init=f_init,
                              alpha_init=alpha_init, guard_eta=guard_eta)
     if config.shards > 1:
+        if config.working_set > 2:
+            from dpsvm_tpu.parallel.dist_decomp import (
+                train_distributed_decomp)
+            return train_distributed_decomp(x, y, config, f_init=f_init,
+                                            alpha_init=alpha_init)
         from dpsvm_tpu.parallel.dist_smo import train_distributed
         return train_distributed(x, y, config, f_init=f_init,
                                  alpha_init=alpha_init, guard_eta=guard_eta)

@@ -263,11 +263,7 @@ class SVMConfig:
                      "outer selection is top_k, not packed extrema"),
                     ("backend", self.backend == "numpy",
                      "the golden oracle keeps the reference's pair "
-                     "iteration"),
-                    ("shards", self.shards > 1,
-                     "decomposition is single-device today (the "
-                     "distributed path keeps the reference's pair "
-                     "protocol)")):
+                     "iteration")):
                 if bad:
                     raise ValueError(
                         f"working_set > 2 does not support {field}: {what}")

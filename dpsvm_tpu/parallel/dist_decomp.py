@@ -1,0 +1,284 @@
+"""Distributed large-working-set decomposition: the MXU path over a mesh.
+
+Composes the two round-3 solvers: solver/decomp.py's outer round
+(top-q violators -> one big kernel-block fetch -> WSS2 inner subsolve ->
+rank-q update) runs SPMD over the 1-D data mesh of parallel/dist_smo.py.
+Per outer round:
+
+  * each shard takes its LOCAL top-q/2 violators per side (lax.top_k on
+    the masked scores — q/2 values + global indices per shard);
+  * one ``all_gather`` merges them; the global top-q/2 per side is a
+    replicated stable argsort over the P*q/2 candidates. Stability plus
+    contiguous sharding makes the merged selection EQUAL to the
+    single-device top_k (ties resolve to the lowest global index in
+    both), so the distributed trajectory matches single-device decomp;
+  * the (q, d) working-set rows + their (alpha, f, x2, y) ride ONE
+    masked ``psum`` pack from their owner shards (the q-row
+    generalization of dist_smo's pair broadcast);
+  * K_WW is computed replicated in exact f32 (q^2 d FLOPs — noise), and
+    the inner WSS2 subsolve runs REPLICATED on every shard: identical
+    inputs, identical arithmetic, zero communication;
+  * the heavy (q, d) @ (d, n_s) block fetch and the rank-q f update are
+    local to each shard — the part worth scaling is the part that
+    scales;
+  * outer stopping extrema ride the same all_gather that selection uses.
+
+Communication per round: one (P, q/2, 2)-ish all_gather pair (KBs) and
+one (q, d+4) psum (~q*d floats; 3 MB at q=1024, d=784) — ICI noise next
+to the sharded matmul. Everything lives inside ONE jitted while_loop,
+chunk-polled by the shared host driver.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.ops.kernels import KernelSpec, rows_from_dots
+from dpsvm_tpu.ops.selection import masked_scores_and_masks
+from dpsvm_tpu.parallel.dist_smo import (_local_slice,
+                                         prepare_distributed_inputs)
+from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+from dpsvm_tpu.solver.decomp import inner_subsolve
+from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
+                                     resume_state)
+
+
+class DistDecompCarry(NamedTuple):
+    alpha: jax.Array    # (n_pad,) sharded
+    f: jax.Array        # (n_pad,) sharded
+    b_hi: jax.Array     # () replicated-equal
+    b_lo: jax.Array     # ()
+    n_iter: jax.Array   # () i32 cumulative inner pair-updates
+
+
+def _merged_top(vals_l, gidx_l, k):
+    """Global top-k from per-shard top-k candidates, matching
+    single-device ``lax.top_k`` exactly: all_gather the per-shard
+    (value, global index) lists and take the k best by a STABLE argsort.
+    Per-shard candidates are value-sorted with lower-local-index ties
+    (top_k's rule) and shards are contiguous, so the flattened order of
+    any value tie is ascending global index — stability then reproduces
+    the single-device lowest-index-wins selection."""
+    vals = lax.all_gather(vals_l, SHARD_AXIS).reshape(-1)     # (P*k,)
+    gidx = lax.all_gather(gidx_l, SHARD_AXIS).reshape(-1)
+    order = jnp.argsort(-vals, stable=True)[:k]
+    return vals[order], gidx[order]
+
+
+def _gather_w(wi, active, xs, ys, x2s, alpha_s, f_s, rank, n_per_shard,
+              shard_x: bool):
+    """Replicated (rows, x2, y, alpha, f) of the working set from the
+    owner shards via one masked psum pack ((q, d+4); rows omitted from
+    the pack when X is replicated)."""
+    loc = jnp.clip(wi - rank * n_per_shard, 0, n_per_shard - 1)
+    own = active & (wi // n_per_shard == rank)
+    ownf = own.astype(jnp.float32)[:, None]
+    # Owner-masked per-slot scalars (x2, y, alpha, f), each (q,).
+    x2_c = (x2s[loc] if shard_x
+            else x2s[jnp.clip(wi, 0, x2s.shape[0] - 1)])
+    cols = jnp.stack([
+        jnp.where(own, x2_c, 0.0),
+        jnp.where(own, ys[loc], 0.0),
+        jnp.where(own, alpha_s[loc], 0.0),
+        jnp.where(own, f_s[loc], 0.0),
+    ], axis=1)                                               # (q, 4)
+    if shard_x:
+        pack = jnp.concatenate([xs[loc] * ownf, cols], axis=1)
+        pack = lax.psum(pack, SHARD_AXIS)
+        d = xs.shape[-1]
+        rows, cols = pack[:, :d], pack[:, d:]
+    else:
+        cols = lax.psum(cols, SHARD_AXIS)
+        rows = xs[jnp.clip(wi, 0, xs.shape[0] - 1)]
+        rows = jnp.where(active[:, None], rows, 0.0)
+    return rows, cols[:, 0], cols[:, 1], cols[:, 2], cols[:, 3]
+
+
+def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
+                      c: float, kspec: KernelSpec, n_per_shard: int,
+                      n_true: int, q: int, inner_cap: int,
+                      epsilon: float, limit, shard_x: bool, precision,
+                      weights=(1.0, 1.0),
+                      pairwise_clip: bool = False) -> DistDecompCarry:
+    """One distributed outer round."""
+    alpha_s, f_s = carry.alpha, carry.f
+    rank = lax.axis_index(SHARD_AXIS)
+    wp, wn = weights
+    if wp != 1.0 or wn != 1.0:
+        c_box = jnp.where(ys > 0, jnp.float32(c * wp), jnp.float32(c * wn))
+    else:
+        c_box = c
+
+    # --- selection: local top-q/2 per side, merged replicated ---------
+    f_up_l, f_low_l, _, _ = masked_scores_and_masks(alpha_s, ys, f_s,
+                                                    c_box, valid)
+    k2 = q // 2
+    # A shard can hold fewer rows than q/2 (tiny n, many shards): each
+    # shard then contributes its whole slice; the train wrapper's
+    # q <= 2n clamp guarantees P * k_loc >= q/2 merged candidates.
+    k_loc = min(k2, n_per_shard)
+    base = rank * n_per_shard
+    uv_l, ui_l = lax.top_k(-f_up_l, k_loc)
+    lv_l, li_l = lax.top_k(f_low_l, k_loc)
+    uv, ui = _merged_top(uv_l, ui_l.astype(jnp.int32) + base, k2)
+    lv, li = _merged_top(lv_l, li_l.astype(jnp.int32) + base, k2)
+    b_hi = -uv[0]
+    b_lo = lv[0]
+
+    w_idx = jnp.unique(jnp.concatenate([ui, li]), size=q,
+                       fill_value=jnp.int32(-1))
+    active = (w_idx >= 0) & (w_idx < n_true)
+    wi = jnp.where(active, w_idx, 0)
+
+    # --- working-set state from the owner shards ----------------------
+    rows, x2_w, y_w, a_w0, f_w0 = _gather_w(
+        wi, active, xs, ys, x2s, alpha_s, f_s, rank, n_per_shard, shard_x)
+    if wp != 1.0 or wn != 1.0:
+        c_w = jnp.where(y_w > 0, jnp.float32(c * wp), jnp.float32(c * wn))
+    else:
+        c_w = jnp.full((q,), jnp.float32(c))
+
+    # --- exact f32 subproblem kernel (see solver/decomp.py on why the
+    # block must NOT be gathered from bf16 dots) -----------------------
+    dots_ww = jnp.matmul(rows, rows.T, precision=lax.Precision.HIGHEST)
+    k_ww = rows_from_dots(dots_ww, x2_w, x2_w, kspec)
+
+    # --- replicated WSS2 inner subsolve (identical on every shard,
+    # zero communication; shared with solver/decomp.py) ----------------
+    step_cap = jnp.minimum(jnp.int32(inner_cap), limit - carry.n_iter)
+
+    # Every seed field is replicated-equal across shards by
+    # construction, but shard_map's VMA typing tags psum-derived values
+    # as axis-varying; the while_loop carry must enter with uniformly-
+    # varying types (pcast rejects already-varying leaves, hence the
+    # guard).
+    def _to_varying(v):
+        try:
+            return lax.pcast(v, (SHARD_AXIS,), to="varying")
+        except ValueError:
+            return v
+
+    inner = inner_subsolve(
+        k_ww, y_w, c_w, a_w0, f_w0, active, epsilon=epsilon,
+        step_cap=step_cap, pairwise_clip=pairwise_clip,
+        seed_transform=lambda s: jax.tree.map(_to_varying, s))
+
+    # --- rank-q application, shard-local ------------------------------
+    dalpha = jnp.where(active, inner.a - a_w0, 0.0)
+    own = active & (wi // n_per_shard == rank)
+    loc = jnp.clip(wi - rank * n_per_shard, 0, n_per_shard - 1)
+    alpha_s = alpha_s.at[loc].add(jnp.where(own, dalpha, 0.0))
+
+    xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
+    dots = jnp.matmul(rows, xs_l.T, precision=precision)     # (q, n_s)
+    k_wn = rows_from_dots(dots, x2_w, x2s_l, kspec)
+    f_s = f_s + jnp.matmul((dalpha * y_w)[None, :], k_wn,
+                           precision=precision)[0]
+
+    return DistDecompCarry(alpha_s, f_s, b_hi, b_lo,
+                           carry.n_iter + inner.t)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
+                              epsilon: float, n_per_shard: int,
+                              n_true: int, q: int, inner_cap: int,
+                              shard_x: bool, precision_name: str,
+                              weights=(1.0, 1.0),
+                              pairwise_clip: bool = False):
+    precision = getattr(lax.Precision, precision_name)
+    kspec = KernelSpec.coerce(kspec)
+    x_spec = P(SHARD_AXIS) if shard_x else P()
+
+    def run(carry: DistDecompCarry, xs, ys, x2s, valid, limit):
+        def cond(s: DistDecompCarry):
+            return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
+
+        def body(s: DistDecompCarry):
+            return _dist_decomp_step(
+                s, xs, ys, x2s, valid, c=c, kspec=kspec,
+                n_per_shard=n_per_shard, n_true=n_true, q=q,
+                inner_cap=inner_cap, epsilon=epsilon, limit=limit,
+                shard_x=shard_x, precision=precision, weights=weights,
+                pairwise_clip=pairwise_clip)
+
+        carry = carry._replace(
+            b_hi=lax.pcast(carry.b_hi, (SHARD_AXIS,), to="varying"),
+            b_lo=lax.pcast(carry.b_lo, (SHARD_AXIS,), to="varying"),
+            n_iter=lax.pcast(carry.n_iter, (SHARD_AXIS,), to="varying"))
+        out = lax.while_loop(cond, body, carry)
+        return out._replace(b_hi=lax.pmax(out.b_hi, SHARD_AXIS),
+                            b_lo=lax.pmax(out.b_lo, SHARD_AXIS),
+                            n_iter=lax.pmax(out.n_iter, SHARD_AXIS))
+
+    carry_specs = DistDecompCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
+                                  b_hi=P(), b_lo=P(), n_iter=P())
+    mapped = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec,
+                  P(SHARD_AXIS), P()),
+        out_specs=carry_specs)
+
+    def run_with_stats(carry, xs, ys, x2s, valid, limit):
+        final = mapped(carry, xs, ys, x2s, valid, limit)
+        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+
+    return jax.jit(run_with_stats, donate_argnums=(0,))
+
+
+def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
+                             config: SVMConfig,
+                             mesh: Optional[jax.sharding.Mesh] = None,
+                             f_init: Optional[np.ndarray] = None,
+                             alpha_init: Optional[np.ndarray] = None
+                             ) -> TrainResult:
+    """working_set > 2 over a device mesh; NumPy in/out like the rest."""
+    config.validate()
+    n, d = x.shape
+    if mesh is None:
+        mesh = make_data_mesh(config.shards)
+    gamma = float(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
+    eps = float(config.epsilon)
+    q = 2 * min(int(config.working_set) // 2, n)
+    inner_cap = int(config.inner_iters) or max(32, q // 4)
+
+    ckpt = resume_state(config, n, d, gamma)
+    di = prepare_distributed_inputs(x, y, config, mesh, ckpt,
+                                    f_init, alpha_init)
+    n_s = di.n_s
+    xd, yd, x2, validd = di.xd, di.yd, di.x2, di.validd
+    shard, repl, init = di.shard, di.repl, di.init
+
+    carry = DistDecompCarry(
+        alpha=jax.device_put(np.asarray(init[0], np.float32), shard),
+        f=jax.device_put(np.asarray(init[1], np.float32), shard),
+        b_hi=jax.device_put(np.float32(init[2]), repl),
+        b_lo=jax.device_put(np.float32(init[3]), repl),
+        n_iter=jax.device_put(np.int32(init[4]), repl))
+
+    runner = _build_dist_decomp_runner(
+        mesh, float(config.c), kspec, eps, n_s, n, q, inner_cap,
+        bool(config.shard_x), config.matmul_precision.upper(),
+        (float(config.weight_pos), float(config.weight_neg)),
+        config.clip == "pairwise")
+
+    def step_chunk(cr, lim):
+        limit = jax.device_put(np.int32(lim), repl)
+        return runner(cr, xd, yd, x2, validd, limit)
+
+    return host_training_loop(
+        config, gamma, n, d, carry,
+        step_chunk=step_chunk,
+        carry_to_host=lambda cr: (np.asarray(cr.alpha)[:n],
+                                  np.asarray(cr.f)[:n]),
+        it0=int(init[4]),
+    )

@@ -406,6 +406,62 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
     return jax.jit(run_with_stats, donate_argnums=(0,))
 
 
+class DistInputs(NamedTuple):
+    """Everything the pad-and-shard protocol produces, shared by the
+    pair (this module) and decomposition (parallel/dist_decomp.py)
+    distributed trainers."""
+    n_s: int
+    xd: jax.Array
+    yd: jax.Array
+    x2: jax.Array
+    validd: jax.Array
+    shard: NamedSharding
+    repl: NamedSharding
+    init: tuple            # (alpha0, f0, b_hi, b_lo, n_iter)
+
+
+def prepare_distributed_inputs(x, y, config: SVMConfig, mesh, ckpt,
+                               f_init, alpha_init) -> DistInputs:
+    """Pad n to the mesh, place X/y/x2/valid with the configured
+    layout, and seed (alpha, f, b's, n_iter) from the checkpoint or the
+    (possibly f_init/alpha_init-overridden) classification init."""
+    n, d = x.shape
+    p = mesh.devices.size
+    n_pad = ((n + p - 1) // p) * p
+    xp = np.zeros((n_pad, d), np.float32)
+    xp[:n] = x
+    yp = np.zeros((n_pad,), np.float32)
+    yp[:n] = y
+    valid = np.arange(n_pad) < n
+
+    shard = NamedSharding(mesh, P(SHARD_AXIS))
+    repl = NamedSharding(mesh, P())
+    x_sharding = shard if config.shard_x else repl
+
+    if ckpt is not None:
+        a0 = np.zeros((n_pad,), np.float32)
+        a0[:n] = ckpt.alpha
+        f0 = np.zeros((n_pad,), np.float32)
+        f0[:n] = ckpt.f
+        init = (a0, f0, ckpt.b_hi, ckpt.b_lo, ckpt.n_iter)
+    else:
+        f0 = -yp
+        if f_init is not None:
+            f0 = np.zeros((n_pad,), np.float32)
+            f0[:n] = np.asarray(f_init, np.float32)
+        a0 = np.zeros((n_pad,), np.float32)
+        if alpha_init is not None:
+            a0[:n] = np.asarray(alpha_init, np.float32)
+        init = (a0, f0, -SENTINEL, SENTINEL, 0)
+    return DistInputs(
+        n_s=n_pad // p,
+        xd=jax.device_put(xp, x_sharding),
+        yd=jax.device_put(yp, shard),
+        x2=jax.device_put(host_row_norms_sq(xp), x_sharding),
+        validd=jax.device_put(valid, shard),
+        shard=shard, repl=repl, init=init)
+
+
 def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                       mesh: Optional[jax.sharding.Mesh] = None,
                       f_init: Optional[np.ndarray] = None,
@@ -426,39 +482,11 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     eps = float(config.epsilon)
 
     ckpt = resume_state(config, n, d, gamma)
-
-    n_pad = ((n + p - 1) // p) * p
-    n_s = n_pad // p
-    xp = np.zeros((n_pad, d), np.float32)
-    xp[:n] = x
-    yp = np.zeros((n_pad,), np.float32)
-    yp[:n] = y
-    valid = np.arange(n_pad) < n
-
-    shard = NamedSharding(mesh, P(SHARD_AXIS))
-    repl = NamedSharding(mesh, P())
-    x_sharding = shard if config.shard_x else repl
-
-    xd = jax.device_put(xp, x_sharding)
-    yd = jax.device_put(yp, shard)
-    x2 = jax.device_put(host_row_norms_sq(xp), x_sharding)
-    validd = jax.device_put(valid, shard)
-
-    if ckpt is not None:
-        alpha0 = np.zeros((n_pad,), np.float32)
-        alpha0[:n] = ckpt.alpha
-        f0 = np.zeros((n_pad,), np.float32)
-        f0[:n] = ckpt.f
-        init = (alpha0, f0, ckpt.b_hi, ckpt.b_lo, ckpt.n_iter)
-    else:
-        f0 = -yp
-        if f_init is not None:
-            f0 = np.zeros((n_pad,), np.float32)
-            f0[:n] = np.asarray(f_init, np.float32)
-        a0 = np.zeros((n_pad,), np.float32)
-        if alpha_init is not None:
-            a0[:n] = np.asarray(alpha_init, np.float32)
-        init = (a0, f0, -SENTINEL, SENTINEL, 0)
+    di = prepare_distributed_inputs(x, y, config, mesh, ckpt,
+                                    f_init, alpha_init)
+    n_s = di.n_s
+    xd, yd, x2, validd = di.xd, di.yd, di.x2, di.validd
+    shard, repl, init = di.shard, di.repl, di.init
     # Per-shard row cache: `lines` lines per shard (the reference's -s is
     # per-rank lines too, svmTrainMain.cpp:70); 0 disables. Resume starts
     # cold — the checkpoint holds only (alpha, f), like the reference's

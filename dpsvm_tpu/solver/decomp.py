@@ -83,6 +83,74 @@ class _InnerState(NamedTuple):
     t: jax.Array        # () i32 inner steps taken
 
 
+def inner_subsolve(k_ww, y_w, c_w, a_w0, f_w0, active, *, epsilon,
+                   step_cap, pairwise_clip, seed_transform=None
+                   ) -> _InnerState:
+    """The WSS2 SMO subsolve on a (q, q) block — shared by the
+    single-device and distributed decomposition paths (this block
+    encodes the measured design facts: exact-f32 K_WW callers, the TAU
+    eta clamp, real-extrema seeding so an already-optimal block no-ops
+    instead of corner-slamming; see decomp_step's comments).
+
+    ``seed_transform`` lets the distributed caller pcast the seed to
+    shard_map's varying types; arithmetic is identical either way."""
+    kdiag_w = jnp.diagonal(k_ww)
+
+    def inner_cond(s: _InnerState):
+        return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.t < step_cap)
+
+    def inner_body(s: _InnerState):
+        fu, fl, _, in_low_w = masked_scores_and_masks(s.a, y_w, s.f, c_w,
+                                                      valid=active)
+        i_hi = jnp.argmin(fu)
+        bh = fu[i_hi]
+        bl = jnp.max(fl)                    # stopping gap: max violator
+        # Second-order (LIBSVM WSS2) partner choice — free here because
+        # the exact kernel column K_WW[i_hi] is already on hand (the
+        # 2-violator solver pays a serial (1,d)@(d,n) matmul for this).
+        # First-order inner steps need ~10-20x more of them at benchmark
+        # shapes, and an inner step costs ~22 us of fixed latency
+        # regardless of q, so step QUALITY is everything (measured:
+        # first-order inner stalls the MNIST shape at 2M steps; WSS2
+        # inner converges it).
+        bb = fl - bh
+        aa = jnp.maximum(kdiag_w[i_hi] + kdiag_w - 2.0 * k_ww[i_hi],
+                         1e-12)
+        obj = jnp.where(in_low_w & (bb > 0), bb * bb / aa, -1.0)
+        i_lo = jnp.argmax(obj)
+        bl_sel = fl[i_lo]
+        eta = jnp.maximum(k_ww[i_hi, i_hi] + k_ww[i_lo, i_lo]
+                          - 2.0 * k_ww[i_hi, i_lo], 1e-12)
+        a_hi, a_lo = s.a[i_hi], s.a[i_lo]
+        a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_w[i_hi], y_w[i_lo],
+                                         bh, bl_sel, eta,
+                                         c_w[i_hi], c_w[i_lo],
+                                         pairwise_clip)
+        a = s.a.at[i_lo].set(a_lo_n)
+        a = a.at[i_hi].set(a_hi_n)
+        fsub = (s.f + (a_hi_n - a_hi) * y_w[i_hi] * k_ww[i_hi]
+                + (a_lo_n - a_lo) * y_w[i_lo] * k_ww[i_lo])
+        return _InnerState(a, fsub, bh, bl, s.t + 1)
+
+    # Seed with the block's REAL entry extrema, not do-while sentinels:
+    # when the subproblem enters already at its optimum (the outer
+    # loop's trailing round, or a warm-start from the solved model), a
+    # sentinel-forced first step would find no positive violator,
+    # argmax an all(-1) objective to slot 0, and bl_sel = -SENTINEL
+    # would slam that alpha to a box corner while still reporting
+    # convergence. With the real entry gap the loop never starts.
+    # Whenever the global gap is open the block's entry gap is >= it
+    # (the global pair is in W), so >= 1 inner step still happens and
+    # every non-trailing round makes strict progress.
+    fu0, fl0, _, _ = masked_scores_and_masks(a_w0, y_w, f_w0, c_w,
+                                             valid=active)
+    inner0 = _InnerState(a_w0, f_w0, jnp.min(fu0), jnp.max(fl0),
+                         jnp.int32(0))
+    if seed_transform is not None:
+        inner0 = seed_transform(inner0)
+    return lax.while_loop(inner_cond, inner_body, inner0)
+
+
 def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
                 x2: jax.Array, c: float, kspec: KernelSpec, *,
                 q: int, inner_cap: int, epsilon: float,
@@ -140,65 +208,14 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     else:
         c_w = jnp.full((q,), jnp.float32(c))
 
-    # --- inner subsolve: plain SMO on (q,)-sized state ------------------
+    # --- inner subsolve: WSS2 SMO on (q,)-sized state (shared helper,
+    # also driven by parallel/dist_decomp.py) ---------------------------
     step_cap = jnp.int32(inner_cap)
     if limit is not None:
         step_cap = jnp.minimum(step_cap, limit - carry.n_iter)
-
-    def inner_cond(s: _InnerState):
-        return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.t < step_cap)
-
-    kdiag_w = jnp.diagonal(k_ww)
-
-    def inner_body(s: _InnerState):
-        fu, fl, _, in_low_w = masked_scores_and_masks(s.a, y_w, s.f, c_w,
-                                                      valid=active)
-        i_hi = jnp.argmin(fu)
-        bh = fu[i_hi]
-        bl = jnp.max(fl)                    # stopping gap: max violator
-        # Second-order (LIBSVM WSS2) choice of the partner — free here,
-        # because the exact kernel column K_WW[i_hi] is already on hand
-        # (the 2-violator solver pays a serial (1,d)@(d,n) matmul for
-        # this, solver/smo.py second_order). First-order inner steps
-        # need ~10-20x more of them at benchmark shapes, and on TPU an
-        # inner step costs ~22 us of fixed latency regardless of q, so
-        # step QUALITY is everything (measured: first-order inner stalls
-        # the MNIST shape at 2M steps; WSS2 inner converges it).
-        bb = fl - bh
-        aa = jnp.maximum(kdiag_w[i_hi] + kdiag_w - 2.0 * k_ww[i_hi],
-                         1e-12)
-        obj = jnp.where(in_low_w & (bb > 0), bb * bb / aa, -1.0)
-        i_lo = jnp.argmax(obj)
-        bl_sel = fl[i_lo]
-        eta = jnp.maximum(k_ww[i_hi, i_hi] + k_ww[i_lo, i_lo]
-                          - 2.0 * k_ww[i_hi, i_lo], 1e-12)
-        a_hi, a_lo = s.a[i_hi], s.a[i_lo]
-        a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_w[i_hi], y_w[i_lo],
-                                         bh, bl_sel, eta,
-                                         c_w[i_hi], c_w[i_lo],
-                                         pairwise_clip)
-        a = s.a.at[i_lo].set(a_lo_n)
-        a = a.at[i_hi].set(a_hi_n)
-        fsub = (s.f + (a_hi_n - a_hi) * y_w[i_hi] * k_ww[i_hi]
-                + (a_lo_n - a_lo) * y_w[i_lo] * k_ww[i_lo])
-        return _InnerState(a, fsub, bh, bl, s.t + 1)
-
-    # Seed the inner stopping state with the block's REAL entry extrema,
-    # not do-while sentinels: when the subproblem enters already at its
-    # optimum (reachable — the outer loop's trailing round, or a
-    # warm-start from the solved model), a sentinel-forced first step
-    # would find no positive violator, argmax over an all(-1) objective
-    # would fall to slot 0, and bl_sel = -SENTINEL would slam that alpha
-    # to a box corner while still reporting convergence. With the real
-    # entry gap the loop simply never starts (zero-step no-op round).
-    # Whenever the global gap is open the block's entry gap is >= it
-    # (the global pair is in W), so >= 1 inner step still happens and
-    # every non-trailing round makes strict progress.
-    fu0, fl0, _, _ = masked_scores_and_masks(a_w0, y_w, f_w0, c_w,
-                                             valid=active)
-    inner0 = _InnerState(a_w0, f_w0, jnp.min(fu0), jnp.max(fl0),
-                         jnp.int32(0))
-    inner = lax.while_loop(inner_cond, inner_body, inner0)
+    inner = inner_subsolve(k_ww, y_w, c_w, a_w0, f_w0, active,
+                           epsilon=epsilon, step_cap=step_cap,
+                           pairwise_clip=pairwise_clip)
 
     # --- rank-q application --------------------------------------------
     dalpha = jnp.where(active, inner.a - a_w0, 0.0)

@@ -26,10 +26,12 @@ from dpsvm_tpu.data.synthetic import make_blobs, make_planted, make_xor
 
 
 def true_gap_and_b(x, y, alpha, C, gamma):
-    """Exact first-order optimality gap from scratch (f64 kernel)."""
+    """Exact first-order optimality gap from scratch (f64 kernel).
+    ``C`` may be a scalar or a per-example bound array."""
     xf = np.asarray(x, np.float64)
     yf = np.asarray(y, np.float64)
     a = np.asarray(alpha, np.float64)
+    C = np.broadcast_to(np.asarray(C, np.float64), a.shape)
     d2 = (xf ** 2).sum(1)
     K = np.exp(-gamma * (d2[:, None] + d2[None, :] - 2.0 * xf @ xf.T))
     f = K @ (a * yf) - yf
@@ -170,10 +172,14 @@ def test_config_guard_rails():
     with pytest.raises(ValueError, match="working_set"):
         SVMConfig(working_set=16384).validate()
     for bad in (dict(selection="second-order"), dict(cache_size=4),
-                dict(shards=2), dict(backend="numpy"),
-                dict(select_impl="packed")):
+                dict(backend="numpy"), dict(select_impl="packed")):
         with pytest.raises(ValueError, match="working_set > 2"):
             SVMConfig(working_set=8, **bad).validate()
+    # distributed decomposition is a real path (parallel/dist_decomp.py)
+    SVMConfig(working_set=8, shards=2).validate()
+    # ...but the active-set manager stays single-device
+    with pytest.raises(ValueError, match="shrinking"):
+        SVMConfig(working_set=8, shrinking=True, shards=2).validate()
     with pytest.raises(ValueError, match="inner_iters"):
         SVMConfig(inner_iters=100).validate()
     # inner_iters rides along with a valid q
