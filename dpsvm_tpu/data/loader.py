@@ -114,6 +114,51 @@ def load_csv(
     return _check_finite(xs, path), ys
 
 
+def _load_libsvm_native(lib, path, num_examples, num_attributes,
+                        float_labels):
+    """C++ fast path for load_libsvm; None = fall back to Python (both
+    for hard parse errors, so the user sees the line-numbered message,
+    and for validation failures the scalar return code cannot carry)."""
+    if num_examples is not None and num_attributes is not None:
+        # Both shapes known: skip the stats scan (the fill pass's
+        # row-count check covers short files) — one pass, not two.
+        n, d = num_examples, num_attributes
+    else:
+        max_idx = ctypes.c_long(0)
+        n_found = lib.dpsvm_libsvm_stats(
+            path.encode(), np.int64(num_examples or 0),
+            ctypes.byref(max_idx))
+        if n_found <= 0:
+            # open/alloc/parse failure, or an actually-empty file: the
+            # Python parser owns the error message.
+            return None
+        n = num_examples if num_examples is not None else int(n_found)
+        if n_found < n:
+            return None                  # short file: readable error below
+        d = (num_attributes if num_attributes is not None
+             else int(max_idx.value))
+    if d <= 0:
+        return None
+    x = np.zeros((n, d), dtype=np.float32)
+    y = np.empty((n,), dtype=np.float32)
+    got = lib.dpsvm_parse_libsvm(
+        path.encode(),
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, d)
+    if got != n:
+        return None
+    if np.any(np.abs(y) >= 2 ** 24):
+        # float32 label transport stops being exact: Python path.
+        return None
+    if not float_labels:
+        yi = y.astype(np.int32)
+        if not np.array_equal(yi.astype(np.float32), y):
+            return None                  # non-integer labels: Python error
+        y = yi
+    return _check_finite(x, path), y
+
+
 def load_libsvm(
     path: str,
     num_examples: Optional[int] = None,
@@ -139,6 +184,19 @@ def load_libsvm(
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+    if num_examples is not None and num_examples <= 0:
+        raise ValueError(f"empty dataset: {path!r} "
+                         f"(num_examples={num_examples})")
+
+    lib = load_native_lib()
+    if lib is not None:
+        out = _load_libsvm_native(lib, path, num_examples, num_attributes,
+                                  float_labels)
+        if out is not None:
+            return out
+        # Malformed input (or short file): fall through to the Python
+        # parser, which produces line-numbered error messages.
+
     labels = []
     rows = []          # list of (idx_array, val_array), 1-based indices
     max_idx = 0

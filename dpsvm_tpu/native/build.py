@@ -58,6 +58,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c_float_p, c_int_p, c_float_p, ctypes.c_long, ctypes.c_long,
     ]
     lib.dpsvm_write_model.restype = ctypes.c_long
+
+    lib.dpsvm_libsvm_stats.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                       c_long_p]
+    lib.dpsvm_libsvm_stats.restype = ctypes.c_long
+
+    lib.dpsvm_parse_libsvm.argtypes = [
+        ctypes.c_char_p, c_float_p, c_float_p, ctypes.c_long,
+        ctypes.c_long,
+    ]
+    lib.dpsvm_parse_libsvm.restype = ctypes.c_long
     return lib
 
 
@@ -84,7 +94,11 @@ def load_native_lib() -> Optional[ctypes.CDLL]:
                 _failed = True
                 return None
             _cached = _bind(ctypes.CDLL(_LIB))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale cached .so (e.g. archive-preserved
+            # mtimes defeating the staleness check) missing newer symbols
+            # must degrade to the Python paths, not crash the loaders.
             _failed = True
+            _cached = None
             return None
     return _cached

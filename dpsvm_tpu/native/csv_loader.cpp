@@ -148,4 +148,108 @@ long dpsvm_write_model(const char* path, double gamma, double b,
     return n_sv;
 }
 
+// --- libsvm / svmlight sparse format ("<label> idx:val idx:val ...") ---
+// The reference could only consume this format via an offline Python
+// convert step (scripts/convert_adult.py); the framework's loaders accept
+// it natively, and this is the fast path behind data/loader.py::load_libsvm
+// (the pure-Python parser remains the fallback and the source of
+// line-numbered error messages). Acceptance must not be LOOSER than the
+// Python parser (a file must not load with g++ present but error without),
+// so the float parse is stricter than bare strtof: no leading whitespace
+// (Python tokenizes on whitespace first) and no hex literals (Python's
+// float() rejects "0x1A").
+
+static int strict_float(char* p, char** end, float* out) {
+    if (*p == ' ' || *p == '\t') return 0;
+    float v = strtof(p, end);
+    if (*end == p) return 0;
+    for (char* q = p; q < *end; ++q) {
+        if (*q == 'x' || *q == 'X') return 0;
+    }
+    *out = v;
+    return 1;
+}
+
+// Parse one libsvm row in place. Returns 1 on success, 0 on a malformed
+// row, -1 for a blank/comment line. Shared by the scan and fill passes so
+// the two cannot disagree on which rows are valid. `row` may be null
+// (scan pass: only label/max_index are produced); num_attributes < 0
+// means "no column bound" (scan pass).
+static int parse_libsvm_row(char* buf, float* label, float* row,
+                            long num_attributes, long* max_index) {
+    char* p = buf;
+    while (*p == ' ' || *p == '\t') ++p;
+    if (*p == '\0' || *p == '\r' || *p == '#') return -1;
+    char* end = nullptr;
+    if (!strict_float(p, &end, label)) return 0;
+    p = end;
+    for (;;) {
+        while (*p == ' ' || *p == '\t') ++p;
+        if (*p == '\0' || *p == '\r') return 1;
+        long idx = strtol(p, &end, 10);
+        if (end == p || *end != ':' || idx < 1) return 0;
+        p = end + 1;
+        float val;
+        if (!strict_float(p, &end, &val)) return 0;
+        p = end;
+        if (idx > *max_index) *max_index = idx;
+        if (row && idx <= num_attributes) row[idx - 1] = val;
+    }
+}
+
+// Scan pass: count data rows (blank lines and '#' comments skipped) and the
+// maximum 1-based feature index. max_rows <= 0 means "all". Returns the row
+// count, or -1 open failure, -2 alloc failure, -3 malformed line / bad index.
+long dpsvm_libsvm_stats(const char* path, long max_rows, long* max_index) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    char* buf = nullptr;
+    size_t cap = 0;
+    long n = 0, mi = 0;
+    while (max_rows <= 0 || n < max_rows) {
+        long len = read_line(f, &buf, &cap);
+        if (len == -2) { fclose(f); free(buf); return -2; }
+        if (len < 0) break;
+        float label;
+        int r = parse_libsvm_row(buf, &label, nullptr, -1, &mi);
+        if (r == 0) { fclose(f); free(buf); return -3; }
+        if (r > 0) ++n;
+    }
+    free(buf);
+    fclose(f);
+    *max_index = mi;
+    return n;
+}
+
+// Fill pass: x_out must be (max_rows, num_attributes) ZEROED by the caller
+// (absent features stay 0); labels land as float (the Python wrapper owns
+// integer-label validation and bails back to Python for |label| >= 2^24,
+// where float32 stops being exact). Features with index > num_attributes
+// are dropped — the same column-narrowing semantics as the dense path and
+// the reference converter (convert_adult.py:31). Returns rows parsed or
+// the negative codes of dpsvm_libsvm_stats.
+long dpsvm_parse_libsvm(const char* path, float* x_out, float* y_out,
+                        long max_rows, long num_attributes) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    char* buf = nullptr;
+    size_t cap = 0;
+    long n = 0, mi = 0;
+    while (n < max_rows) {
+        long len = read_line(f, &buf, &cap);
+        if (len == -2) { fclose(f); free(buf); return -2; }
+        if (len < 0) break;
+        float label;
+        int r = parse_libsvm_row(buf, &label, x_out + n * num_attributes,
+                                 num_attributes, &mi);
+        if (r == 0) { fclose(f); free(buf); return -3; }
+        if (r < 0) continue;
+        y_out[n] = label;
+        ++n;
+    }
+    free(buf);
+    fclose(f);
+    return n;
+}
+
 }  // extern "C"

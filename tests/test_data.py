@@ -328,3 +328,92 @@ class TestMakePlanted:
             alpha = np.asarray(r.alpha)
             nsv_at[noise] = int(np.sum(alpha >= 10.0 - 1e-4))
         assert nsv_at[0.10] > nsv_at[0.0] + 50, nsv_at
+
+
+class TestNativeLibsvmParser:
+    """C++ fast path for the sparse libsvm loader (csv_loader.cpp
+    dpsvm_libsvm_stats/dpsvm_parse_libsvm): bit-identical to the Python
+    parser, with the Python path still owning every error message."""
+
+    def _write(self, path, n=200, d=30, seed=0):
+        rng = np.random.default_rng(seed)
+        with open(path, "w") as f:
+            f.write("# header comment\n\n")
+            for i in range(n):
+                idxs = np.sort(rng.choice(np.arange(1, d + 1), size=6,
+                                          replace=False))
+                toks = " ".join(f"{j}:{rng.normal():.6g}" for j in idxs)
+                f.write(f"{(-1) ** i} {toks}\n")
+
+    def test_native_matches_python(self, tmp_path, monkeypatch):
+        from dpsvm_tpu.data.loader import load_libsvm
+
+        p = str(tmp_path / "s.libsvm")
+        self._write(p)
+        xa, ya = load_libsvm(p)
+        xs, ys = load_libsvm(p, num_examples=50, num_attributes=12)
+        monkeypatch.setenv("DPSVM_NO_NATIVE", "1")
+        import dpsvm_tpu.native.build as nb
+        monkeypatch.setattr(nb, "_cached", None)
+        xb, yb = load_libsvm(p)
+        xt, yt = load_libsvm(p, num_examples=50, num_attributes=12)
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+        np.testing.assert_array_equal(xs, xt)
+        np.testing.assert_array_equal(ys, yt)
+        assert ya.dtype == np.int32 and xa.dtype == np.float32
+
+    def test_errors_still_line_numbered(self, tmp_path):
+        from dpsvm_tpu.data.loader import load_libsvm
+
+        bad = tmp_path / "bad.libsvm"
+        bad.write_text("+1 1:0.5\n-1 nope:2\n")
+        with pytest.raises(ValueError, match="bad.libsvm:2"):
+            load_libsvm(str(bad))
+        zero = tmp_path / "zero.libsvm"
+        zero.write_text("+1 0:0.5\n")
+        with pytest.raises(ValueError, match="1-based"):
+            load_libsvm(str(zero))
+
+    def test_float_and_integer_labels(self, tmp_path):
+        from dpsvm_tpu.data.loader import load_libsvm
+
+        p = tmp_path / "f.libsvm"
+        p.write_text("0.25 1:1\n-3.5 2:2\n")
+        x, y = load_libsvm(str(p), float_labels=True)
+        np.testing.assert_allclose(y, [0.25, -3.5])
+        with pytest.raises(ValueError, match="non-integer label"):
+            load_libsvm(str(p))
+
+    def test_acceptance_not_looser_than_python(self, tmp_path):
+        """Inputs the Python parser rejects must NOT load via the native
+        path (round-3 review: bare strtof accepts hex floats and
+        whitespace after the colon)."""
+        from dpsvm_tpu.data.loader import load_libsvm
+
+        hexv = tmp_path / "hex.libsvm"
+        hexv.write_text("1 1:0x1A\n")
+        with pytest.raises(ValueError, match="bad feature token"):
+            load_libsvm(str(hexv))
+        spaced = tmp_path / "sp.libsvm"
+        spaced.write_text("1 1: 0.5\n")
+        with pytest.raises(ValueError, match="bad feature token"):
+            load_libsvm(str(spaced))
+
+    def test_num_examples_zero_rejected_like_python(self, tmp_path):
+        from dpsvm_tpu.data.loader import load_libsvm
+
+        p = tmp_path / "z.libsvm"
+        p.write_text("1 1:1\n")
+        with pytest.raises(ValueError, match="empty dataset"):
+            load_libsvm(str(p), num_examples=0)
+
+    def test_huge_integer_labels_exact(self, tmp_path):
+        """Labels above 2^24 are not float32-representable; the native
+        path must bail to Python rather than silently round."""
+        from dpsvm_tpu.data.loader import load_libsvm
+
+        p = tmp_path / "big.libsvm"
+        p.write_text("16777217 1:1\n16777216 2:1\n")
+        _, y = load_libsvm(str(p))
+        assert y.tolist() == [16777217, 16777216]
