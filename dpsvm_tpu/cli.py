@@ -126,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "column is ignored)")
     tr.add_argument("--nu", type=float, default=0.5,
                     help="one-class outlier-fraction bound (LIBSVM -n)")
+    tr.add_argument("--nu-svc", action="store_true",
+                    help="nu-SVC (LIBSVM -s 1): --nu replaces -c; nu "
+                         "lower-bounds the SV fraction and upper-bounds "
+                         "the margin-error fraction")
+    tr.add_argument("--nu-svr", action="store_true",
+                    help="nu-SVR (LIBSVM -s 4): the epsilon tube width "
+                         "is learned; --nu bounds the outside-tube "
+                         "fraction, -c is the usual cost")
     tr.add_argument("--svr", action="store_true",
                     help="epsilon-SVR regression (float targets; LIBSVM "
                          "svm-train -s 3 analog)")
@@ -265,26 +273,38 @@ def cmd_train(args: argparse.Namespace) -> int:
                 print(f"error: {flag} does not apply to --cv mode{hint}",
                       file=sys.stderr)
                 return 2
-    if args.svr and args.one_class:
-        print("error: --svr and --one-class are mutually exclusive",
+    modes = [f for f, on in (("--svr", args.svr),
+                             ("--one-class", args.one_class),
+                             ("--nu-svc", args.nu_svc),
+                             ("--nu-svr", args.nu_svr)) if on]
+    if len(modes) > 1:
+        print(f"error: {' and '.join(modes)} are mutually exclusive",
               file=sys.stderr)
         return 2
-    if args.svr or args.one_class:
-        mode = "--svr" if args.svr else "--one-class"
+    if modes:
+        # One conflict table for every restricted mode — a new flag
+        # must be added exactly once.
+        mode = modes[0]
+        nu_mode = mode in ("--nu-svc", "--nu-svr")
         conflicts = [("--multiclass", args.multiclass),
                      ("--probability", args.probability),
                      ("--check-kkt", args.check_kkt),
                      ("--pallas on", args.pallas == "on"),
                      ("--weight-pos/--weight-neg",
                       args.weight_pos != 1.0 or args.weight_neg != 1.0)]
+        if nu_mode:
+            conflicts += [("--cv", bool(args.cv)),
+                          ("--checkpoint/--resume",
+                           bool(args.checkpoint or args.resume))]
         for flag, on in conflicts:
             if on:
-                print(f"error: {flag} is a classification flag; it does "
-                      f"not apply to {mode}", file=sys.stderr)
+                print(f"error: {flag} does not apply to {mode}",
+                      file=sys.stderr)
                 return 2
 
     x, y = load_dataset(args.input, args.num_ex, args.num_att,
-                        float_labels=args.svr or args.one_class)
+                        float_labels=(args.svr or args.one_class
+                                      or args.nu_svr))
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, kernel=args.kernel,
         degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
@@ -340,6 +360,35 @@ def cmd_train(args: argparse.Namespace) -> int:
                   f"{r['accuracy'] * 100:.4f}%")
         return 0
 
+    if args.nu_svc:
+        from dpsvm_tpu.models.nusvm import train_nusvc
+        from dpsvm_tpu.models.svm import evaluate
+        model, result = train_nusvc(x, np.asarray(y, np.int32), args.nu,
+                                    config)
+        n_sv = save_model(model, args.model)
+        print(f"Number of SVs: {n_sv}")
+        print(f"b: {result.b:.6f}")
+        print(f"Training iterations: {result.n_iter}"
+              + ("" if result.converged else " (NOT converged)"))
+        print(f"Training accuracy: {evaluate(model, x, y):.6f} "
+              f"(nu = {args.nu})")
+        print(f"Training time: {result.train_seconds:.3f} s")
+        return 0
+    if args.nu_svr:
+        from dpsvm_tpu.models.nusvm import train_nusvr
+        from dpsvm_tpu.models.svr import evaluate_svr
+        model, result = train_nusvr(x, y, args.nu, config)
+        n_sv = save_model(model, args.model)
+        m = evaluate_svr(model, x, y)
+        print(f"Number of SVs: {n_sv}")
+        print(f"b: {result.b:.6f}")
+        print(f"epsilon: {result.learned_epsilon:.6f}")   # learned tube
+        print(f"Training iterations: {result.n_iter}"
+              + ("" if result.converged else " (NOT converged)"))
+        print(f"Training MSE: {m['mse']:.6f}  R^2: {m['r2']:.6f} "
+              f"(nu = {args.nu})")
+        print(f"Training time: {result.train_seconds:.3f} s")
+        return 0
     if args.one_class:
         from dpsvm_tpu.models.oneclass import predict_oneclass, train_oneclass
         model, result = train_oneclass(x, args.nu, config)

@@ -345,6 +345,10 @@ class TrainResult:
     kernel: str = "rbf"                 # LIBSVM -t family (see SVMConfig)
     coef0: float = 0.0
     degree: int = 3
+    learned_epsilon: "Optional[float]" = None   # nu-SVR only: the tube
+                                        # half-width the optimization
+                                        # found ((r1+r2)/2 — LIBSVM -s 4
+                                        # prints it as "epsilon = ...")
 
     @property
     def gap(self) -> float:

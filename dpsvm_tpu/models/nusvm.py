@@ -1,0 +1,229 @@
+"""nu-SVM family: nu-SVC (LIBSVM -s 1) and nu-SVR (-s 4).
+
+The nu formulations replace C's per-example cost with a single nu in
+(0, 1] that lower-bounds the SV fraction and upper-bounds the margin-
+error fraction. Their duals carry TWO equality constraints (one per
+class), which the solver honors with ``nu_selection``: working pairs
+share a label and the class with the larger KKT gap is optimized first
+(LIBSVM's Solver_NU, svm.cpp). Everything else — the compiled loop, the
+masks, the pair update — is the unmodified solver, reached through the
+same ``alpha_init``/``f_init`` seeding hooks SVR and one-class use:
+
+  * nu-SVC (solve_nu_svc): box [0, 1], sum of each class's alphas
+    = nu*n/2, zero linear term (f0 = K (alpha0 y), no -y), pairwise
+    clip (the class sums are invariants). Post-solve, the per-class
+    thresholds r1/r2 (from the final gradient's free SVs) give
+    r = (r1+r2)/2 and rho = (r1-r2)/2; the stored model rescales
+    alpha/r with intercept rho/r so the decision function matches
+    C-SVC's form (and sklearn.svm.NuSVC's values).
+  * nu-SVR (solve_nu_svr): the 2n doubled variables of epsilon-SVR
+    (models/svr.py) but with alpha = alpha* = min(C, remaining) seeding
+    (sum C*nu*n/2 per half), linear term -+z instead of the epsilon
+    tube (the tube width is a RESULT here: epsilon_eff = (r1+r2)/2,
+    intercept b = -(r1-r2)/2).
+
+Quality bar: decision/prediction parity against sklearn's NuSVC/NuSVR
+(libsvm) at matched hyperparameters — tests/test_nusvm.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.models.svm import SVMModel
+
+
+def _solve_nu(x, y_pm, alpha0, f0, config: SVMConfig) -> TrainResult:
+    """Run the nu_selection solver (single device; the nu family's
+    two-constraint selection has no distributed/decomp variant yet)."""
+    from dpsvm_tpu.solver.smo import train_single_device
+
+    for field, bad in (("shards", config.shards > 1),
+                       ("working_set", config.working_set > 2),
+                       ("shrinking", config.shrinking),
+                       ("cache_size", config.cache_size > 0),
+                       ("selection", config.selection != "first-order"),
+                       ("backend", config.backend == "numpy"),
+                       ("use_pallas", config.use_pallas == "on"),
+                       # Checkpoints carry no task tag, and a shape-
+                       # compatible C-SVC checkpoint resuming here would
+                       # silently replace the nu seeding with alphas
+                       # violating both equality constraints.
+                       ("resume_from", bool(config.resume_from)),
+                       ("checkpoint_path", bool(config.checkpoint_path)),
+                       ("weight_pos/weight_neg",
+                        config.weight_pos != 1.0
+                        or config.weight_neg != 1.0)):
+        if bad:
+            raise ValueError(f"nu-SVM training does not support {field} "
+                             "(the two-constraint Solver_NU selection "
+                             "runs on the single-device first-order "
+                             "path; class weights and checkpoints do "
+                             "not compose with the nu constraints)")
+    return train_single_device(x, y_pm, config, f_init=f0,
+                               alpha_init=alpha0, guard_eta=True,
+                               nu_selection=True)
+
+
+def _class_thresholds(f, y_pm, alpha, c_box):
+    """LIBSVM Solver_NU::calculate_rho's (r1, r2) from the final state.
+
+    G_i = y_i f_i (f maintains K(alpha y); the nu duals have no linear
+    term). Per class: the average G over free SVs, else the midpoint of
+    the active-bound extremes."""
+    g = y_pm * f
+    out = []
+    for sign in (1.0, -1.0):
+        cls = y_pm == sign
+        free = cls & (alpha > 0) & (alpha < c_box)
+        if free.any():
+            out.append(float(g[free].mean()))
+            continue
+        at0 = cls & (alpha == 0)
+        atc = cls & (alpha == c_box)
+        # alpha=0 can only increase (G too low is a violation): upper
+        # candidate; alpha=C can only decrease: lower candidate.
+        ub = float(g[at0].min()) if at0.any() else np.inf
+        lb = float(g[atc].max()) if atc.any() else -np.inf
+        out.append((ub + lb) / 2.0)
+    return out[0], out[1]
+
+
+def _nu_head_seed(total: float, cap: float, n: int) -> np.ndarray:
+    """LIBSVM's prefix seeding — min(cap, remaining) in data order — in
+    closed form (a_i = clip(total - i*cap, 0, cap)); the sequential loop
+    would cost O(n) Python steps at covtype-scale n."""
+    a = np.clip(total - cap * np.arange(n, dtype=np.float64), 0.0, cap)
+    return a.astype(np.float32)
+
+
+def train_nusvc(x: np.ndarray, y: np.ndarray, nu: float = 0.5,
+                config: Optional[SVMConfig] = None
+                ) -> Tuple[SVMModel, TrainResult]:
+    """Fit a nu-SVC (LIBSVM -s 1). ``config.c`` is ignored (the nu-SVC
+    box is 1 by construction); labels are +/-1."""
+    from dpsvm_tpu.ops.diagnostics import _stream_kv
+
+    config = config or SVMConfig()
+    if not 0.0 < nu <= 1.0:
+        raise ValueError(f"nu must be in (0, 1], got {nu}")
+    if config.weight_pos != 1.0 or config.weight_neg != 1.0:
+        raise ValueError("class weights do not apply to nu-SVC (the nu "
+                         "constraint fixes each class's alpha mass)")
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y)
+    if x.ndim != 2 or y.shape != (x.shape[0],):
+        raise ValueError(f"x must be (n, d) with y (n,), got {x.shape} "
+                         f"and {y.shape}")
+    if not np.all(np.isin(np.unique(y), (-1, 1))):
+        raise ValueError("nu-SVC labels must be +/-1 (binary); for "
+                         "multiclass data use models.multiclass")
+    n, d = x.shape
+    pos = y > 0
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    # Feasibility (LIBSVM svm_check_parameter): nu*n/2 alphas of size
+    # <= 1 must fit in each class.
+    if nu * n / 2.0 > min(n_pos, n_neg) + 1e-9:
+        raise ValueError(
+            f"nu={nu} is infeasible: nu*n/2 = {nu * n / 2:.1f} exceeds "
+            f"the smaller class ({min(n_pos, n_neg)} examples)")
+
+    half = nu * n / 2.0
+    alpha0 = np.zeros(n, np.float32)
+    for cls in (pos, ~pos):
+        idx = np.nonzero(cls)[0]
+        alpha0[idx] = _nu_head_seed(half, 1.0, len(idx))
+
+    spec = config.kernel_spec(d)
+    yf = np.where(pos, 1.0, -1.0).astype(np.float32)
+    f0 = _stream_kv(x, alpha0 * yf, spec, block=4096)
+
+    config = dataclasses.replace(config, c=1.0, clip="pairwise")
+    result = _solve_nu(x, yf, alpha0, f0, config)
+
+    alpha = np.asarray(result.alpha, np.float32)
+    f = _stream_kv(x, alpha * yf, spec, block=4096)
+    r1, r2 = _class_thresholds(f, yf, alpha, 1.0)
+    r = (r1 + r2) / 2.0
+    if not np.isfinite(r) or r <= 0:
+        raise RuntimeError(f"degenerate nu-SVC solution (r={r}); the "
+                           "problem may be unseparated at this nu/gamma")
+    rho = (r1 - r2) / 2.0
+
+    keep = alpha > 0
+    model = SVMModel(
+        x_sv=np.ascontiguousarray(x[keep]),
+        alpha=(alpha[keep] / np.float32(r)),
+        y_sv=np.where(pos[keep], 1, -1).astype(np.int32),
+        b=float(rho / r),
+        gamma=float(config.resolve_gamma(d)),
+        kernel=config.kernel, coef0=float(config.coef0),
+        degree=int(config.degree))
+    result.b = float(rho / r)
+    result.n_sv = int(keep.sum())
+    return model, result
+
+
+def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
+                config: Optional[SVMConfig] = None
+                ) -> Tuple[SVMModel, TrainResult]:
+    """Fit a nu-SVR (LIBSVM -s 4): the tube width is learned, nu bounds
+    the fraction of points outside it. ``config.c`` is the usual cost;
+    ``config.svr_epsilon`` is ignored (epsilon is a result)."""
+    from dpsvm_tpu.ops.diagnostics import _stream_kv
+
+    config = config or SVMConfig()
+    if not 0.0 < nu <= 1.0:
+        raise ValueError(f"nu must be in (0, 1], got {nu}")
+    x = np.asarray(x, np.float32)
+    z = np.asarray(z, np.float32)
+    n, d = x.shape
+    if z.shape != (n,):
+        raise ValueError(f"targets must be ({n},), got {z.shape}")
+    C = float(config.c)
+
+    # LIBSVM solve_nu_svr seeding: alpha_j = alpha*_j = min(C, rem),
+    # rem from C*nu*n/2.
+    seed = _nu_head_seed(C * nu * n / 2.0, C, n)
+    alpha0 = np.concatenate([seed, seed]).astype(np.float32)
+
+    # Doubled problem (see models/svr.py): rows [x; x], pseudo-labels
+    # [+1; -1]. f = y_i G_i with G = Qa + p, p = [-z; +z]:
+    # f_i = K(a y)_i + y_i p_i = K(a y)_i - z_i  (both halves).
+    x2n = np.concatenate([x, x], axis=0)
+    y_pm = np.concatenate([np.ones(n), -np.ones(n)]).astype(np.float32)
+    spec = config.kernel_spec(d)
+    coef0 = (alpha0 * y_pm)[:n] + (alpha0 * y_pm)[n:]
+    kv = _stream_kv(x, coef0, spec, block=4096)
+    f0 = np.concatenate([kv - z, kv - z]).astype(np.float32)
+
+    config = dataclasses.replace(config, clip="pairwise")
+    result = _solve_nu(x2n, y_pm, alpha0, f0, config)
+
+    a2 = np.asarray(result.alpha, np.float32)
+    delta = a2[:n] - a2[n:]
+    kv = _stream_kv(x, delta, spec, block=4096)
+    f = np.concatenate([kv - z, kv - z]).astype(np.float32)
+    r1, r2 = _class_thresholds(f, y_pm, a2, np.float32(C))
+    # The learned tube half-width -(r1+r2)/2 (LIBSVM's "epsilon = -r",
+    # svm.cpp svm_train for NU_SVR); intercept b = -(r1-r2)/2.
+    eps_eff = -(r1 + r2) / 2.0
+    b = -(r1 - r2) / 2.0
+
+    keep = delta != 0
+    model = SVMModel(
+        x_sv=np.ascontiguousarray(x[keep]),
+        alpha=np.abs(delta[keep]).astype(np.float32),
+        y_sv=np.sign(delta[keep]).astype(np.int32),
+        b=float(-b),      # stored so that sum - b == sum + b_intercept
+        gamma=float(config.resolve_gamma(d)),
+        kernel=config.kernel, coef0=float(config.coef0),
+        degree=int(config.degree), task="svr")
+    result.b = float(b)
+    result.n_sv = int(keep.sum())
+    result.learned_epsilon = float(eps_eff)
+    return model, result

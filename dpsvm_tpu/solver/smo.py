@@ -77,7 +77,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              precision=lax.Precision.HIGHEST,
              packed_select: bool = False,
              pairwise_clip: bool = False,
-             guard_eta: bool = False) -> SMOCarry:
+             guard_eta: bool = False,
+             nu_selection: bool = False) -> SMOCarry:
     """One modified-SMO iteration (select -> eta -> alpha -> f).
 
     ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
@@ -107,7 +108,39 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         c_box = c
         c_of = lambda i: jnp.float32(c)
 
-    if second_order:
+    if nu_selection:
+        # LIBSVM Solver_NU (svm.cpp select_working_set of the NU
+        # variant): two equality constraints (one per class) mean a
+        # working pair must share its label, so the violating pair is
+        # chosen per class and the class with the larger KKT gap wins.
+        # The stopping quantity is max(gap_+, gap_-); it rides the
+        # carry's (b_hi, b_lo) slots as (0, max_gap) so the shared
+        # do-while cond `b_lo > b_hi + 2 eps` applies unchanged — the
+        # nu wrappers (models/nusvm.py) derive the real intercept/rho
+        # from the final state, not from these slots.
+        f_up, f_low, _, _ = masked_scores_and_masks(alpha, y, f, c_box)
+        pos = y > 0
+        fup_p = jnp.where(pos, f_up, jnp.float32(SENTINEL))
+        flo_p = jnp.where(pos, f_low, jnp.float32(-SENTINEL))
+        fup_m = jnp.where(pos, jnp.float32(SENTINEL), f_up)
+        flo_m = jnp.where(pos, jnp.float32(-SENTINEL), f_low)
+        ihp, ilp = jnp.argmin(fup_p), jnp.argmax(flo_p)
+        ihm, ilm = jnp.argmin(fup_m), jnp.argmax(flo_m)
+        gap_p = flo_p[ilp] - fup_p[ihp]
+        gap_m = flo_m[ilm] - fup_m[ihm]
+        use_p = gap_p >= gap_m
+        i_hi = jnp.where(use_p, ihp, ihm)
+        i_lo = jnp.where(use_p, ilp, ilm)
+        b_hi_sel = jnp.where(use_p, fup_p[ihp], fup_m[ihm])
+        b_lo_sel = jnp.where(use_p, flo_p[ilp], flo_m[ilm])
+        rows = jnp.stack([x[i_hi], x[i_lo]])                 # (2, d)
+        dots = jnp.matmul(rows, x.T, precision=precision)    # (2, n)
+        w2 = jnp.stack([x2[i_hi], x2[i_lo]])
+        k = rows_from_dots(dots, w2, x2, kspec)
+        b_hi = b_hi_sel                 # the alpha step's gradient pair
+        b_lo = jnp.maximum(gap_p, gap_m)
+        cache = carry.cache
+    elif second_order:
         f_up, f_low, _, in_low = masked_scores_and_masks(alpha, y, f, c_box)
         i_hi = jnp.argmin(f_up)
         b_hi = f_up[i_hi]
@@ -148,7 +181,7 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         k = rows_from_dots(dots, w2, x2, kspec)                  # (2, n)
 
     eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
-    if second_order or guard_eta:
+    if second_order or guard_eta or nu_selection:
         # WSS2 steers toward small-eta pairs (the selection objective
         # divides by the clamped a_j), so clamp the update denominator
         # the same way LIBSVM does (TAU). ``guard_eta`` applies the same
@@ -172,6 +205,9 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
     alpha = alpha.at[i_hi].set(a_hi_n)
     f = f + (a_hi_n - a_hi) * y_hi * k[0] + (a_lo_n - a_lo) * y_lo * k[1]
 
+    if nu_selection:
+        # Stopping slots carry (0, max class gap), not the step's pair.
+        b_hi = jnp.float32(0.0)
     return SMOCarry(alpha, f, b_hi, b_lo, carry.n_iter + 1, cache)
 
 
@@ -182,7 +218,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                         weights=(1.0, 1.0),
                         packed_select: bool = False,
                         pairwise_clip: bool = False,
-                        guard_eta: bool = False):
+                        guard_eta: bool = False,
+                        nu_selection: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit.
@@ -206,7 +243,8 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                                precision=precision,
                                packed_select=packed_select,
                                pairwise_clip=pairwise_clip,
-                               guard_eta=guard_eta),
+                               guard_eta=guard_eta,
+                               nu_selection=nu_selection),
             carry)
         # Poll stats packed inside the same program: the host reads one
         # (3,) array per chunk instead of three blocking scalars, and no
@@ -221,7 +259,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                         device: Optional[jax.Device] = None,
                         f_init: Optional[np.ndarray] = None,
                         alpha_init: Optional[np.ndarray] = None,
-                        guard_eta: bool = False) -> TrainResult:
+                        guard_eta: bool = False,
+                        nu_selection: bool = False) -> TrainResult:
     """Train on one device. Data arrives as host NumPy, leaves as NumPy.
 
     ``f_init`` / ``alpha_init`` override the classification
@@ -263,7 +302,8 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                   float(config.weight_neg)),
                                  config.select_impl == "packed",
                                  config.clip == "pairwise",
-                                 guard_eta=guard_eta)
+                                 guard_eta=guard_eta,
+                                 nu_selection=nu_selection)
 
     return host_training_loop(
         config, gamma, n, d, carry,

@@ -1,0 +1,160 @@
+"""nu-SVC / nu-SVR (models/nusvm.py, LIBSVM -s 1 and -s 4).
+
+Quality bar: decision-value / prediction parity against sklearn's
+NuSVC/NuSVR (libsvm) at matched hyperparameters, plus the nu-property
+itself (nu lower-bounds the SV fraction, upper-bounds the margin-error
+fraction) and the class-sum invariants the two-constraint solver must
+conserve.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs, make_xor
+from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
+from dpsvm_tpu.models.svm import decision_function, evaluate
+from dpsvm_tpu.models.svr import predict_svr
+
+sklearn_svm = pytest.importorskip("sklearn.svm")
+
+
+@pytest.mark.parametrize("nu", [0.2, 0.5])
+def test_nusvc_decision_parity_blobs(nu):
+    x, y = make_blobs(n=300, d=6, seed=1)
+    ref = sklearn_svm.NuSVC(nu=nu, kernel="rbf", gamma=0.25,
+                            tol=1e-4).fit(x, y)
+    m, r = train_nusvc(x, y, nu, SVMConfig(gamma=0.25, epsilon=5e-5,
+                                           max_iter=200_000))
+    assert r.converged
+    assert abs(m.n_sv - int(ref.n_support_.sum())) <= max(
+        3, 0.02 * ref.n_support_.sum())
+    ours = np.asarray(decision_function(m, x))
+    np.testing.assert_allclose(ours, ref.decision_function(x), atol=5e-3)
+
+
+def test_nusvc_decision_parity_xor():
+    x, y = make_xor(n=240, seed=2)
+    nu = 0.4
+    ref = sklearn_svm.NuSVC(nu=nu, kernel="rbf", gamma=1.0,
+                            tol=1e-4).fit(x, y)
+    m, r = train_nusvc(x, y, nu, SVMConfig(gamma=1.0, epsilon=5e-5,
+                                           max_iter=200_000))
+    assert r.converged
+    ours = np.asarray(decision_function(m, x))
+    np.testing.assert_allclose(ours, ref.decision_function(x), atol=5e-3)
+    assert evaluate(m, x, y) >= 0.95
+
+
+def test_nusvc_nu_property_and_invariants():
+    """nu bounds: SV fraction >= nu; margin errors (alpha at the box)
+    <= nu. The raw dual also keeps each class's alpha mass at nu*n/2
+    (the two equality constraints, conserved by same-class pairwise
+    steps)."""
+    x, y = make_blobs(n=400, d=5, seed=7, separation=1.2)
+    nu = 0.3
+    m, r = train_nusvc(x, y, nu, SVMConfig(gamma=0.3, epsilon=1e-4,
+                                           max_iter=200_000))
+    assert r.converged
+    n = len(y)
+    raw = np.asarray(r.alpha)
+    # class sums: invariant at nu*n/2 each (raw, pre-rescale dual)
+    np.testing.assert_allclose(raw[y > 0].sum(), nu * n / 2, rtol=1e-4)
+    np.testing.assert_allclose(raw[y < 0].sum(), nu * n / 2, rtol=1e-4)
+    assert m.n_sv / n >= nu - 1e-6
+    bounded = np.sum(raw >= 1.0 - 1e-6)
+    assert bounded / n <= nu + 1e-6
+
+
+@pytest.mark.parametrize("nu", [0.3, 0.6])
+def test_nusvr_prediction_parity(nu):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(200, 5)).astype(np.float32)
+    z = (np.sin(x[:, 0]) + 0.5 * x[:, 1]).astype(np.float32)
+    ref = sklearn_svm.NuSVR(nu=nu, C=10.0, kernel="rbf", gamma=0.2,
+                            tol=1e-4).fit(x, z)
+    m, r = train_nusvr(x, z, nu, SVMConfig(c=10.0, gamma=0.2,
+                                           epsilon=5e-5,
+                                           max_iter=400_000))
+    assert r.converged
+    ours = np.asarray(predict_svr(m, x))
+    np.testing.assert_allclose(ours, ref.predict(x), atol=5e-3)
+
+
+def test_nusvr_model_roundtrips_through_test_cli(tmp_path):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(150, 4)).astype(np.float32)
+    z = (x[:, 0] * 0.7 - x[:, 2]).astype(np.float32)
+    train_csv = str(tmp_path / "r.csv")
+    save_csv(train_csv, x, z)
+    model = str(tmp_path / "r.svm")
+    assert main(["train", "-f", train_csv, "-m", model, "--nu-svr",
+                 "--nu", "0.5", "-c", "10", "-q"]) == 0
+    assert main(["test", "-f", train_csv, "-m", model]) == 0
+
+
+def test_nusvc_cli(tmp_path):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y = make_blobs(n=200, d=5, seed=9)
+    train_csv = str(tmp_path / "c.csv")
+    save_csv(train_csv, x, y)
+    model = str(tmp_path / "c.svm")
+    assert main(["train", "-f", train_csv, "-m", model, "--nu-svc",
+                 "--nu", "0.3", "-q"]) == 0
+    assert main(["test", "-f", train_csv, "-m", model]) == 0
+
+
+def test_guard_rails():
+    x, y = make_blobs(n=60, d=4, seed=0)
+    with pytest.raises(ValueError, match="nu must be"):
+        train_nusvc(x, y, 0.0)
+    with pytest.raises(ValueError, match="infeasible"):
+        # all-but-two positive: nu*n/2 can't fit in the minority class
+        y2 = np.ones_like(y)
+        y2[:2] = -1
+        train_nusvc(x, y2, 0.9)
+    with pytest.raises(ValueError, match="labels must be"):
+        train_nusvc(x, np.arange(len(y)), 0.3)
+    with pytest.raises(ValueError, match="does not support shards"):
+        train_nusvc(x, y, 0.3, SVMConfig(shards=2))
+    with pytest.raises(ValueError, match="does not support working_set"):
+        train_nusvc(x, y, 0.3, SVMConfig(working_set=16))
+    rng = np.random.default_rng(1)
+    with pytest.raises(ValueError, match="targets must be"):
+        train_nusvr(x, np.zeros((3,)), 0.5)
+
+
+def test_learned_epsilon_reported():
+    """nu-SVR's tube width is a RESULT (LIBSVM -s 4 prints it); larger
+    nu admits more outside-tube points => narrower tube."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(200, 5)).astype(np.float32)
+    z = (np.sin(x[:, 0]) + 0.5 * x[:, 1]
+         + 0.1 * rng.normal(size=200)).astype(np.float32)
+    eps_at = {}
+    for nu in (0.2, 0.7):
+        _, r = train_nusvr(x, z, nu, SVMConfig(c=10.0, gamma=0.2,
+                                               epsilon=1e-4,
+                                               max_iter=400_000))
+        assert r.converged
+        assert r.learned_epsilon is not None and r.learned_epsilon > 0
+        eps_at[nu] = r.learned_epsilon
+    assert eps_at[0.7] < eps_at[0.2]
+
+
+def test_nusvr_rejects_class_weights_and_checkpoints(tmp_path):
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(60, 4)).astype(np.float32)
+    z = x[:, 0].astype(np.float32)
+    with pytest.raises(ValueError, match="weight"):
+        train_nusvr(x, z, 0.5, SVMConfig(weight_pos=2.0))
+    with pytest.raises(ValueError, match="resume_from"):
+        train_nusvr(x, z, 0.5,
+                    SVMConfig(resume_from=str(tmp_path / "c.npz")))
