@@ -32,6 +32,7 @@ Public API
 ``DPSVMRegressor``                 epsilon-SVR facade (models/svr.py)
 ``train_svr`` / ``predict_svr``    epsilon-SVR (LIBSVM -s 3)
 ``train_oneclass`` / ``predict_oneclass``  one-class SVM (LIBSVM -s 2)
+``train_nusvc`` / ``train_nusvr``  nu-SVM family (LIBSVM -s 1 / -s 4)
 ``cross_validate``                 k-fold CV (LIBSVM -v)
 ``warm_start``                     continue training from a previous alpha
 """
@@ -44,6 +45,7 @@ from dpsvm_tpu.api import train, fit, warm_start
 from dpsvm_tpu.models.svr import train_svr, predict_svr, evaluate_svr
 from dpsvm_tpu.models.oneclass import (train_oneclass, predict_oneclass,
                                        score_oneclass)
+from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
 from dpsvm_tpu.models.cv import cross_validate
 
 __version__ = "0.1.0"
@@ -68,5 +70,7 @@ __all__ = [
     "train_oneclass",
     "predict_oneclass",
     "score_oneclass",
+    "train_nusvc",
+    "train_nusvr",
     "cross_validate",
 ]
