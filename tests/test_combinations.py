@@ -178,3 +178,23 @@ def test_oneclass_with_shrinking():
                                            chunk_iters=128))
     assert r.converged
     assert abs(float(np.mean(predict_oneclass(m, x) < 0)) - 0.2) < 0.06
+
+
+def test_multiclass_with_decomposition_and_shrinking():
+    """One-vs-one multiclass drives api.train per pair, so the new
+    solver paths must ride through unchanged."""
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
+                                             train_multiclass)
+
+    rng = np.random.default_rng(2)
+    x, y0 = make_blobs(n=240, d=6, seed=2)
+    lab = np.where(y0 > 0, 2, 0)
+    lab[rng.random(240) < 0.3] = 1
+    for kw in (dict(working_set=16), dict(shrinking=True,
+                                          chunk_iters=128)):
+        mc, results = train_multiclass(
+            x, lab, SVMConfig(c=5.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=100_000, **kw))
+        assert all(r.converged for r in results)
+        assert evaluate_multiclass(mc, x, lab) >= 0.85, kw
