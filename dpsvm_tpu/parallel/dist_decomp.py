@@ -171,7 +171,9 @@ def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
         step_cap=step_cap, pairwise_clip=pairwise_clip,
         seed_transform=lambda s: jax.tree.map(_to_varying, s))
 
-    # --- rank-q application, shard-local ------------------------------
+    # --- rank-q application, shard-local (the (q, n_s) fetch sits
+    # after the subsolve so its epilogue fuses into the weighted
+    # row-sum — see solver/decomp.py) ----------------------------------
     dalpha = jnp.where(active, inner.a - a_w0, 0.0)
     own = active & (wi // n_per_shard == rank)
     loc = jnp.clip(wi - rank * n_per_shard, 0, n_per_shard - 1)
