@@ -273,6 +273,13 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
     # the global picture is stale (measured, CI scale: q=512 cap=2048
     # needs 20.7k inner steps to converge what cap=64 does in 7.0k; the
     # MNIST shape with cap=4q stalls entirely at the 2M budget).
+    # Tuning guide (20000x128 planted, f32; pair-SMO baseline = 50k
+    # iterations): total inner pair-updates to convergence scale with
+    # BOTH knobs — q=1024: cap 32/64/128/256 -> 60k/98k/161k/219k;
+    # q=2048 cap 64 -> 66k; q=4096 cap 128 -> 45k (BELOW the pair
+    # count). Large blocks with short subsolves buy step quality;
+    # rounds (each one (q,d)@(d,n) pass) grow as total/cap — pick the
+    # trade for the hardware's round cost.
     inner_cap = int(config.inner_iters) or max(32, q // 4)
     gamma = float(config.resolve_gamma(d))
     kspec = config.kernel_spec(d)
