@@ -75,6 +75,7 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                  tol: float = 1e-3, max_iter: int = 150_000,
                  selection: str = "first-order", shards: int = 1,
                  matmul_precision: str = "highest",
+                 working_set: int = 2, shrinking: bool = False,
                  probability: bool = False):
         self.C = C
         self.kernel = kernel
@@ -86,11 +87,13 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         self.selection = selection
         self.shards = shards
         self.matmul_precision = matmul_precision
+        self.working_set = working_set
+        self.shrinking = shrinking
         self.probability = probability
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "tol",
                     "max_iter", "selection", "shards", "matmul_precision",
-                    "probability")
+                    "working_set", "shrinking", "probability")
     _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
@@ -99,6 +102,8 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                          epsilon=self.tol,
                          max_iter=self.max_iter, selection=self.selection,
                          shards=self.shards,
+                         working_set=self.working_set,
+                         shrinking=self.shrinking,
                          matmul_precision=self.matmul_precision)
 
     # --- sklearn protocol: fit/predict/score ---
@@ -194,7 +199,8 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
                  coef0: float = 0.0, epsilon: float = 0.1,
                  tol: float = 1e-3, max_iter: int = 150_000,
                  selection: str = "first-order", shards: int = 1,
-                 matmul_precision: str = "highest"):
+                 matmul_precision: str = "highest",
+                 working_set: int = 2, shrinking: bool = False):
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -206,10 +212,12 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
         self.selection = selection
         self.shards = shards
         self.matmul_precision = matmul_precision
+        self.working_set = working_set
+        self.shrinking = shrinking
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "epsilon",
                     "tol", "max_iter", "selection", "shards",
-                    "matmul_precision")
+                    "matmul_precision", "working_set", "shrinking")
 
     def _config(self) -> SVMConfig:
         return SVMConfig(c=self.C, kernel=self.kernel, degree=self.degree,
@@ -217,6 +225,8 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
                          epsilon=self.tol, svr_epsilon=self.epsilon,
                          max_iter=self.max_iter, selection=self.selection,
                          shards=self.shards,
+                         working_set=self.working_set,
+                         shrinking=self.shrinking,
                          matmul_precision=self.matmul_precision)
 
     def fit(self, X, y) -> "DPSVMRegressor":

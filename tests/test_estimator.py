@@ -108,3 +108,19 @@ def test_refit_without_probability_clears_calibration():
     clf.fit(x, y)
     with pytest.raises(RuntimeError, match="probability=True"):
         clf.predict_proba(x)
+
+
+def test_estimator_new_solver_knobs():
+    """working_set / shrinking ride the sklearn facade (get/set_params
+    roundtrip + a fit through each path)."""
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.models.estimator import DPSVMClassifier
+
+    x, y = make_blobs(n=200, d=5, seed=3)
+    clf = DPSVMClassifier(C=5.0, gamma=0.5, working_set=16)
+    assert clf.get_params()["working_set"] == 16
+    clf.set_params(working_set=2, shrinking=True)
+    clf.fit(x, y)
+    assert clf.score(x, y) >= 0.95
+    clf2 = DPSVMClassifier(C=5.0, gamma=0.5, working_set=16).fit(x, y)
+    assert clf2.score(x, y) >= 0.95
