@@ -45,7 +45,8 @@ from dpsvm_tpu.ops.kernels import KernelSpec, rows_from_dots
 from dpsvm_tpu.ops.selection import masked_scores_and_masks
 from dpsvm_tpu.parallel.dist_smo import (_local_slice,
                                          prepare_distributed_inputs)
-from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
+                                     to_host)
 from dpsvm_tpu.solver.decomp import inner_subsolve
 from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
                                      resume_state)
@@ -280,7 +281,7 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
     return host_training_loop(
         config, gamma, n, d, carry,
         step_chunk=step_chunk,
-        carry_to_host=lambda cr: (np.asarray(cr.alpha)[:n],
-                                  np.asarray(cr.f)[:n]),
+        carry_to_host=lambda cr: (to_host(cr.alpha)[:n],
+                                  to_host(cr.f)[:n]),
         it0=int(init[4]),
     )

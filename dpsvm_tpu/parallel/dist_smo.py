@@ -48,7 +48,8 @@ from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
 from dpsvm_tpu.ops.update import alpha_pair_step
-from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
+                                     to_host)
 from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
                                      resume_state)
 
@@ -525,7 +526,7 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     return host_training_loop(
         config, gamma, n, d, carry,
         step_chunk=step_chunk,
-        carry_to_host=lambda c: (np.asarray(c.alpha)[:n],
-                                 np.asarray(c.f)[:n]),
+        carry_to_host=lambda c: (to_host(c.alpha)[:n],
+                                 to_host(c.f)[:n]),
         it0=int(init[4]),
     )

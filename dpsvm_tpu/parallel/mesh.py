@@ -19,6 +19,24 @@ import jax
 SHARD_AXIS = "shard"
 
 
+def to_host(arr) -> "object":
+    """Global-array -> host NumPy, multi-host-safe.
+
+    On one process ``np.asarray`` suffices. Under a multi-process
+    runtime a sharded global array is not fully addressable — each host
+    holds only its shard — so the full array is assembled with an
+    all-gather across processes (the standard jax multihost_utils
+    path). Both distributed trainers funnel their final (alpha, f)
+    read-back through here."""
+    import numpy as np
+
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 def make_data_mesh(shards: int,
                    devices: Optional[Sequence[jax.Device]] = None
                    ) -> jax.sharding.Mesh:

@@ -9,10 +9,12 @@ fresh Python processes on localhost, each joins the same coordinator via
 end-to-end proof that the wrapper creates a working multi-process
 runtime (SURVEY §5 "distributed communication backend").
 
-Kept deliberately small: multi-process startup + one collective, not a
-full training run (the SPMD solver itself is covered on the 8-device
-single-process mesh in test_distributed.py; under multi-process JAX it
-is the same compiled program).
+Round 3 upgraded it from "startup + one collective" to a REAL
+multi-process training run: the same SPMD solver program executes over
+the 2-process global mesh (global device_put of host data, in-program
+cross-process collectives, the multihost to_host() all-gather
+read-back) and must reproduce the single-device trajectory on the same
+data — the full MPI-cluster-equivalent path, on localhost.
 """
 
 from __future__ import annotations
@@ -66,6 +68,32 @@ summed = jax.jit(shard_map(body, mesh=mesh, in_specs=P("p"),
                            out_specs=P("p")))(arr)
 got = float(summed.addressable_data(0)[0])   # this process's shard
 assert got == 3.0, got
+
+# REAL multi-process training: the same SPMD solver program over the
+# 2-process global mesh (one device per host, like one TPU host each),
+# checked against a local single-device run on the same data. Exercises
+# the global device_put of host data, the in-program cross-process
+# collectives, and the multihost to_host() read-back path.
+import numpy as np
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.parallel.dist_smo import train_distributed
+from dpsvm_tpu.parallel.mesh import SHARD_AXIS
+from dpsvm_tpu.solver.smo import train_single_device
+
+x, y = make_blobs(n=64, d=6, seed=5)
+cfg = SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=5000,
+                shards=2, shard_x=True, chunk_iters=128)
+tmesh = Mesh(jax.devices(), (SHARD_AXIS,))
+dist = train_distributed(x, y, cfg, mesh=tmesh)
+single = train_single_device(
+    x, y, SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=5000))
+assert dist.converged and single.converged
+assert dist.n_iter == single.n_iter, (dist.n_iter, single.n_iter)
+np.testing.assert_allclose(np.asarray(dist.alpha),
+                           np.asarray(single.alpha),
+                           rtol=1e-4, atol=1e-5)
+print(f"RANK{rank}_TRAIN_OK", flush=True)
 print(f"RANK{rank}_OK", flush=True)
 """
 
@@ -104,4 +132,5 @@ def test_two_process_initialize_and_psum(tmp_path):
                 p.kill()
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"RANK{rank}_TRAIN_OK" in out, out
         assert f"RANK{rank}_OK" in out, out
