@@ -124,7 +124,11 @@ def test_two_process_initialize_and_psum(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            # Sized for the grown workload: two fresh interpreters each
+            # jax-import, XLA-compile the shard_map training loop, and
+            # run both training jobs (measured ~23 s warm; loaded CI
+            # hosts need slack).
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     finally:
         for p in procs:
