@@ -104,3 +104,22 @@ def test_breast_cancer_oracle_trajectory(breast_cancer_scaled):
     assert abs(xla.b - ref.b) <= 1e-3
     np.testing.assert_allclose(np.asarray(xla.alpha),
                                np.asarray(ref.alpha), rtol=0, atol=2e-3)
+
+
+def test_digits_nusvc_parity(digits_odd_even):
+    """nu-SVC on real data vs sklearn's NuSVC (libsvm)."""
+    sklearn_svm = pytest.importorskip("sklearn.svm")
+    from dpsvm_tpu.models.nusvm import train_nusvc
+    from dpsvm_tpu.models.svm import decision_function
+
+    x, y = digits_odd_even
+    nu = 0.1
+    ref = sklearn_svm.NuSVC(nu=nu, kernel="rbf", gamma=0.125,
+                            tol=1e-4).fit(x, y)
+    m, r = train_nusvc(x, y, nu, SVMConfig(gamma=0.125, epsilon=5e-5,
+                                           max_iter=400_000))
+    assert r.converged
+    assert abs(m.n_sv - int(ref.n_support_.sum())) <= max(
+        3, 0.02 * ref.n_support_.sum())
+    ours = np.asarray(decision_function(m, x))
+    np.testing.assert_allclose(ours, ref.decision_function(x), atol=1e-2)
