@@ -49,6 +49,12 @@ from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
 from dpsvm_tpu.solver.driver import _read_stats
 from dpsvm_tpu.utils.logging import log_progress
 
+# Ceiling on iterations between shrink-rule evaluations (each pulls
+# alpha+f to the host); the cadence is min(n, this) per run — LIBSVM's
+# is min(n, 1000), but a D2H pull is ~ms-scale on a tunneled
+# accelerator where LIBSVM's is a pointer read.
+SHRINK_CHECK_ITERS = 4096
+
 
 def _host_extrema(alpha, y, f, c_box):
     """(b_hi, b_lo) from host arrays — the full-problem optimality check
@@ -206,6 +212,7 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
     active = np.arange(n)
     xa, ya, x2a, carry = make_active(active)
     it = 0
+    last_check = 0
     while True:
         limit = np.int32(min(it + chunk, config.max_iter))
         carry, stats = runner(carry, xa, ya, x2a, limit)
@@ -244,10 +251,15 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
                                    b_lo=np.float32(b_lo))
             continue
 
-        # Mid-training shrink check at the chunk boundary (LIBSVM
-        # checks every min(n,1000) iterations; our chunk is the poll
-        # cadence). Compact only when the active set halves — each
-        # distinct active size is its own XLA program.
+        # Mid-training shrink check (LIBSVM checks every min(n,1000)
+        # iterations). Each check pulls (alpha, f) — two D2H transfers
+        # whose round-trip costs ~65-100 ms on a tunneled TPU — so it
+        # runs at most every SHRINK_CHECK_ITERS iterations, not at
+        # every small chunk poll. Compact only when the active set
+        # halves — each distinct active size is its own XLA program.
+        if it - last_check < min(SHRINK_CHECK_ITERS, n):
+            continue
+        last_check = it
         a_act = np.asarray(carry.alpha)
         f_act = np.asarray(carry.f)
         shrink = _shrinkable(a_act, y_np[active], f_act, c_box[active],
