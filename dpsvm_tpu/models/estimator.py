@@ -59,6 +59,17 @@ class _ParamsMixin:
             raise RuntimeError(f"this {type(self).__name__} is not "
                                "fitted yet; call fit(X, y) first")
 
+    def _common_config_kwargs(self) -> Dict[str, Any]:
+        """The SVMConfig fields shared by both estimators (sklearn's
+        explicit-constructor convention forces the __init__ duplication;
+        the config mapping need exist only once)."""
+        return dict(c=self.C, kernel=self.kernel, degree=self.degree,
+                    gamma=self.gamma, coef0=self.coef0, epsilon=self.tol,
+                    max_iter=self.max_iter, selection=self.selection,
+                    shards=self.shards, working_set=self.working_set,
+                    shrinking=self.shrinking,
+                    matmul_precision=self.matmul_precision)
+
 
 class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
     """SVM classifier on the modified-SMO TPU solver (LIBSVM kernel family).
@@ -97,14 +108,7 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
     _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
-        return SVMConfig(c=self.C, kernel=self.kernel, degree=self.degree,
-                         gamma=self.gamma, coef0=self.coef0,
-                         epsilon=self.tol,
-                         max_iter=self.max_iter, selection=self.selection,
-                         shards=self.shards,
-                         working_set=self.working_set,
-                         shrinking=self.shrinking,
-                         matmul_precision=self.matmul_precision)
+        return SVMConfig(**self._common_config_kwargs())
 
     # --- sklearn protocol: fit/predict/score ---
 
@@ -220,14 +224,8 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
                     "matmul_precision", "working_set", "shrinking")
 
     def _config(self) -> SVMConfig:
-        return SVMConfig(c=self.C, kernel=self.kernel, degree=self.degree,
-                         gamma=self.gamma, coef0=self.coef0,
-                         epsilon=self.tol, svr_epsilon=self.epsilon,
-                         max_iter=self.max_iter, selection=self.selection,
-                         shards=self.shards,
-                         working_set=self.working_set,
-                         shrinking=self.shrinking,
-                         matmul_precision=self.matmul_precision)
+        return SVMConfig(svr_epsilon=self.epsilon,
+                         **self._common_config_kwargs())
 
     def fit(self, X, y) -> "DPSVMRegressor":
         from dpsvm_tpu.models.svr import train_svr

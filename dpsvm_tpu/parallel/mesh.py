@@ -15,11 +15,12 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import numpy as np
 
 SHARD_AXIS = "shard"
 
 
-def to_host(arr) -> "object":
+def to_host(arr) -> np.ndarray:
     """Global-array -> host NumPy, multi-host-safe.
 
     On one process ``np.asarray`` suffices. Under a multi-process
@@ -28,8 +29,6 @@ def to_host(arr) -> "object":
     all-gather across processes (the standard jax multihost_utils
     path). Both distributed trainers funnel their final (alpha, f)
     read-back through here."""
-    import numpy as np
-
     if getattr(arr, "is_fully_addressable", True):
         return np.asarray(arr)
     from jax.experimental import multihost_utils
