@@ -224,7 +224,10 @@ class SVMConfig:
         if self.select_impl != "argminmax":
             # Reject every path that would silently ignore the flag, so
             # an A/B run can't attribute default-lowering numbers to it.
-            if self.use_pallas == "on":
+            # (working_set > 2 rejects 'packed' on its own below, with
+            # its own message — use_pallas='on' means the inner-subsolve
+            # kernel there, not the fused 2-violator one.)
+            if self.use_pallas == "on" and self.working_set == 2:
                 raise ValueError("the fused Pallas kernel has its own "
                                  "in-kernel selection; select_impl does "
                                  "not apply (use_pallas='on')")
@@ -237,7 +240,10 @@ class SVMConfig:
                 raise ValueError("second-order selection needs the hi row "
                                  "before the lo index is known; the pair "
                                  "row-cache does not apply (cache_size=0)")
-            if self.use_pallas == "on":
+            if self.use_pallas == "on" and self.working_set == 2:
+                # (With working_set > 2 the combination is rejected by
+                # the working_set guard table — selection must be
+                # first-order there — with the right message.)
                 raise ValueError("the fused Pallas kernel implements "
                                  "first-order selection only")
             if self.select_impl != "argminmax":
@@ -252,13 +258,16 @@ class SVMConfig:
                                  f"got {self.working_set}")
             # Reject every path that would silently ignore q, so results
             # can't be misattributed (same policy as select_impl).
+            # (use_pallas='on' IS meaningful here: it selects the
+            # Pallas inner-subsolve kernel, ops/subsolve_kernel.py.)
             for field, bad, what in (
                     ("selection", self.selection != "first-order",
-                     "the decomposition subsolve is first-order"),
+                     "the decomposition subsolve is WSS2 internally"),
                     ("cache_size", self.cache_size > 0,
                      "the block fetch replaces the pair row-cache"),
-                    ("use_pallas", self.use_pallas == "on",
-                     "the fused Pallas kernel is the 2-violator shape"),
+                    ("use_pallas+shards",
+                     self.use_pallas == "on" and self.shards > 1,
+                     "the Pallas inner subsolve is single-device today"),
                     ("select_impl", self.select_impl != "argminmax",
                      "outer selection is top_k, not packed extrema"),
                     ("backend", self.backend == "numpy",
@@ -279,9 +288,11 @@ class SVMConfig:
                     ("cache_size", self.cache_size > 0,
                      "cached row indices would dangle across "
                      "compactions"),
-                    ("use_pallas", self.use_pallas == "on",
-                     "the fused kernel hard-codes the full-problem "
-                     "init"),
+                    ("use_pallas",
+                     self.use_pallas == "on" and self.working_set == 2,
+                     "the 2-violator fused kernel hard-codes the "
+                     "full-problem init (the decomposition's inner "
+                     "kernel composes fine)"),
                     ("checkpoint_path", bool(self.checkpoint_path),
                      "checkpoint/resume does not capture active-set "
                      "state"),
@@ -302,7 +313,11 @@ class SVMConfig:
         if self.use_pallas not in ("auto", "on", "off"):
             raise ValueError(f"use_pallas must be 'auto', 'on' or 'off', "
                              f"got {self.use_pallas!r}")
-        if self.use_pallas == "on" and self.fused_incompatibility():
+        if (self.use_pallas == "on" and self.working_set == 2
+                and self.fused_incompatibility()):
+            # With working_set > 2, use_pallas='on' selects the
+            # decomposition's inner-subsolve kernel instead (validated
+            # by the working_set guard table above).
             raise ValueError("the fused Pallas kernel does not support "
                              f"{self.fused_incompatibility()}; use "
                              "use_pallas='auto' or 'off'")

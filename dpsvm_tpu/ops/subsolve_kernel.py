@@ -1,0 +1,159 @@
+"""The decomposition inner subsolve as ONE Pallas TPU kernel.
+
+The XLA inner loop (solver/decomp.py inner_subsolve) pays per-step op
+dispatch: each WSS2 pair update lowers to several unfusable HLO groups
+(reductions, gathers, scatters) costing ~22 us of fixed latency per
+step regardless of q. This kernel runs the WHOLE capped subsolve —
+up to ``max_cap`` pair updates — inside a single kernel launch: the
+(q, q) block, the alphas and the subproblem gradient live in VMEM for
+the entire loop, and a step is pure VPU work (masked extrema, one-hot
+scalar selects, two dynamic row loads, an AXPY), so the per-step cost
+is the arithmetic, not the dispatch.
+
+Design notes:
+  * scalar gathers (f[i], y[i], c[i], eta entries) are one-hot
+    multiply-reduces over (q,) vectors — no dynamic scalar indexing,
+    which TPU vector memory dislikes;
+  * the two kernel-block rows are ``pl.ds`` dynamic-start row loads
+    from the VMEM-resident block (supported on the sublane dimension);
+    the diagonal is extracted once before the loop;
+  * the loop is a ``lax.fori_loop`` to the COMPILE-TIME cap with a
+    ``live`` flag (a converged or budget-capped subsolve keeps the
+    state fixed); the dynamic remaining-budget cap rides in as a
+    scalar and folds into ``live``. Entry extrema seed the stopping
+    state exactly like the XLA path (an already-optimal block must
+    no-op).
+
+Off-TPU the kernel runs in Pallas interpret mode (the CPU test suite's
+path — tests/test_subsolve_kernel.py asserts it walks the XLA
+inner_subsolve's trajectory).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from dpsvm_tpu.ops.selection import masked_scores_and_masks
+from dpsvm_tpu.ops.update import alpha_pair_step
+
+
+def _subsolve_kernel(scal_ref, cap_ref, kww_ref, y_ref, c_ref, act_ref,
+                     a_ref, f_ref, aout_ref, fout_ref, stats_ref, *,
+                     q: int, max_cap: int, pairwise: bool):
+    eps = scal_ref[0]
+    step_cap = cap_ref[0]
+
+    yv = y_ref[0]
+    cv = c_ref[0]
+    act = act_ref[0] != 0.0
+    iota = lax.broadcasted_iota(jnp.int32, (q,), 0)
+    # Diagonal K_jj, extracted once (O(q^2), outside the loop).
+    ii = lax.broadcasted_iota(jnp.int32, (q, q), 0)
+    jj = lax.broadcasted_iota(jnp.int32, (q, q), 1)
+    kjj = jnp.sum(jnp.where(ii == jj, kww_ref[...], 0.0), axis=1)
+
+    def row(idx):
+        return kww_ref[pl.ds(idx, 1), :][0]
+
+    def pick(vec, idx):
+        """vec[idx] without dynamic indexing: one-hot reduce."""
+        return jnp.sum(jnp.where(iota == idx, vec, 0.0))
+
+    def body(_, state):
+        a, f, bh, bl, t, live = state
+        # Gate on the PREVIOUS step's stored gap, exactly like the XLA
+        # while_loop's cond (checked before the body): the body whose
+        # fresh selection first satisfies the gap still applies its
+        # trailing update. Gating on the fresh gap would run one fewer
+        # step and diverge from inner_subsolve's trajectory.
+        live = live & (bl > bh + 2.0 * eps) & (t < step_cap)
+        fu, fl, _, in_low = masked_scores_and_masks(a, yv, f, cv,
+                                                    valid=act)
+        i_hi = jnp.argmin(fu).astype(jnp.int32)
+        bh_t = jnp.min(fu)
+        bl_t = jnp.max(fl)
+
+        row_hi = row(i_hi)
+        k_hh = pick(kjj, i_hi)
+        # WSS2 partner: maximize (fl - bh)^2 / (K_ii + K_jj - 2 K_ij).
+        bb = fl - bh_t
+        aa = jnp.maximum(k_hh + kjj - 2.0 * row_hi, 1e-12)
+        obj = jnp.where(in_low & (bb > 0), bb * bb / aa, -1.0)
+        i_lo = jnp.argmax(obj).astype(jnp.int32)
+        bl_sel = pick(fl, i_lo)
+
+        row_lo = row(i_lo)
+        k_ll = pick(kjj, i_lo)
+        k_hl = pick(row_hi, i_lo)
+        eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, 1e-12)
+
+        y_hi, y_lo = pick(yv, i_hi), pick(yv, i_lo)
+        a_hi, a_lo = pick(a, i_hi), pick(a, i_lo)
+        c_hi, c_lo = pick(cv, i_hi), pick(cv, i_lo)
+        a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, bh_t,
+                                         bl_sel, eta, c_hi, c_lo,
+                                         pairwise)
+        # lo-then-hi one-hot writes (the i_hi == i_lo corner keeps the
+        # hi value, matching the XLA path's .at[] write order).
+        a_new = jnp.where(iota == i_lo, a_lo_n, a)
+        a_new = jnp.where(iota == i_hi, a_hi_n, a_new)
+        f_new = (f + (a_hi_n - a_hi) * y_hi * row_hi
+                 + (a_lo_n - a_lo) * y_lo * row_lo)
+
+        a = jnp.where(live, a_new, a)
+        f = jnp.where(live, f_new, f)
+        bh = jnp.where(live, bh_t, bh)
+        bl = jnp.where(live, bl_t, bl)
+        t = t + jnp.where(live, 1, 0).astype(jnp.int32)
+        return a, f, bh, bl, t, live
+
+    # Entry extrema seed the stopping state (already-optimal block =>
+    # the very first `live` is False and the loop is a no-op).
+    a0 = a_ref[0]
+    f0 = f_ref[0]
+    fu0, fl0, _, _ = masked_scores_and_masks(a0, yv, f0, cv, valid=act)
+    init = (a0, f0, jnp.min(fu0), jnp.max(fl0), jnp.int32(0), True)
+    a, f, bh, bl, t, _ = lax.fori_loop(0, max_cap, body, init)
+
+    aout_ref[0] = a
+    fout_ref[0] = f
+    stats_ref[0] = bh
+    stats_ref[1] = bl
+    stats_ref[2] = t.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("max_cap", "pairwise",
+                                             "interpret"))
+def pallas_inner_subsolve(k_ww, y_w, c_w, a_w0, f_w0, active, epsilon,
+                          step_cap, *, max_cap: int, pairwise: bool,
+                          interpret: bool = False):
+    """Run the capped WSS2 subsolve in one kernel launch.
+
+    Same contract as solver/decomp.inner_subsolve: returns
+    (a, f, b_hi, b_lo, t). ``max_cap`` is the static loop bound (the
+    config's inner cap); ``step_cap`` the dynamic remaining budget.
+    """
+    q = k_ww.shape[0]
+    scal = jnp.stack([jnp.float32(epsilon)])
+    cap = jnp.reshape(jnp.asarray(step_cap, jnp.int32), (1,))
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, q), jnp.float32),    # a
+        jax.ShapeDtypeStruct((1, q), jnp.float32),    # f
+        jax.ShapeDtypeStruct((3,), jnp.float32),      # b_hi, b_lo, t
+    )
+    a, f, stats = pl.pallas_call(
+        functools.partial(_subsolve_kernel, q=q, max_cap=max_cap,
+                          pairwise=pairwise),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(scal, cap, k_ww,
+      y_w[None, :], c_w[None, :],
+      active.astype(jnp.float32)[None, :],
+      a_w0[None, :], f_w0[None, :])
+    return (a[0], f[0], stats[0], stats[1],
+            stats[2].astype(jnp.int32))

@@ -156,10 +156,15 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
                 q: int, inner_cap: int, epsilon: float,
                 limit=None, weights=(1.0, 1.0),
                 precision=lax.Precision.HIGHEST,
-                pairwise_clip: bool = False) -> DecompCarry:
+                pairwise_clip: bool = False,
+                pallas_inner: bool = False,
+                interpret: bool = False) -> DecompCarry:
     """One outer decomposition round (select-q -> block -> subsolve ->
     rank-q update). ``limit`` (traced) caps the round's inner steps so
-    ``n_iter`` stops exactly at the budget like every other solver."""
+    ``n_iter`` stops exactly at the budget like every other solver.
+    ``pallas_inner`` runs the subsolve as one Pallas kernel launch
+    (ops/subsolve_kernel.py) instead of the XLA while_loop — same math,
+    bitwise-equal in interpret-mode tests."""
     alpha, f = carry.alpha, carry.f
     wp, wn = weights
     if wp != 1.0 or wn != 1.0:
@@ -210,9 +215,17 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     step_cap = jnp.int32(inner_cap)
     if limit is not None:
         step_cap = jnp.minimum(step_cap, limit - carry.n_iter)
-    inner = inner_subsolve(k_ww, y_w, c_w, a_w0, f_w0, active,
-                           epsilon=epsilon, step_cap=step_cap,
-                           pairwise_clip=pairwise_clip)
+    if pallas_inner:
+        from dpsvm_tpu.ops.subsolve_kernel import pallas_inner_subsolve
+        a_in, f_in, bh_in, bl_in, t_in = pallas_inner_subsolve(
+            k_ww, y_w, c_w, a_w0, f_w0, active, epsilon, step_cap,
+            max_cap=inner_cap, pairwise=pairwise_clip,
+            interpret=interpret)
+        inner = _InnerState(a_in, f_in, bh_in, bl_in, t_in)
+    else:
+        inner = inner_subsolve(k_ww, y_w, c_w, a_w0, f_w0, active,
+                               epsilon=epsilon, step_cap=step_cap,
+                               pairwise_clip=pairwise_clip)
 
     # --- rank-q application: the ONE (q, d) @ (d, n) MXU pass ----------
     # Deliberately AFTER the subsolve: the (q, n) block is consumed only
@@ -234,9 +247,16 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
 @functools.lru_cache(maxsize=32)
 def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
                          inner_cap: int, precision_name: str,
-                         weights=(1.0, 1.0), pairwise_clip: bool = False):
+                         weights=(1.0, 1.0), pairwise_clip: bool = False,
+                         pallas_inner: bool = False):
     """Compiled chunk runner with the decomposition outer loop inside;
-    same contract as smo._build_chunk_runner."""
+    same contract as smo._build_chunk_runner. The interpret-mode policy
+    for the Pallas inner kernel is resolved HERE (off-TPU backends run
+    it interpreted, the CPU test suite's path) so every call site shares
+    one policy."""
+    from dpsvm_tpu.solver.fused import _should_interpret
+
+    interpret = _should_interpret() if pallas_inner else False
     precision = getattr(lax.Precision, precision_name)
     kspec = KernelSpec.coerce(kspec)
 
@@ -247,7 +267,9 @@ def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
                                   inner_cap=inner_cap, epsilon=epsilon,
                                   limit=limit, weights=weights,
                                   precision=precision,
-                                  pairwise_clip=pairwise_clip),
+                                  pairwise_clip=pairwise_clip,
+                                  pallas_inner=pallas_inner,
+                                  interpret=interpret),
             carry)
         return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
 
@@ -307,7 +329,8 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
                                   config.matmul_precision.upper(),
                                   (float(config.weight_pos),
                                    float(config.weight_neg)),
-                                  config.clip == "pairwise")
+                                  config.clip == "pairwise",
+                                  pallas_inner=config.use_pallas == "on")
 
     return host_training_loop(
         config, gamma, n, d, carry,

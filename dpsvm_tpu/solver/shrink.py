@@ -178,7 +178,8 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
             int(config.inner_iters) or max(32, q // 4),
             config.matmul_precision.upper(),
             (float(config.weight_pos), float(config.weight_neg)),
-            config.clip == "pairwise")
+            config.clip == "pairwise",
+            pallas_inner=config.use_pallas == "on")
     else:
         from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
         runner = _build_chunk_runner(
