@@ -268,6 +268,10 @@ class SVMConfig:
                     ("use_pallas+shards",
                      self.use_pallas == "on" and self.shards > 1,
                      "the Pallas inner subsolve is single-device today"),
+                    ("use_pallas+working_set",
+                     self.use_pallas == "on" and self.working_set > 2048,
+                     "the inner-subsolve kernel keeps the (q, q) f32 "
+                     "block VMEM-resident; q caps at 2048 (16 MB)"),
                     ("select_impl", self.select_impl != "argminmax",
                      "outer selection is top_k, not packed extrema"),
                     ("backend", self.backend == "numpy",

@@ -130,3 +130,9 @@ def test_misattribution_guards_name_the_right_kernel():
     with pytest.raises(ValueError, match="working_set > 2"):
         SVMConfig(working_set=32, use_pallas="on",
                   select_impl="packed").validate()
+
+
+def test_vmem_cap_guard():
+    with pytest.raises(ValueError, match="2048"):
+        SVMConfig(working_set=4096, use_pallas="on").validate()
+    SVMConfig(working_set=2048, use_pallas="on").validate()
