@@ -31,6 +31,8 @@ Environment:
     BENCH_INNER_ITERS decomposition inner-step cap (0 = auto q/4)
     BENCH_SHRINKING   1 = LIBSVM-style active-set training
                       (solver/shrink.py; composes with the above)
+    BENCH_PALLAS      auto (default) | on — 'on' with BENCH_WORKING_SET
+                      selects the Pallas inner-subsolve kernel
 """
 
 from __future__ import annotations
@@ -86,10 +88,12 @@ def main() -> None:
     working_set = int(os.environ.get("BENCH_WORKING_SET", 2))
     inner_iters = int(os.environ.get("BENCH_INNER_ITERS", 0))
     shrinking = os.environ.get("BENCH_SHRINKING", "") == "1"
+    use_pallas = os.environ.get("BENCH_PALLAS", "auto")
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
-                       shrinking=shrinking, chunk_iters=8192)
+                       shrinking=shrinking, use_pallas=use_pallas,
+                       chunk_iters=8192)
 
     t0 = time.perf_counter()
     result = train(x, y, config)
