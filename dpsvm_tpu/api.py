@@ -57,6 +57,14 @@ def train(x: np.ndarray, y: np.ndarray,
         from dpsvm_tpu.solver.oracle import smo_reference
         return smo_reference(x, y, config, f_init=f_init,
                              alpha_init=alpha_init, guard_eta=guard_eta)
+    if config.shrinking:
+        # Active-set training (solver/shrink.py); composes with
+        # working_set > 2 AND shards > 1 (the manager wraps any of the
+        # compiled chunk runners, local or SPMD).
+        from dpsvm_tpu.solver.shrink import train_shrinking
+        return train_shrinking(
+            x, y, config, f_init=f_init, alpha_init=alpha_init,
+            guard_eta=guard_eta)
     if config.shards > 1:
         if config.working_set > 2:
             from dpsvm_tpu.parallel.dist_decomp import (
@@ -66,13 +74,6 @@ def train(x: np.ndarray, y: np.ndarray,
         from dpsvm_tpu.parallel.dist_smo import train_distributed
         return train_distributed(x, y, config, f_init=f_init,
                                  alpha_init=alpha_init, guard_eta=guard_eta)
-    if config.shrinking:
-        # Active-set training (solver/shrink.py); composes with
-        # working_set > 2 (the manager wraps either chunk runner).
-        from dpsvm_tpu.solver.shrink import train_single_device_shrinking
-        return train_single_device_shrinking(
-            x, y, config, f_init=f_init, alpha_init=alpha_init,
-            guard_eta=guard_eta)
     if config.working_set > 2:
         # Large-working-set decomposition (solver/decomp.py). Eta is
         # always TAU-clamped there, so guard_eta is subsumed.

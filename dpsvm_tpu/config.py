@@ -284,8 +284,6 @@ class SVMConfig:
             # Reject paths that would silently ignore or fight the
             # active-set manager (same no-silent-ignore policy).
             for field, bad, what in (
-                    ("shards", self.shards > 1,
-                     "shrinking is single-device today"),
                     ("backend", self.backend == "numpy",
                      "the golden oracle keeps the reference's full-set "
                      "iteration"),

@@ -9,8 +9,10 @@ its per-iteration cost is O(n_shard * d) forever.
 
 XLA cannot reshape arrays inside a compiled loop, so shrinking here is a
 HOST-level active-set manager around the existing compiled chunk
-runners (the 2-violator program, solver/smo.py, or the decomposition
-program, solver/decomp.py — both share the chunk contract):
+runners — the 2-violator program (solver/smo.py), the decomposition
+program (solver/decomp.py), or their SPMD variants over the device mesh
+(parallel/dist_smo.py, parallel/dist_decomp.py; ``config.shards``) —
+all of which share the chunk contract:
 
   * train in chunks on the ACTIVE subproblem (x/y/x2/alpha/f compacted
     to the active rows — SMO on that subproblem is exact because
@@ -44,7 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
 from dpsvm_tpu.solver.driver import _read_stats
 from dpsvm_tpu.utils.logging import log_progress
@@ -133,14 +135,15 @@ def _stream_kv_against(x_rows: np.ndarray, x_sv: np.ndarray,
     return out
 
 
-def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
-                                  config: SVMConfig,
-                                  device: Optional[jax.Device] = None,
-                                  f_init: Optional[np.ndarray] = None,
-                                  alpha_init: Optional[np.ndarray] = None,
-                                  guard_eta: bool = False) -> TrainResult:
-    """Active-set training loop. Same NumPy-in/NumPy-out contract as the
-    other solvers."""
+def train_shrinking(x: np.ndarray, y: np.ndarray,
+                    config: SVMConfig,
+                    device: Optional[jax.Device] = None,
+                    f_init: Optional[np.ndarray] = None,
+                    alpha_init: Optional[np.ndarray] = None,
+                    guard_eta: bool = False) -> TrainResult:
+    """Active-set training loop — single device or SPMD over the mesh
+    (``config.shards``). Same NumPy-in/NumPy-out contract as the other
+    solvers."""
     config.validate()
     t0 = time.perf_counter()
     n, d = x.shape
@@ -163,39 +166,53 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
     f0 = f.copy()               # relative f reconstruction at unshrink
 
     decomp = config.working_set > 2
+    dist = config.shards > 1
     min_active = 1
+    q = 0
     if decomp:
-        from dpsvm_tpu.solver.decomp import (_build_decomp_runner,
-                                             init_carry)
         q = 2 * min(int(config.working_set) // 2, n)
         # The decomp runner's top_k needs q//2 <= len(active); never
         # compact below the block size (review finding: a few-SV
         # problem could otherwise shrink the active set under q and
         # crash the re-trace).
         min_active = q
-        runner = _build_decomp_runner(
-            float(config.c), kspec, eps, q,
-            int(config.inner_iters) or max(32, q // 4),
-            config.matmul_precision.upper(),
-            (float(config.weight_pos), float(config.weight_neg)),
-            config.clip == "pairwise",
-            pallas_inner=config.use_pallas == "on")
+    inner_cap = int(config.inner_iters) or max(32, q // 4)
+    weights = (float(config.weight_pos), float(config.weight_neg))
+    pairwise = config.clip == "pairwise"
+    precision_name = config.matmul_precision.upper()
+
+    if dist:
+        from dpsvm_tpu.parallel.mesh import make_data_mesh, to_host
+        mesh = make_data_mesh(config.shards)
+        p = mesh.devices.size
+        min_active = max(min_active, p)
     else:
+        xd_full = jax.device_put(jnp.asarray(x), device)
+
+    if not dist and decomp:
+        from dpsvm_tpu.solver.decomp import (_build_decomp_runner,
+                                             init_carry)
+        runner = _build_decomp_runner(
+            float(config.c), kspec, eps, q, inner_cap, precision_name,
+            weights, pairwise, pallas_inner=config.use_pallas == "on")
+    elif not dist:
         from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
         runner = _build_chunk_runner(
-            float(config.c), kspec, eps, False,
-            config.matmul_precision.upper(),
-            config.selection == "second-order",
-            (float(config.weight_pos), float(config.weight_neg)),
-            config.select_impl == "packed",
-            config.clip == "pairwise", guard_eta=guard_eta)
-
-    xd_full = jax.device_put(jnp.asarray(x), device)
+            float(config.c), kspec, eps, False, precision_name,
+            config.selection == "second-order", weights,
+            config.select_impl == "packed", pairwise,
+            guard_eta=guard_eta)
 
     def make_active(idx: np.ndarray):
-        """Device arrays + fresh carry for the active subproblem (all
-        placed on ``device``, like the other solvers — a carry left on
-        the default device would clash with xa in the jitted runner)."""
+        """(step, pull, carry) for the active subproblem.
+
+        ``step(carry, limit) -> (carry, stats)`` runs one chunk;
+        ``pull(carry) -> (alpha_act, f_act)`` reads the state back. The
+        distributed mode builds a fresh SPMD runner per active size
+        (padding/shardings change with it — the same ≤ log2(n) program
+        bound as the single-device path)."""
+        if dist:
+            return _make_active_dist(idx)
         if len(idx) == n:
             xa = xd_full
         else:
@@ -208,15 +225,85 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
         carry = carry._replace(alpha=alpha[idx].copy(), f=f[idx].copy())
         if device is not None:
             carry = jax.device_put(carry, device)
-        return xa, ya, x2a, carry
+        step = lambda c, lim: runner(c, xa, ya, x2a, np.int32(lim))
+        pull = lambda c: (np.asarray(c.alpha), np.asarray(c.f))
+        return step, pull, carry
+
+    placed_full = []        # cached full-set placement: every unshrink
+                            # returns to idx == arange(n), and re-paying
+                            # the full n x d H2D there is the exact cost
+                            # class the single-device path's xd_full
+                            # cache avoids
+
+    def _make_active_dist(idx: np.ndarray):
+        """SPMD subproblem over the mesh: the shared pad-and-shard
+        protocol (parallel/dist_smo.prepare_distributed_inputs) places
+        the active slice; the carry is seeded fresh from the manager's
+        host state."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dpsvm_tpu.parallel.dist_smo import prepare_distributed_inputs
+        from dpsvm_tpu.parallel.mesh import SHARD_AXIS
+
+        n_act = len(idx)
+        if n_act == n and placed_full:
+            di = placed_full[0]
+        else:
+            di = prepare_distributed_inputs(x[idx], y_np[idx], config,
+                                            mesh, None, None, None)
+            if n_act == n:
+                placed_full.append(di)
+        n_s = di.n_s
+        n_pad = n_s * p
+        pad1 = lambda v: np.concatenate(
+            [v, np.zeros(n_pad - n_act, v.dtype)])
+        a_seed = jax.device_put(pad1(alpha[idx]), di.shard)
+        f_seed = jax.device_put(pad1(f[idx]), di.shard)
+        b_hi0 = jax.device_put(np.float32(-SENTINEL), di.repl)
+        b_lo0 = jax.device_put(np.float32(SENTINEL), di.repl)
+        it0 = jax.device_put(np.int32(0), di.repl)
+
+        if decomp:
+            from dpsvm_tpu.parallel.dist_decomp import (
+                DistDecompCarry, _build_dist_decomp_runner)
+            run = _build_dist_decomp_runner(
+                mesh, float(config.c), kspec, eps, n_s, n_act, q,
+                inner_cap, bool(config.shard_x), precision_name,
+                weights, pairwise)
+            carry = DistDecompCarry(alpha=a_seed, f=f_seed, b_hi=b_hi0,
+                                    b_lo=b_lo0, n_iter=it0)
+        else:
+            from dpsvm_tpu.parallel.dist_smo import (DistCarry,
+                                                     _build_dist_runner)
+            run = _build_dist_runner(
+                mesh, float(config.c), kspec, eps, n_s,
+                bool(config.shard_x), precision_name,
+                config.selection == "second-order", weights,
+                use_cache=False,
+                packed_select=config.select_impl == "packed",
+                pairwise_clip=pairwise, guard_eta=guard_eta)
+            carry = DistCarry(
+                alpha=a_seed, f=f_seed, b_hi=b_hi0, b_lo=b_lo0,
+                n_iter=it0,
+                ck=jax.device_put(np.full((0,), -1, np.int32), di.shard),
+                cs=jax.device_put(np.zeros((0,), np.int32), di.shard),
+                cr=jax.device_put(np.zeros((0, n_s), np.float32),
+                                  NamedSharding(mesh,
+                                                P(SHARD_AXIS, None))))
+
+        def step(c, lim):
+            return run(c, di.xd, di.yd, di.x2, di.validd,
+                       jax.device_put(np.int32(lim), di.repl))
+
+        pull = lambda c: (to_host(c.alpha)[:n_act], to_host(c.f)[:n_act])
+        return step, pull, carry
 
     active = np.arange(n)
-    xa, ya, x2a, carry = make_active(active)
+    step, pull, carry = make_active(active)
     it = 0
     last_check = 0
     while True:
-        limit = np.int32(min(it + chunk, config.max_iter))
-        carry, stats = runner(carry, xa, ya, x2a, limit)
+        limit = min(it + chunk, config.max_iter)
+        carry, stats = step(carry, limit)
         it, b_lo, b_hi = _read_stats(stats)
         sub_converged = not (b_lo > b_hi + 2.0 * eps)
         capped = it >= config.max_iter
@@ -224,8 +311,7 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
 
         if sub_converged or capped:
             # Scatter the subproblem's state back.
-            alpha[active] = np.asarray(carry.alpha)
-            f[active] = np.asarray(carry.f)
+            alpha[active], f[active] = pull(carry)
             if len(active) == n:
                 converged = sub_converged
                 break
@@ -246,7 +332,7 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
             # budget. The reconstructed extrema ride along so the next
             # chunk's entry state is the real one.
             active = np.arange(n)
-            xa, ya, x2a, carry = make_active(active)
+            step, pull, carry = make_active(active)
             carry = carry._replace(n_iter=np.int32(it),
                                    b_hi=np.float32(b_hi),
                                    b_lo=np.float32(b_lo))
@@ -261,8 +347,7 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
         if it - last_check < min(SHRINK_CHECK_ITERS, n):
             continue
         last_check = it
-        a_act = np.asarray(carry.alpha)
-        f_act = np.asarray(carry.f)
+        a_act, f_act = pull(carry)
         shrink = _shrinkable(a_act, y_np[active], f_act, c_box[active],
                              b_hi, b_lo)
         keep = int(len(active) - shrink.sum())
@@ -270,13 +355,13 @@ def train_single_device_shrinking(x: np.ndarray, y: np.ndarray,
             alpha[active] = a_act
             f[active] = f_act
             active = active[~shrink]
-            xa, ya, x2a, new_carry = make_active(active)
+            step, pull, new_carry = make_active(active)
             # Preserve the loop bookkeeping (n_iter and the stopping
             # state survive the compaction; selection state is
             # recomputed next chunk anyway).
             carry = new_carry._replace(
-                n_iter=carry.n_iter,
-                b_hi=carry.b_hi, b_lo=carry.b_lo)
+                n_iter=np.int32(it),
+                b_hi=np.float32(b_hi), b_lo=np.float32(b_lo))
 
     log_progress(config, it, b_lo, b_hi, final=True)
     return TrainResult(

@@ -175,11 +175,10 @@ def test_config_guard_rails():
                 dict(backend="numpy"), dict(select_impl="packed")):
         with pytest.raises(ValueError, match="working_set > 2"):
             SVMConfig(working_set=8, **bad).validate()
-    # distributed decomposition is a real path (parallel/dist_decomp.py)
+    # distributed decomposition is a real path (parallel/dist_decomp.py),
+    # and the active-set manager composes with it over the mesh
     SVMConfig(working_set=8, shards=2).validate()
-    # ...but the active-set manager stays single-device
-    with pytest.raises(ValueError, match="shrinking"):
-        SVMConfig(working_set=8, shrinking=True, shards=2).validate()
+    SVMConfig(working_set=8, shrinking=True, shards=2).validate()
     with pytest.raises(ValueError, match="inner_iters"):
         SVMConfig(inner_iters=100).validate()
     # inner_iters rides along with a valid q

@@ -121,12 +121,35 @@ def test_few_sv_problem_never_compacts_below_block_size():
 
 
 def test_config_guard_rails():
-    for bad in (dict(shards=2), dict(backend="numpy"), dict(cache_size=4),
+    for bad in (dict(backend="numpy"), dict(cache_size=4),
                 dict(checkpoint_path="/tmp/x.npz"),
                 dict(resume_from="/tmp/x.npz"),
                 dict(profile_dir="/tmp/prof")):
         with pytest.raises(ValueError, match="shrinking"):
             SVMConfig(shrinking=True, **bad).validate()
-    # compositions that must remain legal
+    # compositions that must remain legal (shards composes since the
+    # manager drives the SPMD runners too)
     SVMConfig(shrinking=True, working_set=64).validate()
     SVMConfig(shrinking=True, selection="second-order").validate()
+    SVMConfig(shrinking=True, shards=8).validate()
+    SVMConfig(shrinking=True, shards=8, working_set=64).validate()
+
+
+@pytest.mark.parametrize("kw", [dict(shards=8),
+                                dict(shards=8, shard_x=False),
+                                dict(shards=8, working_set=64)])
+def test_distributed_shrinking_quality(kw):
+    """The active-set manager over the SPMD runners: same convergence
+    contract on the 8-device CPU mesh, both X layouts and the
+    decomposition runner."""
+    x, y = make_planted(2000, 24, gamma=0.5, seed=5, noise=0.01)
+    eps = 1e-3
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=eps,
+                              max_iter=400_000, shrinking=True,
+                              chunk_iters=512, **kw))
+    assert r.converged
+    gap, b = true_gap_and_b(x, y, r.alpha, C=10.0, gamma=0.5)
+    assert gap <= 2.0 * eps + 5e-4, gap
+    assert abs(b - r.b) <= 1e-3
+    alpha = np.asarray(r.alpha)
+    assert np.all(alpha >= 0) and np.all(alpha <= 10.0)
