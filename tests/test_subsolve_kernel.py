@@ -136,3 +136,17 @@ def test_vmem_cap_guard():
     with pytest.raises(ValueError, match="2048"):
         SVMConfig(working_set=4096, use_pallas="on").validate()
     SVMConfig(working_set=2048, use_pallas="on").validate()
+
+
+def test_shrinking_with_pallas_inner():
+    """The full round-3 single-device stack: shrinking manager over the
+    decomposition runner with the kernelized subsolve."""
+    x, y = make_planted(1200, 16, gamma=0.5, seed=4, noise=0.01)
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=200_000, working_set=32,
+                              shrinking=True, use_pallas="on",
+                              chunk_iters=512))
+    assert r.converged
+    from test_decomp import true_gap_and_b
+    gap, _ = true_gap_and_b(x, y, r.alpha, C=10.0, gamma=0.5)
+    assert gap <= 2e-3 + 5e-4
