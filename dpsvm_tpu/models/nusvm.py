@@ -47,6 +47,8 @@ def _solve_nu(x, y_pm, alpha0, f0, config: SVMConfig) -> TrainResult:
                        ("shrinking", config.shrinking),
                        ("cache_size", config.cache_size > 0),
                        ("selection", config.selection != "first-order"),
+                       ("select_impl",
+                        config.select_impl != "argminmax"),
                        ("backend", config.backend == "numpy"),
                        ("use_pallas", config.use_pallas == "on"),
                        # Checkpoints carry no task tag, and a shape-
@@ -197,9 +199,12 @@ def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
     x2n = np.concatenate([x, x], axis=0)
     y_pm = np.concatenate([np.ones(n), -np.ones(n)]).astype(np.float32)
     spec = config.kernel_spec(d)
-    coef0 = (alpha0 * y_pm)[:n] + (alpha0 * y_pm)[n:]
-    kv = _stream_kv(x, coef0, spec, block=4096)
-    f0 = np.concatenate([kv - z, kv - z]).astype(np.float32)
+    # The seed's kernel term vanishes identically: alpha_j == alpha*_j
+    # with opposite pseudo-labels gives coef = seed - seed = 0, so
+    # f0 = K@0 - z = -z on both halves — no O(n^2 d) kernel pass needed
+    # (round-3 review: _stream_kv here burned minutes at covtype scale
+    # computing a zero vector).
+    f0 = np.concatenate([-z, -z]).astype(np.float32)
 
     config = dataclasses.replace(config, clip="pairwise")
     result = _solve_nu(x2n, y_pm, alpha0, f0, config)

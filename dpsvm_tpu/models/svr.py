@@ -40,7 +40,14 @@ def train_svr(x: np.ndarray, y: np.ndarray,
               config: Optional[SVMConfig] = None
               ) -> Tuple[SVMModel, TrainResult]:
     """Fit an epsilon-SVR. y: (n,) float targets; tube half-width =
-    ``config.svr_epsilon`` (LIBSVM -p, default 0.1)."""
+    ``config.svr_epsilon`` (LIBSVM -p, default 0.1).
+
+    ``config.clip`` is ALWAYS the conserving pairwise rule here — the
+    SVR dual's equality constraint is part of the model, and the
+    reference's independent clip drifts it (round-2 advisory). The
+    config default ('independent') cannot be distinguished from an
+    explicit request, so the flag is deliberately not honored on this
+    path; there is no SVR mode with the drifting clip."""
     from dpsvm_tpu.api import train
 
     config = config or SVMConfig()

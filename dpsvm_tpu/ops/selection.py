@@ -33,6 +33,22 @@ def iup_ilow_masks(alpha: jax.Array, y: jax.Array, c
     return in_up, in_low
 
 
+def iup_ilow_masks_np(alpha, y, c):
+    """NumPy twin of ``iup_ilow_masks`` for host-side consumers (the
+    shrinking manager's shrink rule and unshrink optimality check) —
+    ONE membership definition, two array libraries. Semantics must stay
+    identical to the jnp version above."""
+    import numpy as np
+
+    at0 = alpha == 0.0
+    atc = alpha == c
+    interior = ~at0 & ~atc
+    pos = np.asarray(y) > 0
+    in_up = interior | (at0 & pos) | (atc & ~pos)
+    in_low = interior | (at0 & ~pos) | (atc & pos)
+    return in_up, in_low
+
+
 def masked_scores_and_masks(alpha: jax.Array, y: jax.Array, f: jax.Array,
                             c, valid: Optional[jax.Array] = None
                             ) -> Tuple[jax.Array, jax.Array,

@@ -124,7 +124,10 @@ def _subsolve_kernel(scal_ref, cap_ref, kww_ref, y_ref, c_ref, act_ref,
     fout_ref[0] = f
     stats_ref[0] = bh
     stats_ref[1] = bl
-    stats_ref[2] = t.astype(jnp.float32)
+    # Bit pattern, not a cast: an f32 VALUE lane would corrupt counts
+    # above 2^24 (the same hazard driver.pack_stats documents), and
+    # inner_iters is unbounded.
+    stats_ref[2] = lax.bitcast_convert_type(t, jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("max_cap", "pairwise",
@@ -156,4 +159,4 @@ def pallas_inner_subsolve(k_ww, y_w, c_w, a_w0, f_w0, active, epsilon,
       active.astype(jnp.float32)[None, :],
       a_w0[None, :], f_w0[None, :])
     return (a[0], f[0], stats[0], stats[1],
-            stats[2].astype(jnp.int32))
+            lax.bitcast_convert_type(stats[2], jnp.int32))

@@ -163,8 +163,12 @@ def train_single_device_fused(x: np.ndarray, y: np.ndarray,
         # written by the smo path record the previous body's selection,
         # which would be stale here).
         carry = carry._replace(n_iter=jnp.int32(ckpt.n_iter))
-        if not (float(carry.b_lo) > float(carry.b_hi)
+        if ckpt.n_iter < int(config.max_iter) and not (
+                float(carry.b_lo) > float(carry.b_hi)
                 + 2.0 * float(config.epsilon)):
+            # Budget gate mirrors the smo path: a checkpoint written AT
+            # max_iter resumes to zero bodies there (limit == n_iter),
+            # so the do-while mirror must not spend an extra update.
             # The recomputed selection already satisfies the gap. The smo
             # path's resumed loop still runs one body here (its cond saw
             # the checkpoint's stale open gap, and the body both computes

@@ -48,6 +48,7 @@ import numpy as np
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
+from dpsvm_tpu.ops.selection import iup_ilow_masks_np
 from dpsvm_tpu.solver.driver import _read_stats
 from dpsvm_tpu.utils.logging import log_progress
 
@@ -60,13 +61,9 @@ SHRINK_CHECK_ITERS = 4096
 
 def _host_extrema(alpha, y, f, c_box):
     """(b_hi, b_lo) from host arrays — the full-problem optimality check
-    at unshrink time, no device program needed."""
-    at0 = alpha == 0.0
-    atc = alpha == c_box
-    interior = ~at0 & ~atc
-    pos = y > 0
-    in_up = interior | (at0 & pos) | (atc & ~pos)
-    in_low = interior | (at0 & ~pos) | (atc & pos)
+    at unshrink time, no device program needed. Membership comes from
+    the ONE shared rule (ops/selection.iup_ilow_masks_np)."""
+    in_up, in_low = iup_ilow_masks_np(alpha, y, c_box)
     b_hi = float(f[in_up].min()) if in_up.any() else np.inf
     b_lo = float(f[in_low].max()) if in_low.any() else -np.inf
     return b_hi, b_lo
@@ -77,11 +74,9 @@ def _shrinkable(alpha, y, f, c_box, b_hi, b_lo):
     be either side of a violating pair (I_up-only with f >= b_lo can
     never beat the current max-violator as argmin side, and vice
     versa)."""
-    at0 = alpha == 0.0
-    atc = alpha == c_box
-    pos = y > 0
-    up_only = (at0 & pos) | (atc & ~pos)
-    low_only = (at0 & ~pos) | (atc & pos)
+    in_up, in_low = iup_ilow_masks_np(alpha, y, c_box)
+    up_only = in_up & ~in_low
+    low_only = in_low & ~in_up
     return (up_only & (f > b_lo)) | (low_only & (f < b_hi))
 
 
