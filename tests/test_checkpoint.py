@@ -71,3 +71,35 @@ def test_checkpoint_validation(tmp_path, blobs_small):
 def test_checkpoint_every_requires_path():
     with pytest.raises(ValueError, match="checkpoint_every"):
         SVMConfig(checkpoint_every=10).validate()
+
+
+def test_resume_at_budget_identical_across_paths(tmp_path, blobs_small):
+    """Regression (round-3 review): a checkpoint written exactly AT
+    max_iter must resume to ZERO extra updates on every solver path —
+    the fused path's do-while mirror used to spend one body beyond the
+    budget and flip the verdict to converged."""
+    import dataclasses
+
+    from dpsvm_tpu.solver.fused import train_single_device_fused
+    from dpsvm_tpu.solver.smo import train_single_device
+
+    x, y = blobs_small
+    ck = str(tmp_path / "at_budget.npz")
+    cfg = SVMConfig(c=10.0, gamma=2.0, epsilon=1e-9, max_iter=64,
+                    chunk_iters=16, checkpoint_path=ck,
+                    checkpoint_every=16)
+    capped = train_single_device(x, y, cfg)
+    assert not capped.converged and capped.n_iter == 64
+
+    rcfg = dataclasses.replace(cfg, checkpoint_path=None,
+                               checkpoint_every=0, resume_from=ck)
+    r_smo = train_single_device(x, y, rcfg)
+    r_fused = train_single_device_fused(
+        x, y, dataclasses.replace(rcfg, use_pallas="on"))
+    for r in (r_smo, r_fused):
+        assert r.n_iter == 64, r.n_iter
+        assert not r.converged
+    np.testing.assert_array_equal(np.asarray(r_smo.alpha),
+                                  np.asarray(capped.alpha))
+    np.testing.assert_array_equal(np.asarray(r_fused.alpha),
+                                  np.asarray(capped.alpha))
