@@ -135,7 +135,8 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                     device: Optional[jax.Device] = None,
                     f_init: Optional[np.ndarray] = None,
                     alpha_init: Optional[np.ndarray] = None,
-                    guard_eta: bool = False) -> TrainResult:
+                    guard_eta: bool = False,
+                    mesh=None) -> TrainResult:
     """Active-set training loop — single device or SPMD over the mesh
     (``config.shards``). Same NumPy-in/NumPy-out contract as the other
     solvers."""
@@ -178,7 +179,8 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
 
     if dist:
         from dpsvm_tpu.parallel.mesh import make_data_mesh, to_host
-        mesh = make_data_mesh(config.shards)
+        if mesh is None:
+            mesh = make_data_mesh(config.shards)
         p = mesh.devices.size
         min_active = max(min_active, p)
     else:
