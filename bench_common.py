@@ -18,17 +18,49 @@ import sys
 
 
 def standin(n: int, d: int, gamma: float, seed: int = 0):
-    """(x, y) stand-in for an (n, d) benchmark trained at ``gamma``."""
+    """(x, y) stand-in for an (n, d) benchmark trained at ``gamma``.
+
+    Generation is deterministic in (gen, n, d, gamma, seed) and costs
+    real host time at benchmark shapes (~8 s at 60000x784, minutes at
+    400000x2000), so results are memoized to /tmp — a measurement sweep
+    re-running the same shape pays generation once. ``BENCH_NO_MEMO=1``
+    bypasses the cache.
+    """
     gen = os.environ.get("BENCH_GEN", "planted")
+    if gen not in ("planted", "mnist-like"):
+        raise SystemExit(f"BENCH_GEN must be 'planted' or 'mnist-like', "
+                         f"got {gen!r}")
+    import numpy as np
+    memo = None
+    if os.environ.get("BENCH_NO_MEMO", "") != "1":
+        # The key embeds a hash of the generator SOURCE so retuning
+        # make_planted (as happened between rounds) can never serve
+        # stale pre-change data labeled as current.
+        import hashlib
+
+        from dpsvm_tpu.data import synthetic as _syn
+        with open(_syn.__file__, "rb") as fh:
+            ver = hashlib.sha1(fh.read()).hexdigest()[:8]
+        memo = (f"/tmp/dpsvm_standin/{gen}_{n}x{d}"
+                f"_g{gamma:.6g}_s{seed}_{ver}.npz")
+    if memo and os.path.exists(memo):
+        with np.load(memo) as z:
+            x, y = z["x"], z["y"]
+        print(f"data: synthetic {gen} ({n}x{d}, gamma={gamma}) [memo]",
+              file=sys.stderr, flush=True)
+        return x, y
     if gen == "planted":
         from dpsvm_tpu.data.synthetic import make_planted
         x, y = make_planted(n=n, d=d, gamma=gamma, seed=seed)
-    elif gen == "mnist-like":
+    else:
         from dpsvm_tpu.data.synthetic import make_mnist_like
         x, y = make_mnist_like(n=n, d=d, seed=seed)
-    else:
-        raise SystemExit(f"BENCH_GEN must be 'planted' or 'mnist-like', "
-                         f"got {gen!r}")
+    if memo:
+        os.makedirs(os.path.dirname(memo), exist_ok=True)
+        # np.savez appends ".npz" unless the name already ends with it
+        tmp = memo + f".tmp{os.getpid()}.npz"
+        np.savez(tmp, x=x, y=y)
+        os.replace(tmp, memo)
     print(f"data: synthetic {gen} ({n}x{d}, gamma={gamma})",
           file=sys.stderr, flush=True)
     return x, y

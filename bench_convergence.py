@@ -89,11 +89,15 @@ def main() -> None:
     inner_iters = int(os.environ.get("BENCH_INNER_ITERS", 0))
     shrinking = os.environ.get("BENCH_SHRINKING", "") == "1"
     use_pallas = os.environ.get("BENCH_PALLAS", "auto")
+    # BENCH_VERBOSE=1 prints gap progress at chunk polls — a run killed
+    # by an outer wall-clock timeout then still leaves rate evidence on
+    # stderr instead of vanishing without a number.
+    verbose = os.environ.get("BENCH_VERBOSE", "") == "1"
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
                        shrinking=shrinking, use_pallas=use_pallas,
-                       chunk_iters=8192)
+                       verbose=verbose, chunk_iters=8192)
 
     t0 = time.perf_counter()
     result = train(x, y, config)

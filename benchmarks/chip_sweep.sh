@@ -26,13 +26,27 @@ have() {  # tag already measured successfully?
 run() {  # run <tag> <timeout_s> <env...> -- <cmd...>
   local tag="$1" tmo="$2"; shift 2
   # Tags name their configuration, so pin every load-bearing knob the
-  # harness would otherwise read from the ambient environment — an
-  # exported BENCH_GEN/BENCH_PRECISION left over from a by-hand run
-  # must not silently relabel a recorded measurement.
-  local envs=(BENCH_GEN=planted)
+  # harnesses would otherwise read from the ambient environment — an
+  # exported BENCH_DATA/BENCH_WORKING_SET/... left over from a by-hand
+  # run must not silently relabel a recorded measurement. Later
+  # assignments override earlier ones in env(1), so per-run settings
+  # win over these defaults.
+  local envs=(BENCH_GEN=planted BENCH_DATA= BENCH_SELECTION=first-order
+              BENCH_EPS=1e-3 BENCH_WORKING_SET=2 BENCH_INNER_ITERS=0
+              BENCH_SHRINKING= BENCH_PALLAS=auto BENCH_MAX_ITER=400000
+              BENCH_NO_MEMO= BENCH_VERBOSE=1)
   while [ "$1" != "--" ]; do envs+=("$1"); shift; done
   shift
   if have "$tag"; then echo "SKIP $tag (already recorded)"; return 0; fi
+  # A tag that has already failed twice is not retried automatically —
+  # a doomed run (e.g. one that cannot finish inside its wall timeout)
+  # must not burn its budget on every sweep re-invocation. Delete its
+  # lines from the results file to retry by hand.
+  if [ -f "$RESULTS" ] && \
+     [ "$(grep -c "\"tag\": \"$tag\"" "$RESULTS")" -ge 2 ]; then
+    echo "SKIP $tag (2 failed attempts recorded; edit $RESULTS to retry)"
+    return 0
+  fi
   if ! probe; then echo "ABORT: tunnel down before $tag"; exit 3; fi
   echo "RUN  $tag: env ${envs[*]} $*"
   local errlog="/tmp/sweep_err_${tag}.log"
@@ -83,6 +97,25 @@ run conv_decomp2048_pal  1500 $MNIST BENCH_PRECISION=DEFAULT \
 run conv_adult_1m 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
     BENCH_GAMMA=0.5 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=1000000 \
     BENCH_SHRINKING=1 -- $M
+#    ... and the exact-arithmetic arm that is CPU-verified to converge
+#    at 579k iters, in case bf16 kernel error stalls the C=100 tail.
+run conv_adult_1m_f32 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
+    BENCH_GAMMA=0.5 BENCH_PRECISION=HIGHEST BENCH_MAX_ITER=1000000 \
+    BENCH_SHRINKING=1 -- $M
+
+# 3b) The HBM-bound shapes are where decomposition's economics should
+#    win biggest: a 2-violator iteration streams all of X per step
+#    (measured 438 it/s bf16 at the epsilon shape, 3,936 at covtype —
+#    PERF.md run_configs table) while an inner decomposition update
+#    touches only the VMEM-resident (q,q) block, so the (q,d)@(d,n)
+#    stream amortizes over ~cap updates. Budget-capped runs still yield
+#    the effective pair-update rate from n_iter/seconds.
+run conv_covtype_decomp 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
+    BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=4096 \
+    BENCH_SHRINKING=1 BENCH_MAX_ITER=3000000 -- $M
+run conv_epsilon_decomp 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
+    BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=4096 \
+    BENCH_MAX_ITER=200000 -- $M
 
 # 4) Settle the fused Pallas iteration kernel: head-to-head past the
 #    VMEM cliff (n=120k), the one regime it could win.
