@@ -93,11 +93,12 @@ def main() -> None:
     # by an outer wall-clock timeout then still leaves rate evidence on
     # stderr instead of vanishing without a number.
     verbose = os.environ.get("BENCH_VERBOSE", "") == "1"
+    polish = os.environ.get("BENCH_POLISH", "") == "1"
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
                        shrinking=shrinking, use_pallas=use_pallas,
-                       verbose=verbose, chunk_iters=8192)
+                       polish=polish, verbose=verbose, chunk_iters=8192)
 
     t0 = time.perf_counter()
     result = train(x, y, config)
@@ -124,6 +125,7 @@ def main() -> None:
         "selection": selection,
         "working_set": working_set,
         "shrinking": shrinking,
+        "polish": polish,
         "train_accuracy": round(float(acc), 6),
     }), flush=True)
 

@@ -34,7 +34,7 @@ run() {  # run <tag> <timeout_s> <env...> -- <cmd...>
   local envs=(BENCH_GEN=planted BENCH_DATA= BENCH_SELECTION=first-order
               BENCH_EPS=1e-3 BENCH_WORKING_SET=2 BENCH_INNER_ITERS=0
               BENCH_SHRINKING= BENCH_PALLAS=auto BENCH_MAX_ITER=400000
-              BENCH_NO_MEMO= BENCH_VERBOSE=1)
+              BENCH_POLISH= BENCH_NO_MEMO= BENCH_VERBOSE=1)
   while [ "$1" != "--" ]; do envs+=("$1"); shift; done
   shift
   if have "$tag"; then echo "SKIP $tag (already recorded)"; return 0; fi
@@ -102,6 +102,12 @@ run conv_adult_1m 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
 run conv_adult_1m_f32 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
     BENCH_GAMMA=0.5 BENCH_PRECISION=HIGHEST BENCH_MAX_ITER=1000000 \
     BENCH_SHRINKING=1 -- $M
+
+# 2b) Polishing (arXiv:2207.01016's recipe): bf16 bulk solve + exact-
+#    f32 warm-start refinement. Compare against the pure-f32 ~55-70 s
+#    implied by the 2,922 it/s run_configs row — the polished run's
+#    final KKT holds in exact arithmetic.
+run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 -- $M
 
 # 3b) The HBM-bound shapes are where decomposition's economics should
 #    win biggest: a 2-violator iteration streams all of X per step

@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="MXU matmul precision: 'highest'=exact f32 "
                          "(reference parity), 'default'=bf16-multiply "
                          "(~5x faster, same model quality in A/B runs)")
+    tr.add_argument("--polish", action="store_true",
+                    help="two-phase precision schedule: fast bf16 bulk "
+                         "solve, then an exact-f32 warm-start refinement "
+                         "to the same epsilon — exact-arithmetic final "
+                         "KKT at near-bf16 wall-clock")
     tr.add_argument("--weight-pos", type=float, default=1.0,
                     help="cost weight for y=+1 examples (box bound "
                          "C*weight; LIBSVM -w1)")
@@ -289,6 +294,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         conflicts = [("--multiclass", args.multiclass),
                      ("--probability", args.probability),
                      ("--check-kkt", args.check_kkt),
+                     ("--polish", args.polish),
                      ("--pallas on", args.pallas == "on"),
                      ("--weight-pos/--weight-neg",
                       args.weight_pos != 1.0 or args.weight_neg != 1.0)]
@@ -319,6 +325,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         profile_dir=args.profile_dir,
         debug_nans=args.debug_nans,
         matmul_precision=args.precision,
+        polish=args.polish,
         use_pallas=args.pallas,
         selection=args.selection,
         select_impl=args.select_impl,

@@ -124,6 +124,17 @@ class SVMConfig:
     matmul_precision: str = "highest"   # jax.lax precision for kernel rows
                                         # (solver dtype is float32 for
                                         # reference parity, not configurable)
+    polish: bool = False                # two-phase precision schedule
+                                        # ("polishing", the fast-SVM
+                                        # recipe of arXiv:2207.01016):
+                                        # bulk-solve fast — at the
+                                        # configured precision, or bf16
+                                        # "default" when that is
+                                        # "highest" — then warm-start
+                                        # refine at exact f32 to the
+                                        # same epsilon. Final KKT holds
+                                        # in exact arithmetic at near-
+                                        # bf16 wall-clock.
     verbose: bool = False
     log_every: int = 0                  # 0 = no per-chunk logging
 
@@ -250,6 +261,23 @@ class SVMConfig:
                 raise ValueError("select_impl applies to first-order "
                                  "selection only (WSS2's argmax-over-"
                                  "objective has no packed lowering)")
+        if self.polish:
+            # Reject combinations that would make the two-phase schedule
+            # meaningless or non-replayable, with the reason.
+            for field, bad, what in (
+                    ("backend", self.backend == "numpy",
+                     "the numpy oracle already computes in exact "
+                     "arithmetic — there is nothing to polish"),
+                    ("resume_from", bool(self.resume_from),
+                     "the two-phase schedule is not one replayable "
+                     "trajectory; resume the fast phase, then polish"),
+                    ("checkpoint_path", bool(self.checkpoint_path),
+                     "the two-phase schedule is not one replayable "
+                     "trajectory; checkpoint the fast phase, then "
+                     "polish")):
+                if bad:
+                    raise ValueError(f"polish does not support {field}: "
+                                     f"{what}")
         if self.working_set != 2:
             if (self.working_set < 4 or self.working_set % 2
                     or self.working_set > 8192):

@@ -87,6 +87,7 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                  selection: str = "first-order", shards: int = 1,
                  matmul_precision: str = "highest",
                  working_set: int = 2, shrinking: bool = False,
+                 polish: bool = False,
                  probability: bool = False):
         self.C = C
         self.kernel = kernel
@@ -100,15 +101,19 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         self.matmul_precision = matmul_precision
         self.working_set = working_set
         self.shrinking = shrinking
+        self.polish = polish
         self.probability = probability
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "tol",
                     "max_iter", "selection", "shards", "matmul_precision",
-                    "working_set", "shrinking", "probability")
+                    "working_set", "shrinking", "polish", "probability")
     _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
-        return SVMConfig(**self._common_config_kwargs())
+        # polish is classification-only (the SVR wrapper seeds f), so it
+        # lives here rather than in the shared kwargs.
+        return SVMConfig(polish=self.polish,
+                         **self._common_config_kwargs())
 
     # --- sklearn protocol: fit/predict/score ---
 
