@@ -122,6 +122,12 @@ run conv_covtype_decomp 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
 run conv_epsilon_decomp 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
     BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=4096 \
     BENCH_MAX_ITER=200000 -- $M
+#    The 2-violator covtype baseline at a budget sized to roughly the
+#    decomposition arm's wall-clock (~3.9k it/s measured at this shape),
+#    so the A/B compares progress (train_acc, final gap) at equal time.
+run conv_covtype_pair 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
+    BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT \
+    BENCH_MAX_ITER=280000 -- $M
 
 # 4) Settle the fused Pallas iteration kernel: head-to-head past the
 #    VMEM cliff (n=120k), the one regime it could win.

@@ -109,6 +109,7 @@ def host_training_loop(
                if config.profile_dir else contextlib.nullcontext())
 
     t0 = time.perf_counter()
+    prev_polled = it0
     with profile, _debug_nans(config.debug_nans):
         limit = min(it0 + chunk, config.max_iter)
         carry, stats = step_chunk(carry, limit)
@@ -125,7 +126,9 @@ def host_training_loop(
             converged = not (b_lo > b_hi + 2.0 * eps)
             done = converged or n_iter >= config.max_iter
 
-            log_progress(config, n_iter, b_lo, b_hi, final=done)
+            log_progress(config, n_iter, b_lo, b_hi, final=done,
+                         prev_iter=prev_polled)
+            prev_polled = n_iter
 
             def make() -> SolverCheckpoint:
                 alpha, f = carry_to_host(carry)

@@ -300,11 +300,14 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
     last_check = 0
     while True:
         limit = min(it + chunk, config.max_iter)
+        prev_polled = it
         carry, stats = step(carry, limit)
         it, b_lo, b_hi = _read_stats(stats)
         sub_converged = not (b_lo > b_hi + 2.0 * eps)
         capped = it >= config.max_iter
-        log_progress(config, it, b_lo, b_hi, final=False)
+        if not capped:   # the final=True line after the loop reports
+            log_progress(config, it, b_lo, b_hi, final=False,
+                         prev_iter=prev_polled)
 
         if sub_converged or capped:
             # Scatter the subproblem's state back.

@@ -10,6 +10,7 @@ optimality gap b_lo - b_hi (convergence is gap <= 2 epsilon).
 from __future__ import annotations
 
 import logging
+from typing import Optional
 
 _logger = logging.getLogger("dpsvm_tpu")
 
@@ -19,14 +20,27 @@ def get_logger() -> logging.Logger:
 
 
 def log_progress(config, n_iter: int, b_lo: float, b_hi: float,
-                 final: bool = False) -> None:
+                 final: bool = False,
+                 prev_iter: Optional[int] = None) -> None:
     """final=True forces the line (convergence mid-chunk would otherwise
-    skip the one report that matters)."""
+    skip the one report that matters).
+
+    ``prev_iter`` is the iteration count at the CALLER's previous poll:
+    when given, the line is emitted whenever the poll crossed an
+    ``every`` boundary. The plain modulo cadence only fires when n_iter
+    lands on an exact multiple, which is true for the 2-violator chunk
+    loop but never for the decomposition/shrinking paths (their
+    per-poll counts advance by block-round totals) — those callers pass
+    prev_iter so --verbose shows progress there too."""
     if not config.verbose and not config.log_every:
         return
     every = config.log_every or config.chunk_iters
-    if not final and n_iter % every and n_iter < config.max_iter:
-        return
+    if not final and n_iter < config.max_iter:
+        if prev_iter is not None:
+            if n_iter // every == prev_iter // every:
+                return
+        elif n_iter % every:
+            return
     gap = b_lo - b_hi
     # Will the logging hierarchy actually EMIT this INFO record? Not just
     # "does a handler exist": a root handler at the default WARNING level

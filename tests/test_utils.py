@@ -36,6 +36,20 @@ def test_log_progress_final_forces_line(caplog):
         assert len(caplog.records) == 1
 
 
+def test_log_progress_boundary_crossing_cadence(caplog):
+    """The decomposition/shrinking paths advance n_iter by block-round
+    totals that never land on exact chunk multiples; with prev_iter the
+    line fires on every crossed boundary instead."""
+    cfg = SVMConfig(verbose=True, chunk_iters=512, max_iter=10_000)
+    with caplog.at_level(logging.INFO, logger="dpsvm_tpu"):
+        log_progress(cfg, 700, 0.1, 0.099, prev_iter=300)   # crosses 512
+        assert len(caplog.records) == 1
+        log_progress(cfg, 900, 0.1, 0.099, prev_iter=700)   # same bucket
+        assert len(caplog.records) == 1
+        log_progress(cfg, 1100, 0.1, 0.099, prev_iter=900)  # crosses 1024
+        assert len(caplog.records) == 2
+
+
 def test_native_killswitch_wins_over_cache(monkeypatch):
     from dpsvm_tpu.native import build as nb
     # ensure a cached lib exists (or None if no compiler — still valid test)
