@@ -81,6 +81,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="MXU matmul precision: 'highest'=exact f32 "
                          "(reference parity), 'default'=bf16-multiply "
                          "(~5x faster, same model quality in A/B runs)")
+    tr.add_argument("--model-format", default="reference",
+                    choices=["reference", "libsvm"],
+                    help="model file layout: 'reference' (the MPI "
+                         "trainer's CSV-ish format) or 'libsvm' "
+                         "(svm-train .model text, readable by LIBSVM/"
+                         "sklearn tooling); the test command "
+                         "auto-detects either format")
     tr.add_argument("--polish", action="store_true",
                     help="two-phase precision schedule: fast bf16 bulk "
                          "solve, then an exact-f32 warm-start refinement "
@@ -222,6 +229,15 @@ def cmd_train(args: argparse.Namespace) -> int:
     from dpsvm_tpu.data.loader import load_dataset
     from dpsvm_tpu.models.io import save_model
     from dpsvm_tpu.models.svm import evaluate
+
+    if args.model_format == "libsvm":
+        from dpsvm_tpu.models.libsvm_io import save_libsvm_model
+        save_model = save_libsvm_model
+        if args.multiclass:
+            print("error: --model-format libsvm applies to binary "
+                  "models; --multiclass writes a directory of "
+                  "reference-format per-pair files", file=sys.stderr)
+            return 2
 
     if args.multiclass:
         # Flag conflicts are detectable from args alone — fail before
@@ -512,13 +528,34 @@ def cmd_test(args: argparse.Namespace) -> int:
         return 0
 
     model = load_model(args.model)
-    x, y = load_dataset(args.input, args.num_ex,
-                        _width_hint(model.num_attributes),
+    # Load the data at its NATURAL width (no model-width hint: a hint
+    # narrower than the data would silently truncate libsvm-format
+    # rows), then reconcile. Both sparse formats mean "absent index ==
+    # zero", so the narrower side widens with zero columns: libsvm test
+    # splits can undershoot the model (a9a.t is 122 vs 123) and sparse
+    # .model files underreport when trailing columns are zero in every
+    # SV. Dense CSVs carry their true width — a mismatch there (or a
+    # wider dataset against a reference-format model) is a real error.
+    x, y = load_dataset(args.input, args.num_ex, args.num_att,
                         float_labels=model.task == "svr")
     if x.shape[1] != model.num_attributes:
-        print(f"error: dataset has {x.shape[1]} attributes, model has "
-              f"{model.num_attributes}", file=sys.stderr)
-        return 2
+        import dataclasses
+
+        from dpsvm_tpu.models.io import is_libsvm_model
+        data_is_libsvm = (args.num_att is None
+                          and sniff_format(args.input) == "libsvm")
+        if x.shape[1] < model.num_attributes and data_is_libsvm:
+            x = np.pad(x, ((0, 0),
+                           (0, model.num_attributes - x.shape[1])))
+        elif (x.shape[1] > model.num_attributes
+                and is_libsvm_model(args.model)):
+            model = dataclasses.replace(model, x_sv=np.pad(
+                model.x_sv,
+                ((0, 0), (0, x.shape[1] - model.num_attributes))))
+        else:
+            print(f"error: dataset has {x.shape[1]} attributes, model "
+                  f"has {model.num_attributes}", file=sys.stderr)
+            return 2
     if model.task == "oneclass":
         if args.proba:
             print("error: --proba applies to classifiers only",

@@ -84,10 +84,31 @@ def save_model(model: SVMModel, path: str) -> int:
     return wrote
 
 
-def load_model(path: str) -> SVMModel:
-    """Read a model file (with or without the b line)."""
+def is_libsvm_model(path: str) -> bool:
+    """True when the file is LIBSVM ``.model`` format (svm-train's
+    output), which opens with an ``svm_type`` header line no reference-
+    format file can start with (its line 1 is a bare gamma float or our
+    ``kernel ...`` header)."""
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                return ln.startswith("svm_type")
+    return False
+
+
+def load_model(path: str, n_features=None) -> SVMModel:
+    """Read a model file (with or without the b line).
+
+    LIBSVM ``.model`` files are detected and dispatched to
+    ``models.libsvm_io`` (``n_features`` widens their sparse SV matrix;
+    reference-format files carry explicit width and ignore it).
+    """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+    if is_libsvm_model(path):
+        from dpsvm_tpu.models.libsvm_io import load_libsvm_model
+        return load_libsvm_model(path, n_features=n_features)
     with open(path) as f:
         lines = [ln.strip() for ln in f if ln.strip()]
     if len(lines) < 2:
