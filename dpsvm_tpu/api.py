@@ -19,7 +19,9 @@ from dpsvm_tpu.models.svm import SVMModel
 def _check_xy(x, y):
     """The cheap shape/label validation shared by train and warm_start
     (warm_start must run it BEFORE its O(n^2) kernel pass)."""
-    x = np.asarray(x, np.float32)
+    from dpsvm_tpu.utils import densify
+
+    x = np.asarray(densify(x), np.float32)
     y = np.asarray(y)
     if x.ndim != 2:
         raise ValueError(f"x must be (n, d), got shape {x.shape}")

@@ -203,6 +203,13 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("-r", "--restore-range", default=None, metavar="PATH",
                     help="apply previously saved params (svm-scale -r; "
                          "use for test files)")
+    inf = sub.add_parser(
+        "info", help="environment diagnostics: backend, devices, "
+                     "native helper, compile cache")
+    inf.add_argument("--timeout", type=float, default=20.0,
+                     help="seconds to wait for backend initialization "
+                          "before reporting it unreachable (a tunneled "
+                          "TPU that is down would otherwise hang here)")
     return root
 
 
@@ -654,6 +661,48 @@ def cmd_convert(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_info(args: argparse.Namespace) -> int:
+    """Environment diagnostics — the ops question 'what will a training
+    run actually see' answered without starting one. Probes the backend
+    with a bounded wait so a dead tunnel reports instead of hanging."""
+    import os
+
+    import dpsvm_tpu
+
+    print(f"dpsvm_tpu {dpsvm_tpu.__version__}")
+    import jax
+
+    print(f"jax {jax.__version__}")
+    from dpsvm_tpu.utils.backend_guard import probe_devices
+
+    devices, reason = probe_devices(args.timeout)
+    if devices is None:
+        print(f"backend: UNREACHABLE ({reason})")
+    else:
+        plat = devices[0].platform
+        print(f"backend: {plat} ({len(devices)} device"
+              f"{'s' if len(devices) != 1 else ''})")
+        for d in devices:
+            print(f"  {d}")
+        print(f"distributed: shards up to {len(devices)} on this host "
+              "(--shards); multi-host via jax.distributed "
+              "(docs/DISTRIBUTED.md)")
+    from dpsvm_tpu.native import load_native_lib
+
+    lib = load_native_lib()
+    print("native helper: "
+          + ("loaded (C++ CSV/libsvm parser + model writer)"
+             if lib is not None else
+             "unavailable (pure-Python fallbacks active)"))
+    # Same key enable_compile_cache honors — info must report the
+    # directory a training run would actually use.
+    cache = os.environ.get("JAX_CACHE_DIR", "/tmp/dpsvm_jaxcache")
+    state = "populated" if os.path.isdir(cache) and os.listdir(cache) \
+        else "empty"
+    print(f"compile cache: {cache} ({state})")
+    return 0 if devices is not None else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -663,6 +712,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_convert(args)
         if args.command == "scale":
             return cmd_scale(args)
+        if args.command == "info":
+            return cmd_info(args)
         return cmd_test(args)
     except FileNotFoundError as e:
         print(f"error: file not found: {e}", file=sys.stderr)

@@ -123,8 +123,9 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         optional attribute — _platt, intercept_, n_support_ — is reset,
         never stale from an earlier fit with different params)."""
         from dpsvm_tpu.api import fit as _fit
+        from dpsvm_tpu.utils import densify
 
-        X = np.asarray(X, np.float32)
+        X = np.asarray(densify(X), np.float32)
         y = np.asarray(y)
         classes = np.unique(y)
         if len(classes) < 2:
@@ -169,11 +170,14 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
             raise ValueError("decision_function is binary-only; use "
                              "predict for multiclass models")
         from dpsvm_tpu.models.svm import decision_function as _dec
-        return np.asarray(_dec(self._model, np.asarray(X, np.float32)))
+        from dpsvm_tpu.utils import densify
+        return np.asarray(_dec(self._model,
+                               np.asarray(densify(X), np.float32)))
 
     def predict(self, X) -> np.ndarray:
         self._check_fitted()
-        X = np.asarray(X, np.float32)
+        from dpsvm_tpu.utils import densify
+        X = np.asarray(densify(X), np.float32)
         if self._model is not None:
             dec = self.decision_function(X)
             return np.where(dec < 0, self.classes_[0], self.classes_[1])
@@ -234,8 +238,9 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
 
     def fit(self, X, y) -> "DPSVMRegressor":
         from dpsvm_tpu.models.svr import train_svr
+        from dpsvm_tpu.utils import densify
 
-        X = np.asarray(X, np.float32)
+        X = np.asarray(densify(X), np.float32)
         y = np.asarray(y, np.float32)
         model, result = train_svr(X, y, self._config())
         self._model = model
@@ -249,8 +254,9 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
         from dpsvm_tpu.models.svr import predict_svr
 
         self._check_fitted()
-        return np.asarray(predict_svr(self._model,
-                                      np.asarray(X, np.float32)))
+        from dpsvm_tpu.utils import densify
+        return np.asarray(predict_svr(
+            self._model, np.asarray(densify(X), np.float32)))
 
     def score(self, X, y) -> float:
         """R^2, the sklearn regressor convention."""

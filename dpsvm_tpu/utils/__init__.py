@@ -1,6 +1,19 @@
-"""Utilities: structured logging, phase timing."""
+"""Utilities: structured logging, phase timing, input coercion."""
 
 from dpsvm_tpu.utils.logging import log_progress, get_logger
 from dpsvm_tpu.utils.timing import PhaseTimer
 
-__all__ = ["log_progress", "get_logger", "PhaseTimer"]
+
+def densify(x):
+    """scipy.sparse input -> dense ndarray; anything else passes through.
+
+    The TPU compute path is dense (kernel rows are MXU matmuls over a
+    dense X), and ``np.asarray`` on a sparse matrix produces a useless
+    0-d object array — every user-facing entry point (api, estimators,
+    decision functions) densifies up front instead."""
+    if hasattr(x, "toarray") and hasattr(x, "tocsr"):
+        return x.toarray()
+    return x
+
+
+__all__ = ["log_progress", "get_logger", "PhaseTimer", "densify"]

@@ -64,6 +64,33 @@ def require_devices(timeout_s: Optional[float] = None) -> List:
     return result["devices"]
 
 
+def probe_devices(timeout_s: float):
+    """(devices, None) or (None, reason) — the CATCHABLE probe.
+
+    ``require_devices`` hard-exits (os._exit) by design so a wedged
+    tunnel can never leave a benchmark half-running; diagnostics like
+    ``cli info`` need to report the failure and keep printing instead.
+    """
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+            result["devices"] = jax.devices()
+        except Exception as e:
+            result["error"] = str(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, (f"backend initialization hung for >{timeout_s:.0f}s "
+                      "— the TPU tunnel is unresponsive")
+    if "error" in result:
+        return None, f"jax backend unavailable: {result['error']}"
+    return result["devices"], None
+
+
 def enable_compile_cache() -> None:
     """Point jax at a persistent on-disk compile cache.
 

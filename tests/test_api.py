@@ -176,3 +176,24 @@ def test_warm_start_guards(blobs_small):
     with pytest.raises(ValueError, match=r"x must be \(n, d\)"):
         warm_start(x[:, 0], y, np.zeros(len(y), np.float32),
                    SVMConfig(c=4.0))
+
+
+def test_scipy_sparse_input_densified(blobs_small):
+    import scipy.sparse as sp
+
+    x, y = blobs_small
+    dense = dt.train(x, y, dt.SVMConfig(c=2.0, max_iter=20_000))
+    sparse = dt.train(sp.csr_matrix(x), y,
+                      dt.SVMConfig(c=2.0, max_iter=20_000))
+    assert sparse.n_iter == dense.n_iter
+    np.testing.assert_allclose(sparse.alpha, dense.alpha)
+
+
+def test_cli_info(capsys):
+    from dpsvm_tpu.cli import main
+
+    assert main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "backend: cpu" in out
+    assert "native helper:" in out
+    assert "compile cache:" in out

@@ -124,3 +124,18 @@ def test_estimator_new_solver_knobs():
     assert clf.score(x, y) >= 0.95
     clf2 = DPSVMClassifier(C=5.0, gamma=0.5, working_set=16).fit(x, y)
     assert clf2.score(x, y) >= 0.95
+
+
+def test_estimator_accepts_scipy_sparse(blobs_small):
+    import scipy.sparse as sp
+
+    from dpsvm_tpu.models.estimator import DPSVMClassifier
+
+    x, y = blobs_small
+    clf = DPSVMClassifier(C=2.0, max_iter=20_000)
+    clf.fit(sp.csr_matrix(x), y)
+    dense_pred = clf.predict(x)
+    assert (clf.predict(sp.csr_matrix(x)) == dense_pred).all()
+    assert clf.score(sp.csr_matrix(x), y) > 0.9
+    np.testing.assert_allclose(clf.decision_function(sp.csr_matrix(x)),
+                               clf.decision_function(x))
