@@ -83,6 +83,13 @@ run conv_decomp4096  1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=4096 -- $M
 run conv_decomp_shrink 1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=4096 BENCH_SHRINKING=1 -- $M
+#    The iteration-economy scan (solver/decomp.py tuning guide) says
+#    q=4096 cap=128 reaches convergence in FEWER pair-updates than the
+#    auto cap q/4=1024 — these arms decide the wall-clock winner.
+run conv_decomp4096_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 -- $M
+run conv_decomp_shrink_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_SHRINKING=1 -- $M
 
 # 2) Pallas inner-subsolve kernel A/B (q capped at 2048 by the VMEM
 #    guard): same decomposition config, kernel on vs XLA inner loop.
@@ -116,11 +123,14 @@ run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 -- $M
 #    touches only the VMEM-resident (q,q) block, so the (q,d)@(d,n)
 #    stream amortizes over ~cap updates. Budget-capped runs still yield
 #    the effective pair-update rate from n_iter/seconds.
-run conv_covtype_decomp 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
-    BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=4096 \
+#    q=2048, not 4096: the fetched (q,n) f32 block is q*n*4 bytes —
+#    4 GB at covtype scale, 8 GB at q=4096, which plus X and the
+#    f-update workspace would crowd the v5e's 16 GB HBM.
+run conv_covtype_decomp_q2048 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
+    BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
     BENCH_SHRINKING=1 BENCH_MAX_ITER=3000000 -- $M
-run conv_epsilon_decomp 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
-    BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=4096 \
+run conv_epsilon_decomp_q2048 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
+    BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
     BENCH_MAX_ITER=200000 -- $M
 #    The 2-violator covtype baseline at a budget sized to roughly the
 #    decomposition arm's wall-clock (~3.9k it/s measured at this shape),

@@ -55,6 +55,9 @@ def train(x: np.ndarray, y: np.ndarray,
     config = config or SVMConfig()
     config.validate()
     x, y = _check_xy(x, y)
+    if config.kernel == "precomputed" and x.shape[0] != x.shape[1]:
+        raise ValueError("precomputed kernel training needs the square "
+                         f"(n, n) kernel matrix as x, got {x.shape}")
     if config.polish:
         # Two-phase "polishing" (the fast-SVM recipe, arXiv:2207.01016):
         # the configured solver path does the bulk of the work at fast
@@ -137,6 +140,9 @@ def train(x: np.ndarray, y: np.ndarray,
 def fit(x: np.ndarray, y: np.ndarray,
         config: Optional[SVMConfig] = None) -> Tuple[SVMModel, TrainResult]:
     """train + SV compaction in one call."""
+    from dpsvm_tpu.utils import densify
+
+    x = densify(x)      # from_train_result consumes x too
     result = train(x, y, config)
     return SVMModel.from_train_result(x, y, result), result
 

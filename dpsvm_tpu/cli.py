@@ -48,9 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="kernel gamma (default 1/num_attributes)")
     tr.add_argument("-t", "--kernel", default="rbf",
                     type=_kernel_name,
-                    help="kernel: linear | poly | rbf | sigmoid, or the "
-                         "LIBSVM -t integer 0..3 (default rbf — the "
-                         "reference's only kernel)")
+                    help="kernel: linear | poly | rbf | sigmoid | "
+                         "precomputed, or the LIBSVM -t integer 0..4 "
+                         "(default rbf — the reference's only kernel; "
+                         "-t 4 trains on a (n, n) kernel matrix CSV and "
+                         "tests on K(test, train) rows)")
     tr.add_argument("-d", "--degree", type=int, default=3,
                     help="poly kernel degree (LIBSVM -d)")
     tr.add_argument("-r", "--coef0", type=float, default=0.0,
@@ -213,7 +215,8 @@ def build_parser() -> argparse.ArgumentParser:
     return root
 
 
-_KERNEL_BY_T = {"0": "linear", "1": "poly", "2": "rbf", "3": "sigmoid"}
+_KERNEL_BY_T = {"0": "linear", "1": "poly", "2": "rbf", "3": "sigmoid",
+                "4": "precomputed"}
 
 
 def _kernel_name(v: str) -> str:
@@ -222,8 +225,8 @@ def _kernel_name(v: str) -> str:
     name = _KERNEL_BY_T.get(v, v)
     if name not in _KERNEL_BY_T.values():
         raise argparse.ArgumentTypeError(
-            f"{v!r} is not a kernel (linear | poly | rbf | sigmoid, "
-            "or LIBSVM -t 0..3)")
+            f"{v!r} is not a kernel (linear | poly | rbf | sigmoid | "
+            "precomputed, or LIBSVM -t 0..4)")
     return name
 
 
@@ -244,6 +247,13 @@ def cmd_train(args: argparse.Namespace) -> int:
             print("error: --model-format libsvm applies to binary "
                   "models; --multiclass writes a directory of "
                   "reference-format per-pair files", file=sys.stderr)
+            return 2
+        if args.kernel == "precomputed":
+            # args-detectable: fail before the CSV parse and the train
+            print("error: --model-format libsvm cannot store "
+                  "precomputed-kernel models (0:serial export is not "
+                  "implemented); use the reference format",
+                  file=sys.stderr)
             return 2
 
     if args.multiclass:
@@ -694,9 +704,9 @@ def cmd_info(args: argparse.Namespace) -> int:
           + ("loaded (C++ CSV/libsvm parser + model writer)"
              if lib is not None else
              "unavailable (pure-Python fallbacks active)"))
-    # Same key enable_compile_cache honors — info must report the
-    # directory a training run would actually use.
-    cache = os.environ.get("JAX_CACHE_DIR", "/tmp/dpsvm_jaxcache")
+    from dpsvm_tpu.utils.backend_guard import compile_cache_dir
+
+    cache = compile_cache_dir()
     state = "populated" if os.path.isdir(cache) and os.listdir(cache) \
         else "empty"
     print(f"compile cache: {cache} ({state})")

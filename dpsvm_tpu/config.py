@@ -221,9 +221,33 @@ class SVMConfig:
         if self.clip not in ("independent", "pairwise"):
             raise ValueError(f"clip must be 'independent' or 'pairwise', "
                              f"got {self.clip!r}")
-        if self.kernel not in ("linear", "poly", "rbf", "sigmoid"):
-            raise ValueError(f"kernel must be 'linear', 'poly', 'rbf' or "
-                             f"'sigmoid', got {self.kernel!r}")
+        if self.kernel not in ("linear", "poly", "rbf", "sigmoid",
+                               "precomputed"):
+            raise ValueError(f"kernel must be 'linear', 'poly', 'rbf', "
+                             f"'sigmoid' or 'precomputed', got "
+                             f"{self.kernel!r}")
+        if self.kernel == "precomputed":
+            # LIBSVM -t 4: x IS the (n, n) kernel matrix. Paths that
+            # must re-EVALUATE kernel values between row subsets (not
+            # just gather stored ones) cannot, and say so.
+            if self.shrinking:
+                raise ValueError(
+                    "precomputed kernel does not support shrinking: the "
+                    "unshrink f reconstruction evaluates kernels between "
+                    "row subsets, which a gathered K cannot provide")
+            if self.backend == "numpy":
+                raise ValueError(
+                    "precomputed kernel is not implemented on the numpy "
+                    "golden-reference backend; use the xla backend")
+            if self.cache_size > 0:
+                raise ValueError(
+                    "precomputed kernel has nothing to cache: the row "
+                    "fetch is already a 2-row gather of the stored K")
+            if self.use_pallas == "on":
+                raise ValueError(
+                    "the Pallas kernels are built around the vector-"
+                    "kernel row fetch; precomputed uses the plain XLA "
+                    "gather path")
         if self.kernel == "poly" and self.degree < 1:
             raise ValueError(f"poly degree must be >= 1, got {self.degree}")
         if self.selection not in ("first-order", "second-order"):

@@ -49,7 +49,12 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     task: "svc" (binary or multiclass by label count) or "svr".
     Returns {"predictions", "folds", plus task metrics}.
     """
+    from dpsvm_tpu.utils import densify
+    x = densify(x)
     config = config or SVMConfig()
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "cross-validation does not support the precomputed kernel: folds subset rows, which needs matching column subsets of K; slice K per fold and train binary models instead")
     x = np.asarray(x, np.float32)
     y = np.asarray(y)
     if task not in ("svc", "svr"):

@@ -53,9 +53,19 @@ def save_model(model: SVMModel, path: str) -> int:
                     f"{model.coef0:.9g} {int(model.degree)}\n")
             if model.task != "svc":
                 f.write(f"task {model.task}\n")
+            if model.kernel == "precomputed":
+                # SVs are INDICES into the training set; the svidx line
+                # carries them plus the width K(test, train) must have.
+                idx = " ".join(str(int(i)) for i in model.sv_idx)
+                f.write(f"svidx {int(model.n_train)} {idx}\n")
             f.write(f"{model.b:.9g}\n")
             wrote = 0
             for i in range(n):
+                if model.kernel == "precomputed":
+                    # every stored row aligns with svidx — no skipping
+                    f.write(f"{alpha[i]:.9g},{int(y[i])}\n")
+                    wrote += 1
+                    continue
                 if not alpha[i] > 0:
                     continue
                 row = ",".join(f"{v:.9g}" for v in x[i])
@@ -129,6 +139,18 @@ def load_model(path: str, n_features=None) -> SVMModel:
         if task not in ("svc", "svr", "oneclass"):
             raise ValueError(f"{path}: unknown task {task!r}")
         lines = [lines[0]] + lines[2:]
+    sv_idx, n_train = None, None
+    if len(lines) > 1 and lines[1].startswith("svidx "):
+        if kernel != "precomputed":
+            raise ValueError(f"{path}: svidx line is precomputed-kernel "
+                             "only")
+        parts = lines[1].split()
+        n_train = int(parts[1])
+        sv_idx = np.asarray(parts[2:], dtype=np.int64)
+        lines = [lines[0]] + lines[2:]
+    elif kernel == "precomputed":
+        raise ValueError(f"{path}: precomputed-kernel model is missing "
+                         "its svidx line")
     # After the header line(s): an optional lone-scalar b line, then SVs
     # (the reference's seq.cpp layout omits b — SURVEY §2c).
     has_b = len(lines) > 1 and "," not in lines[1]
@@ -149,5 +171,9 @@ def load_model(path: str, n_features=None) -> SVMModel:
         alpha[i] = float(parts[0])
         y[i] = int(float(parts[1]))
         x[i] = np.asarray(parts[2:], dtype=np.float32)
+    if sv_idx is not None and len(sv_idx) != n_sv:
+        raise ValueError(f"{path}: svidx lists {len(sv_idx)} indices "
+                         f"but there are {n_sv} SV lines")
     return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=b, gamma=gamma,
-                    kernel=kernel, coef0=coef0, degree=degree, task=task)
+                    kernel=kernel, coef0=coef0, degree=degree, task=task,
+                    sv_idx=sv_idx, n_train=n_train)

@@ -64,6 +64,10 @@ def save_libsvm_model(model: SVMModel, path: str) -> int:
     if model.task not in _TASK_TO_SVMTYPE:
         raise ValueError(f"cannot export task {model.task!r} as a "
                          "LIBSVM model (supported: svc, svr, oneclass)")
+    if model.kernel == "precomputed":
+        raise ValueError("LIBSVM export of precomputed-kernel models "
+                         "(0:serial SV lines) is not implemented — use "
+                         "the reference format (save_model)")
     coef = np.asarray(model.alpha, np.float64) * np.asarray(
         model.y_sv, np.float64)
     x = np.asarray(model.x_sv)

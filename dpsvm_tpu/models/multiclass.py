@@ -42,7 +42,12 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     """Train OvO; y may hold any integer labels (2 classes work too)."""
     from dpsvm_tpu.api import fit
 
+    from dpsvm_tpu.utils import densify
+    x = densify(x)
     config = config or SVMConfig()
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "one-vs-one multiclass does not support the precomputed kernel: each pair trains on a ROW subset, which needs the matching column subset of K; slice K per pair and train binary models instead")
     if config.checkpoint_path or config.resume_from:
         # Every pairwise fit would share the one checkpoint file —
         # overwriting each other or failing shape validation mid-run.

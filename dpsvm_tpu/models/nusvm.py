@@ -110,7 +110,12 @@ def train_nusvc(x: np.ndarray, y: np.ndarray, nu: float = 0.5,
     box is 1 by construction); labels are +/-1."""
     from dpsvm_tpu.ops.diagnostics import _stream_kv
 
+    from dpsvm_tpu.utils import densify
+    x = densify(x)
     config = config or SVMConfig()
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "nu-SVC does not support the precomputed kernel: use a vector kernel (or c-SVC, which supports precomputed)")
     if not 0.0 < nu <= 1.0:
         raise ValueError(f"nu must be in (0, 1], got {nu}")
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:
@@ -178,7 +183,12 @@ def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
     ``config.svr_epsilon`` is ignored (epsilon is a result)."""
     from dpsvm_tpu.ops.diagnostics import _stream_kv
 
+    from dpsvm_tpu.utils import densify
+    x = densify(x)
     config = config or SVMConfig()
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "nu-SVR does not support the precomputed kernel: the 2n-variable dual duplicates every row; use a vector kernel")
     if not 0.0 < nu <= 1.0:
         raise ValueError(f"nu must be in (0, 1], got {nu}")
     x = np.asarray(x, np.float32)

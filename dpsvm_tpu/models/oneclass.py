@@ -38,7 +38,12 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
     from dpsvm_tpu.api import train
     from dpsvm_tpu.ops.diagnostics import _stream_kv
 
+    from dpsvm_tpu.utils import densify
+    x = densify(x)
     config = config or SVMConfig()
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "one-class SVM does not support the precomputed kernel: the alpha seed and unshifted f init are defined on vector rows here; use a vector kernel")
     if not 0.0 < nu < 1.0:
         raise ValueError(f"nu must be in (0, 1), got {nu}")
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:

@@ -42,6 +42,12 @@ class SVMModel:
     degree: int = 3
     task: str = "svc"     # "svc" (classification) | "svr" (regression,
                           # coefficients encode delta = a - a*)
+    sv_idx: "Optional[np.ndarray]" = None   # precomputed kernel only:
+                          # SV indices into the TRAINING set (LIBSVM's
+                          # "0:serial"); prediction input is K(test,
+                          # train) and the decision gathers its columns
+    n_train: "Optional[int]" = None         # precomputed only: training
+                          # n, i.e. the width K(test, train) must have
 
     @property
     def kernel_spec(self) -> KernelSpec:
@@ -50,19 +56,40 @@ class SVMModel:
 
     @property
     def n_sv(self) -> int:
-        return int(self.x_sv.shape[0])
+        return int(self.alpha.shape[0])
 
     @property
     def num_attributes(self) -> int:
+        """Width the evaluation input must have: d for vector kernels,
+        n_train (K(test, train) columns) for precomputed."""
+        if self.kernel == "precomputed":
+            return int(self.n_train)
         return int(self.x_sv.shape[1])
 
     @classmethod
     def from_train_result(cls, x: np.ndarray, y: np.ndarray,
                           result: TrainResult) -> "SVMModel":
         """Compact SVs (alpha > 0) out of the full training set — the
-        ``aggregate_sv`` step (``svmTrain.cu:595-631``) as one boolean mask."""
+        ``aggregate_sv`` step (``svmTrain.cu:595-631``) as one boolean mask.
+
+        For the precomputed kernel x is the (n, n) kernel matrix; the
+        model keeps SV INDICES (prediction gathers columns of the
+        user-supplied K(test, train)) instead of SV rows."""
         alpha = np.asarray(result.alpha, dtype=np.float32)
         keep = alpha > 0
+        if result.kernel == "precomputed":
+            return cls(
+                x_sv=np.zeros((int(keep.sum()), 0), np.float32),
+                alpha=alpha[keep],
+                y_sv=np.asarray(y, np.int32)[keep],
+                b=float(result.b),
+                gamma=float(result.gamma),
+                kernel=result.kernel,
+                coef0=float(result.coef0),
+                degree=int(result.degree),
+                sv_idx=np.flatnonzero(keep).astype(np.int64),
+                n_train=int(np.asarray(x).shape[0]),
+            )
         return cls(
             x_sv=np.ascontiguousarray(np.asarray(x, np.float32)[keep]),
             alpha=alpha[keep],
@@ -94,6 +121,19 @@ def decision_function(model: SVMModel, x_test: np.ndarray,
                       batch_size: Optional[int] = 8192) -> np.ndarray:
     """dual_i = sum_j alpha_j y_j K(x_j, t_i) [- b], batched on the MXU."""
     x_test = np.asarray(x_test, np.float32)
+    if model.kernel == "precomputed":
+        # x_test is K(test, train): the decision is a column gather of
+        # the SV serials plus one (m, n_sv) @ (n_sv,) product.
+        if x_test.shape[1] != model.num_attributes:
+            raise ValueError(
+                f"precomputed evaluation needs K(test, train) with "
+                f"{model.num_attributes} columns (the training n), got "
+                f"{x_test.shape[1]}")
+        coef_np = (model.alpha * model.y_sv.astype(np.float32))
+        dual = x_test[:, model.sv_idx] @ coef_np
+        if include_b:
+            dual = dual - np.float32(model.b)
+        return dual.astype(np.float32)
     coef = jnp.asarray(model.alpha * model.y_sv.astype(np.float32))
     x_sv = jnp.asarray(model.x_sv)
     sv2 = row_norms_sq(x_sv)

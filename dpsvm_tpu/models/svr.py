@@ -50,7 +50,12 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     path; there is no SVR mode with the drifting clip."""
     from dpsvm_tpu.api import train
 
+    from dpsvm_tpu.utils import densify
+    x = densify(x)
     config = config or SVMConfig()
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "epsilon-SVR does not support the precomputed kernel: the 2n-variable dual duplicates every row, which would need the duplicated (2n, 2n) kernel matrix; use a vector kernel")
     config.validate()
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:
         raise ValueError("class weights are a classification concept; "

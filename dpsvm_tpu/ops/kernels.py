@@ -33,8 +33,11 @@ import jax.numpy as jnp
 class KernelSpec(NamedTuple):
     """Static (hashable, jit-key-safe) kernel description."""
 
-    kind: str = "rbf"        # linear | poly | rbf | sigmoid
-    gamma: float = 1.0       # unused by linear
+    kind: str = "rbf"        # linear | poly | rbf | sigmoid |
+                             # precomputed (LIBSVM -t 4: X IS the
+                             # kernel matrix; a "row fetch" is a gather
+                             # and the x2 slot carries diag(K))
+    gamma: float = 1.0       # unused by linear/precomputed
     coef0: float = 0.0       # poly / sigmoid only
     degree: int = 3          # poly only
 
@@ -58,6 +61,20 @@ def row_norms_sq(x: jax.Array, precision=jax.lax.Precision.HIGHEST) -> jax.Array
     ``thrust::inner_product`` calls in a host loop, ``svmTrain.cu:361-364``.)
     """
     return jnp.einsum("ij,ij->i", x, x, precision=precision)
+
+
+def host_row_stats(x, spec) -> "np.ndarray":
+    """The per-row scalar the solvers thread through as ``x2``: squared
+    row norms for the vector kernels, diag(K) for precomputed (where
+    callers pass the kernel matrix as x). Keeping the diagonal in the
+    same slot lets kdiag_from_norms and every solver path stay
+    kernel-generic."""
+    import numpy as np
+    spec = KernelSpec.coerce(spec)
+    if spec.kind == "precomputed":
+        return np.ascontiguousarray(
+            np.diagonal(np.asarray(x, np.float32))).astype(np.float32)
+    return host_row_norms_sq(x)
 
 
 def host_row_norms_sq(x) -> "np.ndarray":
@@ -113,6 +130,8 @@ def kdiag_from_norms(x2: jax.Array, spec: KernelSpec) -> jax.Array:
         return (spec.gamma * x2 + spec.coef0) ** spec.degree
     if spec.kind == "sigmoid":
         return jnp.tanh(spec.gamma * x2 + spec.coef0)
+    if spec.kind == "precomputed":
+        return x2       # x2 carries diag(K) by convention (host_row_stats)
     raise ValueError(f"unknown kernel kind {spec.kind!r}")
 
 
@@ -124,5 +143,8 @@ def kernel_rows(rows: jax.Array, w2: jax.Array, x: jax.Array, x2: jax.Array,
     the original call convention).
     """
     spec = KernelSpec.coerce(spec)
+    if spec.kind == "precomputed":
+        # The gathered rows ARE the kernel rows (x is K); no matmul.
+        return rows
     dots = jnp.matmul(rows, x.T, precision=precision)
     return rows_from_dots(dots, w2, x2, spec)

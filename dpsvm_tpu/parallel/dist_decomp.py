@@ -149,8 +149,14 @@ def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
 
     # --- exact f32 subproblem kernel (see solver/decomp.py on why the
     # block must NOT be gathered from bf16 dots) -----------------------
-    dots_ww = jnp.matmul(rows, rows.T, precision=lax.Precision.HIGHEST)
-    k_ww = rows_from_dots(dots_ww, x2_w, x2_w, kspec)
+    if kspec.kind == "precomputed":
+        # gathered K rows: the (q, q) block is a column gather of the
+        # stored exact values (global indices)
+        k_ww = rows[:, wi]
+    else:
+        dots_ww = jnp.matmul(rows, rows.T,
+                             precision=lax.Precision.HIGHEST)
+        k_ww = rows_from_dots(dots_ww, x2_w, x2_w, kspec)
 
     # --- replicated WSS2 inner subsolve (identical on every shard,
     # zero communication; shared with solver/decomp.py) ----------------
@@ -180,9 +186,13 @@ def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
     loc = jnp.clip(wi - rank * n_per_shard, 0, n_per_shard - 1)
     alpha_s = alpha_s.at[loc].add(jnp.where(own, dalpha, 0.0))
 
-    xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
-    dots = jnp.matmul(rows, xs_l.T, precision=precision)     # (q, n_s)
-    k_wn = rows_from_dots(dots, x2_w, x2s_l, kspec)
+    if kspec.kind == "precomputed":
+        k_wn = lax.dynamic_slice_in_dim(rows, rank * n_per_shard,
+                                        n_per_shard, axis=1)
+    else:
+        xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
+        dots = jnp.matmul(rows, xs_l.T, precision=precision)  # (q, n_s)
+        k_wn = rows_from_dots(dots, x2_w, x2s_l, kspec)
     f_s = f_s + jnp.matmul((dalpha * y_w)[None, :], k_wn,
                            precision=precision)[0]
 

@@ -42,7 +42,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_norms_sq,
+from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
+                                   host_row_norms_sq,
                                    kdiag_from_norms, rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
@@ -167,6 +168,11 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
     xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
 
     def local_k_row(row, w2):
+        if kspec.kind == "precomputed":
+            # the broadcast row IS the kernel row; take this shard's
+            # column segment
+            return lax.dynamic_slice_in_dim(row, rank * n_per_shard,
+                                            n_per_shard)
         dots = jnp.matmul(row[None, :], xs_l.T, precision=precision)
         return rows_from_dots(dots, w2[None], x2s_l, kspec)[0]
 
@@ -293,7 +299,16 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
 
     # --- kernel rows on the local slice: (2, d) @ (d, n_s) (CS-3) ---
     cache_out = (carry.ck, carry.cs, carry.cr)
-    if use_cache:
+    if kspec.kind == "precomputed":
+        # The gathered working rows carry the FULL (column-padded)
+        # kernel row: eta entries are global-index reads and the local
+        # segment is a slice. (config rejects the cache here.)
+        k_hh = rows[0, i_hi_g]
+        k_ll = rows[1, i_lo_g]
+        k_hl = rows[0, i_lo_g]
+        k_local = lax.dynamic_slice_in_dim(
+            rows, rank * n_per_shard, n_per_shard, axis=1)
+    elif use_cache:
         # Per-shard dot-row cache keyed on GLOBAL working index, exactly
         # the reference's per-rank layout (cache line = this shard's
         # segment, key = global index — svmTrain.cu:142-156). The key
@@ -429,8 +444,20 @@ def prepare_distributed_inputs(x, y, config: SVMConfig, mesh, ckpt,
     n, d = x.shape
     p = mesh.devices.size
     n_pad = ((n + p - 1) // p) * p
-    xp = np.zeros((n_pad, d), np.float32)
-    xp[:n] = x
+    if config.kernel == "precomputed":
+        # pad K on BOTH axes: per-shard column segments must exist for
+        # the padded rows too (padded entries are masked invalid and
+        # their zero kernel values leave f unchanged)
+        xp = np.zeros((n_pad, n_pad), np.float32)
+        xp[:n, :n] = x
+    else:
+        xp = np.zeros((n_pad, d), np.float32)
+        xp[:n] = x
+    # x2 (squared norms, or diag(K) for precomputed) computed on the
+    # UNPADDED rows then zero-padded: diagonal() on the padded matrix
+    # would be wrong (row-padding makes it non-square).
+    x2p = np.zeros((n_pad,), np.float32)
+    x2p[:n] = host_row_stats(x, config.kernel_spec(d))
     yp = np.zeros((n_pad,), np.float32)
     yp[:n] = y
     valid = np.arange(n_pad) < n
@@ -458,7 +485,7 @@ def prepare_distributed_inputs(x, y, config: SVMConfig, mesh, ckpt,
         n_s=n_pad // p,
         xd=jax.device_put(xp, x_sharding),
         yd=jax.device_put(yp, shard),
-        x2=jax.device_put(host_row_norms_sq(xp), x_sharding),
+        x2=jax.device_put(x2p, x_sharding),
         validd=jax.device_put(valid, shard),
         shard=shard, repl=repl, init=init)
 

@@ -46,7 +46,8 @@ import numpy as np
 from jax import lax
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_norms_sq,
+from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
+                                   host_row_norms_sq,
                                    rows_from_dots)
 from dpsvm_tpu.ops.selection import masked_scores_and_masks
 from dpsvm_tpu.ops.update import alpha_pair_step
@@ -199,8 +200,14 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     # The (q, d) @ (d, q) pass is O(q^2 d) — noise next to the (q, n)
     # fetch below.
     rows = x[wi]
-    dots_ww = jnp.matmul(rows, rows.T, precision=lax.Precision.HIGHEST)
-    k_ww = rows_from_dots(dots_ww, x2[wi], x2[wi], kspec)    # (q, q)
+    if kspec.kind == "precomputed":
+        # rows are gathered K rows; the (q, q) block is a column gather
+        # of the stored (exact) values — the PSD concern above is moot.
+        k_ww = rows[:, wi]
+    else:
+        dots_ww = jnp.matmul(rows, rows.T,
+                             precision=lax.Precision.HIGHEST)
+        k_ww = rows_from_dots(dots_ww, x2[wi], x2[wi], kspec)  # (q, q)
 
     y_w = y[wi]
     a_w0 = alpha[wi]
@@ -237,8 +244,11 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     # Padding slots carry dalpha == 0, so duplicate index-0 adds are
     # no-ops; real slots are unique by construction.
     alpha = alpha.at[wi].add(dalpha)
-    dots = jnp.matmul(rows, x.T, precision=precision)        # (q, n)
-    k_wn = rows_from_dots(dots, x2[wi], x2, kspec)           # (q, n)
+    if kspec.kind == "precomputed":
+        k_wn = rows                                          # (q, n)
+    else:
+        dots = jnp.matmul(rows, x.T, precision=precision)    # (q, n)
+        k_wn = rows_from_dots(dots, x2[wi], x2, kspec)       # (q, n)
     f = f + jnp.matmul((dalpha * y_w)[None, :], k_wn,
                        precision=precision)[0]
     return DecompCarry(alpha, f, b_hi, b_lo, carry.n_iter + inner.t)
@@ -308,7 +318,7 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
 
     xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
     yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
-    x2 = jax.device_put(host_row_norms_sq(x), device)
+    x2 = jax.device_put(host_row_stats(x, kspec), device)
     carry = init_carry(np.asarray(y, np.float32))
     if f_init is not None:
         carry = carry._replace(f=np.asarray(f_init, np.float32))

@@ -30,7 +30,8 @@ import numpy as np
 from jax import lax
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
-from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_norms_sq,
+from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
+                                   host_row_norms_sq,
                                    kdiag_from_norms, rows_from_dots)
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
@@ -133,10 +134,13 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         i_lo = jnp.where(use_p, ilp, ilm)
         b_hi_sel = jnp.where(use_p, fup_p[ihp], fup_m[ihm])
         b_lo_sel = jnp.where(use_p, flo_p[ilp], flo_m[ilm])
-        rows = jnp.stack([x[i_hi], x[i_lo]])                 # (2, d)
-        dots = jnp.matmul(rows, x.T, precision=precision)    # (2, n)
-        w2 = jnp.stack([x2[i_hi], x2[i_lo]])
-        k = rows_from_dots(dots, w2, x2, kspec)
+        if kspec.kind == "precomputed":
+            k = jnp.stack([x[i_hi], x[i_lo]])   # gathered K rows
+        else:
+            rows = jnp.stack([x[i_hi], x[i_lo]])             # (2, d)
+            dots = jnp.matmul(rows, x.T, precision=precision)  # (2, n)
+            w2 = jnp.stack([x2[i_hi], x2[i_lo]])
+            k = rows_from_dots(dots, w2, x2, kspec)
         b_hi = b_hi_sel                 # the alpha step's gradient pair
         b_lo = jnp.maximum(gap_p, gap_m)
         cache = carry.cache
@@ -145,9 +149,12 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         i_hi = jnp.argmin(f_up)
         b_hi = f_up[i_hi]
         b_lo = jnp.max(f_low)                       # stopping gap only
-        dots_hi = jnp.matmul(x[i_hi][None, :], x.T,
-                             precision=precision)              # (1, n)
-        k_hi = rows_from_dots(dots_hi, x2[i_hi][None], x2, kspec)[0]
+        if kspec.kind == "precomputed":
+            k_hi = x[i_hi]                      # the gathered K row
+        else:
+            dots_hi = jnp.matmul(x[i_hi][None, :], x.T,
+                                 precision=precision)          # (1, n)
+            k_hi = rows_from_dots(dots_hi, x2[i_hi][None], x2, kspec)[0]
         bb = f_low - b_hi
         if kspec.is_rbf:
             a = jnp.maximum(2.0 - 2.0 * k_hi, 1e-12)
@@ -156,9 +163,13 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
             a = jnp.maximum(kd[i_hi] + kd - 2.0 * k_hi, 1e-12)
         obj = jnp.where(in_low & (bb > 0), bb * bb / a, -1.0)
         i_lo = jnp.argmax(obj)
-        dots_lo = jnp.matmul(x[i_lo][None, :], x.T,
-                             precision=precision)
-        k_lo = rows_from_dots(dots_lo, x2[i_lo][None], x2, kspec)[0]
+        if kspec.kind == "precomputed":
+            k_lo = x[i_lo]
+        else:
+            dots_lo = jnp.matmul(x[i_lo][None, :], x.T,
+                                 precision=precision)
+            k_lo = rows_from_dots(dots_lo, x2[i_lo][None], x2,
+                                  kspec)[0]
         k = jnp.stack([k_hi, k_lo])
         b_lo_sel = f_low[i_lo]                      # alpha step uses the
         cache = carry.cache                         # SELECTED violator
@@ -168,17 +179,23 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         b_lo_sel = b_lo
 
         cache = carry.cache
-        if use_cache:
-            dots, cache = cache_fetch_pair(
-                cache, i_hi, i_lo,
-                lambda: jnp.matmul(jnp.stack([x[i_hi], x[i_lo]]), x.T,
-                                   precision=precision))
+        if kspec.kind == "precomputed":
+            # The fetch is a 2-row gather of K — nothing to cache,
+            # nothing to recompute (config rejects cache_size > 0).
+            k = jnp.stack([x[i_hi], x[i_lo]])
         else:
-            rows = jnp.stack([x[i_hi], x[i_lo]])                 # (2, d)
-            dots = jnp.matmul(rows, x.T, precision=precision)    # (2, n)
+            if use_cache:
+                dots, cache = cache_fetch_pair(
+                    cache, i_hi, i_lo,
+                    lambda: jnp.matmul(jnp.stack([x[i_hi], x[i_lo]]),
+                                       x.T, precision=precision))
+            else:
+                rows = jnp.stack([x[i_hi], x[i_lo]])             # (2, d)
+                dots = jnp.matmul(rows, x.T,
+                                  precision=precision)           # (2, n)
 
-        w2 = jnp.stack([x2[i_hi], x2[i_lo]])
-        k = rows_from_dots(dots, w2, x2, kspec)                  # (2, n)
+            w2 = jnp.stack([x2[i_hi], x2[i_lo]])
+            k = rows_from_dots(dots, w2, x2, kspec)              # (2, n)
 
     eta = k[0, i_hi] + k[1, i_lo] - 2.0 * k[0, i_lo]
     if second_order or guard_eta or nu_selection:
@@ -278,7 +295,7 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
 
     xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
     yd = jax.device_put(jnp.asarray(y, jnp.float32), device)
-    x2 = jax.device_put(host_row_norms_sq(x), device)
+    x2 = jax.device_put(host_row_stats(x, kspec), device)
     carry = init_carry(np.asarray(y, np.float32), config.cache_size)
     if f_init is not None:
         carry = carry._replace(f=np.asarray(f_init, np.float32))

@@ -36,32 +36,16 @@ def require_devices(timeout_s: Optional[float] = None) -> List:
                   "positive number of seconds", file=sys.stderr, flush=True)
             sys.exit(1)
 
-    result: dict = {}
-
-    def probe() -> None:
-        try:
-            import jax
-            result["devices"] = jax.devices()
-        except Exception as e:      # backend raised (e.g. UNAVAILABLE)
-            result["error"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-
-    if t.is_alive():
-        print(f"error: jax backend initialization hung for >{timeout_s:.0f}s "
-              f"(platform={os.environ.get('JAX_PLATFORMS', 'default')!r}); "
-              "the TPU tunnel is unresponsive — not producing a number "
-              "rather than a bogus one", file=sys.stderr, flush=True)
-        # The hung thread holds jax's init lock; a normal exit could
-        # block on atexit hooks that touch the backend.
-        os._exit(1)
-    if "error" in result:
-        print(f"error: jax backend unavailable: {result['error']}",
+    devices, reason = probe_devices(timeout_s)
+    if devices is None:
+        print(f"error: {reason} "
+              f"(platform={os.environ.get('JAX_PLATFORMS', 'default')!r})"
+              " — not producing a number rather than a bogus one",
               file=sys.stderr, flush=True)
+        # A hung probe thread holds jax's init lock; a normal exit
+        # could block on atexit hooks that touch the backend.
         os._exit(1)
-    return result["devices"]
+    return devices
 
 
 def probe_devices(timeout_s: float):
@@ -91,6 +75,12 @@ def probe_devices(timeout_s: float):
     return result["devices"], None
 
 
+def compile_cache_dir() -> str:
+    """The persistent compile-cache directory a run will actually use —
+    the single source for enable_compile_cache and `cli info`."""
+    return os.environ.get("JAX_CACHE_DIR", "/tmp/dpsvm_jaxcache")
+
+
 def enable_compile_cache() -> None:
     """Point jax at a persistent on-disk compile cache.
 
@@ -103,8 +93,7 @@ def enable_compile_cache() -> None:
     try:
         import jax
         jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_CACHE_DIR",
-                                         "/tmp/dpsvm_jaxcache"))
+                          compile_cache_dir())
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as e:

@@ -197,3 +197,14 @@ def test_cli_info(capsys):
     assert "backend: cpu" in out
     assert "native helper:" in out
     assert "compile cache:" in out
+
+
+def test_fit_accepts_scipy_sparse(blobs_small):
+    import scipy.sparse as sp
+
+    x, y = blobs_small
+    model, result = dt.fit(sp.csr_matrix(x),
+                           y, dt.SVMConfig(c=2.0, max_iter=20_000))
+    dense_model, _ = dt.fit(x, y, dt.SVMConfig(c=2.0, max_iter=20_000))
+    assert model.n_sv == dense_model.n_sv
+    np.testing.assert_allclose(model.x_sv, dense_model.x_sv)

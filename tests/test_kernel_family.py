@@ -176,8 +176,12 @@ def test_cli_kernel_flags(tmp_path, blobs_small):
     assert e.value.code == 2
     with pytest.raises(SystemExit) as e:
         main(["train", "-f", data, "-m", str(tmp_path / "x.svm"),
-              "-t", "4"])          # LIBSVM -t 4 (precomputed): unsupported
+              "-t", "5"])          # beyond the LIBSVM -t 0..4 range
     assert e.value.code == 2
+    # -t 4 (precomputed) is supported — but this dataset is not square,
+    # so the train-time shape validation rejects it cleanly
+    assert main(["train", "-f", data, "-m", str(tmp_path / "x.svm"),
+                 "-t", "4", "-q"]) == 2
 
 
 def test_checkpoint_kernel_guard(tmp_path, blobs_small):

@@ -1,0 +1,200 @@
+"""Precomputed kernel (LIBSVM -t 4): x IS the (n, n) kernel matrix.
+
+Design under test: the ``x2`` slot carries diag(K) (host_row_stats),
+kernel "evaluation" is a row/column gather, and the model stores SV
+INDICES (prediction input is K(test, train), LIBSVM's own convention).
+The parity bar is sklearn's SVC(kernel="precomputed") on the same K,
+plus exact trajectory identity with the explicit-RBF path when K is an
+RBF Gram matrix — the strongest possible internal consistency check.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import fit, train
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.models.svm import decision_function
+
+
+def _rbf_gram(x, g):
+    sq = (x ** 2).sum(1)
+    return np.exp(-g * (sq[:, None] + sq[None, :]
+                        - 2.0 * x @ x.T)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def gram_problem():
+    x, y = make_blobs(n=120, d=6, seed=4)
+    g = 0.25
+    return x, y, g, _rbf_gram(x, g)
+
+
+def test_matches_sklearn_and_rbf_trajectory(gram_problem):
+    from sklearn.svm import SVC
+
+    x, y, g, K = gram_problem
+    ref = SVC(C=4.0, kernel="precomputed", tol=1e-3).fit(K, y)
+    model, result = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                        epsilon=5e-4))
+    assert result.converged
+    assert model.n_sv == int(ref.n_support_.sum())
+    dec = decision_function(model, K)
+    np.testing.assert_allclose(dec, ref.decision_function(K),
+                               rtol=1e-3, atol=2e-3)
+    assert (np.where(dec >= 0, 1, -1) == ref.predict(K)).all()
+
+    # Trajectory identity with the explicit-RBF path on the same data:
+    # the gathered-K iteration must be numerically the same algorithm.
+    rbf = train(x, y, SVMConfig(c=4.0, gamma=g, epsilon=5e-4))
+    assert rbf.n_iter == result.n_iter
+    assert abs(rbf.b - result.b) < 1e-5
+
+
+@pytest.mark.parametrize("extra", [
+    {"selection": "second-order"},
+    {"working_set": 32},
+    {"shards": 8},
+    {"shards": 8, "working_set": 32},
+    {"polish": True},
+])
+def test_solver_paths_agree(gram_problem, extra):
+    from sklearn.svm import SVC
+
+    x, y, g, K = gram_problem
+    ref = SVC(C=4.0, kernel="precomputed", tol=1e-3).fit(K, y)
+    model, result = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                        epsilon=5e-4, **extra))
+    assert result.converged, extra
+    dec = decision_function(model, K)
+    assert (np.where(dec >= 0, 1, -1) == ref.predict(K)).all(), extra
+
+
+def test_heldout_prediction_via_column_gather(gram_problem):
+    """The real deployment shape: train on K(train, train), predict
+    with K(test, train) — only the SV columns are consumed."""
+    from sklearn.svm import SVC
+
+    x, y, g, K = gram_problem
+    rng = np.random.default_rng(9)
+    x_test = x + 0.1 * rng.normal(size=x.shape).astype(np.float32)
+    sq_tr = (x ** 2).sum(1)
+    sq_te = (x_test ** 2).sum(1)
+    K_test = np.exp(-g * (sq_te[:, None] + sq_tr[None, :]
+                          - 2.0 * x_test @ x.T)).astype(np.float32)
+
+    ref = SVC(C=4.0, kernel="precomputed", tol=1e-3).fit(K, y)
+    model, _ = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                   epsilon=5e-4))
+    dec = decision_function(model, K_test)
+    np.testing.assert_allclose(dec, ref.decision_function(K_test),
+                               rtol=1e-3, atol=2e-3)
+
+    with pytest.raises(ValueError, match="columns"):
+        decision_function(model, K_test[:, :-1])
+
+
+def test_model_file_roundtrip(gram_problem, tmp_path):
+    from dpsvm_tpu.models.io import load_model, save_model
+
+    x, y, g, K = gram_problem
+    model, _ = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                   epsilon=5e-4))
+    path = str(tmp_path / "pc.svm")
+    wrote = save_model(model, path)
+    assert wrote == model.n_sv
+    back = load_model(path)
+    assert back.kernel == "precomputed"
+    assert back.n_train == model.n_train
+    np.testing.assert_array_equal(back.sv_idx, model.sv_idx)
+    np.testing.assert_allclose(
+        decision_function(back, K), decision_function(model, K),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_cli_train_test_t4(gram_problem, tmp_path):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+
+    x, y, g, K = gram_problem
+    csv = str(tmp_path / "k.csv")
+    save_csv(csv, K, y)
+    model = str(tmp_path / "m.svm")
+    assert main(["train", "-f", csv, "-m", model, "-t", "4",
+                 "-c", "4", "-q"]) == 0
+    assert main(["test", "-f", csv, "-m", model]) == 0
+
+
+def test_guards(gram_problem):
+    x, y, g, K = gram_problem
+    with pytest.raises(ValueError, match="square"):
+        train(K[:, :-1], y, SVMConfig(kernel="precomputed"))
+    with pytest.raises(ValueError, match="shrinking"):
+        SVMConfig(kernel="precomputed", shrinking=True).validate()
+    with pytest.raises(ValueError, match="numpy"):
+        SVMConfig(kernel="precomputed", backend="numpy").validate()
+    with pytest.raises(ValueError, match="cache"):
+        SVMConfig(kernel="precomputed", cache_size=8).validate()
+    with pytest.raises(ValueError, match="Pallas"):
+        SVMConfig(kernel="precomputed", use_pallas="on").validate()
+
+    from dpsvm_tpu.models.svr import train_svr
+    with pytest.raises(ValueError, match="precomputed"):
+        train_svr(K, y.astype(np.float32),
+                  SVMConfig(kernel="precomputed"))
+    from dpsvm_tpu.models.oneclass import train_oneclass
+    with pytest.raises(ValueError, match="precomputed"):
+        train_oneclass(K, 0.5, SVMConfig(kernel="precomputed"))
+    from dpsvm_tpu.models.multiclass import train_multiclass
+    with pytest.raises(ValueError, match="precomputed"):
+        train_multiclass(K, y, SVMConfig(kernel="precomputed"))
+    from dpsvm_tpu.models.cv import cross_validate
+    with pytest.raises(ValueError, match="precomputed"):
+        cross_validate(K, y, 3, SVMConfig(kernel="precomputed"))
+    from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
+    with pytest.raises(ValueError, match="precomputed"):
+        train_nusvc(K, y, 0.3, SVMConfig(kernel="precomputed"))
+    with pytest.raises(ValueError, match="precomputed"):
+        train_nusvr(K, y.astype(np.float32), 0.3,
+                    SVMConfig(kernel="precomputed"))
+
+    model, _ = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                   epsilon=5e-4))
+    from dpsvm_tpu.models.libsvm_io import save_libsvm_model
+    with pytest.raises(ValueError, match="precomputed"):
+        save_libsvm_model(model, "/tmp/should_not_write.model")
+
+
+def test_estimator_precomputed(gram_problem):
+    from dpsvm_tpu.models.estimator import DPSVMClassifier
+
+    x, y, g, K = gram_problem
+    clf = DPSVMClassifier(C=4.0, kernel="precomputed", tol=1e-3)
+    clf.fit(K, y)
+    assert clf.score(K, y) >= 0.95
+
+
+def test_distributed_trajectory_parity_nondivisible_n():
+    """shards=8 at n=101 exercises the square row+column padding; the
+    distributed trajectory must equal single-device exactly (same bar
+    as test_distributed.py for vector kernels)."""
+    x, y = make_blobs(n=101, d=5, seed=7)
+    K = _rbf_gram(x, 0.2)
+    cfg = dict(c=2.0, kernel="precomputed", epsilon=1e-3)
+    single = train(K, y, SVMConfig(**cfg))
+    dist = train(K, y, SVMConfig(shards=8, **cfg))
+    assert dist.n_iter == single.n_iter
+    np.testing.assert_allclose(dist.alpha, single.alpha,
+                               rtol=1e-4, atol=1e-5)
+    assert abs(dist.b - single.b) < 1e-4
+
+
+def test_cli_rejects_libsvm_format_with_t4(tmp_path, capsys):
+    """args-detectable conflict fails before the CSV parse."""
+    from dpsvm_tpu.cli import main
+
+    rc = main(["train", "-f", str(tmp_path / "absent.csv"),
+               "-m", str(tmp_path / "m.model"), "-t", "4",
+               "--model-format", "libsvm", "-q"])
+    assert rc == 2
+    assert "precomputed" in capsys.readouterr().err
