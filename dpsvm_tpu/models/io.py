@@ -107,6 +107,41 @@ def is_libsvm_model(path: str) -> bool:
     return False
 
 
+def _native_load(path: str) -> "Optional[SVMModel]":
+    """Reference-format fast path through the C++ reader (MNIST-scale
+    RBF model files are tens of MB of text). Returns None whenever the
+    native helper is absent, the file uses an extended layout (kernel/
+    task/svidx headers — the C++ side reports -4), or anything fails to
+    parse — the Python reader below is the format authority and the
+    source of error messages, and the native path is never LOOSER."""
+    lib = load_native_lib()
+    if lib is None:
+        return None
+    n_sv = ctypes.c_long()
+    d = ctypes.c_long()
+    has_b = ctypes.c_int()
+    gamma = ctypes.c_double()
+    b = ctypes.c_double()
+    rc = lib.dpsvm_model_shape(path.encode(), ctypes.byref(n_sv),
+                               ctypes.byref(d), ctypes.byref(has_b),
+                               ctypes.byref(gamma), ctypes.byref(b))
+    if rc != 0 or n_sv.value <= 0 or d.value < 1:
+        return None
+    alpha = np.empty((n_sv.value,), np.float32)
+    y = np.empty((n_sv.value,), np.int32)
+    x = np.empty((n_sv.value, d.value), np.float32)
+    got = lib.dpsvm_parse_model(
+        path.encode(),
+        alpha.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_sv.value, d.value, has_b.value)
+    if got != n_sv.value:
+        return None
+    return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=float(b.value),
+                    gamma=float(gamma.value))
+
+
 def load_model(path: str, n_features=None) -> SVMModel:
     """Read a model file (with or without the b line).
 
@@ -119,6 +154,9 @@ def load_model(path: str, n_features=None) -> SVMModel:
     if is_libsvm_model(path):
         from dpsvm_tpu.models.libsvm_io import load_libsvm_model
         return load_libsvm_model(path, n_features=n_features)
+    native = _native_load(path)   # load_native_lib honors DPSVM_NO_NATIVE
+    if native is not None:
+        return native
     with open(path) as f:
         lines = [ln.strip() for ln in f if ln.strip()]
     if len(lines) < 2:

@@ -31,7 +31,9 @@ def _compile() -> bool:
         return False
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp = _LIB + ".tmp"
-    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    # gnu++17 (not c++17): the strict dialect hides POSIX prototypes
+    # like getline(3) that the loader depends on.
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=gnu++17", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.SubprocessError, OSError):
@@ -68,6 +70,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_long,
     ]
     lib.dpsvm_parse_libsvm.restype = ctypes.c_long
+
+    c_double_p = ctypes.POINTER(ctypes.c_double)
+    lib.dpsvm_model_shape.argtypes = [
+        ctypes.c_char_p, c_long_p, c_long_p,
+        ctypes.POINTER(ctypes.c_int), c_double_p, c_double_p,
+    ]
+    lib.dpsvm_model_shape.restype = ctypes.c_int
+
+    lib.dpsvm_parse_model.argtypes = [
+        ctypes.c_char_p, c_float_p, c_int_p, c_float_p,
+        ctypes.c_long, ctypes.c_long, ctypes.c_int,
+    ]
+    lib.dpsvm_parse_model.restype = ctypes.c_long
     return lib
 
 

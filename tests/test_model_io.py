@@ -73,3 +73,87 @@ def test_cli_train_then_test(tmp_path):
     assert rc == 0
     rc = main(["test", "-f", data, "-m", model_path])
     assert rc == 0
+
+
+class TestNativeModelReader:
+    """The C++ reference-format reader must agree with the Python
+    reader bit-for-bit and never be LOOSER (a file that errors without
+    g++ must not silently load with it)."""
+
+    def _roundtrip_both(self, tmp_path, monkeypatch, model):
+        from dpsvm_tpu.models.io import load_model, save_model
+
+        path = str(tmp_path / "m.svm")
+        save_model(model, path)
+        native = load_model(path)
+        monkeypatch.setenv("DPSVM_NO_NATIVE", "1")
+        python = load_model(path)
+        monkeypatch.delenv("DPSVM_NO_NATIVE")
+        return native, python
+
+    def test_bitwise_agreement_with_python_reader(self, tmp_path,
+                                                  monkeypatch,
+                                                  blobs_small):
+        import numpy as np
+
+        from dpsvm_tpu.api import fit
+        from dpsvm_tpu.config import SVMConfig
+        from dpsvm_tpu.native import load_native_lib
+
+        if load_native_lib() is None:
+            import pytest
+            pytest.skip("no native toolchain")
+        x, y = blobs_small
+        model, _ = fit(x, y, SVMConfig(c=4.0, gamma=0.25))
+        native, python = self._roundtrip_both(tmp_path, monkeypatch,
+                                              model)
+        np.testing.assert_array_equal(native.alpha, python.alpha)
+        np.testing.assert_array_equal(native.y_sv, python.y_sv)
+        np.testing.assert_array_equal(native.x_sv, python.x_sv)
+        assert native.b == python.b
+        assert native.gamma == python.gamma
+        assert native.kernel == python.kernel == "rbf"
+
+    def test_extended_formats_fall_through_to_python(self, tmp_path,
+                                                     blobs_small):
+        from dpsvm_tpu.api import fit
+        from dpsvm_tpu.config import SVMConfig
+        from dpsvm_tpu.models.io import _native_load, load_model, \
+            save_model
+
+        x, y = blobs_small
+        model, _ = fit(x, y, SVMConfig(c=2.0, kernel="poly", degree=2,
+                                       coef0=1.0))
+        path = str(tmp_path / "poly.svm")
+        save_model(model, path)
+        assert _native_load(path) is None     # kernel header -> Python
+        assert load_model(path).kernel == "poly"
+
+        # b-less seq.cpp layout: native must handle it identically
+        bless = str(tmp_path / "bless.svm")
+        rbf_model, _ = fit(x, y, SVMConfig(c=2.0, gamma=0.25))
+        save_model(rbf_model, bless)
+        body = open(bless).read().splitlines()
+        open(bless, "w").write("\n".join([body[0]] + body[2:]) + "\n")
+        got = load_model(bless)
+        assert got.b == 0.0
+        assert got.n_sv == rbf_model.n_sv
+
+    def test_native_not_looser_on_malformed(self, tmp_path):
+        import pytest
+
+        from dpsvm_tpu.models.io import load_model
+
+        p = tmp_path / "short.svm"
+        p.write_text("0.25\n0.1\n1.5,1,0.5\n2.0,-1\n")   # ragged SV line
+        with pytest.raises(ValueError):
+            load_model(str(p))
+        p.write_text("0.25\n0.1\n1.5,1,0.5,junk\n")      # garbage field
+        with pytest.raises(ValueError):
+            load_model(str(p))
+        p.write_text("0.25\n0.1 junk\n1.0,1,2.0,3.0\n")  # trailing junk on b
+        with pytest.raises(ValueError):
+            load_model(str(p))
+        p.write_text("0x1p2\n0.1\n1.0,1,2.0,3.0\n")      # hex float gamma
+        with pytest.raises(ValueError):
+            load_model(str(p))
