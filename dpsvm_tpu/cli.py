@@ -248,13 +248,6 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "models; --multiclass writes a directory of "
                   "reference-format per-pair files", file=sys.stderr)
             return 2
-        if args.kernel == "precomputed":
-            # args-detectable: fail before the CSV parse and the train
-            print("error: --model-format libsvm cannot store "
-                  "precomputed-kernel models (0:serial export is not "
-                  "implemented); use the reference format",
-                  file=sys.stderr)
-            return 2
 
     if args.multiclass:
         # Flag conflicts are detectable from args alone — fail before
@@ -566,9 +559,14 @@ def cmd_test(args: argparse.Namespace) -> int:
                            (0, model.num_attributes - x.shape[1])))
         elif (x.shape[1] > model.num_attributes
                 and is_libsvm_model(args.model)):
-            model = dataclasses.replace(model, x_sv=np.pad(
-                model.x_sv,
-                ((0, 0), (0, x.shape[1] - model.num_attributes))))
+            if model.kernel == "precomputed":
+                # LIBSVM stores no n_train; serials only bound it from
+                # below. The data's K(test, train) width is the truth.
+                model = dataclasses.replace(model, n_train=x.shape[1])
+            else:
+                model = dataclasses.replace(model, x_sv=np.pad(
+                    model.x_sv,
+                    ((0, 0), (0, x.shape[1] - model.num_attributes))))
         else:
             print(f"error: dataset has {x.shape[1]} attributes, model "
                   f"has {model.num_attributes}", file=sys.stderr)

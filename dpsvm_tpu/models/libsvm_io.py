@@ -51,7 +51,8 @@ _SVMTYPE_TO_TASK["nu_svc"] = "svc"    # a fitted nu model's decision
 _SVMTYPE_TO_TASK["nu_svr"] = "svr"    # function is the same functional
                                       # form; only training differed
 _KERNEL_TO_LIBSVM = {"linear": "linear", "poly": "polynomial",
-                     "rbf": "rbf", "sigmoid": "sigmoid"}
+                     "rbf": "rbf", "sigmoid": "sigmoid",
+                     "precomputed": "precomputed"}
 _LIBSVM_TO_KERNEL = {v: k for k, v in _KERNEL_TO_LIBSVM.items()}
 
 
@@ -64,10 +65,6 @@ def save_libsvm_model(model: SVMModel, path: str) -> int:
     if model.task not in _TASK_TO_SVMTYPE:
         raise ValueError(f"cannot export task {model.task!r} as a "
                          "LIBSVM model (supported: svc, svr, oneclass)")
-    if model.kernel == "precomputed":
-        raise ValueError("LIBSVM export of precomputed-kernel models "
-                         "(0:serial SV lines) is not implemented — use "
-                         "the reference format (save_model)")
     coef = np.asarray(model.alpha, np.float64) * np.asarray(
         model.y_sv, np.float64)
     x = np.asarray(model.x_sv)
@@ -92,8 +89,12 @@ def save_libsvm_model(model: SVMModel, path: str) -> int:
                   f"rho {model.b:.17g}"]
     lines.append("SV")
     for i in order:
-        feats = " ".join(f"{j + 1}:{v:.9g}"
-                         for j, v in enumerate(x[i]) if v != 0)
+        if model.kernel == "precomputed":
+            # LIBSVM stores the SV as its 1-based training serial
+            feats = f"0:{int(model.sv_idx[i]) + 1}"
+        else:
+            feats = " ".join(f"{j + 1}:{v:.9g}"
+                             for j, v in enumerate(x[i]) if v != 0)
         lines.append(f"{coef[i]:.17g} {feats}")
     with open(path, "w") as fh:
         fh.write("\n".join(lines) + "\n")
@@ -133,9 +134,7 @@ def load_libsvm_model(path: str,
     task = _SVMTYPE_TO_TASK[svm_type]
     ltype = header.get("kernel_type", "rbf")
     if ltype not in _LIBSVM_TO_KERNEL:
-        raise ValueError(f"{path}: unsupported kernel_type {ltype!r} "
-                         "(precomputed kernels have no SV features to "
-                         "load)")
+        raise ValueError(f"{path}: unsupported kernel_type {ltype!r}")
     kernel = _LIBSVM_TO_KERNEL[ltype]
     nr_class = int(header.get("nr_class", 2))
     if task == "svc" and nr_class != 2:
@@ -149,7 +148,51 @@ def load_libsvm_model(path: str,
                          f"got {len(rho_vals)}")
     rho = rho_vals[0]
 
+    def _svc_label_flip(coefs, rho):
+        """LIBSVM's decision is positive for label[0]; ours for +1 —
+        a 'label -1 1' file stores negated coefficients."""
+        labels = [int(v) for v in header.get("label", "1 -1").split()]
+        if sorted(labels) != [-1, 1]:
+            raise ValueError(f"{path}: binary import needs labels "
+                             f"{{-1, 1}}, got {labels} — remap labels "
+                             "at conversion time (cli convert)")
+        if labels[0] == -1:
+            return -coefs, -rho
+        return coefs, rho
+
     coefs = np.empty(len(sv_lines), np.float64)
+    if kernel == "precomputed":
+        if task != "svc":
+            raise ValueError(f"{path}: precomputed import supports "
+                             "c_svc models only")
+        # SV lines are "coef 0:serial" — the SV's 1-based position in
+        # the training set. n_train is not stored by LIBSVM; use
+        # n_features (K(test, train) width) when given, else the
+        # largest serial seen.
+        sv_idx = np.empty(len(sv_lines), np.int64)
+        for i, ln in enumerate(sv_lines):
+            parts = ln.split()
+            if len(parts) != 2 or not parts[1].startswith("0:"):
+                raise ValueError(f"{path}: precomputed SV line {i} must "
+                                 f"be '<coef> 0:<serial>', got {ln!r}")
+            coefs[i] = float(parts[0])
+            serial = int(parts[1][2:])
+            if serial < 1:
+                raise ValueError(f"{path}: SV serial {serial} (LIBSVM "
+                                 "serials are 1-based)")
+            sv_idx[i] = serial - 1
+        coefs, rho_pc = _svc_label_flip(coefs, rho)
+        # LIBSVM stores no n_train: the largest serial only bounds it
+        # from below. Pass n_features (the K(test, train) width) to get
+        # the true width — cli test does.
+        n_train = max(int(sv_idx.max()) + 1, n_features or 0)
+        return SVMModel(
+            x_sv=np.zeros((len(sv_lines), 0), np.float32),
+            alpha=np.abs(coefs).astype(np.float32),
+            y_sv=np.where(coefs >= 0, 1, -1).astype(np.int32),
+            b=rho_pc, gamma=float(header.get("gamma", 1.0)),
+            kernel="precomputed", task="svc",
+            sv_idx=sv_idx, n_train=n_train)
     feats: List[Dict[int, float]] = []
     max_idx = 0
     for i, ln in enumerate(sv_lines):
@@ -173,17 +216,8 @@ def load_libsvm_model(path: str,
         for idx, val in row.items():
             x[i, idx - 1] = val
 
-    # LIBSVM's decision is positive for label[0]; our convention is
-    # positive == +1. A 'label -1 1' file stores negated coefficients.
     if task == "svc":
-        labels = [int(v) for v in header.get("label", "1 -1").split()]
-        if sorted(labels) != [-1, 1]:
-            raise ValueError(f"{path}: binary import needs labels "
-                             f"{{-1, 1}}, got {labels} — remap labels "
-                             "at conversion time (cli convert)")
-        if labels[0] == -1:
-            coefs = -coefs
-            rho = -rho
+        coefs, rho = _svc_label_flip(coefs, rho)
     if task == "oneclass":
         y_sv = np.ones(len(sv_lines), np.int32)
         alpha = coefs.astype(np.float32)

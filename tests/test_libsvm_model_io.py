@@ -165,8 +165,12 @@ def test_rejects_malformed(tmp_path):
                  "rho 0 0 0\nSV\n1.0 1:1\n")
     with pytest.raises(ValueError, match="class"):
         load_libsvm_model(str(p))
-    p.write_text("svm_type c_svc\nkernel_type precomputed\nSV\n1.0 1:1\n")
+    p.write_text("svm_type c_svc\nkernel_type foo\nSV\n1.0 1:1\n")
     with pytest.raises(ValueError, match="kernel_type"):
+        load_libsvm_model(str(p))
+    # precomputed needs 0:serial SV lines
+    p.write_text("svm_type c_svc\nkernel_type precomputed\nSV\n1.0 1:1\n")
+    with pytest.raises(ValueError, match="serial"):
         load_libsvm_model(str(p))
     p.write_text("svm_type c_svc\nkernel_type rbf\nlabel 0 1\nSV\n"
                  "1.0 1:1\n")
