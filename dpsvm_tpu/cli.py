@@ -157,9 +157,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="one-vs-one multi-class training (labels may be "
                          "any integers; -m becomes a model DIRECTORY)")
     tr.add_argument("-b", "--probability", action="store_true",
-                    help="fit Platt-scaled probabilities on the training "
-                         "decision values (LIBSVM -b 1 analog) and save "
-                         "them as a <model>.platt.json sidecar")
+                    help="LIBSVM -b 1 analog: fit Platt-scaled "
+                         "probabilities on the training decision values "
+                         "— a <model>.platt.json sidecar for binary "
+                         "models; per-pair sigmoids in the model "
+                         "directory's index.json with --multiclass "
+                         "(pairwise-coupled at test time)")
     tr.add_argument("--check-kkt", action="store_true",
                     help="post-train optimality report: dual/primal "
                          "objective, duality gap, and the KKT residual "
@@ -175,10 +178,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also write one predicted label per line "
                          "(binary models: 'label,decision_value')")
     te.add_argument("--proba", default=None, metavar="PATH",
-                    help="write Platt-calibrated P(y=+1|x) per line and "
-                         "print Brier score / log-loss (needs the "
-                         "<model>.platt.json sidecar from train "
-                         "--probability)")
+                    help="binary model: write Platt-calibrated "
+                         "P(y=+1|x) per line + Brier/log-loss (needs "
+                         "the <model>.platt.json sidecar). Multiclass "
+                         "model dir: write comma-separated per-class "
+                         "probabilities (pairwise coupling) + log-loss, "
+                         "and predict by the coupled argmax. Both need "
+                         "train --probability")
 
     cv = sub.add_parser(
         "convert", help="dataset converters (the reference's scripts/)")
@@ -257,11 +263,6 @@ def cmd_train(args: argparse.Namespace) -> int:
             print(f"error: -m {args.model} is an existing file; "
                   "--multiclass writes a model DIRECTORY",
                   file=sys.stderr)
-            return 2
-        if args.probability:
-            print("error: --probability calibrates a binary decision "
-                  "value; it does not apply to one-vs-one multiclass "
-                  "models", file=sys.stderr)
             return 2
         if args.check_kkt:
             print("error: --check-kkt reports on a single binary "
@@ -365,9 +366,13 @@ def cmd_train(args: argparse.Namespace) -> int:
         from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
                                                  save_multiclass,
                                                  train_multiclass)
-        mc, results = train_multiclass(x, y, config)
+        mc, results = train_multiclass(x, y, config,
+                                       probability=args.probability)
         save_multiclass(mc, args.model)
         acc = evaluate_multiclass(mc, x, y)
+        if args.probability:
+            print(f"Platt calibration: {len(mc.models)} per-pair "
+                  "sigmoids (pairwise-coupled at test time; LIBSVM -b)")
         print(f"Classes: {[int(c) for c in mc.classes]} "
               f"({len(mc.models)} pairwise models)")
         print(f"Training iterations: "
@@ -515,26 +520,59 @@ def cmd_test(args: argparse.Namespace) -> int:
 
     if os.path.isdir(args.model):
         from dpsvm_tpu.models.multiclass import load_multiclass
-        if args.proba:
-            print("error: --proba applies to binary models only; "
-                  "one-vs-one multiclass models have no calibrated "
-                  "sidecar", file=sys.stderr)
-            return 2
         mc = load_multiclass(args.model)
+        if args.proba and mc.platt is None:
+            print("error: this multiclass model was trained without "
+                  "calibration — train with --multiclass --probability",
+                  file=sys.stderr)
+            return 2
         d_model = mc.models[0].num_attributes
         x, y = load_dataset(args.input, args.num_ex, _width_hint(d_model))
         if x.shape[1] != d_model:
             print(f"error: dataset has {x.shape[1]} attributes, model has "
                   f"{d_model}", file=sys.stderr)
             return 2
-        from dpsvm_tpu.models.multiclass import predict_multiclass
-        pred = predict_multiclass(mc, x, include_b=not args.no_b)
+        from dpsvm_tpu.models.multiclass import (pairwise_decisions,
+                                                 predict_multiclass,
+                                                 predict_proba_multiclass)
+        # One kernel-inference pass per pair, shared by everything
+        # below (each pass is a full (m, d) @ (d, n_sv) evaluation).
+        decisions = pairwise_decisions(mc, x, include_b=not args.no_b)
+        if args.proba:
+            # The sigmoids were fit on intercept-included decisions.
+            dec_b = (pairwise_decisions(mc, x) if args.no_b
+                     else decisions)
+            proba = predict_proba_multiclass(mc, x, decisions=dec_b)
+            # LIBSVM -b 1 predicts by the COUPLED argmax (which can
+            # differ from the OvO vote on ~1% of rows); keep the
+            # written predictions consistent with the written
+            # probabilities.
+            pred = mc.classes[np.argmax(proba, axis=1)]
+        else:
+            proba = None
+            pred = predict_multiclass(mc, x, include_b=not args.no_b,
+                                      decisions=decisions)
         acc = float(np.mean(pred == y))
         if args.predictions:
             with open(args.predictions, "w") as f:
                 f.writelines(f"{int(p)}\n" for p in pred)
         print(f"Classes: {[int(c) for c in mc.classes]}")
         print(f"Test accuracy: {acc:.6f}")
+        if args.proba:
+            with open(args.proba, "w") as f:
+                f.writelines(",".join(f"{v:.6g}" for v in row) + "\n"
+                             for row in proba)
+            cls_index = {int(c): i for i, c in enumerate(mc.classes)}
+            truth = np.asarray([cls_index.get(int(v), -1) for v in y])
+            known = truth >= 0
+            if known.any():
+                pc = np.clip(proba[np.flatnonzero(known), truth[known]],
+                             1e-12, None)
+                print(f"Log-loss: {float(-np.mean(np.log(pc))):.6f} "
+                      f"({int(known.sum())} examples)")
+            else:
+                print("Log-loss: n/a (no test label matches a training "
+                      "class)")
         return 0
 
     model = load_model(args.model)

@@ -151,11 +151,8 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                 state["_platt"] = fit_platt(dec, ypm)
         else:
             from dpsvm_tpu.models.multiclass import train_multiclass
-            if self.probability:
-                raise ValueError("probability=True is binary-only "
-                                 "(one-vs-one voting has no calibrated "
-                                 "decision value)")
-            multi, results = train_multiclass(X, y, self._config())
+            multi, results = train_multiclass(
+                X, y, self._config(), probability=self.probability)
             state.update(
                 _multi=multi,
                 n_iter_=int(sum(r.n_iter for r in results)),
@@ -185,8 +182,19 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         return predict_multiclass(self._multi, X)
 
     def predict_proba(self, X) -> np.ndarray:
-        """(n, 2) [P(class0), P(class1)]; needs probability=True."""
+        """(n, n_classes) probabilities in classes_ order; needs
+        probability=True. Binary: the Platt sigmoid; multiclass:
+        per-pair Platt + pairwise coupling (LIBSVM -b 1)."""
         self._check_fitted()
+        if self._multi is not None:
+            if self._multi.platt is None:
+                raise RuntimeError("fit with probability=True to enable "
+                                   "predict_proba")
+            from dpsvm_tpu.models.multiclass import (
+                predict_proba_multiclass)
+            from dpsvm_tpu.utils import densify
+            return predict_proba_multiclass(
+                self._multi, np.asarray(densify(X), np.float32))
         if getattr(self, "_platt", None) is None:
             raise RuntimeError("fit with probability=True to enable "
                                "predict_proba")

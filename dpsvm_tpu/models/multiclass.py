@@ -30,6 +30,9 @@ class MulticlassModel:
     classes: np.ndarray                    # (k,) sorted original labels
     pairs: List[Tuple[int, int]]           # index pairs into classes
     models: List[SVMModel]                 # one per pair
+    platt: "Optional[List[Tuple[float, float]]]" = None
+                                           # per-pair Platt (A, B) when
+                                           # trained with probability
 
     @property
     def n_classes(self) -> int:
@@ -38,8 +41,14 @@ class MulticlassModel:
 
 def train_multiclass(x: np.ndarray, y: np.ndarray,
                      config: Optional[SVMConfig] = None,
+                     probability: bool = False,
                      ) -> Tuple[MulticlassModel, List[TrainResult]]:
-    """Train OvO; y may hold any integer labels (2 classes work too)."""
+    """Train OvO; y may hold any integer labels (2 classes work too).
+
+    ``probability=True`` fits a per-pair Platt sigmoid on the pair's
+    training decision values (the binary --probability simplification,
+    see models/calibration.py) so ``predict_proba_multiclass`` can
+    couple them — LIBSVM's ``-b 1`` for multiclass."""
     from dpsvm_tpu.api import fit
 
     from dpsvm_tpu.utils import densify
@@ -60,6 +69,7 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     if len(classes) < 2:
         raise ValueError(f"need at least 2 classes, got {classes}")
     pairs, models, results = [], [], []
+    platt: Optional[List[Tuple[float, float]]] = [] if probability else None
     for ai in range(len(classes)):
         for bi in range(ai + 1, len(classes)):
             sel = (y == classes[ai]) | (y == classes[bi])
@@ -69,23 +79,114 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
             pairs.append((ai, bi))
             models.append(model)
             results.append(result)
+            if probability:
+                from dpsvm_tpu.models.calibration import fit_platt
+                dec = np.asarray(decision_function(model, xs))
+                platt.append(fit_platt(dec, ys))
     return MulticlassModel(classes=classes, pairs=pairs,
-                           models=models), results
+                           models=models, platt=platt), results
+
+
+def pairwise_decisions(model: MulticlassModel, x: np.ndarray,
+                       include_b: bool = True) -> List[np.ndarray]:
+    """One decision vector per pair — computed once and shared by the
+    vote and the probability coupling (each pass is a full kernel
+    inference; callers evaluating both must not pay it twice)."""
+    return [np.asarray(decision_function(m, x, include_b=include_b))
+            for m in model.models]
 
 
 def predict_multiclass(model: MulticlassModel, x: np.ndarray,
-                       include_b: bool = True) -> np.ndarray:
+                       include_b: bool = True,
+                       decisions: Optional[List[np.ndarray]] = None,
+                       ) -> np.ndarray:
     """Majority vote over pairwise decisions; ties -> earlier class.
 
     include_b=False drops the intercept like seq_test.cpp:197, matching
-    the binary evaluator's --no-b."""
+    the binary evaluator's --no-b. ``decisions`` reuses a
+    ``pairwise_decisions`` result (include_b must match)."""
+    if decisions is None:
+        decisions = pairwise_decisions(model, x, include_b=include_b)
     n = x.shape[0]
     votes = np.zeros((n, model.n_classes), dtype=np.int32)
-    for (ai, bi), m in zip(model.pairs, model.models):
-        dec = decision_function(m, x, include_b=include_b)
+    for (ai, bi), dec in zip(model.pairs, decisions):
         votes[:, ai] += dec >= 0
         votes[:, bi] += dec < 0
     return model.classes[np.argmax(votes, axis=1)]
+
+
+def _couple_pairwise(r: np.ndarray, max_iter: int = 100,
+                     eps: float = 1e-12) -> np.ndarray:
+    """Class probabilities from pairwise ones (Wu, Lin & Weng 2004,
+    their second method — the one LIBSVM's multiclass -b 1 uses).
+
+    r: (n, k, k) with r[t, i, j] = P(class i | i or j, x_t) and
+    r[t, j, i] = 1 - r[t, i, j]. Minimizes
+    sum_i sum_{j != i} (r[j,i] p_i - r[i,j] p_j)^2 subject to
+    p >= 0, sum p = 1, by the paper's Gauss-Seidel iteration —
+    implemented from the published equations, vectorized over the n
+    samples (every sample runs the same component update in lockstep;
+    convergence is per the max over samples)."""
+    n, k, _ = r.shape
+    if k == 2:
+        p = np.empty((n, 2))
+        p[:, 0] = r[:, 0, 1]
+        p[:, 1] = r[:, 1, 0]
+        return p
+    q = np.zeros((n, k, k))
+    for i in range(k):
+        for j in range(k):
+            if i == j:
+                mask = np.ones(k, bool)
+                mask[i] = False
+                q[:, i, i] = np.sum(r[:, mask, i] ** 2, axis=1)
+            else:
+                q[:, i, j] = -r[:, j, i] * r[:, i, j]
+    p = np.full((n, k), 1.0 / k)
+    for _ in range(max_iter):
+        qp = np.einsum("nij,nj->ni", q, p)
+        pqp = np.einsum("ni,ni->n", p, qp)
+        if np.max(np.abs(qp - pqp[:, None])) < 0.005 / k:
+            break
+        for t in range(k):
+            diff = (-qp[:, t] + pqp) / q[:, t, t]
+            p[:, t] += diff
+            pqp = ((pqp + diff * (diff * q[:, t, t] + 2.0 * qp[:, t]))
+                   / (1.0 + diff) ** 2)
+            qp = (qp + diff[:, None] * q[:, t, :]) / (1.0 + diff)[:, None]
+            p /= (1.0 + diff)[:, None]
+    return np.clip(p, eps, None) / np.sum(
+        np.clip(p, eps, None), axis=1, keepdims=True)
+
+
+def predict_proba_multiclass(model: MulticlassModel, x: np.ndarray,
+                             decisions: Optional[List[np.ndarray]]
+                             = None) -> np.ndarray:
+    """(n, k) class probabilities in ``model.classes`` order via
+    per-pair Platt sigmoids + pairwise coupling (LIBSVM -b 1).
+    ``decisions`` reuses a ``pairwise_decisions`` result (the sigmoids
+    were fit on intercept-included decisions, so it must be one
+    computed with include_b=True)."""
+    from dpsvm_tpu.models.calibration import sigmoid_proba
+
+    if model.platt is None:
+        raise ValueError("this multiclass model was trained without "
+                         "probability calibration — retrain with "
+                         "probability=True (CLI: --multiclass "
+                         "--probability)")
+    if decisions is None:
+        decisions = pairwise_decisions(model, x, include_b=True)
+    n = x.shape[0]
+    k = model.n_classes
+    r = np.zeros((n, k, k))
+    for (ai, bi), dec, (pa, pb) in zip(model.pairs, decisions,
+                                       model.platt):
+        # pair label +1 == class ai (train_multiclass's orientation);
+        # LIBSVM clips coupled inputs away from exact 0/1
+        pr = np.clip(sigmoid_proba(dec, pa, pb), 1e-7, 1.0 - 1e-7)
+        r[:, ai, bi] = pr
+        r[:, bi, ai] = 1.0 - pr
+    return _couple_pairwise(r)
 
 
 def evaluate_multiclass(model: MulticlassModel, x: np.ndarray,
@@ -97,10 +198,14 @@ def evaluate_multiclass(model: MulticlassModel, x: np.ndarray,
 def save_multiclass(model: MulticlassModel, dirpath: str) -> None:
     os.makedirs(dirpath, exist_ok=True)
     entries = []
-    for (ai, bi), m in zip(model.pairs, model.models):
+    for i, ((ai, bi), m) in enumerate(zip(model.pairs, model.models)):
         name = f"pair_{int(model.classes[ai])}_{int(model.classes[bi])}.svm"
         save_model(m, os.path.join(dirpath, name))
-        entries.append({"a": int(ai), "b": int(bi), "file": name})
+        entry = {"a": int(ai), "b": int(bi), "file": name}
+        if model.platt is not None:
+            pa, pb = model.platt[i]
+            entry["platt"] = [float(pa), float(pb)]
+        entries.append(entry)
     with open(os.path.join(dirpath, "index.json"), "w") as f:
         json.dump({"format": "dpsvm_tpu-ovo-v1",
                    "classes": [int(c) for c in model.classes],
@@ -117,8 +222,14 @@ def load_multiclass(dirpath: str) -> MulticlassModel:
         raise ValueError(f"{index_path}: unknown format "
                          f"{index.get('format')!r}")
     classes = np.asarray(index["classes"])
-    pairs, models = [], []
+    pairs, models, platt = [], [], []
     for e in index["pairs"]:
         pairs.append((int(e["a"]), int(e["b"])))
         models.append(load_model(os.path.join(dirpath, e["file"])))
-    return MulticlassModel(classes=classes, pairs=pairs, models=models)
+        if "platt" in e:
+            platt.append((float(e["platt"][0]), float(e["platt"][1])))
+    if platt and len(platt) != len(pairs):
+        raise ValueError(f"{index_path}: {len(platt)} platt entries for "
+                         f"{len(pairs)} pairs — corrupt index")
+    return MulticlassModel(classes=classes, pairs=pairs, models=models,
+                           platt=platt or None)

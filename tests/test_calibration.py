@@ -112,7 +112,10 @@ def test_cli_proba_without_sidecar_errors(tmp_path, capsys):
     assert "platt" in capsys.readouterr().err.lower()
 
 
-def test_cli_probability_rejected_for_multiclass(tmp_path, capsys):
+def test_cli_proba_needs_calibrated_multiclass_model(tmp_path, capsys):
+    """--multiclass --probability is now supported (pairwise coupling,
+    tests/test_multiclass.py); an UNCALIBRATED model dir still rejects
+    test --proba with a pointer to the right flags."""
     from dpsvm_tpu.cli import main
 
     rng = np.random.default_rng(0)
@@ -121,6 +124,9 @@ def test_cli_probability_rejected_for_multiclass(tmp_path, capsys):
     x += y[:, None].astype(np.float32)
     csv = str(tmp_path / "mc.csv")
     save_csv(csv, x, y)
-    assert main(["train", "-f", csv, "-m", str(tmp_path / "mcmodel"),
-                 "--multiclass", "--probability", "-q"]) == 2
-    assert "probability" in capsys.readouterr().err
+    mdir = str(tmp_path / "mcmodel")
+    assert main(["train", "-f", csv, "-m", mdir,
+                 "--multiclass", "-q"]) == 0
+    assert main(["test", "-f", csv, "-m", mdir,
+                 "--proba", str(tmp_path / "p.csv")]) == 2
+    assert "--probability" in capsys.readouterr().err

@@ -81,3 +81,120 @@ def test_ovo_cli_roundtrip(tmp_path, three_class):
     assert rc == 0
     rc = main(["test", "-f", train_csv, "-m", model_dir])
     assert rc == 0
+
+
+class TestMulticlassProbability:
+    """LIBSVM -b 1 for multiclass: per-pair Platt + Wu-Lin-Weng
+    pairwise coupling. sklearn's SVC(probability=True) implements the
+    same coupling (its per-pair sigmoids are CV-fit, ours train-fit —
+    the documented binary simplification), so agreement is the bar."""
+
+    def _three_class(self):
+        rng = np.random.default_rng(3)
+        centers = np.array([[0, 0, 2], [3, 1, -1], [-2, 3, 0]],
+                           np.float32)
+        x = np.concatenate([c + 0.9 * rng.normal(size=(70, 3))
+                            .astype(np.float32) for c in centers])
+        y = np.repeat([0, 1, 2], 70)
+        return x, y
+
+    def test_matches_sklearn_coupling(self):
+        import warnings
+
+        from sklearn.svm import SVC
+
+        from dpsvm_tpu.models.multiclass import (
+            predict_multiclass, predict_proba_multiclass,
+            train_multiclass)
+
+        x, y = self._three_class()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # sklearn probability dep.
+            ref = SVC(C=4.0, gamma=0.3, probability=True,
+                      random_state=0).fit(x, y)
+        mc, _ = train_multiclass(x, y, SVMConfig(c=4.0, gamma=0.3),
+                                 probability=True)
+        p = predict_proba_multiclass(mc, x)
+        np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-9)
+        assert np.abs(p - ref.predict_proba(x)).mean() < 0.02
+        assert (p.argmax(1) == ref.predict_proba(x).argmax(1)).mean() \
+            >= 0.99
+        # argmax of coupled probabilities tracks the OvO vote
+        assert (mc.classes[p.argmax(1)]
+                == predict_multiclass(mc, x)).mean() >= 0.99
+
+    def test_binary_coupling_equals_sigmoid(self):
+        from dpsvm_tpu.models.calibration import sigmoid_proba
+        from dpsvm_tpu.models.multiclass import (
+            predict_proba_multiclass, train_multiclass)
+        from dpsvm_tpu.models.svm import decision_function
+
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(120, 4)).astype(np.float32)
+        y = np.where(x[:, 0] + 0.3 * rng.normal(size=120) > 0, 3, 7)
+        mc, _ = train_multiclass(x, y, SVMConfig(c=2.0),
+                                 probability=True)
+        p = predict_proba_multiclass(mc, x)
+        dec = np.asarray(decision_function(mc.models[0], x))
+        p_pair = np.clip(sigmoid_proba(dec, *mc.platt[0]),
+                         1e-7, 1 - 1e-7)
+        # class order: classes=[3, 7]; pair +1 == class 3
+        np.testing.assert_allclose(p[:, 0], p_pair, atol=1e-12)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from dpsvm_tpu.models.multiclass import (
+            load_multiclass, predict_proba_multiclass, save_multiclass,
+            train_multiclass)
+
+        x, y = self._three_class()
+        mc, _ = train_multiclass(x, y, SVMConfig(c=4.0, gamma=0.3),
+                                 probability=True)
+        d = str(tmp_path / "mcdir")
+        save_multiclass(mc, d)
+        back = load_multiclass(d)
+        assert back.platt is not None
+        np.testing.assert_allclose(
+            predict_proba_multiclass(back, x),
+            predict_proba_multiclass(mc, x), rtol=1e-6, atol=1e-9)
+
+    def test_uncalibrated_model_rejects_proba(self):
+        import pytest
+
+        from dpsvm_tpu.models.multiclass import (
+            predict_proba_multiclass, train_multiclass)
+
+        x, y = self._three_class()
+        mc, _ = train_multiclass(x, y, SVMConfig(c=4.0, gamma=0.3))
+        with pytest.raises(ValueError, match="probability"):
+            predict_proba_multiclass(mc, x)
+
+    def test_cli_multiclass_probability(self, tmp_path):
+        from dpsvm_tpu.cli import main
+        from dpsvm_tpu.data.synthetic import save_csv
+
+        x, y = self._three_class()
+        csv = str(tmp_path / "d.csv")
+        save_csv(csv, x, y)
+        mdir = str(tmp_path / "mdir")
+        assert main(["train", "-f", csv, "-m", mdir, "--multiclass",
+                     "--probability", "-q"]) == 0
+        proba_path = str(tmp_path / "proba.csv")
+        assert main(["test", "-f", csv, "-m", mdir,
+                     "--proba", proba_path]) == 0
+        rows = [ln.split(",") for ln in
+                open(proba_path).read().strip().splitlines()]
+        assert len(rows) == len(y) and len(rows[0]) == 3
+        s = sum(float(v) for v in rows[0])
+        assert abs(s - 1.0) < 1e-4
+
+    def test_estimator_multiclass_proba(self):
+        from dpsvm_tpu.models.estimator import DPSVMClassifier
+
+        x, y = self._three_class()
+        clf = DPSVMClassifier(C=4.0, gamma=0.3, probability=True)
+        clf.fit(x, y)
+        p = clf.predict_proba(x)
+        assert p.shape == (len(y), 3)
+        np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-9)
+        assert (clf.classes_[p.argmax(1)] == clf.predict(x)).mean() \
+            >= 0.99
