@@ -5,12 +5,13 @@ Fits P(y=+1 | dec) = 1 / (1 + exp(A*dec + B)) by regularized maximum
 likelihood with Newton's method (Platt 1999, with the Lin/Weng/Lin 2007
 numerical fixes: target smoothing and a stable log-sum formulation).
 
-Simplification vs LIBSVM, documented: LIBSVM fits on 5-fold
-cross-validated decision values; here the fit uses the training
-decision values directly (one extra inference pass instead of five
-extra trainings). For well-separated data this overestimates
-confidence slightly — prefer a held-out set via ``fit_platt(dec, y)``
-when calibration quality matters.
+Two fit procedures: ``fit_platt`` on the training decision values
+(the cheap default — one extra inference pass; overestimates
+confidence slightly on well-separated data) and ``fit_platt_cv``,
+LIBSVM's actual -b 1 procedure (pool 5-fold held-out decisions at the
+cost of five extra trainings; CLI ``--probability-cv``, estimator
+``probability="cv"`` — measured 8x closer to sklearn's calibrated
+probabilities, tests/test_calibration.py).
 
 Persisted as a ``<model>.platt.json`` sidecar so the reference-format
 model file stays byte-compatible with the reference tooling.
@@ -74,6 +75,45 @@ def fit_platt(dec: np.ndarray, y: np.ndarray,
         else:
             break
     return float(a), float(b)
+
+
+def fit_platt_cv(x: np.ndarray, y: np.ndarray, config,
+                 k: int = 5, seed: int = 0) -> Tuple[float, float]:
+    """LIBSVM-faithful sigmoid fit: pool decision values of k-fold
+    HELD-OUT models, then fit (A, B) on the pooled values.
+
+    This is exactly what svm-train -b 1 does (libsvm's
+    svm_binary_svc_probability): the extra k trainings buy decision
+    values that are not optimistically separated by the very model
+    being calibrated. The plain ``fit_platt`` on training decisions is
+    the documented cheap default; this is the quality option
+    (CLI: --probability-cv).
+    """
+    import dataclasses
+
+    from dpsvm_tpu.api import fit as _fit
+    from dpsvm_tpu.models.cv import kfold_assignment
+
+    # The fold fits are internal: checkpoint/resume/profiling belong to
+    # the caller's MAIN fit. Sharing them here would re-resume a
+    # full-n checkpoint into fold-sized problems (shape error) or let
+    # five fold fits overwrite the real run's checkpoint file.
+    config = dataclasses.replace(config, checkpoint_path=None,
+                                 checkpoint_every=0, resume_from=None,
+                                 profile_dir=None)
+    y = np.asarray(y)
+    fold = kfold_assignment(y, k, seed=seed)
+    dec = np.empty(len(y), np.float64)
+    for f in range(k):
+        tr = fold != f
+        te = ~tr
+        if len(np.unique(y[tr])) < 2:
+            raise ValueError(f"CV-fit calibration: fold {f} leaves a "
+                             "single training class — use fewer folds "
+                             "or plain --probability")
+        model, _ = _fit(np.ascontiguousarray(x[tr]), y[tr], config)
+        dec[te] = np.asarray(decision_function(model, x[te]))
+    return fit_platt(dec, y)
 
 
 def sigmoid_proba(dec: np.ndarray, a: float, b: float) -> np.ndarray:

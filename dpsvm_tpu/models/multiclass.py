@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import List, Optional, Tuple
+from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -41,14 +41,16 @@ class MulticlassModel:
 
 def train_multiclass(x: np.ndarray, y: np.ndarray,
                      config: Optional[SVMConfig] = None,
-                     probability: bool = False,
+                     probability: "Union[bool, str]" = False,
                      ) -> Tuple[MulticlassModel, List[TrainResult]]:
     """Train OvO; y may hold any integer labels (2 classes work too).
 
     ``probability=True`` fits a per-pair Platt sigmoid on the pair's
     training decision values (the binary --probability simplification,
     see models/calibration.py) so ``predict_proba_multiclass`` can
-    couple them — LIBSVM's ``-b 1`` for multiclass."""
+    couple them — LIBSVM's ``-b 1`` for multiclass. ``probability="cv"``
+    fits each pair's sigmoid on k-fold held-out decisions instead
+    (LIBSVM's actual procedure, at k extra trainings per pair)."""
     from dpsvm_tpu.api import fit
 
     from dpsvm_tpu.utils import densify
@@ -80,9 +82,13 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
             models.append(model)
             results.append(result)
             if probability:
-                from dpsvm_tpu.models.calibration import fit_platt
-                dec = np.asarray(decision_function(model, xs))
-                platt.append(fit_platt(dec, ys))
+                from dpsvm_tpu.models.calibration import (fit_platt,
+                                                          fit_platt_cv)
+                if probability == "cv":
+                    platt.append(fit_platt_cv(xs, ys, config))
+                else:
+                    dec = np.asarray(decision_function(model, xs))
+                    platt.append(fit_platt(dec, ys))
     return MulticlassModel(classes=classes, pairs=pairs,
                            models=models, platt=platt), results
 

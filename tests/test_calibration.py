@@ -130,3 +130,67 @@ def test_cli_proba_needs_calibrated_multiclass_model(tmp_path, capsys):
     assert main(["test", "-f", csv, "-m", mdir,
                  "--proba", str(tmp_path / "p.csv")]) == 2
     assert "--probability" in capsys.readouterr().err
+
+
+def test_cv_fit_calibration_matches_sklearn_closer_than_train_fit():
+    """fit_platt_cv pools 5-fold held-out decisions — LIBSVM's actual
+    -b 1 procedure, which sklearn also uses; it must land much closer
+    to sklearn's probabilities than the cheap train-decision fit
+    (measured: 0.008 vs 0.067 mean abs diff at this shape)."""
+    import warnings
+
+    from sklearn.svm import SVC
+
+    from dpsvm_tpu.models.estimator import DPSVMClassifier
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    y = np.where(x[:, 0] + 0.8 * rng.normal(size=300) > 0, 1, -1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = SVC(C=2.0, gamma=0.2, probability=True,
+                  random_state=0).fit(x, y)
+    pr = ref.predict_proba(x)[:, 1]
+
+    diffs = {}
+    for mode in (True, "cv"):
+        clf = DPSVMClassifier(C=2.0, gamma=0.2,
+                              probability=mode).fit(x, y)
+        p = clf.predict_proba(x)[:, 1]
+        diffs[mode] = float(np.abs(p - pr).mean())
+    assert diffs["cv"] < diffs[True]
+    assert diffs["cv"] < 0.03
+
+
+def test_cli_probability_cv(tmp_path):
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=120, d=5, seed=6)
+    csv = str(tmp_path / "d.csv")
+    save_csv(csv, x, y)
+    model = str(tmp_path / "m.svm")
+    assert main(["train", "-f", csv, "-m", model,
+                 "--probability-cv", "-q"]) == 0
+    import os
+    assert os.path.exists(model + ".platt.json")
+    proba = str(tmp_path / "p.txt")
+    assert main(["test", "-f", csv, "-m", model, "--proba", proba]) == 0
+    vals = [float(v) for v in open(proba).read().split()]
+    assert len(vals) == 120 and all(0 < v < 1 for v in vals)
+
+
+def test_multiclass_cv_calibration(tmp_path):
+    from dpsvm_tpu.models.multiclass import (predict_proba_multiclass,
+                                             train_multiclass)
+
+    rng = np.random.default_rng(4)
+    centers = np.array([[0, 0, 2], [3, 1, -1], [-2, 3, 0]], np.float32)
+    x = np.concatenate([c + 0.9 * rng.normal(size=(50, 3))
+                        .astype(np.float32) for c in centers])
+    y = np.repeat([0, 1, 2], 50)
+    mc, _ = train_multiclass(x, y, SVMConfig(c=4.0, gamma=0.3),
+                             probability="cv")
+    p = predict_proba_multiclass(mc, x)
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-9)
+    assert (mc.classes[p.argmax(1)] == y).mean() > 0.9
