@@ -558,15 +558,26 @@ def cmd_test(args: argparse.Namespace) -> int:
         # below (each pass is a full (m, d) @ (d, n_sv) evaluation).
         decisions = pairwise_decisions(mc, x, include_b=not args.no_b)
         if args.proba:
-            # The sigmoids were fit on intercept-included decisions.
-            dec_b = (pairwise_decisions(mc, x) if args.no_b
-                     else decisions)
+            # The sigmoids were fit on intercept-included decisions;
+            # with-b = intercept-free − b per pair, so no second
+            # kernel-inference pass is ever paid.
+            dec_b = ([d - np.float32(m.b)
+                      for d, m in zip(decisions, mc.models)]
+                     if args.no_b else decisions)
             proba = predict_proba_multiclass(mc, x, decisions=dec_b)
-            # LIBSVM -b 1 predicts by the COUPLED argmax (which can
-            # differ from the OvO vote on ~1% of rows); keep the
-            # written predictions consistent with the written
-            # probabilities.
-            pred = mc.classes[np.argmax(proba, axis=1)]
+            if args.no_b:
+                # --no-b asks for intercept-free decisions; the
+                # sigmoids are only defined on intercept-included ones,
+                # so honor the flag for predictions via the OvO vote
+                # and let the proba file carry the (with-b) coupling.
+                pred = predict_multiclass(mc, x, include_b=False,
+                                          decisions=decisions)
+            else:
+                # LIBSVM -b 1 predicts by the COUPLED argmax (which
+                # can differ from the OvO vote on ~1% of rows); keep
+                # the written predictions consistent with the written
+                # probabilities.
+                pred = mc.classes[np.argmax(proba, axis=1)]
         else:
             proba = None
             pred = predict_multiclass(mc, x, include_b=not args.no_b,
@@ -619,7 +630,8 @@ def cmd_test(args: argparse.Namespace) -> int:
             if model.kernel == "precomputed":
                 # LIBSVM stores no n_train; serials only bound it from
                 # below. The data's K(test, train) width is the truth.
-                model = dataclasses.replace(model, n_train=x.shape[1])
+                model = dataclasses.replace(model, n_train=x.shape[1],
+                                            n_train_exact=True)
             else:
                 model = dataclasses.replace(model, x_sv=np.pad(
                     model.x_sv,
@@ -688,8 +700,10 @@ def cmd_test(args: argparse.Namespace) -> int:
                   "train with --probability first", file=sys.stderr)
             return 2
         # The sigmoid was fit on intercept-included decision values;
-        # recompute them if --no-b dropped b from the accuracy pass.
-        dec_b = (decision_function(model, x) if args.no_b else dec)
+        # with-b = intercept-free − b, so --no-b costs no second
+        # kernel-inference pass.
+        dec_b = (np.asarray(dec) - np.float32(model.b)
+                 if args.no_b else dec)
         proba = sigmoid_proba(dec_b, pa, pb)
         with open(args.proba, "w") as f:
             f.writelines(f"{p:.6g}\n" for p in proba)

@@ -56,8 +56,12 @@ def save_model(model: SVMModel, path: str) -> int:
             if model.kernel == "precomputed":
                 # SVs are INDICES into the training set; the svidx line
                 # carries them plus the width K(test, train) must have.
+                # A '+' suffix marks a LOWER-BOUND width (model came
+                # from a LIBSVM import without n_features), so the
+                # relaxed width check survives a native round-trip.
                 idx = " ".join(str(int(i)) for i in model.sv_idx)
-                f.write(f"svidx {int(model.n_train)} {idx}\n")
+                lb = "" if model.n_train_exact else "+"
+                f.write(f"svidx {int(model.n_train)}{lb} {idx}\n")
             f.write(f"{model.b:.9g}\n")
             wrote = 0
             for i in range(n):
@@ -177,13 +181,14 @@ def load_model(path: str, n_features=None) -> SVMModel:
         if task not in ("svc", "svr", "oneclass"):
             raise ValueError(f"{path}: unknown task {task!r}")
         lines = [lines[0]] + lines[2:]
-    sv_idx, n_train = None, None
+    sv_idx, n_train, n_train_exact = None, None, True
     if len(lines) > 1 and lines[1].startswith("svidx "):
         if kernel != "precomputed":
             raise ValueError(f"{path}: svidx line is precomputed-kernel "
                              "only")
         parts = lines[1].split()
-        n_train = int(parts[1])
+        n_train_exact = not parts[1].endswith("+")
+        n_train = int(parts[1].rstrip("+"))
         sv_idx = np.asarray(parts[2:], dtype=np.int64)
         lines = [lines[0]] + lines[2:]
     elif kernel == "precomputed":
@@ -214,4 +219,5 @@ def load_model(path: str, n_features=None) -> SVMModel:
                          f"but there are {n_sv} SV lines")
     return SVMModel(x_sv=x, alpha=alpha, y_sv=y, b=b, gamma=gamma,
                     kernel=kernel, coef0=coef0, degree=degree, task=task,
-                    sv_idx=sv_idx, n_train=n_train)
+                    sv_idx=sv_idx, n_train=n_train,
+                    n_train_exact=n_train_exact)

@@ -65,6 +65,12 @@ def save_libsvm_model(model: SVMModel, path: str) -> int:
     if model.task not in _TASK_TO_SVMTYPE:
         raise ValueError(f"cannot export task {model.task!r} as a "
                          "LIBSVM model (supported: svc, svr, oneclass)")
+    if model.kernel == "precomputed" and model.sv_idx is None:
+        # Validate before opening the file: failing mid-write would
+        # leave a truncated .model behind.
+        raise ValueError("precomputed model has no sv_idx (training "
+                         "serials) — cannot write LIBSVM '0:serial' "
+                         "SV lines")
     coef = np.asarray(model.alpha, np.float64) * np.asarray(
         model.y_sv, np.float64)
     x = np.asarray(model.x_sv)
@@ -192,7 +198,8 @@ def load_libsvm_model(path: str,
             y_sv=np.where(coefs >= 0, 1, -1).astype(np.int32),
             b=rho_pc, gamma=float(header.get("gamma", 1.0)),
             kernel="precomputed", task="svc",
-            sv_idx=sv_idx, n_train=n_train)
+            sv_idx=sv_idx, n_train=n_train,
+            n_train_exact=n_features is not None)
     feats: List[Dict[int, float]] = []
     max_idx = 0
     for i, ln in enumerate(sv_lines):

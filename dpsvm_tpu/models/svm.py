@@ -48,6 +48,13 @@ class SVMModel:
                           # train) and the decision gathers its columns
     n_train: "Optional[int]" = None         # precomputed only: training
                           # n, i.e. the width K(test, train) must have
+    n_train_exact: bool = True              # False only for LIBSVM
+                          # imports without an n_features hint, where
+                          # n_train is max(serial)+1 — a LOWER bound
+                          # (the .model format stores no n_train) — and
+                          # wider K(test, train) is legitimate. The
+                          # native format persists the flag as a '+'
+                          # suffix on the svidx width token.
 
     @property
     def kernel_spec(self) -> KernelSpec:
@@ -124,10 +131,24 @@ def decision_function(model: SVMModel, x_test: np.ndarray,
     if model.kernel == "precomputed":
         # x_test is K(test, train): the decision is a column gather of
         # the SV serials plus one (m, n_sv) @ (n_sv,) product.
-        if x_test.shape[1] != model.num_attributes:
+        # When n_train is known exactly (native models), a width
+        # mismatch means the wrong matrix — stay strict. For LIBSVM
+        # imports without an n_features hint num_attributes is merely
+        # max(serial)+1 — a lower bound — so wider valid K(test, train)
+        # is accepted there (the decision only gathers SV columns).
+        if model.n_train_exact:
+            if x_test.shape[1] != model.num_attributes:
+                raise ValueError(
+                    f"precomputed evaluation needs K(test, train) with "
+                    f"{model.num_attributes} columns (the training n), "
+                    f"got {x_test.shape[1]}")
+        elif x_test.shape[1] < model.num_attributes:
             raise ValueError(
-                f"precomputed evaluation needs K(test, train) with "
-                f"{model.num_attributes} columns (the training n), got "
+                f"precomputed evaluation needs K(test, train) with at "
+                f"least {model.num_attributes} columns (this model came "
+                f"from a LIBSVM file, which stores no n_train; "
+                f"max SV serial + 1 is a lower bound — pass n_features "
+                f"to load_libsvm_model for an exact check), got "
                 f"{x_test.shape[1]}")
         coef_np = (model.alpha * model.y_sv.astype(np.float32))
         dual = x_test[:, model.sv_idx] @ coef_np

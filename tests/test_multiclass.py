@@ -198,3 +198,34 @@ class TestMulticlassProbability:
         np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-9)
         assert (clf.classes_[p.argmax(1)] == clf.predict(x)).mean() \
             >= 0.99
+
+
+def test_cli_no_b_proba_predictions_honor_no_b(tmp_path, three_class):
+    """ADVICE r3: with ``test --no-b --proba`` the predictions file
+    must honor --no-b (OvO vote on intercept-free decisions); only the
+    proba file uses the with-b coupling the sigmoids were fit on."""
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.synthetic import save_csv
+    from dpsvm_tpu.models.multiclass import (load_multiclass,
+                                             predict_multiclass)
+
+    x, y = three_class
+    csv = str(tmp_path / "d.csv")
+    save_csv(csv, x, y)
+    mdir = str(tmp_path / "mdir")
+    assert main(["train", "-f", csv, "-m", mdir, "--multiclass",
+                 "--probability", "-q"]) == 0
+    pred_path = str(tmp_path / "pred.txt")
+    proba_path = str(tmp_path / "proba.csv")
+    assert main(["test", "-f", csv, "-m", mdir, "--no-b",
+                 "--predictions", pred_path,
+                 "--proba", proba_path]) == 0
+    written = np.array([int(v) for v in
+                        open(pred_path).read().split()])
+    mc = load_multiclass(mdir)
+    expect = predict_multiclass(mc, x, include_b=False)
+    assert (written == expect).all()
+    # proba file still present and row-normalised
+    row = [float(v) for v in
+           open(proba_path).readline().strip().split(",")]
+    assert abs(sum(row) - 1.0) < 1e-4

@@ -258,3 +258,79 @@ def test_cli_libsvm_model_when_max_serial_not_sv(tmp_path):
         # fix total_sv/nr_sv counts is unnecessary for our reader
         open(model, "w").write("\n".join(keep) + "\n")
     assert main(["test", "-f", csv, "-m", model]) == 0
+
+
+def test_api_wider_k_accepted_when_libsvm_underreports(gram_problem,
+                                                       tmp_path):
+    """ADVICE r3: a LIBSVM import without n_features sets n_train =
+    max(serial)+1, a LOWER bound whenever the highest-serial training
+    point is not an SV. Direct API callers passing valid full-width
+    K(test, train) must not be rejected — only too-narrow input is an
+    error."""
+    from dpsvm_tpu.models.libsvm_io import (load_libsvm_model,
+                                            save_libsvm_model)
+
+    x, y, g, K = gram_problem
+    model, _ = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                   epsilon=5e-4))
+    path = str(tmp_path / "pc.model")
+    save_libsvm_model(model, path)
+    back = load_libsvm_model(path)          # no n_features hint
+    assert back.n_train <= model.n_train
+    # Full-width K(test, train) is valid input regardless of the hint.
+    dec = decision_function(back, K)
+    np.testing.assert_allclose(dec, decision_function(model, K),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="at least"):
+        decision_function(back, K[:, :back.n_train - 1])
+
+
+def test_save_libsvm_rejects_missing_sv_idx(gram_problem, tmp_path):
+    """ADVICE r3: a precomputed model without sv_idx must fail with a
+    clear ValueError BEFORE the file is opened, not TypeError mid-write
+    leaving a truncated .model behind."""
+    import dataclasses
+
+    from dpsvm_tpu.models.libsvm_io import save_libsvm_model
+
+    x, y, g, K = gram_problem
+    model, _ = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                   epsilon=5e-4))
+    broken = dataclasses.replace(model, sv_idx=None)
+    path = str(tmp_path / "broken.model")
+    with pytest.raises(ValueError, match="sv_idx"):
+        save_libsvm_model(broken, path)
+    import os
+    assert not os.path.exists(path)
+
+
+def test_native_roundtrip_preserves_lower_bound_width(gram_problem,
+                                                      tmp_path):
+    """Review r4: the relaxed width check must survive a native-format
+    round-trip — the svidx line persists the lower-bound marker ('+'),
+    so a re-saved LIBSVM import keeps accepting full-width K."""
+    from dpsvm_tpu.models.io import load_model, save_model
+    from dpsvm_tpu.models.libsvm_io import (load_libsvm_model,
+                                            save_libsvm_model)
+
+    x, y, g, K = gram_problem
+    model, _ = fit(K, y, SVMConfig(c=4.0, kernel="precomputed",
+                                   epsilon=5e-4))
+    lib_path = str(tmp_path / "pc.model")
+    save_libsvm_model(model, lib_path)
+    imported = load_libsvm_model(lib_path)       # no hint: lower bound
+    assert not imported.n_train_exact
+    native_path = str(tmp_path / "pc.native")
+    save_model(imported, native_path)
+    back = load_model(native_path)
+    assert not back.n_train_exact
+    assert back.n_train == imported.n_train
+    np.testing.assert_allclose(decision_function(back, K),
+                               decision_function(model, K),
+                               rtol=1e-5, atol=1e-5)
+    # and an EXACT model stays strict through the same round-trip
+    save_model(model, native_path)
+    strict = load_model(native_path)
+    assert strict.n_train_exact
+    with pytest.raises(ValueError, match="columns"):
+        decision_function(strict, np.pad(K, ((0, 0), (0, 1))))
