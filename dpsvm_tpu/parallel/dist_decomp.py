@@ -10,8 +10,14 @@ Per outer round:
   * one ``all_gather`` merges them; the global top-q/2 per side is a
     replicated stable argsort over the P*q/2 candidates. Stability plus
     contiguous sharding makes the merged selection EQUAL to the
-    single-device top_k (ties resolve to the lowest global index in
-    both), so the distributed trajectory matches single-device decomp;
+    single-device top_k on EQUAL scores (ties resolve to the lowest
+    global index in both), so the distributed trajectory matches
+    single-device decomp whenever the kernel entries agree bitwise —
+    exact at shapes where the sharded (q, d) @ (d, n_s) fetch tiles the
+    d-reduction the same way (asserted in the driver dryrun), while at
+    other shapes one ulp of fetch difference can flip a near-tie and
+    the contract is the equal-quality eps-KKT point of
+    tests/test_dist_decomp.py;
   * the (q, d) working-set rows + their (alpha, f, x2, y) ride ONE
     masked ``psum`` pack from their owner shards (the q-row
     generalization of dist_smo's pair broadcast);
