@@ -417,3 +417,101 @@ class TestNativeLibsvmParser:
         p.write_text("16777217 1:1\n16777216 2:1\n")
         _, y = load_libsvm(str(p))
         assert y.tolist() == [16777217, 16777216]
+
+
+class TestPlantedCalibration:
+    """Head-to-head calibration of make_planted against REAL data
+    (sklearn digits, the round-3 real-data benchmark): the properties
+    the generator docstring states, asserted (round-3 verdict #6).
+
+    Basis of trust for every synthetic perf row in docs/PERF.md: the
+    planted problem must sit in the same kernel regime as real image
+    data at the benchmark (C, gamma) — off-diagonal kernel mass,
+    low effective rank, and SV fraction of the trained model — with the
+    planted side allowed to be HARDER (more SVs), never easier.
+    Measured 2026-07-30 (CPU, f32): off-diag quantiles q10/50/90/99
+    digits [.195 .308 .492 .760] vs planted [.119 .241 .442 .649];
+    eigen top-10 trace fraction .638 vs .574, effective rank 7.9 vs
+    11.2 (600-point subsample); SV fraction .140 vs .279.
+    """
+
+    GAMMA = 0.125     # digits benchmark gamma (tests/test_realdata.py)
+
+    @staticmethod
+    def _digits():
+        datasets = pytest.importorskip("sklearn.datasets")
+
+        ds = datasets.load_digits()
+        x = (ds.data / 16.0).astype(np.float32)
+        y = np.where(ds.target % 2 == 0, 1, -1).astype(np.int32)
+        return x, y
+
+    @staticmethod
+    def _K(x, g):
+        xd = x.astype(np.float64)
+        sq = (xd ** 2).sum(1)
+        d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * xd @ xd.T, 0.0)
+        return np.exp(-g * d2)
+
+    def test_offdiag_kernel_mass_matches_digits(self):
+        """The docstring's calibration target (digits off-diag median
+        ~0.3, p99 ~0.76) holds for the real data, and planted at the
+        same shape/gamma lands within 2x on every quantile — the same
+        (0, 1)-spanning regime, nothing near-identity."""
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        xd, _ = self._digits()
+        xp, _ = make_planted(len(xd), xd.shape[1], self.GAMMA, seed=3)
+        iu = np.triu_indices(len(xd), 1)
+        qs = (0.10, 0.50, 0.90, 0.99)
+        qd = np.quantile(self._K(xd, self.GAMMA)[iu], qs)
+        qp = np.quantile(self._K(xp, self.GAMMA)[iu], qs)
+        assert 0.25 <= qd[1] <= 0.35 and 0.70 <= qd[3] <= 0.82, qd
+        for name, d_v, p_v in zip(qs, qd, qp):
+            assert 0.5 * d_v <= p_v <= 2.0 * d_v, (
+                f"q{int(name*100)}: planted {p_v:.3f} vs digits "
+                f"{d_v:.3f} — outside 2x")
+
+    def test_kernel_spectrum_matches_digits(self):
+        """Both kernels live in the low-effective-rank regime real data
+        has (effective rank << n; an i.i.d. generator's K is
+        near-identity with effective rank ~ n)."""
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        xd, _ = self._digits()
+        xp, _ = make_planted(len(xd), xd.shape[1], self.GAMMA, seed=3)
+        rng = np.random.default_rng(0)
+        sub = rng.choice(len(xd), 600, replace=False)
+        out = {}
+        for name, x in (("digits", xd), ("planted", xp)):
+            ev = np.sort(np.linalg.eigvalsh(self._K(x[sub],
+                                                    self.GAMMA)))[::-1]
+            tr = ev.sum()
+            out[name] = (ev[:10].sum() / tr, tr ** 2 / (ev ** 2).sum())
+        for name, (top10, neff) in out.items():
+            assert top10 >= 0.4, (name, top10)     # real structure
+            assert neff <= 30.0, (name, neff)      # nowhere near ~n
+        # and the two are the SAME regime, within 2.5x effective rank
+        r = out["planted"][1] / out["digits"][1]
+        assert 1 / 2.5 <= r <= 2.5, out
+
+    @pytest.mark.slow
+    def test_sv_fraction_matches_digits(self):
+        """Trained at the digits benchmark config (C=10), the planted
+        problem's SV fraction is within 3x of real digits' and on the
+        HARD side (>=), so synthetic perf rows never flatter the
+        solver. Measured: digits 0.140 (2,246 iters), planted 0.279
+        (5,760 iters)."""
+        from dpsvm_tpu.api import fit
+        from dpsvm_tpu.data.synthetic import make_planted
+
+        xd, yd = self._digits()
+        xp, yp = make_planted(len(xd), xd.shape[1], self.GAMMA, seed=3)
+        cfg = SVMConfig(c=10.0, gamma=self.GAMMA, epsilon=1e-3,
+                        max_iter=200_000)
+        md, rd = fit(xd, yd, cfg)
+        mp, rp = fit(xp, yp, cfg)
+        assert rd.converged and rp.converged
+        fd, fp = md.n_sv / len(yd), mp.n_sv / len(yp)
+        assert 0.03 <= fd <= 0.5 and 0.03 <= fp <= 0.5, (fd, fp)
+        assert fd <= fp <= 3.0 * fd, (fd, fp)
