@@ -55,6 +55,9 @@ def train(x: np.ndarray, y: np.ndarray,
     config = config or SVMConfig()
     config.validate()
     x, y = _check_xy(x, y)
+    # Concretize any "auto" solver-path sentinels now that the problem
+    # shape is known; every path below sees only concrete values.
+    config = config.resolved(x.shape[0], x.shape[1])
     if config.kernel == "precomputed" and x.shape[0] != x.shape[1]:
         raise ValueError("precomputed kernel training needs the square "
                          f"(n, n) kernel matrix as x, got {x.shape}")

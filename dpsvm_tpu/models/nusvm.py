@@ -42,9 +42,18 @@ def _solve_nu(x, y_pm, alpha0, f0, config: SVMConfig) -> TrainResult:
     two-constraint selection has no distributed/decomp variant yet)."""
     from dpsvm_tpu.solver.smo import train_single_device
 
+    # The nu family supports neither shrinking nor decomposition, so
+    # "auto" sentinels always concretize to the classic path here.
+    if config.shrinking == "auto" or config.working_set == 0:
+        config = dataclasses.replace(
+            config,
+            shrinking=(False if config.shrinking == "auto"
+                       else config.shrinking),
+            working_set=(2 if config.working_set == 0
+                         else config.working_set))
     for field, bad in (("shards", config.shards > 1),
                        ("working_set", config.working_set > 2),
-                       ("shrinking", config.shrinking),
+                       ("shrinking", config.shrinking is True),
                        ("cache_size", config.cache_size > 0),
                        ("selection", config.selection != "first-order"),
                        ("select_impl",

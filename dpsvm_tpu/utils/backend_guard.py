@@ -57,6 +57,20 @@ def probe_devices(timeout_s: float):
     """
     result: dict = {}
 
+    # BENCH_PLATFORM=cpu lets any benchmark harness run off-TPU (smoke
+    # tests of the sweep path, iteration-economy runs). The env var
+    # alone is not enough: this image's sitecustomize pre-imports jax
+    # with the axon backend baked into JAX_PLATFORMS, so the switch
+    # must go through jax.config BEFORE the first device use.
+    override = os.environ.get("BENCH_PLATFORM", "").strip()
+    if override:
+        try:
+            import jax
+            jax.config.update("jax_platforms", override)
+        except Exception as e:
+            return None, (f"BENCH_PLATFORM={override!r} could not be "
+                          f"applied: {e}")
+
     def probe() -> None:
         try:
             import jax
@@ -72,7 +86,19 @@ def probe_devices(timeout_s: float):
                       "— the TPU tunnel is unresponsive")
     if "error" in result:
         return None, f"jax backend unavailable: {result['error']}"
-    return result["devices"], None
+    devices = result["devices"]
+    if override:
+        # jax.config.update silently no-ops once a backend is already
+        # initialized; verify the override actually took so a run can
+        # never record numbers attributed to the wrong platform.
+        got = devices[0].platform.lower() if devices else "none"
+        want = override.split(",")[0].strip().lower()
+        if got != want:
+            return None, (f"BENCH_PLATFORM={override!r} did not take "
+                          f"effect (backend already initialized as "
+                          f"{got!r}) — refusing to measure on the "
+                          "wrong platform")
+    return devices, None
 
 
 def compile_cache_dir() -> str:

@@ -208,3 +208,74 @@ def test_fit_accepts_scipy_sparse(blobs_small):
     dense_model, _ = dt.fit(x, y, dt.SVMConfig(c=2.0, max_iter=20_000))
     assert model.n_sv == dense_model.n_sv
     np.testing.assert_allclose(model.x_sv, dense_model.x_sv)
+
+
+class TestAutoSolverSentinels:
+    """The "auto" solver-path machinery (round-4, verdict #2): the
+    sentinels resolve to concrete values before any solver runs, the
+    resolution table is the single place chip-measured defaults land,
+    and — until those chip rows exist — auto is trajectory-identical
+    to the explicit reference-parity defaults."""
+
+    def test_auto_matches_explicit_defaults(self, blobs_small):
+        x, y = blobs_small
+        base = dict(c=2.0, gamma=0.5, epsilon=1e-3, max_iter=20_000)
+        auto = dt.train(x, y, dt.SVMConfig(shrinking="auto",
+                                           working_set=0, **base))
+        expl = dt.train(x, y, dt.SVMConfig(**base))
+        assert auto.n_iter == expl.n_iter
+        np.testing.assert_allclose(auto.alpha, expl.alpha,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_resolved_is_concrete_and_noop_for_concrete(self):
+        cfg = dt.SVMConfig(shrinking="auto", working_set=0)
+        r = cfg.resolved(1000, 64)
+        assert r.shrinking in (True, False)
+        assert r.working_set >= 2
+        concrete = dt.SVMConfig(shrinking=True)
+        assert concrete.resolved(1000, 64) is concrete
+
+    def test_validate_rejects_bad_sentinels(self):
+        with pytest.raises(ValueError, match="shrinking"):
+            dt.SVMConfig(shrinking="yes").validate()
+        with pytest.raises(ValueError, match="working_set"):
+            dt.SVMConfig(working_set=1).validate()
+
+    def test_auto_declines_unsupported_paths(self):
+        # precomputed can never shrink; auto resolves to False, while
+        # explicit True still errors loudly.
+        cfg = dt.SVMConfig(kernel="precomputed", shrinking="auto")
+        cfg.validate()
+        assert cfg.resolved(200, 200).shrinking is False
+        with pytest.raises(ValueError, match="shrinking"):
+            dt.SVMConfig(kernel="precomputed", shrinking=True).validate()
+
+    def test_nu_family_accepts_sentinels(self, blobs_small):
+        from dpsvm_tpu.models.nusvm import train_nusvc
+
+        x, y = blobs_small
+        m_auto, _ = train_nusvc(x, y, nu=0.3, config=dt.SVMConfig(
+            shrinking="auto", working_set=0, max_iter=20_000))
+        m_expl, _ = train_nusvc(x, y, nu=0.3, config=dt.SVMConfig(
+            max_iter=20_000))
+        assert m_auto.n_sv == m_expl.n_sv
+
+
+def test_shrinking_rejects_truthy_nonbool():
+    """Review r4: 1 == True and np.True_ == True would pass an
+    equality membership check yet skip every 'is True' guard while
+    still truthy-dispatching into the shrinking path."""
+    with pytest.raises(ValueError, match="shrinking"):
+        dt.SVMConfig(shrinking=1).validate()
+    with pytest.raises(ValueError, match="shrinking"):
+        dt.SVMConfig(shrinking=np.True_).validate()
+
+
+def test_working_set_auto_rejects_resolution_dependent_knobs():
+    """Review r4: knobs whose meaning depends on which path the
+    sentinel resolves to must be pinned explicitly — validate() and
+    train() must agree, not fail asymmetrically post-resolution."""
+    with pytest.raises(ValueError, match="inner_iters"):
+        dt.SVMConfig(working_set=0, inner_iters=8).validate()
+    with pytest.raises(ValueError, match="use_pallas"):
+        dt.SVMConfig(working_set=0, use_pallas="on").validate()

@@ -77,3 +77,27 @@ def test_backend_guard_times_out_cleanly(tmp_path):
     assert r.returncode == 1
     assert "hung" in r.stderr
     assert "UNREACHABLE" not in r.stdout
+
+
+def test_bench_platform_mismatch_refused(monkeypatch):
+    """Review r4: BENCH_PLATFORM must be VERIFIED, not just applied —
+    jax.config.update silently no-ops once a backend is initialized, and
+    a number measured on the wrong platform must never be recorded. In
+    this process the backend is already up as cpu (conftest), so an
+    override asking for tpu must be refused with a clear reason."""
+    from dpsvm_tpu.utils.backend_guard import probe_devices
+
+    monkeypatch.setenv("BENCH_PLATFORM", "tpu")
+    devices, reason = probe_devices(timeout_s=30)
+    assert devices is None
+    assert "BENCH_PLATFORM" in reason
+
+
+def test_bench_platform_matching_override_passes(monkeypatch):
+    """The override that matches the live backend keeps working."""
+    from dpsvm_tpu.utils.backend_guard import probe_devices
+
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    devices, reason = probe_devices(timeout_s=30)
+    assert reason is None
+    assert devices and devices[0].platform == "cpu"
