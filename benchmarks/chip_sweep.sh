@@ -92,6 +92,18 @@ run conv_decomp4096_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
 run conv_decomp_shrink_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_SHRINKING=1 -- $M
 
+# 1b) WSS2 to-convergence A/B (verdict weak #5: correct implementation,
+#    no earned perf row). At mnist shape WSS2 cuts pair-updates ~0.6x
+#    (CPU economics) paying 2 serial row-matmuls per step; ijcnn1's
+#    372k-iteration trajectory is where a >2x iteration cut would land.
+run conv_wss2 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_SELECTION=second-order -- $M
+run conv_ijcnn1_wss2 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
+    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 \
+    BENCH_SELECTION=second-order -- $M
+run conv_ijcnn1_base 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
+    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 -- $M
+
 # 2) Pallas inner-subsolve kernel A/B (q capped at 2048 by the VMEM
 #    guard): same decomposition config, kernel on vs XLA inner loop.
 run conv_decomp2048      1500 $MNIST BENCH_PRECISION=DEFAULT \

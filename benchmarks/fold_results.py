@@ -1,0 +1,95 @@
+"""Render a chip_sweep results JSONL into the docs/PERF.md table rows.
+
+The sweep records each tagged run's rc, wall seconds, and stdout
+(benchmarks/chip_sweep.sh). The stdout of every harness is one JSON
+line, so folding results into the measurement record is mechanical —
+this script does the mechanical part and prints markdown rows with
+`[sweep <tag>]` provenance, grouped by harness metric, plus a summary
+of failed/missing tags. A human still writes the conclusions.
+
+Usage:  python benchmarks/fold_results.py [results.jsonl]
+        (default: benchmarks/results/chip_sweep_r3.jsonl)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _last_json_line(stdout_lines):
+    """Harness stdout may carry stray lines; the measurement is the
+    LAST parseable JSON object."""
+    for ln in reversed(stdout_lines):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    path = (sys.argv[1] if len(sys.argv) > 1
+            else os.path.join(os.path.dirname(__file__), "results",
+                              "chip_sweep_r3.jsonl"))
+    if not os.path.exists(path):
+        print(f"no results file at {path}", file=sys.stderr)
+        return 1
+    runs = {}           # tag -> latest record (later lines win)
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            rec = json.loads(raw)
+            runs[rec["tag"]] = rec
+
+    ok = {t: r for t, r in runs.items() if r["rc"] == 0}
+    failed = {t: r for t, r in runs.items() if r["rc"] != 0}
+
+    # Group measurements by metric for table-shaped output.
+    by_metric = {}
+    for tag, rec in sorted(ok.items()):
+        m = _last_json_line(rec.get("stdout", []))
+        if m is None:
+            failed[tag] = rec
+            continue
+        by_metric.setdefault(m.get("metric", "?"), []).append((tag, rec, m))
+
+    for metric, rows in sorted(by_metric.items()):
+        print(f"\n### {metric}\n")
+        if metric == "mnist_scale_seconds_to_convergence":
+            print("| tag | seconds | n_iter | converged | n_sv | "
+                  "train acc | provenance |")
+            print("|---|---|---|---|---|---|---|")
+            for tag, rec, m in rows:
+                n_iter = m.get("n_iter")
+                n_iter = f"{n_iter:,}" if isinstance(n_iter, int) else "?"
+                print(f"| {tag} | {m['value']} | {n_iter}"
+                      f" | {m.get('converged')} | {m.get('n_sv', '?')} |"
+                      f" {m.get('train_accuracy', '?')} |"
+                      f" `[sweep {tag}]` |")
+        else:
+            print("| tag | value | unit | extras | provenance |")
+            print("|---|---|---|---|---|")
+            for tag, rec, m in rows:
+                extras = {k: v for k, v in m.items()
+                          if k not in ("metric", "value", "unit")}
+                print(f"| {tag} | {m.get('value')} | {m.get('unit')} |"
+                      f" {json.dumps(extras)} | `[sweep {tag}]` |")
+
+    if failed:
+        print("\n### failed / unparsable tags\n")
+        for tag, rec in sorted(failed.items()):
+            tail = (rec.get("stderr_tail") or ["?"])[-1]
+            print(f"- `{tag}` rc={rec['rc']} {rec['seconds']}s — {tail}")
+    print(f"\n{len(ok)} ok, {len(failed)} failed, "
+          f"{len(runs)} tags total", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

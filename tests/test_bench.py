@@ -82,11 +82,15 @@ def test_backend_guard_times_out_cleanly(tmp_path):
 def test_bench_platform_mismatch_refused(monkeypatch):
     """Review r4: BENCH_PLATFORM must be VERIFIED, not just applied —
     jax.config.update silently no-ops once a backend is initialized, and
-    a number measured on the wrong platform must never be recorded. In
-    this process the backend is already up as cpu (conftest), so an
-    override asking for tpu must be refused with a clear reason."""
+    a number measured on the wrong platform must never be recorded.
+    Initialize the cpu backend HERE (conftest only sets jax.config;
+    order must not matter), then ask for tpu: refusal, with a reason
+    naming the override."""
+    import jax
+
     from dpsvm_tpu.utils.backend_guard import probe_devices
 
+    jax.devices()               # backend comes up as cpu
     monkeypatch.setenv("BENCH_PLATFORM", "tpu")
     devices, reason = probe_devices(timeout_s=30)
     assert devices is None
