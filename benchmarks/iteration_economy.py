@@ -1,0 +1,101 @@
+"""Pair-updates-to-convergence per solver path — hardware-independent.
+
+Wall-clock belongs to the chip (benchmarks/chip_sweep.sh); TRAJECTORY
+LENGTH does not: at exact f32 arithmetic the pair-update count to
+convergence is a property of the algorithm, not the machine. This scan
+measures it per solver path so the auto-dispatch table
+(config._auto_solver_plan) can separate "fewer/more updates" (measured
+here, any platform) from "cheaper/dearer updates" (chip-only). The
+20000x128 row reproduces the scan quoted in solver/decomp.py's tuning
+guide and docs/PERF.md's iteration-economics table.
+
+Prints one JSON line per arm:
+    {"metric": "pair_updates_to_convergence", "arm": ..., "n": ...,
+     "d": ..., "value": <pair updates>, "converged": ..., "n_sv": ...,
+     "seconds": <informational only on cpu>}
+
+Environment:
+    BENCH_PLATFORM  cpu to run off-TPU (recommended: this scan's wall
+                    seconds are NOT the measurement)
+    BENCH_N/BENCH_D/BENCH_C/BENCH_GAMMA/BENCH_EPS/BENCH_MAX_ITER
+    BENCH_ARMS      comma list from: classic, shrink, wss2,
+                    q<Q>, q<Q>c<CAP>, q<Q>shrink
+                    (default: classic,shrink,wss2,q1024,q4096c128)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import _pathfix  # noqa: F401,E402
+
+
+def arm_config(arm: str, base: dict):
+    from dpsvm_tpu.config import SVMConfig
+
+    kw = dict(base)
+    if arm == "classic":
+        pass
+    elif arm == "shrink":
+        kw["shrinking"] = True
+    elif arm == "wss2":
+        kw["selection"] = "second-order"
+    elif arm.startswith("q"):
+        spec = arm[1:]
+        shrink = spec.endswith("shrink")
+        if shrink:
+            spec = spec[: -len("shrink")]
+        if "c" in spec:
+            q_s, cap_s = spec.split("c", 1)
+            kw["inner_iters"] = int(cap_s)
+        else:
+            q_s = spec
+        kw["working_set"] = int(q_s)
+        if shrink:
+            kw["shrinking"] = True
+    else:
+        raise SystemExit(f"unknown arm {arm!r}")
+    return SVMConfig(**kw)
+
+
+def main() -> None:
+    from dpsvm_tpu.utils.backend_guard import require_devices
+
+    require_devices()
+    from bench_common import standin
+
+    from dpsvm_tpu.api import train
+
+    n = int(os.environ.get("BENCH_N", "20000"))
+    d = int(os.environ.get("BENCH_D", "128"))
+    c = float(os.environ.get("BENCH_C", "10"))
+    gamma = float(os.environ.get("BENCH_GAMMA", "0.25"))
+    eps = float(os.environ.get("BENCH_EPS", "1e-3"))
+    max_iter = int(os.environ.get("BENCH_MAX_ITER", "400000"))
+    arms = os.environ.get(
+        "BENCH_ARMS", "classic,shrink,wss2,q1024,q4096c128").split(",")
+
+    x, y = standin(n, d, gamma)
+    base = dict(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
+                matmul_precision="highest")   # exact arithmetic: the
+    # trajectory (and so the update count) is platform-independent.
+    for arm in [a.strip() for a in arms if a.strip()]:
+        cfg = arm_config(arm, base)
+        t0 = time.perf_counter()
+        r = train(x, y, cfg)
+        secs = time.perf_counter() - t0
+        alpha = r.alpha
+        import numpy as np
+        n_sv = int(np.sum(np.asarray(alpha) > 0))
+        print(json.dumps({
+            "metric": "pair_updates_to_convergence", "arm": arm,
+            "n": n, "d": d, "c": c, "gamma": gamma,
+            "value": int(r.n_iter), "converged": bool(r.converged),
+            "n_sv": n_sv, "seconds": round(secs, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,3 +105,42 @@ def test_bench_platform_matching_override_passes(monkeypatch):
     devices, reason = probe_devices(timeout_s=30)
     assert reason is None
     assert devices and devices[0].platform == "cpu"
+
+
+def test_fold_results_renders_and_degrades(tmp_path):
+    """benchmarks/fold_results.py turns sweep JSONL into PERF-ready
+    rows: later lines win per tag, missing keys degrade to '?', failed
+    tags are summarized, and the exit code distinguishes no-file."""
+    rows = [
+        {"tag": "conv_x", "rc": 1, "seconds": 5, "stdout": [],
+         "stderr_tail": ["first attempt died"]},
+        {"tag": "conv_x", "rc": 0, "seconds": 30, "stdout": [
+            "noise line",
+            json.dumps({"metric": "mnist_scale_seconds_to_convergence",
+                        "value": 12.5, "unit": "s", "n_iter": 143000,
+                        "converged": True, "n_sv": 8100,
+                        "train_accuracy": 0.97})], "stderr_tail": []},
+        {"tag": "inf", "rc": 0, "seconds": 9, "stdout": [
+            json.dumps({"metric": "inference_examples_per_sec",
+                        "value": 1e6, "unit": "ex/s"})],
+         "stderr_tail": []},
+        {"tag": "dead", "rc": 3, "seconds": 2, "stdout": [],
+         "stderr_tail": ["tunnel down"]},
+    ]
+    path = tmp_path / "sweep.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "fold_results.py"), str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert "| conv_x | 12.5 | 143,000 | True | 8100 | 0.97 |" in r.stdout
+    assert "[sweep conv_x]" in r.stdout and "[sweep inf]" in r.stdout
+    assert "`dead` rc=3" in r.stdout
+    assert "2 ok, 1 failed" in r.stderr
+    missing = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "fold_results.py"),
+         str(tmp_path / "absent.jsonl")],
+        capture_output=True, text=True, timeout=60)
+    assert missing.returncode == 1
