@@ -279,3 +279,29 @@ def test_working_set_auto_rejects_resolution_dependent_knobs():
         dt.SVMConfig(working_set=0, inner_iters=8).validate()
     with pytest.raises(ValueError, match="use_pallas"):
         dt.SVMConfig(working_set=0, use_pallas="on").validate()
+
+
+def test_cli_shrinking_tri_state(tmp_path):
+    """CLI --shrinking: bare flag = on, explicit 0 = off, 'auto' =
+    shape-resolved sentinel — flip-ready without breaking the flag."""
+    from dpsvm_tpu.cli import build_parser, main
+    from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+
+    parser = build_parser()
+    base = ["train", "-f", "x.csv"]
+    for extra, want in (([], False), (["--shrinking"], True),
+                        (["--shrinking", "0"], False),
+                        (["--shrinking", "1"], True),
+                        (["--shrinking", "auto"], "auto")):
+        got = parser.parse_args(base + extra).shrinking
+        assert got is want or got == want, (extra, got)
+    x, y = make_blobs(n=150, d=8, seed=3)
+    csv = str(tmp_path / "d.csv")
+    save_csv(csv, x, y)
+    for extra in ([], ["--shrinking"], ["--shrinking", "0"],
+                  ["--shrinking", "auto"]):
+        m = str(tmp_path / ("m" + "_".join(extra) + ".svm"))
+        assert main(["train", "-f", csv, "-m", m, "-q"] + extra) == 0
+    with pytest.raises(SystemExit):
+        main(["train", "-f", csv, "-m", str(tmp_path / "x.svm"),
+              "--shrinking", "maybe"])
