@@ -311,7 +311,13 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
     # q=2048 cap 64 -> 66k; q=4096 cap 128 -> 45k (BELOW the pair
     # count). Large blocks with short subsolves buy step quality;
     # rounds (each one (q,d)@(d,n) pass) grow as total/cap — pick the
-    # trade for the hardware's round cost.
+    # trade for the hardware's round cost. The scan is committed and
+    # re-runnable (benchmarks/iteration_economy.py, results in
+    # benchmarks/results/iteration_economy_r4.jsonl); its cross-shape
+    # rows show the economics improve with d (q=4096 cap 128 at
+    # 8000x784: 13k updates, 0.66x the pair count) and fail outright at
+    # small-d/small-gamma (30000x54 C=64: q arms DNF at 600k) — see
+    # docs/PERF.md "Solver-path iteration economics".
     inner_cap = int(config.inner_iters) or max(32, q // 4)
     gamma = float(config.resolve_gamma(d))
     kspec = config.kernel_spec(d)
