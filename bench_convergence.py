@@ -81,6 +81,10 @@ def main() -> None:
         n = int(os.environ.get("BENCH_N", 60_000))
         d = int(os.environ.get("BENCH_D", 784))
         x, y = standin(n=n, d=d, gamma=gamma, seed=0)
+    # Host data gen at the big shapes takes real time; don't let it eat
+    # the stall watchdog's window for the H2D transfer + first compile.
+    from dpsvm_tpu.utils import watchdog
+    watchdog.pet()
 
     # Large chunks cost nothing (the device-side while_loop exits the
     # moment the gap closes — the limit is only a host-poll cadence) and

@@ -35,7 +35,7 @@ run() {  # run <tag> <timeout_s> <env...> -- <cmd...>
               BENCH_EPS=1e-3 BENCH_WORKING_SET=2 BENCH_INNER_ITERS=0
               BENCH_SHRINKING= BENCH_PALLAS=auto BENCH_MAX_ITER=400000
               BENCH_POLISH= BENCH_NO_MEMO= BENCH_VERBOSE=1
-              BENCH_PLATFORM=)
+              BENCH_PLATFORM= BENCH_STALL_TIMEOUT=)
   while [ "$1" != "--" ]; do envs+=("$1"); shift; done
   shift
   if have "$tag"; then echo "SKIP $tag (already recorded)"; return 0; fi
@@ -79,55 +79,55 @@ MNIST="BENCH_N=60000 BENCH_D=784 BENCH_C=10 BENCH_GAMMA=0.25"
 #    pending"). First-run compile of each active-size program is slow on
 #    the tunnel; generous timeouts.
 run conv_shrink      1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_SHRINKING=1 -- $M
+    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp4096  1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=4096 -- $M
+    BENCH_WORKING_SET=4096 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp_shrink 1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=4096 BENCH_SHRINKING=1 -- $M
+    BENCH_WORKING_SET=4096 BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 #    The iteration-economy scan (solver/decomp.py tuning guide) says
 #    q=4096 cap=128 reaches convergence in FEWER pair-updates than the
 #    auto cap q/4=1024 — these arms decide the wall-clock winner.
 run conv_decomp4096_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 -- $M
+    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp_shrink_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_SHRINKING=1 -- $M
+    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 
 # 1b) WSS2 to-convergence A/B (verdict weak #5: correct implementation,
 #    no earned perf row). At mnist shape WSS2 cuts pair-updates ~0.6x
 #    (CPU economics) paying 2 serial row-matmuls per step; ijcnn1's
 #    372k-iteration trajectory is where a >2x iteration cut would land.
 run conv_wss2 1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_SELECTION=second-order -- $M
+    BENCH_SELECTION=second-order BENCH_STALL_TIMEOUT=420 -- $M
 run conv_ijcnn1_wss2 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
     BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 \
-    BENCH_SELECTION=second-order -- $M
+    BENCH_SELECTION=second-order BENCH_STALL_TIMEOUT=420 -- $M
 run conv_ijcnn1_base 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
-    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 -- $M
+    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 BENCH_STALL_TIMEOUT=420 -- $M
 
 # 2) Pallas inner-subsolve kernel A/B (q capped at 2048 by the VMEM
 #    guard): same decomposition config, kernel on vs XLA inner loop.
 run conv_decomp2048      1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=2048 -- $M
+    BENCH_WORKING_SET=2048 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp2048_pal  1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=2048 BENCH_PALLAS=on -- $M
+    BENCH_WORKING_SET=2048 BENCH_PALLAS=on BENCH_STALL_TIMEOUT=420 -- $M
 
 # 3) adult shape with the budget it actually needs (f32+shrinking
 #    converges at 579k iters CPU-verified; the 400k-cap row in PERF.md
 #    is a non-result).
 run conv_adult_1m 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
     BENCH_GAMMA=0.5 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=1000000 \
-    BENCH_SHRINKING=1 -- $M
+    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 #    ... and the exact-arithmetic arm that is CPU-verified to converge
 #    at 579k iters, in case bf16 kernel error stalls the C=100 tail.
 run conv_adult_1m_f32 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
     BENCH_GAMMA=0.5 BENCH_PRECISION=HIGHEST BENCH_MAX_ITER=1000000 \
-    BENCH_SHRINKING=1 -- $M
+    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 
 # 2b) Polishing (arXiv:2207.01016's recipe): bf16 bulk solve + exact-
 #    f32 warm-start refinement. Compare against the pure-f32 ~55-70 s
 #    implied by the 2,922 it/s run_configs row — the polished run's
 #    final KKT holds in exact arithmetic.
-run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 -- $M
+run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 BENCH_STALL_TIMEOUT=420 -- $M
 
 # 3b) The HBM-bound shapes are where decomposition's economics should
 #    win biggest: a 2-violator iteration streams all of X per step
@@ -141,16 +141,16 @@ run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 -- $M
 #    f-update workspace would crowd the v5e's 16 GB HBM.
 run conv_covtype_decomp_q2048 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
     BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
-    BENCH_SHRINKING=1 BENCH_MAX_ITER=3000000 -- $M
+    BENCH_SHRINKING=1 BENCH_MAX_ITER=3000000 BENCH_STALL_TIMEOUT=900 -- $M
 run conv_epsilon_decomp_q2048 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
     BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
-    BENCH_MAX_ITER=200000 -- $M
+    BENCH_MAX_ITER=200000 BENCH_STALL_TIMEOUT=900 -- $M
 #    The 2-violator covtype baseline at a budget sized to roughly the
 #    decomposition arm's wall-clock (~3.9k it/s measured at this shape),
 #    so the A/B compares progress (train_acc, final gap) at equal time.
 run conv_covtype_pair 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
     BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT \
-    BENCH_MAX_ITER=280000 -- $M
+    BENCH_MAX_ITER=280000 BENCH_STALL_TIMEOUT=900 -- $M
 
 # 4) Settle the fused Pallas iteration kernel: head-to-head past the
 #    VMEM cliff (n=120k), the one regime it could win.

@@ -1,0 +1,78 @@
+#!/bin/bash
+# Keep benchmarks/chip_sweep.sh armed across axon tunnel outages.
+#
+# The tunnel flaps (round 3: down the whole round; round 4: up for
+# ~60 s at 01:00 UTC then down again, long enough to start conv_shrink
+# and hang it). This loop probes every ~5 min, logs every transition,
+# and re-invokes the idempotent sweep whenever the chip answers.
+#
+# Outage scrubbing: the stall watchdog (utils/watchdog.py, armed by
+# chip_sweep.sh via BENCH_STALL_TIMEOUT) exits 124 printing a STALL
+# diagnostic to stderr when the device stops answering mid-run, while a
+# genuinely-too-slow run is killed by the outer timeout(1) at its full
+# budget WITHOUT that line. Records whose stderr_tail carries STALL
+# (and no measurement JSON reached stdout) are dead-tunnel artifacts —
+# scrubbed before each re-invocation so the tag's 2-attempt budget is
+# spent on real measurements. Slow-run timeouts and real crashes are
+# never scrubbed; the 2-attempt cap still protects against doomed
+# configs.
+#
+# Usage:  nohup bash benchmarks/sweep_retry.sh >/tmp/sweep_retry.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+RESULTS="benchmarks/results/chip_sweep_r3.jsonl"
+WATCH="/tmp/chip_watch.log"
+
+# Prints one line per scrubbed tag; callers test the output to decide
+# whether the sweep still has work (a scrubbed tag must be re-run).
+scrub_outage_timeouts() {
+  [ -f "$RESULTS" ] || return 0
+  python - "$RESULTS" <<'PY'
+import json, os, sys
+path = sys.argv[1]
+keep, dropped = [], []
+with open(path) as fh:
+    for raw in fh:
+        raw = raw.strip()
+        if not raw:
+            continue
+        r = json.loads(raw)
+        stalled = any("STALL" in ln for ln in r.get("stderr_tail", []))
+        measured = any('"metric"' in ln for ln in r.get("stdout", []))
+        if r.get("rc") == 124 and stalled and not measured:
+            dropped.append(r["tag"])
+        else:
+            keep.append(raw)
+tmp = path + ".tmp"
+with open(tmp, "w") as fh:
+    fh.write("".join(l + "\n" for l in keep))
+os.replace(tmp, path)       # atomic: a crash mid-scrub loses nothing
+if dropped:
+    print("scrubbed outage timeouts:", ", ".join(dropped))
+PY
+}
+
+while true; do
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) UP" >> "$WATCH"
+    scrub_outage_timeouts
+    bash benchmarks/chip_sweep.sh "$RESULTS"
+    rc=$?
+    echo "$(date -u +%FT%TZ) sweep exited rc=$rc" >> "$WATCH"
+    if [ "$rc" -eq 0 ]; then
+      # rc=0 means every tag was attempted, not that every tag was
+      # measured: a watchdog-STALLed tag records rc=124 and the sweep
+      # moves on. Only stop when a post-pass scrub finds nothing to
+      # re-run — otherwise loop so the scrubbed tags get their retry.
+      if [ -z "$(scrub_outage_timeouts)" ]; then
+        echo "$(date -u +%FT%TZ) SWEEP COMPLETE" >> "$WATCH"
+        break
+      fi
+      echo "$(date -u +%FT%TZ) rc=0 but scrubbed stalls remain; looping" \
+        >> "$WATCH"
+    fi
+  else
+    echo "$(date -u +%FT%TZ) DOWN" >> "$WATCH"
+  fi
+  sleep 280
+done

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.utils import watchdog
 from dpsvm_tpu.utils.checkpoint import (SolverCheckpoint, load_checkpoint,
                                         maybe_checkpoint)
 from dpsvm_tpu.utils.logging import log_progress
@@ -81,7 +82,8 @@ def pack_stats(n_iter, b_lo, b_hi):
 
 
 def _read_stats(stats) -> tuple:
-    s = np.asarray(stats)
+    s = np.asarray(stats)       # blocks until the chunk's stats land
+    watchdog.pet()
     b = s[1:].view(np.float32)
     return int(s[0]), float(b[0]), float(b[1])
 
@@ -110,6 +112,9 @@ def host_training_loop(
 
     t0 = time.perf_counter()
     prev_polled = it0
+    # Setup (data gen, H2D, host norms) is done once we get here; give
+    # the stall watchdog a fresh window for the first chunk's compile.
+    watchdog.pet()
     with profile, _debug_nans(config.debug_nans):
         limit = min(it0 + chunk, config.max_iter)
         carry, stats = step_chunk(carry, limit)

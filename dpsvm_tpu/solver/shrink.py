@@ -50,6 +50,7 @@ from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
 from dpsvm_tpu.ops.selection import iup_ilow_masks_np
 from dpsvm_tpu.solver.driver import _read_stats
+from dpsvm_tpu.utils import watchdog
 from dpsvm_tpu.utils.logging import log_progress
 
 # Ceiling on iterations between shrink-rule evaluations (each pulls
@@ -224,6 +225,9 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
             carry = jax.device_put(carry, device)
         step = lambda c, lim: runner(c, xa, ya, x2a, np.int32(lim))
         pull = lambda c: (np.asarray(c.alpha), np.asarray(c.f))
+        # New active size => new compile on first step; fresh stall
+        # window (same reason as the distributed builder below).
+        watchdog.pet()
         return step, pull, carry
 
     placed_full = []        # cached full-set placement: every unshrink
@@ -292,12 +296,18 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                        jax.device_put(np.int32(lim), di.repl))
 
         pull = lambda c: (to_host(c.alpha)[:n_act], to_host(c.f)[:n_act])
+        # Each rebuild means a fresh program (new active size) whose
+        # first step pays a full compile; give the stall watchdog a
+        # fresh window so a healthy compile is never killed as a stall.
+        watchdog.pet()
         return step, pull, carry
 
     active = np.arange(n)
     step, pull, carry = make_active(active)
     it = 0
     last_check = 0
+    # Setup/H2D done; fresh stall-watchdog window for the first compile.
+    watchdog.pet()
     while True:
         limit = min(it + chunk, config.max_iter)
         prev_polled = it

@@ -26,15 +26,7 @@ def require_devices(timeout_s: Optional[float] = None) -> List:
     seconds; a healthy backend never takes minutes).
     """
     if timeout_s is None:
-        raw = os.environ.get("BENCH_BACKEND_TIMEOUT", "180")
-        try:
-            timeout_s = float(raw)
-        except ValueError:
-            timeout_s = -1.0
-        if timeout_s <= 0:
-            print(f"error: BENCH_BACKEND_TIMEOUT={raw!r} must be a "
-                  "positive number of seconds", file=sys.stderr, flush=True)
-            sys.exit(1)
+        timeout_s = _positive_seconds_env("BENCH_BACKEND_TIMEOUT", "180")
 
     devices, reason = probe_devices(timeout_s)
     if devices is None:
@@ -45,7 +37,28 @@ def require_devices(timeout_s: Optional[float] = None) -> List:
         # A hung probe thread holds jax's init lock; a normal exit
         # could block on atexit hooks that touch the backend.
         os._exit(1)
+
+    # On a flapping tunnel a device call can hang AFTER a successful
+    # probe; arm the stall watchdog (pet at every chunk-stats poll,
+    # exit 124 with a STALL diagnostic on expiry) when the harness asks
+    # for it. Library/tests never set the env var.
+    if os.environ.get("BENCH_STALL_TIMEOUT"):
+        from dpsvm_tpu.utils import watchdog
+        watchdog.arm(_positive_seconds_env("BENCH_STALL_TIMEOUT", "0"))
     return devices
+
+
+def _positive_seconds_env(name: str, default: str) -> float:
+    raw = os.environ.get(name, default)
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if val <= 0:
+        print(f"error: {name}={raw!r} must be a positive number of "
+              "seconds", file=sys.stderr, flush=True)
+        sys.exit(1)
+    return val
 
 
 def probe_devices(timeout_s: float):

@@ -1,0 +1,79 @@
+"""Device-stall watchdog for benchmark harnesses on a tunneled TPU.
+
+The axon tunnel flaps (round 3: down for the whole round; round 4: up
+for ~60 s, long enough to start a run and then hang it mid-chunk). A
+harness blocked inside a device call cannot time itself out from
+Python, so a hung tunnel burns the arm's entire outer wall-clock
+timeout and leaves no distinguishing evidence behind. This watchdog
+turns that failure mode into a fast, labeled exit:
+
+* ``arm(timeout_s)`` starts a daemon thread holding a deadline;
+* ``pet()`` pushes the deadline forward — called from the one place
+  every solver path's host loop touches the device result stream
+  (``solver.driver._read_stats``, the per-chunk stats poll);
+* on expiry the thread prints a ``STALL`` diagnostic to stderr and
+  ``os._exit(124)`` — the same exit code as ``timeout(1)``, so sweep
+  tooling treats "device stopped answering" and "killed by outer
+  timeout" uniformly (``benchmarks/sweep_retry.sh`` scrubs rc=124
+  records with no measurement on stdout before re-running a tag).
+
+Never armed by library code: only ``require_devices()`` arms it, and
+only when ``BENCH_STALL_TIMEOUT`` is set (``benchmarks/chip_sweep.sh``
+pins it). Tests and API users are unaffected; ``pet()`` while disarmed
+is a no-op costing one attribute read.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+_lock = threading.Lock()
+_deadline: float | None = None      # None = disarmed
+_timeout = 0.0
+_thread: threading.Thread | None = None
+_POLL_S = 5.0
+
+
+def arm(timeout_s: float) -> None:
+    global _deadline, _timeout, _thread
+    with _lock:
+        _timeout = float(timeout_s)
+        _deadline = time.monotonic() + _timeout
+        if _thread is None:
+            _thread = threading.Thread(
+                target=_watch, name="dpsvm-stall-watchdog", daemon=True)
+            _thread.start()
+
+
+def pet() -> None:
+    """Reset the deadline; no-op while disarmed."""
+    global _deadline
+    if _deadline is None:
+        return
+    with _lock:
+        if _deadline is not None:
+            _deadline = time.monotonic() + _timeout
+
+
+def disarm() -> None:
+    global _deadline
+    with _lock:
+        _deadline = None
+
+
+def _watch() -> None:
+    while True:
+        time.sleep(_POLL_S)
+        with _lock:
+            expired = _deadline is not None and time.monotonic() > _deadline
+            timeout = _timeout
+    # os._exit inside the lock would be fine too, but keep the exit
+    # path trivially deadlock-free.
+        if expired:
+            print(f"STALL: no device response for {timeout:.0f}s "
+                  f"(watchdog armed via BENCH_STALL_TIMEOUT); exiting 124",
+                  file=sys.stderr, flush=True)
+            os._exit(124)

@@ -1,0 +1,80 @@
+"""Stall watchdog (utils/watchdog.py): armed only by harness opt-in,
+petted at every chunk-stats poll, exits 124 with a STALL line when the
+device stops answering. The expiry path is validated in a subprocess
+(os._exit is not catchable in-process)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+from dpsvm_tpu.utils import watchdog
+
+
+def test_pet_disarmed_is_noop():
+    watchdog.pet()          # must not raise, must not start a thread
+    assert watchdog._thread is None or not watchdog._deadline
+
+
+def test_arm_pet_disarm_cycle():
+    watchdog.arm(60.0)
+    try:
+        watchdog.pet()
+        assert watchdog._deadline is not None
+    finally:
+        watchdog.disarm()
+    assert watchdog._deadline is None
+    watchdog.pet()          # disarmed again: no-op
+
+
+def test_read_stats_pets_watchdog():
+    """The one poll point every solver path shares refreshes the
+    deadline."""
+    from dpsvm_tpu.solver.driver import _read_stats, pack_stats
+    import jax.numpy as jnp
+
+    import time
+
+    watchdog.arm(60.0)
+    try:
+        before = watchdog._deadline
+        time.sleep(0.05)
+        stats = np.asarray(
+            pack_stats(jnp.int32(7), jnp.float32(1.5), jnp.float32(-2.0)))
+        n_iter, b_lo, b_hi = _read_stats(stats)
+        assert (n_iter, b_lo, b_hi) == (7, 1.5, -2.0)
+        # Strict: a removed pet() call leaves the deadline unchanged.
+        assert watchdog._deadline > before
+    finally:
+        watchdog.disarm()
+
+
+def test_expiry_exits_124_with_stall_line():
+    code = textwrap.dedent("""
+        import time
+        from dpsvm_tpu.utils import watchdog
+        watchdog._POLL_S = 0.2
+        watchdog.arm(0.5)
+        time.sleep(30)      # watchdog must kill us long before this
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=25, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 124
+    assert "STALL" in proc.stderr
+
+
+def test_require_devices_arms_only_on_env(monkeypatch):
+    monkeypatch.delenv("BENCH_STALL_TIMEOUT", raising=False)
+    from dpsvm_tpu.utils.backend_guard import require_devices
+    watchdog.disarm()
+    require_devices()
+    assert watchdog._deadline is None
+    monkeypatch.setenv("BENCH_STALL_TIMEOUT", "120")
+    require_devices()
+    try:
+        assert watchdog._deadline is not None
+    finally:
+        watchdog.disarm()
