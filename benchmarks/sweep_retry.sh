@@ -25,28 +25,48 @@ WATCH="/tmp/chip_watch.log"
 
 # Prints one line per scrubbed tag; callers test the output to decide
 # whether the sweep still has work (a scrubbed tag must be re-run).
+# Each tag is scrubbed at most 3 times (sidecar counter next to the
+# results file): a config that stalls deterministically — a run wedge,
+# not a tunnel flap — keeps its STALL records after that, so the
+# sweep's own 2-attempt cap engages instead of retrying forever.
 scrub_outage_timeouts() {
   [ -f "$RESULTS" ] || return 0
   python - "$RESULTS" <<'PY'
 import json, os, sys
 path = sys.argv[1]
+side = path + ".scrubs.json"
+try:
+    with open(side) as fh:
+        scrubs = json.load(fh)
+except (OSError, json.JSONDecodeError):
+    scrubs = {}
 keep, dropped = [], []
 with open(path) as fh:
     for raw in fh:
         raw = raw.strip()
         if not raw:
             continue
-        r = json.loads(raw)
+        try:
+            r = json.loads(raw)
+        except json.JSONDecodeError:
+            keep.append(raw)        # never drop what we can't parse
+            continue
         stalled = any("STALL" in ln for ln in r.get("stderr_tail", []))
         measured = any('"metric"' in ln for ln in r.get("stdout", []))
-        if r.get("rc") == 124 and stalled and not measured:
-            dropped.append(r["tag"])
+        tag = r.get("tag", "?")
+        if (r.get("rc") == 124 and stalled and not measured
+                and scrubs.get(tag, 0) < 3):
+            scrubs[tag] = scrubs.get(tag, 0) + 1
+            dropped.append(tag)
         else:
             keep.append(raw)
 tmp = path + ".tmp"
 with open(tmp, "w") as fh:
     fh.write("".join(l + "\n" for l in keep))
 os.replace(tmp, path)       # atomic: a crash mid-scrub loses nothing
+with open(side + ".tmp", "w") as fh:
+    json.dump(scrubs, fh)
+os.replace(side + ".tmp", side)
 if dropped:
     print("scrubbed outage timeouts:", ", ".join(dropped))
 PY
@@ -62,14 +82,17 @@ while true; do
     if [ "$rc" -eq 0 ]; then
       # rc=0 means every tag was attempted, not that every tag was
       # measured: a watchdog-STALLed tag records rc=124 and the sweep
-      # moves on. Only stop when a post-pass scrub finds nothing to
-      # re-run — otherwise loop so the scrubbed tags get their retry.
-      if [ -z "$(scrub_outage_timeouts)" ]; then
+      # moves on. Only stop when a post-pass scrub RAN CLEANLY and
+      # found nothing to re-run — a crashed scrub (non-zero rc) must
+      # loop, not masquerade as completion.
+      scrub_out=$(scrub_outage_timeouts)
+      scrub_rc=$?
+      if [ "$scrub_rc" -eq 0 ] && [ -z "$scrub_out" ]; then
         echo "$(date -u +%FT%TZ) SWEEP COMPLETE" >> "$WATCH"
         break
       fi
-      echo "$(date -u +%FT%TZ) rc=0 but scrubbed stalls remain; looping" \
-        >> "$WATCH"
+      echo "$(date -u +%FT%TZ) rc=0, scrub rc=$scrub_rc out='$scrub_out';" \
+        "looping" >> "$WATCH"
     fi
   else
     echo "$(date -u +%FT%TZ) DOWN" >> "$WATCH"

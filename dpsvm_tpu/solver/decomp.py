@@ -159,7 +159,8 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
                 precision=lax.Precision.HIGHEST,
                 pairwise_clip: bool = False,
                 pallas_inner: bool = False,
-                interpret: bool = False) -> DecompCarry:
+                interpret: bool = False,
+                valid=None) -> DecompCarry:
     """One outer decomposition round (select-q -> block -> subsolve ->
     rank-q update). ``limit`` (traced) caps the round's inner steps so
     ``n_iter`` stops exactly at the budget like every other solver.
@@ -174,7 +175,8 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
         c_box = c
 
     # --- outer selection: top q/2 violators per side --------------------
-    f_up, f_low, in_up, in_low = masked_scores_and_masks(alpha, y, f, c_box)
+    f_up, f_low, in_up, in_low = masked_scores_and_masks(alpha, y, f, c_box,
+                                                         valid=valid)
     _, up_idx = lax.top_k(-f_up, q // 2)        # ascending f: worst first
     _, low_idx = lax.top_k(f_low, q // 2)       # descending f
     b_hi = f_up[up_idx[0]]
@@ -188,7 +190,12 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     active = w_idx >= 0
     wi = jnp.where(active, w_idx, 0)
     # (Every point with alpha in [0, C] is in I_up or I_low, so beyond
-    # the -1 padding no further membership masking is needed.)
+    # the -1 padding no further membership masking is needed — except
+    # capacity-padding rows under the shrinking manager, whose sentinel
+    # scores can still be picked as top_k filler when real violators run
+    # out; they must stay frozen in the subsolve.)
+    if valid is not None:
+        active = active & valid[wi]
 
     # --- the subproblem kernel K_WW, computed EXACTLY (f32 HIGHEST),
     # not gathered from the possibly-bf16 K_WN: in DEFAULT precision a
@@ -258,30 +265,48 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
 def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
                          inner_cap: int, precision_name: str,
                          weights=(1.0, 1.0), pairwise_clip: bool = False,
-                         pallas_inner: bool = False):
+                         pallas_inner: bool = False,
+                         masked: bool = False):
     """Compiled chunk runner with the decomposition outer loop inside;
-    same contract as smo._build_chunk_runner. The interpret-mode policy
-    for the Pallas inner kernel is resolved HERE (off-TPU backends run
-    it interpreted, the CPU test suite's path) so every call site shares
-    one policy."""
+    same contract as smo._build_chunk_runner (including the
+    ``masked=True`` padded-capacity variant for the shrinking manager:
+    an extra dynamic ``n_valid`` before ``limit``). The interpret-mode
+    policy for the Pallas inner kernel is resolved HERE (off-TPU
+    backends run it interpreted, the CPU test suite's path) so every
+    call site shares one policy."""
     from dpsvm_tpu.solver.fused import _should_interpret
 
     interpret = _should_interpret() if pallas_inner else False
     precision = getattr(lax.Precision, precision_name)
     kspec = KernelSpec.coerce(kspec)
 
-    def run(carry: DecompCarry, x, y, x2, limit):
-        final = lax.while_loop(
-            lambda s: (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit),
-            lambda s: decomp_step(s, x, y, x2, c, kspec, q=q,
-                                  inner_cap=inner_cap, epsilon=epsilon,
-                                  limit=limit, weights=weights,
-                                  precision=precision,
-                                  pairwise_clip=pairwise_clip,
-                                  pallas_inner=pallas_inner,
-                                  interpret=interpret),
-            carry)
-        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+    def body(s, x, y, x2, limit, valid):
+        return decomp_step(s, x, y, x2, c, kspec, q=q,
+                           inner_cap=inner_cap, epsilon=epsilon,
+                           limit=limit, weights=weights,
+                           precision=precision,
+                           pairwise_clip=pairwise_clip,
+                           pallas_inner=pallas_inner,
+                           interpret=interpret,
+                           valid=valid)
+
+    if masked:
+        def run(carry: DecompCarry, x, y, x2, n_valid, limit):
+            valid = jnp.arange(x.shape[0], dtype=jnp.int32) < n_valid
+            final = lax.while_loop(
+                lambda s: (s.b_lo > s.b_hi + 2.0 * epsilon)
+                          & (s.n_iter < limit),
+                lambda s: body(s, x, y, x2, limit, valid),
+                carry)
+            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+    else:
+        def run(carry: DecompCarry, x, y, x2, limit):
+            final = lax.while_loop(
+                lambda s: (s.b_lo > s.b_hi + 2.0 * epsilon)
+                          & (s.n_iter < limit),
+                lambda s: body(s, x, y, x2, limit, None),
+                carry)
+            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
 
     return jax.jit(run, donate_argnums=(0,))
 

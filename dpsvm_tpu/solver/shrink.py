@@ -60,6 +60,27 @@ from dpsvm_tpu.utils.logging import log_progress
 SHRINK_CHECK_ITERS = 4096
 
 
+def _bucket_cap(n_act: int, n: int, floor: int = 512) -> int:
+    """Power-of-two program capacity for an active subproblem.
+
+    Every distinct array size is its own XLA program, and on the
+    tunneled TPU a program costs ~0.5-3 s of client compile plus ~3 s of
+    server-side load per process (docs/PERF.md reconciliation table) —
+    paid at every compaction and again at every re-shrink cycle that
+    lands on a new exact size. Quantizing capacities to powers of two
+    (capped at n, floored to keep tiny programs from churning) makes all
+    cycles — and all runs at the same shape, via the persistent compile
+    cache — share one program per bucket, at most log2(n) in total.
+    Padding rows are masked out of every selection rule (the runners'
+    ``masked=True`` variant), so the trajectory is identical to an
+    exact-size subproblem's.
+    """
+    cap = floor
+    while cap < n_act:
+        cap *= 2
+    return min(cap, n)
+
+
 def _host_extrema(alpha, y, f, c_box):
     """(b_hi, b_lo) from host arrays — the full-problem optimality check
     at unshrink time, no device program needed. Membership comes from
@@ -192,14 +213,15 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                                              init_carry)
         runner = _build_decomp_runner(
             float(config.c), kspec, eps, q, inner_cap, precision_name,
-            weights, pairwise, pallas_inner=config.use_pallas == "on")
+            weights, pairwise, pallas_inner=config.use_pallas == "on",
+            masked=True)
     elif not dist:
         from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
         runner = _build_chunk_runner(
             float(config.c), kspec, eps, False, precision_name,
             config.selection == "second-order", weights,
             config.select_impl == "packed", pairwise,
-            guard_eta=guard_eta)
+            guard_eta=guard_eta, masked=True)
 
     def make_active(idx: np.ndarray):
         """(step, pull, carry) for the active subproblem.
@@ -211,20 +233,41 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         bound as the single-device path)."""
         if dist:
             return _make_active_dist(idx)
-        if len(idx) == n:
+        n_act = len(idx)
+        cap = _bucket_cap(max(n_act, min_active), n)
+        pad = cap - n_act
+        if n_act == n:
             xa = xd_full
         else:
             xa = jnp.take(xd_full, jax.device_put(jnp.asarray(idx),
                                                   device), axis=0)
-        ya = jax.device_put(jnp.asarray(y_np[idx]), device)
-        x2a = jax.device_put(jnp.asarray(x2_np[idx]), device)
-        carry = init_carry(y_np[idx]) if decomp else init_carry(
-            y_np[idx], cache_lines=0)
-        carry = carry._replace(alpha=alpha[idx].copy(), f=f[idx].copy())
+        if pad:
+            # Inert capacity padding: zero rows, +1 labels, alpha 0 —
+            # the runner's valid mask (rows < n_act) keeps them out of
+            # every selection rule, so values only need to be finite.
+            xa = jnp.concatenate(
+                [xa, jnp.zeros((pad, xa.shape[1]), xa.dtype)])
+            ya_np = np.concatenate([y_np[idx], np.ones(pad, np.float32)])
+            x2a_np = np.concatenate([x2_np[idx],
+                                     np.zeros(pad, np.float32)])
+            a_seed = np.concatenate([alpha[idx],
+                                     np.zeros(pad, np.float32)])
+            f_seed = np.concatenate([f[idx],
+                                     np.full(pad, SENTINEL, np.float32)])
+        else:
+            ya_np, x2a_np = y_np[idx], x2_np[idx]
+            a_seed, f_seed = alpha[idx].copy(), f[idx].copy()
+        ya = jax.device_put(jnp.asarray(ya_np), device)
+        x2a = jax.device_put(jnp.asarray(x2a_np), device)
+        carry = init_carry(ya_np) if decomp else init_carry(
+            ya_np, cache_lines=0)
+        carry = carry._replace(alpha=a_seed, f=f_seed)
         if device is not None:
             carry = jax.device_put(carry, device)
-        step = lambda c, lim: runner(c, xa, ya, x2a, np.int32(lim))
-        pull = lambda c: (np.asarray(c.alpha), np.asarray(c.f))
+        step = lambda c, lim: runner(c, xa, ya, x2a, np.int32(n_act),
+                                     np.int32(lim))
+        pull = lambda c: (np.asarray(c.alpha)[:n_act],
+                          np.asarray(c.f)[:n_act])
         # New active size => new compile on first step; fresh stall
         # window (same reason as the distributed builder below).
         watchdog.pet()

@@ -79,7 +79,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
              packed_select: bool = False,
              pairwise_clip: bool = False,
              guard_eta: bool = False,
-             nu_selection: bool = False) -> SMOCarry:
+             nu_selection: bool = False,
+             valid: Optional[jax.Array] = None) -> SMOCarry:
     """One modified-SMO iteration (select -> eta -> alpha -> f).
 
     ``second_order`` switches the lo-index choice to the LIBSVM WSS2 rule
@@ -96,6 +97,11 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
 
     ``weights`` = (w_pos, w_neg) class-weights the box bound per example
     (C_i = C * w(y_i)); (1, 1) keeps the exact scalar reference path.
+
+    ``valid`` (optional bool (n,)) masks padding rows out of every
+    selection rule — the shrinking manager pads active subproblems to
+    power-of-two capacities so re-shrink cycles reuse compiled programs
+    (solver/shrink.py). None keeps the exact unmasked path.
     """
     alpha, f = carry.alpha, carry.f
     wp, wn = weights
@@ -119,7 +125,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         # do-while cond `b_lo > b_hi + 2 eps` applies unchanged — the
         # nu wrappers (models/nusvm.py) derive the real intercept/rho
         # from the final state, not from these slots.
-        f_up, f_low, _, _ = masked_scores_and_masks(alpha, y, f, c_box)
+        f_up, f_low, _, _ = masked_scores_and_masks(alpha, y, f, c_box,
+                                                    valid=valid)
         pos = y > 0
         fup_p = jnp.where(pos, f_up, jnp.float32(SENTINEL))
         flo_p = jnp.where(pos, f_low, jnp.float32(-SENTINEL))
@@ -145,7 +152,8 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         b_lo = jnp.maximum(gap_p, gap_m)
         cache = carry.cache
     elif second_order:
-        f_up, f_low, _, in_low = masked_scores_and_masks(alpha, y, f, c_box)
+        f_up, f_low, _, in_low = masked_scores_and_masks(alpha, y, f, c_box,
+                                                         valid=valid)
         i_hi = jnp.argmin(f_up)
         b_hi = f_up[i_hi]
         b_lo = jnp.max(f_low)                       # stopping gap only
@@ -175,7 +183,7 @@ def smo_step(carry: SMOCarry, x: jax.Array, y: jax.Array, x2: jax.Array,
         cache = carry.cache                         # SELECTED violator
     else:
         select = masked_extrema_packed if packed_select else masked_extrema
-        i_hi, b_hi, i_lo, b_lo = select(alpha, y, f, c_box)
+        i_hi, b_hi, i_lo, b_lo = select(alpha, y, f, c_box, valid)
         b_lo_sel = b_lo
 
         cache = carry.cache
@@ -236,13 +244,19 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                         packed_select: bool = False,
                         pairwise_clip: bool = False,
                         guard_eta: bool = False,
-                        nu_selection: bool = False):
+                        nu_selection: bool = False,
+                        masked: bool = False):
     """Compiled chunk runner: run SMO iterations until convergence or the
     iteration limit, entirely on device. Cached per hyperparameter set;
     shapes specialize via jit.
 
     ``kspec`` is a KernelSpec, or a bare gamma float as RBF shorthand
     (the original call convention, kept for the benchmark harnesses).
+
+    ``masked=True`` builds the padded-capacity variant used by the
+    shrinking manager: ``run`` takes an extra dynamic ``n_valid`` i32
+    before ``limit`` and masks rows >= n_valid out of selection. Kept a
+    build-time flag so the headline unmasked path pays nothing for it.
     """
     precision = getattr(lax.Precision, precision_name)
     kspec = KernelSpec.coerce(kspec)
@@ -250,24 +264,37 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
     def cond(carry: SMOCarry, limit):
         return (carry.b_lo > carry.b_hi + 2.0 * epsilon) & (carry.n_iter < limit)
 
-    def run(carry: SMOCarry, x, y, x2, limit):
-        final = lax.while_loop(
-            lambda s: cond(s, limit),
-            lambda s: smo_step(s, x, y, x2, c, kspec,
-                               use_cache=use_cache,
-                               second_order=second_order,
-                               weights=weights,
-                               precision=precision,
-                               packed_select=packed_select,
-                               pairwise_clip=pairwise_clip,
-                               guard_eta=guard_eta,
-                               nu_selection=nu_selection),
-            carry)
-        # Poll stats packed inside the same program: the host reads one
-        # (3,) array per chunk instead of three blocking scalars, and no
-        # auxiliary XLA program exists to pay first-compile overhead
-        # (solver/driver.py "Poll economics").
-        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+    def body(s, x, y, x2, valid):
+        return smo_step(s, x, y, x2, c, kspec,
+                        use_cache=use_cache,
+                        second_order=second_order,
+                        weights=weights,
+                        precision=precision,
+                        packed_select=packed_select,
+                        pairwise_clip=pairwise_clip,
+                        guard_eta=guard_eta,
+                        nu_selection=nu_selection,
+                        valid=valid)
+
+    # Poll stats packed inside the same program: the host reads one
+    # (3,) array per chunk instead of three blocking scalars, and no
+    # auxiliary XLA program exists to pay first-compile overhead
+    # (solver/driver.py "Poll economics").
+    if masked:
+        def run(carry: SMOCarry, x, y, x2, n_valid, limit):
+            valid = jnp.arange(x.shape[0], dtype=jnp.int32) < n_valid
+            final = lax.while_loop(
+                lambda s: cond(s, limit),
+                lambda s: body(s, x, y, x2, valid),
+                carry)
+            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+    else:
+        def run(carry: SMOCarry, x, y, x2, limit):
+            final = lax.while_loop(
+                lambda s: cond(s, limit),
+                lambda s: body(s, x, y, x2, None),
+                carry)
+            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
 
     return jax.jit(run, donate_argnums=(0,))
 

@@ -1,0 +1,74 @@
+"""Power-of-two capacity bucketing in the shrinking manager
+(solver/shrink.py::_bucket_cap + the runners' masked variants).
+
+The claim that licenses bucketing: padding rows are masked out of every
+selection rule, so a padded subproblem's trajectory is IDENTICAL to the
+exact-size subproblem's — capacities exist only to bound the number of
+compiled programs (log2(n) across all shrink cycles and runs)."""
+
+import numpy as np
+import pytest
+
+import dpsvm_tpu.solver.shrink as shrink_mod
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.solver.shrink import _bucket_cap
+
+
+def test_bucket_cap_properties():
+    n = 60_000
+    for n_act in (1, 100, 512, 513, 8_000, 29_000, 33_000, 60_000):
+        cap = _bucket_cap(n_act, n)
+        assert cap >= n_act
+        assert cap <= n
+        # power of two unless clamped at n
+        assert cap == n or (cap & (cap - 1)) == 0
+    # distinct exact sizes inside one bucket share a program capacity
+    assert _bucket_cap(5_000, 60_000) == _bucket_cap(5_200, 60_000) == 8192
+    # the floor keeps tiny programs from churning
+    assert _bucket_cap(3, 60_000) == 512
+    # capacity never exceeds the full problem
+    assert _bucket_cap(50_000, 60_000) == 60_000
+
+
+@pytest.mark.parametrize("working_set", [2, 64])
+def test_bucketed_trajectory_equals_exact(monkeypatch, working_set):
+    """Same iterations, same alphas, same b with capacities quantized
+    (default) and with exact-size subproblems (identity bucketing) —
+    the masked padding must be invisible to the trajectory."""
+    x, y = make_blobs(n=700, d=24, seed=11)
+    cfg = SVMConfig(c=10.0, epsilon=1e-3, max_iter=200_000,
+                    shrinking=True, working_set=working_set,
+                    chunk_iters=256)
+
+    r_bucketed = shrink_mod.train_shrinking(x, y, cfg)
+
+    monkeypatch.setattr(shrink_mod, "_bucket_cap",
+                        lambda n_act, n, floor=512: n_act)
+    r_exact = shrink_mod.train_shrinking(x, y, cfg)
+
+    assert r_bucketed.converged and r_exact.converged
+    assert r_bucketed.n_iter == r_exact.n_iter
+    assert r_bucketed.b == pytest.approx(r_exact.b, abs=1e-6)
+    np.testing.assert_allclose(r_bucketed.alpha, r_exact.alpha,
+                               atol=1e-5)
+
+
+def test_masked_full_size_equals_unshrunk_prefix():
+    """At full capacity (n_valid == n) the masked runner's selection is
+    bitwise the unmasked rule: a shrinking run that never shrinks (huge
+    min-active via a problem where everything stays violating early)
+    still matches the plain solver's model quality."""
+    from dpsvm_tpu.solver.smo import train_single_device
+
+    x, y = make_blobs(n=400, d=16, seed=5)
+    cfg_plain = SVMConfig(c=10.0, epsilon=1e-3, max_iter=100_000)
+    cfg_shrink = SVMConfig(c=10.0, epsilon=1e-3, max_iter=100_000,
+                           shrinking=True)
+    r_plain = train_single_device(x, y, cfg_plain)
+    r_shrink = shrink_mod.train_shrinking(x, y, cfg_shrink)
+    assert r_plain.converged and r_shrink.converged
+    # Shrinking changes the trajectory once a shrink fires, but the
+    # converged model must satisfy the same stopping contract.
+    assert r_shrink.n_sv == pytest.approx(r_plain.n_sv, rel=0.05)
+    assert abs(r_shrink.b - r_plain.b) < 5e-3
