@@ -23,6 +23,24 @@ import sys
 from typing import List, Optional
 
 
+def _add_backend_flags(p: argparse.ArgumentParser) -> None:
+    """Backend selection + fail-fast init, for the device-using
+    subcommands (train/test). Without these a dead tunneled TPU hangs
+    the CLI inside the first jax device call with no diagnostic."""
+    p.add_argument("--platform", default=None, metavar="NAME",
+                   help="force the jax platform (e.g. 'cpu'); default: "
+                        "the DPSVM_PLATFORM env var, else the ambient "
+                        "backend. Applied before first device use — env "
+                        "vars alone cannot switch it on images that "
+                        "pre-import jax")
+    p.add_argument("--backend-timeout", type=float, default=180.0,
+                   metavar="S",
+                   help="seconds to wait for backend initialization "
+                        "before exiting with a clean error instead of "
+                        "hanging (an unreachable tunneled TPU would "
+                        "otherwise block forever)")
+
+
 def _add_data_flags(p: argparse.ArgumentParser,
                     model_required: bool = True) -> None:
     p.add_argument("-f", "--input", required=True, help="dataset: dense CSV 'label,f1,...' or libsvm "
@@ -43,6 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     tr = sub.add_parser("train", help="train a binary SVM (RBF default)")
     _add_data_flags(tr, model_required=False)
+    _add_backend_flags(tr)
     tr.add_argument("-c", "--cost", type=float, default=1.0)
     tr.add_argument("-g", "--gamma", type=float, default=None,
                     help="kernel gamma (default 1/num_attributes)")
@@ -181,6 +200,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     te = sub.add_parser("test", help="evaluate a saved model on a dataset")
     _add_data_flags(te)
+    _add_backend_flags(te)
     te.add_argument("--no-b", action="store_true",
                     help="drop the intercept like seq_test.cpp:197")
     te.add_argument("--predictions", default=None, metavar="PATH",
@@ -770,7 +790,7 @@ def cmd_info(args: argparse.Namespace) -> int:
     import jax
 
     print(f"jax {jax.__version__}")
-    from dpsvm_tpu.utils.backend_guard import probe_devices
+    from dpsvm_tpu.utils.backend_guard import HUNG_PREFIX, probe_devices
 
     devices, reason = probe_devices(args.timeout)
     if devices is None:
@@ -797,12 +817,55 @@ def cmd_info(args: argparse.Namespace) -> int:
     state = "populated" if os.path.isdir(cache) and os.listdir(cache) \
         else "empty"
     print(f"compile cache: {cache} ({state})")
+    if devices is None and reason.startswith(HUNG_PREFIX):
+        # Diagnostics are fully printed; hard-exit because the wedged
+        # probe thread holds jax's init lock and a normal interpreter
+        # exit can block in jax atexit hooks on it.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
     return 0 if devices is not None else 1
+
+
+def _init_backend(args: argparse.Namespace) -> int:
+    """Apply --platform/DPSVM_PLATFORM and fail fast on a dead backend.
+
+    0 on success; nonzero = the caller should exit with it. The numpy
+    backend needs no device and skips the probe entirely. The
+    apply-and-verify logic lives in probe_devices (its ``override``
+    parameter), so an ambient BENCH_PLATFORM can never clobber an
+    explicit --platform.
+    """
+    import os
+
+    if getattr(args, "backend", "xla") == "numpy":
+        return 0
+    platform = args.platform or os.environ.get("DPSVM_PLATFORM", "").strip()
+    from dpsvm_tpu.utils.backend_guard import HUNG_PREFIX, probe_devices
+
+    devices, reason = probe_devices(args.backend_timeout,
+                                    override=platform or None)
+    if devices is None:
+        print(f"error: {reason} — try --platform cpu to run on the "
+              "host, or `cli info` for diagnostics", file=sys.stderr)
+        if reason.startswith(HUNG_PREFIX):
+            # The wedged probe thread holds jax's init lock; a normal
+            # exit can block in jax atexit hooks on that lock, hanging
+            # the process the flag exists to un-hang.
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(3)
+        return 3
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
+        if args.command in ("train", "test"):
+            rc = _init_backend(args)
+            if rc:
+                return rc
         if args.command == "train":
             return cmd_train(args)
         if args.command == "convert":

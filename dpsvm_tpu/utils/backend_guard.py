@@ -61,12 +61,27 @@ def _positive_seconds_env(name: str, default: str) -> float:
     return val
 
 
-def probe_devices(timeout_s: float):
+# Machine-checkable prefix for the hung-probe reason: a hung probe
+# thread HOLDS jax's init lock, so callers that go on to a normal
+# interpreter exit can block in jax atexit hooks — they must os._exit
+# after printing (require_devices does; cli._init_backend checks this
+# prefix to do the same).
+HUNG_PREFIX = "backend initialization hung"
+
+_UNSET = object()
+
+
+def probe_devices(timeout_s: float, override=_UNSET):
     """(devices, None) or (None, reason) — the CATCHABLE probe.
 
     ``require_devices`` hard-exits (os._exit) by design so a wedged
     tunnel can never leave a benchmark half-running; diagnostics like
     ``cli info`` need to report the failure and keep printing instead.
+
+    ``override``: platform to force before first device use. The
+    default reads BENCH_PLATFORM (benchmark-harness behavior); pass an
+    explicit name (CLI --platform) or None (no change, ambient
+    backend) to take that decision away from the environment.
     """
     result: dict = {}
 
@@ -75,7 +90,8 @@ def probe_devices(timeout_s: float):
     # alone is not enough: this image's sitecustomize pre-imports jax
     # with the axon backend baked into JAX_PLATFORMS, so the switch
     # must go through jax.config BEFORE the first device use.
-    override = os.environ.get("BENCH_PLATFORM", "").strip()
+    if override is _UNSET:
+        override = os.environ.get("BENCH_PLATFORM", "").strip()
     if override:
         try:
             import jax
@@ -95,7 +111,7 @@ def probe_devices(timeout_s: float):
     t.start()
     t.join(timeout_s)
     if t.is_alive():
-        return None, (f"backend initialization hung for >{timeout_s:.0f}s "
+        return None, (f"{HUNG_PREFIX} for >{timeout_s:.0f}s "
                       "— the TPU tunnel is unresponsive")
     if "error" in result:
         return None, f"jax backend unavailable: {result['error']}"

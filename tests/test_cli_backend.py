@@ -1,0 +1,58 @@
+"""CLI backend selection and fail-fast init (cli._init_backend):
+--platform / DPSVM_PLATFORM force the jax platform before first device
+use, and a dead backend exits with a clean rc=3 error instead of
+hanging inside the first device call (the tunneled-TPU failure mode)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.cli import main
+from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+
+
+@pytest.fixture()
+def dataset(tmp_path):
+    x, y = make_blobs(n=200, d=8, seed=7)
+    train = tmp_path / "train.csv"
+    save_csv(str(train), x, y)
+    return str(train), str(tmp_path / "model.svm")
+
+
+def test_platform_flag_trains(dataset):
+    train, model = dataset
+    rc = main(["train", "-f", train, "-m", model, "-c", "10",
+               "--platform", "cpu", "-q"])
+    assert rc in (0, None)
+    rc = main(["test", "-f", train, "-m", model, "--platform", "cpu"])
+    assert rc in (0, None)
+
+
+def test_platform_env_var(dataset, monkeypatch):
+    train, model = dataset
+    monkeypatch.setenv("DPSVM_PLATFORM", "cpu")
+    rc = main(["train", "-f", train, "-m", model, "-c", "10", "-q"])
+    assert rc in (0, None)
+
+
+def test_platform_mismatch_is_clean_error(dataset, capsys):
+    """Asking for a platform the initialized backend cannot provide is
+    a diagnosed rc=3, not silent training on the wrong device."""
+    train, model = dataset
+    rc = main(["train", "-f", train, "-m", model,
+               "--platform", "nonexistent-platform"])
+    assert rc == 3
+    err = capsys.readouterr().err
+    assert "nonexistent-platform" in err or "error" in err
+
+
+def test_numpy_backend_skips_probe(dataset, monkeypatch):
+    """--backend numpy must not require a live device at all."""
+    train, model = dataset
+    # Poison the probe: numpy runs must never call it.
+    import dpsvm_tpu.utils.backend_guard as bg
+    monkeypatch.setattr(
+        bg, "probe_devices",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("probed")))
+    rc = main(["train", "-f", train, "-m", model, "-c", "10",
+               "--backend", "numpy", "-q"])
+    assert rc in (0, None)
