@@ -790,7 +790,7 @@ def cmd_info(args: argparse.Namespace) -> int:
     import jax
 
     print(f"jax {jax.__version__}")
-    from dpsvm_tpu.utils.backend_guard import HUNG_PREFIX, probe_devices
+    from dpsvm_tpu.utils.backend_guard import exit_if_hung, probe_devices
 
     devices, reason = probe_devices(args.timeout)
     if devices is None:
@@ -817,14 +817,12 @@ def cmd_info(args: argparse.Namespace) -> int:
     state = "populated" if os.path.isdir(cache) and os.listdir(cache) \
         else "empty"
     print(f"compile cache: {cache} ({state})")
-    if devices is None and reason.startswith(HUNG_PREFIX):
-        # Diagnostics are fully printed; hard-exit because the wedged
-        # probe thread holds jax's init lock and a normal interpreter
-        # exit can block in jax atexit hooks on it.
-        sys.stdout.flush()
-        sys.stderr.flush()
-        os._exit(1)
-    return 0 if devices is not None else 1
+    if devices is None:
+        # Diagnostics are fully printed; a hung probe must hard-exit
+        # (wedged thread holds jax's init lock — see exit_if_hung).
+        exit_if_hung(reason, 1)
+        return 1
+    return 0
 
 
 def _init_backend(args: argparse.Namespace) -> int:
@@ -840,21 +838,17 @@ def _init_backend(args: argparse.Namespace) -> int:
 
     if getattr(args, "backend", "xla") == "numpy":
         return 0
+    label = "--platform" if args.platform else "DPSVM_PLATFORM"
     platform = args.platform or os.environ.get("DPSVM_PLATFORM", "").strip()
-    from dpsvm_tpu.utils.backend_guard import HUNG_PREFIX, probe_devices
+    from dpsvm_tpu.utils.backend_guard import exit_if_hung, probe_devices
 
     devices, reason = probe_devices(args.backend_timeout,
-                                    override=platform or None)
+                                    override=platform or None,
+                                    override_label=label)
     if devices is None:
         print(f"error: {reason} — try --platform cpu to run on the "
               "host, or `cli info` for diagnostics", file=sys.stderr)
-        if reason.startswith(HUNG_PREFIX):
-            # The wedged probe thread holds jax's init lock; a normal
-            # exit can block in jax atexit hooks on that lock, hanging
-            # the process the flag exists to un-hang.
-            sys.stderr.flush()
-            sys.stdout.flush()
-            os._exit(3)
+        exit_if_hung(reason, 3)
         return 3
     return 0
 

@@ -111,11 +111,12 @@ def _gather_w(wi, active, xs, ys, x2s, alpha_s, f_s, rank, n_per_shard,
 
 def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
                       c: float, kspec: KernelSpec, n_per_shard: int,
-                      n_true: int, q: int, inner_cap: int,
+                      n_true, q: int, inner_cap: int,
                       epsilon: float, limit, shard_x: bool, precision,
                       weights=(1.0, 1.0),
                       pairwise_clip: bool = False) -> DistDecompCarry:
-    """One distributed outer round."""
+    """One distributed outer round. ``n_true`` (traced i32) is the
+    count of valid rows — global indices >= it are capacity padding."""
     alpha_s, f_s = carry.alpha, carry.f
     rank = lax.axis_index(SHARD_AXIS)
     wp, wn = weights
@@ -209,7 +210,7 @@ def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
 @functools.lru_cache(maxsize=16)
 def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
                               epsilon: float, n_per_shard: int,
-                              n_true: int, q: int, inner_cap: int,
+                              q: int, inner_cap: int,
                               shard_x: bool, precision_name: str,
                               weights=(1.0, 1.0),
                               pairwise_clip: bool = False):
@@ -218,6 +219,13 @@ def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
     x_spec = P(SHARD_AXIS) if shard_x else P()
 
     def run(carry: DistDecompCarry, xs, ys, x2s, valid, limit):
+        # The valid-row count, derived from the data rather than baked
+        # into the program: the shrinking manager re-enters here with
+        # many different active counts at the same padded capacity, and
+        # a static count would recompile per count (it is also part of
+        # the builder's lru_cache key no longer).
+        n_true = lax.psum(jnp.sum(valid.astype(jnp.int32)), SHARD_AXIS)
+
         def cond(s: DistDecompCarry):
             return (s.b_lo > s.b_hi + 2.0 * epsilon) & (s.n_iter < limit)
 
@@ -285,7 +293,7 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
         n_iter=jax.device_put(np.int32(init[4]), repl))
 
     runner = _build_dist_decomp_runner(
-        mesh, float(config.c), kspec, eps, n_s, n, q, inner_cap,
+        mesh, float(config.c), kspec, eps, n_s, q, inner_cap,
         bool(config.shard_x), config.matmul_precision.upper(),
         (float(config.weight_pos), float(config.weight_neg)),
         config.clip == "pairwise")

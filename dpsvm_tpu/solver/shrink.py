@@ -289,11 +289,25 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         from dpsvm_tpu.parallel.mesh import SHARD_AXIS
 
         n_act = len(idx)
+        # Same power-of-two capacity policy as the single-device path:
+        # the SPMD programs are shape-keyed on n_s = capacity / p, so
+        # quantized capacities bound the program count at log2(n)
+        # across all shrink cycles; rows in [n_act, cap) are zero
+        # padding marked invalid by prepare's mask (n_valid).
+        cap = _bucket_cap(max(n_act, min_active), n)
         if n_act == n and placed_full:
             di = placed_full[0]
         else:
-            di = prepare_distributed_inputs(x[idx], y_np[idx], config,
-                                            mesh, None, None, None)
+            if cap > n_act:
+                x_in = np.zeros((cap, x.shape[1]), np.float32)
+                x_in[:n_act] = x[idx]
+                y_in = np.zeros((cap,), np.float32)
+                y_in[:n_act] = y_np[idx]
+            else:
+                x_in, y_in = x[idx], y_np[idx]
+            di = prepare_distributed_inputs(x_in, y_in, config,
+                                            mesh, None, None, None,
+                                            n_valid=n_act)
             if n_act == n:
                 placed_full.append(di)
         n_s = di.n_s
@@ -310,7 +324,7 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
             from dpsvm_tpu.parallel.dist_decomp import (
                 DistDecompCarry, _build_dist_decomp_runner)
             run = _build_dist_decomp_runner(
-                mesh, float(config.c), kspec, eps, n_s, n_act, q,
+                mesh, float(config.c), kspec, eps, n_s, q,
                 inner_cap, bool(config.shard_x), precision_name,
                 weights, pairwise)
             carry = DistDecompCarry(alpha=a_seed, f=f_seed, b_hi=b_hi0,

@@ -71,7 +71,8 @@ HUNG_PREFIX = "backend initialization hung"
 _UNSET = object()
 
 
-def probe_devices(timeout_s: float, override=_UNSET):
+def probe_devices(timeout_s: float, override=_UNSET,
+                  override_label: str = "platform override"):
     """(devices, None) or (None, reason) — the CATCHABLE probe.
 
     ``require_devices`` hard-exits (os._exit) by design so a wedged
@@ -82,6 +83,8 @@ def probe_devices(timeout_s: float, override=_UNSET):
     default reads BENCH_PLATFORM (benchmark-harness behavior); pass an
     explicit name (CLI --platform) or None (no change, ambient
     backend) to take that decision away from the environment.
+    ``override_label`` names the knob in diagnostics so a failure
+    blames the flag the user actually set.
     """
     result: dict = {}
 
@@ -92,13 +95,28 @@ def probe_devices(timeout_s: float, override=_UNSET):
     # must go through jax.config BEFORE the first device use.
     if override is _UNSET:
         override = os.environ.get("BENCH_PLATFORM", "").strip()
+        override_label = "BENCH_PLATFORM"
+    prev_platforms = None
     if override:
         try:
             import jax
+            prev_platforms = jax.config.jax_platforms
             jax.config.update("jax_platforms", override)
         except Exception as e:
-            return None, (f"BENCH_PLATFORM={override!r} could not be "
+            return None, (f"{override_label}={override!r} could not be "
                           f"applied: {e}")
+
+    def restore() -> None:
+        # A failed override must not poison jax_platforms for the rest
+        # of the process: later callers (tests in one run, notebook
+        # cells, harness retries) would crash initializing the bogus
+        # platform instead of their own.
+        if override:
+            import jax
+            try:
+                jax.config.update("jax_platforms", prev_platforms)
+            except Exception:
+                pass
 
     def probe() -> None:
         try:
@@ -111,9 +129,13 @@ def probe_devices(timeout_s: float, override=_UNSET):
     t.start()
     t.join(timeout_s)
     if t.is_alive():
+        # No restore: the wedged thread is mid-initialization with the
+        # override applied; callers must hard-exit anyway (the thread
+        # holds jax's init lock — see exit_if_hung).
         return None, (f"{HUNG_PREFIX} for >{timeout_s:.0f}s "
                       "— the TPU tunnel is unresponsive")
     if "error" in result:
+        restore()
         return None, f"jax backend unavailable: {result['error']}"
     devices = result["devices"]
     if override:
@@ -123,11 +145,26 @@ def probe_devices(timeout_s: float, override=_UNSET):
         got = devices[0].platform.lower() if devices else "none"
         want = override.split(",")[0].strip().lower()
         if got != want:
-            return None, (f"BENCH_PLATFORM={override!r} did not take "
+            restore()
+            return None, (f"{override_label}={override!r} did not take "
                           f"effect (backend already initialized as "
                           f"{got!r}) — refusing to measure on the "
                           "wrong platform")
     return devices, None
+
+
+def exit_if_hung(reason: "Optional[str]", code: int) -> None:
+    """os._exit(code) when ``reason`` is a hung-probe diagnosis.
+
+    The wedged probe thread holds jax's init lock, so a normal
+    interpreter exit can block in jax atexit hooks on that lock —
+    callers print everything they have to say first, then call this.
+    No-op for None or any other failure reason.
+    """
+    if reason and reason.startswith(HUNG_PREFIX):
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
 
 
 def compile_cache_dir() -> str:

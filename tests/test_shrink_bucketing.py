@@ -54,6 +54,27 @@ def test_bucketed_trajectory_equals_exact(monkeypatch, working_set):
                                atol=1e-5)
 
 
+def test_dist_bucketed_trajectory_equals_exact(monkeypatch):
+    """The SPMD path quantizes capacities the same way (programs are
+    shape-keyed on capacity / p); padding rows are zero-row, zero-label
+    entries masked by prepare's n_valid, so the distributed trajectory
+    must match the exact-size subproblems' too."""
+    x, y = make_blobs(n=720, d=16, seed=13)
+    cfg = SVMConfig(c=10.0, epsilon=1e-3, max_iter=200_000,
+                    shrinking=True, shards=8, chunk_iters=256)
+
+    r_bucketed = shrink_mod.train_shrinking(x, y, cfg)
+    monkeypatch.setattr(shrink_mod, "_bucket_cap",
+                        lambda n_act, n, floor=512: n_act)
+    r_exact = shrink_mod.train_shrinking(x, y, cfg)
+
+    assert r_bucketed.converged and r_exact.converged
+    assert r_bucketed.n_iter == r_exact.n_iter
+    assert r_bucketed.b == pytest.approx(r_exact.b, abs=1e-6)
+    np.testing.assert_allclose(r_bucketed.alpha, r_exact.alpha,
+                               atol=1e-5)
+
+
 def test_masked_full_size_equals_unshrunk_prefix():
     """At full capacity (n_valid == n) the masked runner's selection is
     bitwise the unmasked rule: a shrinking run that never shrinks (huge
