@@ -10,67 +10,11 @@
 #
 # Usage:  bash benchmarks/chip_sweep.sh [results_file]
 set -u
-RESULTS="${1:-benchmarks/results/chip_sweep_r3.jsonl}"
-case "$RESULTS" in /*) ;; *) RESULTS="$PWD/$RESULTS" ;; esac
+ORIG_PWD="$PWD"
 cd "$(dirname "$0")/.."
-mkdir -p "$(dirname "$RESULTS")"
+. benchmarks/sweep_lib.sh
+resolve_results benchmarks/results/chip_sweep_r3.jsonl "${1:-}"
 
-probe() {
-  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
-}
-
-have() {  # tag already measured successfully?
-  [ -f "$RESULTS" ] && grep -q "\"tag\": \"$1\", \"rc\": 0" "$RESULTS"
-}
-
-run() {  # run <tag> <timeout_s> <env...> -- <cmd...>
-  local tag="$1" tmo="$2"; shift 2
-  # Tags name their configuration, so pin every load-bearing knob the
-  # harnesses would otherwise read from the ambient environment — an
-  # exported BENCH_DATA/BENCH_WORKING_SET/... left over from a by-hand
-  # run must not silently relabel a recorded measurement. Later
-  # assignments override earlier ones in env(1), so per-run settings
-  # win over these defaults.
-  local envs=(BENCH_GEN=planted BENCH_DATA= BENCH_SELECTION=first-order
-              BENCH_EPS=1e-3 BENCH_WORKING_SET=2 BENCH_INNER_ITERS=0
-              BENCH_SHRINKING= BENCH_PALLAS=auto BENCH_MAX_ITER=400000
-              BENCH_POLISH= BENCH_NO_MEMO= BENCH_VERBOSE=1
-              BENCH_PLATFORM= BENCH_STALL_TIMEOUT=)
-  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
-  shift
-  if have "$tag"; then echo "SKIP $tag (already recorded)"; return 0; fi
-  # A tag that has already failed twice is not retried automatically —
-  # a doomed run (e.g. one that cannot finish inside its wall timeout)
-  # must not burn its budget on every sweep re-invocation. Delete its
-  # lines from the results file to retry by hand.
-  if [ -f "$RESULTS" ] && \
-     [ "$(grep -c "\"tag\": \"$tag\"" "$RESULTS")" -ge 2 ]; then
-    echo "SKIP $tag (2 failed attempts recorded; edit $RESULTS to retry)"
-    return 0
-  fi
-  if ! probe; then echo "ABORT: tunnel down before $tag"; exit 3; fi
-  echo "RUN  $tag: env ${envs[*]} $*"
-  local errlog="/tmp/sweep_err_${tag}.log"
-  local t0=$SECONDS out rc
-  out=$(env "${envs[@]}" timeout "$tmo" "$@" 2>"$errlog")
-  rc=$?
-  python - "$RESULTS" "$tag" "$rc" "$((SECONDS - t0))" "$errlog" \
-      <<'PY' "$out"
-import json, sys
-path, tag, rc, secs, errlog, out = sys.argv[1:7]
-try:
-    with open(errlog) as fh:
-        err_tail = fh.read().strip().splitlines()[-15:]
-except OSError:
-    err_tail = []
-line = json.dumps({"tag": tag, "rc": int(rc), "seconds": int(secs),
-                   "stdout": out.strip().splitlines(),
-                   "stderr_tail": err_tail})
-with open(path, "a") as fh:
-    fh.write(line + "\n")
-print(("OK   " if rc == "0" else "FAIL ") + tag + f" rc={rc} {secs}s")
-PY
-}
 
 M="python bench_convergence.py"
 MNIST="BENCH_N=60000 BENCH_D=784 BENCH_C=10 BENCH_GAMMA=0.25"

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 follow-up chip measurements, one command, idempotent.
+#
+# The round-3 sweep (chip_sweep.sh) carries the backlog the round-3
+# tunnel outage blocked; this script adds the arms the round-4 verdict
+# review exposed as missing from it:
+#
+#   * conv_base — the plain 2-violator bf16 mnist-shape run, i.e. the
+#     19.09 s `[window r3]` headline itself. Every A/B in the r3 sweep
+#     compares against this row, so it must be a sweep-tagged capture,
+#     not a by-hand window number.
+#   * conv_f32 — pure exact-f32 to convergence at the same shape: the
+#     denominator for the polish arm's claimed win (PERF.md projects
+#     ~55-70 s from the 2,922 it/s run_configs row; measure, don't
+#     project).
+#
+# Results append to benchmarks/results/chip_sweep_r4.jsonl (separate
+# file from the r3 backlog so provenance tags stay honest about which
+# sweep produced a row). Usage: bash benchmarks/chip_sweep_r4.sh
+set -u
+ORIG_PWD="$PWD"
+cd "$(dirname "$0")/.."
+. benchmarks/sweep_lib.sh
+resolve_results benchmarks/results/chip_sweep_r4.jsonl "${1:-}"
+
+M="python bench_convergence.py"
+MNIST="BENCH_N=60000 BENCH_D=784 BENCH_C=10 BENCH_GAMMA=0.25"
+
+run conv_base 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_STALL_TIMEOUT=420 -- $M
+run conv_f32  1500 $MNIST BENCH_PRECISION=HIGHEST \
+    BENCH_STALL_TIMEOUT=420 -- $M
+
+echo "sweep complete -> $RESULTS"

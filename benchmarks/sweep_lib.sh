@@ -1,0 +1,76 @@
+# Shared machinery for the tagged chip sweeps (chip_sweep*.sh).
+#
+# Caller contract (see chip_sweep.sh): record ORIG_PWD="$PWD", cd to
+# the repo root, source this, then `resolve_results <default> "${1:-}"`
+# to set RESULTS. Provides resolve_results / probe / have / run.
+# `run <tag> <timeout_s> <env...> -- <cmd...>`
+# appends one JSON line per attempt to $RESULTS and skips tags that
+# already have an rc=0 record, so a sweep can be interrupted by a
+# tunnel outage and simply re-invoked. A tag with two failed attempts
+# is not retried automatically (delete its lines to retry by hand);
+# the retry loop's outage scrubber removes STALL-tagged rc=124 records
+# so tunnel flaps don't burn that budget.
+
+resolve_results() {  # resolve_results <repo-relative-default> [<arg>]
+  # Sets RESULTS and creates its directory. An explicit argument is
+  # caller-relative (the caller records $ORIG_PWD before cd'ing to the
+  # repo root); the default is anchored to the repo root so invoking a
+  # sweep from any cwd appends to the same file.
+  local def="$1" arg="${2:-}"
+  case "$arg" in ""|/*) ;; *) arg="${ORIG_PWD:?set ORIG_PWD before cd}/$arg" ;; esac
+  RESULTS="${arg:-$PWD/$def}"
+  mkdir -p "$(dirname "$RESULTS")"
+}
+
+probe() {
+  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+have() {  # tag already measured successfully?
+  [ -f "$RESULTS" ] && grep -q "\"tag\": \"$1\", \"rc\": 0" "$RESULTS"
+}
+
+run() {  # run <tag> <timeout_s> <env...> -- <cmd...>
+  local tag="$1" tmo="$2"; shift 2
+  # Tags name their configuration, so pin every load-bearing knob the
+  # harnesses would otherwise read from the ambient environment — an
+  # exported BENCH_DATA/BENCH_WORKING_SET/... left over from a by-hand
+  # run must not silently relabel a recorded measurement. Later
+  # assignments override earlier ones in env(1), so per-run settings
+  # win over these defaults.
+  local envs=(BENCH_GEN=planted BENCH_DATA= BENCH_SELECTION=first-order
+              BENCH_EPS=1e-3 BENCH_WORKING_SET=2 BENCH_INNER_ITERS=0
+              BENCH_SHRINKING= BENCH_PALLAS=auto BENCH_MAX_ITER=400000
+              BENCH_POLISH= BENCH_NO_MEMO= BENCH_VERBOSE=1
+              BENCH_PLATFORM= BENCH_STALL_TIMEOUT=)
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  if have "$tag"; then echo "SKIP $tag (already recorded)"; return 0; fi
+  if [ -f "$RESULTS" ] && \
+     [ "$(grep -c "\"tag\": \"$tag\"" "$RESULTS")" -ge 2 ]; then
+    echo "SKIP $tag (2 failed attempts recorded; edit $RESULTS to retry)"
+    return 0
+  fi
+  if ! probe; then echo "ABORT: tunnel down before $tag"; exit 3; fi
+  echo "RUN  $tag: env ${envs[*]} $*"
+  local errlog="/tmp/sweep_err_${tag}.log"
+  local t0=$SECONDS out rc
+  out=$(env "${envs[@]}" timeout "$tmo" "$@" 2>"$errlog")
+  rc=$?
+  python - "$RESULTS" "$tag" "$rc" "$((SECONDS - t0))" "$errlog" \
+      <<'PY' "$out"
+import json, sys
+path, tag, rc, secs, errlog, out = sys.argv[1:7]
+try:
+    with open(errlog) as fh:
+        err_tail = fh.read().strip().splitlines()[-15:]
+except OSError:
+    err_tail = []
+line = json.dumps({"tag": tag, "rc": int(rc), "seconds": int(secs),
+                   "stdout": out.strip().splitlines(),
+                   "stderr_tail": err_tail})
+with open(path, "a") as fh:
+    fh.write(line + "\n")
+print(("OK   " if rc == "0" else "FAIL ") + tag + f" rc={rc} {secs}s")
+PY
+}

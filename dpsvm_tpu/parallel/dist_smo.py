@@ -438,22 +438,24 @@ class DistInputs(NamedTuple):
 
 def prepare_distributed_inputs(x, y, config: SVMConfig, mesh, ckpt,
                                f_init, alpha_init,
-                               n_valid: "Optional[int]" = None
+                               capacity: "Optional[int]" = None
                                ) -> DistInputs:
     """Pad n to the mesh, place X/y/x2/valid with the configured
     layout, and seed (alpha, f, b's, n_iter) from the checkpoint or the
     (possibly f_init/alpha_init-overridden) classification init.
 
-    ``n_valid``: rows >= it are capacity padding the caller appended
-    (the shrinking manager's power-of-two buckets) — masked invalid
-    exactly like the mesh-divisibility padding. Default: all n rows
-    are real.
+    ``capacity``: pad the row count up to at least this many rows
+    before the mesh-divisibility rounding (the shrinking manager's
+    power-of-two buckets, which keep the SPMD program count bounded at
+    log2(n) across shrink cycles). Capacity rows are zero and masked
+    invalid exactly like the mesh-divisibility padding — this is the
+    ONE place that builds padded distributed inputs, so callers never
+    pre-pad. Default: no extra rows.
     """
     n, d = x.shape
     p = mesh.devices.size
-    n_pad = ((n + p - 1) // p) * p
-    if n_valid is None:
-        n_valid = n
+    n_cap = max(n, int(capacity or 0))
+    n_pad = ((n_cap + p - 1) // p) * p
     if config.kernel == "precomputed":
         # pad K on BOTH axes: per-shard column segments must exist for
         # the padded rows too (padded entries are masked invalid and
@@ -470,7 +472,7 @@ def prepare_distributed_inputs(x, y, config: SVMConfig, mesh, ckpt,
     x2p[:n] = host_row_stats(x, config.kernel_spec(d))
     yp = np.zeros((n_pad,), np.float32)
     yp[:n] = y
-    valid = np.arange(n_pad) < n_valid
+    valid = np.arange(n_pad) < n
 
     shard = NamedSharding(mesh, P(SHARD_AXIS))
     repl = NamedSharding(mesh, P())

@@ -293,21 +293,14 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         # the SPMD programs are shape-keyed on n_s = capacity / p, so
         # quantized capacities bound the program count at log2(n)
         # across all shrink cycles; rows in [n_act, cap) are zero
-        # padding marked invalid by prepare's mask (n_valid).
+        # padding marked invalid by prepare's valid mask.
         cap = _bucket_cap(max(n_act, min_active), n)
         if n_act == n and placed_full:
             di = placed_full[0]
         else:
-            if cap > n_act:
-                x_in = np.zeros((cap, x.shape[1]), np.float32)
-                x_in[:n_act] = x[idx]
-                y_in = np.zeros((cap,), np.float32)
-                y_in[:n_act] = y_np[idx]
-            else:
-                x_in, y_in = x[idx], y_np[idx]
-            di = prepare_distributed_inputs(x_in, y_in, config,
+            di = prepare_distributed_inputs(x[idx], y_np[idx], config,
                                             mesh, None, None, None,
-                                            n_valid=n_act)
+                                            capacity=cap)
             if n_act == n:
                 placed_full.append(di)
         n_s = di.n_s

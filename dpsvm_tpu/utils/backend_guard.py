@@ -136,7 +136,13 @@ def probe_devices(timeout_s: float, override=_UNSET,
                       "— the TPU tunnel is unresponsive")
     if "error" in result:
         restore()
-        return None, f"jax backend unavailable: {result['error']}"
+        # With an override applied, the raw jax error ("Unknown backend
+        # ...") does not name the knob that caused it; blame it here so
+        # a bad --platform/BENCH_PLATFORM is diagnosable from the
+        # message alone.
+        blame = (f" (with {override_label}={override!r} applied)"
+                 if override else "")
+        return None, f"jax backend unavailable: {result['error']}{blame}"
     devices = result["devices"]
     if override:
         # jax.config.update silently no-ops once a backend is already

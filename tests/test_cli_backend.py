@@ -44,7 +44,12 @@ def test_platform_mismatch_is_clean_error(dataset, capsys):
                "--platform", "nonexistent-platform"])
     assert rc == 3
     err = capsys.readouterr().err
-    assert "--platform" in err
+    # The diagnosis must name the flag AND the value the user set —
+    # both failure shapes (init error, override-didn't-take) format it
+    # as --platform='...'. The unconditional "try --platform cpu" hint
+    # also contains the bare flag name, so asserting on that alone
+    # would be vacuous.
+    assert "--platform='nonexistent-platform'" in err
     # The override was rolled back: jax still works in-process.
     import jax
     assert jax.devices()[0].platform == "cpu"

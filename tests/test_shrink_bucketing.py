@@ -56,9 +56,10 @@ def test_bucketed_trajectory_equals_exact(monkeypatch, working_set):
 
 def test_dist_bucketed_trajectory_equals_exact(monkeypatch):
     """The SPMD path quantizes capacities the same way (programs are
-    shape-keyed on capacity / p); padding rows are zero-row, zero-label
-    entries masked by prepare's n_valid, so the distributed trajectory
-    must match the exact-size subproblems' too."""
+    shape-keyed on capacity / p); capacity rows are zero-row, zero-label
+    entries masked invalid by prepare_distributed_inputs (its
+    ``capacity`` parameter), so the distributed trajectory must match
+    the exact-size subproblems' too."""
     x, y = make_blobs(n=720, d=16, seed=13)
     cfg = SVMConfig(c=10.0, epsilon=1e-3, max_iter=200_000,
                     shrinking=True, shards=8, chunk_iters=256)
@@ -76,7 +77,7 @@ def test_dist_bucketed_trajectory_equals_exact(monkeypatch):
 
 
 def test_masked_full_size_equals_unshrunk_prefix():
-    """At full capacity (n_valid == n) the masked runner's selection is
+    """At full capacity (no padding rows) the masked runner's selection is
     bitwise the unmasked rule: a shrinking run that never shrinks (huge
     min-active via a problem where everything stays violating early)
     still matches the plain solver's model quality."""
