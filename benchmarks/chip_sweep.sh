@@ -19,61 +19,80 @@ resolve_results benchmarks/results/chip_sweep_r3.jsonl "${1:-}"
 M="python bench_convergence.py"
 MNIST="BENCH_N=60000 BENCH_D=784 BENCH_C=10 BENCH_GAMMA=0.25"
 
-# 1) Solver-path wall-clock rows at the mnist shape (PERF.md "chip rows
-#    pending"). First-run compile of each active-size program is slow on
-#    the tunnel; generous timeouts.
+# Tags are idempotent and independent, so they are ordered by DECISION
+# VALUE, not by theme: the axon tunnel flaps in short windows (round 3:
+# down all round; round 4: 12-minute windows), and each re-invocation
+# must capture the most verdict-critical rows first.
+
+# --- Tier A: default-flip and kernel decisions + short rows ---------
+#    The iteration-economy scan (solver/decomp.py tuning guide) says
+#    q=4096 cap=128 reaches convergence in FEWER pair-updates than the
+#    auto cap q/4=1024 — this arm + conv_decomp4096 decide decomposition
+#    wall-clock at the mnist shape.
+run conv_decomp4096_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_STALL_TIMEOUT=420 -- $M
+#    adult with the budget it actually needs (f32+shrinking converges at
+#    579k iters CPU-verified; the 400k-cap row in PERF.md is a
+#    non-result) — the last unconverged reference config.
+run conv_adult_1m 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
+    BENCH_GAMMA=0.5 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=1000000 \
+    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
+#    Batched inference PERF row (reference evaluates per-example).
+run inference 900 BENCH_NSV=8000 BENCH_M=10000 BENCH_D=784 \
+    BENCH_PASSES=5 -- python benchmarks/inference_bench.py
+#    Pallas inner-subsolve kernel A/B (q capped at 2048 by the VMEM
+#    guard): same decomposition config, kernel on vs XLA inner loop.
+run conv_decomp2048      1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=2048 BENCH_STALL_TIMEOUT=420 -- $M
+run conv_decomp2048_pal  1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=2048 BENCH_PALLAS=on BENCH_STALL_TIMEOUT=420 -- $M
+#    Settle the fused Pallas iteration kernel: head-to-head past the
+#    VMEM cliff (n=120k), the one regime it could win.
+run pallas_cliff 1800 BENCH_N=120000 BENCH_D=784 \
+    BENCH_PRECISION=DEFAULT BENCH_ITERS=1500 \
+    -- python benchmarks/pallas_cliff.py
+
+# --- Tier B: remaining A/B arms -------------------------------------
+#    WSS2 to-convergence A/B (verdict weak #5: correct implementation,
+#    no earned perf row). At mnist shape WSS2 cuts pair-updates ~0.6x
+#    (CPU economics) paying 2 serial row-matmuls per step; ijcnn1's
+#    372k-iteration trajectory is where a >2x iteration cut would land.
+run conv_wss2 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_SELECTION=second-order BENCH_STALL_TIMEOUT=420 -- $M
+run conv_ijcnn1_base 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
+    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 BENCH_STALL_TIMEOUT=420 -- $M
+run conv_ijcnn1_wss2 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
+    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 \
+    BENCH_SELECTION=second-order BENCH_STALL_TIMEOUT=420 -- $M
+#    Polishing (arXiv:2207.01016's recipe): bf16 bulk solve + exact-
+#    f32 warm-start refinement. Compare against conv_f32 (r4 sweep) —
+#    the polished run's final KKT holds in exact arithmetic.
+run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 BENCH_STALL_TIMEOUT=420 -- $M
+#    ... and the exact-arithmetic adult arm that is CPU-verified to
+#    converge at 579k iters, in case bf16 kernel error stalls the C=100
+#    tail.
+run conv_adult_1m_f32 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
+    BENCH_GAMMA=0.5 BENCH_PRECISION=HIGHEST BENCH_MAX_ITER=1000000 \
+    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_shrink      1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp4096  1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=4096 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp_shrink 1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=4096 BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
-#    The iteration-economy scan (solver/decomp.py tuning guide) says
-#    q=4096 cap=128 reaches convergence in FEWER pair-updates than the
-#    auto cap q/4=1024 — these arms decide the wall-clock winner.
-run conv_decomp4096_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_STALL_TIMEOUT=420 -- $M
 run conv_decomp_shrink_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=4096 BENCH_INNER_ITERS=128 BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
+#    A/B re-runs on the planted generator (round-2 rows measured on the
+#    legacy stand-in; verdict #7 asked for re-runs on the honest one).
+run selection_ab_planted 900 BENCH_N=60000 BENCH_D=784 \
+    BENCH_PRECISION=DEFAULT BENCH_MEASURE_ITERS=3000 \
+    -- python benchmarks/selection_ab.py
+run cache_ab_planted 1500 BENCH_PRECISION=HIGHEST \
+    BENCH_MEASURE_ITERS=2000 BENCH_WARM_ITERS=500 BENCH_CACHE_LINES=0,10 \
+    -- python benchmarks/cache_ab.py adult mnist
 
-# 1b) WSS2 to-convergence A/B (verdict weak #5: correct implementation,
-#    no earned perf row). At mnist shape WSS2 cuts pair-updates ~0.6x
-#    (CPU economics) paying 2 serial row-matmuls per step; ijcnn1's
-#    372k-iteration trajectory is where a >2x iteration cut would land.
-run conv_wss2 1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_SELECTION=second-order BENCH_STALL_TIMEOUT=420 -- $M
-run conv_ijcnn1_wss2 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
-    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 \
-    BENCH_SELECTION=second-order BENCH_STALL_TIMEOUT=420 -- $M
-run conv_ijcnn1_base 1500 BENCH_N=49990 BENCH_D=22 BENCH_C=32 \
-    BENCH_GAMMA=2 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=600000 BENCH_STALL_TIMEOUT=420 -- $M
-
-# 2) Pallas inner-subsolve kernel A/B (q capped at 2048 by the VMEM
-#    guard): same decomposition config, kernel on vs XLA inner loop.
-run conv_decomp2048      1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=2048 BENCH_STALL_TIMEOUT=420 -- $M
-run conv_decomp2048_pal  1500 $MNIST BENCH_PRECISION=DEFAULT \
-    BENCH_WORKING_SET=2048 BENCH_PALLAS=on BENCH_STALL_TIMEOUT=420 -- $M
-
-# 3) adult shape with the budget it actually needs (f32+shrinking
-#    converges at 579k iters CPU-verified; the 400k-cap row in PERF.md
-#    is a non-result).
-run conv_adult_1m 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
-    BENCH_GAMMA=0.5 BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=1000000 \
-    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
-#    ... and the exact-arithmetic arm that is CPU-verified to converge
-#    at 579k iters, in case bf16 kernel error stalls the C=100 tail.
-run conv_adult_1m_f32 1800 BENCH_N=32561 BENCH_D=123 BENCH_C=100 \
-    BENCH_GAMMA=0.5 BENCH_PRECISION=HIGHEST BENCH_MAX_ITER=1000000 \
-    BENCH_SHRINKING=1 BENCH_STALL_TIMEOUT=420 -- $M
-
-# 2b) Polishing (arXiv:2207.01016's recipe): bf16 bulk solve + exact-
-#    f32 warm-start refinement. Compare against the pure-f32 ~55-70 s
-#    implied by the 2,922 it/s run_configs row — the polished run's
-#    final KKT holds in exact arithmetic.
-run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 BENCH_STALL_TIMEOUT=420 -- $M
-
-# 3b) The HBM-bound shapes are where decomposition's economics should
+# --- Tier C: the long HBM-bound arms (need a stable window) ---------
+#    The HBM-bound shapes are where decomposition's economics should
 #    win biggest: a 2-violator iteration streams all of X per step
 #    (measured 438 it/s bf16 at the epsilon shape, 3,936 at covtype —
 #    PERF.md run_configs table) while an inner decomposition update
@@ -86,33 +105,14 @@ run conv_polish 1500 $MNIST BENCH_PRECISION=HIGHEST BENCH_POLISH=1 BENCH_STALL_T
 run conv_covtype_decomp_q2048 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
     BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
     BENCH_SHRINKING=1 BENCH_MAX_ITER=3000000 BENCH_STALL_TIMEOUT=900 -- $M
-run conv_epsilon_decomp_q2048 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
-    BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
-    BENCH_MAX_ITER=200000 BENCH_STALL_TIMEOUT=900 -- $M
 #    The 2-violator covtype baseline at a budget sized to roughly the
 #    decomposition arm's wall-clock (~3.9k it/s measured at this shape),
 #    so the A/B compares progress (train_acc, final gap) at equal time.
 run conv_covtype_pair 1800 BENCH_N=500000 BENCH_D=54 BENCH_C=2048 \
     BENCH_GAMMA=0.03125 BENCH_PRECISION=DEFAULT \
     BENCH_MAX_ITER=280000 BENCH_STALL_TIMEOUT=900 -- $M
-
-# 4) Settle the fused Pallas iteration kernel: head-to-head past the
-#    VMEM cliff (n=120k), the one regime it could win.
-run pallas_cliff 1800 BENCH_N=120000 BENCH_D=784 \
-    BENCH_PRECISION=DEFAULT BENCH_ITERS=1500 \
-    -- python benchmarks/pallas_cliff.py
-
-# 5) Batched inference PERF row (reference evaluates per-example).
-run inference 900 BENCH_NSV=8000 BENCH_M=10000 BENCH_D=784 \
-    BENCH_PASSES=5 -- python benchmarks/inference_bench.py
-
-# 6) A/B re-runs on the planted generator (round-2 rows measured on the
-#    legacy stand-in; verdict #7 asked for re-runs on the honest one).
-run cache_ab_planted 1500 BENCH_PRECISION=HIGHEST \
-    BENCH_MEASURE_ITERS=2000 BENCH_WARM_ITERS=500 BENCH_CACHE_LINES=0,10 \
-    -- python benchmarks/cache_ab.py adult mnist
-run selection_ab_planted 900 BENCH_N=60000 BENCH_D=784 \
-    BENCH_PRECISION=DEFAULT BENCH_MEASURE_ITERS=3000 \
-    -- python benchmarks/selection_ab.py
+run conv_epsilon_decomp_q2048 1800 BENCH_N=400000 BENCH_D=2000 BENCH_C=1 \
+    BENCH_GAMMA=5e-4 BENCH_PRECISION=DEFAULT BENCH_WORKING_SET=2048 \
+    BENCH_MAX_ITER=200000 BENCH_STALL_TIMEOUT=900 -- $M
 
 echo "sweep complete -> $RESULTS"

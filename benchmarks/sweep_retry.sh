@@ -21,6 +21,7 @@
 set -u
 cd "$(dirname "$0")/.."
 RESULTS="benchmarks/results/chip_sweep_r3.jsonl"
+RESULTS_R4="benchmarks/results/chip_sweep_r4.jsonl"
 WATCH="/tmp/chip_watch.log"
 
 # Prints one line per scrubbed tag; callers test the output to decide
@@ -29,9 +30,9 @@ WATCH="/tmp/chip_watch.log"
 # results file): a config that stalls deterministically — a run wedge,
 # not a tunnel flap — keeps its STALL records after that, so the
 # sweep's own 2-attempt cap engages instead of retrying forever.
-scrub_outage_timeouts() {
-  [ -f "$RESULTS" ] || return 0
-  python - "$RESULTS" <<'PY'
+scrub_outage_timeouts() {  # scrub_outage_timeouts <results_file>
+  [ -f "$1" ] || return 0
+  python - "$1" <<'PY'
 import json, os, sys
 path = sys.argv[1]
 side = path + ".scrubs.json"
@@ -75,17 +76,24 @@ PY
 while true; do
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) UP" >> "$WATCH"
-    scrub_outage_timeouts
+    scrub_outage_timeouts "$RESULTS"
+    scrub_outage_timeouts "$RESULTS_R4"
+    # The short r4 sweep first: it carries the headline-re-verification
+    # rows (conv_base/conv_f32), worth capturing even in a window too
+    # brief for the r3 backlog.
+    bash benchmarks/chip_sweep_r4.sh "$RESULTS_R4"
+    rc4=$?
     bash benchmarks/chip_sweep.sh "$RESULTS"
     rc=$?
-    echo "$(date -u +%FT%TZ) sweep exited rc=$rc" >> "$WATCH"
-    if [ "$rc" -eq 0 ]; then
+    echo "$(date -u +%FT%TZ) sweeps exited rc4=$rc4 rc=$rc" >> "$WATCH"
+    if [ "$rc4" -eq 0 ] && [ "$rc" -eq 0 ]; then
       # rc=0 means every tag was attempted, not that every tag was
       # measured: a watchdog-STALLed tag records rc=124 and the sweep
       # moves on. Only stop when a post-pass scrub RAN CLEANLY and
       # found nothing to re-run — a crashed scrub (non-zero rc) must
       # loop, not masquerade as completion.
-      scrub_out=$(scrub_outage_timeouts)
+      scrub_out=$(scrub_outage_timeouts "$RESULTS";
+                  scrub_outage_timeouts "$RESULTS_R4")
       scrub_rc=$?
       if [ "$scrub_rc" -eq 0 ] && [ -z "$scrub_out" ]; then
         echo "$(date -u +%FT%TZ) SWEEP COMPLETE" >> "$WATCH"
