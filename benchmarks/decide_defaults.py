@@ -1,0 +1,190 @@
+"""Apply docs/ROUND4.md's pre-registered decision rules to the sweep.
+
+The rules were fixed before any chip row landed; this script is their
+mechanical application, so the default-flip and kernel decisions are an
+audit trail, not a judgment call made after seeing the data. It reads
+the tagged sweep results (r3 backlog + r4 re-verification files) and
+prints one verdict line per rule with the numbers it used. A human
+still edits config._auto_solver_plan / demotes kernels — this prints
+exactly what those edits must be.
+
+Usage:  python benchmarks/decide_defaults.py
+        (reads benchmarks/results/chip_sweep_r3.jsonl and _r4.jsonl)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def load(path):
+    """tag -> last JSON measurement line; tag+"@all" -> every JSON line
+    (harnesses like pallas_cliff print one line per arm)."""
+    runs = {}
+    if not os.path.exists(path):
+        return runs
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            rec = json.loads(raw)
+            if rec.get("rc") != 0:
+                continue
+            ms = []
+            for ln in rec.get("stdout", []):
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    try:
+                        ms.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        continue
+            if ms:
+                runs[rec["tag"]] = ms[-1]
+                runs[rec["tag"] + "@all"] = ms
+    return runs
+
+
+def fmt(m):
+    if m is None:
+        return "MISSING"
+    out = f"{m.get('value')}{m.get('unit', '')}"
+    if "n_iter" in m:
+        out += f" n_iter={m['n_iter']:,} conv={m.get('converged')}"
+    if "n_sv" in m:
+        out += f" n_sv={m['n_sv']}"
+    return out
+
+
+def same_quality(a, b):
+    """Rule 1's quality bar: n_sv within 2%, train acc within 0.005."""
+    if a is None or b is None:
+        return False
+    sv_ok = abs(a["n_sv"] - b["n_sv"]) <= 0.02 * max(a["n_sv"], b["n_sv"])
+    acc_ok = abs(a.get("train_accuracy", 0) -
+                 b.get("train_accuracy", 0)) <= 0.005
+    return sv_ok and acc_ok
+
+
+def wallclock_win(cand, base, margin=0.10):
+    """True when cand converges and beats base wall-clock by > margin."""
+    if cand is None or base is None:
+        return False
+    if not (cand.get("converged") and base.get("converged")):
+        return False
+    return cand["value"] < (1.0 - margin) * base["value"]
+
+
+def main() -> int:
+    r3 = load(os.path.join(HERE, "results", "chip_sweep_r3.jsonl"))
+    r4 = load(os.path.join(HERE, "results", "chip_sweep_r4.jsonl"))
+    t = {**r3, **r4}
+    g = t.get
+
+    print("== inputs ==")
+    for tag in sorted(t):
+        if not tag.endswith("@all"):
+            print(f"  {tag}: {fmt(t[tag])}")
+
+    base = g("conv_base")
+    print("\n== rule 1: shrinking default (mnist shape class) ==")
+    sh = g("conv_shrink")
+    if base and sh:
+        win = wallclock_win(sh, base) and same_quality(sh, base)
+        print(f"  conv_shrink {fmt(sh)} vs conv_base {fmt(base)}"
+              f" -> shrinking default {'ON' if win else 'stays OFF'}")
+    else:
+        print(f"  undecidable: conv_shrink={fmt(sh)} conv_base={fmt(base)}")
+
+    print("\n== rule 2: decomposition default (mnist shape class) ==")
+    arms = {a: g(a) for a in ("conv_decomp4096", "conv_decomp4096_cap128",
+                              "conv_decomp2048")}
+    conv_arms = {a: m for a, m in arms.items()
+                 if m is not None and m.get("converged")}
+    if base and conv_arms:
+        best_tag = min(conv_arms, key=lambda a: conv_arms[a]["value"])
+        best = conv_arms[best_tag]
+        win = wallclock_win(best, base) and same_quality(best, base)
+        print(f"  best converged arm {best_tag} {fmt(best)} vs conv_base "
+              f"{fmt(base)} -> decomposition default "
+              f"{'ON (' + best_tag + ')' if win else 'stays OFF'}")
+    else:
+        print(f"  no converged decomposition arm (or conv_base missing) "
+              f"-> stays OFF; arms: "
+              + ", ".join(f"{a}={fmt(m)}" for a, m in arms.items()))
+
+    print("\n== rule 2b: HBM-shape decomposition (covtype/epsilon class) ==")
+    for cand_tag, pair_tag in (("conv_covtype_decomp_q2048",
+                                "conv_covtype_pair"),):
+        cand, pair = g(cand_tag), g(pair_tag)
+        if cand and pair:
+            r_c = cand["n_iter"] / cand["value"]
+            r_p = pair["n_iter"] / pair["value"]
+            acc_ok = (cand.get("train_accuracy", 0)
+                      >= pair.get("train_accuracy", 0) - 0.005)
+            win = r_c > 1.10 * r_p and acc_ok
+            print(f"  {cand_tag} rate={r_c:,.0f}/s acc="
+                  f"{cand.get('train_accuracy')} vs {pair_tag} rate="
+                  f"{r_p:,.0f}/s acc={pair.get('train_accuracy')}"
+                  f" -> {'decomp wins this class' if win else 'no flip'}")
+        else:
+            print(f"  undecidable: {cand_tag}={fmt(cand)} "
+                  f"{pair_tag}={fmt(pair)}")
+
+    print("\n== rule 3: fused 2-violator Pallas kernel (pallas_cliff) ==")
+    pc_all = g("pallas_cliff@all") or []
+    rates = {m.get("arm"): m.get("iters_per_sec") for m in pc_all}
+    xla, pal = rates.get("xla"), rates.get("pallas")
+    if xla and pal:
+        keep = pal > 1.10 * xla
+        print(f"  pallas {pal} vs xla {xla} it/s past the cliff -> "
+              f"{'KEEP' if keep else 'DEMOTE to experimental/'}")
+    else:
+        print(f"  undecidable: pallas_cliff arms={rates or 'MISSING'}")
+
+    print("\n== rule 4: inner-subsolve Pallas kernel ==")
+    d, dp = g("conv_decomp2048"), g("conv_decomp2048_pal")
+    if d and dp:
+        if dp["value"] < 0.95 * d["value"]:
+            verdict = ("KEEP as opt-in; promote to auto"
+                       if dp["value"] < 0.90 * d["value"] else "KEEP as opt-in")
+        else:
+            verdict = "DEMOTE to experimental/"
+        print(f"  pal {fmt(dp)} vs xla-inner {fmt(d)} -> {verdict}")
+    else:
+        print(f"  undecidable: conv_decomp2048={fmt(d)} pal={fmt(dp)}")
+
+    print("\n== rule 5: adult row ==")
+    a1, a2 = g("conv_adult_1m"), g("conv_adult_1m_f32")
+    for tag, m in (("conv_adult_1m", a1), ("conv_adult_1m_f32", a2)):
+        print(f"  {tag}: {fmt(m)}")
+    conv = [m for m in (a1, a2) if m is not None and m.get("converged")]
+    if conv:
+        best = min(conv, key=lambda m: m["value"])
+        print(f"  -> PERF.md adult row becomes {fmt(best)}")
+    elif a1 is None and a2 is None:
+        print("  -> undecidable: both arms MISSING")
+    else:
+        print("  -> neither converged: row documents measured iteration "
+              "need; polish is the recommended config")
+
+    print("\n== rule 6: WSS2 ==")
+    for cand_tag, base_tag in (("conv_wss2", "conv_base"),
+                               ("conv_ijcnn1_wss2", "conv_ijcnn1_base")):
+        cand, b = g(cand_tag), g(base_tag)
+        if cand and b:
+            win = wallclock_win(cand, b)
+            print(f"  {cand_tag} {fmt(cand)} vs {base_tag} {fmt(b)} -> "
+                  f"{'recommended-usage note' if win else 'measured negative'}")
+        else:
+            print(f"  undecidable: {cand_tag}={fmt(cand)} "
+                  f"{base_tag}={fmt(b)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
