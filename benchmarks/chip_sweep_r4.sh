@@ -31,4 +31,23 @@ run conv_base 1500 $MNIST BENCH_PRECISION=DEFAULT \
 run conv_f32  1500 $MNIST BENCH_PRECISION=HIGHEST \
     BENCH_STALL_TIMEOUT=420 -- $M
 
+# Ratio-informed decomposition arms (added before any decomposition
+# chip row landed; rationale committed first — see the q-selection
+# rule in solver/decomp.py). The r3 backlog's q=4096 mnist arms sit at
+# q ~= 0.5x the shape's ~8.1k SV count, the regime the CPU scan
+# measures as a 2.5-3x update blowup at BOTH smaller shapes; 1.3x
+# n_sv is ~10.6k, and q=12288 (= 3x4096, a multiple of the 128-wide
+# MXU tile) is the next tile-friendly size comfortably above it.
+# cap 128 = the measured cap minimum at q=4096; cap 256 scales cap
+# with q.
+run conv_decomp12288_cap256 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=12288 BENCH_INNER_ITERS=256 BENCH_STALL_TIMEOUT=420 -- $M
+run conv_decomp12288_cap128 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=12288 BENCH_INNER_ITERS=128 BENCH_STALL_TIMEOUT=420 -- $M
+#    ... and stacked with shrinking (count-neutral on CPU; cheaper
+#    block fetches as the active set shrinks).
+run conv_decomp12288_cap256_shrink 1500 $MNIST BENCH_PRECISION=DEFAULT \
+    BENCH_WORKING_SET=12288 BENCH_INNER_ITERS=256 BENCH_SHRINKING=1 \
+    BENCH_STALL_TIMEOUT=420 -- $M
+
 echo "sweep complete -> $RESULTS"

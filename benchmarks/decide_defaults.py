@@ -101,8 +101,16 @@ def main() -> int:
         print(f"  undecidable: conv_shrink={fmt(sh)} conv_base={fmt(base)}")
 
     print("\n== rule 2: decomposition default (mnist shape class) ==")
+    # The q=12288 arms were added before any decomposition chip row
+    # landed, from the committed CPU q-selection rule (q >= 1.3x n_sv;
+    # solver/decomp.py) — amendment recorded in docs/ROUND4.md.
+    # The _shrink-stacked arm is EXCLUDED from this min: rule 2 decides
+    # the working_set default alone, and a combined-knob win must not
+    # be attributed to it (rule 1 decides shrinking separately; the
+    # combined arm is reported below as its own candidate).
     arms = {a: g(a) for a in ("conv_decomp4096", "conv_decomp4096_cap128",
-                              "conv_decomp2048")}
+                              "conv_decomp2048", "conv_decomp12288_cap128",
+                              "conv_decomp12288_cap256")}
     conv_arms = {a: m for a, m in arms.items()
                  if m is not None and m.get("converged")}
     if base and conv_arms:
@@ -116,6 +124,14 @@ def main() -> int:
         print(f"  no converged decomposition arm (or conv_base missing) "
               f"-> stays OFF; arms: "
               + ", ".join(f"{a}={fmt(m)}" for a, m in arms.items()))
+    combo = g("conv_decomp12288_cap256_shrink")
+    if combo is not None and base is not None:
+        win = wallclock_win(combo, base) and same_quality(combo, base)
+        verdict = ("wins as a COMBINED config (both knobs flip together "
+                   "only if rules 1+2 support it)" if win
+                   else "no combined win")
+        print(f"  combined decomp+shrink arm {fmt(combo)} vs conv_base "
+              f"{fmt(base)} -> {verdict}")
 
     print("\n== rule 2b: HBM-shape decomposition (covtype/epsilon class) ==")
     for cand_tag, pair_tag in (("conv_covtype_decomp_q2048",

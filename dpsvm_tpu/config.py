@@ -345,11 +345,18 @@ class SVMConfig:
                     "iteration at working_set=2, inner subsolve at "
                     "q > 2); use an explicit working_set with it")
         if self.working_set not in (0, 2):
+            # Upper bound sized so the decomposition state stays cheap
+            # relative to HBM (K_WW is q^2 f32 — 1 GB at 16384) while
+            # admitting the measured q-selection rule: q must exceed
+            # the SV count by ~1.3x or subsolves grind on stale global
+            # state (benchmarks/results/iteration_economy_r4.jsonl:
+            # q<n_sv costs 2.5-3x the updates at both 8000x784 and
+            # 20000x784), and the reference shapes run to ~8k SVs.
             if (self.working_set < 4 or self.working_set % 2
-                    or self.working_set > 8192):
+                    or self.working_set > 16384):
                 raise ValueError("working_set must be 0 (auto), 2 "
                                  "(classic SMO pair) or an even value "
-                                 f"in [4, 8192], got {self.working_set}")
+                                 f"in [4, 16384], got {self.working_set}")
             # Reject every path that would silently ignore q, so results
             # can't be misattributed (same policy as select_impl).
             # (use_pallas='on' IS meaningful here: it selects the

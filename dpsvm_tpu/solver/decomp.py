@@ -343,6 +343,15 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
     # 8000x784: 13k updates, 0.66x the pair count) and fail outright at
     # small-d/small-gamma (30000x54 C=64: q arms DNF at 600k) — see
     # docs/PERF.md "Solver-path iteration economics".
+    # q-SELECTION RULE (same scan, round 4): q must exceed the problem's
+    # SV count by ~1.3x, or the subsolves grind on stale global state
+    # and the update count blows up 2.5-3x instead of winning 0.7x —
+    # measured at TWO shapes: 8000x784 (n_sv~1.4k: q1024 34.4k updates
+    # vs q2048 13.7k vs q4096 13.0k) and 20000x784 (n_sv~3.1k: q2048
+    # 103k vs q4096 34.8k, classic 49.8k). Above the threshold the
+    # economy is flat in q, so prefer the smallest q >= 1.3x the
+    # expected SV count; the 60000x784 benchmark shape (n_sv~8.1k)
+    # therefore needs q~12288, NOT 4096.
     inner_cap = int(config.inner_iters) or max(32, q // 4)
     gamma = float(config.resolve_gamma(d))
     kspec = config.kernel_spec(d)
