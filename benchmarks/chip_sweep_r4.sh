@@ -50,4 +50,12 @@ run conv_decomp12288_cap256_shrink 1500 $MNIST BENCH_PRECISION=DEFAULT \
     BENCH_WORKING_SET=12288 BENCH_INNER_ITERS=256 BENCH_SHRINKING=1 \
     BENCH_STALL_TIMEOUT=420 -- $M
 
+# Batched vs sequential OvO multiclass (solver/batched_ovo.py): all 45
+# pairs of a 10-class problem in one compiled program vs the pairwise
+# loop. No reference baseline exists (the reference is binary-only);
+# the A/B is our own two modes, same models out.
+run ovo_mnist10 1800 BENCH_N=30000 BENCH_D=784 BENCH_K=10 \
+    BENCH_PRECISION=DEFAULT BENCH_MAX_ITER=200000 \
+    BENCH_STALL_TIMEOUT=600 -- python benchmarks/ovo_bench.py
+
 echo "sweep complete -> $RESULTS"

@@ -1,0 +1,95 @@
+"""Batched vs sequential one-vs-one multiclass training wall-clock.
+
+The reference is binary-only, so this benchmark has no reference
+baseline: the comparison is our own sequential pairwise loop (LIBSVM's
+OvO structure) vs the batched program (solver/batched_ovo.py) that
+advances all K(K-1)/2 pairs in one compiled loop. Same data, same
+hyperparameters, same models out (per-pair n_sv agreement is recorded
+in a final ``ovo_model_check`` JSON line so the sweep captures it —
+not asserted, since ulp-level matmul-layout differences can
+legitimately flip a near-tie SV; see solver/batched_ovo.py).
+
+Prints one JSON line per arm:
+    {"metric": "ovo_train_seconds", "arm": "batched"|"sequential",
+     "value": <s>, "k": ..., "pairs": ..., "n": ..., "d": ...,
+     "total_pair_iters": ..., "batched_steps_max": ...,
+     "all_converged": ...}
+
+Environment: BENCH_N (total examples, default 30000), BENCH_D (784),
+BENCH_K (10 classes), BENCH_C (10), BENCH_GAMMA (0.25), BENCH_EPS
+(1e-3), BENCH_MAX_ITER (200000), BENCH_PRECISION (DEFAULT|HIGHEST),
+BENCH_ARMS (comma list, default "batched,sequential"),
+BENCH_PLATFORM (cpu to run off-TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import _pathfix  # noqa: F401,E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", 30_000))
+    d = int(os.environ.get("BENCH_D", 784))
+    k = int(os.environ.get("BENCH_K", 10))
+    c = float(os.environ.get("BENCH_C", 10.0))
+    gamma = float(os.environ.get("BENCH_GAMMA", 0.25))
+    eps = float(os.environ.get("BENCH_EPS", 1e-3))
+    max_iter = int(os.environ.get("BENCH_MAX_ITER", 200_000))
+    precision = os.environ.get("BENCH_PRECISION", "DEFAULT").lower()
+    arms = os.environ.get("BENCH_ARMS", "batched,sequential").split(",")
+
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+    enable_compile_cache()
+    dev = require_devices()[0]
+    log(f"device: {dev}")
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synthetic import make_planted_multiclass
+    from dpsvm_tpu.models.multiclass import train_multiclass
+
+    t0 = time.perf_counter()
+    x, y = make_planted_multiclass(n, d, gamma, k=k, seed=0)
+    log(f"data: planted multiclass {n}x{d}, k={k} "
+        f"({time.perf_counter() - t0:.1f}s)")
+
+    config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
+                       matmul_precision=("default"
+                                         if precision == "default"
+                                         else "highest"))
+
+    n_sv_by_arm = {}
+    for arm in arms:
+        arm = arm.strip()
+        t0 = time.perf_counter()
+        _, results = train_multiclass(x, y, config,
+                                      batched=(arm == "batched"))
+        secs = time.perf_counter() - t0
+        n_sv_by_arm[arm] = [r.n_sv for r in results]
+        print(json.dumps({
+            "metric": "ovo_train_seconds", "arm": arm,
+            "value": round(secs, 2), "k": k,
+            "pairs": len(results), "n": n, "d": d,
+            "total_pair_iters": int(sum(r.n_iter for r in results)),
+            "batched_steps_max": int(max(r.n_iter for r in results)),
+            "all_converged": bool(all(r.converged for r in results)),
+        }), flush=True)
+    if len(n_sv_by_arm) == 2:
+        a, b = n_sv_by_arm.values()
+        same = sum(int(x == y) for x, y in zip(a, b))
+        print(json.dumps({"metric": "ovo_model_check",
+                          "n_sv_matches": same, "pairs": len(a)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

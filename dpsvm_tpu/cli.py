@@ -179,6 +179,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--multiclass", action="store_true",
                     help="one-vs-one multi-class training (labels may be "
                          "any integers; -m becomes a model DIRECTORY)")
+    tr.add_argument("--ovo-batched", action="store_true",
+                    help="train ALL one-vs-one pairs in one compiled "
+                         "batched program (shared X stream, per-step "
+                         "latency amortized across pairs); plain "
+                         "first-order single-device path only — "
+                         "incompatible options are rejected")
     tr.add_argument("-b", "--probability", action="store_true",
                     help="LIBSVM -b 1 analog: fit Platt-scaled "
                          "probabilities on the training decision values "
@@ -298,6 +304,10 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "reference-format per-pair files", file=sys.stderr)
             return 2
 
+    if args.ovo_batched and not args.multiclass:
+        print("error: --ovo-batched is a --multiclass training mode",
+              file=sys.stderr)
+        return 2
     if args.multiclass:
         # Flag conflicts are detectable from args alone — fail before
         # the (possibly huge) CSV parse.
@@ -416,7 +426,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         proba_mode = ("cv" if args.probability_cv
                       else args.probability)
         mc, results = train_multiclass(x, y, config,
-                                       probability=proba_mode)
+                                       probability=proba_mode,
+                                       batched=args.ovo_batched)
         save_multiclass(mc, args.model)
         acc = evaluate_multiclass(mc, x, y)
         if proba_mode:

@@ -102,15 +102,30 @@ def make_planted(n: int, d: int, gamma: float, seed: int = 0,
     C): asserted at CI scale by tests/test_data.py and measured at the
     reference shapes in docs/PERF.md.
     """
+    x, assign, rng = _planted_latent(n, d, gamma, 2 * clusters_per_class,
+                                     latent_dim, seed)
+    y = np.where(assign < clusters_per_class, 1, -1).astype(np.int32)
+    flip = rng.random(n) < noise
+    y = np.where(flip, -y, y).astype(np.int32)
+    return x, y
+
+
+def _planted_latent(n: int, d: int, gamma: float, n_clusters: int,
+                    latent_dim: int, seed: int):
+    """(x, cluster assignment, rng) — the gamma-calibrated latent
+    cluster geometry shared by the binary and multiclass planted
+    generators. The calibration lives HERE, once: cluster centers on a
+    latent sphere of radius r_c, cluster noise sigma, tuned against
+    REAL image data (sklearn digits at its benchmark gamma:
+    off-diagonal K has median ~0.3, p99 ~0.76) via within-cluster
+    E||xi-xj||^2 = 2*latent_dim*sigma^2 := 0.7/gamma (K ~ 0.5) and
+    cross-cluster ~ 1.5/gamma (K ~ 0.22); asserted against digits by
+    tests/test_data.py::TestPlantedCalibration. The returned rng has
+    consumed the generation draws, so callers' label-noise draws stay
+    reproducible per (shape, seed)."""
     if latent_dim > d:
         latent_dim = d
     rng = np.random.default_rng(seed)
-    n_clusters = 2 * clusters_per_class
-    # Cluster centers on a latent sphere of radius r_c, cluster noise
-    # sigma, calibrated against REAL image data (sklearn digits at its
-    # benchmark gamma: off-diagonal K has median ~0.3, p99 ~0.76):
-    # within-cluster E||xi-xj||^2 = 2*latent_dim*sigma^2 := 0.7/gamma
-    # (K ~ 0.5) and cross-cluster ~ 1.5/gamma (K ~ 0.22).
     sigma = float(np.sqrt(0.35 / (latent_dim * gamma)))
     r_c = float(np.sqrt(0.4 / gamma))
     centers = rng.normal(size=(n_clusters, latent_dim))
@@ -120,9 +135,26 @@ def make_planted(n: int, d: int, gamma: float, seed: int = 0,
     # Embed isometrically: random orthonormal rows (QR of a Gaussian).
     basis, _ = np.linalg.qr(rng.normal(size=(d, latent_dim)))
     x = (z @ basis.T).astype(np.float32)
-    y = np.where(assign < clusters_per_class, 1, -1).astype(np.int32)
+    return x, assign, rng
+
+
+def make_planted_multiclass(n: int, d: int, gamma: float, k: int = 10,
+                            seed: int = 0, noise: float = 0.02,
+                            latent_dim: int = 16,
+                            clusters_per_class: int = 4,
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """K-class variant of ``make_planted``: the same gamma-calibrated
+    latent cluster geometry, with ``clusters_per_class`` clusters per
+    class and integer labels 0..k-1. ``noise`` flips a fraction of
+    labels to a uniformly random OTHER class (the multiclass analog of
+    the binary flip — those points become bounded SVs of their pairs).
+    Used by the OvO benchmarks (benchmarks/ovo_bench.py)."""
+    x, assign, rng = _planted_latent(n, d, gamma, k * clusters_per_class,
+                                     latent_dim, seed)
+    y = (assign // clusters_per_class).astype(np.int32)
     flip = rng.random(n) < noise
-    y = np.where(flip, -y, y).astype(np.int32)
+    shift = rng.integers(1, k, size=n)
+    y = np.where(flip, (y + shift) % k, y).astype(np.int32)
     return x, y
 
 

@@ -42,6 +42,7 @@ class MulticlassModel:
 def train_multiclass(x: np.ndarray, y: np.ndarray,
                      config: Optional[SVMConfig] = None,
                      probability: "Union[bool, str]" = False,
+                     batched: bool = False,
                      ) -> Tuple[MulticlassModel, List[TrainResult]]:
     """Train OvO; y may hold any integer labels (2 classes work too).
 
@@ -50,7 +51,14 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     see models/calibration.py) so ``predict_proba_multiclass`` can
     couple them — LIBSVM's ``-b 1`` for multiclass. ``probability="cv"``
     fits each pair's sigmoid on k-fold held-out decisions instead
-    (LIBSVM's actual procedure, at k extra trainings per pair)."""
+    (LIBSVM's actual procedure, at k extra trainings per pair).
+
+    ``batched=True`` trains ALL pairs in one compiled batched program
+    (solver/batched_ovo.py): per-pair trajectories are exactly the
+    sequential solver's, but the X stream and the per-step latency
+    floor are paid once per batched step for every pair instead of per
+    pair. Restricted to the plain first-order single-device path (the
+    guard below); the sequential loop remains the general one."""
     from dpsvm_tpu.api import fit
 
     from dpsvm_tpu.utils import densify
@@ -70,8 +78,55 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     classes = np.unique(y)
     if len(classes) < 2:
         raise ValueError(f"need at least 2 classes, got {classes}")
+    if batched:
+        # The batched program advances every pair with the plain
+        # first-order single-device step; reject anything that would
+        # silently fall back or change the math (no-silent-ignore, the
+        # config guard-table policy).
+        blockers = [name for name, bad in (
+            ("selection", config.selection != "first-order"),
+            ("weights", config.weight_pos != 1.0
+             or config.weight_neg != 1.0),
+            ("shards", config.shards != 1),
+            ("shrinking", config.shrinking not in (False, "auto")),
+            ("working_set", config.working_set not in (0, 2)),
+            ("cache_size", config.cache_size > 0),
+            ("use_pallas", config.use_pallas == "on"),
+            ("backend", config.backend != "xla"),
+            ("polish", config.polish),
+        ) if bad]
+        if blockers:
+            raise ValueError(
+                "batched OvO runs the plain first-order single-device "
+                f"path; incompatible options set: {blockers} (train "
+                "with batched=False for these)")
     pairs, models, results = [], [], []
     platt: Optional[List[Tuple[float, float]]] = [] if probability else None
+    if batched:
+        from dpsvm_tpu.solver.batched_ovo import (build_pair_targets,
+                                                  train_ovo_batched)
+
+        yb, valid, pairs = build_pair_targets(y, classes)
+        batch_results = train_ovo_batched(x, yb, valid, config)
+        for p, (ai, bi) in enumerate(pairs):
+            sel = valid[p]
+            xs = np.ascontiguousarray(x[sel])
+            ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
+            r = batch_results[p]
+            r = dataclasses.replace(
+                r, alpha=np.asarray(r.alpha, np.float32)[sel])
+            models.append(SVMModel.from_train_result(xs, ys, r))
+            results.append(r)
+            if probability:
+                from dpsvm_tpu.models.calibration import (fit_platt,
+                                                          fit_platt_cv)
+                if probability == "cv":
+                    platt.append(fit_platt_cv(xs, ys, config))
+                else:
+                    dec = np.asarray(decision_function(models[-1], xs))
+                    platt.append(fit_platt(dec, ys))
+        return MulticlassModel(classes=classes, pairs=pairs,
+                               models=models, platt=platt), results
     for ai in range(len(classes)):
         for bi in range(ai + 1, len(classes)):
             sel = (y == classes[ai]) | (y == classes[bi])

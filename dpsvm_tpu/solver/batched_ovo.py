@@ -1,0 +1,281 @@
+"""Batched one-vs-one training: all K(K-1)/2 subproblems in ONE program.
+
+TPU-native multiclass design with no reference analog (the reference,
+``svmTrainMain.cpp``, is strictly binary; LIBSVM trains OvO pairs one
+after another). Sequential OvO pays the whole per-iteration latency
+floor (~22 us of sequential-dependency cost per SMO step, measured —
+docs/PERF.md "Per-phase cost") and the per-pair dispatch/compile
+overhead P times over. But the P pair subproblems are INDEPENDENT and
+share one X, which is exactly the shape the hardware wants batched:
+
+* every subproblem's working-pair row fetch joins one
+  ``(2P, d) @ (d, n)`` MXU matmul — the dominant VMEM stream of X is
+  paid once per batched step for ALL pairs instead of once per pair;
+* selection becomes a masked ``(P, n)`` row-wise reduction (the lanes
+  the VPU wants), amortizing the scalar-chain latency over P problems;
+* one compiled program, one dispatch stream, one convergence poll.
+
+Each subproblem advances one SMO step per batched step until ITS OWN
+gap closes (frozen thereafter via masked updates), replicating the
+sequential solver's per-problem trajectory (``solver/smo.py``):
+selection order over the subset, eta, clips, the do-while trailing
+update, per-problem iteration counting. The parity claim, stated
+precisely: EQUAL GIVEN EQUAL ARITHMETIC — the batched row fetch is a
+``(2P, d) @ (d, n)`` matmul where the sequential path computes
+``(2, d) @ (d, n_sub)`` over the compacted subset, and the different
+tiling can differ by ulps, which SMO's argmin can amplify into a
+different (equally valid) trajectory near ties. tests/test_batched_ovo
+asserts BITWISE equality where the layouts coincide (one pair covering
+every row — identical matmul shapes) and model-level equality (same
+n_sv, alpha/b within float tolerance, same convergence) on true
+multiclass problems. This is the same claim shape as
+``parallel/dist_decomp.py``'s sharded-fetch caveat.
+The wall-clock cost of a batched step is set by the slowest-converging
+pair; lanes of finished pairs ride along masked (their updates are
+zeroed), which is cheap because the step cost is dominated by the
+shared X stream, not the per-pair scalar work.
+
+Parity scope (v1, guards in ``train_multiclass``): first-order
+selection, unweighted, single device, no cache/shrinking/working-set,
+every kernel family except precomputed (pair training needs row AND
+column slices of K). Both clip rules.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
+from dpsvm_tpu.ops.kernels import KernelSpec, host_row_stats, rows_from_dots
+from dpsvm_tpu.ops.selection import masked_scores
+from dpsvm_tpu.ops.update import alpha_pair_step
+from dpsvm_tpu.utils import watchdog
+
+
+class OvoCarry(NamedTuple):
+    alpha: jax.Array    # (P, n) f32
+    f: jax.Array        # (P, n) f32
+    b_hi: jax.Array     # (P,) f32 — previous step's selection, like the
+    b_lo: jax.Array     # (P,) f32   pair solver's do-while carry slots
+    n_iter: jax.Array   # (P,) i32 — per-problem step counts
+    t: jax.Array        # () i32 — batched steps taken (poll cadence)
+
+
+def build_pair_targets(y: np.ndarray, classes: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray,
+                                  List[Tuple[int, int]]]:
+    """(yb (P, n) f32 with +/-1 on the pair's rows and 0 elsewhere,
+    valid (P, n) bool, pairs): the OvO subproblem layout over the SHARED
+    example axis. Row order inside a subproblem is the full-set order,
+    which boolean-mask compaction preserves — the tie-break order the
+    sequential trainer sees on its compacted subset."""
+    y = np.asarray(y)
+    k = len(classes)
+    pairs = [(a, b) for a in range(k) for b in range(a + 1, k)]
+    n = y.shape[0]
+    yb = np.zeros((len(pairs), n), np.float32)
+    valid = np.zeros((len(pairs), n), bool)
+    for p, (a, b) in enumerate(pairs):
+        sel_a = y == classes[a]
+        sel_b = y == classes[b]
+        yb[p, sel_a] = 1.0
+        yb[p, sel_b] = -1.0
+        valid[p] = sel_a | sel_b
+    return yb, valid, pairs
+
+
+def _ovo_step(carry: OvoCarry, x, yb, x2, valid, *, c: float,
+              kspec: KernelSpec, epsilon: float, max_iter: int,
+              precision, pairwise_clip: bool) -> OvoCarry:
+    """One batched step: every still-active subproblem advances one
+    exact first-order SMO iteration; finished ones are frozen."""
+    alpha, f = carry.alpha, carry.f
+    P = alpha.shape[0]
+    rows_p = jnp.arange(P)
+
+    # Active = carry b's (previous selection) still show a violating
+    # pair AND budget left — the sequential solver's do-while cond,
+    # applied per problem.
+    active = (carry.b_lo > carry.b_hi + 2.0 * epsilon) \
+        & (carry.n_iter < jnp.int32(max_iter))
+
+    # --- masked first-order selection, all problems at once ----------
+    # (masked_scores is elementwise, so the shared membership
+    # definition broadcasts over the (P, n) batch unchanged.)
+    f_up, f_low = masked_scores(alpha, yb, f, c, valid)
+    i_hi = jnp.argmin(f_up, axis=1)                     # (P,)
+    i_lo = jnp.argmax(f_low, axis=1)
+    b_hi = jnp.take_along_axis(f_up, i_hi[:, None], 1)[:, 0]
+    b_lo = jnp.take_along_axis(f_low, i_lo[:, None], 1)[:, 0]
+
+    # --- shared row fetch: ONE (2P, d) @ (d, n) MXU pass -------------
+    w_idx = jnp.concatenate([i_hi, i_lo])               # (2P,)
+    rows = x[w_idx]                                     # (2P, d)
+    dots = jnp.matmul(rows, x.T, precision=precision)   # (2P, n)
+    k_all = rows_from_dots(dots, x2[w_idx], x2, kspec)
+    k_hi, k_lo = k_all[:P], k_all[P:]                   # (P, n) each
+
+    gather = lambda m, i: jnp.take_along_axis(m, i[:, None], 1)[:, 0]
+    eta = (gather(k_hi, i_hi) + gather(k_lo, i_lo)
+           - 2.0 * gather(k_hi, i_lo))                  # (P,)
+
+    y_hi = gather(yb, i_hi)
+    y_lo = gather(yb, i_lo)
+    a_hi = gather(alpha, i_hi)
+    a_lo = gather(alpha, i_lo)
+    c_f = jnp.full((P,), jnp.float32(c))
+    a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi, b_lo,
+                                     eta, c_f, c_f, pairwise_clip)
+    # Freeze finished problems: their alphas keep the old values and
+    # their f deltas are zero.
+    a_hi_n = jnp.where(active, a_hi_n, a_hi)
+    a_lo_n = jnp.where(active, a_lo_n, a_lo)
+
+    # Write order lo-then-hi per problem (the i_hi == i_lo corner),
+    # matching solver/smo.py:229-230.
+    alpha = alpha.at[rows_p, i_lo].set(a_lo_n)
+    alpha = alpha.at[rows_p, i_hi].set(a_hi_n)
+    f = f + ((a_hi_n - a_hi) * y_hi)[:, None] * k_hi \
+          + ((a_lo_n - a_lo) * y_lo)[:, None] * k_lo
+
+    return OvoCarry(
+        alpha=alpha, f=f,
+        # b slots update only for problems that stepped, so a finished
+        # problem's cond stays false forever (and its final gap is the
+        # one its last real step saw — same as sequential).
+        b_hi=jnp.where(active, b_hi, carry.b_hi),
+        b_lo=jnp.where(active, b_lo, carry.b_lo),
+        n_iter=carry.n_iter + active.astype(jnp.int32),
+        t=carry.t + 1,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _build_ovo_runner(c: float, kspec: KernelSpec, epsilon: float,
+                      max_iter: int, precision_name: str,
+                      pairwise_clip: bool):
+    """Compiled batched chunk runner, cached per hyperparameter set.
+    Shapes (P, n, d) specialize via jit."""
+    precision = getattr(lax.Precision, precision_name)
+
+    def chunk(carry: OvoCarry, x, yb, x2, valid, limit):
+        def cond(s):
+            any_active = jnp.any(
+                (s.b_lo > s.b_hi + 2.0 * epsilon)
+                & (s.n_iter < jnp.int32(max_iter)))
+            return any_active & (s.t < limit)
+
+        final = lax.while_loop(
+            cond,
+            lambda s: _ovo_step(s, x, yb, x2, valid, c=c, kspec=kspec,
+                                epsilon=epsilon, max_iter=max_iter,
+                                precision=precision,
+                                pairwise_clip=pairwise_clip),
+            carry)
+        # Per-problem poll stats in ONE transfer: (3, P) i32 with the
+        # b's riding as bit patterns (same trick as driver.pack_stats).
+        stats = jnp.stack([
+            final.n_iter,
+            lax.bitcast_convert_type(final.b_lo, jnp.int32),
+            lax.bitcast_convert_type(final.b_hi, jnp.int32)])
+        return final, stats
+
+    return jax.jit(chunk, donate_argnums=(0,))
+
+
+def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
+                      config: SVMConfig,
+                      device: Optional[jax.Device] = None
+                      ) -> List[TrainResult]:
+    """Train the (P, n) OvO batch; one TrainResult per subproblem, each
+    carrying the FULL-LENGTH (n,) alpha (zeros off the subproblem —
+    callers compact with their own row masks)."""
+    config.validate()
+    n, d = x.shape
+    P = yb.shape[0]
+    gamma = float(config.resolve_gamma(d))
+    kspec = config.kernel_spec(d)
+    precision_name = config.matmul_precision.upper()
+
+    t0 = time.perf_counter()
+    xd = jax.device_put(jnp.asarray(x, jnp.float32), device)
+    ybd = jax.device_put(jnp.asarray(yb, jnp.float32), device)
+    x2 = jax.device_put(host_row_stats(x, kspec), device)
+    vd = jax.device_put(jnp.asarray(valid), device)
+    carry = OvoCarry(
+        alpha=jnp.zeros((P, n), jnp.float32),
+        f=jnp.asarray(-yb, jnp.float32),
+        b_hi=jnp.full((P,), jnp.float32(-SENTINEL)),
+        b_lo=jnp.full((P,), jnp.float32(SENTINEL)),
+        n_iter=jnp.zeros((P,), jnp.int32),
+        t=jnp.int32(0),
+    )
+    if device is not None:
+        carry = jax.device_put(carry, device)
+
+    runner = _build_ovo_runner(float(config.c), kspec,
+                               float(config.epsilon),
+                               int(config.max_iter), precision_name,
+                               config.clip == "pairwise")
+
+    eps = float(config.epsilon)
+    chunk = int(config.chunk_iters)
+    # The batched-step budget: every problem is frozen after max_iter
+    # of ITS OWN steps, so max_iter batched steps bound the whole run.
+    budget = int(config.max_iter)
+    watchdog.pet()
+
+    limit = min(chunk, budget)
+    carry, stats = runner(carry, xd, ybd, x2, vd, jnp.int32(limit))
+    while True:
+        # Speculative next chunk before the poll blocks (same dispatch
+        # pipelining as driver.host_training_loop; a chunk dispatched
+        # after global convergence exits on its first cond check).
+        limit_next = min(limit + chunk, budget)
+        if limit_next > limit:
+            carry_next, stats_next = runner(carry, xd, ybd, x2, vd,
+                                            jnp.int32(limit_next))
+        else:
+            carry_next = stats_next = None
+
+        s = np.asarray(stats)               # blocks; (3, P) i32
+        watchdog.pet()
+        n_iter = s[0]
+        b_lo = s[1].view(np.float32)
+        b_hi = s[2].view(np.float32)
+        done = ~(b_lo > b_hi + 2.0 * eps)
+        capped = n_iter >= budget
+        if np.all(done | capped) or stats_next is None:
+            break
+        carry, stats, limit = carry_next, stats_next, limit_next
+
+    train_seconds = time.perf_counter() - t0
+    alpha_all = np.asarray(carry.alpha if stats_next is None
+                           else carry_next.alpha)
+    # A speculative chunk after global convergence is a no-op, so its
+    # carry equals the polled one; reading whichever is newest is safe
+    # and keeps the donated-buffer chain simple.
+    results = []
+    for p in range(P):
+        results.append(TrainResult(
+            alpha=alpha_all[p],
+            b=(float(b_lo[p]) + float(b_hi[p])) / 2.0,
+            n_iter=int(n_iter[p]),
+            converged=bool(done[p]),
+            b_lo=float(b_lo[p]),
+            b_hi=float(b_hi[p]),
+            train_seconds=train_seconds,   # shared program: wall clock
+            gamma=gamma,                   # is per-batch, not per-pair
+            n_sv=int(np.sum(alpha_all[p] > 0)),
+            kernel=config.kernel,
+            coef0=float(config.coef0),
+            degree=int(config.degree),
+        ))
+    return results

@@ -1,0 +1,106 @@
+"""Batched OvO (solver/batched_ovo.py) vs the sequential pairwise loop.
+
+The batched program claims EXACT per-pair trajectory parity with the
+sequential solver (same selection over the subset in full-set order,
+same eta/clips, same do-while trailing update, same iteration counts) —
+asserted here pairwise at exact f32, plus the guard table and the
+quality contract on a harder problem.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.multiclass import train_multiclass
+from tests.test_multiclass import make_three_class
+
+
+def _cfg(**kw):
+    base = dict(c=1.0, gamma=0.25, epsilon=1e-3, max_iter=20_000,
+                chunk_iters=64)
+    base.update(kw)
+    return SVMConfig(**base)
+
+
+def test_batched_bitwise_parity_single_pair():
+    """With ONE pair covering every row, the batched matmul has the
+    sequential solver's exact shape — the trajectories must be
+    BITWISE identical, trailing update and iteration count included."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(160, 6)).astype(np.float32)
+    y = (rng.random(160) < 0.5).astype(np.int32)   # labels {0, 1}
+    x[y == 1] += 1.0
+    m_seq, r_seq = train_multiclass(x, y, _cfg())
+    m_bat, r_bat = train_multiclass(x, y, _cfg(), batched=True)
+    (rs,), (rb,) = r_seq, r_bat
+    assert rb.n_iter == rs.n_iter
+    assert rb.converged and rs.converged
+    np.testing.assert_array_equal(np.asarray(rb.alpha),
+                                  np.asarray(rs.alpha))
+    assert rb.b == rs.b
+    np.testing.assert_array_equal(m_bat.models[0].x_sv,
+                                  m_seq.models[0].x_sv)
+
+
+def test_batched_equals_sequential_per_pair():
+    """True multiclass: the batched (2P, d) @ (d, n) fetch tiles
+    differently from the sequential compacted one, so ulps can flip
+    near-tie selections (see the module docstring) — the contract is
+    model-level equality, not bitwise trajectories."""
+    x, y = make_three_class(n_per=80, d=6, seed=3)
+    m_seq, r_seq = train_multiclass(x, y, _cfg())
+    m_bat, r_bat = train_multiclass(x, y, _cfg(), batched=True)
+    assert m_bat.pairs == m_seq.pairs
+    for p, (rs, rb) in enumerate(zip(r_seq, r_bat)):
+        assert rb.converged and rs.converged
+        # same step-count scale (a real trajectory, not a stall) ...
+        assert abs(rb.n_iter - rs.n_iter) <= max(10, rs.n_iter // 10)
+        # ... converging to the same model
+        assert rb.n_sv == rs.n_sv
+        np.testing.assert_allclose(np.asarray(rb.alpha),
+                                   np.asarray(rs.alpha), atol=5e-3)
+        assert rb.b == pytest.approx(rs.b, abs=1e-3)
+    for ms, mb in zip(m_seq.models, m_bat.models):
+        np.testing.assert_array_equal(mb.x_sv, ms.x_sv)
+
+
+def test_batched_pairwise_clip_parity():
+    x, y = make_three_class(n_per=60, d=4, seed=9)
+    cfg = _cfg(clip="pairwise")
+    _, r_seq = train_multiclass(x, y, cfg)
+    _, r_bat = train_multiclass(x, y, cfg, batched=True)
+    for rs, rb in zip(r_seq, r_bat):
+        assert rb.converged and rs.converged
+        assert rb.n_sv == rs.n_sv
+        np.testing.assert_allclose(np.asarray(rb.alpha),
+                                   np.asarray(rs.alpha), atol=5e-3)
+
+
+def test_batched_capped_budget_freezes_per_pair():
+    """A pair that hits max_iter is reported unconverged with exactly
+    max_iter steps; others converge unaffected."""
+    x, y = make_three_class(n_per=80, d=6, seed=3)
+    cfg = _cfg(max_iter=40)      # far below any pair's need
+    _, r_bat = train_multiclass(x, y, cfg, batched=True)
+    for rb in r_bat:
+        assert not rb.converged
+        assert rb.n_iter == 40
+
+
+def test_batched_guard_table():
+    x, y = make_three_class(n_per=30, d=4, seed=1)
+    for bad in (dict(selection="second-order"), dict(weight_pos=2.0),
+                dict(shrinking=True), dict(working_set=64),
+                dict(cache_size=4), dict(backend="numpy"),
+                dict(polish=True)):
+        with pytest.raises(ValueError, match="batched"):
+            train_multiclass(x, y, _cfg(**bad), batched=True)
+
+
+def test_batched_probability_platt():
+    x, y = make_three_class(n_per=50, d=4, seed=5)
+    m, _ = train_multiclass(x, y, _cfg(), batched=True, probability=True)
+    from dpsvm_tpu.models.multiclass import predict_proba_multiclass
+    proba = predict_proba_multiclass(m, x)
+    assert proba.shape == (len(y), 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
