@@ -179,12 +179,15 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--multiclass", action="store_true",
                     help="one-vs-one multi-class training (labels may be "
                          "any integers; -m becomes a model DIRECTORY)")
-    tr.add_argument("--ovo-batched", action="store_true",
-                    help="train ALL one-vs-one pairs in one compiled "
-                         "batched program (shared X stream, per-step "
-                         "latency amortized across pairs); plain "
-                         "first-order single-device path only — "
-                         "incompatible options are rejected")
+    tr.add_argument("--batched", action="store_true",
+                    help="train independent subproblems in ONE compiled "
+                         "batched program — all one-vs-one pairs with "
+                         "--multiclass, all folds with --cv (folds x "
+                         "pairs for multiclass CV). Shared X stream, "
+                         "per-step latency amortized across "
+                         "subproblems; plain first-order single-device "
+                         "path only — incompatible options are "
+                         "rejected")
     tr.add_argument("-b", "--probability", action="store_true",
                     help="LIBSVM -b 1 analog: fit Platt-scaled "
                          "probabilities on the training decision values "
@@ -304,9 +307,13 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "reference-format per-pair files", file=sys.stderr)
             return 2
 
-    if args.ovo_batched and not args.multiclass:
-        print("error: --ovo-batched is a --multiclass training mode",
-              file=sys.stderr)
+    if args.batched and not (args.multiclass or args.cv):
+        print("error: --batched applies to --multiclass or --cv "
+              "training", file=sys.stderr)
+        return 2
+    if args.batched and args.svr:
+        print("error: batched CV is classification-only (SVR folds "
+              "train on per-fold pseudo-examples)", file=sys.stderr)
         return 2
     if args.multiclass:
         # Flag conflicts are detectable from args alone — fail before
@@ -427,7 +434,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                       else args.probability)
         mc, results = train_multiclass(x, y, config,
                                        probability=proba_mode,
-                                       batched=args.ovo_batched)
+                                       batched=args.batched)
         save_multiclass(mc, args.model)
         acc = evaluate_multiclass(mc, x, y)
         if proba_mode:
@@ -450,7 +457,8 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.cv:
         from dpsvm_tpu.models.cv import cross_validate
         r = cross_validate(x, y, args.cv, config,
-                           task="svr" if args.svr else "svc")
+                           task="svr" if args.svr else "svc",
+                           batched=args.batched)
         if args.svr:
             print(f"Cross Validation ({args.cv}-fold) MSE: "
                   f"{r['mse']:.6f}  MAE: {r['mae']:.6f}  "

@@ -43,11 +43,20 @@ def kfold_assignment(y: np.ndarray, k: int, seed: int = 0,
 
 def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
                    config: Optional[SVMConfig] = None,
-                   task: str = "svc", seed: int = 0) -> dict:
+                   task: str = "svc", seed: int = 0,
+                   batched: bool = False) -> dict:
     """Pooled held-out predictions over k folds.
 
     task: "svc" (binary or multiclass by label count) or "svr".
     Returns {"predictions", "folds", plus task metrics}.
+
+    ``batched=True`` (classification only) trains every fold's
+    subproblems in ONE compiled batched program (solver/batched_ovo.py
+    — the machinery is a general masked-subproblem batch, and CV folds
+    are just K more masks): K subproblems for binary, K * K(K-1)/2 for
+    multiclass OvO, instead of k sequential trainings. Same scope guard
+    as ``train_multiclass(batched=True)``; SVR is rejected (its 2n
+    pseudo-example construction doesn't share X across folds).
     """
     from dpsvm_tpu.utils import densify
     x = densify(x)
@@ -63,7 +72,21 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
         raise ValueError("checkpoint/resume are single-run options; they "
                          "cannot be shared across CV folds")
 
+    if batched:
+        from dpsvm_tpu.solver.batched_ovo import batched_guard
+        if task == "svr":
+            raise ValueError(
+                "batched CV is classification-only: SVR folds train on "
+                "2m pseudo-examples built per fold (models/svr.py), so "
+                "they do not share one X the way classification folds "
+                "do; run --cv without batching for SVR")
+        batched_guard(config, "CV")
+
     fold = kfold_assignment(y, k, seed, stratify=task == "svc")
+    if batched:
+        pred = _cross_validate_batched(x, y, k, fold, config)
+        return {"predictions": pred, "folds": fold, "k": k,
+                "accuracy": float(np.mean(pred == y))}
     pred = np.empty(len(y), np.float32 if task == "svr" else y.dtype)
     for f in range(k):
         tr = fold != f
@@ -101,3 +124,77 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     else:
         out["accuracy"] = float(np.mean(pred == y))
     return out
+
+
+def _cross_validate_batched(x: np.ndarray, y: np.ndarray, k: int,
+                            fold: np.ndarray, config: SVMConfig
+                            ) -> np.ndarray:
+    """All folds' classification subproblems in one batched program.
+
+    Binary: K subproblems, subproblem f = the +/-1 problem on rows with
+    fold != f. Multiclass: K * P subproblems (every fold x every OvO
+    pair), then each fold's slice of results votes on its held-out rows
+    exactly like the sequential path's per-fold MulticlassModel.
+    """
+    from dpsvm_tpu.models.svm import predict
+    from dpsvm_tpu.solver.batched_ovo import (build_pair_targets,
+                                              compact_submodel,
+                                              train_ovo_batched)
+
+    classes = np.unique(y)
+    if len(classes) < 2:
+        # Same fail-loudly contract as the sequential per-fold guard:
+        # a P=0 pair batch would otherwise "train" nothing and vote
+        # classes[0] everywhere with a perfect-looking accuracy.
+        raise ValueError(f"need at least 2 classes, got {classes}")
+    n = len(y)
+    pred = np.empty(n, y.dtype)
+    # Fold f's training split must hold every class (the sequential
+    # path's per-fold guard, checked up front here since training is
+    # one shot).
+    for f in range(k):
+        tr_classes = np.unique(y[fold != f])
+        if len(tr_classes) < len(classes):
+            raise ValueError(
+                f"CV fold {f}: training split is missing classes "
+                f"(has {tr_classes!r}) — a class has fewer than {k} "
+                "members; reduce k or rebalance the data")
+
+    if len(classes) == 2:
+        ypm = np.where(y == classes[-1], 1, -1).astype(np.float32)
+        yb = np.tile(ypm, (k, 1))
+        valid = np.stack([fold != f for f in range(k)])
+        yb[~valid] = 0.0
+        results = train_ovo_batched(x, yb, valid, config)
+        for f, r in enumerate(results):
+            sel = valid[f]
+            ys = np.where(ypm[sel] > 0, 1, -1).astype(np.int32)
+            model, _ = compact_submodel(x, sel, ys, r)
+            te = fold == f
+            p = predict(model, x[te])
+            pred[te] = np.where(p > 0, classes[-1], classes[0])
+        return pred
+
+    # Multiclass: K folds x P pairs in one batch. Subproblem (f, p)
+    # is pair p's +/-1 problem masked to fold f's training rows.
+    pair_yb, pair_valid, pairs = build_pair_targets(y, classes)
+    P = len(pairs)
+    yb = np.repeat(pair_yb[None, :, :], k, axis=0).reshape(k * P, n)
+    valid = (np.repeat(pair_valid[None, :, :], k, axis=0)
+             & np.stack([fold != f for f in range(k)])[:, None, :]
+             ).reshape(k * P, n)
+    yb[~valid] = 0.0
+    results = train_ovo_batched(x, yb, valid, config)
+    from dpsvm_tpu.models.multiclass import (MulticlassModel,
+                                             predict_multiclass)
+    for f in range(k):
+        models = []
+        for p, (ai, bi) in enumerate(pairs):
+            sel = valid[f * P + p]
+            ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
+            model, _ = compact_submodel(x, sel, ys, results[f * P + p])
+            models.append(model)
+        mc = MulticlassModel(classes=classes, pairs=pairs, models=models)
+        te = fold == f
+        pred[te] = predict_multiclass(mc, x[te])
+    return pred

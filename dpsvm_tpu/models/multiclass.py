@@ -79,47 +79,27 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     if len(classes) < 2:
         raise ValueError(f"need at least 2 classes, got {classes}")
     if batched:
-        # The batched program advances every pair with the plain
-        # first-order single-device step; reject anything that would
-        # silently fall back or change the math (no-silent-ignore, the
-        # config guard-table policy).
-        blockers = [name for name, bad in (
-            ("selection", config.selection != "first-order"),
-            ("weights", config.weight_pos != 1.0
-             or config.weight_neg != 1.0),
-            ("shards", config.shards != 1),
-            ("shrinking", config.shrinking not in (False, "auto")),
-            ("working_set", config.working_set not in (0, 2)),
-            ("cache_size", config.cache_size > 0),
-            ("use_pallas", config.use_pallas == "on"),
-            ("backend", config.backend != "xla"),
-            ("polish", config.polish),
-        ) if bad]
-        if blockers:
-            raise ValueError(
-                "batched OvO runs the plain first-order single-device "
-                f"path; incompatible options set: {blockers} (train "
-                "with batched=False for these)")
+        from dpsvm_tpu.solver.batched_ovo import batched_guard
+        batched_guard(config, "OvO")
     pairs, models, results = [], [], []
     platt: Optional[List[Tuple[float, float]]] = [] if probability else None
     if batched:
         from dpsvm_tpu.solver.batched_ovo import (build_pair_targets,
+                                                  compact_submodel,
                                                   train_ovo_batched)
 
         yb, valid, pairs = build_pair_targets(y, classes)
         batch_results = train_ovo_batched(x, yb, valid, config)
         for p, (ai, bi) in enumerate(pairs):
             sel = valid[p]
-            xs = np.ascontiguousarray(x[sel])
             ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
-            r = batch_results[p]
-            r = dataclasses.replace(
-                r, alpha=np.asarray(r.alpha, np.float32)[sel])
-            models.append(SVMModel.from_train_result(xs, ys, r))
+            model, r = compact_submodel(x, sel, ys, batch_results[p])
+            models.append(model)
             results.append(r)
             if probability:
                 from dpsvm_tpu.models.calibration import (fit_platt,
                                                           fit_platt_cv)
+                xs = np.ascontiguousarray(x[sel])
                 if probability == "cv":
                     platt.append(fit_platt_cv(xs, ys, config))
                 else:

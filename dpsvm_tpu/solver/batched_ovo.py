@@ -59,6 +59,45 @@ from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.utils import watchdog
 
 
+def compact_submodel(x: np.ndarray, sel: np.ndarray, ys: np.ndarray,
+                     result: TrainResult):
+    """(SVMModel, compacted TrainResult) for one batched subproblem:
+    the 'callers compact with their own row masks' step of
+    ``train_ovo_batched``'s contract, in ONE place for every consumer
+    (OvO pairs, binary CV folds, multiclass CV fold x pair)."""
+    import dataclasses
+
+    from dpsvm_tpu.models.svm import SVMModel
+
+    xs = np.ascontiguousarray(x[sel])
+    rr = dataclasses.replace(
+        result, alpha=np.asarray(result.alpha, np.float32)[sel])
+    return SVMModel.from_train_result(xs, np.asarray(ys, np.int32),
+                                      rr), rr
+
+
+def batched_guard(config: SVMConfig, what: str) -> None:
+    """Reject configs the batched program would silently ignore or
+    change the math of (the no-silent-ignore policy of config.validate's
+    guard tables). Shared by the OvO and CV batched entry points."""
+    blockers = [name for name, bad in (
+        ("selection", config.selection != "first-order"),
+        ("weights", config.weight_pos != 1.0 or config.weight_neg != 1.0),
+        ("shards", config.shards != 1),
+        ("shrinking", config.shrinking not in (False, "auto")),
+        ("working_set", config.working_set not in (0, 2)),
+        ("cache_size", config.cache_size > 0),
+        ("use_pallas", config.use_pallas == "on"),
+        ("backend", config.backend != "xla"),
+        ("polish", config.polish),
+    ) if bad]
+    if blockers:
+        raise ValueError(
+            f"batched {what} runs the plain first-order single-device "
+            f"path; incompatible options set: {blockers} (train "
+            "with batched=False for these)")
+
+
 class OvoCarry(NamedTuple):
     alpha: jax.Array    # (P, n) f32
     f: jax.Array        # (P, n) f32

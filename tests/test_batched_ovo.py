@@ -97,6 +97,51 @@ def test_batched_guard_table():
             train_multiclass(x, y, _cfg(**bad), batched=True)
 
 
+def test_batched_cv_binary_matches_sequential():
+    """Batched CV (K fold subproblems in one program) reproduces the
+    sequential CV protocol: same fold assignment, near-identical pooled
+    predictions (ulp-level matmul-layout differences can flip rare
+    boundary examples — same caveat as the OvO parity contract)."""
+    from dpsvm_tpu.models.cv import cross_validate
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(300, 8)).astype(np.float32)
+    y = (x[:, :2].sum(axis=1) > 0).astype(np.int32)
+    cfg = _cfg(gamma=0.125)
+    r_seq = cross_validate(x, y, 5, cfg, seed=3)
+    r_bat = cross_validate(x, y, 5, cfg, seed=3, batched=True)
+    np.testing.assert_array_equal(r_bat["folds"], r_seq["folds"])
+    agree = float(np.mean(r_bat["predictions"] == r_seq["predictions"]))
+    assert agree >= 0.99, agree
+    assert abs(r_bat["accuracy"] - r_seq["accuracy"]) <= 0.02
+
+
+def test_batched_cv_multiclass():
+    """Multiclass CV batches folds x pairs; pooled accuracy matches the
+    sequential run on a separable problem."""
+    from dpsvm_tpu.models.cv import cross_validate
+    x, y = make_three_class(n_per=60, d=4, seed=13)
+    cfg = _cfg()
+    r_seq = cross_validate(x, y, 4, cfg, seed=1)
+    r_bat = cross_validate(x, y, 4, cfg, seed=1, batched=True)
+    np.testing.assert_array_equal(r_bat["folds"], r_seq["folds"])
+    assert abs(r_bat["accuracy"] - r_seq["accuracy"]) <= 0.02
+    agree = float(np.mean(r_bat["predictions"] == r_seq["predictions"]))
+    assert agree >= 0.98, agree
+
+
+def test_batched_cv_guards():
+    from dpsvm_tpu.models.cv import cross_validate
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(60, 4)).astype(np.float32)
+    yc = (x[:, 0] > 0).astype(np.int32)
+    with pytest.raises(ValueError, match="classification-only"):
+        cross_validate(x, rng.normal(size=60).astype(np.float32), 3,
+                       _cfg(), task="svr", batched=True)
+    with pytest.raises(ValueError, match="batched"):
+        cross_validate(x, yc, 3, _cfg(selection="second-order"),
+                       batched=True)
+
+
 def test_batched_probability_platt():
     x, y = make_three_class(n_per=50, d=4, seed=5)
     m, _ = train_multiclass(x, y, _cfg(), batched=True, probability=True)
