@@ -91,7 +91,8 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                  matmul_precision: str = "highest",
                  working_set: int = 2, shrinking: bool = False,
                  polish: bool = False,
-                 probability: "Union[bool, str]" = False):
+                 probability: "Union[bool, str]" = False,
+                 batched: bool = False):
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -106,10 +107,15 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         self.shrinking = shrinking
         self.polish = polish
         self.probability = probability
+        # Multiclass-only: train all OvO pairs in one compiled batched
+        # program (solver/batched_ovo.py); ignored for binary fits
+        # (there is nothing to batch).
+        self.batched = batched
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "tol",
                     "max_iter", "selection", "shards", "matmul_precision",
-                    "working_set", "shrinking", "polish", "probability")
+                    "working_set", "shrinking", "polish", "probability",
+                    "batched")
     _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
@@ -161,7 +167,8 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         else:
             from dpsvm_tpu.models.multiclass import train_multiclass
             multi, results = train_multiclass(
-                X, y, self._config(), probability=self.probability)
+                X, y, self._config(), probability=self.probability,
+                batched=self.batched)
             state.update(
                 _multi=multi,
                 n_iter_=int(sum(r.n_iter for r in results)),

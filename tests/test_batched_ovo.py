@@ -149,3 +149,17 @@ def test_batched_probability_platt():
     proba = predict_proba_multiclass(m, x)
     assert proba.shape == (len(y), 3)
     np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_estimator_batched_param():
+    """DPSVMClassifier(batched=True) routes multiclass fits through the
+    batched trainer and round-trips through get_params (sklearn clone
+    protocol)."""
+    from dpsvm_tpu.models.estimator import DPSVMClassifier
+    x, y = make_three_class(n_per=40, d=4, seed=6)
+    clf = DPSVMClassifier(C=1.0, gamma=0.25, max_iter=20_000,
+                          batched=True).fit(x, y)
+    assert clf.score(x, y) > 0.9
+    params = clf.get_params()
+    assert params["batched"] is True
+    assert DPSVMClassifier(**params).get_params() == params
