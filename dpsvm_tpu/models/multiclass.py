@@ -132,9 +132,63 @@ def pairwise_decisions(model: MulticlassModel, x: np.ndarray,
                        include_b: bool = True) -> List[np.ndarray]:
     """One decision vector per pair — computed once and shared by the
     vote and the probability coupling (each pass is a full kernel
-    inference; callers evaluating both must not pay it twice)."""
+    inference; callers evaluating both must not pay it twice).
+
+    When every pair shares one kernel spec (always true for models this
+    package trains; checked, not assumed — a hand-assembled directory
+    may mix kernels), all P inferences collapse into ONE pass: a single
+    ``(m, d) @ (d, sum n_sv)`` MXU matmul over the concatenated SV
+    rows, then a per-pair segment sum — instead of P dispatches each
+    streaming x_test again."""
+    ms = model.models
+    specs = {(m.kernel, float(m.gamma), float(m.coef0), int(m.degree))
+             for m in ms}
+    if len(specs) == 1 and ms[0].kernel != "precomputed" and len(ms) > 1:
+        return _pairwise_decisions_batched(model, x, include_b)
     return [np.asarray(decision_function(m, x, include_b=include_b))
-            for m in model.models]
+            for m in ms]
+
+
+def _pairwise_decisions_batched(model: MulticlassModel, x: np.ndarray,
+                                include_b: bool,
+                                batch_size: int = 8192
+                                ) -> List[np.ndarray]:
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.models.svm import _pairwise_decisions_jit
+
+    ms = model.models
+    x = np.asarray(x, np.float32)
+    # Loop-invariant operands go to the device ONCE (the whole point of
+    # the batched path is removing redundant transfers).
+    sv_all = jnp.asarray(np.concatenate([m.x_sv for m in ms]))
+    coef = jnp.asarray(np.concatenate(
+        [m.alpha * m.y_sv.astype(np.float32) for m in ms]))
+    seg_ids = jnp.asarray(np.repeat(np.arange(len(ms), dtype=np.int32),
+                                    [len(m.alpha) for m in ms]))
+    b_vec = jnp.asarray(np.array([m.b for m in ms], np.float32))
+    spec = ms[0]
+    m_rows = x.shape[0]
+    P = len(ms)
+    args = (sv_all, coef, seg_ids, b_vec, jnp.float32(spec.gamma),
+            jnp.float32(spec.coef0))
+    kw = dict(kind=spec.kernel, degree=int(spec.degree),
+              include_b=include_b, num_segments=P)
+    if m_rows <= batch_size:
+        out = np.asarray(_pairwise_decisions_jit(jnp.asarray(x), *args,
+                                                 **kw))
+        return [out[:, p] for p in range(P)]
+    # Pad to a full batch grid so jit compiles exactly once
+    # (decision_function's scheme).
+    out = np.empty((m_rows, P), np.float32)
+    for lo in range(0, m_rows, batch_size):
+        hi = min(lo + batch_size, m_rows)
+        block = np.zeros((batch_size, x.shape[1]), np.float32)
+        block[: hi - lo] = x[lo:hi]
+        vals = np.asarray(_pairwise_decisions_jit(jnp.asarray(block),
+                                                  *args, **kw))
+        out[lo:hi] = vals[: hi - lo]
+    return [out[:, p] for p in range(P)]
 
 
 def predict_multiclass(model: MulticlassModel, x: np.ndarray,

@@ -109,6 +109,30 @@ class SVMModel:
         )
 
 
+@functools.partial(jax.jit, static_argnames=("kind", "degree",
+                                             "include_b",
+                                             "num_segments"))
+def _pairwise_decisions_jit(x_test, sv_all, coef, seg_ids, b_vec, gamma,
+                            coef0, kind: str, degree: int,
+                            include_b: bool, num_segments: int):
+    """All P pairwise decisions in one pass (models/multiclass.py's
+    batched path): one (m, d) @ (d, S) kernel matmul over the
+    concatenated SV rows, then a sorted segment_sum per pair — O(m*S)
+    like the per-model loop (no dense (S, P) reduction matrix), and a
+    non-finite kernel value stays confined to its own pair's decision
+    exactly as in the loop."""
+    spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
+    t2 = row_norms_sq(x_test)
+    sv2 = row_norms_sq(sv_all)
+    k = kernel_rows(x_test, t2, sv_all, sv2, spec)    # (m, S)
+    dual = jax.ops.segment_sum((k * coef[None, :]).T, seg_ids,
+                               num_segments=num_segments,
+                               indices_are_sorted=True).T    # (m, P)
+    if include_b:
+        dual = dual - b_vec[None, :]
+    return dual
+
+
 @functools.partial(jax.jit, static_argnames=("kind", "degree", "include_b"))
 def _decision_jit(x_test, x_sv, coef, sv2, b, gamma, coef0,
                   kind: str, degree: int, include_b: bool):
