@@ -130,11 +130,16 @@ def build_pair_targets(y: np.ndarray, classes: np.ndarray
     return yb, valid, pairs
 
 
-def _ovo_step(carry: OvoCarry, x, yb, x2, valid, *, c: float,
-              kspec: KernelSpec, epsilon: float, max_iter: int,
+def _ovo_step(carry: OvoCarry, x, yb, x2, valid, c_arr,
+              *, kspec: KernelSpec, epsilon: float, max_iter: int,
               precision, pairwise_clip: bool) -> OvoCarry:
     """One batched step: every still-active subproblem advances one
-    exact first-order SMO iteration; finished ones are frozen."""
+    exact first-order SMO iteration; finished ones are frozen.
+
+    ``c_arr`` is the (P,) per-subproblem box bound — identical values
+    for OvO/CV batches, distinct ones for the C-grid sweep (the box is
+    the ONLY place C enters the iteration, so one compiled program
+    serves any C assignment)."""
     alpha, f = carry.alpha, carry.f
     P = alpha.shape[0]
     rows_p = jnp.arange(P)
@@ -148,7 +153,7 @@ def _ovo_step(carry: OvoCarry, x, yb, x2, valid, *, c: float,
     # --- masked first-order selection, all problems at once ----------
     # (masked_scores is elementwise, so the shared membership
     # definition broadcasts over the (P, n) batch unchanged.)
-    f_up, f_low = masked_scores(alpha, yb, f, c, valid)
+    f_up, f_low = masked_scores(alpha, yb, f, c_arr[:, None], valid)
     i_hi = jnp.argmin(f_up, axis=1)                     # (P,)
     i_lo = jnp.argmax(f_low, axis=1)
     b_hi = jnp.take_along_axis(f_up, i_hi[:, None], 1)[:, 0]
@@ -169,9 +174,8 @@ def _ovo_step(carry: OvoCarry, x, yb, x2, valid, *, c: float,
     y_lo = gather(yb, i_lo)
     a_hi = gather(alpha, i_hi)
     a_lo = gather(alpha, i_lo)
-    c_f = jnp.full((P,), jnp.float32(c))
     a_hi_n, a_lo_n = alpha_pair_step(a_hi, a_lo, y_hi, y_lo, b_hi, b_lo,
-                                     eta, c_f, c_f, pairwise_clip)
+                                     eta, c_arr, c_arr, pairwise_clip)
     # Freeze finished problems: their alphas keep the old values and
     # their f deltas are zero.
     a_hi_n = jnp.where(active, a_hi_n, a_hi)
@@ -197,14 +201,15 @@ def _ovo_step(carry: OvoCarry, x, yb, x2, valid, *, c: float,
 
 
 @functools.lru_cache(maxsize=16)
-def _build_ovo_runner(c: float, kspec: KernelSpec, epsilon: float,
+def _build_ovo_runner(kspec: KernelSpec, epsilon: float,
                       max_iter: int, precision_name: str,
                       pairwise_clip: bool):
     """Compiled batched chunk runner, cached per hyperparameter set.
-    Shapes (P, n, d) specialize via jit."""
+    Shapes (P, n, d) specialize via jit; C rides as a traced (P,)
+    argument so one program serves every C assignment."""
     precision = getattr(lax.Precision, precision_name)
 
-    def chunk(carry: OvoCarry, x, yb, x2, valid, limit):
+    def chunk(carry: OvoCarry, x, yb, x2, valid, c_arr, limit):
         def cond(s):
             any_active = jnp.any(
                 (s.b_lo > s.b_hi + 2.0 * epsilon)
@@ -213,7 +218,8 @@ def _build_ovo_runner(c: float, kspec: KernelSpec, epsilon: float,
 
         final = lax.while_loop(
             cond,
-            lambda s: _ovo_step(s, x, yb, x2, valid, c=c, kspec=kspec,
+            lambda s: _ovo_step(s, x, yb, x2, valid, c_arr,
+                                kspec=kspec,
                                 epsilon=epsilon, max_iter=max_iter,
                                 precision=precision,
                                 pairwise_clip=pairwise_clip),
@@ -231,11 +237,16 @@ def _build_ovo_runner(c: float, kspec: KernelSpec, epsilon: float,
 
 def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
                       config: SVMConfig,
-                      device: Optional[jax.Device] = None
+                      device: Optional[jax.Device] = None,
+                      c_values: Optional[np.ndarray] = None
                       ) -> List[TrainResult]:
     """Train the (P, n) OvO batch; one TrainResult per subproblem, each
     carrying the FULL-LENGTH (n,) alpha (zeros off the subproblem —
-    callers compact with their own row masks)."""
+    callers compact with their own row masks).
+
+    ``c_values`` (optional (P,)) gives each subproblem its own box
+    bound — the C-grid sweep (train_c_sweep). Default: config.c
+    everywhere."""
     config.validate()
     n, d = x.shape
     P = yb.shape[0]
@@ -259,7 +270,20 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
     if device is not None:
         carry = jax.device_put(carry, device)
 
-    runner = _build_ovo_runner(float(config.c), kspec,
+    if c_values is None:
+        c_arr = np.full((P,), np.float32(config.c))
+    else:
+        c_arr = np.asarray(c_values, np.float32)
+        if c_arr.shape != (P,):
+            raise ValueError(f"c_values must have shape ({P},), got "
+                             f"{c_arr.shape}")
+        if not np.all(c_arr > 0):
+            # (not np.any(<= 0): NaN passes that form and would train a
+            # silently-"converged" empty model with b=nan)
+            raise ValueError("every C in c_values must be a finite "
+                             "number > 0")
+    c_d = jax.device_put(jnp.asarray(c_arr), device)
+    runner = _build_ovo_runner(kspec,
                                float(config.epsilon),
                                int(config.max_iter), precision_name,
                                config.clip == "pairwise")
@@ -272,7 +296,7 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
     watchdog.pet()
 
     limit = min(chunk, budget)
-    carry, stats = runner(carry, xd, ybd, x2, vd, jnp.int32(limit))
+    carry, stats = runner(carry, xd, ybd, x2, vd, c_d, jnp.int32(limit))
     while True:
         # Speculative next chunk before the poll blocks (same dispatch
         # pipelining as driver.host_training_loop; a chunk dispatched
@@ -280,7 +304,7 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
         limit_next = min(limit + chunk, budget)
         if limit_next > limit:
             carry_next, stats_next = runner(carry, xd, ybd, x2, vd,
-                                            jnp.int32(limit_next))
+                                            c_d, jnp.int32(limit_next))
         else:
             carry_next = stats_next = None
 
@@ -318,3 +342,39 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
             degree=int(config.degree),
         ))
     return results
+
+
+def train_c_sweep(x: np.ndarray, y: np.ndarray, cs,
+                  config: SVMConfig,
+                  device: Optional[jax.Device] = None
+                  ) -> List[TrainResult]:
+    """Train the SAME binary problem at every C in ``cs`` — in ONE
+    compiled batched program (LIBSVM users run grid.py and pay one full
+    training per grid point; here the C column of the grid shares the
+    X stream and the per-step latency like any other subproblem batch,
+    since the box bound is the only place C enters the iteration).
+
+    ``y`` is +/-1; returns one full-problem TrainResult per C, in input
+    order. config.c is ignored in favor of ``cs``. Same solver scope as
+    every batched path (``batched_guard``)."""
+    batched_guard(config, "C-sweep")
+    if config.kernel == "precomputed":
+        # The batched step computes kernel rows from X (matmul +
+        # epilogue); the precomputed gather path is not wired into it.
+        # Same explicit rejection as train_multiclass / cross_validate.
+        raise ValueError("the batched C-sweep does not support the "
+                         "precomputed kernel; fit each C with "
+                         "api.fit instead")
+    cs = np.asarray(cs, np.float32)
+    if cs.ndim != 1 or len(cs) == 0:
+        raise ValueError(f"cs must be a non-empty 1-D list of C values, "
+                         f"got shape {cs.shape}")
+    y = np.asarray(y, np.float32)
+    bad = set(np.unique(y)) - {1.0, -1.0}
+    if bad:
+        raise ValueError(f"train_c_sweep takes +/-1 labels, got extra "
+                         f"values {sorted(bad)}")
+    yb = np.tile(y, (len(cs), 1))
+    valid = np.ones((len(cs), len(y)), bool)
+    return train_ovo_batched(x, yb, valid, config, device=device,
+                             c_values=cs)

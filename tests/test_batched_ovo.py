@@ -163,3 +163,65 @@ def test_estimator_batched_param():
     params = clf.get_params()
     assert params["batched"] is True
     assert DPSVMClassifier(**params).get_params() == params
+
+
+def test_c_sweep_matches_individual_fits():
+    """Every C of a batched sweep converges to the model an individual
+    fit at that C produces (bitwise for P=1 is covered above; here the
+    layouts differ, so model-level: same n_sv, alpha/b within float
+    tolerance)."""
+    import dataclasses
+
+    from dpsvm_tpu import api
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(200, 6)).astype(np.float32)
+    y = np.where(x[:, 0] + 0.3 * rng.normal(size=200) > 0, 1, -1
+                 ).astype(np.int32)
+    cs = [0.1, 1.0, 10.0]
+    cfg = _cfg()
+    swept = api.sweep_c(x, y, cs, cfg)
+    assert len(swept) == 3
+    for c, (model, r) in zip(cs, swept):
+        cfg_c = dataclasses.replace(cfg, c=c)
+        _, r_ind = api.fit(x, y, cfg_c)
+        assert r.converged and r_ind.converged
+        assert r.n_sv == r_ind.n_sv, c
+        np.testing.assert_allclose(np.asarray(r.alpha),
+                                   np.asarray(r_ind.alpha), atol=5e-3)
+        assert r.b == pytest.approx(r_ind.b, abs=1e-3)
+    # more regularization -> no fewer bounded SVs; distinct C gave
+    # distinct models (the sweep really varied the box)
+    assert len({m.n_sv for m, _ in swept}) > 1
+
+
+def test_c_sweep_guards():
+    from dpsvm_tpu.solver.batched_ovo import train_c_sweep
+    x = np.zeros((20, 3), np.float32)
+    y = np.ones(20, np.float32)
+    with pytest.raises(ValueError, match="labels"):
+        train_c_sweep(x, np.arange(20), [1.0], _cfg())
+    with pytest.raises(ValueError, match="non-empty"):
+        train_c_sweep(x, y, [], _cfg())
+    with pytest.raises(ValueError, match="batched"):
+        train_c_sweep(x, y, [1.0], _cfg(selection="second-order"))
+    with pytest.raises(ValueError, match="> 0"):
+        from dpsvm_tpu.solver.batched_ovo import train_ovo_batched
+        train_ovo_batched(x, np.tile(y, (1, 1)), np.ones((1, 20), bool),
+                          _cfg(), c_values=np.array([-1.0]))
+
+
+def test_c_sweep_validation_gaps():
+    """NaN C, mismatched y length, and precomputed kernel all fail
+    loudly before training."""
+    from dpsvm_tpu import api
+    from dpsvm_tpu.solver.batched_ovo import train_c_sweep
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(50, 4)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    with pytest.raises(ValueError, match="finite"):
+        api.sweep_c(x, y, [float("nan")], _cfg())
+    with pytest.raises(ValueError, match="y must be"):
+        api.sweep_c(x, y[:-1], [1.0], _cfg())
+    with pytest.raises(ValueError, match="precomputed"):
+        train_c_sweep(np.eye(50, dtype=np.float32), y.astype(np.float32),
+                      [1.0], _cfg(kernel="precomputed"))
