@@ -179,6 +179,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--multiclass", action="store_true",
                     help="one-vs-one multi-class training (labels may be "
                          "any integers; -m becomes a model DIRECTORY)")
+    tr.add_argument("--c-sweep", default=None, metavar="C1,C2,...",
+                    help="with --cv: evaluate CV accuracy at every C of "
+                         "the comma list in ONE batched program (all "
+                         "folds x all C points — LIBSVM grid.py's inner "
+                         "loop as a single compiled batch; binary "
+                         "classification only) and report the best C")
     tr.add_argument("--batched", action="store_true",
                     help="train independent subproblems in ONE compiled "
                          "batched program — all one-vs-one pairs with "
@@ -307,6 +313,14 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "reference-format per-pair files", file=sys.stderr)
             return 2
 
+    if args.c_sweep is not None and not args.cv:
+        print("error: --c-sweep requires --cv K (it selects C by "
+              "cross-validated accuracy)", file=sys.stderr)
+        return 2
+    if args.c_sweep is not None and (args.svr or args.multiclass):
+        print("error: --c-sweep is binary-classification-only",
+              file=sys.stderr)
+        return 2
     if args.batched and not (args.multiclass or args.cv):
         print("error: --batched applies to --multiclass or --cv "
               "training", file=sys.stderr)
@@ -456,6 +470,21 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     if args.cv:
         from dpsvm_tpu.models.cv import cross_validate
+        if args.c_sweep is not None:
+            from dpsvm_tpu.models.cv import cross_validate_c_sweep
+            try:
+                cs = [float(t) for t in args.c_sweep.split(",") if t]
+            except ValueError:
+                print(f"error: --c-sweep needs a comma list of numbers, "
+                      f"got {args.c_sweep!r}", file=sys.stderr)
+                return 2
+            r = cross_validate_c_sweep(x, y, args.cv, cs, config)
+            for c, a in zip(r["cs"], r["accuracies"]):
+                print(f"C={c:g}: Cross Validation Accuracy = "
+                      f"{a * 100:.4f}%")
+            print(f"Best: C={r['best_c']:g} "
+                  f"({r['best_accuracy'] * 100:.4f}%)")
+            return 0
         r = cross_validate(x, y, args.cv, config,
                            task="svr" if args.svr else "svc",
                            batched=args.batched)

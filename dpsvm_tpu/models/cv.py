@@ -198,3 +198,75 @@ def _cross_validate_batched(x: np.ndarray, y: np.ndarray, k: int,
         te = fold == f
         pred[te] = predict_multiclass(mc, x[te])
     return pred
+
+
+def cross_validate_c_sweep(x: np.ndarray, y: np.ndarray, k: int, cs,
+                           config: Optional[SVMConfig] = None,
+                           seed: int = 0) -> dict:
+    """CV accuracy at every C of a grid — ALL folds x C points in one
+    compiled batched program (binary classification).
+
+    This is LIBSVM grid.py's inner loop (one k-fold CV per C, each fold
+    a full training) collapsed into a single batch of k * len(cs)
+    masked subproblems: subproblem (f, j) trains fold f's split at
+    C=cs[j]. Returns {"cs", "accuracies", "best_c", "best_accuracy",
+    "folds"}; ties prefer the SMALLER C (more regularization at equal
+    held-out accuracy).
+    """
+    from dpsvm_tpu.models.svm import predict
+    from dpsvm_tpu.solver.batched_ovo import (batched_guard,
+                                              compact_submodel,
+                                              train_ovo_batched,
+                                              validate_c_grid)
+    from dpsvm_tpu.utils import densify
+
+    config = config or SVMConfig()
+    batched_guard(config, "CV C-sweep")
+    if config.checkpoint_path or config.resume_from:
+        raise ValueError("checkpoint/resume are single-run options; "
+                         "they cannot be shared across the sweep's "
+                         "fold x C subproblems")
+    cs_in = [float(c) for c in np.asarray(cs).ravel()]
+    cs = validate_c_grid(cs, config)
+    x = np.asarray(densify(x), np.float32)
+    y = np.asarray(y)
+    classes = np.unique(y)
+    if len(classes) != 2:
+        raise ValueError("the CV C-sweep is binary-only; run "
+                         "cross_validate per C for multiclass")
+
+    fold = kfold_assignment(y, k, seed, stratify=True)
+    for f in range(k):
+        if len(np.unique(y[fold != f])) < 2:
+            raise ValueError(
+                f"CV fold {f}: training split has a single class — a "
+                f"class has fewer than {k} members; reduce k")
+    ypm = np.where(y == classes[-1], 1, -1).astype(np.float32)
+    n, J = len(y), len(cs)
+    # Subproblem (f, j) -> row f*J + j: fold f's mask, C = cs[j].
+    yb = np.tile(ypm, (k * J, 1))
+    valid = np.repeat(np.stack([fold != f for f in range(k)]), J, axis=0)
+    yb[~valid] = 0.0
+    c_values = np.tile(cs, k)
+    results = train_ovo_batched(x, yb, valid, config, c_values=c_values)
+
+    correct = np.zeros(J, np.int64)
+    for f in range(k):
+        te = fold == f
+        sel = valid[f * J]              # same training mask for all C
+        # the fold's training slice and labels are shared by its whole
+        # C column — copy once, not J times
+        xs = np.ascontiguousarray(x[sel])
+        ys = np.where(ypm[sel] > 0, 1, -1).astype(np.int32)
+        for j in range(J):
+            model, _ = compact_submodel(x, sel, ys, results[f * J + j],
+                                        xs=xs)
+            p = predict(model, x[te])
+            pred = np.where(p > 0, classes[-1], classes[0])
+            correct[j] += int(np.sum(pred == y[te]))
+    accs = correct / float(n)
+    best = int(max(range(J), key=lambda j: (accs[j], -cs_in[j])))
+    # report the caller's ORIGINAL values (the f32 cast is a training
+    # detail; best_c must compare equal to the input grid point)
+    return {"cs": cs_in, "accuracies": accs, "best_c": cs_in[best],
+            "best_accuracy": float(accs[best]), "folds": fold, "k": k}

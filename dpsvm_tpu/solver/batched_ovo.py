@@ -60,16 +60,21 @@ from dpsvm_tpu.utils import watchdog
 
 
 def compact_submodel(x: np.ndarray, sel: np.ndarray, ys: np.ndarray,
-                     result: TrainResult):
+                     result: TrainResult, xs: "Optional[np.ndarray]" = None):
     """(SVMModel, compacted TrainResult) for one batched subproblem:
     the 'callers compact with their own row masks' step of
     ``train_ovo_batched``'s contract, in ONE place for every consumer
-    (OvO pairs, binary CV folds, multiclass CV fold x pair)."""
+    (OvO pairs, binary CV folds, multiclass CV fold x pair).
+
+    ``xs``: the precomputed x[sel] slice, for callers scoring several
+    subproblems that share one mask (the CV C-sweep's per-fold C
+    column) — skips re-copying the training slice per subproblem."""
     import dataclasses
 
     from dpsvm_tpu.models.svm import SVMModel
 
-    xs = np.ascontiguousarray(x[sel])
+    if xs is None:
+        xs = np.ascontiguousarray(x[sel])
     rr = dataclasses.replace(
         result, alpha=np.asarray(result.alpha, np.float32)[sel])
     return SVMModel.from_train_result(xs, np.asarray(ys, np.int32),
@@ -344,6 +349,25 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
     return results
 
 
+def validate_c_grid(cs, config: SVMConfig) -> np.ndarray:
+    """Shared validation for the C-grid entry points (train_c_sweep,
+    models/cv.cross_validate_c_sweep): one copy of the cs and
+    precomputed-kernel rules so the two paths cannot drift. Returns the
+    f32 cs array actually trained with (callers keep their original
+    values for reporting — f32 rounding must not leak into results)."""
+    if config.kernel == "precomputed":
+        # The batched step computes kernel rows from X (matmul +
+        # epilogue); the precomputed gather path is not wired into it.
+        raise ValueError("the batched C-sweep does not support the "
+                         "precomputed kernel; fit each C with "
+                         "api.fit instead")
+    cs = np.asarray(cs, np.float32)
+    if cs.ndim != 1 or len(cs) == 0:
+        raise ValueError(f"cs must be a non-empty 1-D list of C values, "
+                         f"got shape {cs.shape}")
+    return cs
+
+
 def train_c_sweep(x: np.ndarray, y: np.ndarray, cs,
                   config: SVMConfig,
                   device: Optional[jax.Device] = None
@@ -358,17 +382,7 @@ def train_c_sweep(x: np.ndarray, y: np.ndarray, cs,
     order. config.c is ignored in favor of ``cs``. Same solver scope as
     every batched path (``batched_guard``)."""
     batched_guard(config, "C-sweep")
-    if config.kernel == "precomputed":
-        # The batched step computes kernel rows from X (matmul +
-        # epilogue); the precomputed gather path is not wired into it.
-        # Same explicit rejection as train_multiclass / cross_validate.
-        raise ValueError("the batched C-sweep does not support the "
-                         "precomputed kernel; fit each C with "
-                         "api.fit instead")
-    cs = np.asarray(cs, np.float32)
-    if cs.ndim != 1 or len(cs) == 0:
-        raise ValueError(f"cs must be a non-empty 1-D list of C values, "
-                         f"got shape {cs.shape}")
+    cs = validate_c_grid(cs, config)
     y = np.asarray(y, np.float32)
     bad = set(np.unique(y)) - {1.0, -1.0}
     if bad:

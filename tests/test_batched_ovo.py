@@ -225,3 +225,34 @@ def test_c_sweep_validation_gaps():
     with pytest.raises(ValueError, match="precomputed"):
         train_c_sweep(np.eye(50, dtype=np.float32), y.astype(np.float32),
                       [1.0], _cfg(kernel="precomputed"))
+
+
+def test_cv_c_sweep_matches_per_c_cv():
+    """The folds x C batch reproduces per-C cross_validate accuracies
+    (same fold seed, same protocol) and picks the argmax C."""
+    from dpsvm_tpu.models.cv import cross_validate, cross_validate_c_sweep
+    rng = np.random.default_rng(41)
+    x = rng.normal(size=(240, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * rng.normal(size=240) > 0).astype(np.int32)
+    cs = [0.1, 1.0, 10.0]
+    cfg = _cfg(gamma=0.125)
+    import dataclasses
+    sweep = cross_validate_c_sweep(x, y, 4, cs, cfg, seed=2)
+    for j, c in enumerate(cs):
+        r = cross_validate(x, y, 4, dataclasses.replace(cfg, c=c),
+                           seed=2)
+        assert abs(sweep["accuracies"][j] - r["accuracy"]) <= 0.02, c
+    assert sweep["best_c"] in cs
+    j_best = int(np.argmax(sweep["accuracies"]))
+    assert sweep["best_accuracy"] == sweep["accuracies"][j_best]
+
+
+def test_cv_c_sweep_guards():
+    from dpsvm_tpu.models.cv import cross_validate_c_sweep
+    x, y = make_three_class(n_per=20, d=4, seed=3)
+    with pytest.raises(ValueError, match="binary-only"):
+        cross_validate_c_sweep(x, y, 3, [1.0], _cfg())
+    xb = x[y != 7]
+    yb = y[y != 7]
+    with pytest.raises(ValueError, match="non-empty"):
+        cross_validate_c_sweep(xb, yb, 3, [], _cfg())
