@@ -151,19 +151,21 @@ def fit(x: np.ndarray, y: np.ndarray,
 
 
 def sweep_c(x: np.ndarray, y: np.ndarray, cs,
-            config: Optional[SVMConfig] = None
-            ) -> "list[Tuple[SVMModel, TrainResult]]":
-    """Fit the same +/-1 problem at every C in ``cs`` in ONE compiled
-    batched program (solver/batched_ovo.train_c_sweep — the C-grid
-    analog of LIBSVM's grid.py, without one full training per grid
-    point). Returns [(model, result)] in ``cs`` order; combine with a
-    held-out set or models/cv for selection."""
+            config: Optional[SVMConfig] = None,
+            gammas=None) -> "list[Tuple[SVMModel, TrainResult]]":
+    """Fit the same +/-1 problem at every point of a C (x gamma) grid
+    in ONE compiled batched program (solver/batched_ovo.train_c_sweep —
+    LIBSVM grid.py's whole grid as one batch: C only moves the box
+    bound, gamma only the kernel epilogue after the shared dots).
+    Returns [(model, result)] in ``cs`` order (row-major (C, gamma)
+    order with ``gammas``); combine with a held-out set or models/cv
+    for selection."""
     from dpsvm_tpu.models.svm import SVMModel
     from dpsvm_tpu.solver.batched_ovo import train_c_sweep
 
     x, y = _check_xy(x, y)
     config = config or SVMConfig()
-    results = train_c_sweep(x, y, cs, config)
+    results = train_c_sweep(x, y, cs, config, gammas=gammas)
     return [(SVMModel.from_train_result(x, y, r), r) for r in results]
 
 

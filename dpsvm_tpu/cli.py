@@ -185,6 +185,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "folds x all C points — LIBSVM grid.py's inner "
                          "loop as a single compiled batch; binary "
                          "classification only) and report the best C")
+    tr.add_argument("--gamma-sweep", default=None, metavar="G1,G2,...",
+                    help="with --cv --c-sweep: extend the sweep to the "
+                         "full C x gamma grid, still one batched "
+                         "program (gamma only enters the kernel "
+                         "epilogue; the dot products are shared)")
     tr.add_argument("--batched", action="store_true",
                     help="train independent subproblems in ONE compiled "
                          "batched program — all one-vs-one pairs with "
@@ -313,6 +318,10 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "reference-format per-pair files", file=sys.stderr)
             return 2
 
+    if args.gamma_sweep is not None and args.c_sweep is None:
+        print("error: --gamma-sweep extends --c-sweep (pass both)",
+              file=sys.stderr)
+        return 2
     if args.c_sweep is not None and not args.cv:
         print("error: --c-sweep requires --cv K (it selects C by "
               "cross-validated accuracy)", file=sys.stderr)
@@ -474,15 +483,27 @@ def cmd_train(args: argparse.Namespace) -> int:
             from dpsvm_tpu.models.cv import cross_validate_c_sweep
             try:
                 cs = [float(t) for t in args.c_sweep.split(",") if t]
+                gs = ([float(t) for t in args.gamma_sweep.split(",") if t]
+                      if args.gamma_sweep is not None else None)
             except ValueError:
-                print(f"error: --c-sweep needs a comma list of numbers, "
-                      f"got {args.c_sweep!r}", file=sys.stderr)
+                print("error: --c-sweep/--gamma-sweep need comma lists "
+                      "of numbers", file=sys.stderr)
                 return 2
-            r = cross_validate_c_sweep(x, y, args.cv, cs, config)
-            for c, a in zip(r["cs"], r["accuracies"]):
-                print(f"C={c:g}: Cross Validation Accuracy = "
-                      f"{a * 100:.4f}%")
-            print(f"Best: C={r['best_c']:g} "
+            r = cross_validate_c_sweep(x, y, args.cv, cs, config,
+                                       gammas=gs)
+            if gs is None:
+                for c, a in zip(r["cs"], r["accuracies"]):
+                    print(f"C={c:g}: Cross Validation Accuracy = "
+                          f"{a * 100:.4f}%")
+                print(f"Best: C={r['best_c']:g} "
+                      f"({r['best_accuracy'] * 100:.4f}%)")
+                return 0
+            for i, c in enumerate(r["cs"]):
+                for j, g in enumerate(r["gammas"]):
+                    print(f"C={c:g} gamma={g:g}: Cross Validation "
+                          f"Accuracy = "
+                          f"{r['accuracies'][i, j] * 100:.4f}%")
+            print(f"Best: C={r['best_c']:g} gamma={r['best_gamma']:g} "
                   f"({r['best_accuracy'] * 100:.4f}%)")
             return 0
         r = cross_validate(x, y, args.cv, config,

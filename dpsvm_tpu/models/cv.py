@@ -202,16 +202,19 @@ def _cross_validate_batched(x: np.ndarray, y: np.ndarray, k: int,
 
 def cross_validate_c_sweep(x: np.ndarray, y: np.ndarray, k: int, cs,
                            config: Optional[SVMConfig] = None,
-                           seed: int = 0) -> dict:
-    """CV accuracy at every C of a grid — ALL folds x C points in one
-    compiled batched program (binary classification).
+                           seed: int = 0, gammas=None) -> dict:
+    """CV accuracy at every point of a C (x gamma) grid — ALL folds x
+    grid points in one compiled batched program (binary
+    classification).
 
-    This is LIBSVM grid.py's inner loop (one k-fold CV per C, each fold
-    a full training) collapsed into a single batch of k * len(cs)
-    masked subproblems: subproblem (f, j) trains fold f's split at
-    C=cs[j]. Returns {"cs", "accuracies", "best_c", "best_accuracy",
-    "folds"}; ties prefer the SMALLER C (more regularization at equal
-    held-out accuracy).
+    This is LIBSVM grid.py (one k-fold CV per grid point, each fold a
+    full training) collapsed into a single batch of k * len(cs) [*
+    len(gammas)] masked subproblems. Returns {"cs", "accuracies",
+    "best_c", "best_accuracy", "folds"}; with ``gammas`` also
+    {"gammas", "best_gamma"}, and "accuracies" becomes a
+    (len(cs), len(gammas)) matrix. Ties prefer the SMALLER C (more
+    regularization at equal held-out accuracy), then the smaller gamma
+    (smoother kernel).
     """
     from dpsvm_tpu.models.svm import predict
     from dpsvm_tpu.solver.batched_ovo import (batched_guard,
@@ -226,8 +229,12 @@ def cross_validate_c_sweep(x: np.ndarray, y: np.ndarray, k: int, cs,
         raise ValueError("checkpoint/resume are single-run options; "
                          "they cannot be shared across the sweep's "
                          "fold x C subproblems")
+    # capture the caller's ORIGINAL values before the f32 training cast
+    # (reported best_c/best_gamma must compare equal to the input grid)
     cs_in = [float(c) for c in np.asarray(cs).ravel()]
-    cs = validate_c_grid(cs, config)
+    gammas_in = (None if gammas is None
+                 else [float(g) for g in np.asarray(gammas).ravel()])
+    cs, gammas = validate_c_grid(cs, config, gammas)
     x = np.asarray(densify(x), np.float32)
     y = np.asarray(y)
     classes = np.unique(y)
@@ -242,13 +249,23 @@ def cross_validate_c_sweep(x: np.ndarray, y: np.ndarray, k: int, cs,
                 f"CV fold {f}: training split has a single class — a "
                 f"class has fewer than {k} members; reduce k")
     ypm = np.where(y == classes[-1], 1, -1).astype(np.float32)
-    n, J = len(y), len(cs)
-    # Subproblem (f, j) -> row f*J + j: fold f's mask, C = cs[j].
+    n = len(y)
+    # The per-fold grid column: (C, gamma) pairs in row-major order
+    # (plain C list when no gamma axis).
+    if gammas_in is None:
+        grid_c, grid_g = list(cs), None
+    else:
+        grid_c = [c for c in cs for _ in gammas_in]
+        grid_g = np.array(gammas_in * len(cs), np.float32)
+    J = len(grid_c)
+    # Subproblem (f, j) -> row f*J + j: fold f's mask, grid point j.
     yb = np.tile(ypm, (k * J, 1))
     valid = np.repeat(np.stack([fold != f for f in range(k)]), J, axis=0)
     yb[~valid] = 0.0
-    c_values = np.tile(cs, k)
-    results = train_ovo_batched(x, yb, valid, config, c_values=c_values)
+    c_values = np.tile(np.asarray(grid_c, np.float32), k)
+    gamma_values = None if grid_g is None else np.tile(grid_g, k)
+    results = train_ovo_batched(x, yb, valid, config, c_values=c_values,
+                                gamma_values=gamma_values)
 
     correct = np.zeros(J, np.int64)
     for f in range(k):
@@ -265,8 +282,17 @@ def cross_validate_c_sweep(x: np.ndarray, y: np.ndarray, k: int, cs,
             pred = np.where(p > 0, classes[-1], classes[0])
             correct[j] += int(np.sum(pred == y[te]))
     accs = correct / float(n)
-    best = int(max(range(J), key=lambda j: (accs[j], -cs_in[j])))
     # report the caller's ORIGINAL values (the f32 cast is a training
     # detail; best_c must compare equal to the input grid point)
-    return {"cs": cs_in, "accuracies": accs, "best_c": cs_in[best],
+    if gammas_in is None:
+        best = int(max(range(J), key=lambda j: (accs[j], -cs_in[j])))
+        return {"cs": cs_in, "accuracies": accs, "best_c": cs_in[best],
+                "best_accuracy": float(accs[best]), "folds": fold,
+                "k": k}
+    G = len(gammas_in)
+    best = int(max(range(J), key=lambda j: (
+        accs[j], -cs_in[j // G], -gammas_in[j % G])))
+    return {"cs": cs_in, "gammas": gammas_in,
+            "accuracies": accs.reshape(len(cs_in), G),
+            "best_c": cs_in[best // G], "best_gamma": gammas_in[best % G],
             "best_accuracy": float(accs[best]), "folds": fold, "k": k}

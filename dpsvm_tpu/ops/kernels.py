@@ -100,21 +100,27 @@ def rbf_rows_from_dots(dots: jax.Array, w2: jax.Array, x2: jax.Array,
 
 
 def rows_from_dots(dots: jax.Array, w2: jax.Array, x2: jax.Array,
-                   spec: KernelSpec) -> jax.Array:
+                   spec: KernelSpec, gamma=None) -> jax.Array:
     """Kernel rows from dot products, dispatched statically on the kind.
 
     dots: (r, n); w2: (r,) squared norms of the working rows (consumed
     by RBF only); x2: (n,). The RBF branch is byte-identical to
     ``rbf_rows_from_dots`` — reference parity is untouched.
+
+    ``gamma`` overrides ``spec.gamma`` with a traced value — a scalar,
+    or an (r, 1) per-row array (the batched gamma-grid sweep: the dots
+    are gamma-independent, so per-row gammas reuse one matmul). The
+    expressions are unchanged; an array gamma merely broadcasts.
     """
+    g = spec.gamma if gamma is None else gamma
     if spec.kind == "rbf":
-        return rbf_rows_from_dots(dots, w2, x2, spec.gamma)
+        return rbf_rows_from_dots(dots, w2, x2, g)
     if spec.kind == "linear":
         return dots
     if spec.kind == "poly":
-        return (spec.gamma * dots + spec.coef0) ** spec.degree
+        return (g * dots + spec.coef0) ** spec.degree
     if spec.kind == "sigmoid":
-        return jnp.tanh(spec.gamma * dots + spec.coef0)
+        return jnp.tanh(g * dots + spec.coef0)
     raise ValueError(f"unknown kernel kind {spec.kind!r}")
 
 

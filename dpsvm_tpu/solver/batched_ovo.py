@@ -135,7 +135,7 @@ def build_pair_targets(y: np.ndarray, classes: np.ndarray
     return yb, valid, pairs
 
 
-def _ovo_step(carry: OvoCarry, x, yb, x2, valid, c_arr,
+def _ovo_step(carry: OvoCarry, x, yb, x2, valid, c_arr, g_arr,
               *, kspec: KernelSpec, epsilon: float, max_iter: int,
               precision, pairwise_clip: bool) -> OvoCarry:
     """One batched step: every still-active subproblem advances one
@@ -144,7 +144,11 @@ def _ovo_step(carry: OvoCarry, x, yb, x2, valid, c_arr,
     ``c_arr`` is the (P,) per-subproblem box bound — identical values
     for OvO/CV batches, distinct ones for the C-grid sweep (the box is
     the ONLY place C enters the iteration, so one compiled program
-    serves any C assignment)."""
+    serves any C assignment). ``g_arr`` is the (P,) per-subproblem
+    kernel gamma, traded the same way: the row-fetch dots are
+    gamma-independent, so per-problem gammas share the one matmul and
+    only the elementwise epilogue differs — one program serves the
+    whole (C, gamma) grid."""
     alpha, f = carry.alpha, carry.f
     P = alpha.shape[0]
     rows_p = jnp.arange(P)
@@ -168,7 +172,8 @@ def _ovo_step(carry: OvoCarry, x, yb, x2, valid, c_arr,
     w_idx = jnp.concatenate([i_hi, i_lo])               # (2P,)
     rows = x[w_idx]                                     # (2P, d)
     dots = jnp.matmul(rows, x.T, precision=precision)   # (2P, n)
-    k_all = rows_from_dots(dots, x2[w_idx], x2, kspec)
+    g2 = jnp.concatenate([g_arr, g_arr])[:, None]       # (2P, 1)
+    k_all = rows_from_dots(dots, x2[w_idx], x2, kspec, gamma=g2)
     k_hi, k_lo = k_all[:P], k_all[P:]                   # (P, n) each
 
     gather = lambda m, i: jnp.take_along_axis(m, i[:, None], 1)[:, 0]
@@ -214,7 +219,7 @@ def _build_ovo_runner(kspec: KernelSpec, epsilon: float,
     argument so one program serves every C assignment."""
     precision = getattr(lax.Precision, precision_name)
 
-    def chunk(carry: OvoCarry, x, yb, x2, valid, c_arr, limit):
+    def chunk(carry: OvoCarry, x, yb, x2, valid, c_arr, g_arr, limit):
         def cond(s):
             any_active = jnp.any(
                 (s.b_lo > s.b_hi + 2.0 * epsilon)
@@ -223,7 +228,7 @@ def _build_ovo_runner(kspec: KernelSpec, epsilon: float,
 
         final = lax.while_loop(
             cond,
-            lambda s: _ovo_step(s, x, yb, x2, valid, c_arr,
+            lambda s: _ovo_step(s, x, yb, x2, valid, c_arr, g_arr,
                                 kspec=kspec,
                                 epsilon=epsilon, max_iter=max_iter,
                                 precision=precision,
@@ -243,7 +248,8 @@ def _build_ovo_runner(kspec: KernelSpec, epsilon: float,
 def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
                       config: SVMConfig,
                       device: Optional[jax.Device] = None,
-                      c_values: Optional[np.ndarray] = None
+                      c_values: Optional[np.ndarray] = None,
+                      gamma_values: Optional[np.ndarray] = None
                       ) -> List[TrainResult]:
     """Train the (P, n) OvO batch; one TrainResult per subproblem, each
     carrying the FULL-LENGTH (n,) alpha (zeros off the subproblem —
@@ -251,7 +257,10 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
 
     ``c_values`` (optional (P,)) gives each subproblem its own box
     bound — the C-grid sweep (train_c_sweep). Default: config.c
-    everywhere."""
+    everywhere. ``gamma_values`` (optional (P,)) likewise gives each
+    subproblem its own kernel gamma (the gamma axis of a grid);
+    default: the config's resolved gamma. Each TrainResult reports the
+    gamma its subproblem trained with."""
     config.validate()
     n, d = x.shape
     P = yb.shape[0]
@@ -282,12 +291,23 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
         if c_arr.shape != (P,):
             raise ValueError(f"c_values must have shape ({P},), got "
                              f"{c_arr.shape}")
-        if not np.all(c_arr > 0):
-            # (not np.any(<= 0): NaN passes that form and would train a
-            # silently-"converged" empty model with b=nan)
+        if not (np.all(np.isfinite(c_arr)) and np.all(c_arr > 0)):
+            # (isfinite matters: NaN/inf pass a bare > 0 / <= 0 test
+            # and train a silently-"converged" empty model with b=nan)
             raise ValueError("every C in c_values must be a finite "
                              "number > 0")
+    if gamma_values is None:
+        g_arr = np.full((P,), np.float32(gamma))
+    else:
+        g_arr = np.asarray(gamma_values, np.float32)
+        if g_arr.shape != (P,):
+            raise ValueError(f"gamma_values must have shape ({P},), "
+                             f"got {g_arr.shape}")
+        if not (np.all(np.isfinite(g_arr)) and np.all(g_arr > 0)):
+            raise ValueError("every gamma in gamma_values must be a "
+                             "finite number > 0")
     c_d = jax.device_put(jnp.asarray(c_arr), device)
+    g_d = jax.device_put(jnp.asarray(g_arr), device)
     runner = _build_ovo_runner(kspec,
                                float(config.epsilon),
                                int(config.max_iter), precision_name,
@@ -301,7 +321,8 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
     watchdog.pet()
 
     limit = min(chunk, budget)
-    carry, stats = runner(carry, xd, ybd, x2, vd, c_d, jnp.int32(limit))
+    carry, stats = runner(carry, xd, ybd, x2, vd, c_d, g_d,
+                          jnp.int32(limit))
     while True:
         # Speculative next chunk before the poll blocks (same dispatch
         # pipelining as driver.host_training_loop; a chunk dispatched
@@ -309,7 +330,8 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
         limit_next = min(limit + chunk, budget)
         if limit_next > limit:
             carry_next, stats_next = runner(carry, xd, ybd, x2, vd,
-                                            c_d, jnp.int32(limit_next))
+                                            c_d, g_d,
+                                            jnp.int32(limit_next))
         else:
             carry_next = stats_next = None
 
@@ -340,7 +362,7 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
             b_lo=float(b_lo[p]),
             b_hi=float(b_hi[p]),
             train_seconds=train_seconds,   # shared program: wall clock
-            gamma=gamma,                   # is per-batch, not per-pair
+            gamma=float(g_arr[p]),         # is per-batch, not per-pair
             n_sv=int(np.sum(alpha_all[p] > 0)),
             kernel=config.kernel,
             coef0=float(config.coef0),
@@ -349,12 +371,13 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
     return results
 
 
-def validate_c_grid(cs, config: SVMConfig) -> np.ndarray:
-    """Shared validation for the C-grid entry points (train_c_sweep,
-    models/cv.cross_validate_c_sweep): one copy of the cs and
-    precomputed-kernel rules so the two paths cannot drift. Returns the
-    f32 cs array actually trained with (callers keep their original
-    values for reporting — f32 rounding must not leak into results)."""
+def validate_c_grid(cs, config: SVMConfig, gammas=None):
+    """Shared validation for the grid-sweep entry points (train_c_sweep,
+    models/cv.cross_validate_c_sweep): ONE copy of the cs/gammas and
+    kernel rules so the paths cannot drift. Returns (cs, gammas) as the
+    f32 arrays actually trained with, gammas None when not swept
+    (callers keep their original values for reporting — f32 rounding
+    must not leak into results)."""
     if config.kernel == "precomputed":
         # The batched step computes kernel rows from X (matmul +
         # epilogue); the precomputed gather path is not wired into it.
@@ -365,30 +388,61 @@ def validate_c_grid(cs, config: SVMConfig) -> np.ndarray:
     if cs.ndim != 1 or len(cs) == 0:
         raise ValueError(f"cs must be a non-empty 1-D list of C values, "
                          f"got shape {cs.shape}")
-    return cs
+    if not (np.all(np.isfinite(cs)) and np.all(cs > 0)):
+        raise ValueError("every C must be a finite number > 0 "
+                         "(after float32 cast)")
+    if gammas is None:
+        return cs, None
+    if config.kernel == "linear":
+        # gamma does not enter the linear kernel at all; training
+        # len(gammas) bitwise-identical copies and reporting a
+        # "best_gamma" would fabricate a model-selection result
+        # (no-silent-ignore).
+        raise ValueError("the linear kernel has no gamma; drop the "
+                         "gamma axis of the sweep")
+    gammas = np.asarray(gammas, np.float32)
+    if gammas.ndim != 1 or len(gammas) == 0:
+        raise ValueError(f"gammas must be a non-empty 1-D list, got "
+                         f"shape {gammas.shape}")
+    if not (np.all(np.isfinite(gammas)) and np.all(gammas > 0)):
+        raise ValueError("every gamma must be a finite number > 0 "
+                         "(after float32 cast)")
+    return cs, gammas
 
 
 def train_c_sweep(x: np.ndarray, y: np.ndarray, cs,
                   config: SVMConfig,
-                  device: Optional[jax.Device] = None
-                  ) -> List[TrainResult]:
-    """Train the SAME binary problem at every C in ``cs`` — in ONE
-    compiled batched program (LIBSVM users run grid.py and pay one full
-    training per grid point; here the C column of the grid shares the
-    X stream and the per-step latency like any other subproblem batch,
-    since the box bound is the only place C enters the iteration).
+                  device: Optional[jax.Device] = None,
+                  gammas=None) -> List[TrainResult]:
+    """Train the SAME binary problem at every point of a C (x gamma)
+    grid — in ONE compiled batched program (LIBSVM users run grid.py
+    and pay one full training per grid point; here every grid point
+    shares the X stream and the per-step latency like any other
+    subproblem batch: the box bound is the only place C enters the
+    iteration, and gamma only enters the elementwise kernel epilogue
+    after the gamma-independent dot products).
 
-    ``y`` is +/-1; returns one full-problem TrainResult per C, in input
-    order. config.c is ignored in favor of ``cs``. Same solver scope as
-    every batched path (``batched_guard``)."""
+    ``y`` is +/-1. Without ``gammas``: one TrainResult per C in input
+    order (config's resolved gamma). With ``gammas``: the full product
+    grid in row-major (C, gamma) order — result index i*len(gammas)+j
+    is (cs[i], gammas[j]), and each TrainResult reports its own gamma.
+    config.c is ignored in favor of ``cs``. Same solver scope as every
+    batched path (``batched_guard``)."""
     batched_guard(config, "C-sweep")
-    cs = validate_c_grid(cs, config)
+    cs, gammas = validate_c_grid(cs, config, gammas)
     y = np.asarray(y, np.float32)
     bad = set(np.unique(y)) - {1.0, -1.0}
     if bad:
         raise ValueError(f"train_c_sweep takes +/-1 labels, got extra "
                          f"values {sorted(bad)}")
-    yb = np.tile(y, (len(cs), 1))
-    valid = np.ones((len(cs), len(y)), bool)
+    if gammas is None:
+        c_values, gamma_values = cs, None
+    else:
+        c_values = np.repeat(cs, len(gammas))
+        gamma_values = np.tile(gammas, len(cs))
+    P = len(c_values)
+    yb = np.tile(y, (P, 1))
+    valid = np.ones((P, len(y)), bool)
     return train_ovo_batched(x, yb, valid, config, device=device,
-                             c_values=cs)
+                             c_values=c_values,
+                             gamma_values=gamma_values)

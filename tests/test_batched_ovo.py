@@ -256,3 +256,67 @@ def test_cv_c_sweep_guards():
     yb = y[y != 7]
     with pytest.raises(ValueError, match="non-empty"):
         cross_validate_c_sweep(xb, yb, 3, [], _cfg())
+
+
+def test_full_grid_matches_individual_fits():
+    """C x gamma grid: every point equals an individual fit at that
+    (C, gamma) — gamma rides the epilogue, C the box, dots shared."""
+    import dataclasses
+
+    from dpsvm_tpu import api
+    rng = np.random.default_rng(51)
+    x = rng.normal(size=(180, 6)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    cs, gs = [0.5, 5.0], [0.05, 0.5]
+    cfg = _cfg()
+    grid = api.sweep_c(x, y, cs, cfg, gammas=gs)
+    assert len(grid) == 4
+    idx = 0
+    for c in cs:
+        for g in gs:
+            _, ri = api.fit(x, y, dataclasses.replace(cfg, c=c, gamma=g))
+            rb = grid[idx][1]
+            assert rb.gamma == pytest.approx(g)
+            assert rb.n_sv == ri.n_sv, (c, g)
+            np.testing.assert_allclose(np.asarray(rb.alpha),
+                                       np.asarray(ri.alpha), atol=5e-3)
+            idx += 1
+
+
+def test_cv_grid_sweep_shape_and_best():
+    from dpsvm_tpu.models.cv import cross_validate, cross_validate_c_sweep
+    import dataclasses
+    rng = np.random.default_rng(61)
+    x = rng.normal(size=(200, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.4 * rng.normal(size=200) > 0).astype(np.int32)
+    cfg = _cfg()
+    r = cross_validate_c_sweep(x, y, 4, [0.5, 5.0], cfg, seed=7,
+                               gammas=[0.05, 0.5])
+    assert r["accuracies"].shape == (2, 2)
+    assert r["best_c"] in [0.5, 5.0] and r["best_gamma"] in [0.05, 0.5]
+    i = r["cs"].index(r["best_c"])
+    j = r["gammas"].index(r["best_gamma"])
+    assert r["best_accuracy"] == r["accuracies"][i, j]
+    # each cell matches a per-config CV run
+    rc = cross_validate(x, y, 4, dataclasses.replace(cfg, c=5.0,
+                                                     gamma=0.5), seed=7)
+    assert abs(r["accuracies"][1, 1] - rc["accuracy"]) <= 0.02
+
+
+def test_grid_validation_rejections():
+    """inf/NaN grid values and the linear-kernel gamma axis fail
+    loudly (validate_c_grid, one copy of the rules)."""
+    from dpsvm_tpu import api
+    from dpsvm_tpu.solver.batched_ovo import train_c_sweep
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(40, 4)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    with pytest.raises(ValueError, match="finite"):
+        api.sweep_c(x, y, [float("inf")], _cfg())
+    with pytest.raises(ValueError, match="finite"):
+        api.sweep_c(x, y, [1.0], _cfg(), gammas=[float("inf")])
+    with pytest.raises(ValueError, match="finite"):
+        api.sweep_c(x, y, [1.0], _cfg(), gammas=[float("nan")])
+    with pytest.raises(ValueError, match="linear"):
+        train_c_sweep(x, y.astype(np.float32), [1.0],
+                      _cfg(kernel="linear"), gammas=[0.1, 1.0])
