@@ -34,6 +34,12 @@ Public API
 ``train_oneclass`` / ``predict_oneclass``  one-class SVM (LIBSVM -s 2)
 ``train_nusvc`` / ``train_nusvr``  nu-SVM family (LIBSVM -s 1 / -s 4)
 ``cross_validate``                 k-fold CV (LIBSVM -v)
+``sweep_c``                        whole (C, gamma) grid in one batched
+                                   program (grid.py analog)
+``cross_validate_c_sweep``         CV accuracy over the grid, folds x
+                                   points in one batch; reports best
+``train_multiclass``               one-vs-one multiclass (batched=True:
+                                   all pairs in one compiled program)
 ``warm_start``                     continue training from a previous alpha
 """
 
@@ -41,12 +47,13 @@ from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.models.svm import SVMModel, decision_function, predict, evaluate
 from dpsvm_tpu.models.io import save_model, load_model
 from dpsvm_tpu.models.estimator import DPSVMClassifier, DPSVMRegressor
-from dpsvm_tpu.api import train, fit, warm_start
+from dpsvm_tpu.api import train, fit, sweep_c, warm_start
 from dpsvm_tpu.models.svr import train_svr, predict_svr, evaluate_svr
 from dpsvm_tpu.models.oneclass import (train_oneclass, predict_oneclass,
                                        score_oneclass)
 from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
-from dpsvm_tpu.models.cv import cross_validate
+from dpsvm_tpu.models.cv import cross_validate, cross_validate_c_sweep
+from dpsvm_tpu.models.multiclass import train_multiclass
 
 __version__ = "0.1.0"
 
@@ -73,4 +80,7 @@ __all__ = [
     "train_nusvc",
     "train_nusvr",
     "cross_validate",
+    "cross_validate_c_sweep",
+    "sweep_c",
+    "train_multiclass",
 ]
