@@ -17,6 +17,39 @@ import os
 import sys
 
 
+def _memoized(label: str, key: str, make):
+    """Disk-memoized (x, y) generation under /tmp/dpsvm_standin.
+
+    Deterministic keys + a hash of the generator SOURCE, so retuning
+    data/synthetic.py (as happened between rounds) can never serve
+    stale pre-change data labeled as current. ``BENCH_NO_MEMO=1``
+    bypasses the cache."""
+    import numpy as np
+    memo = None
+    if os.environ.get("BENCH_NO_MEMO", "") != "1":
+        import hashlib
+
+        from dpsvm_tpu.data import synthetic as _syn
+        with open(_syn.__file__, "rb") as fh:
+            ver = hashlib.sha1(fh.read()).hexdigest()[:8]
+        memo = f"/tmp/dpsvm_standin/{key}_{ver}.npz"
+    if memo and os.path.exists(memo):
+        with np.load(memo) as z:
+            x, y = z["x"], z["y"]
+        print(f"data: synthetic {label} [memo]", file=sys.stderr,
+              flush=True)
+        return x, y
+    x, y = make()
+    if memo:
+        os.makedirs(os.path.dirname(memo), exist_ok=True)
+        # np.savez appends ".npz" unless the name already ends with it
+        tmp = memo + f".tmp{os.getpid()}.npz"
+        np.savez(tmp, x=x, y=y)
+        os.replace(tmp, memo)
+    print(f"data: synthetic {label}", file=sys.stderr, flush=True)
+    return x, y
+
+
 def standin(n: int, d: int, gamma: float, seed: int = 0):
     """(x, y) stand-in for an (n, d) benchmark trained at ``gamma``.
 
@@ -30,37 +63,27 @@ def standin(n: int, d: int, gamma: float, seed: int = 0):
     if gen not in ("planted", "mnist-like"):
         raise SystemExit(f"BENCH_GEN must be 'planted' or 'mnist-like', "
                          f"got {gen!r}")
-    import numpy as np
-    memo = None
-    if os.environ.get("BENCH_NO_MEMO", "") != "1":
-        # The key embeds a hash of the generator SOURCE so retuning
-        # make_planted (as happened between rounds) can never serve
-        # stale pre-change data labeled as current.
-        import hashlib
 
-        from dpsvm_tpu.data import synthetic as _syn
-        with open(_syn.__file__, "rb") as fh:
-            ver = hashlib.sha1(fh.read()).hexdigest()[:8]
-        memo = (f"/tmp/dpsvm_standin/{gen}_{n}x{d}"
-                f"_g{gamma:.6g}_s{seed}_{ver}.npz")
-    if memo and os.path.exists(memo):
-        with np.load(memo) as z:
-            x, y = z["x"], z["y"]
-        print(f"data: synthetic {gen} ({n}x{d}, gamma={gamma}) [memo]",
-              file=sys.stderr, flush=True)
-        return x, y
-    if gen == "planted":
-        from dpsvm_tpu.data.synthetic import make_planted
-        x, y = make_planted(n=n, d=d, gamma=gamma, seed=seed)
-    else:
+    def make():
+        if gen == "planted":
+            from dpsvm_tpu.data.synthetic import make_planted
+            return make_planted(n=n, d=d, gamma=gamma, seed=seed)
         from dpsvm_tpu.data.synthetic import make_mnist_like
-        x, y = make_mnist_like(n=n, d=d, seed=seed)
-    if memo:
-        os.makedirs(os.path.dirname(memo), exist_ok=True)
-        # np.savez appends ".npz" unless the name already ends with it
-        tmp = memo + f".tmp{os.getpid()}.npz"
-        np.savez(tmp, x=x, y=y)
-        os.replace(tmp, memo)
-    print(f"data: synthetic {gen} ({n}x{d}, gamma={gamma})",
-          file=sys.stderr, flush=True)
-    return x, y
+        return make_mnist_like(n=n, d=d, seed=seed)
+
+    return _memoized(f"{gen} ({n}x{d}, gamma={gamma})",
+                     f"{gen}_{n}x{d}_g{gamma:.6g}_s{seed}", make)
+
+
+def standin_multiclass(n: int, d: int, gamma: float, k: int,
+                       seed: int = 0):
+    """Memoized k-class planted stand-in (the OvO benchmark's data) —
+    same cache discipline as ``standin`` so a sweep window never pays
+    multiclass generation twice."""
+
+    def make():
+        from dpsvm_tpu.data.synthetic import make_planted_multiclass
+        return make_planted_multiclass(n, d, gamma, k=k, seed=seed)
+
+    return _memoized(f"planted {k}-class ({n}x{d}, gamma={gamma})",
+                     f"plantedk{k}_{n}x{d}_g{gamma:.6g}_s{seed}", make)

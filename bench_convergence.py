@@ -49,6 +49,44 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def convergence_run(x, y, config) -> dict:
+    """Train (x, y) under ``config`` and return the measurement dict
+    that main() prints as its JSON line. Importable so the one-process
+    window runner (benchmarks/burst_runner.py) produces rows with
+    exactly this schema without paying a subprocess per tag."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.models.svm import SVMModel, evaluate
+
+    t0 = time.perf_counter()
+    result = train(x, y, config)
+    seconds = time.perf_counter() - t0
+
+    model = SVMModel.from_train_result(x, y, result)
+    acc = evaluate(model, x, y)
+    log(f"{result.n_iter} iters in {seconds:.2f}s, converged="
+        f"{result.converged}, n_sv={result.n_sv}, train_acc={acc:.4f}")
+    log(f"split: loop {result.train_seconds:.2f}s (chunk runner, compile "
+        f"included) + setup {seconds - result.train_seconds:.2f}s "
+        f"(H2D transfer, host norms, alpha readback)")
+
+    return {
+        "metric": "mnist_scale_seconds_to_convergence",
+        "value": round(seconds, 2),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / seconds, 3)
+        if seconds > 0 else 0.0,
+        "n_iter": int(result.n_iter),
+        "n_sv": int(result.n_sv),
+        "converged": bool(result.converged),
+        "precision": config.matmul_precision,
+        "selection": config.selection,
+        "working_set": config.working_set,
+        "shrinking": config.shrinking,
+        "polish": config.polish,
+        "train_accuracy": round(float(acc), 6),
+    }
+
+
 def main() -> None:
     from dpsvm_tpu.utils.backend_guard import require_devices
 
@@ -58,11 +96,7 @@ def main() -> None:
     from dpsvm_tpu.utils.backend_guard import enable_compile_cache
     enable_compile_cache()
 
-    import numpy as np
-
-    from dpsvm_tpu.api import train
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.models.svm import SVMModel, evaluate
 
     precision = os.environ.get("BENCH_PRECISION", "DEFAULT").lower()
     selection = os.environ.get("BENCH_SELECTION", "first-order")
@@ -104,34 +138,7 @@ def main() -> None:
                        shrinking=shrinking, use_pallas=use_pallas,
                        polish=polish, verbose=verbose, chunk_iters=8192)
 
-    t0 = time.perf_counter()
-    result = train(x, y, config)
-    seconds = time.perf_counter() - t0
-
-    model = SVMModel.from_train_result(x, y, result)
-    acc = evaluate(model, x, y)
-    log(f"{result.n_iter} iters in {seconds:.2f}s, converged="
-        f"{result.converged}, n_sv={result.n_sv}, train_acc={acc:.4f}")
-    log(f"split: loop {result.train_seconds:.2f}s (chunk runner, compile "
-        f"included) + setup {seconds - result.train_seconds:.2f}s "
-        f"(H2D transfer, host norms, alpha readback)")
-
-    print(json.dumps({
-        "metric": "mnist_scale_seconds_to_convergence",
-        "value": round(seconds, 2),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_SECONDS / seconds, 3)
-        if seconds > 0 else 0.0,
-        "n_iter": int(result.n_iter),
-        "n_sv": int(result.n_sv),
-        "converged": bool(result.converged),
-        "precision": precision,
-        "selection": selection,
-        "working_set": working_set,
-        "shrinking": shrinking,
-        "polish": polish,
-        "train_accuracy": round(float(acc), 6),
-    }), flush=True)
+    print(json.dumps(convergence_run(x, y, config)), flush=True)
 
 
 if __name__ == "__main__":

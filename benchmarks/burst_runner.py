@@ -1,0 +1,341 @@
+"""One-process window runner: every decision-critical sweep tag, one
+backend init, shared data — so a short tunnel window lands MANY rows.
+
+Round 4's 13-minute window captured exactly 2 tags because each shell
+sweep tag pays its own probe (a fresh ``import jax; jax.devices()``
+over the tunnel, ~10-20 s), its own process start (jax import + backend
+init + ~3 s server-side program load), and its own data generation.
+This runner pays backend init ONCE, reuses (x, y) arrays across every
+tag that shares a shape (conv_base / conv_f32 / all mnist-shape
+decomposition arms train on the same 188 MB array), and runs tags in
+pre-registered decision-value order, so whatever slice of the backlog a
+window permits is always the most verdict-critical slice.
+
+Records land in the SAME results files as the shell sweeps
+(benchmarks/results/chip_sweep_r3.jsonl / _r4.jsonl) with the same
+schema and key order, so ``sweep_lib.sh``'s ``have()`` skip logic, the
+outage scrubber, and ``decide_defaults.py`` all see one ledger; rows
+written here carry ``"runner": "burst"`` for provenance. The shell
+sweeps remain the backstop: re-invoked after this runner, they skip
+every tag it recorded.
+
+Wall budgets: each conv tag trains with ``SVMConfig.wall_budget_s`` so
+an over-projection returns a partial row (rate evidence) instead of
+eating the window. A budget-stopped row (unconverged below its
+iteration cap) records rc=95 — a burned attempt that may retry once,
+never a fake measurement. Subprocess tags (standalone harnesses) get a
+plain ``timeout``.
+
+Stall accounting: a wedged device kills this process via the stall
+watchdog (exit 124) mid-tag, leaving no record for the in-flight tag.
+A sidecar pending-counter caps any single tag at 3 such kills before
+the runner skips it, so one deterministically-wedging config cannot
+block the backlog forever.
+
+Usage:  python benchmarks/burst_runner.py [--list] [tag ...]
+        (no args = full backlog in priority order; BENCH_STALL_TIMEOUT
+        should be set by the caller — sweep_retry.sh pins it)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import _pathfix  # noqa: F401,E402  (repo root onto sys.path)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+R3 = os.path.join(HERE, "results", "chip_sweep_r3.jsonl")
+R4 = os.path.join(HERE, "results", "chip_sweep_r4.jsonl")
+PENDING = os.environ.get(
+    "BURST_PENDING", os.path.join(HERE, "results", "burst_pending.json"))
+
+MNIST = dict(n=60_000, d=784, c=10.0, gamma=0.25)
+ADULT = dict(n=32_561, d=123, c=100.0, gamma=0.5)
+IJCNN1 = dict(n=49_990, d=22, c=32.0, gamma=2.0)
+
+
+def conv(tag, file, budget, *, n, d, c, gamma, precision="default",
+         max_iter=400_000, **cfg):
+    return dict(tag=tag, file=file, budget=budget, kind="conv",
+                n=n, d=d, c=c, gamma=gamma, precision=precision,
+                max_iter=max_iter, cfg=cfg)
+
+
+def sub(tag, file, budget, cmd, **env):
+    return dict(tag=tag, file=file, budget=budget, kind="sub",
+                cmd=cmd, env={k: str(v) for k, v in env.items()})
+
+
+# Priority = decision value (VERDICT r4 "next round" ordering): the
+# headline re-verification first, then the default-flip arms (rules
+# 1/2), the adult convergence row (rule 5), the terminal Pallas
+# decisions (rules 3/4), batched OvO/inference pricing, then the
+# remaining A/B arms and the long HBM-bound rate rows.
+TAGS = [
+    conv("conv_base", R4, 300, **MNIST),
+    conv("conv_f32", R4, 420, precision="highest", **MNIST),
+    conv("conv_decomp12288_cap256", R4, 300, working_set=12288,
+         inner_iters=256, **MNIST),
+    conv("conv_decomp12288_cap128", R4, 300, working_set=12288,
+         inner_iters=128, **MNIST),
+    conv("conv_adult_1m", R3, 300, max_iter=1_000_000, shrinking=True,
+         **ADULT),
+    conv("conv_decomp12288_cap256_shrink", R4, 300, working_set=12288,
+         inner_iters=256, shrinking=True, **MNIST),
+    sub("inference", R3, 240,
+        [sys.executable, "benchmarks/inference_bench.py"],
+        BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),
+    conv("conv_decomp2048", R3, 300, working_set=2048, **MNIST),
+    conv("conv_decomp2048_pal", R3, 300, working_set=2048,
+         use_pallas="on", **MNIST),
+    sub("pallas_cliff", R3, 420,
+        [sys.executable, "benchmarks/pallas_cliff.py"],
+        BENCH_N=120000, BENCH_D=784, BENCH_PRECISION="DEFAULT",
+        BENCH_ITERS=1500),
+    sub("ovo_mnist10", R4, 1500,
+        [sys.executable, "benchmarks/ovo_bench.py"],
+        BENCH_N=30000, BENCH_D=784, BENCH_K=10, BENCH_PRECISION="DEFAULT",
+        BENCH_MAX_ITER=200000),
+    conv("conv_wss2", R3, 420, selection="second-order", **MNIST),
+    conv("conv_ijcnn1_base", R3, 300, max_iter=600_000, **IJCNN1),
+    conv("conv_ijcnn1_wss2", R3, 300, max_iter=600_000,
+         selection="second-order", **IJCNN1),
+    conv("conv_polish", R3, 420, precision="highest", polish=True,
+         **MNIST),
+    conv("conv_adult_1m_f32", R3, 420, precision="highest",
+         max_iter=1_000_000, shrinking=True, **ADULT),
+    conv("conv_decomp4096_cap128", R3, 300, working_set=4096,
+         inner_iters=128, **MNIST),
+    conv("conv_decomp_shrink_cap128", R3, 300, working_set=4096,
+         inner_iters=128, shrinking=True, **MNIST),
+    conv("conv_decomp_shrink", R3, 300, working_set=4096, shrinking=True,
+         **MNIST),
+    sub("selection_ab_planted", R3, 420,
+        [sys.executable, "benchmarks/selection_ab.py"],
+        BENCH_N=60000, BENCH_D=784, BENCH_PRECISION="DEFAULT",
+        BENCH_MEASURE_ITERS=3000),
+    sub("cache_ab_planted", R3, 900,
+        [sys.executable, "benchmarks/cache_ab.py", "adult", "mnist"],
+        BENCH_PRECISION="HIGHEST", BENCH_MEASURE_ITERS=2000,
+        BENCH_WARM_ITERS=500, BENCH_CACHE_LINES="0,10"),
+    conv("conv_covtype_decomp_q2048", R3, 900, n=500_000, d=54,
+         c=2048.0, gamma=0.03125, working_set=2048, shrinking=True,
+         max_iter=3_000_000),
+    conv("conv_covtype_pair", R3, 300, n=500_000, d=54, c=2048.0,
+         gamma=0.03125, max_iter=280_000),
+    conv("conv_epsilon_decomp_q2048", R3, 900, n=400_000, d=2000,
+         c=1.0, gamma=5e-4, working_set=2048, max_iter=200_000),
+]
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def records(path):
+    out = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for raw in fh:
+                raw = raw.strip()
+                if raw:
+                    try:
+                        out.append(json.loads(raw))
+                    except json.JSONDecodeError:
+                        pass
+    return out
+
+
+def record(path, tag, rc, secs, stdout_lines, stderr_lines):
+    # Key order matches sweep_lib.sh exactly: its have() greps the
+    # literal string '"tag": "X", "rc": 0'.
+    line = json.dumps({"tag": tag, "rc": int(rc), "seconds": int(secs),
+                       "stdout": stdout_lines,
+                       "stderr_tail": stderr_lines[-15:],
+                       "runner": "burst"})
+    with open(path, "a") as fh:
+        fh.write(line + "\n")
+
+
+def load_pending():
+    try:
+        with open(PENDING) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def save_pending(p):
+    tmp = PENDING + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(p, fh)
+    os.replace(tmp, PENDING)
+
+
+class _Tee:
+    """Mirror writes to the real stderr while keeping a tail buffer."""
+
+    def __init__(self, real):
+        self.real = real
+        self.lines = []
+        self._buf = ""
+
+    def write(self, s):
+        self.real.write(s)
+        self._buf += s
+        *done, self._buf = self._buf.split("\n")
+        self.lines += done
+        return len(s)
+
+    def flush(self):
+        self.real.flush()
+
+    def tail(self):
+        return self.lines[-15:] + ([self._buf] if self._buf else [])
+
+
+_DATA = {}
+
+
+def standin_cached(n, d, gamma):
+    key = (n, d, gamma)
+    if key not in _DATA:
+        from bench_common import standin
+        from dpsvm_tpu.utils import watchdog
+        watchdog.pet()
+        _DATA[key] = standin(n=n, d=d, gamma=gamma, seed=0)
+        watchdog.pet()
+    return _DATA[key]
+
+
+def run_conv(spec):
+    """(rc, measurement-json-lines, stderr-tail) for an in-process
+    convergence tag."""
+    import contextlib
+
+    from bench_convergence import convergence_run
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = standin_cached(spec["n"], spec["d"], spec["gamma"])
+    kw = dict(c=spec["c"], gamma=spec["gamma"], epsilon=1e-3,
+              max_iter=spec["max_iter"],
+              matmul_precision=spec["precision"],
+              chunk_iters=8192, verbose=True,
+              wall_budget_s=float(spec["budget"]))
+    kw.update(spec["cfg"])          # spec cfg wins, incl. overrides
+    config = SVMConfig(**kw)
+    tee = _Tee(sys.stderr)
+    with contextlib.redirect_stderr(tee):
+        m = convergence_run(x, y, config)
+    # Budget-stopped (unconverged, below the iteration cap) = burned
+    # attempt with rate evidence, NOT a completed measurement.
+    rc = 0 if (m["converged"] or m["n_iter"] >= spec["max_iter"]) else 95
+    return rc, [json.dumps(m)], tee.tail()
+
+
+def run_sub(spec):
+    env = dict(os.environ)
+    # Pin the ambient knobs exactly like sweep_lib.sh's run() so a
+    # leftover export can never relabel a recorded measurement.
+    env.update({"BENCH_GEN": "planted", "BENCH_DATA": "",
+                "BENCH_SELECTION": "first-order", "BENCH_EPS": "1e-3",
+                "BENCH_WORKING_SET": "2", "BENCH_INNER_ITERS": "0",
+                "BENCH_SHRINKING": "", "BENCH_PALLAS": "auto",
+                "BENCH_MAX_ITER": "400000", "BENCH_POLISH": "",
+                "BENCH_NO_MEMO": "", "BENCH_VERBOSE": "1",
+                "BENCH_PLATFORM": ""})
+    env.update(spec["env"])
+    env.setdefault("BENCH_STALL_TIMEOUT",
+                   os.environ.get("BENCH_STALL_TIMEOUT", "420"))
+    try:
+        p = subprocess.run(spec["cmd"], cwd=ROOT, env=env,
+                           capture_output=True, text=True,
+                           timeout=spec["budget"])
+        rc, out, err = p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+    return rc, out.strip().splitlines(), err.strip().splitlines()
+
+
+def main(argv) -> int:
+    global TAGS
+    tags_src = os.environ.get("BURST_TAGS_JSON")
+    if tags_src:
+        # Hand-driven / test tag lists: same spec dicts, from a file.
+        with open(tags_src) as fh:
+            TAGS = json.load(fh)
+    if "--list" in argv:
+        for t in TAGS:
+            print(t["tag"])
+        return 0
+    want = [a for a in argv if not a.startswith("-")]
+    tags = [t for t in TAGS if not want or t["tag"] in want]
+    unknown = set(want) - {t["tag"] for t in tags}
+    if unknown:
+        log(f"unknown tags: {sorted(unknown)}")
+        return 2
+
+    # Pin the ambient knobs the IN-PROCESS conv tags read (run_sub pins
+    # its own subprocess env): a leftover `export BENCH_GEN=mnist-like`
+    # must not silently relabel recorded measurements.
+    os.environ["BENCH_GEN"] = "planted"
+    os.environ["BENCH_NO_MEMO"] = ""
+
+    from dpsvm_tpu.utils import watchdog
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+    dev = require_devices()[0]
+    log(f"burst runner: device {dev} ({dev.platform}), {len(tags)} tags")
+    enable_compile_cache()
+
+    for spec in tags:
+        tag, path = spec["tag"], spec["file"]
+        recs = [r for r in records(path) if r.get("tag") == tag]
+        if any(r.get("rc") == 0 for r in recs):
+            log(f"SKIP {tag} (already recorded)")
+            continue
+        if len(recs) >= 2:
+            log(f"SKIP {tag} (2 failed attempts recorded)")
+            continue
+        pend = load_pending()
+        if pend.get(tag, 0) >= 3:
+            log(f"SKIP {tag} (3 mid-run kills recorded — wedging config?"
+                f" clear {PENDING} to retry)")
+            continue
+        pend[tag] = pend.get(tag, 0) + 1
+        save_pending(pend)
+
+        log(f"RUN  {tag} (budget {spec['budget']}s)")
+        watchdog.pet()
+        t0 = time.monotonic()
+        try:
+            if spec["kind"] == "conv":
+                rc, out_lines, err_lines = run_conv(spec)
+            else:
+                rc, out_lines, err_lines = run_sub(spec)
+        except Exception:
+            import traceback
+            rc = 1
+            out_lines = []
+            err_lines = traceback.format_exc().strip().splitlines()
+        secs = time.monotonic() - t0
+        record(path, tag, rc, secs, out_lines, err_lines)
+        pend = load_pending()
+        pend[tag] = 0
+        save_pending(pend)
+        log(f"{'OK  ' if rc == 0 else 'FAIL'} {tag} rc={rc} {secs:.0f}s")
+    log("burst complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -53,12 +53,12 @@ def main() -> None:
     dev = require_devices()[0]
     log(f"device: {dev}")
 
+    from bench_common import standin_multiclass
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.data.synthetic import make_planted_multiclass
     from dpsvm_tpu.models.multiclass import train_multiclass
 
     t0 = time.perf_counter()
-    x, y = make_planted_multiclass(n, d, gamma, k=k, seed=0)
+    x, y = standin_multiclass(n, d, gamma, k=k, seed=0)
     log(f"data: planted multiclass {n}x{d}, k={k} "
         f"({time.perf_counter() - t0:.1f}s)")
 

@@ -78,23 +78,31 @@ while true; do
     echo "$(date -u +%FT%TZ) UP" >> "$WATCH"
     scrub_outage_timeouts "$RESULTS"
     scrub_outage_timeouts "$RESULTS_R4"
-    # The short r4 sweep first: it carries the headline-re-verification
-    # rows (conv_base/conv_f32), worth capturing even in a window too
-    # brief for the r3 backlog.
+    # The one-process burst runner first: one backend init, shared
+    # data arrays, pre-registered decision-value order — a short
+    # window lands many rows instead of round 4's two. It writes into
+    # the same results files, so the shell sweeps below skip whatever
+    # it recorded and act as the backstop for anything it missed.
+    BENCH_STALL_TIMEOUT=420 python benchmarks/burst_runner.py
+    rcb=$?
     bash benchmarks/chip_sweep_r4.sh "$RESULTS_R4"
     rc4=$?
     bash benchmarks/chip_sweep.sh "$RESULTS"
     rc=$?
-    echo "$(date -u +%FT%TZ) sweeps exited rc4=$rc4 rc=$rc" >> "$WATCH"
-    if [ "$rc4" -eq 0 ] && [ "$rc" -eq 0 ]; then
+    echo "$(date -u +%FT%TZ) sweeps exited rcb=$rcb rc4=$rc4 rc=$rc" \
+      >> "$WATCH"
+    if [ "$rcb" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc" -eq 0 ]; then
       # rc=0 means every tag was attempted, not that every tag was
       # measured: a watchdog-STALLed tag records rc=124 and the sweep
       # moves on. Only stop when a post-pass scrub RAN CLEANLY and
       # found nothing to re-run — a crashed scrub (non-zero rc) must
-      # loop, not masquerade as completion.
-      scrub_out=$(scrub_outage_timeouts "$RESULTS";
-                  scrub_outage_timeouts "$RESULTS_R4")
-      scrub_rc=$?
+      # loop, not masquerade as completion. Run the two scrubs
+      # separately and OR the exit codes: a crashed FIRST scrub with
+      # empty combined output must loop too (ADVICE r4).
+      scrub_out1=$(scrub_outage_timeouts "$RESULTS"); rc1=$?
+      scrub_out2=$(scrub_outage_timeouts "$RESULTS_R4"); rc2=$?
+      scrub_rc=$((rc1 | rc2))
+      scrub_out="${scrub_out1}${scrub_out2}"
       if [ "$scrub_rc" -eq 0 ] && [ -z "$scrub_out" ]; then
         echo "$(date -u +%FT%TZ) SWEEP COMPLETE" >> "$WATCH"
         break
@@ -102,8 +110,12 @@ while true; do
       echo "$(date -u +%FT%TZ) rc=0, scrub rc=$scrub_rc out='$scrub_out';" \
         "looping" >> "$WATCH"
     fi
+    sleep 280
   else
+    # A down probe already burned its 120 s timeout; a short sleep
+    # keeps the detection period ~3.5 min so less of a flap window is
+    # lost before the sweep fires (round 4's windows were ~13 min).
     echo "$(date -u +%FT%TZ) DOWN" >> "$WATCH"
+    sleep 90
   fi
-  sleep 280
 done

@@ -142,6 +142,16 @@ class SVMConfig:
                                         # bf16 wall-clock.
     verbose: bool = False
     log_every: int = 0                  # 0 = no per-chunk logging
+    wall_budget_s: float = 0.0          # stop dispatching chunks once this
+                                        # much wall-clock has elapsed in the
+                                        # training loop (0 = no budget). The
+                                        # run returns the usual TrainResult,
+                                        # converged=False if the gap was
+                                        # still open — a time-budgeted train
+                                        # for measurement windows and
+                                        # best-effort-within-deadline use;
+                                        # enforced at chunk-poll granularity
+                                        # (~chunk_iters iterations)
 
     # --- persistence / observability (reference has none — SURVEY §5) ---
     checkpoint_path: Optional[str] = None   # .npz solver-state file
@@ -234,6 +244,9 @@ class SVMConfig:
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
         if self.checkpoint_every and not self.checkpoint_path:
             raise ValueError("checkpoint_every set without checkpoint_path")
+        if self.wall_budget_s < 0:
+            raise ValueError(
+                f"wall_budget_s must be >= 0, got {self.wall_budget_s}")
         if self.weight_pos <= 0 or self.weight_neg <= 0:
             raise ValueError("class weights must be > 0, got "
                              f"({self.weight_pos}, {self.weight_neg})")
@@ -437,7 +450,8 @@ class SVMConfig:
                 ("checkpoint_path", self.checkpoint_path),
                 ("checkpoint_every", self.checkpoint_every),
                 ("resume_from", self.resume_from),
-                ("profile_dir", self.profile_dir)) if v]
+                ("profile_dir", self.profile_dir),
+                ("wall_budget_s", self.wall_budget_s)) if v]
             if unsupported:
                 raise ValueError(
                     f"the numpy backend does not support: {unsupported}")

@@ -72,18 +72,29 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
         raise ValueError("checkpoint/resume are single-run options; they "
                          "cannot be shared across CV folds")
 
-    if batched:
-        from dpsvm_tpu.solver.batched_ovo import batched_guard
-        if task == "svr":
-            raise ValueError(
-                "batched CV is classification-only: SVR folds train on "
-                "2m pseudo-examples built per fold (models/svr.py), so "
-                "they do not share one X the way classification folds "
-                "do; run --cv without batching for SVR")
-        batched_guard(config, "CV")
+    if batched and task == "svr":
+        raise ValueError(
+            "batched CV is classification-only: SVR folds train on "
+            "2m pseudo-examples built per fold (models/svr.py), so "
+            "they do not share one X the way classification folds "
+            "do; run --cv without batching for SVR")
 
     fold = kfold_assignment(y, k, seed, stratify=task == "svc")
     if batched:
+        from dpsvm_tpu.solver.batched_ovo import (batched_guard,
+                                                  ovo_pair_shapes)
+        # Sentinel resolution is per subproblem on the sequential path:
+        # per-fold for binary, per fold x pair for multiclass.
+        shapes = []
+        d = x.shape[1]
+        for f in range(k):
+            ytr = y[fold != f]
+            cls = np.unique(ytr)
+            if len(cls) > 2:
+                shapes += ovo_pair_shapes(ytr, cls, d)
+            else:
+                shapes.append((len(ytr), d))
+        batched_guard(config, "CV", shapes)
         pred = _cross_validate_batched(x, y, k, fold, config)
         return {"predictions": pred, "folds": fold, "k": k,
                 "accuracy": float(np.mean(pred == y))}
@@ -248,6 +259,9 @@ def cross_validate_c_sweep(x: np.ndarray, y: np.ndarray, k: int, cs,
             raise ValueError(
                 f"CV fold {f}: training split has a single class — a "
                 f"class has fewer than {k} members; reduce k")
+    batched_guard(config, "CV C-sweep",
+                  [(int(np.sum(fold != f)), x.shape[1])
+                   for f in range(k)])
     ypm = np.where(y == classes[-1], 1, -1).astype(np.float32)
     n = len(y)
     # The per-fold grid column: (C, gamma) pairs in row-major order

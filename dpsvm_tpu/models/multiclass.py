@@ -79,8 +79,10 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     if len(classes) < 2:
         raise ValueError(f"need at least 2 classes, got {classes}")
     if batched:
-        from dpsvm_tpu.solver.batched_ovo import batched_guard
-        batched_guard(config, "OvO")
+        from dpsvm_tpu.solver.batched_ovo import (batched_guard,
+                                                  ovo_pair_shapes)
+        batched_guard(config, "OvO",
+                      ovo_pair_shapes(y, classes, x.shape[1]))
     pairs, models, results = [], [], []
     platt: Optional[List[Tuple[float, float]]] = [] if probability else None
     if batched:

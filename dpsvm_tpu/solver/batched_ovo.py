@@ -81,10 +81,35 @@ def compact_submodel(x: np.ndarray, sel: np.ndarray, ys: np.ndarray,
                                       rr), rr
 
 
-def batched_guard(config: SVMConfig, what: str) -> None:
+def ovo_pair_shapes(y, classes, d):
+    """(n_a + n_b, d) for every OvO pair of ``classes`` in ``y`` — the
+    subproblem shapes the sequential path resolves auto sentinels at.
+    ONE implementation shared by the OvO and CV entry points so their
+    ``batched_guard`` shape lists cannot drift."""
+    y = np.asarray(y)
+    counts = {cl: int(np.sum(y == cl)) for cl in classes}
+    return [(counts[classes[a]] + counts[classes[b]], d)
+            for a in range(len(classes))
+            for b in range(a + 1, len(classes))]
+
+
+def batched_guard(config: SVMConfig, what: str,
+                  subproblem_shapes=None) -> None:
     """Reject configs the batched program would silently ignore or
     change the math of (the no-silent-ignore policy of config.validate's
-    guard tables). Shared by the OvO and CV batched entry points."""
+    guard tables). Shared by the OvO and CV batched entry points.
+
+    ``subproblem_shapes``: iterable of (n, d) the sequential equivalent
+    would train — per-pair sizes for OvO, per-fold sizes for CV. When
+    the config carries auto sentinels (working_set=0 / shrinking=
+    "auto"), the sequential path resolves them PER SUBPROBLEM via
+    ``config.resolved``; the batched program only implements the
+    classic first-order path, so any subproblem whose resolution picks
+    a different solver path must be rejected here, not silently trained
+    differently. (Today ``_auto_solver_plan`` resolves to classic at
+    every shape, making this a no-op — but the policy slots are
+    designed to flip on measured chip rows, and batched=True must not
+    drift from the sequential default when they do.)"""
     blockers = [name for name, bad in (
         ("selection", config.selection != "first-order"),
         ("weights", config.weight_pos != 1.0 or config.weight_neg != 1.0),
@@ -101,6 +126,20 @@ def batched_guard(config: SVMConfig, what: str) -> None:
             f"batched {what} runs the plain first-order single-device "
             f"path; incompatible options set: {blockers} (train "
             "with batched=False for these)")
+    if (config.shrinking == "auto" or config.working_set == 0) \
+            and subproblem_shapes is not None:
+        for n_i, d_i in subproblem_shapes:
+            r = config.resolved(int(n_i), int(d_i))
+            if r.working_set != 2 or r.shrinking:
+                raise ValueError(
+                    f"batched {what}: the auto solver plan resolves to "
+                    f"a non-classic path (working_set={r.working_set}, "
+                    f"shrinking={r.shrinking}) for a {n_i}x{d_i} "
+                    "subproblem; the batched program only implements "
+                    "the classic first-order path — train with "
+                    "batched=False, or set working_set=2 / "
+                    "shrinking=False explicitly to accept the classic "
+                    "path for every subproblem")
 
 
 class OvoCarry(NamedTuple):
@@ -344,6 +383,20 @@ def train_ovo_batched(x: np.ndarray, yb: np.ndarray, valid: np.ndarray,
         capped = n_iter >= budget
         if np.all(done | capped) or stats_next is None:
             break
+        if (config.wall_budget_s
+                and time.perf_counter() - t0 > config.wall_budget_s):
+            # Time budget exhausted. The speculative chunk is already in
+            # flight and is NOT a no-op mid-training, so poll it: the
+            # reported (n_iter, b) must describe the carry actually
+            # returned below.
+            s = np.asarray(stats_next)
+            watchdog.pet()
+            n_iter = s[0]
+            b_lo = s[1].view(np.float32)
+            b_hi = s[2].view(np.float32)
+            done = ~(b_lo > b_hi + 2.0 * eps)
+            carry, stats_next = carry_next, None
+            break
         carry, stats, limit = carry_next, stats_next, limit_next
 
     train_seconds = time.perf_counter() - t0
@@ -428,7 +481,9 @@ def train_c_sweep(x: np.ndarray, y: np.ndarray, cs,
     is (cs[i], gammas[j]), and each TrainResult reports its own gamma.
     config.c is ignored in favor of ``cs``. Same solver scope as every
     batched path (``batched_guard``)."""
-    batched_guard(config, "C-sweep")
+    x = np.asarray(x)
+    batched_guard(config, "C-sweep",
+                  [(x.shape[0], x.shape[1])])
     cs, gammas = validate_c_grid(cs, config, gammas)
     y = np.asarray(y, np.float32)
     bad = set(np.unique(y)) - {1.0, -1.0}

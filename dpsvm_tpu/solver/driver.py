@@ -130,6 +130,16 @@ def host_training_loop(
             n_iter, b_lo, b_hi = _read_stats(stats)
             converged = not (b_lo > b_hi + 2.0 * eps)
             done = converged or n_iter >= config.max_iter
+            if (not done and config.wall_budget_s
+                    and time.perf_counter() - t0 > config.wall_budget_s):
+                # Time budget exhausted: stop dispatching. In pipelined
+                # mode a speculative chunk is already in flight; read its
+                # stats so the returned (n_iter, alpha) describe the same
+                # state — the extra chunk is counted, not silently run.
+                if pipeline:
+                    n_iter, b_lo, b_hi = _read_stats(next_stats)
+                    converged = not (b_lo > b_hi + 2.0 * eps)
+                done = True
 
             log_progress(config, n_iter, b_lo, b_hi, final=done,
                          prev_iter=prev_polled)

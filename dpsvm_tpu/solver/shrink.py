@@ -365,6 +365,12 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         it, b_lo, b_hi = _read_stats(stats)
         sub_converged = not (b_lo > b_hi + 2.0 * eps)
         capped = it >= config.max_iter
+        if (not capped and config.wall_budget_s
+                and time.perf_counter() - t0 > config.wall_budget_s):
+            # Time budget exhausted: same exit path as the iteration cap
+            # (scatter back, unshrink-reconstruct if compacted, report
+            # the honest full-problem convergence state).
+            capped = True
         if not capped:   # the final=True line after the loop reports
             log_progress(config, it, b_lo, b_hi, final=False,
                          prev_iter=prev_polled)

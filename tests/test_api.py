@@ -261,6 +261,31 @@ class TestAutoSolverSentinels:
         assert m_auto.n_sv == m_expl.n_sv
 
 
+def test_wall_budget_stops_early_and_reports_unconverged(blobs_small):
+    x, y = blobs_small
+    # A budget the first chunk poll already exceeds: the run must stop at
+    # chunk granularity (<= 2 chunks in pipelined mode — the speculative
+    # chunk is counted, not silently run) and report converged=False on a
+    # problem whose trajectory is longer than that.
+    cfg = dt.SVMConfig(c=1.0, gamma=0.5, epsilon=1e-6, max_iter=500_000,
+                       chunk_iters=8, wall_budget_s=1e-9)
+    res = dt.train(x, y, cfg)
+    assert res.n_iter <= 16
+    assert not res.converged
+    # No budget => same config runs past that point.
+    full = dt.train(x, y, dt.SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3,
+                                       max_iter=500_000, chunk_iters=8))
+    assert full.n_iter > res.n_iter
+
+
+def test_wall_budget_validation():
+    with pytest.raises(ValueError, match="wall_budget_s"):
+        dt.SVMConfig(wall_budget_s=-1.0).validate()
+    # no-silent-ignore: the numpy oracle has no budget support
+    with pytest.raises(ValueError, match="wall_budget_s"):
+        dt.SVMConfig(backend="numpy", wall_budget_s=1.0).validate()
+
+
 def test_shrinking_rejects_truthy_nonbool():
     """Review r4: 1 == True and np.True_ == True would pass an
     equality membership check yet skip every 'is True' guard while

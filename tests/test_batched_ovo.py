@@ -97,6 +97,33 @@ def test_batched_guard_table():
             train_multiclass(x, y, _cfg(**bad), batched=True)
 
 
+def test_batched_guard_rejects_sentinels_resolving_nonclassic(monkeypatch):
+    """If _auto_solver_plan ever flips a shape class to shrinking or
+    decomposition, batched=True with auto sentinels must REFUSE rather
+    than silently train a different solver path than the sequential
+    default (ADVICE r4). Simulated by patching the plan table."""
+    import dpsvm_tpu.config as cfgmod
+    x, y = make_three_class(n_per=30, d=4, seed=1)
+
+    def flipped(n, d, config):
+        plan = {}
+        if config.shrinking == "auto":
+            plan["shrinking"] = True
+        if config.working_set == 0:
+            plan["working_set"] = 64
+        return plan
+
+    monkeypatch.setattr(cfgmod, "_auto_solver_plan", flipped)
+    with pytest.raises(ValueError, match="non-classic"):
+        train_multiclass(x, y, _cfg(shrinking="auto"), batched=True)
+    with pytest.raises(ValueError, match="non-classic"):
+        train_multiclass(x, y, _cfg(working_set=0), batched=True)
+    # Sentinels still fine while the plan resolves classic.
+    monkeypatch.undo()
+    train_multiclass(x, y, _cfg(shrinking="auto", working_set=0),
+                     batched=True)
+
+
 def test_batched_cv_binary_matches_sequential():
     """Batched CV (K fold subproblems in one program) reproduces the
     sequential CV protocol: same fold assignment, near-identical pooled

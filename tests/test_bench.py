@@ -55,6 +55,55 @@ def test_bench_convergence_contract():
     assert rec["train_accuracy"] > 0.9
 
 
+def test_burst_runner_records_and_skips(tmp_path):
+    """The one-process window runner: records land in the tag's own
+    results file with sweep_lib's schema/key order (its grep-based
+    skip logic must see them), budget-stopped runs burn an attempt
+    (rc=95) instead of recording a fake measurement, and a re-run
+    skips completed tags."""
+    res = tmp_path / "sweep.jsonl"
+    tags = [
+        {"tag": "t_conv", "file": str(res), "budget": 120,
+         "kind": "conv", "n": 600, "d": 24, "c": 1.0, "gamma": 0.5,
+         "precision": "highest", "max_iter": 20000, "cfg": {}},
+        {"tag": "t_budget", "file": str(res), "budget": 1e-9,
+         "kind": "conv", "n": 600, "d": 24, "c": 1.0, "gamma": 0.5,
+         "precision": "highest", "max_iter": 20000,
+         "cfg": {"chunk_iters": 8, "epsilon": 1e-7}},
+    ]
+    spec = tmp_path / "tags.json"
+    spec.write_text(json.dumps(tags))
+    env = {"BURST_TAGS_JSON": str(spec), "BENCH_PLATFORM": "cpu",
+           "BENCH_GEN": "planted",
+           "BURST_PENDING": str(tmp_path / "pending.json")}
+    r = _run("benchmarks/burst_runner.py", env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(l) for l in res.read_text().splitlines()]
+    by_tag = {rec["tag"]: rec for rec in recs}
+    assert by_tag["t_conv"]["rc"] == 0
+    m = json.loads(by_tag["t_conv"]["stdout"][-1])
+    assert m["converged"] is True and m["n_sv"] > 0
+    # sweep_lib.sh's have() greps this exact literal:
+    assert '"tag": "t_conv", "rc": 0' in res.read_text()
+    # wall-budget stop: attempt burned, rate evidence kept
+    assert by_tag["t_budget"]["rc"] == 95
+    mb = json.loads(by_tag["t_budget"]["stdout"][-1])
+    assert mb["converged"] is False and mb["n_iter"] < 20000
+    # second invocation: t_conv skipped (rc=0 present), t_budget
+    # retried once more (1 failed attempt < 2)
+    r2 = _run("benchmarks/burst_runner.py", env)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "SKIP t_conv" in r2.stderr
+    recs2 = [json.loads(l) for l in res.read_text().splitlines()]
+    assert len([x for x in recs2 if x["tag"] == "t_conv"]) == 1
+    assert len([x for x in recs2 if x["tag"] == "t_budget"]) == 2
+    # third: t_budget now has 2 failed attempts -> skipped
+    r3 = _run("benchmarks/burst_runner.py", env)
+    assert "SKIP t_budget" in r3.stderr
+    assert len([json.loads(l) for l in res.read_text().splitlines()
+                if '"t_budget"' in l]) == 2
+
+
 def test_backend_guard_times_out_cleanly(tmp_path):
     """A backend that never comes up must yield rc=1 + one clear error
     line, not a hang. Simulated by pointing JAX at a plugin that blocks:
