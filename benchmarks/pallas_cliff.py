@@ -76,8 +76,9 @@ def main() -> None:
 
     import numpy as np
 
-    from dpsvm_tpu.ops.fused_step import DEFAULT_BLOCK_N, pad_to_block
-    from dpsvm_tpu.solver.fused import _run_chunk, init_fused_carry
+    from dpsvm_tpu.experimental.fused_step import (DEFAULT_BLOCK_N,
+                                                   pad_to_block)
+    from dpsvm_tpu.experimental.fused import _run_chunk, init_fused_carry
 
     n_pad = pad_to_block(n, DEFAULT_BLOCK_N)
     xp = np.zeros((n_pad, d), np.float32)

@@ -131,7 +131,8 @@ def train(x: np.ndarray, y: np.ndarray,
         from dpsvm_tpu.solver.decomp import train_single_device_decomp
         return train_single_device_decomp(x, y, config, f_init=f_init,
                                           alpha_init=alpha_init)
-    from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
+    from dpsvm_tpu.experimental.fused import (train_single_device_fused,
+                                               use_fused)
     if f_init is None and alpha_init is None and use_fused(config):
         # the fused kernel hard-codes the classification init
         return train_single_device_fused(x, y, config)

@@ -152,7 +152,7 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["auto", "on", "off"],
                     help="fused Pallas iteration kernel: 'on' forces it; "
                          "'auto' currently prefers the XLA path (faster "
-                         "on measured hardware, see solver/fused.py)")
+                         "on measured hardware, see experimental/fused.py)")
     tr.add_argument("-v", "--cv", type=int, default=0, metavar="K",
                     help="k-fold cross-validation mode (LIBSVM -v): "
                          "report pooled held-out accuracy (or MSE for "

@@ -163,7 +163,7 @@ class SVMConfig:
     def fused_incompatibility(self) -> Optional[str]:
         """Why the fused Pallas kernel cannot run this config (None if it
         can). Single source of truth for validate() and the dispatch
-        policy in solver/fused.use_fused."""
+        policy in experimental/fused.use_fused."""
         if self.backend != "xla":
             return f"backend {self.backend!r}"
         if self.shards > 1:
@@ -373,7 +373,7 @@ class SVMConfig:
             # Reject every path that would silently ignore q, so results
             # can't be misattributed (same policy as select_impl).
             # (use_pallas='on' IS meaningful here: it selects the
-            # Pallas inner-subsolve kernel, ops/subsolve_kernel.py.)
+            # Pallas inner-subsolve kernel, experimental/subsolve_kernel.py.)
             for field, bad, what in (
                     ("selection", self.selection != "first-order",
                      "the decomposition subsolve is WSS2 internally"),

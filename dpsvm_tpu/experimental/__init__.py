@@ -1,0 +1,34 @@
+"""Hand-written Pallas kernels — demoted to experimental, opt-in only.
+
+Status (terminal decision, round 5, pre-registered in docs/ROUND4.md
+rules 3/4 and executed per the no-window default): on every chip
+measurement to date the hand-fused kernels LOSE to the plain XLA
+lowering of the same math —
+
+* fused 2-violator iteration (``fused_step.py`` + ``fused.py``,
+  replacing the reference's 5-kernel-launch iteration,
+  ``svmTrain.cu:469-497``): at the 60000x784 benchmark shape XLA keeps
+  the bf16-cast X VMEM-resident across ``lax.while_loop`` iterations
+  (~64 us/iter) while a ``pallas_call`` re-stages X from HBM every
+  invocation (~200 us/iter). Measured round 2, `docs/PERF.md`
+  ("Per-phase cost" and the selection A/B sections).
+* inner-subsolve kernel (``subsolve_kernel.py``): same math as
+  ``solver/decomp.inner_subsolve``'s XLA while_loop; never earned a
+  chip win (its A/B arm `conv_decomp2048_pal` remains queued in the
+  sweep backlog).
+
+Both remain fully functional and tested (``tests/test_fused.py``,
+``tests/test_subsolve_kernel.py``) and reachable via
+``SVMConfig(use_pallas="on")`` — ``"auto"`` NEVER selects them.
+Promotion back out of experimental requires the pre-registered bar:
+``pallas_cliff`` beating XLA past the VMEM cliff by >10% (rule 3), or
+``conv_decomp2048_pal`` beating its XLA arm by >5% (rule 4); the sweep
+arms that decide this stay armed in ``benchmarks/burst_runner.py``.
+
+Why keep them at all: they are the only in-tree demonstrations of
+block-pipelined Pallas patterns over this solver's data layout
+(manual HBM->VMEM staging, in-kernel while_loops, masked block
+reductions), and the cliff regime (n past VMEM capacity, where both
+paths must stream from HBM) is measured-undecided — the one place the
+fused design could still win.
+"""

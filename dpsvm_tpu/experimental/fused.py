@@ -2,7 +2,7 @@
 
 Same algorithm and driver contract as solver/smo.py, but each iteration's
 O(n) work — kernel rows, f update, next working-set selection — is one
-Pallas pass over X (ops/fused_step.py) instead of several XLA ops. The
+Pallas pass over X (experimental/fused_step.py) instead of several XLA ops. The
 whole loop still lives in one ``lax.while_loop`` under ``jit``; only the
 state layout differs (vectors are (1, n_pad) so the kernel can slice them
 on the 128-lane axis, and the working set rides in the carry across the
@@ -24,7 +24,7 @@ import numpy as np
 from jax import lax
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
-from dpsvm_tpu.ops.fused_step import (DEFAULT_BLOCK_N, FusedCarry,
+from dpsvm_tpu.experimental.fused_step import (DEFAULT_BLOCK_N, FusedCarry,
                                       fused_smo_body, pad_to_block)
 from dpsvm_tpu.ops.kernels import row_norms_sq
 from dpsvm_tpu.ops.selection import masked_extrema

@@ -165,7 +165,7 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     rank-q update). ``limit`` (traced) caps the round's inner steps so
     ``n_iter`` stops exactly at the budget like every other solver.
     ``pallas_inner`` runs the subsolve as one Pallas kernel launch
-    (ops/subsolve_kernel.py) instead of the XLA while_loop — same math,
+    (experimental/subsolve_kernel.py) instead of the XLA while_loop — same math,
     bitwise-equal in interpret-mode tests."""
     alpha, f = carry.alpha, carry.f
     wp, wn = weights
@@ -230,7 +230,8 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
     if limit is not None:
         step_cap = jnp.minimum(step_cap, limit - carry.n_iter)
     if pallas_inner:
-        from dpsvm_tpu.ops.subsolve_kernel import pallas_inner_subsolve
+        from dpsvm_tpu.experimental.subsolve_kernel import (
+            pallas_inner_subsolve)
         a_in, f_in, bh_in, bl_in, t_in = pallas_inner_subsolve(
             k_ww, y_w, c_w, a_w0, f_w0, active, epsilon, step_cap,
             max_cap=inner_cap, pairwise=pairwise_clip,
@@ -274,7 +275,7 @@ def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
     policy for the Pallas inner kernel is resolved HERE (off-TPU
     backends run it interpreted, the CPU test suite's path) so every
     call site shares one policy."""
-    from dpsvm_tpu.solver.fused import _should_interpret
+    from dpsvm_tpu.experimental.fused import _should_interpret
 
     interpret = _should_interpret() if pallas_inner else False
     precision = getattr(lax.Precision, precision_name)

@@ -80,7 +80,7 @@ def test_resume_at_budget_identical_across_paths(tmp_path, blobs_small):
     budget and flip the verdict to converged."""
     import dataclasses
 
-    from dpsvm_tpu.solver.fused import train_single_device_fused
+    from dpsvm_tpu.experimental.fused import train_single_device_fused
     from dpsvm_tpu.solver.smo import train_single_device
 
     x, y = blobs_small

@@ -12,7 +12,8 @@ import pytest
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.data.synthetic import make_blobs, make_xor
 from dpsvm_tpu.models.svm import SVMModel, evaluate
-from dpsvm_tpu.solver.fused import train_single_device_fused, use_fused
+from dpsvm_tpu.experimental.fused import (train_single_device_fused,
+                                           use_fused)
 from dpsvm_tpu.solver.oracle import smo_reference
 from dpsvm_tpu.solver.smo import train_single_device
 

@@ -1,4 +1,4 @@
-"""Pallas inner-subsolve kernel (ops/subsolve_kernel.py) vs the XLA
+"""Pallas inner-subsolve kernel (experimental/subsolve_kernel.py) vs the XLA
 inner loop — interpret mode on CPU, same contract as test_fused.py."""
 
 from __future__ import annotations
@@ -12,7 +12,7 @@ from dpsvm_tpu.api import train
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.data.synthetic import make_blobs, make_planted
 from dpsvm_tpu.ops.kernels import KernelSpec, row_norms_sq, rows_from_dots
-from dpsvm_tpu.ops.subsolve_kernel import pallas_inner_subsolve
+from dpsvm_tpu.experimental.subsolve_kernel import pallas_inner_subsolve
 from dpsvm_tpu.solver.decomp import inner_subsolve
 
 
