@@ -188,6 +188,22 @@ def main() -> int:
         print("  -> neither converged: row documents measured iteration "
               "need; polish is the recommended config")
 
+    print("\n== pricing rows (no rule — feed docs/PERF.md directly) ==")
+    ovo = {m.get("arm"): m for m in (g("ovo_mnist10@all") or [])
+           if m.get("metric") == "ovo_train_seconds"}
+    if "batched" in ovo and "sequential" in ovo:
+        b, s = ovo["batched"], ovo["sequential"]
+        chk = next((m for m in g("ovo_mnist10@all")
+                    if m.get("metric") == "ovo_model_check"), {})
+        print(f"  ovo_mnist10: batched {b['value']}s vs sequential "
+              f"{s['value']}s -> {s['value'] / b['value']:.2f}x "
+              f"(pairs={b.get('pairs')}, model check: {chk or 'n/a'})")
+    else:
+        print(f"  ovo_mnist10: arms={sorted(ovo) or 'MISSING'}")
+    inf = g("inference")
+    print(f"  inference: {fmt(inf)}"
+          + (f" ({inf['value'] / 1e6:.2f}M ex/s)" if inf else ""))
+
     print("\n== rule 6: WSS2 ==")
     for cand_tag, base_tag in (("conv_wss2", "conv_base"),
                                ("conv_ijcnn1_wss2", "conv_ijcnn1_base")):

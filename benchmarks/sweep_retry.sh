@@ -91,6 +91,16 @@ while true; do
     rc=$?
     echo "$(date -u +%FT%TZ) sweeps exited rcb=$rcb rc4=$rc4 rc=$rc" \
       >> "$WATCH"
+    # Leave the decision-rule application as an artifact after every
+    # window, so landed rows are pre-digested even if nobody is
+    # watching the loop (a human still edits _auto_solver_plan /
+    # promotes kernels — this records exactly what the edits must be).
+    python benchmarks/decide_defaults.py \
+      > benchmarks/results/decide_defaults_r5.txt 2>&1 || true
+    python benchmarks/fold_results.py "$RESULTS" \
+      > benchmarks/results/fold_r3.md 2>&1 || true
+    python benchmarks/fold_results.py "$RESULTS_R4" \
+      > benchmarks/results/fold_r4.md 2>&1 || true
     if [ "$rcb" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc" -eq 0 ]; then
       # rc=0 means every tag was attempted, not that every tag was
       # measured: a watchdog-STALLed tag records rc=124 and the sweep
