@@ -457,26 +457,67 @@ class SVMConfig:
                     f"the numpy backend does not support: {unsupported}")
 
 
+def _shape_class(n: int, d: int) -> str:
+    """Problem-shape class for the auto-dispatch table. Boundaries come
+    from the measured d-regimes of the CPU iteration-economy scan
+    (docs/PERF.md "Solver-path iteration economics"): decomposition's
+    update cut improves with d (0.90x at d=128 -> 0.66x at d=784) and
+    fails at small-d/small-gamma (30000x54: DNF at the 600k cap), and
+    past ~VMEM scale the 2-violator step becomes HBM-stream-bound."""
+    if n >= 200_000:
+        return "hbm"        # covtype/epsilon: X streams from HBM
+    if d >= 512:
+        return "highd"      # mnist-like: the decomposition candidate
+    if d <= 32:
+        return "lowd"       # ijcnn1-like
+    return "mid"            # adult-like
+
+
+# (want_shrink, want_q, want_cap) per shape class — THE table that
+# cashes measured chip economics into default behavior (round-3
+# verdict #2). Every non-parity entry must cite a measured chip row in
+# docs/PERF.md; parity entries say why they stand. want_cap is the
+# decomposition inner-step cap that ships with a flipped want_q
+# (0 = the solver's auto q/4).
+_PLAN_TABLE = {
+    # highd shrinking: SETTLED NEGATIVE on chip — conv_shrink 74.36 s
+    # vs 19.09 s base at 60000x784 [sweep conv_shrink, r4 window];
+    # shrinking's cheaper steps cannot pay for its host round-trips
+    # when the row fetch is one fused MXU pass. want_q: pending the
+    # conv_decomp12288_cap* arms (q-selection rule says q >= ~1.3x
+    # n_sv; the CPU cut at d=784 is 0.66-0.70x updates).
+    "highd": (False, 2, 0),
+    # lowd: pending conv_ijcnn1_* arms; CPU scan shows WSS2's cut
+    # (0.59x) but no decomposition case (long subsolves on stale
+    # state at small d).
+    "lowd": (False, 2, 0),
+    # mid: pending conv_adult_1m; CPU wall win for shrinking (2.6-3.3x
+    # at d<=128) is deliberately NOT cashed — the shrink trade depends
+    # on the hardware's round cost (see the highd chip negative).
+    "mid": (False, 2, 0),
+    # hbm: decomposition denied on measured CPU evidence at the
+    # covtype d-regime (both 30000x54 q arms DNF at the 600k cap —
+    # auto must never pick it there); the q2048 chip arms decide
+    # whether measured-rate evidence overturns this.
+    "hbm": (False, 2, 0),
+}
+
+
 def _auto_solver_plan(n: int, d: int, config: "SVMConfig") -> dict:
     """Shape-based solver-path choice for the "auto" sentinels.
 
-    THE table that cashes measured chip economics into default behavior
-    (round-3 verdict #2): entries must cite a measured row in
-    docs/PERF.md before deviating from the reference-parity path.
-    Current policy — pending the chip sweep's wall-clock-to-convergence
-    A/B rows (`benchmarks/chip_sweep.sh` conv_shrink / conv_decomp* /
-    conv_covtype* tags) — resolves to the classic 2-violator unshrunk
-    path at every shape, i.e. exactly the framework's explicit
-    defaults. CPU evidence (PERF.md iteration-economics table: same
-    pair-update count, 3.0x wall-clock with shrinking at 20000x128) is
-    deliberately NOT cashed in here: the shrink/decomp trade depends on
-    the hardware's round cost, and CPU-tuned defaults on a TPU are the
-    exact mistake the verdict flagged (weak #4).
-
-    Never chooses a path a conflicting explicit field rules out — the
-    guard tables in validate() stay the no-silent-ignore authority for
-    EXPLICIT combinations, while auto simply declines the fast path.
+    Policy lives in ``_PLAN_TABLE`` (per shape class); this function
+    applies it without ever choosing a path a conflicting explicit
+    field rules out — the guard tables in validate() stay the
+    no-silent-ignore authority for EXPLICIT combinations, while auto
+    simply declines the fast path. Current table resolves to the
+    classic 2-violator unshrunk path at every class (exactly the
+    framework's explicit defaults): CPU wall-clock evidence is
+    deliberately not cashed into TPU defaults (round-3 verdict weak
+    #4), and the chip rows that would flip the slots are the armed
+    sweep backlog (`benchmarks/burst_runner.py`).
     """
+    want_shrink, want_q, want_cap = _PLAN_TABLE[_shape_class(n, d)]
     plan = {}
     if config.shrinking == "auto":
         shrink_supported = (config.kernel != "precomputed"
@@ -487,15 +528,18 @@ def _auto_solver_plan(n: int, d: int, config: "SVMConfig") -> dict:
                             and not config.profile_dir
                             and not (config.use_pallas == "on"
                                      and config.working_set == 2))
-        want_shrink = False   # <- chip-measured policy slot
         plan["shrinking"] = bool(want_shrink and shrink_supported)
     if config.working_set == 0:
         decomp_supported = (config.selection == "first-order"
                             and config.cache_size == 0
                             and config.select_impl == "argminmax"
                             and config.backend != "numpy")
-        want_q = 2            # <- chip-measured q-table slot
-        plan["working_set"] = want_q if decomp_supported else 2
+        if want_q > 2 and decomp_supported:
+            plan["working_set"] = want_q
+            if want_cap and config.inner_iters == 0:
+                plan["inner_iters"] = want_cap
+        else:
+            plan["working_set"] = 2
     return plan
 
 

@@ -250,6 +250,31 @@ class TestAutoSolverSentinels:
         with pytest.raises(ValueError, match="shrinking"):
             dt.SVMConfig(kernel="precomputed", shrinking=True).validate()
 
+    def test_shape_classes_partition_reference_shapes(self):
+        from dpsvm_tpu.config import _shape_class
+        assert _shape_class(60_000, 784) == "highd"    # mnist
+        assert _shape_class(49_990, 22) == "lowd"      # ijcnn1
+        assert _shape_class(32_561, 123) == "mid"      # adult
+        assert _shape_class(500_000, 54) == "hbm"      # covtype
+        assert _shape_class(400_000, 2000) == "hbm"    # epsilon
+
+    def test_plan_table_flip_flows_through_resolved(self, monkeypatch):
+        """When a chip row flips a class's slots, resolved() must hand
+        the solver the winning (q, cap) — simulated flip, since the
+        live table is parity pending rows."""
+        import dpsvm_tpu.config as cfgmod
+        monkeypatch.setitem(cfgmod._PLAN_TABLE, "highd",
+                            (False, 12288, 256))
+        r = dt.SVMConfig(working_set=0).resolved(60_000, 784)
+        assert r.working_set == 12288 and r.inner_iters == 256
+        # the flip is per class: other classes stay parity
+        r2 = dt.SVMConfig(working_set=0).resolved(32_561, 123)
+        assert r2.working_set == 2 and r2.inner_iters == 0
+        # unsupported combinations still decline the fast path
+        r3 = dt.SVMConfig(working_set=0,
+                          selection="second-order").resolved(60_000, 784)
+        assert r3.working_set == 2
+
     def test_nu_family_accepts_sentinels(self, blobs_small):
         from dpsvm_tpu.models.nusvm import train_nusvc
 
