@@ -132,11 +132,16 @@ def main() -> None:
     # stderr instead of vanishing without a number.
     verbose = os.environ.get("BENCH_VERBOSE", "") == "1"
     polish = os.environ.get("BENCH_POLISH", "") == "1"
+    # In-run wall budget (seconds; 0 = none): an over-projected arm
+    # returns a partial rate row instead of being timeout-killed with
+    # no number (the burst runner sets the config field directly).
+    wall_budget = float(os.environ.get("BENCH_WALL_BUDGET", 0) or 0)
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
                        shrinking=shrinking, use_pallas=use_pallas,
-                       polish=polish, verbose=verbose, chunk_iters=8192)
+                       polish=polish, verbose=verbose, chunk_iters=8192,
+                       wall_budget_s=wall_budget)
 
     print(json.dumps(convergence_run(x, y, config)), flush=True)
 

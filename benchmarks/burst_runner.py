@@ -78,6 +78,12 @@ def sub(tag, file, budget, cmd, **env):
 # remaining A/B arms and the long HBM-bound rate rows.
 TAGS = [
     conv("conv_base", R4, 300, **MNIST),
+    # Steady-state it/s at the headline shape — the same number the
+    # driver's round-end bench.py captures, taken as a sweep row too so
+    # a capture-time outage (rounds 3 and 4) cannot leave the round
+    # without a chip-verified rate.
+    sub("headline_bf16", R4, 300, [sys.executable, "bench.py"],
+        BENCH_PRECISION="DEFAULT"),
     conv("conv_f32", R4, 420, precision="highest", **MNIST),
     conv("conv_decomp12288_cap256", R4, 300, working_set=12288,
          inner_iters=256, **MNIST),
@@ -248,7 +254,7 @@ def run_sub(spec):
                 "BENCH_SHRINKING": "", "BENCH_PALLAS": "auto",
                 "BENCH_MAX_ITER": "400000", "BENCH_POLISH": "",
                 "BENCH_NO_MEMO": "", "BENCH_VERBOSE": "1",
-                "BENCH_PLATFORM": ""})
+                "BENCH_PLATFORM": "", "BENCH_WALL_BUDGET": ""})
     env.update(spec["env"])
     env.setdefault("BENCH_STALL_TIMEOUT",
                    os.environ.get("BENCH_STALL_TIMEOUT", "420"))
