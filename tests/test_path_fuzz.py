@@ -28,6 +28,8 @@ PATHS = {
     "decomp_shrink": dict(working_set=64, inner_iters=16, shrinking=True),
     "wss2": dict(selection="second-order"),
     "dist8": dict(shards=8),
+    "dist8_decomp": dict(shards=8, working_set=64, inner_iters=16),
+    "dist8_shrink": dict(shards=8, shrinking=True),
     "packed": dict(select_impl="packed"),
 }
 
