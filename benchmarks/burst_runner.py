@@ -245,6 +245,23 @@ def run_conv(spec):
 
 
 def run_sub(spec):
+    # The parent blocks in subprocess.run with no device polls, so its
+    # own stall watchdog must stand down for the duration — the child
+    # arms its own via BENCH_STALL_TIMEOUT, and the run() timeout is
+    # the parent-side bound. Without this, a healthy 15-minute
+    # subprocess tag would get the PARENT os._exit(124)'d at the stall
+    # timeout.
+    from dpsvm_tpu.utils import watchdog
+    watchdog.disarm()
+    try:
+        return _run_sub_inner(spec)
+    finally:
+        stall = os.environ.get("BENCH_STALL_TIMEOUT")
+        if stall:
+            watchdog.arm(float(stall))
+
+
+def _run_sub_inner(spec):
     env = dict(os.environ)
     # Pin the ambient knobs exactly like sweep_lib.sh's run() so a
     # leftover export can never relabel a recorded measurement.

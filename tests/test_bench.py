@@ -104,6 +104,30 @@ def test_burst_runner_records_and_skips(tmp_path):
                 if '"t_budget"' in l]) == 2
 
 
+def test_burst_runner_watchdog_stands_down_for_subprocess_tags(tmp_path):
+    """A subprocess tag longer than the stall timeout must NOT get the
+    parent burst process killed: the parent has no device polls while
+    subprocess.run blocks, so its watchdog disarms for the duration
+    (the child arms its own)."""
+    res = tmp_path / "sweep.jsonl"
+    tags = [{"tag": "t_sub_slow", "file": str(res), "budget": 60,
+             "kind": "sub",
+             "cmd": [sys.executable, "-c",
+                     "import time, json; time.sleep(6); "
+                     "print(json.dumps({'metric': 'x', 'value': 1}))"],
+             "env": {}}]
+    spec = tmp_path / "tags.json"
+    spec.write_text(json.dumps(tags))
+    r = _run("benchmarks/burst_runner.py",
+             {"BURST_TAGS_JSON": str(spec), "BENCH_PLATFORM": "cpu",
+              "BENCH_STALL_TIMEOUT": "3",
+              "BURST_PENDING": str(tmp_path / "pending.json")},
+             timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    recs = [json.loads(l) for l in res.read_text().splitlines()]
+    assert recs[0]["tag"] == "t_sub_slow" and recs[0]["rc"] == 0
+
+
 def test_backend_guard_times_out_cleanly(tmp_path):
     """A backend that never comes up must yield rc=1 + one clear error
     line, not a hang. Simulated by pointing JAX at a plugin that blocks:
