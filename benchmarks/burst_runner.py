@@ -320,6 +320,7 @@ def main(argv) -> int:
     log(f"burst runner: device {dev} ({dev.platform}), {len(tags)} tags")
     enable_compile_cache()
 
+    consecutive_errors = 0
     for spec in tags:
         tag, path = spec["tag"], spec["file"]
         recs = [r for r in records(path) if r.get("tag") == tag]
@@ -356,6 +357,19 @@ def main(argv) -> int:
         pend[tag] = 0
         save_pending(pend)
         log(f"{'OK  ' if rc == 0 else 'FAIL'} {tag} rc={rc} {secs:.0f}s")
+        # A dead tunnel raises (rather than hangs) on every subsequent
+        # device call: each tag would fail-fast rc=1 and burn one of
+        # its 2 recorded attempts with no measurement. Two consecutive
+        # no-output errors ⇒ treat as an environment failure and abort;
+        # untouched tags keep their attempt budget for the next window.
+        if rc not in (0, 95) and not out_lines:
+            consecutive_errors += 1
+            if consecutive_errors >= 2:
+                log("ABORT: 2 consecutive no-output failures — "
+                    "environment looks dead; preserving the backlog")
+                return 3
+        else:
+            consecutive_errors = 0
     log("burst complete")
     return 0
 

@@ -104,6 +104,33 @@ def test_burst_runner_records_and_skips(tmp_path):
                 if '"t_budget"' in l]) == 2
 
 
+def test_burst_runner_aborts_after_consecutive_dead_errors(tmp_path):
+    """Two consecutive no-output failures (a dead tunnel raises on
+    every device call) abort the burst so untouched tags keep their
+    attempt budget for the next window."""
+    res = tmp_path / "sweep.jsonl"
+    fail = [sys.executable, "-c", "import sys; sys.exit(1)"]
+    ok = [sys.executable, "-c",
+          "import json; print(json.dumps({'metric': 'x', 'value': 1}))"]
+    tags = [
+        {"tag": "t_f1", "file": str(res), "budget": 30, "kind": "sub",
+         "cmd": fail, "env": {}},
+        {"tag": "t_f2", "file": str(res), "budget": 30, "kind": "sub",
+         "cmd": fail, "env": {}},
+        {"tag": "t_never", "file": str(res), "budget": 30, "kind": "sub",
+         "cmd": ok, "env": {}},
+    ]
+    spec = tmp_path / "tags.json"
+    spec.write_text(json.dumps(tags))
+    r = _run("benchmarks/burst_runner.py",
+             {"BURST_TAGS_JSON": str(spec), "BENCH_PLATFORM": "cpu",
+              "BURST_PENDING": str(tmp_path / "pending.json")},
+             timeout=120)
+    assert r.returncode == 3, (r.returncode, r.stderr[-1500:])
+    recs = [json.loads(l) for l in res.read_text().splitlines()]
+    assert [x["tag"] for x in recs] == ["t_f1", "t_f2"]  # t_never spared
+
+
 def test_burst_runner_watchdog_stands_down_for_subprocess_tags(tmp_path):
     """A subprocess tag longer than the stall timeout must NOT get the
     parent burst process killed: the parent has no device polls while
