@@ -362,7 +362,10 @@ def main(argv) -> int:
         # its 2 recorded attempts with no measurement. Two consecutive
         # no-output errors ⇒ treat as an environment failure and abort;
         # untouched tags keep their attempt budget for the next window.
-        if rc not in (0, 95) and not out_lines:
+        # rc=124 is excluded: a subprocess timeout/stall means SLOW (or
+        # a mid-run drop the scrubber will reclaim), not a dead env —
+        # two adjacent long tags must not fake an abort.
+        if rc not in (0, 95, 124) and not out_lines:
             consecutive_errors += 1
             if consecutive_errors >= 2:
                 log("ABORT: 2 consecutive no-output failures — "
