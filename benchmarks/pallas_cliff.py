@@ -78,7 +78,8 @@ def main() -> None:
 
     from dpsvm_tpu.experimental.fused_step import (DEFAULT_BLOCK_N,
                                                    pad_to_block)
-    from dpsvm_tpu.experimental.fused import _run_chunk, init_fused_carry
+    from dpsvm_tpu.experimental.fused import (_run_chunk, _should_interpret,
+                                              init_fused_carry)
 
     n_pad = pad_to_block(n, DEFAULT_BLOCK_N)
     xp = np.zeros((n_pad, d), np.float32)
@@ -94,7 +95,12 @@ def main() -> None:
     run = functools.partial(_run_chunk, c=C, gamma=GAMMA, epsilon=EPS,
                             max_iter=10_000_000,
                             block_n=DEFAULT_BLOCK_N,
-                            precision_name=precision, interpret=False)
+                            precision_name=precision,
+                            # one interpret policy for every call site:
+                            # real kernel on TPU, interpret off-TPU (the
+                            # CPU rehearsal path; meaninglessly slow for
+                            # timing but structurally end-to-end)
+                            interpret=_should_interpret())
     fc, _ = run(fc, xf, x2f, yf, jnp.int32(warm))
     jax.block_until_ready(fc.f)
     it0 = int(fc.n_iter)
