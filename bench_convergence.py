@@ -29,6 +29,8 @@ Environment:
     BENCH_WORKING_SET 2 (classic pair SMO) | even q > 2 (large-working-
                       set decomposition, solver/decomp.py)
     BENCH_INNER_ITERS decomposition inner-step cap (0 = auto q/4)
+    BENCH_GROW        1 = adaptive working-set growth (grow_working_set;
+                      only with BENCH_WORKING_SET > 2)
     BENCH_SHRINKING   1 = LIBSVM-style active-set training
                       (solver/shrink.py; composes with the above)
     BENCH_PALLAS      auto (default) | on — 'on' with BENCH_WORKING_SET
@@ -125,6 +127,7 @@ def main() -> None:
     # each poll round pays a ~65 ms tunnel round-trip, so poll rarely.
     working_set = int(os.environ.get("BENCH_WORKING_SET", 2))
     inner_iters = int(os.environ.get("BENCH_INNER_ITERS", 0))
+    grow = os.environ.get("BENCH_GROW", "") == "1"
     shrinking = os.environ.get("BENCH_SHRINKING", "") == "1"
     use_pallas = os.environ.get("BENCH_PALLAS", "auto")
     # BENCH_VERBOSE=1 prints gap progress at chunk polls — a run killed
@@ -139,6 +142,7 @@ def main() -> None:
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
+                       grow_working_set=grow,
                        shrinking=shrinking, use_pallas=use_pallas,
                        polish=polish, verbose=verbose, chunk_iters=8192,
                        wall_budget_s=wall_budget)

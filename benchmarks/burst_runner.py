@@ -89,6 +89,12 @@ TAGS = [
          inner_iters=256, **MNIST),
     conv("conv_decomp12288_cap128", R4, 300, working_set=12288,
          inner_iters=128, **MNIST),
+    # Adaptive growth from a modest q: prices the no-prior-knowledge
+    # policy against the informed fixed-q arms above (CPU economy:
+    # 1.45x the right-sized update count — PERF.md "Adaptive
+    # working-set growth"; each growth pays one compile on chip).
+    conv("conv_decomp_adaptive", R4, 420, working_set=4096,
+         inner_iters=256, grow_working_set=True, **MNIST),
     conv("conv_adult_1m", R3, 300, max_iter=1_000_000, shrinking=True,
          **ADULT),
     conv("conv_decomp12288_cap256_shrink", R4, 300, working_set=12288,
@@ -271,7 +277,8 @@ def _run_sub_inner(spec):
                 "BENCH_SHRINKING": "", "BENCH_PALLAS": "auto",
                 "BENCH_MAX_ITER": "400000", "BENCH_POLISH": "",
                 "BENCH_NO_MEMO": "", "BENCH_VERBOSE": "1",
-                "BENCH_PLATFORM": "", "BENCH_WALL_BUDGET": ""})
+                "BENCH_PLATFORM": "", "BENCH_WALL_BUDGET": "",
+                "BENCH_GROW": ""})
     env.update(spec["env"])
     env.setdefault("BENCH_STALL_TIMEOUT",
                    os.environ.get("BENCH_STALL_TIMEOUT", "420"))

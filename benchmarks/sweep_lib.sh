@@ -42,7 +42,8 @@ run() {  # run <tag> <timeout_s> <env...> -- <cmd...>
               BENCH_EPS=1e-3 BENCH_WORKING_SET=2 BENCH_INNER_ITERS=0
               BENCH_SHRINKING= BENCH_PALLAS=auto BENCH_MAX_ITER=400000
               BENCH_POLISH= BENCH_NO_MEMO= BENCH_VERBOSE=1
-              BENCH_PLATFORM= BENCH_STALL_TIMEOUT= BENCH_WALL_BUDGET=)
+              BENCH_PLATFORM= BENCH_STALL_TIMEOUT= BENCH_WALL_BUDGET=
+              BENCH_GROW=)
   while [ "$1" != "--" ]; do envs+=("$1"); shift; done
   shift
   if have "$tag"; then echo "SKIP $tag (already recorded)"; return 0; fi

@@ -135,6 +135,13 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--inner-iters", type=int, default=0,
                     help="decomposition inner-step cap per round "
                          "(0 = auto: Q/4; only with --working-set > 2)")
+    tr.add_argument("--grow-working-set", action="store_true",
+                    help="adaptive decomposition: grow Q (recompile, "
+                         "same state) when the SV count approaches it "
+                         "— applies the measured q-selection rule "
+                         "(Q must stay above ~1.3x the SV count) "
+                         "without knowing the SV count up front; "
+                         "start with a modest --working-set")
     tr.add_argument("--shrinking", nargs="?", const=True, default=False,
                     type=_shrinking_value, metavar="{0,1,auto}",
                     help="LIBSVM -h analog: active-set training — "
@@ -445,6 +452,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         select_impl=args.select_impl,
         working_set=args.working_set,
         inner_iters=args.inner_iters,
+        grow_working_set=args.grow_working_set,
         shrinking=args.shrinking,
         weight_pos=args.weight_pos,
         weight_neg=args.weight_neg,

@@ -80,6 +80,16 @@ class SVMConfig:
                                         # outer round (0 = auto: q/4).
                                         # The subsolve also exits early
                                         # when its own gap closes.
+    grow_working_set: bool = False      # adaptive decomposition: start
+                                        # at working_set=q and GROW the
+                                        # block (recompile, same carry)
+                                        # when the SV count approaches
+                                        # it — the measured q-selection
+                                        # rule (q >= ~1.3x n_sv or
+                                        # updates blow up 2.5-3x)
+                                        # applied without knowing n_sv
+                                        # a priori. Single-device
+                                        # XLA decomposition only.
     shrinking: object = False           # LIBSVM -h: active-set training
                                         # (solver/shrink.py) — compact
                                         # the problem to the rows that
@@ -394,6 +404,28 @@ class SVMConfig:
                 if bad:
                     raise ValueError(
                         f"working_set > 2 does not support {field}: {what}")
+        if self.grow_working_set:
+            # Same no-silent-ignore policy: reject every path that
+            # would ignore (or fight) the growth manager.
+            for field, bad, what in (
+                    ("working_set", self.working_set in (0, 2),
+                     "growth needs an explicit starting q > 2 "
+                     "(working_set=0 may resolve to the classic pair)"),
+                    ("shards", self.shards > 1,
+                     "the growth manager is single-device today"),
+                    ("shrinking", self.shrinking is not False,
+                     "two host-level rebuild managers (shrink compacts "
+                     "n, growth raises q) are not composed yet"),
+                    ("use_pallas", self.use_pallas == "on",
+                     "the Pallas inner subsolve caps q at 2048, which "
+                     "growth would cross"),
+                    ("backend", self.backend == "numpy",
+                     "the golden oracle keeps the reference's pair "
+                     "iteration")):
+                if bad:
+                    raise ValueError(
+                        f"grow_working_set does not support {field}: "
+                        f"{what}")
         if self.shrinking is True:
             # Reject paths that would silently ignore or fight the
             # active-set manager (same no-silent-ignore policy).

@@ -97,8 +97,17 @@ def host_training_loop(
     step_chunk: Callable,           # (carry, limit:int) -> (carry, stats)
     carry_to_host: Callable,        # carry -> (alpha, f) np arrays
     it0: int = 0,                   # carry's entry iteration (0 or resume)
+    poll_hook: Optional[Callable] = None,
 ) -> TrainResult:
-    """Run chunks until convergence / max_iter; return the TrainResult."""
+    """Run chunks until convergence / max_iter; return the TrainResult.
+
+    ``poll_hook(n_iter, carry) -> Optional[new_step_chunk]``: called at
+    each poll while the run is not done; a non-None return replaces
+    ``step_chunk`` for subsequent dispatches (the decomposition growth
+    manager swaps in a larger-q program this way — legal because the
+    carry layout is program-independent). In pipelined mode one
+    already-dispatched speculative chunk still runs under the old
+    program; its math is the same, only its block size is."""
     eps = float(config.epsilon)
     chunk = config.chunk_iters
     # Pipelining changes WHEN the carry is read, not what is computed:
@@ -144,6 +153,11 @@ def host_training_loop(
             log_progress(config, n_iter, b_lo, b_hi, final=done,
                          prev_iter=prev_polled)
             prev_polled = n_iter
+
+            if poll_hook is not None and not done:
+                replacement = poll_hook(n_iter, carry)
+                if replacement is not None:
+                    step_chunk = replacement
 
             def make() -> SolverCheckpoint:
                 alpha, f = carry_to_host(carry)

@@ -1,0 +1,130 @@
+"""Adaptive working-set growth (grow_working_set=True).
+
+The measured q-selection rule says q must stay above ~1.3x the SV
+count or subsolves grind on stale global state (2.5-3x the updates,
+benchmarks/results/iteration_economy_r4.jsonl) — but n_sv is unknown
+until the problem is solved. The growth manager starts at the
+configured q and rebuilds the runner at a larger block when the SV
+count crosses the occupancy threshold; the carry is
+program-independent, so a rebuild changes the program, not the state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import dpsvm_tpu.solver.decomp as decomp
+from dpsvm_tpu.api import train
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_planted
+
+
+@pytest.fixture(scope="module")
+def sv_heavy():
+    """A problem whose SV count far exceeds a small starting q (planted
+    with noise at moderate C keeps a large margin population)."""
+    return make_planted(1500, 24, gamma=0.5, seed=7, noise=0.05)
+
+
+def _grow_calls(monkeypatch):
+    """Record every (q, cap) the runner builder is asked for."""
+    calls = []
+    real = decomp._build_decomp_runner
+
+    def spy(c, kspec, eps, q, cap, *a, **kw):
+        calls.append((q, cap))
+        return real(c, kspec, eps, q, cap, *a, **kw)
+
+    monkeypatch.setattr(decomp, "_build_decomp_runner", spy)
+    return calls
+
+
+def test_growth_triggers_and_matches_classic_model(monkeypatch, sv_heavy):
+    x, y = sv_heavy
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 256); monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 256)
+    calls = _grow_calls(monkeypatch)
+    base = dict(c=10.0, gamma=0.5, epsilon=1e-3, max_iter=300_000)
+    ref = train(x, y, SVMConfig(**base))
+    assert ref.converged
+
+    r = train(x, y, SVMConfig(working_set=64, grow_working_set=True,
+                              chunk_iters=256, **base))
+    assert r.converged
+    qs = [q for q, _ in calls]
+    assert qs[0] == 64
+    assert len(qs) >= 2 and qs[-1] > 64, qs
+    assert qs == sorted(qs)                      # growth only
+    # each growth at least doubles q => few rebuilds by construction
+    assert len(qs) <= 8
+    # auto inner cap tracks the grown q
+    assert all(cap == max(32, q // 4) for q, cap in calls)
+    # model quality: the classic parity bar (same invariants as the
+    # cross-path fuzz — b is NOT path-invariant under the reference's
+    # independent clip, so the decision-surface check is prediction
+    # agreement)
+    from dpsvm_tpu.models.svm import SVMModel, predict
+    assert abs(r.n_sv - ref.n_sv) <= max(0.03 * ref.n_sv, 5.0)
+    m_ref = SVMModel.from_train_result(x, y, ref)
+    m_grow = SVMModel.from_train_result(x, y, r)
+    agree = float(np.mean(np.asarray(predict(m_grow, x))
+                          == np.asarray(predict(m_ref, x))))
+    assert agree >= 0.99, agree
+
+
+def test_no_growth_when_block_is_ample(monkeypatch, sv_heavy):
+    x, y = sv_heavy
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 256); monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 256)
+    calls = _grow_calls(monkeypatch)
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=1400,
+                              grow_working_set=True, chunk_iters=256))
+    assert r.converged
+    # q starts at (even-clamped) n-scale: nothing to grow into
+    assert len(calls) == 1, calls
+
+
+def test_growth_capped_at_problem_size(monkeypatch):
+    """q never exceeds n (top_k bound) or the validation ceiling."""
+    x, y = make_planted(700, 16, gamma=0.5, seed=3, noise=0.08)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 128); monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 128)
+    calls = _grow_calls(monkeypatch)
+    r = train(x, y, SVMConfig(c=50.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=32,
+                              grow_working_set=True, chunk_iters=128))
+    assert r.converged
+    assert all(q <= 700 for q, _ in calls), calls
+
+
+def test_guard_rails():
+    with pytest.raises(ValueError, match="grow_working_set"):
+        SVMConfig(grow_working_set=True).validate()          # q=2
+    with pytest.raises(ValueError, match="grow_working_set"):
+        SVMConfig(grow_working_set=True, working_set=0).validate()
+    with pytest.raises(ValueError, match="grow_working_set"):
+        SVMConfig(grow_working_set=True, working_set=64,
+                  shards=2).validate()
+    with pytest.raises(ValueError, match="grow_working_set"):
+        SVMConfig(grow_working_set=True, working_set=64,
+                  shrinking=True).validate()
+    with pytest.raises(ValueError, match="grow_working_set"):
+        SVMConfig(grow_working_set=True, working_set=64,
+                  use_pallas="on").validate()
+    # numpy is rejected by the working_set guard table before the grow
+    # table is reached — either message is a loud refusal
+    with pytest.raises(ValueError, match="backend"):
+        SVMConfig(grow_working_set=True, working_set=64,
+                  backend="numpy").validate()
+
+
+def test_explicit_inner_cap_survives_growth(monkeypatch, sv_heavy):
+    x, y = sv_heavy
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 256); monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 256)
+    calls = _grow_calls(monkeypatch)
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=64,
+                              inner_iters=16, grow_working_set=True,
+                              chunk_iters=256))
+    assert r.converged
+    assert len(calls) >= 2
+    assert all(cap == 16 for _, cap in calls), calls
