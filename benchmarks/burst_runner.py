@@ -190,7 +190,13 @@ def save_pending(p):
 
 
 class _Tee:
-    """Mirror writes to the real stderr while keeping a tail buffer."""
+    """Mirror writes to the real stderr while keeping a tail buffer.
+
+    Lives only for one tag's redirect window, but library loggers
+    (absl's, initialized lazily at first compile) can capture it as
+    their handler stream and close() it at interpreter shutdown —
+    so it must behave like a file: unknown attributes delegate to the
+    real stream and close() is a no-op (never closing real stderr)."""
 
     def __init__(self, real):
         self.real = real
@@ -206,6 +212,12 @@ class _Tee:
 
     def flush(self):
         self.real.flush()
+
+    def close(self):
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self.real, name)
 
     def tail(self):
         return self.lines[-15:] + ([self._buf] if self._buf else [])
