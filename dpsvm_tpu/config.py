@@ -88,8 +88,9 @@ class SVMConfig:
                                         # rule (q >= ~1.3x n_sv or
                                         # updates blow up 2.5-3x)
                                         # applied without knowing n_sv
-                                        # a priori. Single-device
-                                        # XLA decomposition only.
+                                        # a priori. XLA decomposition
+                                        # paths (single-device AND
+                                        # distributed).
     shrinking: object = False           # LIBSVM -h: active-set training
                                         # (solver/shrink.py) — compact
                                         # the problem to the rows that
@@ -411,8 +412,6 @@ class SVMConfig:
                     ("working_set", self.working_set in (0, 2),
                      "growth needs an explicit starting q > 2 "
                      "(working_set=0 may resolve to the classic pair)"),
-                    ("shards", self.shards > 1,
-                     "the growth manager is single-device today"),
                     ("shrinking", self.shrinking is not False,
                      "two host-level rebuild managers (shrink compacts "
                      "n, growth raises q) are not composed yet"),

@@ -276,7 +276,6 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
     kspec = config.kernel_spec(d)
     eps = float(config.epsilon)
     q = 2 * min(int(config.working_set) // 2, n)
-    inner_cap = int(config.inner_iters) or max(32, q // 4)
 
     ckpt = resume_state(config, n, d, gamma)
     di = prepare_distributed_inputs(x, y, config, mesh, ckpt,
@@ -292,20 +291,37 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
         b_lo=jax.device_put(np.float32(init[3]), repl),
         n_iter=jax.device_put(np.int32(init[4]), repl))
 
-    runner = _build_dist_decomp_runner(
-        mesh, float(config.c), kspec, eps, n_s, q, inner_cap,
-        bool(config.shard_x), config.matmul_precision.upper(),
-        (float(config.weight_pos), float(config.weight_neg)),
-        config.clip == "pairwise")
+    def build(q_now: int):
+        q_now = 2 * min(int(q_now) // 2, n)     # same clamp as above
+        cap = int(config.inner_iters) or max(32, q_now // 4)
+        r = _build_dist_decomp_runner(
+            mesh, float(config.c), kspec, eps, n_s, q_now, cap,
+            bool(config.shard_x), config.matmul_precision.upper(),
+            (float(config.weight_pos), float(config.weight_neg)),
+            config.clip == "pairwise")
 
-    def step_chunk(cr, lim):
-        limit = jax.device_put(np.int32(lim), repl)
-        return runner(cr, xd, yd, x2, validd, limit)
+        def step(cr, lim):
+            limit = jax.device_put(np.int32(lim), repl)
+            return r(cr, xd, yd, x2, validd, limit)
+
+        return step
+
+    # Adaptive growth works unchanged over the mesh: the sharded carry
+    # is program-independent too (alpha/f are (n_s,)-per-shard whatever
+    # q is), so a growth rebuild is just a new SPMD program; the SV
+    # count gathers the sharded alpha (padding rows hold alpha=0 and
+    # count as non-SV).
+    if config.grow_working_set:
+        from dpsvm_tpu.solver.decomp import _make_growth_hook
+        poll_hook = _make_growth_hook(config, n, q, build)
+    else:
+        poll_hook = None
 
     return host_training_loop(
         config, gamma, n, d, carry,
-        step_chunk=step_chunk,
+        step_chunk=build(q),
         carry_to_host=lambda cr: (to_host(cr.alpha)[:n],
                                   to_host(cr.f)[:n]),
         it0=int(init[4]),
+        poll_hook=poll_hook,
     )

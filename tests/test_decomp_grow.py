@@ -103,9 +103,6 @@ def test_guard_rails():
         SVMConfig(grow_working_set=True, working_set=0).validate()
     with pytest.raises(ValueError, match="grow_working_set"):
         SVMConfig(grow_working_set=True, working_set=64,
-                  shards=2).validate()
-    with pytest.raises(ValueError, match="grow_working_set"):
-        SVMConfig(grow_working_set=True, working_set=64,
                   shrinking=True).validate()
     with pytest.raises(ValueError, match="grow_working_set"):
         SVMConfig(grow_working_set=True, working_set=64,
@@ -115,6 +112,40 @@ def test_guard_rails():
     with pytest.raises(ValueError, match="backend"):
         SVMConfig(grow_working_set=True, working_set=64,
                   backend="numpy").validate()
+
+
+def test_distributed_growth_matches_classic(monkeypatch, sv_heavy):
+    """Growth over the 8-shard mesh: the sharded carry is
+    program-independent too, so rebuilds swap SPMD programs; the model
+    must land on the classic bar like every other path."""
+    import dpsvm_tpu.parallel.dist_decomp as dd
+    from dpsvm_tpu.models.svm import SVMModel, predict
+
+    x, y = sv_heavy
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 256)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 256)
+    qs = []
+    real = dd._build_dist_decomp_runner
+
+    def spy(mesh, c, kspec, eps, n_s, q, cap, *a, **kw):
+        qs.append((q, cap))
+        return real(mesh, c, kspec, eps, n_s, q, cap, *a, **kw)
+
+    monkeypatch.setattr(dd, "_build_dist_decomp_runner", spy)
+    base = dict(c=10.0, gamma=0.5, epsilon=1e-3, max_iter=300_000)
+    ref = train(x, y, SVMConfig(**base))
+    r = train(x, y, SVMConfig(working_set=64, grow_working_set=True,
+                              shards=8, chunk_iters=256, **base))
+    assert r.converged
+    assert qs[0][0] == 64
+    assert len(qs) >= 2 and qs[-1][0] > 64, qs
+    assert all(cap == max(32, q // 4) for q, cap in qs)
+    assert abs(r.n_sv - ref.n_sv) <= max(0.03 * ref.n_sv, 5.0)
+    m_ref = SVMModel.from_train_result(x, y, ref)
+    m_g = SVMModel.from_train_result(x, y, r)
+    agree = float(np.mean(np.asarray(predict(m_g, x))
+                          == np.asarray(predict(m_ref, x))))
+    assert agree >= 0.99, agree
 
 
 def test_explicit_inner_cap_survives_growth(monkeypatch, sv_heavy):
