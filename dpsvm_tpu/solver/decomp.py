@@ -419,6 +419,16 @@ GROW_CHECK_MAX = 16_384
 GROW_AT_OCCUPANCY = 0.75
 GROW_TARGET_FACTOR = 1.5
 GROW_QUANTUM = 2_048
+# Growth must self-bound by accelerator memory, unlike an explicit
+# fixed q (the user's own choice): each outer round materializes
+# (q, n)-shaped intermediates — the dots matmul output, plus the
+# kernel-epilogue block when XLA does not fuse it into the rank-q
+# reduction — so budget ~8 bytes per (q-row x example) and keep
+# headroom for X and the vector state. 8 GB keeps q at the
+# sweep-validated 2048 at covtype scale (n=500k: the (q, n) block is
+# 4 GB at q=2048, 8 GB at 4096 — the r3 sweep's own sizing note) and
+# is no constraint at the mnist shape (q_mem ~ 16k at n=60k).
+GROW_HBM_BUDGET = 8 * 1024 ** 3
 
 
 def _make_growth_hook(config: SVMConfig, n: int, q0: int, build):
@@ -439,7 +449,8 @@ def _make_growth_hook(config: SVMConfig, n: int, q0: int, build):
     running undersized)."""
     from dpsvm_tpu.utils import watchdog
 
-    q_max = min(16_384, n - (n % 2))
+    q_mem = int(GROW_HBM_BUDGET // (8 * max(n, 1)))
+    q_max = min(16_384, n - (n % 2), max(q_mem - (q_mem % 2), q0))
     state = {"q": q0, "last_check": 0, "cadence": GROW_CHECK_MIN}
 
     def hook(n_iter: int, carry):

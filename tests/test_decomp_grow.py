@@ -96,6 +96,32 @@ def test_growth_capped_at_problem_size(monkeypatch):
     assert all(q <= 700 for q, _ in calls), calls
 
 
+def test_growth_self_bounds_by_memory(monkeypatch, sv_heavy):
+    """Automatic growth must respect the accelerator-memory budget:
+    with a budget that only admits a small q at this n, the manager
+    never grows past it (an explicit fixed q is the user's own choice;
+    growth is automatic so it self-bounds)."""
+    x, y = sv_heavy                     # n=1500
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 256)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 256)
+    # budget admits q_mem = budget/(8n) = 128 at n=1500
+    monkeypatch.setattr(decomp, "GROW_HBM_BUDGET", 128 * 8 * 1500)
+    calls = _grow_calls(monkeypatch)
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=64,
+                              grow_working_set=True, chunk_iters=256))
+    assert r.converged
+    assert all(q <= 128 for q, _ in calls), calls
+    # the budget never shrinks a run below its configured start
+    monkeypatch.setattr(decomp, "GROW_HBM_BUDGET", 8 * 8 * 1500)
+    calls2 = _grow_calls(monkeypatch)
+    r2 = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                               max_iter=300_000, working_set=64,
+                               grow_working_set=True, chunk_iters=256))
+    assert r2.converged
+    assert [q for q, _ in calls2] == [64], calls2
+
+
 def test_guard_rails():
     with pytest.raises(ValueError, match="grow_working_set"):
         SVMConfig(grow_working_set=True).validate()          # q=2
