@@ -19,7 +19,9 @@ Environment:
                     seconds are NOT the measurement)
     BENCH_N/BENCH_D/BENCH_C/BENCH_GAMMA/BENCH_EPS/BENCH_MAX_ITER
     BENCH_ARMS      comma list from: classic, shrink, wss2,
-                    q<Q>, q<Q>c<CAP>, q<Q>shrink
+                    q<Q>, q<Q>c<CAP>, q<Q>shrink,
+                    grow<Q>, grow<Q>c<CAP> (adaptive working-set
+                    growth from a q=<Q> start)
                     (default: classic,shrink,wss2,q1024,q4096c128)
 """
 
@@ -43,8 +45,9 @@ def arm_config(arm: str, base: dict):
         kw["shrinking"] = True
     elif arm == "wss2":
         kw["selection"] = "second-order"
-    elif arm.startswith("q"):
-        spec = arm[1:]
+    elif arm.startswith("q") or arm.startswith("grow"):
+        grow = arm.startswith("grow")
+        spec = arm[4:] if grow else arm[1:]
         shrink = spec.endswith("shrink")
         if shrink:
             spec = spec[: -len("shrink")]
@@ -54,6 +57,8 @@ def arm_config(arm: str, base: dict):
         else:
             q_s = spec
         kw["working_set"] = int(q_s)
+        if grow:
+            kw["grow_working_set"] = True
         if shrink:
             kw["shrinking"] = True
     else:
