@@ -174,6 +174,38 @@ def test_distributed_growth_matches_classic(monkeypatch, sv_heavy):
     assert agree >= 0.99, agree
 
 
+def test_growth_composes_with_wall_budget(monkeypatch, sv_heavy):
+    """Budget break and growth share the poll loop: a tight budget must
+    stop a growing run cleanly (partial result, warm-startable), never
+    fight the rebuild."""
+    from dpsvm_tpu.api import warm_start
+
+    x, y = sv_heavy
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 64)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 64)
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=64,
+                              grow_working_set=True, chunk_iters=64,
+                              wall_budget_s=0.4))
+    assert not r.converged and r.n_iter > 0
+    full = warm_start(x, y, r.alpha,
+                      SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3,
+                                max_iter=300_000))
+    assert full.converged
+
+
+def test_wall_budget_in_checkpointing_mode(tmp_path, sv_heavy):
+    """checkpoint_every disables dispatch pipelining; the budget exit
+    must work on that strictly-sequential path too."""
+    x, y = sv_heavy
+    ck = str(tmp_path / "state.npz")
+    r = train(x, y, SVMConfig(c=10.0, gamma=0.5, epsilon=1e-6,
+                              max_iter=500_000, chunk_iters=32,
+                              checkpoint_path=ck, checkpoint_every=64,
+                              wall_budget_s=0.3))
+    assert not r.converged and 0 < r.n_iter < 500_000
+
+
 def test_explicit_inner_cap_survives_growth(monkeypatch, sv_heavy):
     x, y = sv_heavy
     monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 256); monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 256)
