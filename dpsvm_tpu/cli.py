@@ -119,6 +119,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "C*weight; LIBSVM -w1)")
     tr.add_argument("--weight-neg", type=float, default=1.0,
                     help="cost weight for y=-1 examples (LIBSVM -w-1)")
+    tr.add_argument("--clip", default=None,
+                    choices=["independent", "pairwise"],
+                    help="alpha-step clip rule: 'independent' = the "
+                         "reference's (both alphas clipped separately; "
+                         "lets sum(alpha*y) drift — noticeably at "
+                         "strongly asymmetric class weights), "
+                         "'pairwise' = the textbook/LIBSVM joint box "
+                         "(conserves the equality constraint exactly)")
+    tr.add_argument("--weight", action="append", default=[],
+                    metavar="LABEL:W",
+                    help="per-label cost weight for --multiclass "
+                         "(repeatable; LIBSVM -wi for any label set): "
+                         "each OvO pair trains with C*W on that "
+                         "label's examples; unlisted labels weigh 1")
     tr.add_argument("--selection", default="first-order",
                     choices=["first-order", "second-order"],
                     help="working-set rule: 'first-order' = reference "
@@ -369,9 +383,42 @@ def cmd_train(args: argparse.Namespace) -> int:
             # a +/-1 weight would attach to an arbitrary pseudo-label,
             # not to any actual data class (LIBSVM -wi maps by label).
             print("error: --weight-pos/--weight-neg are binary-problem "
-                  "flags; per-label weighting of multiclass pairs is not "
-                  "supported", file=sys.stderr)
+                  "flags; weight multiclass classes by LABEL with "
+                  "--weight LABEL:W instead", file=sys.stderr)
             return 2
+        if args.weight and args.batched:
+            print("error: --weight needs per-pair box bounds; the "
+                  "batched program shares one weight pair across all "
+                  "subproblems — drop --batched", file=sys.stderr)
+            return 2
+        if args.weight and args.clip == "independent":
+            print("error: --weight trains each pair with the joint "
+                  "(pairwise) alpha update — LIBSVM -wi semantics; "
+                  "the independent clip drifts sum(alpha*y) at "
+                  "asymmetric bounds. Drop --clip independent",
+                  file=sys.stderr)
+            return 2
+    elif args.weight:
+        print("error: --weight maps costs by class LABEL and applies "
+              "to --multiclass; use --weight-pos/--weight-neg for a "
+              "binary problem", file=sys.stderr)
+        return 2
+    # Parse --weight specs HERE: a malformed spec is detectable from
+    # args alone and must fail before the (possibly huge) CSV parse.
+    class_weight = None
+    if args.weight:
+        class_weight = {}
+        for spec in args.weight:
+            label, sep, w = spec.partition(":")
+            try:
+                if not sep:
+                    raise ValueError
+                key = int(label) if "." not in label else float(label)
+                class_weight[key] = float(w)
+            except ValueError:
+                print(f"error: --weight {spec!r} is not LABEL:W "
+                      "(e.g. --weight 3:5.0)", file=sys.stderr)
+                return 2
 
     if not args.cv and not args.model:
         print("error: -m/--model is required (or pass --cv K for "
@@ -418,7 +465,11 @@ def cmd_train(args: argparse.Namespace) -> int:
                      ("--polish", args.polish),
                      ("--pallas on", args.pallas == "on"),
                      ("--weight-pos/--weight-neg",
-                      args.weight_pos != 1.0 or args.weight_neg != 1.0)]
+                      args.weight_pos != 1.0 or args.weight_neg != 1.0),
+                     # these modes' duals live on an equality
+                     # constraint whose VALUE is part of the model;
+                     # they force the conserving pairwise rule
+                     ("--clip independent", args.clip == "independent")]
         if nu_mode:
             conflicts += [("--cv", bool(args.cv)),
                           ("--checkpoint/--resume",
@@ -456,6 +507,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         shrinking=args.shrinking,
         weight_pos=args.weight_pos,
         weight_neg=args.weight_neg,
+        clip=args.clip or "independent",
     )
     if args.multiclass:
         from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
@@ -465,7 +517,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                       else args.probability)
         mc, results = train_multiclass(x, y, config,
                                        probability=proba_mode,
-                                       batched=args.batched)
+                                       batched=args.batched,
+                                       class_weight=class_weight)
         save_multiclass(mc, args.model)
         acc = evaluate_multiclass(mc, x, y)
         if proba_mode:

@@ -57,7 +57,17 @@ class SVMConfig:
     weight_pos: float = 1.0             # class-weighted costs: the box
     weight_neg: float = 1.0             # bound is C*weight_pos for y=+1
                                         # examples, C*weight_neg for y=-1
-                                        # (LIBSVM -wi; imbalanced data)
+                                        # (LIBSVM -wi; imbalanced data).
+                                        # STRONGLY asymmetric weights
+                                        # under the default independent
+                                        # clip let sum(alpha*y) drift
+                                        # far (measured: drift -252.9,
+                                        # b -226.9 vs libsvm's 2.0 at
+                                        # w=(0.3, 2) on a wine pair) —
+                                        # prefer clip="pairwise" (what
+                                        # LIBSVM's solver does; the
+                                        # multiclass class_weight path
+                                        # forces it)
     selection: str = "first-order"      # working-set rule: "first-order"
                                         # (reference parity, svmTrain.cu:
                                         # 476-481) or "second-order" (the

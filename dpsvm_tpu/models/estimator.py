@@ -92,7 +92,8 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                  working_set: int = 2, shrinking: bool = False,
                  polish: bool = False,
                  probability: "Union[bool, str]" = False,
-                 batched: bool = False):
+                 batched: bool = False,
+                 class_weight: "Optional[dict]" = None):
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -111,11 +112,16 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         # program (solver/batched_ovo.py); ignored for binary fits
         # (there is nothing to batch).
         self.batched = batched
+        # sklearn's class_weight dict (LIBSVM -wi): original label ->
+        # cost multiplier. Binary fits map the two classes' weights to
+        # weight_neg/weight_pos; multiclass passes per-label weights
+        # through to every OvO pair (sequential path only).
+        self.class_weight = class_weight
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "tol",
                     "max_iter", "selection", "shards", "matmul_precision",
                     "working_set", "shrinking", "polish", "probability",
-                    "batched")
+                    "batched", "class_weight")
     _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
@@ -144,8 +150,20 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
             "_platt": None, "intercept_": None, "n_support_": None,
         }
         if len(classes) == 2:
+            cfg = self._config()
+            if self.class_weight:
+                from dpsvm_tpu.models.multiclass import (
+                    resolve_class_weight, weighted_binary_config)
+                cw = resolve_class_weight(classes, self.class_weight)
+                # classes[1] maps to +1 below; the shared helper forces
+                # the pairwise clip (LIBSVM -wi semantics — the
+                # independent clip drifts sum(alpha*y) at asymmetric
+                # bounds).
+                cfg = weighted_binary_config(cfg,
+                                             cw.get(classes[1], 1.0),
+                                             cw.get(classes[0], 1.0))
             ypm = np.where(y == classes[1], 1, -1).astype(np.int32)
-            model, result = _fit(X, ypm, self._config())
+            model, result = _fit(X, ypm, cfg)
             state.update(
                 _model=model,
                 n_iter_=result.n_iter,
@@ -159,8 +177,7 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                 from dpsvm_tpu.models.svm import decision_function
                 if self.probability == "cv":
                     # LIBSVM's actual -b 1 procedure (k extra trainings)
-                    state["_platt"] = fit_platt_cv(X, ypm,
-                                                   self._config())
+                    state["_platt"] = fit_platt_cv(X, ypm, cfg)
                 else:
                     dec = np.asarray(decision_function(model, X))
                     state["_platt"] = fit_platt(dec, ypm)
@@ -168,7 +185,7 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
             from dpsvm_tpu.models.multiclass import train_multiclass
             multi, results = train_multiclass(
                 X, y, self._config(), probability=self.probability,
-                batched=self.batched)
+                batched=self.batched, class_weight=self.class_weight)
             state.update(
                 _multi=multi,
                 n_iter_=int(sum(r.n_iter for r in results)),

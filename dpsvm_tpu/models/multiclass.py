@@ -39,12 +39,60 @@ class MulticlassModel:
         return len(self.classes)
 
 
+def resolve_class_weight(classes, class_weight) -> dict:
+    """Validate a user class_weight mapping against the label set.
+
+    ONE copy of the rules for every entry point (train_multiclass, the
+    sklearn estimator): must be a dict-like label -> weight mapping
+    (sklearn's "balanced" string is NOT supported — compute the weights
+    explicitly), and every key must be a label present in y."""
+    if isinstance(class_weight, str) or not hasattr(class_weight, "get"):
+        raise ValueError(
+            f"class_weight must be a dict mapping label -> cost weight; "
+            f"got {class_weight!r} ('balanced' is not supported — "
+            "compute the weights explicitly, e.g. n/(k*bincount))")
+    unknown = {k for k in class_weight if not np.any(classes == k)}
+    if unknown:
+        raise ValueError(
+            f"class_weight has labels not present in y: "
+            f"{sorted(unknown)} (classes: {classes.tolist()})")
+    return dict(class_weight)
+
+
+def weighted_binary_config(config: SVMConfig, w_pos: float,
+                           w_neg: float) -> SVMConfig:
+    """The weighted subproblem's config: C*w_pos on the +1 side,
+    C*w_neg on the -1 side, and ALWAYS the pairwise clip.
+
+    class_weight is DEFINED as LIBSVM's -wi, whose solver does the
+    joint (pairwise) alpha update — semantic, not stylistic: under the
+    reference's independent clip, asymmetric box bounds let
+    sum(alpha*y) drift arbitrarily far (measured on the wine 0-vs-1
+    pair at w=(0.3, 2.0): drift -252.9, intercept -226.9 vs libsvm's
+    2.0 — a converged-but-wrong model), while the pairwise rule
+    conserves the constraint and matches libsvm's b to 1e-3."""
+    import dataclasses
+    cfg = dataclasses.replace(config, clip="pairwise",
+                              weight_pos=float(w_pos),
+                              weight_neg=float(w_neg))
+    cfg.validate()
+    return cfg
+
+
 def train_multiclass(x: np.ndarray, y: np.ndarray,
                      config: Optional[SVMConfig] = None,
                      probability: "Union[bool, str]" = False,
                      batched: bool = False,
+                     class_weight: "Optional[dict]" = None,
                      ) -> Tuple[MulticlassModel, List[TrainResult]]:
     """Train OvO; y may hold any integer labels (2 classes work too).
+
+    ``class_weight``: LIBSVM's ``-wi`` generalized to any label set
+    (sklearn's ``class_weight`` dict): maps original label -> cost
+    multiplier; a pair (a, b) trains with C*w[a] on a's examples and
+    C*w[b] on b's. Labels absent from the mapping weigh 1.0. Sequential
+    path only (the batched program shares one weight pair across all
+    subproblems — rejected loudly, not ignored).
 
     ``probability=True`` fits a per-pair Platt sigmoid on the pair's
     training decision values (the binary --probability simplification,
@@ -78,6 +126,30 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     classes = np.unique(y)
     if len(classes) < 2:
         raise ValueError(f"need at least 2 classes, got {classes}")
+    if class_weight is not None:
+        if batched:
+            raise ValueError(
+                "class_weight needs per-pair box bounds; the batched "
+                "program shares one weight pair across all subproblems "
+                "— train with batched=False")
+        if config.weight_pos != 1.0 or config.weight_neg != 1.0:
+            raise ValueError(
+                "pass either class_weight (per original label) or "
+                "config weight_pos/weight_neg (per pair side), not "
+                "both — ambiguous which applies to a pair")
+        class_weight = resolve_class_weight(classes, class_weight)
+
+    def pair_config(ai: int, bi: int) -> SVMConfig:
+        """The pair's config: C*w[a] on the +1 side, C*w[b] on the -1
+        side, pairwise clip (see weighted_binary_config; numpy label
+        scalars hash-equal their python values, so the user's dict
+        keys look up directly)."""
+        if class_weight is None:
+            return config
+        return weighted_binary_config(
+            config, class_weight.get(classes[ai], 1.0),
+            class_weight.get(classes[bi], 1.0))
+
     if batched:
         from dpsvm_tpu.solver.batched_ovo import (batched_guard,
                                                   ovo_pair_shapes)
@@ -114,7 +186,8 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
             sel = (y == classes[ai]) | (y == classes[bi])
             xs = np.ascontiguousarray(x[sel])
             ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
-            model, result = fit(xs, ys, config)
+            cfg = pair_config(ai, bi)
+            model, result = fit(xs, ys, cfg)
             pairs.append((ai, bi))
             models.append(model)
             results.append(result)
@@ -122,7 +195,7 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
                 from dpsvm_tpu.models.calibration import (fit_platt,
                                                           fit_platt_cv)
                 if probability == "cv":
-                    platt.append(fit_platt_cv(xs, ys, config))
+                    platt.append(fit_platt_cv(xs, ys, cfg))
                 else:
                     dec = np.asarray(decision_function(model, xs))
                     platt.append(fit_platt(dec, ys))
