@@ -398,11 +398,33 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "asymmetric bounds. Drop --clip independent",
                   file=sys.stderr)
             return 2
-    elif args.weight:
+    elif args.weight and not args.cv:
         print("error: --weight maps costs by class LABEL and applies "
-              "to --multiclass; use --weight-pos/--weight-neg for a "
-              "binary problem", file=sys.stderr)
+              "to --multiclass or --cv training; use "
+              "--weight-pos/--weight-neg for a plain binary problem",
+              file=sys.stderr)
         return 2
+    elif args.weight:
+        # --cv: same scope rules as train_multiclass(class_weight=...)
+        if args.batched:
+            print("error: --weight needs per-pair box bounds; the "
+                  "batched program shares one weight pair across all "
+                  "subproblems — drop --batched", file=sys.stderr)
+            return 2
+        if args.svr:
+            print("error: --weight is classification-only (SVR has no "
+                  "classes)", file=sys.stderr)
+            return 2
+        if args.c_sweep is not None:
+            print("error: --weight is not supported with --c-sweep "
+                  "(the batched grid program shares one weight pair)",
+                  file=sys.stderr)
+            return 2
+        if args.clip == "independent":
+            print("error: --weight trains with the joint (pairwise) "
+                  "alpha update — LIBSVM -wi semantics; drop "
+                  "--clip independent", file=sys.stderr)
+            return 2
     # Parse --weight specs HERE: a malformed spec is detectable from
     # args alone and must fail before the (possibly huge) CSV parse.
     class_weight = None
@@ -569,7 +591,8 @@ def cmd_train(args: argparse.Namespace) -> int:
             return 0
         r = cross_validate(x, y, args.cv, config,
                            task="svr" if args.svr else "svc",
-                           batched=args.batched)
+                           batched=args.batched,
+                           class_weight=class_weight)
         if args.svr:
             print(f"Cross Validation ({args.cv}-fold) MSE: "
                   f"{r['mse']:.6f}  MAE: {r['mae']:.6f}  "

@@ -44,11 +44,17 @@ def kfold_assignment(y: np.ndarray, k: int, seed: int = 0,
 def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
                    config: Optional[SVMConfig] = None,
                    task: str = "svc", seed: int = 0,
-                   batched: bool = False) -> dict:
+                   batched: bool = False,
+                   class_weight: "Optional[dict]" = None) -> dict:
     """Pooled held-out predictions over k folds.
 
     task: "svc" (binary or multiclass by label count) or "svr".
     Returns {"predictions", "folds", plus task metrics}.
+
+    ``class_weight``: per-label costs (LIBSVM -wi; see
+    models/multiclass.train_multiclass) applied to every fold's
+    training — classification only, sequential only (the batched
+    program shares one weight pair; SVR has no classes).
 
     ``batched=True`` (classification only) trains every fold's
     subproblems in ONE compiled batched program (solver/batched_ovo.py
@@ -72,6 +78,17 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
         raise ValueError("checkpoint/resume are single-run options; they "
                          "cannot be shared across CV folds")
 
+    if class_weight is not None:
+        if task == "svr":
+            raise ValueError("class_weight is classification-only "
+                             "(SVR has no classes)")
+        if batched:
+            raise ValueError(
+                "class_weight needs per-pair box bounds; the batched "
+                "program shares one weight pair across all subproblems "
+                "— run --cv without batching")
+        from dpsvm_tpu.models.multiclass import resolve_class_weight
+        class_weight = resolve_class_weight(np.unique(y), class_weight)
     if batched and task == "svr":
         raise ValueError(
             "batched CV is classification-only: SVR folds train on "
@@ -109,7 +126,8 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
         elif len(np.unique(y[tr])) > 2:
             from dpsvm_tpu.models.multiclass import (predict_multiclass,
                                                      train_multiclass)
-            mc, _ = train_multiclass(x[tr], y[tr], config)
+            mc, _ = train_multiclass(x[tr], y[tr], config,
+                                     class_weight=class_weight)
             pred[te] = predict_multiclass(mc, x[te])
         else:
             from dpsvm_tpu.api import fit
@@ -124,7 +142,14 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
                     f"({classes!r}) — a class has fewer than {k} members; "
                     "reduce k or rebalance the data")
             ypm = np.where(y[tr] == classes[-1], 1, -1).astype(np.int32)
-            model, _ = fit(x[tr], ypm, config)
+            cfg = config
+            if class_weight is not None:
+                from dpsvm_tpu.models.multiclass import (
+                    weighted_binary_config)
+                cfg = weighted_binary_config(
+                    config, class_weight.get(classes[-1], 1.0),
+                    class_weight.get(classes[0], 1.0))
+            model, _ = fit(x[tr], ypm, cfg)
             p = predict(model, x[te])
             pred[te] = np.where(p > 0, classes[-1], classes[0])
 

@@ -121,6 +121,27 @@ def test_class_weight_guards():
         train_multiclass(x, y, cfg, class_weight={0: -1.0})
 
 
+def test_cv_class_weight_binary_and_multiclass():
+    """cross_validate threads class_weight to every fold (binary fit
+    and per-fold OvO), with the same scope guards."""
+    from dpsvm_tpu.models.cv import cross_validate
+
+    x, y = make_three_class(n_per=45, d=5, seed=6)
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=50_000)
+    r = cross_validate(x, y, 3, cfg, class_weight={3: 4.0})
+    assert r["accuracy"] > 0.8
+    yb = np.where(y == 3, 3, 0).astype(np.int32)   # binary, labels 0/3
+    rb = cross_validate(x, yb, 3, cfg, class_weight={3: 4.0})
+    assert rb["accuracy"] > 0.8
+    with pytest.raises(ValueError, match="batch"):
+        cross_validate(x, y, 3, cfg, batched=True, class_weight={3: 2.0})
+    with pytest.raises(ValueError, match="classification-only"):
+        cross_validate(x, y.astype(np.float32), 3, cfg, task="svr",
+                       class_weight={3: 2.0})
+    with pytest.raises(ValueError, match="not present"):
+        cross_validate(x, y, 3, cfg, class_weight={5: 2.0})
+
+
 def test_estimator_class_weight_binary_and_multiclass():
     from dpsvm_tpu.models.estimator import DPSVMClassifier
 
