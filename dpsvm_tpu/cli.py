@@ -479,10 +479,18 @@ def cmd_train(args: argparse.Namespace) -> int:
         # must be added exactly once.
         mode = modes[0]
         nu_mode = mode in ("--nu-svc", "--nu-svr")
-        conflicts = [("--multiclass", args.multiclass),
+        # nu-SVC composes with --multiclass (LIBSVM -s 1 is OvO for
+        # >2 classes); every other restricted mode still conflicts.
+        nu_multiclass = args.multiclass and mode == "--nu-svc"
+        conflicts = [("--multiclass",
+                      args.multiclass and mode != "--nu-svc"),
+                     # nu-SVC multiclass supports --probability (sigmoid
+                     # on training decisions); --probability-cv stays
+                     # rejected (its held-out refits are C-SVC)
                      ("--probability-cv" if args.probability_cv
                       else "--probability",
-                      args.probability or args.probability_cv),
+                      (args.probability_cv or
+                       (args.probability and not nu_multiclass))),
                      ("--check-kkt", args.check_kkt),
                      ("--polish", args.polish),
                      ("--pallas on", args.pallas == "on"),
@@ -540,7 +548,9 @@ def cmd_train(args: argparse.Namespace) -> int:
         mc, results = train_multiclass(x, y, config,
                                        probability=proba_mode,
                                        batched=args.batched,
-                                       class_weight=class_weight)
+                                       class_weight=class_weight,
+                                       nu=(args.nu if args.nu_svc
+                                           else None))
         save_multiclass(mc, args.model)
         acc = evaluate_multiclass(mc, x, y)
         if proba_mode:

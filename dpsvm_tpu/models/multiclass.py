@@ -84,8 +84,19 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
                      probability: "Union[bool, str]" = False,
                      batched: bool = False,
                      class_weight: "Optional[dict]" = None,
+                     nu: Optional[float] = None,
                      ) -> Tuple[MulticlassModel, List[TrainResult]]:
     """Train OvO; y may hold any integer labels (2 classes work too).
+
+    ``nu``: train every pair as a nu-SVC instead of C-SVC (LIBSVM
+    ``-s 1``, which is OvO for >2 classes — sklearn's NuSVC). nu
+    bounds each pair's margin-error fraction; per-pair feasibility
+    (nu <= 2*min(n_a, n_b)/(n_a+n_b)) is checked by the binary
+    trainer and reported with the failing pair named. Sequential path
+    only; composes with probability=True (sigmoid on training
+    decisions) but not probability="cv" (its held-out refits are
+    C-SVC) and not class_weight (the nu constraint fixes each class's
+    alpha mass).
 
     ``class_weight``: LIBSVM's ``-wi`` generalized to any label set
     (sklearn's ``class_weight`` dict): maps original label -> cost
@@ -126,6 +137,22 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     classes = np.unique(y)
     if len(classes) < 2:
         raise ValueError(f"need at least 2 classes, got {classes}")
+    if nu is not None:
+        if batched:
+            raise ValueError(
+                "nu-SVC multiclass runs the sequential per-pair path "
+                "(the batched program solves the C-SVC iteration); "
+                "train with batched=False")
+        if class_weight is not None:
+            raise ValueError("class weights do not apply to nu-SVC "
+                             "(the nu constraint fixes each class's "
+                             "alpha mass)")
+        if probability == "cv":
+            raise ValueError(
+                "probability='cv' refits held-out C-SVC models, which "
+                "would calibrate a different model class than the "
+                "nu-SVC pairs; use probability=True (sigmoid on "
+                "training decisions)")
     if class_weight is not None:
         if batched:
             raise ValueError(
@@ -187,7 +214,21 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
             xs = np.ascontiguousarray(x[sel])
             ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
             cfg = pair_config(ai, bi)
-            model, result = fit(xs, ys, cfg)
+            if nu is not None:
+                from dpsvm_tpu.models.nusvm import train_nusvc
+                try:
+                    model, result = train_nusvc(xs, ys, nu, cfg)
+                except (ValueError, RuntimeError) as e:
+                    # name the failing pair: infeasible nu raises
+                    # ValueError, a degenerate solution (unseparated
+                    # pair at this nu/gamma) raises RuntimeError —
+                    # both re-raise as ValueError so the CLI's error
+                    # contract (clean message, exit 2) holds
+                    raise ValueError(
+                        f"pair ({classes[ai]}, {classes[bi]}): {e}"
+                    ) from e
+            else:
+                model, result = fit(xs, ys, cfg)
             pairs.append((ai, bi))
             models.append(model)
             results.append(result)

@@ -158,3 +158,73 @@ def test_nusvr_rejects_class_weights_and_checkpoints(tmp_path):
     with pytest.raises(ValueError, match="resume_from"):
         train_nusvr(x, z, 0.5,
                     SVMConfig(resume_from=str(tmp_path / "c.npz")))
+
+
+class TestMulticlassNu:
+    """nu-SVC through the OvO stack (LIBSVM -s 1 for >2 classes)."""
+
+    def test_matches_sklearn_nusvc(self):
+        sklearn_svm = pytest.importorskip("sklearn.svm")
+        from dpsvm_tpu.models.multiclass import (predict_multiclass,
+                                                 train_multiclass)
+        from tests.test_multiclass import make_three_class
+
+        x, y = make_three_class(n_per=50, d=6, seed=8)
+        nu = 0.3
+        ref = sklearn_svm.NuSVC(nu=nu, kernel="rbf", gamma=0.5,
+                                tol=1e-4).fit(x, y)
+        mc, results = train_multiclass(
+            x, y, SVMConfig(gamma=0.5, epsilon=5e-5, max_iter=200_000),
+            nu=nu)
+        assert all(r.converged for r in results)
+        pred = np.asarray(predict_multiclass(mc, x))
+        assert float(np.mean(pred == ref.predict(x))) >= 0.97
+        # per-pair binary equivalence: the pair's model IS train_nusvc's
+        for p, (ai, bi) in enumerate(mc.pairs):
+            sel = (y == mc.classes[ai]) | (y == mc.classes[bi])
+            ys = np.where(y[sel] == mc.classes[ai], 1, -1).astype(np.int32)
+            m_ref, r_ref = train_nusvc(
+                np.ascontiguousarray(x[sel]), ys, nu,
+                SVMConfig(gamma=0.5, epsilon=5e-5, max_iter=200_000))
+            assert r_ref.n_iter == results[p].n_iter
+            assert m_ref.n_sv == results[p].n_sv
+
+    def test_wine_real_data(self):
+        sklearn_svm = pytest.importorskip("sklearn.svm")
+        sklearn_datasets = pytest.importorskip("sklearn.datasets")
+        from dpsvm_tpu.data.scale import ScaleParams
+        from dpsvm_tpu.models.multiclass import (predict_multiclass,
+                                                 train_multiclass)
+
+        ds = sklearn_datasets.load_wine()
+        xr = ds.data.astype(np.float32)
+        y = ds.target.astype(np.int32)
+        x = ScaleParams.fit(xr, lower=0.0, upper=1.0).transform(
+            xr).astype(np.float32)
+        nu = 0.25
+        ref = sklearn_svm.NuSVC(nu=nu, kernel="rbf", gamma=1.0 / 13.0,
+                                tol=1e-4).fit(x, y)
+        mc, results = train_multiclass(
+            x, y, SVMConfig(gamma=1.0 / 13.0, epsilon=5e-5,
+                            max_iter=200_000), nu=nu)
+        assert all(r.converged for r in results)
+        pred = np.asarray(predict_multiclass(mc, x))
+        assert float(np.mean(pred == ref.predict(x))) >= 0.97
+
+    def test_guards(self):
+        from dpsvm_tpu.models.multiclass import train_multiclass
+        from tests.test_multiclass import make_three_class
+
+        x, y = make_three_class(n_per=30, d=4, seed=1)
+        cfg = SVMConfig(max_iter=20_000)
+        with pytest.raises(ValueError, match="batched=False"):
+            train_multiclass(x, y, cfg, nu=0.3, batched=True)
+        with pytest.raises(ValueError, match="class weights"):
+            train_multiclass(x, y, cfg, nu=0.3, class_weight={0: 2.0})
+        with pytest.raises(ValueError, match="probability"):
+            train_multiclass(x, y, cfg, nu=0.3, probability="cv")
+        # infeasible nu names the failing pair
+        ximb = np.vstack([x, x[y == 0][:1] * 0 + 9.0]).astype(np.float32)
+        yimb = np.concatenate([y, [99]]).astype(np.int32)
+        with pytest.raises(ValueError, match=r"pair \(.*99\)"):
+            train_multiclass(ximb, yimb, cfg, nu=0.9)
