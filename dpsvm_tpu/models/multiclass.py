@@ -71,7 +71,6 @@ def weighted_binary_config(config: SVMConfig, w_pos: float,
     pair at w=(0.3, 2.0): drift -252.9, intercept -226.9 vs libsvm's
     2.0 — a converged-but-wrong model), while the pairwise rule
     conserves the constraint and matches libsvm's b to 1e-3."""
-    import dataclasses
     cfg = dataclasses.replace(config, clip="pairwise",
                               weight_pos=float(w_pos),
                               weight_neg=float(w_neg))
@@ -123,9 +122,42 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
-    if config.kernel == "precomputed":
-        raise ValueError(
-            "one-vs-one multiclass does not support the precomputed kernel: each pair trains on a ROW subset, which needs the matching column subset of K; slice K per pair and train binary models instead")
+    precomp = config.kernel == "precomputed"
+    if precomp:
+        # LIBSVM -t 4 with >2 classes: each pair trains on the
+        # (rows, COLUMNS) sub-kernel K[sel][:, sel], and the pair
+        # model's SV indices are remapped to GLOBAL training indices
+        # afterwards so prediction consumes the user's full
+        # K(test, train) like any precomputed binary model.
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[0] != x.shape[1]:
+            raise ValueError(
+                "precomputed multiclass training needs the square "
+                f"(n, n) kernel matrix K(train, train); got {x.shape}")
+        if len(np.asarray(y)) != x.shape[0]:
+            # the flatnonzero+fancy-indexing pair slicing below would
+            # silently train on a row subset for a short y (the
+            # vector-kernel path's boolean mask fails loudly instead)
+            raise ValueError(
+                f"y has {len(np.asarray(y))} labels for a "
+                f"{x.shape[0]}-row kernel matrix")
+        if nu is not None:
+            # reject the GLOBAL incompatibility here, not as a
+            # misleading per-pair error from the first pair's trainer
+            raise ValueError(
+                "nu-SVC does not support the precomputed kernel: use "
+                "a vector kernel (or C-SVC, which supports "
+                "precomputed)")
+        if batched:
+            raise ValueError(
+                "the batched program streams a feature matrix; "
+                "precomputed multiclass runs the sequential per-pair "
+                "path — train with batched=False")
+        if probability == "cv":
+            raise ValueError(
+                "probability='cv' refits on row subsets, which needs "
+                "matching kernel column subsets per fold; use "
+                "probability=True with the precomputed kernel")
     if config.checkpoint_path or config.resume_from:
         # Every pairwise fit would share the one checkpoint file —
         # overwriting each other or failing shape validation mid-run.
@@ -211,7 +243,12 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
     for ai in range(len(classes)):
         for bi in range(ai + 1, len(classes)):
             sel = (y == classes[ai]) | (y == classes[bi])
-            xs = np.ascontiguousarray(x[sel])
+            sel_idx = np.flatnonzero(sel)
+            if precomp:
+                # the pair's SQUARE sub-kernel (rows AND columns)
+                xs = np.ascontiguousarray(x[np.ix_(sel_idx, sel_idx)])
+            else:
+                xs = np.ascontiguousarray(x[sel])
             ys = np.where(y[sel] == classes[ai], 1, -1).astype(np.int32)
             cfg = pair_config(ai, bi)
             if nu is not None:
@@ -229,6 +266,13 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
                     ) from e
             else:
                 model, result = fit(xs, ys, cfg)
+            if precomp:
+                # remap the pair-local SV indices to the full training
+                # set and widen n_train, so this model evaluates
+                # against the user's (m, n) K(test, train) directly
+                model = dataclasses.replace(
+                    model, sv_idx=sel_idx[model.sv_idx],
+                    n_train=x.shape[0])
             pairs.append((ai, bi))
             models.append(model)
             results.append(result)
@@ -238,7 +282,10 @@ def train_multiclass(x: np.ndarray, y: np.ndarray,
                 if probability == "cv":
                     platt.append(fit_platt_cv(xs, ys, cfg))
                 else:
-                    dec = np.asarray(decision_function(model, xs))
+                    # precomputed: the remapped model consumes the
+                    # n-wide rows K[sel] (not the square slice)
+                    xdec = x[sel] if precomp else xs
+                    dec = np.asarray(decision_function(model, xdec))
                     platt.append(fit_platt(dec, ys))
     return MulticlassModel(classes=classes, pairs=pairs,
                            models=models, platt=platt), results

@@ -145,9 +145,8 @@ def test_guards(gram_problem):
     from dpsvm_tpu.models.oneclass import train_oneclass
     with pytest.raises(ValueError, match="precomputed"):
         train_oneclass(K, 0.5, SVMConfig(kernel="precomputed"))
-    from dpsvm_tpu.models.multiclass import train_multiclass
-    with pytest.raises(ValueError, match="precomputed"):
-        train_multiclass(K, y, SVMConfig(kernel="precomputed"))
+    # multiclass precomputed is SUPPORTED as of round 5 (pairs train on
+    # row+column sub-kernels; TestPrecomputedMulticlass below)
     from dpsvm_tpu.models.cv import cross_validate
     with pytest.raises(ValueError, match="precomputed"):
         cross_validate(K, y, 3, SVMConfig(kernel="precomputed"))
@@ -334,3 +333,78 @@ def test_native_roundtrip_preserves_lower_bound_width(gram_problem,
     assert strict.n_train_exact
     with pytest.raises(ValueError, match="columns"):
         decision_function(strict, np.pad(K, ((0, 0), (0, 1))))
+
+
+class TestPrecomputedMulticlass:
+    """LIBSVM -t 4 with >2 classes: pairs train on (rows, columns)
+    sub-kernels; SV indices remap to the full training set so every
+    pair model consumes the user's (m, n) K(test, train)."""
+
+    @staticmethod
+    def _wine_K():
+        sklearn_datasets = pytest.importorskip("sklearn.datasets")
+        from dpsvm_tpu.data.scale import ScaleParams
+
+        ds = sklearn_datasets.load_wine()
+        xr = ds.data.astype(np.float32)
+        y = ds.target.astype(np.int32)
+        x = ScaleParams.fit(xr, lower=0.0, upper=1.0).transform(
+            xr).astype(np.float32)
+        g = 1.0 / 13.0
+        sq = (x * x).sum(1)
+        K = np.exp(-g * (sq[:, None] + sq[None] - 2.0 * x @ x.T))
+        return K.astype(np.float32), x, y, g
+
+    def test_matches_vector_kernel_and_sklearn(self):
+        sklearn_svm = pytest.importorskip("sklearn.svm")
+        from dpsvm_tpu.models.multiclass import (predict_multiclass,
+                                                 train_multiclass)
+
+        K, x, y, g = self._wine_K()
+        cfgv = SVMConfig(c=10.0, gamma=g, epsilon=5e-4, max_iter=50_000)
+        cfgp = SVMConfig(c=10.0, kernel="precomputed", epsilon=5e-4,
+                         max_iter=50_000)
+        mc_v, _ = train_multiclass(x, y, cfgv)
+        mc_p, res_p = train_multiclass(K, y, cfgp)
+        assert all(r.converged for r in res_p)
+        pred_v = np.asarray(predict_multiclass(mc_v, x))
+        pred_p = np.asarray(predict_multiclass(mc_p, K))
+        # same kernel values => near-identical models (f32 rounding of
+        # the host-computed K vs the fused on-device kernel can flip a
+        # boundary tie)
+        assert float(np.mean(pred_p == pred_v)) >= 0.99
+        ref = sklearn_svm.SVC(C=10.0, kernel="precomputed",
+                              tol=1e-3).fit(K, y)
+        assert float(np.mean(pred_p == ref.predict(K))) >= 0.97
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from dpsvm_tpu.models.multiclass import (load_multiclass,
+                                                 predict_multiclass,
+                                                 save_multiclass,
+                                                 train_multiclass)
+
+        K, x, y, g = self._wine_K()
+        mc, _ = train_multiclass(
+            K, y, SVMConfig(c=10.0, kernel="precomputed", epsilon=5e-4,
+                            max_iter=50_000))
+        d = tmp_path / "mcpre"
+        save_multiclass(mc, str(d))
+        mc2 = load_multiclass(str(d))
+        np.testing.assert_array_equal(
+            np.asarray(predict_multiclass(mc, K)),
+            np.asarray(predict_multiclass(mc2, K)))
+
+    def test_guards(self):
+        from dpsvm_tpu.models.multiclass import train_multiclass
+        K, x, y, g = self._wine_K()
+        cfgp = SVMConfig(c=10.0, kernel="precomputed", max_iter=20_000)
+        with pytest.raises(ValueError, match="batched=False"):
+            train_multiclass(K, y, cfgp, batched=True)
+        with pytest.raises(ValueError, match="probability=True"):
+            train_multiclass(K, y, cfgp, probability="cv")
+        with pytest.raises(ValueError, match="square"):
+            train_multiclass(K[:, :50], y, cfgp)
+        with pytest.raises(ValueError, match="labels for a"):
+            train_multiclass(K, y[:100], cfgp)
+        with pytest.raises(ValueError, match="nu-SVC does not support"):
+            train_multiclass(K, y, cfgp, nu=0.3)
