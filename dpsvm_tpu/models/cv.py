@@ -49,7 +49,10 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     """Pooled held-out predictions over k folds.
 
     task: "svc" (binary or multiclass by label count) or "svr".
-    Returns {"predictions", "folds", plus task metrics}.
+    Returns {"predictions", "folds", plus task metrics}. With
+    ``kernel="precomputed"`` x is the (n, n) K(train, train); folds
+    slice (rows, columns) sub-kernels (classification, sequential
+    only).
 
     ``class_weight``: per-label costs (LIBSVM -wi; see
     models/multiclass.train_multiclass) applied to every fold's
@@ -67,9 +70,30 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
-    if config.kernel == "precomputed":
-        raise ValueError(
-            "cross-validation does not support the precomputed kernel: folds subset rows, which needs matching column subsets of K; slice K per fold and train binary models instead")
+    precomp = config.kernel == "precomputed"
+    if precomp:
+        # LIBSVM -v with -t 4: each fold trains on the (rows, COLUMNS)
+        # sub-kernel K[tr][:, tr] and scores held-out rows against
+        # K[te][:, tr] — the same slicing train_multiclass uses per
+        # OvO pair (its models then handle pair slicing themselves).
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[0] != x.shape[1]:
+            raise ValueError(
+                "precomputed CV needs the square (n, n) kernel matrix "
+                f"K(train, train); got {x.shape}")
+        if len(np.asarray(y)) != x.shape[0]:
+            raise ValueError(
+                f"y has {len(np.asarray(y))} labels for a "
+                f"{x.shape[0]}-row kernel matrix")
+        if batched:
+            raise ValueError(
+                "the batched program streams a feature matrix; "
+                "precomputed CV runs the sequential per-fold path — "
+                "run --cv without batching")
+        if task == "svr":
+            raise ValueError(
+                "precomputed CV is classification-only here (SVR "
+                "builds per-fold pseudo-examples; see models/svr.py)")
     x = np.asarray(x, np.float32)
     y = np.asarray(y)
     if task not in ("svc", "svr"):
@@ -119,16 +143,23 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     for f in range(k):
         tr = fold != f
         te = ~tr
+        if precomp:
+            tr_idx = np.flatnonzero(tr)
+            x_tr = np.ascontiguousarray(x[np.ix_(tr_idx, tr_idx)])
+            x_te = np.ascontiguousarray(x[np.ix_(np.flatnonzero(te),
+                                                 tr_idx)])
+        else:
+            x_tr, x_te = x[tr], x[te]
         if task == "svr":
             from dpsvm_tpu.models.svr import predict_svr, train_svr
-            model, _ = train_svr(x[tr], y[tr], config)
-            pred[te] = predict_svr(model, x[te])
+            model, _ = train_svr(x_tr, y[tr], config)
+            pred[te] = predict_svr(model, x_te)
         elif len(np.unique(y[tr])) > 2:
             from dpsvm_tpu.models.multiclass import (predict_multiclass,
                                                      train_multiclass)
-            mc, _ = train_multiclass(x[tr], y[tr], config,
+            mc, _ = train_multiclass(x_tr, y[tr], config,
                                      class_weight=class_weight)
-            pred[te] = predict_multiclass(mc, x[te])
+            pred[te] = predict_multiclass(mc, x_te)
         else:
             from dpsvm_tpu.api import fit
             from dpsvm_tpu.models.svm import predict
@@ -149,8 +180,8 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
                 cfg = weighted_binary_config(
                     config, class_weight.get(classes[-1], 1.0),
                     class_weight.get(classes[0], 1.0))
-            model, _ = fit(x[tr], ypm, cfg)
-            p = predict(model, x[te])
+            model, _ = fit(x_tr, ypm, cfg)
+            p = predict(model, x_te)
             pred[te] = np.where(p > 0, classes[-1], classes[0])
 
     out = {"predictions": pred, "folds": fold, "k": k}

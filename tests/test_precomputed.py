@@ -145,11 +145,14 @@ def test_guards(gram_problem):
     from dpsvm_tpu.models.oneclass import train_oneclass
     with pytest.raises(ValueError, match="precomputed"):
         train_oneclass(K, 0.5, SVMConfig(kernel="precomputed"))
-    # multiclass precomputed is SUPPORTED as of round 5 (pairs train on
-    # row+column sub-kernels; TestPrecomputedMulticlass below)
+    # multiclass and CV precomputed are SUPPORTED as of round 5 (fold/
+    # pair training slices row+column sub-kernels; see
+    # TestPrecomputedMulticlass / test_cv_precomputed); the batched CV
+    # program still streams features and rejects -t 4
     from dpsvm_tpu.models.cv import cross_validate
-    with pytest.raises(ValueError, match="precomputed"):
-        cross_validate(K, y, 3, SVMConfig(kernel="precomputed"))
+    with pytest.raises(ValueError, match="batch"):
+        cross_validate(K, y, 3, SVMConfig(kernel="precomputed"),
+                       batched=True)
     from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
     with pytest.raises(ValueError, match="precomputed"):
         train_nusvc(K, y, 0.3, SVMConfig(kernel="precomputed"))
@@ -408,3 +411,37 @@ class TestPrecomputedMulticlass:
             train_multiclass(K, y[:100], cfgp)
         with pytest.raises(ValueError, match="nu-SVC does not support"):
             train_multiclass(K, y, cfgp, nu=0.3)
+
+
+def test_cv_precomputed_matches_vector_kernel():
+    """LIBSVM -v with -t 4: per-fold (rows, columns) kernel slicing
+    reproduces the vector-kernel CV protocol fold for fold — binary
+    and multiclass."""
+    from dpsvm_tpu.models.cv import cross_validate
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(240, 6)).astype(np.float32)
+    y3 = rng.integers(0, 3, size=240).astype(np.int32)
+    y3 = np.where(x[:, 0] + x[:, 1] > 0.5, 2, y3)     # learnable-ish
+    g = 0.3
+    sq = (x * x).sum(1)
+    K = np.exp(-g * (sq[:, None] + sq[None] - 2.0 * x @ x.T)).astype(
+        np.float32)
+    cfgv = SVMConfig(c=5.0, gamma=g, epsilon=1e-3, max_iter=50_000)
+    cfgp = SVMConfig(c=5.0, kernel="precomputed", epsilon=1e-3,
+                     max_iter=50_000)
+    rv = cross_validate(x, y3, 3, cfgv)
+    rp = cross_validate(K, y3, 3, cfgp)
+    assert np.array_equal(rv["folds"], rp["folds"])
+    agree = float(np.mean(np.asarray(rv["predictions"])
+                          == np.asarray(rp["predictions"])))
+    assert agree >= 0.98, agree                      # boundary ties only
+    yb = np.where(y3 == 2, 1, -1).astype(np.int32)
+    rvb = cross_validate(x, yb, 4, cfgv)
+    rpb = cross_validate(K, yb, 4, cfgp)
+    assert float(np.mean(np.asarray(rvb["predictions"])
+                         == np.asarray(rpb["predictions"]))) >= 0.98
+    with pytest.raises(ValueError, match="labels for a"):
+        cross_validate(K, y3[:100], 3, cfgp)
+    with pytest.raises(ValueError, match="classification-only"):
+        cross_validate(K, y3.astype(np.float32), 3, cfgp, task="svr")
