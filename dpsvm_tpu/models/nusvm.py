@@ -122,9 +122,7 @@ def train_nusvc(x: np.ndarray, y: np.ndarray, nu: float = 0.5,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
-    if config.kernel == "precomputed":
-        raise ValueError(
-            "nu-SVC does not support the precomputed kernel: use a vector kernel (or c-SVC, which supports precomputed)")
+    precomp = config.kernel == "precomputed"
     if not 0.0 < nu <= 1.0:
         raise ValueError(f"nu must be in (0, 1], got {nu}")
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:
@@ -138,6 +136,10 @@ def train_nusvc(x: np.ndarray, y: np.ndarray, nu: float = 0.5,
     if not np.all(np.isin(np.unique(y), (-1, 1))):
         raise ValueError("nu-SVC labels must be +/-1 (binary); for "
                          "multiclass data use models.multiclass")
+    if precomp and x.shape[0] != x.shape[1]:
+        raise ValueError(
+            "precomputed nu-SVC training needs the square (n, n) "
+            f"kernel matrix K(train, train); got {x.shape}")
     n, d = x.shape
     pos = y > 0
     n_pos, n_neg = int(pos.sum()), int((~pos).sum())
@@ -154,15 +156,22 @@ def train_nusvc(x: np.ndarray, y: np.ndarray, nu: float = 0.5,
         idx = np.nonzero(cls)[0]
         alpha0[idx] = _nu_head_seed(half, 1.0, len(idx))
 
-    spec = config.kernel_spec(d)
     yf = np.where(pos, 1.0, -1.0).astype(np.float32)
-    f0 = _stream_kv(x, alpha0 * yf, spec, block=4096)
+    if precomp:
+        # x IS K: seed/threshold gradients are matvecs, no kernel pass
+        f0 = (x @ (alpha0 * yf)).astype(np.float32)
+    else:
+        spec = config.kernel_spec(d)
+        f0 = _stream_kv(x, alpha0 * yf, spec, block=4096)
 
     config = dataclasses.replace(config, c=1.0, clip="pairwise")
     result = _solve_nu(x, yf, alpha0, f0, config)
 
     alpha = np.asarray(result.alpha, np.float32)
-    f = _stream_kv(x, alpha * yf, spec, block=4096)
+    if precomp:
+        f = (x @ (alpha * yf)).astype(np.float32)
+    else:
+        f = _stream_kv(x, alpha * yf, spec, block=4096)
     r1, r2 = _class_thresholds(f, yf, alpha, 1.0)
     r = (r1 + r2) / 2.0
     if not np.isfinite(r) or r <= 0:
@@ -171,14 +180,19 @@ def train_nusvc(x: np.ndarray, y: np.ndarray, nu: float = 0.5,
     rho = (r1 - r2) / 2.0
 
     keep = alpha > 0
+    extra = {}
+    if precomp:
+        extra = dict(sv_idx=np.flatnonzero(keep).astype(np.int64),
+                     n_train=n)
     model = SVMModel(
-        x_sv=np.ascontiguousarray(x[keep]),
+        x_sv=(np.zeros((int(keep.sum()), 0), np.float32) if precomp
+              else np.ascontiguousarray(x[keep])),
         alpha=(alpha[keep] / np.float32(r)),
         y_sv=np.where(pos[keep], 1, -1).astype(np.int32),
         b=float(rho / r),
         gamma=float(config.resolve_gamma(d)),
         kernel=config.kernel, coef0=float(config.coef0),
-        degree=int(config.degree))
+        degree=int(config.degree), **extra)
     result.b = float(rho / r)
     result.n_sv = int(keep.sum())
     return model, result

@@ -41,9 +41,7 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
-    if config.kernel == "precomputed":
-        raise ValueError(
-            "one-class SVM does not support the precomputed kernel: the alpha seed and unshifted f init are defined on vector rows here; use a vector kernel")
+    precomp = config.kernel == "precomputed"
     if not 0.0 < nu < 1.0:
         raise ValueError(f"nu must be in (0, 1), got {nu}")
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:
@@ -52,6 +50,10 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
     x = np.asarray(x, np.float32)
     if x.ndim != 2:
         raise ValueError(f"x must be (n, d), got shape {x.shape}")
+    if precomp and x.shape[0] != x.shape[1]:
+        raise ValueError(
+            "precomputed one-class training needs the square (n, n) "
+            f"kernel matrix K(train, train); got {x.shape}")
     n, d = x.shape
 
     # LIBSVM's init (svm.cpp solve_one_class): sum(alpha0) = nu * n.
@@ -65,8 +67,12 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
         raise ValueError(f"nu={nu} with n={n} initializes no support "
                          "vectors; increase nu or the dataset size")
 
-    spec = config.kernel_spec(d)
-    f0 = _stream_kv(x, alpha0, spec, block=4096)
+    if precomp:
+        # x IS K: the seed gradient is one matvec, no kernel pass
+        f0 = (x @ alpha0).astype(np.float32)
+    else:
+        spec = config.kernel_spec(d)
+        f0 = _stream_kv(x, alpha0, spec, block=4096)
 
     z = np.ones(n, np.int32)
     # c=1 by construction; pairwise clip because the constraint VALUE
@@ -81,8 +87,14 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
 
     alpha = np.asarray(result.alpha, np.float32)
     keep = alpha > 0
+    extra = {}
+    if precomp:
+        # keep SV indices; prediction gathers the user's K(test, train)
+        extra = dict(sv_idx=np.flatnonzero(keep).astype(np.int64),
+                     n_train=n)
     model = SVMModel(
-        x_sv=np.ascontiguousarray(x[keep]),
+        x_sv=(np.zeros((int(keep.sum()), 0), np.float32) if precomp
+              else np.ascontiguousarray(x[keep])),
         alpha=alpha[keep],
         y_sv=np.ones(int(keep.sum()), np.int32),
         b=float(result.b),                    # rho
@@ -91,6 +103,7 @@ def train_oneclass(x: np.ndarray, nu: float = 0.5,
         coef0=float(result.coef0),
         degree=int(result.degree),
         task="oneclass",
+        **extra,
     )
     return model, result
 

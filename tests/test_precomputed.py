@@ -142,9 +142,10 @@ def test_guards(gram_problem):
     with pytest.raises(ValueError, match="precomputed"):
         train_svr(K, y.astype(np.float32),
                   SVMConfig(kernel="precomputed"))
-    from dpsvm_tpu.models.oneclass import train_oneclass
-    with pytest.raises(ValueError, match="precomputed"):
-        train_oneclass(K, 0.5, SVMConfig(kernel="precomputed"))
+    # one-class and nu-SVC precomputed are SUPPORTED as of round 5
+    # (seed gradients become matvecs of K; see
+    # test_oneclass_precomputed_matches_sklearn /
+    # test_nusvc_precomputed_matches_sklearn)
     # multiclass and CV precomputed are SUPPORTED as of round 5 (fold/
     # pair training slices row+column sub-kernels; see
     # TestPrecomputedMulticlass / test_cv_precomputed); the batched CV
@@ -153,9 +154,7 @@ def test_guards(gram_problem):
     with pytest.raises(ValueError, match="batch"):
         cross_validate(K, y, 3, SVMConfig(kernel="precomputed"),
                        batched=True)
-    from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
-    with pytest.raises(ValueError, match="precomputed"):
-        train_nusvc(K, y, 0.3, SVMConfig(kernel="precomputed"))
+    from dpsvm_tpu.models.nusvm import train_nusvr
     with pytest.raises(ValueError, match="precomputed"):
         train_nusvr(K, y.astype(np.float32), 0.3,
                     SVMConfig(kernel="precomputed"))
@@ -445,3 +444,59 @@ def test_cv_precomputed_matches_vector_kernel():
         cross_validate(K, y3[:100], 3, cfgp)
     with pytest.raises(ValueError, match="classification-only"):
         cross_validate(K, y3.astype(np.float32), 3, cfgp, task="svr")
+
+
+def test_oneclass_precomputed_matches_sklearn(gram_problem):
+    from sklearn.svm import OneClassSVM
+
+    from dpsvm_tpu.models.oneclass import (predict_oneclass,
+                                           score_oneclass, train_oneclass)
+
+    x, y, g, K = gram_problem
+    nu = 0.2
+    sk = OneClassSVM(nu=nu, kernel="precomputed", tol=1e-5).fit(K)
+    model, result = train_oneclass(
+        K, nu=nu, config=SVMConfig(kernel="precomputed", epsilon=5e-6,
+                                   max_iter=200_000))
+    assert result.converged
+    assert abs(model.b - float(np.ravel(sk.offset_)[0])) < 5e-3
+    np.testing.assert_allclose(score_oneclass(model, K),
+                               sk.decision_function(K), atol=5e-3)
+    ours, theirs = predict_oneclass(model, K), sk.predict(K)
+    flipped = np.flatnonzero(ours != theirs)
+    assert np.all(np.abs(sk.decision_function(K)[flipped]) < 2e-2)
+    # identical model to the vector-kernel one-class on the same data
+    m_vec, _ = train_oneclass(
+        x, nu=nu, config=SVMConfig(gamma=g, epsilon=5e-6,
+                                   max_iter=200_000))
+    assert abs(model.b - m_vec.b) < 1e-3
+    with pytest.raises(ValueError, match="square"):
+        train_oneclass(K[:, :50], nu=0.2,
+                       config=SVMConfig(kernel="precomputed"))
+
+
+def test_nusvc_precomputed_matches_sklearn(gram_problem):
+    from sklearn.svm import NuSVC
+
+    from dpsvm_tpu.models.nusvm import train_nusvc
+    from dpsvm_tpu.models.svm import decision_function
+
+    x, y, g, K = gram_problem
+    nu = 0.3
+    ref = NuSVC(nu=nu, kernel="precomputed", tol=1e-4).fit(K, y)
+    model, result = train_nusvc(
+        K, y, nu, SVMConfig(kernel="precomputed", epsilon=5e-5,
+                            max_iter=200_000))
+    assert result.converged
+    assert abs(model.n_sv - int(ref.n_support_.sum())) <= max(
+        3, 0.02 * ref.n_support_.sum())
+    np.testing.assert_allclose(np.asarray(decision_function(model, K)),
+                               ref.decision_function(K), atol=1e-2)
+    # identical model to the vector-kernel nu-SVC on the same data
+    m_vec, r_vec = train_nusvc(x, y, nu,
+                               SVMConfig(gamma=g, epsilon=5e-5,
+                                         max_iter=200_000))
+    assert r_vec.n_iter == result.n_iter
+    assert m_vec.n_sv == model.n_sv
+    with pytest.raises(ValueError, match="square"):
+        train_nusvc(K[:, :50], y, nu, SVMConfig(kernel="precomputed"))
