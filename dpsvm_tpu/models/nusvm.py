@@ -209,13 +209,15 @@ def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
-    if config.kernel == "precomputed":
-        raise ValueError(
-            "nu-SVR does not support the precomputed kernel: the 2n-variable dual duplicates every row; use a vector kernel")
+    precomp = config.kernel == "precomputed"
     if not 0.0 < nu <= 1.0:
         raise ValueError(f"nu must be in (0, 1], got {nu}")
     x = np.asarray(x, np.float32)
     z = np.asarray(z, np.float32)
+    if precomp and (x.ndim != 2 or x.shape[0] != x.shape[1]):
+        raise ValueError(
+            "precomputed nu-SVR training needs the square (n, n) "
+            f"kernel matrix K(train, train); got {x.shape}")
     n, d = x.shape
     if z.shape != (n,):
         raise ValueError(f"targets must be ({n},), got {z.shape}")
@@ -229,9 +231,14 @@ def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
     # Doubled problem (see models/svr.py): rows [x; x], pseudo-labels
     # [+1; -1]. f = y_i G_i with G = Qa + p, p = [-z; +z]:
     # f_i = K(a y)_i + y_i p_i = K(a y)_i - z_i  (both halves).
-    x2n = np.concatenate([x, x], axis=0)
+    if precomp:
+        # the 2n pseudo-examples duplicate the original rows: their
+        # kernel matrix is K tiled 2x2 (see models/svr.py)
+        x2n = np.tile(x, (2, 2))
+    else:
+        x2n = np.concatenate([x, x], axis=0)
+        spec = config.kernel_spec(d)
     y_pm = np.concatenate([np.ones(n), -np.ones(n)]).astype(np.float32)
-    spec = config.kernel_spec(d)
     # The seed's kernel term vanishes identically: alpha_j == alpha*_j
     # with opposite pseudo-labels gives coef = seed - seed = 0, so
     # f0 = K@0 - z = -z on both halves — no O(n^2 d) kernel pass needed
@@ -244,7 +251,10 @@ def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
 
     a2 = np.asarray(result.alpha, np.float32)
     delta = a2[:n] - a2[n:]
-    kv = _stream_kv(x, delta, spec, block=4096)
+    if precomp:
+        kv = (x @ delta).astype(np.float32)
+    else:
+        kv = _stream_kv(x, delta, spec, block=4096)
     f = np.concatenate([kv - z, kv - z]).astype(np.float32)
     r1, r2 = _class_thresholds(f, y_pm, a2, np.float32(C))
     # The learned tube half-width -(r1+r2)/2 (LIBSVM's "epsilon = -r",
@@ -253,14 +263,19 @@ def train_nusvr(x: np.ndarray, z: np.ndarray, nu: float = 0.5,
     b = -(r1 - r2) / 2.0
 
     keep = delta != 0
+    extra = {}
+    if precomp:
+        extra = dict(sv_idx=np.flatnonzero(keep).astype(np.int64),
+                     n_train=n)
     model = SVMModel(
-        x_sv=np.ascontiguousarray(x[keep]),
+        x_sv=(np.zeros((int(keep.sum()), 0), np.float32) if precomp
+              else np.ascontiguousarray(x[keep])),
         alpha=np.abs(delta[keep]).astype(np.float32),
         y_sv=np.sign(delta[keep]).astype(np.int32),
         b=float(-b),      # stored so that sum - b == sum + b_intercept
         gamma=float(config.resolve_gamma(d)),
         kernel=config.kernel, coef0=float(config.coef0),
-        degree=int(config.degree), task="svr")
+        degree=int(config.degree), task="svr", **extra)
     result.b = float(b)
     result.n_sv = int(keep.sum())
     result.learned_epsilon = float(eps_eff)

@@ -53,9 +53,7 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
-    if config.kernel == "precomputed":
-        raise ValueError(
-            "epsilon-SVR does not support the precomputed kernel: the 2n-variable dual duplicates every row, which would need the duplicated (2n, 2n) kernel matrix; use a vector kernel")
+    precomp = config.kernel == "precomputed"
     config.validate()
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:
         raise ValueError("class weights are a classification concept; "
@@ -66,6 +64,10 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     y = np.asarray(y, np.float32)
     if x.ndim != 2:
         raise ValueError(f"x must be (n, d), got shape {x.shape}")
+    if precomp and x.shape[0] != x.shape[1]:
+        raise ValueError(
+            "precomputed SVR training needs the square (n, n) kernel "
+            f"matrix K(train, train); got {x.shape}")
     if y.shape != (x.shape[0],):
         raise ValueError(f"y must be ({x.shape[0]},), got {y.shape}")
     n = x.shape[0]
@@ -80,7 +82,13 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     if config.clip == "independent":
         config = dataclasses.replace(config, clip="pairwise")
 
-    x2n = np.vstack([x, x])
+    if precomp:
+        # the 2n pseudo-examples duplicate the original rows, so their
+        # kernel matrix is K tiled 2x2 (4x the K memory — CI/model-
+        # selection scale; vector kernels stream X instead at scale)
+        x2n = np.tile(x, (2, 2))
+    else:
+        x2n = np.vstack([x, x])
     z = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
     f0 = np.concatenate([p - y, -p - y]).astype(np.float32)
 
@@ -91,8 +99,15 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     beta = np.asarray(result.alpha, np.float32)
     delta = beta[:n] - beta[n:]
     keep = delta != 0
+    extra = {}
+    if precomp:
+        # SV indices into the ORIGINAL n rows: prediction gathers the
+        # user's K(test, train) columns like every precomputed model
+        extra = dict(sv_idx=np.flatnonzero(keep).astype(np.int64),
+                     n_train=n)
     model = SVMModel(
-        x_sv=np.ascontiguousarray(x[keep]),
+        x_sv=(np.zeros((int(keep.sum()), 0), np.float32) if precomp
+              else np.ascontiguousarray(x[keep])),
         alpha=np.abs(delta[keep]),
         y_sv=np.sign(delta[keep]).astype(np.int32),
         b=float(result.b),
@@ -101,6 +116,7 @@ def train_svr(x: np.ndarray, y: np.ndarray,
         coef0=float(result.coef0),
         degree=int(result.degree),
         task="svr",
+        **extra,
     )
     return model, result
 

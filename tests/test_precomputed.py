@@ -138,14 +138,10 @@ def test_guards(gram_problem):
     with pytest.raises(ValueError, match="Pallas"):
         SVMConfig(kernel="precomputed", use_pallas="on").validate()
 
-    from dpsvm_tpu.models.svr import train_svr
-    with pytest.raises(ValueError, match="precomputed"):
-        train_svr(K, y.astype(np.float32),
-                  SVMConfig(kernel="precomputed"))
-    # one-class and nu-SVC precomputed are SUPPORTED as of round 5
-    # (seed gradients become matvecs of K; see
-    # test_oneclass_precomputed_matches_sklearn /
-    # test_nusvc_precomputed_matches_sklearn)
+    # The whole LIBSVM task family (-s 0..4) supports -t 4 as of
+    # round 5: one-class/nu-SVC seed gradients become matvecs of K;
+    # SVR/nu-SVR train on the tiled (2n, 2n) pseudo-kernel. See the
+    # test_*_precomputed_matches_sklearn suite below.
     # multiclass and CV precomputed are SUPPORTED as of round 5 (fold/
     # pair training slices row+column sub-kernels; see
     # TestPrecomputedMulticlass / test_cv_precomputed); the batched CV
@@ -154,10 +150,6 @@ def test_guards(gram_problem):
     with pytest.raises(ValueError, match="batch"):
         cross_validate(K, y, 3, SVMConfig(kernel="precomputed"),
                        batched=True)
-    from dpsvm_tpu.models.nusvm import train_nusvr
-    with pytest.raises(ValueError, match="precomputed"):
-        train_nusvr(K, y.astype(np.float32), 0.3,
-                    SVMConfig(kernel="precomputed"))
 
 
 
@@ -500,3 +492,69 @@ def test_nusvc_precomputed_matches_sklearn(gram_problem):
     assert m_vec.n_sv == model.n_sv
     with pytest.raises(ValueError, match="square"):
         train_nusvc(K[:, :50], y, nu, SVMConfig(kernel="precomputed"))
+
+
+@pytest.fixture(scope="module")
+def reg_gram():
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(150, 5)).astype(np.float32)
+    y = (np.sin(x[:, 0]) + 0.5 * x[:, 1]).astype(np.float32)
+    g = 0.2
+    return x, y, g, _rbf_gram(x, g)
+
+
+def test_svr_precomputed_matches_sklearn(reg_gram):
+    from sklearn.svm import SVR
+
+    from dpsvm_tpu.models.svr import predict_svr, train_svr
+
+    x, y, g, K = reg_gram
+    sk = SVR(C=10.0, epsilon=0.05, kernel="precomputed",
+             tol=1e-3).fit(K, y)
+    model, result = train_svr(
+        K, y, SVMConfig(c=10.0, svr_epsilon=0.05, kernel="precomputed",
+                        epsilon=5e-4, max_iter=50_000))
+    assert result.converged
+    np.testing.assert_allclose(predict_svr(model, K), sk.predict(K),
+                               atol=5e-3)
+    assert abs(model.n_sv - len(sk.support_)) <= max(3, 0.05 * len(y))
+    # model identity with the vector-kernel SVR on the same data
+    # (n_iter can differ by a near-tie flip: the host-f32 Gram rounds
+    # differently than the on-device RBF over the long doubled
+    # trajectory)
+    m_vec, r_vec = train_svr(
+        x, y, SVMConfig(c=10.0, svr_epsilon=0.05, gamma=g,
+                        epsilon=5e-4, max_iter=50_000))
+    assert abs(m_vec.n_sv - model.n_sv) <= 2
+    np.testing.assert_allclose(predict_svr(model, K),
+                               predict_svr(m_vec, x), atol=5e-3)
+    with pytest.raises(ValueError, match="square"):
+        train_svr(K[:, :50], y, SVMConfig(kernel="precomputed"))
+
+
+def test_nusvr_precomputed_matches_sklearn(reg_gram):
+    from sklearn.svm import NuSVR
+
+    from dpsvm_tpu.models.nusvm import train_nusvr
+    from dpsvm_tpu.models.svr import predict_svr
+
+    x, y, g, K = reg_gram
+    nu = 0.4
+    sk = NuSVR(C=10.0, nu=nu, kernel="precomputed", tol=1e-4).fit(K, y)
+    model, result = train_nusvr(
+        K, y, nu, SVMConfig(c=10.0, kernel="precomputed",
+                            epsilon=5e-5, max_iter=200_000))
+    assert result.converged
+    np.testing.assert_allclose(predict_svr(model, K), sk.predict(K),
+                               atol=2e-2)
+    # model identity with the vector-kernel nu-SVR on the same data
+    # (same near-tie caveat as the SVR test above)
+    m_vec, r_vec = train_nusvr(
+        x, y, nu, SVMConfig(c=10.0, gamma=g, epsilon=5e-5,
+                            max_iter=200_000))
+    assert abs(m_vec.n_sv - model.n_sv) <= 2
+    assert abs(result.learned_epsilon - r_vec.learned_epsilon) < 1e-3
+    np.testing.assert_allclose(predict_svr(model, K),
+                               predict_svr(m_vec, x), atol=2e-2)
+    with pytest.raises(ValueError, match="square"):
+        train_nusvr(K[:, :50], y, nu, SVMConfig(kernel="precomputed"))
