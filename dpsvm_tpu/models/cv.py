@@ -90,10 +90,6 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
                 "the batched program streams a feature matrix; "
                 "precomputed CV runs the sequential per-fold path — "
                 "run --cv without batching")
-        if task == "svr":
-            raise ValueError(
-                "precomputed CV is classification-only here (SVR "
-                "builds per-fold pseudo-examples; see models/svr.py)")
     x = np.asarray(x, np.float32)
     y = np.asarray(y)
     if task not in ("svc", "svr"):

@@ -434,8 +434,7 @@ def test_cv_precomputed_matches_vector_kernel():
                          == np.asarray(rpb["predictions"]))) >= 0.98
     with pytest.raises(ValueError, match="labels for a"):
         cross_validate(K, y3[:100], 3, cfgp)
-    with pytest.raises(ValueError, match="classification-only"):
-        cross_validate(K, y3.astype(np.float32), 3, cfgp, task="svr")
+    # SVR CV with -t 4 is supported too: test_cv_precomputed_svr_*
 
 
 def test_oneclass_precomputed_matches_sklearn(gram_problem):
@@ -558,3 +557,25 @@ def test_nusvr_precomputed_matches_sklearn(reg_gram):
                                predict_svr(m_vec, x), atol=2e-2)
     with pytest.raises(ValueError, match="square"):
         train_nusvr(K[:, :50], y, nu, SVMConfig(kernel="precomputed"))
+
+
+def test_cv_precomputed_svr_and_estimator(reg_gram):
+    """-v with -t 4 for regression (per-fold sub-kernels feed the SVR
+    trainer), and the sklearn regressor facade on a Gram matrix."""
+    from dpsvm_tpu.models.cv import cross_validate
+    from dpsvm_tpu.models.estimator import DPSVMRegressor
+
+    x, y, g, K = reg_gram
+    cfgv = SVMConfig(c=10.0, svr_epsilon=0.05, gamma=g, epsilon=1e-3,
+                     max_iter=50_000)
+    cfgp = SVMConfig(c=10.0, svr_epsilon=0.05, kernel="precomputed",
+                     epsilon=1e-3, max_iter=50_000)
+    rv = cross_validate(x, y, 3, cfgv, task="svr")
+    rp = cross_validate(K, y, 3, cfgp, task="svr")
+    assert abs(rv["r2"] - rp["r2"]) < 0.02
+    np.testing.assert_allclose(np.asarray(rp["predictions"]),
+                               np.asarray(rv["predictions"]), atol=0.05)
+
+    reg = DPSVMRegressor(C=10.0, epsilon=0.05, kernel="precomputed",
+                         tol=1e-3).fit(K, y)
+    assert reg.score(K, y) > 0.99
