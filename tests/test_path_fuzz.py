@@ -55,11 +55,20 @@ def test_all_paths_land_on_the_classic_model(seed):
     ref_model = SVMModel.from_train_result(x, y, ref)
     ref_acc = evaluate(ref_model, x, y)
 
-    for name, kw in PATHS.items():
-        r = train(x, y, SVMConfig(**base, **kw))
+    # precomputed arm: the same problem as its Gram matrix must land on
+    # the same model (kernel values identical up to host-f32 rounding)
+    sq = (x * x).sum(1)
+    K = np.exp(-gamma * (sq[:, None] + sq[None] - 2.0 * x @ x.T)
+               ).astype(np.float32)
+    paths = dict(PATHS)
+    paths["precomp"] = dict(kernel="precomputed")
+
+    for name, kw in paths.items():
+        xin = K if name == "precomp" else x
+        r = train(xin, y, SVMConfig(**base, **kw))
         assert r.converged, f"seed {seed} path {name}: unconverged"
-        model = SVMModel.from_train_result(x, y, r)
-        acc = evaluate(model, x, y)
+        model = SVMModel.from_train_result(xin, y, r)
+        acc = evaluate(model, xin, y)
         # Looser than the LibSVM-parity 2%: paths stop anywhere inside
         # the same 2*eps gap, and which marginal points carry an
         # eps-level alpha there is trajectory-dependent; the binding
@@ -74,7 +83,7 @@ def test_all_paths_land_on_the_classic_model(seed):
         # trajectory — config.py's documented semantic), so the
         # decision-surface check is prediction agreement, not b.
         from dpsvm_tpu.models.svm import predict
-        agree = float(np.mean(np.asarray(predict(model, x))
+        agree = float(np.mean(np.asarray(predict(model, xin))
                               == np.asarray(predict(ref_model, x))))
         assert agree >= 0.99, (
             f"seed {seed} path {name}: prediction agreement {agree}")
