@@ -87,6 +87,20 @@ def test_batched_capped_budget_freezes_per_pair():
         assert rb.n_iter == 40
 
 
+def test_batched_wall_budget_stops_with_consistent_state(monkeypatch):
+    """A tight wall budget stops the batched program at chunk
+    granularity; the returned (n_iter, b) describe the carry actually
+    returned (the in-flight speculative chunk is polled, not silently
+    run), so per-pair results stay internally consistent."""
+    x, y = make_three_class(n_per=80, d=6, seed=5)
+    cfg = _cfg(max_iter=200_000, epsilon=1e-7, chunk_iters=8,
+               wall_budget_s=1e-9)
+    _, r_bat = train_multiclass(x, y, cfg, batched=True)
+    assert any(not rb.converged for rb in r_bat)
+    assert all(rb.n_iter <= 16 for rb in r_bat), [rb.n_iter
+                                                 for rb in r_bat]
+
+
 def test_batched_guard_table():
     x, y = make_three_class(n_per=30, d=4, seed=1)
     for bad in (dict(selection="second-order"), dict(weight_pos=2.0),
