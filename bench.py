@@ -16,6 +16,13 @@ Prints exactly ONE JSON line on stdout:
      "iter/s", "vs_baseline": ...}
 Diagnostics go to stderr. Override the shape with BENCH_N / BENCH_D /
 BENCH_ITERS env vars.
+
+Provenance: alongside the JSON line, a run-telemetry trace
+(docs/OBSERVABILITY.md) is written to $BENCH_TRACE_OUT (default
+benchmarks/results/traces/bench_headline.jsonl; set it empty to
+disable) — warmup + measure chunk records and an it/s summary, so a
+driver-verified BENCH window carries the gap trajectory and device
+facts that produced its number.
 """
 
 from __future__ import annotations
@@ -91,29 +98,68 @@ def main() -> None:
     # MNIST benchmark hyperparameters (README.md:23).
     runner = _build_chunk_runner(10.0, 0.25, 1e-3, False, precision)
 
+    from dpsvm_tpu.solver.driver import read_stats
+
     with timer.phase("compile+warmup"):
-        carry, _ = runner(carry, xd, yd, x2, jnp.int32(warmup_iters))
+        carry, stats = runner(carry, xd, yd, x2, jnp.int32(warmup_iters))
         jax.block_until_ready(carry.f)
-    it0 = int(carry.n_iter)
+    warm = read_stats(stats)
+    it0 = warm.n_iter
     if it0 < warmup_iters:
         # Tiny problems converge inside warmup: measure a fresh full run
         # to convergence instead of an already-exhausted carry.
         log(f"WARNING: converged during warmup after {it0} iters; "
             "measuring a fresh run to convergence")
         carry = init_carry(y, cache_lines=0)
+        warm = None
         it0 = 0
 
     with timer.phase("measure"):
         t0 = time.perf_counter()
-        carry, _ = runner(carry, xd, yd, x2, jnp.int32(it0 + measure_iters))
+        carry, stats = runner(carry, xd, yd, x2,
+                              jnp.int32(it0 + measure_iters))
         jax.block_until_ready(carry.f)
         dt = time.perf_counter() - t0
-    iters = int(carry.n_iter) - it0
+    st = read_stats(stats)      # same packed transfer the driver polls
+    iters = st.n_iter - it0
 
     rate = iters / dt if dt > 0 else 0.0
     log(f"phases: {timer.summary()}")
     log(f"{iters} iters in {dt:.3f}s on ({n}x{d}) -> {rate:.1f} iter/s "
-        f"(gap: b_lo={float(carry.b_lo):.4f} b_hi={float(carry.b_hi):.4f})")
+        f"(gap: b_lo={st.b_lo:.4f} b_hi={st.b_hi:.4f})")
+
+    # Provenance trace alongside the JSON line (see module docstring).
+    trace_path = os.environ.get("BENCH_TRACE_OUT")
+    if trace_path is None:
+        trace_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+            "results", "traces", "bench_headline.jsonl")
+    if trace_path:
+        from dpsvm_tpu.solver.driver import trace_env
+        from dpsvm_tpu.telemetry import RunTrace
+        os.makedirs(os.path.dirname(trace_path), exist_ok=True)
+        trace = RunTrace(
+            trace_path,
+            config={"kernel": "rbf", "c": 10.0, "gamma": 0.25,
+                    "epsilon": 1e-3, "shards": 1, "shard_x": True,
+                    "matmul_precision": precision.lower(),
+                    "max_iter": it0 + measure_iters},
+            n=n, d=d, gamma=0.25, solver="bench-smo", it0=it0,
+            env=trace_env())
+        if warm is not None:
+            trace.chunk(n_iter=warm.n_iter, b_lo=warm.b_lo,
+                        b_hi=warm.b_hi, n_sv=warm.n_sv, window="warmup")
+        trace.chunk(n_iter=st.n_iter, b_lo=st.b_lo, b_hi=st.b_hi,
+                    n_sv=st.n_sv, phases=dict(timer.seconds),
+                    window="measure")
+        trace.summary(converged=not (st.b_lo > st.b_hi + 2e-3),
+                      n_iter=st.n_iter, b=(st.b_lo + st.b_hi) / 2.0,
+                      b_lo=st.b_lo, b_hi=st.b_hi, n_sv=st.n_sv,
+                      train_seconds=dt, phases=dict(timer.seconds),
+                      metric="smo_iters_per_sec_mnist_scale")
+        trace.close()
+        log(f"trace: {trace_path}")
+
     print(json.dumps({
         "metric": "smo_iters_per_sec_mnist_scale",
         "value": round(rate, 1),

@@ -35,6 +35,10 @@ Environment:
                       (solver/shrink.py; composes with the above)
     BENCH_PALLAS      auto (default) | on — 'on' with BENCH_WORKING_SET
                       selects the Pallas inner-subsolve kernel
+    BENCH_TRACE_OUT   write the run-telemetry trace here
+                      (docs/OBSERVABILITY.md; unset = no trace. The
+                      burst runner sets this per sweep tag so every
+                      recorded row carries its provenance trace.)
 """
 
 from __future__ import annotations
@@ -139,13 +143,21 @@ def main() -> None:
     # returns a partial rate row instead of being timeout-killed with
     # no number (the burst runner sets the config field directly).
     wall_budget = float(os.environ.get("BENCH_WALL_BUDGET", 0) or 0)
+    # Run-telemetry trace (docs/OBSERVABILITY.md): rejected by validate
+    # with polish (two runs, one file) — drop it there rather than fail
+    # a sweep arm over provenance.
+    trace_out = os.environ.get("BENCH_TRACE_OUT") or None
+    if trace_out and polish:
+        log("BENCH_TRACE_OUT ignored: polish is a two-run schedule")
+        trace_out = None
     config = SVMConfig(c=c, gamma=gamma, epsilon=eps, max_iter=max_iter,
                        matmul_precision=precision, selection=selection,
                        working_set=working_set, inner_iters=inner_iters,
                        grow_working_set=grow,
                        shrinking=shrinking, use_pallas=use_pallas,
                        polish=polish, verbose=verbose, chunk_iters=8192,
-                       wall_budget_s=wall_budget)
+                       wall_budget_s=wall_budget,
+                       trace_out=trace_out)
 
     print(json.dumps(convergence_run(x, y, config)), flush=True)
 

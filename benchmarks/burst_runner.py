@@ -19,6 +19,12 @@ written here carry ``"runner": "burst"`` for provenance. The shell
 sweeps remain the backstop: re-invoked after this runner, they skip
 every tag it recorded.
 
+Provenance: every tag's run-telemetry trace (docs/OBSERVABILITY.md) is
+archived under ``<results dir>/traces/<tag>.jsonl`` — conv tags via
+``SVMConfig.trace_out``, subprocess tags via ``BENCH_TRACE_OUT`` — so
+a recorded row's gap trajectory, phase split and device facts survive
+the window (``dpsvm report`` renders them).
+
 Wall budgets: each conv tag trains with ``SVMConfig.wall_budget_s`` so
 an over-projection returns a partial row (rate evidence) instead of
 eating the window. A budget-stopped row (unconverged below its
@@ -237,6 +243,15 @@ def standin_cached(n, d, gamma):
     return _DATA[key]
 
 
+def trace_path_for(spec):
+    """Archive path for a tag's run-telemetry trace: a traces/ dir next
+    to the tag's results ledger (benchmarks/results/traces/ for the
+    real backlog; the test harness's tmp dir follows its tags file).
+    Re-runs overwrite — the trace documents the RECORDED attempt."""
+    return os.path.join(os.path.dirname(spec["file"]), "traces",
+                        f"{spec['tag']}.jsonl")
+
+
 def run_conv(spec):
     """(rc, measurement-json-lines, stderr-tail) for an in-process
     convergence tag."""
@@ -246,12 +261,17 @@ def run_conv(spec):
     from dpsvm_tpu.config import SVMConfig
 
     x, y = standin_cached(spec["n"], spec["d"], spec["gamma"])
+    trace = trace_path_for(spec)
+    os.makedirs(os.path.dirname(trace), exist_ok=True)
     kw = dict(c=spec["c"], gamma=spec["gamma"], epsilon=1e-3,
               max_iter=spec["max_iter"],
               matmul_precision=spec["precision"],
               chunk_iters=8192, verbose=True,
-              wall_budget_s=float(spec["budget"]))
+              wall_budget_s=float(spec["budget"]),
+              trace_out=trace)
     kw.update(spec["cfg"])          # spec cfg wins, incl. overrides
+    if kw.get("polish"):
+        kw["trace_out"] = None      # polish = two runs, one file: no trace
     config = SVMConfig(**kw)
     tee = _Tee(sys.stderr)
     with contextlib.redirect_stderr(tee):
@@ -283,6 +303,8 @@ def _run_sub_inner(spec):
     env = dict(os.environ)
     # Pin the ambient knobs exactly like sweep_lib.sh's run() so a
     # leftover export can never relabel a recorded measurement.
+    trace = trace_path_for(spec)
+    os.makedirs(os.path.dirname(trace), exist_ok=True)
     env.update({"BENCH_GEN": "planted", "BENCH_DATA": "",
                 "BENCH_SELECTION": "first-order", "BENCH_EPS": "1e-3",
                 "BENCH_WORKING_SET": "2", "BENCH_INNER_ITERS": "0",
@@ -290,7 +312,11 @@ def _run_sub_inner(spec):
                 "BENCH_MAX_ITER": "400000", "BENCH_POLISH": "",
                 "BENCH_NO_MEMO": "", "BENCH_VERBOSE": "1",
                 "BENCH_PLATFORM": "", "BENCH_WALL_BUDGET": "",
-                "BENCH_GROW": ""})
+                "BENCH_GROW": "",
+                # provenance trace archived next to the results ledger
+                # (consumed by bench.py / bench_convergence.py; inert
+                # for harnesses that don't trace)
+                "BENCH_TRACE_OUT": trace})
     env.update(spec["env"])
     env.setdefault("BENCH_STALL_TIMEOUT",
                    os.environ.get("BENCH_STALL_TIMEOUT", "420"))

@@ -19,6 +19,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import math
 import sys
 from typing import List, Optional
 
@@ -95,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="resume training from a checkpoint file")
     tr.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace here")
+    tr.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a run-telemetry JSONL here (manifest + "
+                         "per-chunk gap/SV-count/cache-counter records "
+                         "+ summary — zero extra device polls; render "
+                         "with `dpsvm report PATH`, schema in "
+                         "docs/OBSERVABILITY.md)")
     tr.add_argument("--debug-nans", action="store_true",
                     help="enable jax_debug_nans during training")
     tr.add_argument("--precision", default="highest",
@@ -114,10 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "solve, then an exact-f32 warm-start refinement "
                          "to the same epsilon — exact-arithmetic final "
                          "KKT at near-bf16 wall-clock")
-    tr.add_argument("--weight-pos", type=float, default=1.0,
+    tr.add_argument("--weight-pos", type=_finite_weight, default=1.0,
                     help="cost weight for y=+1 examples (box bound "
                          "C*weight; LIBSVM -w1)")
-    tr.add_argument("--weight-neg", type=float, default=1.0,
+    tr.add_argument("--weight-neg", type=_finite_weight, default=1.0,
                     help="cost weight for y=-1 examples (LIBSVM -w-1)")
     tr.add_argument("--clip", default=None,
                     choices=["independent", "pairwise"],
@@ -288,6 +295,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="seconds to wait for backend initialization "
                           "before reporting it unreachable (a tunneled "
                           "TPU that is down would otherwise hang here)")
+
+    rp = sub.add_parser(
+        "report", help="render a run-telemetry trace (train "
+                       "--trace-out): convergence curve, phase "
+                       "breakdown, cache hit rate, throughput")
+    rp.add_argument("trace", help="trace JSONL written by --trace-out "
+                                  "(or BENCH_TRACE_OUT)")
+    rp.add_argument("--json", action="store_true",
+                    help="machine-readable digest instead of the human "
+                         "rendering")
+    rp.add_argument("--width", type=int, default=60,
+                    help="plot width in columns")
     return root
 
 
@@ -307,6 +326,21 @@ def _shrinking_value(v: str):
         return "auto"
     raise argparse.ArgumentTypeError(
         f"--shrinking takes 0, 1 or auto, got {v!r}")
+
+
+def _finite_weight(v: str) -> float:
+    """Class weights must be finite and > 0 — rejected at parse time,
+    before the (possibly huge) dataset load. ``float`` alone accepts
+    'nan'/'inf', and NaN sails through every downstream `<= 0`
+    comparison (ADVICE r5)."""
+    try:
+        w = float(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"{v!r} is not a number")
+    if not (math.isfinite(w) and w > 0):
+        raise argparse.ArgumentTypeError(
+            f"class weights must be finite and > 0, got {v}")
+    return w
 
 
 def _kernel_name(v: str) -> str:
@@ -378,6 +412,11 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "they cannot be shared across the pairwise multiclass "
                   "subproblems", file=sys.stderr)
             return 2
+        if args.trace_out:
+            print("error: --trace-out records ONE training run; the "
+                  "pairwise multiclass subproblems would each overwrite "
+                  "it", file=sys.stderr)
+            return 2
         if args.weight_pos != 1.0 or args.weight_neg != 1.0:
             # In OvO, '+1' is just the lower-sorted label of each pair —
             # a +/-1 weight would attach to an arbitrary pseudo-label,
@@ -436,7 +475,15 @@ def cmd_train(args: argparse.Namespace) -> int:
                 if not sep:
                     raise ValueError
                 key = int(label) if "." not in label else float(label)
-                class_weight[key] = float(w)
+                wv = float(w)
+                # same finite-and-positive contract as --weight-pos/-neg
+                # (SVMConfig.validate would catch it per pair, but only
+                # after the dataset parse and k-1 trainings)
+                if not (math.isfinite(wv) and wv > 0):
+                    print(f"error: --weight {spec!r}: weights must be "
+                          "finite and > 0", file=sys.stderr)
+                    return 2
+                class_weight[key] = wv
             except ValueError:
                 print(f"error: --weight {spec!r} is not LABEL:W "
                       "(e.g. --weight 3:5.0)", file=sys.stderr)
@@ -461,7 +508,9 @@ def cmd_train(args: argparse.Namespace) -> int:
                  " (CV dispatches to one-vs-one automatically when the "
                  "labels have more than two classes)"),
                 ("--checkpoint/--resume",
-                 bool(args.checkpoint or args.resume), "")):
+                 bool(args.checkpoint or args.resume), ""),
+                ("--trace-out", bool(args.trace_out),
+                 " (it records one run; folds would overwrite it)")):
             if on:
                 print(f"error: {flag} does not apply to --cv mode{hint}",
                       file=sys.stderr)
@@ -525,6 +574,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         checkpoint_every=args.checkpoint_every,
         resume_from=args.resume,
         profile_dir=args.profile_dir,
+        trace_out=args.trace_out,
         debug_nans=args.debug_nans,
         matmul_precision=args.precision,
         polish=args.polish,
@@ -988,6 +1038,29 @@ def cmd_info(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_report(args: argparse.Namespace) -> int:
+    """Render a run-telemetry trace. Pure file I/O — no backend init,
+    so it works on a machine with no accelerator (or a dead tunnel)."""
+    import json
+
+    from dpsvm_tpu.telemetry import (load_trace, render_report,
+                                     summarize_trace)
+
+    try:
+        records = load_trace(args.trace)
+    except FileNotFoundError:
+        print(f"error: no such trace: {args.trace}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summarize_trace(records)))
+    else:
+        print(render_report(records, width=max(int(args.width), 20)))
+    return 0
+
+
 def _init_backend(args: argparse.Namespace) -> int:
     """Apply --platform/DPSVM_PLATFORM and fail fast on a dead backend.
 
@@ -1031,6 +1104,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_scale(args)
         if args.command == "info":
             return cmd_info(args)
+        if args.command == "report":
+            return cmd_report(args)
         return cmd_test(args)
     except FileNotFoundError as e:
         print(f"error: file not found: {e}", file=sys.stderr)

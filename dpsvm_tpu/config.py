@@ -28,6 +28,7 @@ inferred from the data and never part of the config.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional
 
 # Sentinel used by the reference for masked I-set scores
@@ -179,6 +180,14 @@ class SVMConfig:
     checkpoint_every: int = 0               # iterations between saves (0=off)
     resume_from: Optional[str] = None       # checkpoint to resume from
     profile_dir: Optional[str] = None       # jax.profiler trace output dir
+    trace_out: Optional[str] = None         # run-telemetry JSONL path:
+                                            # manifest + per-chunk records
+                                            # (gap, SV count, cache
+                                            # counters — all riding the
+                                            # one packed-stats transfer,
+                                            # zero extra D2H) + summary;
+                                            # render with `dpsvm report`
+                                            # (docs/OBSERVABILITY.md)
     debug_nans: bool = False                # jax_debug_nans during training
 
     def fused_incompatibility(self) -> Optional[str]:
@@ -268,8 +277,13 @@ class SVMConfig:
         if self.wall_budget_s < 0:
             raise ValueError(
                 f"wall_budget_s must be >= 0, got {self.wall_budget_s}")
-        if self.weight_pos <= 0 or self.weight_neg <= 0:
-            raise ValueError("class weights must be > 0, got "
+        # Finite AND positive: `w <= 0` alone lets NaN through (every
+        # NaN comparison is False) and inf past the positivity check —
+        # either would poison the box bound silently (ADVICE r5).
+        if not (math.isfinite(self.weight_pos) and self.weight_pos > 0
+                and math.isfinite(self.weight_neg)
+                and self.weight_neg > 0):
+            raise ValueError("class weights must be > 0 and finite, got "
                              f"({self.weight_pos}, {self.weight_neg})")
         if self.svr_epsilon < 0:
             raise ValueError(
@@ -354,7 +368,12 @@ class SVMConfig:
                     ("checkpoint_path", bool(self.checkpoint_path),
                      "the two-phase schedule is not one replayable "
                      "trajectory; checkpoint the fast phase, then "
-                     "polish")):
+                     "polish"),
+                    ("trace_out", bool(self.trace_out),
+                     "the two-phase schedule is two runs, not one "
+                     "trajectory — one trace file would be overwritten "
+                     "by the refinement phase; trace each phase "
+                     "separately via warm_start")):
                 if bad:
                     raise ValueError(f"polish does not support {field}: "
                                      f"{what}")
@@ -492,6 +511,7 @@ class SVMConfig:
                 ("checkpoint_every", self.checkpoint_every),
                 ("resume_from", self.resume_from),
                 ("profile_dir", self.profile_dir),
+                ("trace_out", self.trace_out),
                 ("wall_budget_s", self.wall_budget_s)) if v]
             if unsupported:
                 raise ValueError(

@@ -28,8 +28,8 @@ from dpsvm_tpu.experimental.fused_step import (DEFAULT_BLOCK_N, FusedCarry,
                                       fused_smo_body, pad_to_block)
 from dpsvm_tpu.ops.kernels import row_norms_sq
 from dpsvm_tpu.ops.selection import masked_extrema
-from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
-                                     resume_state)
+from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
+                                     pack_stats, resume_state)
 
 
 def _should_interpret() -> bool:
@@ -95,7 +95,8 @@ def _run_chunk(carry: FusedCarry, x, x2, y, limit, *, c, gamma, epsilon,
     progressed = (final.n_iter > carry.n_iter) | (final.n_iter == 0)
     out = lax.cond(converged & progressed & (final.n_iter < max_iter),
                    trailing, lambda s: s, final)
-    return out, pack_stats(out.n_iter, out.b_lo, out.b_hi)
+    return out, pack_stats(out.n_iter, out.b_lo, out.b_hi,
+                           n_sv=device_sv_count(out.alpha))
 
 
 def init_fused_carry(alpha, f, y, c: float) -> FusedCarry:

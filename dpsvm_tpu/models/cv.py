@@ -51,8 +51,12 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     task: "svc" (binary or multiclass by label count) or "svr".
     Returns {"predictions", "folds", plus task metrics}. With
     ``kernel="precomputed"`` x is the (n, n) K(train, train); folds
-    slice (rows, columns) sub-kernels (classification, sequential
-    only).
+    slice (rows, columns) sub-kernels. This works for BOTH tasks —
+    classification and SVR (the SVR wrapper consumes the fold's
+    sub-kernel like any other precomputed problem; locked in by
+    tests/test_cv.py::test_cv_svr_precomputed_kernel) — but only on
+    the sequential per-fold path: the batched program streams a
+    feature matrix and rejects precomputed below.
 
     ``class_weight``: per-label costs (LIBSVM -wi; see
     models/multiclass.train_multiclass) applied to every fold's
@@ -97,6 +101,10 @@ def cross_validate(x: np.ndarray, y: np.ndarray, k: int,
     if config.checkpoint_path or config.resume_from:
         raise ValueError("checkpoint/resume are single-run options; they "
                          "cannot be shared across CV folds")
+    if config.trace_out:
+        raise ValueError("trace_out records ONE training run; CV folds "
+                         "would each overwrite it — trace a single fit "
+                         "instead")
 
     if class_weight is not None:
         if task == "svr":

@@ -33,6 +33,13 @@ class RowCache(NamedTuple):
     stamps: jax.Array   # (lines,) int32 last-use tick
     rows: jax.Array     # (lines, n) float32 dot products
     tick: jax.Array     # () int32
+    # Lifetime outcome counters, accumulated on device so they ride the
+    # driver's packed-stats transfer (zero extra D2H polls — the
+    # reference only ever exposed its hit rate through a commented-out
+    # printf, svmTrain.cu margins; see docs/OBSERVABILITY.md). One pair
+    # fetch = 2 lookups, so hits + misses == 2 * fetches.
+    hits: jax.Array     # () int32
+    misses: jax.Array   # () int32
 
 
 def cache_init(lines: int, n: int, dtype=None) -> RowCache:
@@ -44,6 +51,8 @@ def cache_init(lines: int, n: int, dtype=None) -> RowCache:
         stamps=np.zeros((lines,), dtype=np.int32),
         rows=np.zeros((lines, n), dtype=np.dtype(dtype or np.float32)),
         tick=np.int32(0),
+        hits=np.int32(0),
+        misses=np.int32(0),
     )
 
 
@@ -63,11 +72,14 @@ def cache_fetch(cache: RowCache, key: jax.Array,
     line = jnp.where(hit, jnp.argmax(hit_mask), jnp.argmin(cache.stamps))
     row = lax.cond(hit, lambda: cache.rows[line], compute)
     tick = cache.tick + 1
+    h = hit.astype(jnp.int32)
     return row, RowCache(
         keys=cache.keys.at[line].set(key),
         stamps=cache.stamps.at[line].set(tick),
         rows=cache.rows.at[line].set(row),
         tick=tick,
+        hits=cache.hits + h,
+        misses=cache.misses + (1 - h),
     )
 
 
@@ -123,4 +135,10 @@ def cache_fetch_pair(cache: RowCache, key_a: jax.Array, key_b: jax.Array,
     keys = cache.keys.at[line_a].set(key_a).at[line_b].set(key_b)
     stamps = cache.stamps.at[line_a].set(tick).at[line_b].set(tick)
     new_rows = cache.rows.at[line_a].set(rows[0]).at[line_b].set(rows[1])
-    return rows, RowCache(keys=keys, stamps=stamps, rows=new_rows, tick=tick)
+    # Per-key outcome counters: 2 lookups per pair fetch (the i_hi ==
+    # i_lo corner counts b's shared line as a hit, like the reference's
+    # second lookup_cache of the same key would).
+    nh = hit_a.astype(jnp.int32) + hit_b.astype(jnp.int32)
+    return rows, RowCache(keys=keys, stamps=stamps, rows=new_rows,
+                          tick=tick, hits=cache.hits + nh,
+                          misses=cache.misses + (2 - nh))

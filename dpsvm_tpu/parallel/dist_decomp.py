@@ -52,10 +52,11 @@ from dpsvm_tpu.ops.selection import masked_scores_and_masks
 from dpsvm_tpu.parallel.dist_smo import (_local_slice,
                                          prepare_distributed_inputs)
 from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
+                                     pcast_varying, shard_map_compat,
                                      to_host)
 from dpsvm_tpu.solver.decomp import inner_subsolve
-from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
-                                     resume_state)
+from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
+                                     pack_stats, resume_state)
 
 
 class DistDecompCarry(NamedTuple):
@@ -64,6 +65,8 @@ class DistDecompCarry(NamedTuple):
     b_hi: jax.Array     # () replicated-equal
     b_lo: jax.Array     # ()
     n_iter: jax.Array   # () i32 cumulative inner pair-updates
+    rounds: jax.Array   # () i32 outer rounds (telemetry, rides the
+                        # packed stats — solver/decomp.DecompCarry)
 
 
 def _merged_top(vals_l, gidx_l, k):
@@ -172,18 +175,13 @@ def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
     # Every seed field is replicated-equal across shards by
     # construction, but shard_map's VMA typing tags psum-derived values
     # as axis-varying; the while_loop carry must enter with uniformly-
-    # varying types (pcast rejects already-varying leaves, hence the
-    # guard).
-    def _to_varying(v):
-        try:
-            return lax.pcast(v, (SHARD_AXIS,), to="varying")
-        except ValueError:
-            return v
-
+    # varying types (pcast_varying passes already-varying leaves
+    # through, and is the identity on jax versions without VMA typing —
+    # parallel/mesh.py).
     inner = inner_subsolve(
         k_ww, y_w, c_w, a_w0, f_w0, active, epsilon=epsilon,
         step_cap=step_cap, pairwise_clip=pairwise_clip,
-        seed_transform=lambda s: jax.tree.map(_to_varying, s))
+        seed_transform=lambda s: jax.tree.map(pcast_varying, s))
 
     # --- rank-q application, shard-local (the (q, n_s) fetch sits
     # after the subsolve so its epilogue fuses into the weighted
@@ -204,7 +202,7 @@ def _dist_decomp_step(carry: DistDecompCarry, xs, ys, x2s, valid, *,
                            precision=precision)[0]
 
     return DistDecompCarry(alpha_s, f_s, b_hi, b_lo,
-                           carry.n_iter + inner.t)
+                           carry.n_iter + inner.t, carry.rounds + 1)
 
 
 @functools.lru_cache(maxsize=16)
@@ -238,17 +236,20 @@ def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
                 pairwise_clip=pairwise_clip)
 
         carry = carry._replace(
-            b_hi=lax.pcast(carry.b_hi, (SHARD_AXIS,), to="varying"),
-            b_lo=lax.pcast(carry.b_lo, (SHARD_AXIS,), to="varying"),
-            n_iter=lax.pcast(carry.n_iter, (SHARD_AXIS,), to="varying"))
+            b_hi=pcast_varying(carry.b_hi),
+            b_lo=pcast_varying(carry.b_lo),
+            n_iter=pcast_varying(carry.n_iter),
+            rounds=pcast_varying(carry.rounds))
         out = lax.while_loop(cond, body, carry)
         return out._replace(b_hi=lax.pmax(out.b_hi, SHARD_AXIS),
                             b_lo=lax.pmax(out.b_lo, SHARD_AXIS),
-                            n_iter=lax.pmax(out.n_iter, SHARD_AXIS))
+                            n_iter=lax.pmax(out.n_iter, SHARD_AXIS),
+                            rounds=lax.pmax(out.rounds, SHARD_AXIS))
 
     carry_specs = DistDecompCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
-                                  b_hi=P(), b_lo=P(), n_iter=P())
-    mapped = jax.shard_map(
+                                  b_hi=P(), b_lo=P(), n_iter=P(),
+                                  rounds=P())
+    mapped = shard_map_compat(
         run, mesh=mesh,
         in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec,
                   P(SHARD_AXIS), P()),
@@ -256,7 +257,9 @@ def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
 
     def run_with_stats(carry, xs, ys, x2s, valid, limit):
         final = mapped(carry, xs, ys, x2s, valid, limit)
-        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi,
+                                 n_sv=device_sv_count(final.alpha),
+                                 rounds=final.rounds)
 
     return jax.jit(run_with_stats, donate_argnums=(0,))
 
@@ -289,7 +292,8 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
         f=jax.device_put(np.asarray(init[1], np.float32), shard),
         b_hi=jax.device_put(np.float32(init[2]), repl),
         b_lo=jax.device_put(np.float32(init[3]), repl),
-        n_iter=jax.device_put(np.int32(init[4]), repl))
+        n_iter=jax.device_put(np.int32(init[4]), repl),
+        rounds=jax.device_put(np.int32(0), repl))
 
     def build(q_now: int):
         q_now = 2 * min(int(q_now) // 2, n)     # same clamp as above

@@ -50,9 +50,10 @@ from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
 from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
+                                     pcast_varying, shard_map_compat,
                                      to_host)
-from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
-                                     resume_state)
+from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
+                                     pack_stats, resume_state)
 
 
 class DistCarry(NamedTuple):
@@ -68,6 +69,12 @@ class DistCarry(NamedTuple):
     ck: jax.Array       # (P*lines,) i32 keys, sharded; -1 = empty line
     cs: jax.Array       # (P*lines,) i32 last-use stamps, sharded
     cr: jax.Array       # (P*lines, n_s) f32 dot rows, sharded on axis 0
+    # Cache outcome counters (replicated-equal: the key sequence is the
+    # same on every shard, so every shard observes the identical
+    # hit/miss stream — the counter matches the single-device count).
+    # Ride the packed-stats transfer; see docs/OBSERVABILITY.md.
+    ch: jax.Array       # () i32 cache hits
+    cm: jax.Array       # () i32 cache misses
 
 
 def _owner_read(arr: jax.Array, local_idx, is_owner) -> jax.Array:
@@ -227,7 +234,7 @@ def _dist_step_wss2(carry: DistCarry, xs, ys, x2s, valid, *,
                + (a_lo_n - a_lo) * y_lo * k_lo)
 
     return DistCarry(alpha_s, f_s, b_hi, b_lo, carry.n_iter + 1,
-                     carry.ck, carry.cs, carry.cr)
+                     carry.ck, carry.cs, carry.cr, carry.ch, carry.cm)
 
 
 def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
@@ -298,7 +305,7 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
     a_hi, a_lo = scalars[0, 2], scalars[1, 2]
 
     # --- kernel rows on the local slice: (2, d) @ (d, n_s) (CS-3) ---
-    cache_out = (carry.ck, carry.cs, carry.cr)
+    cache_out = (carry.ck, carry.cs, carry.cr, carry.ch, carry.cm)
     if kspec.kind == "precomputed":
         # The gathered working rows carry the FULL (column-padded)
         # kernel row: eta entries are global-index reads and the local
@@ -317,11 +324,13 @@ def _dist_step(carry: DistCarry, xs, ys, x2s, valid, *,
         # n_iter is the LRU tick (one fetch per iteration).
         xs_l, x2s_l = _local_slice(xs, x2s, rank, n_per_shard, shard_x)
         cache = RowCache(keys=carry.ck, stamps=carry.cs, rows=carry.cr,
-                         tick=carry.n_iter)
+                         tick=carry.n_iter, hits=carry.ch,
+                         misses=carry.cm)
         dots, cache = cache_fetch_pair(
             cache, i_hi_g, i_lo_g,
             lambda: jnp.matmul(rows, xs_l.T, precision=precision))
-        cache_out = (cache.keys, cache.stamps, cache.rows)
+        cache_out = (cache.keys, cache.stamps, cache.rows, cache.hits,
+                     cache.misses)
         k_local = rows_from_dots(dots, w2, x2s_l, kspec)           # (2, n_s)
         k_hh, k_ll, k_hl = _eta_kernel_entries(k_local, loc_hi, own_hi,
                                                loc_lo, own_lo)
@@ -391,22 +400,29 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
                         n_per_shard=n_per_shard, shard_x=shard_x,
                         precision=precision, weights=weights, **extra)
 
-        # b_hi/b_lo come out of the loop body via all_gather, which types
-        # them as axis-varying under shard_map's VMA checks; mark the
-        # initial values to match, and fold back to invariant (the values
-        # are replicated-equal by construction) with a pmax on exit.
+        # b_hi/b_lo come out of the loop body via all_gather (and the
+        # cache counters via the sharded key compare), which types them
+        # as axis-varying under shard_map's VMA checks; mark the
+        # initial values to match, and fold back to invariant (the
+        # values are replicated-equal by construction) with a pmax on
+        # exit. pcast_varying is the identity on jax versions without
+        # VMA typing (parallel/mesh.py).
         carry = carry._replace(
-            b_hi=lax.pcast(carry.b_hi, (SHARD_AXIS,), to="varying"),
-            b_lo=lax.pcast(carry.b_lo, (SHARD_AXIS,), to="varying"))
+            b_hi=pcast_varying(carry.b_hi),
+            b_lo=pcast_varying(carry.b_lo),
+            ch=pcast_varying(carry.ch),
+            cm=pcast_varying(carry.cm))
         out = lax.while_loop(cond, body, carry)
         return out._replace(b_hi=lax.pmax(out.b_hi, SHARD_AXIS),
-                            b_lo=lax.pmax(out.b_lo, SHARD_AXIS))
+                            b_lo=lax.pmax(out.b_lo, SHARD_AXIS),
+                            ch=lax.pmax(out.ch, SHARD_AXIS),
+                            cm=lax.pmax(out.cm, SHARD_AXIS))
 
     carry_specs = DistCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
                             b_hi=P(), b_lo=P(), n_iter=P(),
                             ck=P(SHARD_AXIS), cs=P(SHARD_AXIS),
-                            cr=P(SHARD_AXIS, None))
-    mapped = jax.shard_map(
+                            cr=P(SHARD_AXIS, None), ch=P(), cm=P())
+    mapped = shard_map_compat(
         run, mesh=mesh,
         in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec, P(SHARD_AXIS),
                   P()),
@@ -414,10 +430,15 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
 
     def run_with_stats(carry, xs, ys, x2s, valid, limit):
         final = mapped(carry, xs, ys, x2s, valid, limit)
-        # Packed poll scalars as a second output of the SAME compiled
-        # program — one D2H transfer per chunk, no auxiliary XLA
-        # program (solver/driver.py "Poll economics").
-        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+        # Packed poll scalars + telemetry counters as a second output
+        # of the SAME compiled program — one D2H transfer per chunk, no
+        # auxiliary XLA program (solver/driver.py "Poll economics").
+        # The SV count reduces the global sharded alpha; padding rows
+        # hold alpha == 0 and never count.
+        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi,
+                                 n_sv=device_sv_count(final.alpha),
+                                 cache_hits=final.ch,
+                                 cache_misses=final.cm)
 
     return jax.jit(run_with_stats, donate_argnums=(0,))
 
@@ -545,6 +566,8 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         cs=jax.device_put(np.zeros((p * lines,), np.int32), shard),
         cr=jax.device_put(np.zeros((p * lines, n_s), np.float32),
                           row_shard),
+        ch=jax.device_put(np.int32(0), repl),
+        cm=jax.device_put(np.int32(0), repl),
     )
 
     runner = _build_dist_runner(mesh, float(config.c), kspec, eps, n_s,

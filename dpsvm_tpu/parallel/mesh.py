@@ -16,8 +16,43 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
+from jax import lax
 
 SHARD_AXIS = "shard"
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    Newer jax exposes ``jax.shard_map`` with varying-manual-axes (VMA)
+    typing; 0.4.x only has ``jax.experimental.shard_map.shard_map``,
+    whose ``check_rep`` replication checker cannot see through the
+    trainers' while_loop-carried all_gather values — so it is disabled
+    there (the values are replicated-equal by construction, which the
+    newer VMA path proves with pcast/pmax instead). Both distributed
+    trainers and the shrinking manager's SPMD rebuilds funnel through
+    here."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def pcast_varying(v):
+    """``lax.pcast(v, (SHARD_AXIS,), to="varying")`` where VMA typing
+    exists; identity where it does not (jax 0.4.x: no pcast, and no
+    typing to satisfy). Already-varying leaves pass through (pcast
+    rejects them — the dist-decomp subsolve seed mixes psum-derived and
+    invariant values)."""
+    if not hasattr(lax, "pcast"):
+        return v
+    try:
+        return lax.pcast(v, (SHARD_AXIS,), to="varying")
+    except ValueError:
+        return v
 
 
 def to_host(arr) -> np.ndarray:

@@ -52,8 +52,8 @@ from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
                                    rows_from_dots)
 from dpsvm_tpu.ops.selection import masked_scores_and_masks
 from dpsvm_tpu.ops.update import alpha_pair_step
-from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
-                                     resume_state)
+from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
+                                     pack_stats, resume_state)
 
 
 class DecompCarry(NamedTuple):
@@ -62,6 +62,9 @@ class DecompCarry(NamedTuple):
     b_hi: jax.Array     # () f32 latest global selection
     b_lo: jax.Array     # () f32
     n_iter: jax.Array   # () i32 cumulative INNER pair-updates
+    rounds: jax.Array   # () i32 outer rounds (block fetch + subsolve +
+                        # rank-q update) — telemetry only, rides the
+                        # packed-stats transfer (docs/OBSERVABILITY.md)
 
 
 def init_carry(y) -> DecompCarry:
@@ -74,6 +77,7 @@ def init_carry(y) -> DecompCarry:
         b_hi=np.float32(-SENTINEL),
         b_lo=np.float32(SENTINEL),
         n_iter=np.int32(0),
+        rounds=np.int32(0),
     )
 
 
@@ -260,7 +264,8 @@ def decomp_step(carry: DecompCarry, x: jax.Array, y: jax.Array,
         k_wn = rows_from_dots(dots, x2[wi], x2, kspec)       # (q, n)
     f = f + jnp.matmul((dalpha * y_w)[None, :], k_wn,
                        precision=precision)[0]
-    return DecompCarry(alpha, f, b_hi, b_lo, carry.n_iter + inner.t)
+    return DecompCarry(alpha, f, b_hi, b_lo, carry.n_iter + inner.t,
+                       carry.rounds + 1)
 
 
 @functools.lru_cache(maxsize=32)
@@ -292,6 +297,11 @@ def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
                            interpret=interpret,
                            valid=valid)
 
+    def stats(final: DecompCarry):
+        return pack_stats(final.n_iter, final.b_lo, final.b_hi,
+                          n_sv=device_sv_count(final.alpha),
+                          rounds=final.rounds)
+
     if masked:
         def run(carry: DecompCarry, x, y, x2, n_valid, limit):
             valid = jnp.arange(x.shape[0], dtype=jnp.int32) < n_valid
@@ -300,7 +310,7 @@ def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
                           & (s.n_iter < limit),
                 lambda s: body(s, x, y, x2, limit, valid),
                 carry)
-            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+            return final, stats(final)
     else:
         def run(carry: DecompCarry, x, y, x2, limit):
             final = lax.while_loop(
@@ -308,7 +318,7 @@ def _build_decomp_runner(c: float, kspec, epsilon: float, q: int,
                           & (s.n_iter < limit),
                 lambda s: body(s, x, y, x2, limit, None),
                 carry)
-            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+            return final, stats(final)
 
     return jax.jit(run, donate_argnums=(0,))
 
@@ -400,20 +410,26 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
     )
 
 
-# Growth-manager tuning. Check cadence: each SV-count check pulls the
-# alpha vector (one n-float D2H, ~100 ms round-trip on the tunneled
-# TPU), so checks back off exponentially from GROW_CHECK_MIN to
-# GROW_CHECK_MAX inner updates while nothing grows, resetting on
-# growth. The fine initial cadence matters: the SV population ramps up
-# EARLY in the solve, and a coarse first check leaves the run grinding
-# undersized for a large fraction of its trajectory (measured at
-# 8000x784 planted, cap 128 [cpu]: a fixed 16,384-update cadence
-# landed adaptive-from-1024 at 28.4k updates — barely better than
-# fixed-1024's 34.4k — because the first check fired halfway through;
-# the backoff cadence lands it at 18.9k vs fixed-right-size's
-# 13.0-13.7k). GROW_AT_OCCUPANCY triggers growth; GROW_TARGET_FACTOR
-# is the measured q-selection rule's ~1.3x plus margin for SVs yet to
-# appear; GROW_QUANTUM keeps new sizes MXU-tile-friendly.
+# Growth-manager tuning. Check cadence: the SV count now rides the
+# per-chunk packed-stats transfer (solver/driver.py), so a check costs
+# NOTHING — it reads an already-fetched host integer. (It used to pull
+# the whole alpha vector, an n-float D2H that under pipelined dispatch
+# also blocked on the just-dispatched speculative chunk, serializing
+# the poll loop against in-flight work.) The backoff cadence —
+# GROW_CHECK_MIN to GROW_CHECK_MAX inner updates while nothing grows,
+# resetting on growth — is kept to bound how often the manager
+# re-evaluates growth between recompiles (rebuild hysteresis + log
+# noise), not for poll economics. The fine initial cadence matters:
+# the SV population ramps up EARLY in the solve, and a coarse first
+# check leaves the run grinding undersized for a large fraction of its
+# trajectory (measured at 8000x784 planted, cap 128 [cpu]: a fixed
+# 16,384-update cadence landed adaptive-from-1024 at 28.4k updates —
+# barely better than fixed-1024's 34.4k — because the first check
+# fired halfway through; the backoff cadence lands it at 18.9k vs
+# fixed-right-size's 13.0-13.7k). GROW_AT_OCCUPANCY triggers growth;
+# GROW_TARGET_FACTOR is the measured q-selection rule's ~1.3x plus
+# margin for SVs yet to appear; GROW_QUANTUM keeps new sizes
+# MXU-tile-friendly.
 GROW_CHECK_MIN = 2_048
 GROW_CHECK_MAX = 16_384
 GROW_AT_OCCUPANCY = 0.75
@@ -446,19 +462,25 @@ def _make_growth_hook(config: SVMConfig, n: int, q0: int, build):
     compiled program — at most ~2 rebuilds per run by construction
     (each at least doubles q), each costing one compile (~tens of
     seconds on a tunneled TPU, vs the measured 2.5-3x update blowup of
-    running undersized)."""
+    running undersized).
+
+    The SV count is read from the poll's packed ChunkStats — already on
+    the host, no device read. It describes the chunk just polled (one
+    chunk stale under pipelined dispatch), exactly the freshness the
+    old alpha-pull gave, without blocking on the in-flight speculative
+    chunk."""
     from dpsvm_tpu.utils import watchdog
 
     q_mem = int(GROW_HBM_BUDGET // (8 * max(n, 1)))
     q_max = min(16_384, n - (n % 2), max(q_mem - (q_mem % 2), q0))
     state = {"q": q0, "last_check": 0, "cadence": GROW_CHECK_MIN}
 
-    def hook(n_iter: int, carry):
+    def hook(n_iter: int, carry, stats):
         if (state["q"] >= q_max
                 or n_iter - state["last_check"] < state["cadence"]):
             return None
         state["last_check"] = n_iter
-        n_sv = int(np.count_nonzero(np.asarray(carry.alpha)))
+        n_sv = int(stats.n_sv)
         if n_sv <= GROW_AT_OCCUPANCY * state["q"]:
             state["cadence"] = min(2 * state["cadence"], GROW_CHECK_MAX)
             return None

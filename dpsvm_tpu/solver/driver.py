@@ -4,8 +4,9 @@ Both solvers (single-device, distributed) expose the same contract: a
 compiled chunk runner that advances the carry until convergence or an
 iteration limit, entirely on device. This module owns everything around
 it — the polling loop, convergence bookkeeping, progress logging,
-checkpointing, profiler tracing and NaN-debug toggles — so the behavior
-is identical across execution modes.
+checkpointing, profiler tracing, run telemetry (docs/OBSERVABILITY.md)
+and NaN-debug toggles — so the behavior is identical across execution
+modes.
 
 Poll economics (measured on the v5e tunnel, benchmarks/
 profile_train_path.py): a blocking device->host scalar read costs
@@ -13,12 +14,16 @@ profile_train_path.py): a blocking device->host scalar read costs
 ``int()``/``float()`` reads per chunk — spent ~10 s of a 15 s training
 run waiting on polls. Two fixes live here:
 
-* **packed stats**: the three poll scalars (n_iter, b_lo, b_hi) are
-  packed into ONE (3,) device array INSIDE each solver's compiled chunk
-  runner (``pack_stats`` is traced into the same program, returned as a
-  second output) and fetched with a single transfer per chunk. No
-  auxiliary jitted gather exists — a separate tiny program would pay
-  its own ~0.5-3 s per-process first-compile on the tunneled TPU;
+* **packed stats**: every poll scalar — n_iter, b_lo, b_hi, plus the
+  telemetry counters (SV count, cache hits/misses, decomposition
+  rounds) — is packed into ONE (7,) device array INSIDE each solver's
+  compiled chunk runner (``pack_stats`` is traced into the same
+  program, returned as a second output) and fetched with a single
+  transfer per chunk. No auxiliary jitted gather exists — a separate
+  tiny program would pay its own ~0.5-3 s per-process first-compile on
+  the tunneled TPU — and tracing a run (``SVMConfig.trace_out``) adds
+  ZERO device->host transfers because everything a chunk record needs
+  already rides this one array;
 * **pipelined dispatch**: the next chunk is dispatched BEFORE the
   previous chunk's stats are read. The device-side ``lax.while_loop``
   checks convergence every iteration, so a speculative chunk dispatched
@@ -33,7 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +49,7 @@ from dpsvm_tpu.utils import watchdog
 from dpsvm_tpu.utils.checkpoint import (SolverCheckpoint, load_checkpoint,
                                         maybe_checkpoint)
 from dpsvm_tpu.utils.logging import log_progress
+from dpsvm_tpu.utils.timing import PhaseTimer
 
 
 def resume_state(config: SVMConfig, n: int, d: int, gamma: float
@@ -69,23 +75,94 @@ def _debug_nans(enabled: bool):
         jax.config.update("jax_debug_nans", prev)
 
 
-def pack_stats(n_iter, b_lo, b_hi):
-    """(n_iter, b_lo, b_hi) as one (3,) i32 array — one D2H transfer
-    instead of three blocking scalar reads. The floats ride as bit
-    patterns so every field is exact (an f32 lane would corrupt n_iter
-    above 2^24 and stall the max_iter exit check — reference covtype
-    budget is 3e6 and nothing validates an upper bound). Called INSIDE
-    each solver's compiled chunk runner, so no auxiliary XLA program
-    exists to pay the per-program first-compile overhead."""
+# The one packed-stats layout every chunk runner emits and every poll
+# reads: [n_iter, b_lo bits, b_hi bits, n_sv, cache_hits, cache_misses,
+# rounds], all i32 (floats as exact bit patterns).
+STATS_WIDTH = 7
+
+
+class ChunkStats(NamedTuple):
+    """Host-side view of one packed-stats read (docs/OBSERVABILITY.md
+    "Counter semantics")."""
+    n_iter: int
+    b_lo: float
+    b_hi: float
+    n_sv: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    rounds: int = 0
+
+
+def pack_stats(n_iter, b_lo, b_hi, n_sv=None, cache_hits=None,
+               cache_misses=None, rounds=None):
+    """Poll scalars + telemetry counters as one (7,) i32 array — one
+    D2H transfer instead of several blocking scalar reads. The floats
+    ride as bit patterns so every field is exact (an f32 lane would
+    corrupt n_iter above 2^24 and stall the max_iter exit check —
+    reference covtype budget is 3e6 and nothing validates an upper
+    bound). Called INSIDE each solver's compiled chunk runner, so no
+    auxiliary XLA program exists to pay the per-program first-compile
+    overhead. Counter arguments default to 0 so paths without a cache
+    (or without decomposition rounds) pack the same shape."""
     bits = jax.lax.bitcast_convert_type(jnp.stack([b_lo, b_hi]), jnp.int32)
-    return jnp.concatenate([jnp.reshape(n_iter, (1,)), bits])
+
+    def lane(v):
+        return jnp.reshape(jnp.asarray(0 if v is None else v,
+                                       jnp.int32), (1,))
+
+    return jnp.concatenate([jnp.reshape(n_iter, (1,)), bits,
+                            lane(n_sv), lane(cache_hits),
+                            lane(cache_misses), lane(rounds)])
+
+
+def read_stats(stats) -> ChunkStats:
+    """Block until the chunk's packed stats land, then unpack. Tolerates
+    the legacy (3,) layout (counters read as 0) so older callers and
+    recorded arrays stay readable."""
+    s = np.asarray(stats)       # blocks until the chunk's stats land
+    watchdog.pet()
+    b = s[1:3].view(np.float32)
+    extra = [int(v) for v in s[3:STATS_WIDTH]]
+    extra += [0] * (4 - len(extra))
+    return ChunkStats(int(s[0]), float(b[0]), float(b[1]), *extra)
 
 
 def _read_stats(stats) -> tuple:
-    s = np.asarray(stats)       # blocks until the chunk's stats land
-    watchdog.pet()
-    b = s[1:].view(np.float32)
-    return int(s[0]), float(b[0]), float(b[1])
+    """Legacy 3-tuple read, kept for callers that only poll
+    convergence (benchmarks, older tests)."""
+    s = read_stats(stats)
+    return s.n_iter, s.b_lo, s.b_hi
+
+
+def device_sv_count(alpha):
+    """count(alpha > 0) as i32, traced into the chunk program (padding
+    rows hold alpha == 0 and never count)."""
+    return jnp.sum(alpha > 0, dtype=jnp.int32)
+
+
+def trace_env() -> dict:
+    """Backend facts for the trace manifest (the backend is already up
+    by the time any solver runs, so this is a dictionary read)."""
+    try:
+        devs = jax.devices()
+        return {"backend": devs[0].platform,
+                "device_kind": getattr(devs[0], "device_kind", None),
+                "device_count": len(devs)}
+    except Exception:
+        return {"backend": None, "device_kind": None,
+                "device_count": None}
+
+
+def begin_trace(config: SVMConfig, n: int, d: int, gamma: float,
+                solver: str, it0: int = 0):
+    """RunTrace for this run, or None when tracing is off. Shared with
+    the shrinking manager (solver/shrink.py) so every producer writes
+    the one schema."""
+    if not getattr(config, "trace_out", None):
+        return None
+    from dpsvm_tpu.telemetry import RunTrace
+    return RunTrace(config.trace_out, config=config, n=n, d=d,
+                    gamma=gamma, solver=solver, it0=it0, env=trace_env())
 
 
 def host_training_loop(
@@ -101,13 +178,21 @@ def host_training_loop(
 ) -> TrainResult:
     """Run chunks until convergence / max_iter; return the TrainResult.
 
-    ``poll_hook(n_iter, carry) -> Optional[new_step_chunk]``: called at
-    each poll while the run is not done; a non-None return replaces
-    ``step_chunk`` for subsequent dispatches (the decomposition growth
-    manager swaps in a larger-q program this way — legal because the
-    carry layout is program-independent). In pipelined mode one
+    ``poll_hook(n_iter, carry, stats) -> Optional[new_step_chunk]``:
+    called at each poll while the run is not done; a non-None return
+    replaces ``step_chunk`` for subsequent dispatches (the decomposition
+    growth manager swaps in a larger-q program this way — legal because
+    the carry layout is program-independent). ``stats`` is the poll's
+    ChunkStats, so a hook that needs the SV count reads it for free
+    instead of pulling alpha (which, pipelined, would block on the
+    just-dispatched speculative chunk). In pipelined mode one
     already-dispatched speculative chunk still runs under the old
-    program; its math is the same, only its block size is."""
+    program; its math is the same, only its block size is.
+
+    With ``config.trace_out`` set, every poll appends a chunk record to
+    the run trace (manifest/chunk/summary schema: utils/trace.py) —
+    all of it read from the ONE packed-stats transfer above.
+    """
     eps = float(config.epsilon)
     chunk = config.chunk_iters
     # Pipelining changes WHEN the carry is read, not what is computed:
@@ -115,6 +200,16 @@ def host_training_loop(
     # so maybe_checkpoint sees the carry at the polled iteration.
     pipeline = config.checkpoint_every == 0
     last_saved = it0
+
+    from dpsvm_tpu.telemetry import SOLVER_NAMES
+    trace = begin_trace(config, n, d, gamma,
+                        SOLVER_NAMES.get(type(carry).__name__,
+                                         type(carry).__name__), it0)
+    # Host-loop accounting, not device time: "dispatch" buckets the
+    # (async) enqueue calls, "poll" the blocking stats reads — device
+    # execution overlaps both in pipelined mode. The buckets ride every
+    # chunk record and the trace summary.
+    timer = PhaseTimer()
 
     profile = (jax.profiler.trace(config.profile_dir)
                if config.profile_dir else contextlib.nullcontext())
@@ -124,75 +219,118 @@ def host_training_loop(
     # Setup (data gen, H2D, host norms) is done once we get here; give
     # the stall watchdog a fresh window for the first chunk's compile.
     watchdog.pet()
-    with profile, _debug_nans(config.debug_nans):
-        limit = min(it0 + chunk, config.max_iter)
-        carry, stats = step_chunk(carry, limit)
-        while True:
-            if pipeline:
-                # Dispatch the next chunk before the poll blocks; the
-                # speculative chunk is free when this one converged
-                # (the device cond exits instantly), and the poll's
-                # round-trip latency overlaps its execution.
-                limit = min(limit + chunk, config.max_iter)
-                carry, next_stats = step_chunk(carry, limit)
-
-            n_iter, b_lo, b_hi = _read_stats(stats)
-            converged = not (b_lo > b_hi + 2.0 * eps)
-            done = converged or n_iter >= config.max_iter
-            if (not done and config.wall_budget_s
-                    and time.perf_counter() - t0 > config.wall_budget_s):
-                # Time budget exhausted: stop dispatching. In pipelined
-                # mode a speculative chunk is already in flight; read its
-                # stats so the returned (n_iter, alpha) describe the same
-                # state — the extra chunk is counted, not silently run.
-                if pipeline:
-                    n_iter, b_lo, b_hi = _read_stats(next_stats)
-                    converged = not (b_lo > b_hi + 2.0 * eps)
-                done = True
-
-            log_progress(config, n_iter, b_lo, b_hi, final=done,
-                         prev_iter=prev_polled)
-            prev_polled = n_iter
-
-            if poll_hook is not None and not done:
-                replacement = poll_hook(n_iter, carry)
-                if replacement is not None:
-                    step_chunk = replacement
-
-            def make() -> SolverCheckpoint:
-                alpha, f = carry_to_host(carry)
-                return SolverCheckpoint(
-                    alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo, b_hi=b_hi,
-                    c=float(config.c), gamma=gamma,
-                    epsilon=float(config.epsilon), n=n, d=d,
-                    weight_pos=float(config.weight_pos),
-                    weight_neg=float(config.weight_neg),
-                    kernel=config.kernel, coef0=float(config.coef0),
-                    degree=int(config.degree))
-
-            last_saved = maybe_checkpoint(config, last_saved, n_iter, make)
-            if done:
-                break
-            if pipeline:
-                stats = next_stats
-            else:
-                limit = min(n_iter + chunk, config.max_iter)
+    try:
+        with profile, _debug_nans(config.debug_nans):
+            limit = min(it0 + chunk, config.max_iter)
+            with timer.phase("dispatch"):
                 carry, stats = step_chunk(carry, limit)
-    # In pipelined mode `carry` is the speculative chunk dispatched after
-    # the final poll; it was a no-op (converged => cond false on entry;
-    # max_iter => limit == n_iter), so its state equals the final state.
-    alpha, _ = carry_to_host(carry)
-    return TrainResult(
-        alpha=alpha,
-        b=(b_lo + b_hi) / 2.0,           # svmTrainMain.cpp:329
-        n_iter=n_iter,
-        converged=converged,
-        b_lo=b_lo,
-        b_hi=b_hi,
-        train_seconds=time.perf_counter() - t0,
-        gamma=gamma,
-        n_sv=int(np.sum(alpha > 0)),
-        kernel=config.kernel,
-        coef0=float(config.coef0),
-        degree=int(config.degree),
-    )
+            while True:
+                if pipeline:
+                    # Dispatch the next chunk before the poll blocks;
+                    # the speculative chunk is free when this one
+                    # converged (the device cond exits instantly), and
+                    # the poll's round-trip latency overlaps its
+                    # execution.
+                    limit = min(limit + chunk, config.max_iter)
+                    with timer.phase("dispatch"):
+                        carry, next_stats = step_chunk(carry, limit)
+
+                with timer.phase("poll"):
+                    st = read_stats(stats)
+                n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
+                converged = not (b_lo > b_hi + 2.0 * eps)
+                done = converged or n_iter >= config.max_iter
+                if (not done and config.wall_budget_s
+                        and time.perf_counter() - t0
+                        > config.wall_budget_s):
+                    # Time budget exhausted: stop dispatching. In
+                    # pipelined mode a speculative chunk is already in
+                    # flight; read its stats so the returned
+                    # (n_iter, alpha) describe the same state — the
+                    # extra chunk is counted, not silently run.
+                    if pipeline:
+                        with timer.phase("poll"):
+                            st = read_stats(next_stats)
+                        n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
+                        converged = not (b_lo > b_hi + 2.0 * eps)
+                    done = True
+                    if trace is not None:
+                        trace.event("wall_budget", n_iter=n_iter)
+
+                log_progress(config, n_iter, b_lo, b_hi, final=done,
+                             prev_iter=prev_polled)
+                prev_polled = n_iter
+                if trace is not None:
+                    trace.chunk(n_iter=n_iter, b_lo=b_lo, b_hi=b_hi,
+                                n_sv=st.n_sv, cache_hits=st.cache_hits,
+                                cache_misses=st.cache_misses,
+                                rounds=st.rounds,
+                                phases=dict(timer.seconds))
+
+                if poll_hook is not None and not done:
+                    with timer.phase("hook"):
+                        replacement = poll_hook(n_iter, carry, st)
+                    if replacement is not None:
+                        step_chunk = replacement
+                        if trace is not None:
+                            trace.event("program_swap", n_iter=n_iter)
+
+                def make() -> SolverCheckpoint:
+                    alpha, f = carry_to_host(carry)
+                    return SolverCheckpoint(
+                        alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo,
+                        b_hi=b_hi,
+                        c=float(config.c), gamma=gamma,
+                        epsilon=float(config.epsilon), n=n, d=d,
+                        weight_pos=float(config.weight_pos),
+                        weight_neg=float(config.weight_neg),
+                        kernel=config.kernel, coef0=float(config.coef0),
+                        degree=int(config.degree))
+
+                with timer.phase("checkpoint"):
+                    saved = maybe_checkpoint(config, last_saved, n_iter,
+                                             make)
+                if trace is not None and saved != last_saved:
+                    trace.event("checkpoint", n_iter=n_iter)
+                last_saved = saved
+                if done:
+                    break
+                if pipeline:
+                    stats = next_stats
+                else:
+                    limit = min(n_iter + chunk, config.max_iter)
+                    with timer.phase("dispatch"):
+                        carry, stats = step_chunk(carry, limit)
+        # In pipelined mode `carry` is the speculative chunk dispatched
+        # after the final poll; it was a no-op (converged => cond false
+        # on entry; max_iter => limit == n_iter), so its state equals
+        # the final state.
+        alpha, _ = carry_to_host(carry)
+        result = TrainResult(
+            alpha=alpha,
+            b=(b_lo + b_hi) / 2.0,           # svmTrainMain.cpp:329
+            n_iter=n_iter,
+            converged=converged,
+            b_lo=b_lo,
+            b_hi=b_hi,
+            train_seconds=time.perf_counter() - t0,
+            gamma=gamma,
+            n_sv=int(np.sum(alpha > 0)),
+            kernel=config.kernel,
+            coef0=float(config.coef0),
+            degree=int(config.degree),
+        )
+        if trace is not None:
+            trace.summary(converged=result.converged,
+                          n_iter=result.n_iter, b=result.b,
+                          b_lo=result.b_lo, b_hi=result.b_hi,
+                          n_sv=result.n_sv,
+                          train_seconds=result.train_seconds,
+                          cache_hits=st.cache_hits,
+                          cache_misses=st.cache_misses,
+                          rounds=st.rounds,
+                          phases=dict(timer.seconds))
+        return result
+    finally:
+        if trace is not None:
+            trace.close()

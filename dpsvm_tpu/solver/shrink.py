@@ -49,7 +49,7 @@ import numpy as np
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
 from dpsvm_tpu.ops.selection import iup_ilow_masks_np
-from dpsvm_tpu.solver.driver import _read_stats
+from dpsvm_tpu.solver.driver import begin_trace, read_stats
 from dpsvm_tpu.utils import watchdog
 from dpsvm_tpu.utils.logging import log_progress
 
@@ -321,7 +321,9 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                 inner_cap, bool(config.shard_x), precision_name,
                 weights, pairwise)
             carry = DistDecompCarry(alpha=a_seed, f=f_seed, b_hi=b_hi0,
-                                    b_lo=b_lo0, n_iter=it0)
+                                    b_lo=b_lo0, n_iter=it0,
+                                    rounds=jax.device_put(np.int32(0),
+                                                          di.repl))
         else:
             from dpsvm_tpu.parallel.dist_smo import (DistCarry,
                                                      _build_dist_runner)
@@ -339,7 +341,9 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                 cs=jax.device_put(np.zeros((0,), np.int32), di.shard),
                 cr=jax.device_put(np.zeros((0, n_s), np.float32),
                                   NamedSharding(mesh,
-                                                P(SHARD_AXIS, None))))
+                                                P(SHARD_AXIS, None))),
+                ch=jax.device_put(np.int32(0), di.repl),
+                cm=jax.device_put(np.int32(0), di.repl))
 
         def step(c, lim):
             return run(c, di.xd, di.yd, di.x2, di.validd,
@@ -352,95 +356,131 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         watchdog.pet()
         return step, pull, carry
 
+    # Run telemetry (docs/OBSERVABILITY.md): the manager emits the same
+    # trace schema as the shared driver — chunk records read from the
+    # runners' packed stats (n_sv/counters describe the ACTIVE
+    # subproblem; n_active rides each record), plus shrink/unshrink
+    # events marking every active-set transition.
+    trace = begin_trace(config, n, d, gamma, "shrink")
+
     active = np.arange(n)
     step, pull, carry = make_active(active)
     it = 0
     last_check = 0
     # Setup/H2D done; fresh stall-watchdog window for the first compile.
     watchdog.pet()
-    while True:
-        limit = min(it + chunk, config.max_iter)
-        prev_polled = it
-        carry, stats = step(carry, limit)
-        it, b_lo, b_hi = _read_stats(stats)
-        sub_converged = not (b_lo > b_hi + 2.0 * eps)
-        capped = it >= config.max_iter
-        if (not capped and config.wall_budget_s
-                and time.perf_counter() - t0 > config.wall_budget_s):
-            # Time budget exhausted: same exit path as the iteration cap
-            # (scatter back, unshrink-reconstruct if compacted, report
-            # the honest full-problem convergence state).
-            capped = True
-        if not capped:   # the final=True line after the loop reports
-            log_progress(config, it, b_lo, b_hi, final=False,
-                         prev_iter=prev_polled)
+    try:
+        while True:
+            limit = min(it + chunk, config.max_iter)
+            prev_polled = it
+            carry, stats = step(carry, limit)
+            st = read_stats(stats)
+            it, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
+            sub_converged = not (b_lo > b_hi + 2.0 * eps)
+            capped = it >= config.max_iter
+            if (not capped and config.wall_budget_s
+                    and time.perf_counter() - t0 > config.wall_budget_s):
+                # Time budget exhausted: same exit path as the iteration
+                # cap (scatter back, unshrink-reconstruct if compacted,
+                # report the honest full-problem convergence state).
+                capped = True
+                if trace is not None:
+                    trace.event("wall_budget", n_iter=it)
+            if not capped:   # the final=True line after the loop reports
+                log_progress(config, it, b_lo, b_hi, final=False,
+                             prev_iter=prev_polled)
+            if trace is not None:
+                trace.chunk(n_iter=it, b_lo=b_lo, b_hi=b_hi,
+                            n_sv=st.n_sv, cache_hits=st.cache_hits,
+                            cache_misses=st.cache_misses,
+                            rounds=st.rounds, n_active=len(active))
 
-        if sub_converged or capped:
-            # Scatter the subproblem's state back.
-            alpha[active], f[active] = pull(carry)
-            if len(active) == n:
-                converged = sub_converged
-                break
-            # Unshrink: exact f for the frozen rows, then the REAL
-            # optimality check on the full problem.
-            mask = np.zeros(n, bool)
-            mask[active] = True
-            f = _reconstruct_inactive_f(x, y_np, alpha, f, alpha0, f0,
-                                        mask, kspec)
-            b_hi, b_lo = _host_extrema(alpha, y_np, f, c_box)
-            converged = not (b_lo > b_hi + 2.0 * eps)
-            if converged or capped:
-                break
-            # Not there yet: continue on the full problem (and allow
-            # re-shrinking as the new tail converges). The iteration
-            # count must survive the rebuild — a fresh carry's
-            # n_iter=0 would grant the loop a whole new max_iter
-            # budget. The reconstructed extrema ride along so the next
-            # chunk's entry state is the real one.
-            active = np.arange(n)
-            step, pull, carry = make_active(active)
-            carry = carry._replace(n_iter=np.int32(it),
-                                   b_hi=np.float32(b_hi),
-                                   b_lo=np.float32(b_lo))
-            continue
+            if sub_converged or capped:
+                # Scatter the subproblem's state back.
+                alpha[active], f[active] = pull(carry)
+                if len(active) == n:
+                    converged = sub_converged
+                    break
+                # Unshrink: exact f for the frozen rows, then the REAL
+                # optimality check on the full problem.
+                mask = np.zeros(n, bool)
+                mask[active] = True
+                f = _reconstruct_inactive_f(x, y_np, alpha, f, alpha0,
+                                            f0, mask, kspec)
+                b_hi, b_lo = _host_extrema(alpha, y_np, f, c_box)
+                converged = not (b_lo > b_hi + 2.0 * eps)
+                if trace is not None:
+                    trace.event("unshrink", n_iter=it,
+                                n_active_before=len(active),
+                                n_active_after=n,
+                                full_problem_converged=converged)
+                if converged or capped:
+                    break
+                # Not there yet: continue on the full problem (and allow
+                # re-shrinking as the new tail converges). The iteration
+                # count must survive the rebuild — a fresh carry's
+                # n_iter=0 would grant the loop a whole new max_iter
+                # budget. The reconstructed extrema ride along so the
+                # next chunk's entry state is the real one.
+                active = np.arange(n)
+                step, pull, carry = make_active(active)
+                carry = carry._replace(n_iter=np.int32(it),
+                                       b_hi=np.float32(b_hi),
+                                       b_lo=np.float32(b_lo))
+                continue
 
-        # Mid-training shrink check (LIBSVM checks every min(n,1000)
-        # iterations). Each check pulls (alpha, f) — two D2H transfers
-        # whose round-trip costs ~65-100 ms on a tunneled TPU — so it
-        # runs at most every SHRINK_CHECK_ITERS iterations, not at
-        # every small chunk poll. Compact only when the active set
-        # halves — each distinct active size is its own XLA program.
-        if it - last_check < min(SHRINK_CHECK_ITERS, n):
-            continue
-        last_check = it
-        a_act, f_act = pull(carry)
-        shrink = _shrinkable(a_act, y_np[active], f_act, c_box[active],
-                             b_hi, b_lo)
-        keep = int(len(active) - shrink.sum())
-        if keep <= len(active) // 2 and keep >= min_active:
-            alpha[active] = a_act
-            f[active] = f_act
-            active = active[~shrink]
-            step, pull, new_carry = make_active(active)
-            # Preserve the loop bookkeeping (n_iter and the stopping
-            # state survive the compaction; selection state is
-            # recomputed next chunk anyway).
-            carry = new_carry._replace(
-                n_iter=np.int32(it),
-                b_hi=np.float32(b_hi), b_lo=np.float32(b_lo))
+            # Mid-training shrink check (LIBSVM checks every min(n,1000)
+            # iterations). Each check pulls (alpha, f) — two D2H
+            # transfers whose round-trip costs ~65-100 ms on a tunneled
+            # TPU — so it runs at most every SHRINK_CHECK_ITERS
+            # iterations, not at every small chunk poll. Compact only
+            # when the active set halves — each distinct active size is
+            # its own XLA program.
+            if it - last_check < min(SHRINK_CHECK_ITERS, n):
+                continue
+            last_check = it
+            a_act, f_act = pull(carry)
+            shrink = _shrinkable(a_act, y_np[active], f_act,
+                                 c_box[active], b_hi, b_lo)
+            keep = int(len(active) - shrink.sum())
+            if keep <= len(active) // 2 and keep >= min_active:
+                alpha[active] = a_act
+                f[active] = f_act
+                if trace is not None:
+                    trace.event("shrink", n_iter=it,
+                                n_active_before=len(active),
+                                n_active_after=keep)
+                active = active[~shrink]
+                step, pull, new_carry = make_active(active)
+                # Preserve the loop bookkeeping (n_iter and the stopping
+                # state survive the compaction; selection state is
+                # recomputed next chunk anyway).
+                carry = new_carry._replace(
+                    n_iter=np.int32(it),
+                    b_hi=np.float32(b_hi), b_lo=np.float32(b_lo))
 
-    log_progress(config, it, b_lo, b_hi, final=True)
-    return TrainResult(
-        alpha=alpha,
-        b=(b_lo + b_hi) / 2.0,
-        n_iter=it,
-        converged=converged,
-        b_lo=b_lo,
-        b_hi=b_hi,
-        train_seconds=time.perf_counter() - t0,
-        gamma=gamma,
-        n_sv=int(np.sum(alpha > 0)),
-        kernel=config.kernel,
-        coef0=float(config.coef0),
-        degree=int(config.degree),
-    )
+        log_progress(config, it, b_lo, b_hi, final=True)
+        result = TrainResult(
+            alpha=alpha,
+            b=(b_lo + b_hi) / 2.0,
+            n_iter=it,
+            converged=converged,
+            b_lo=b_lo,
+            b_hi=b_hi,
+            train_seconds=time.perf_counter() - t0,
+            gamma=gamma,
+            n_sv=int(np.sum(alpha > 0)),
+            kernel=config.kernel,
+            coef0=float(config.coef0),
+            degree=int(config.degree),
+        )
+        if trace is not None:
+            trace.summary(converged=result.converged,
+                          n_iter=result.n_iter, b=result.b,
+                          b_lo=result.b_lo, b_hi=result.b_hi,
+                          n_sv=result.n_sv,
+                          train_seconds=result.train_seconds)
+        return result
+    finally:
+        if trace is not None:
+            trace.close()

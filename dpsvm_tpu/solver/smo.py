@@ -37,8 +37,8 @@ from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
 from dpsvm_tpu.ops.update import alpha_pair_step
-from dpsvm_tpu.solver.driver import (host_training_loop, pack_stats,
-                                     resume_state)
+from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
+                                     pack_stats, resume_state)
 
 
 class SMOCarry(NamedTuple):
@@ -277,9 +277,16 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                         valid=valid)
 
     # Poll stats packed inside the same program: the host reads one
-    # (3,) array per chunk instead of three blocking scalars, and no
-    # auxiliary XLA program exists to pay first-compile overhead
-    # (solver/driver.py "Poll economics").
+    # (7,) array per chunk — convergence scalars plus the telemetry
+    # counters (SV count, cache hits/misses) — instead of several
+    # blocking scalars, and no auxiliary XLA program exists to pay
+    # first-compile overhead (solver/driver.py "Poll economics").
+    def stats(final: SMOCarry):
+        return pack_stats(final.n_iter, final.b_lo, final.b_hi,
+                          n_sv=device_sv_count(final.alpha),
+                          cache_hits=final.cache.hits,
+                          cache_misses=final.cache.misses)
+
     if masked:
         def run(carry: SMOCarry, x, y, x2, n_valid, limit):
             valid = jnp.arange(x.shape[0], dtype=jnp.int32) < n_valid
@@ -287,14 +294,14 @@ def _build_chunk_runner(c: float, kspec, epsilon: float,
                 lambda s: cond(s, limit),
                 lambda s: body(s, x, y, x2, valid),
                 carry)
-            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+            return final, stats(final)
     else:
         def run(carry: SMOCarry, x, y, x2, limit):
             final = lax.while_loop(
                 lambda s: cond(s, limit),
                 lambda s: body(s, x, y, x2, None),
                 carry)
-            return final, pack_stats(final.n_iter, final.b_lo, final.b_hi)
+            return final, stats(final)
 
     return jax.jit(run, donate_argnums=(0,))
 

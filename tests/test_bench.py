@@ -27,9 +27,11 @@ def _run(script: str, extra_env: dict, timeout: int = 240):
         capture_output=True, text=True, env=env, timeout=timeout)
 
 
-def test_bench_iter_throughput_contract():
+def test_bench_iter_throughput_contract(tmp_path):
+    trace = tmp_path / "bench_trace.jsonl"
     r = _run("bench.py", {"BENCH_N": "512", "BENCH_D": "32",
-                          "BENCH_ITERS": "300"})
+                          "BENCH_ITERS": "300",
+                          "BENCH_TRACE_OUT": str(trace)})
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [l for l in r.stdout.strip().splitlines() if l]
     assert len(lines) == 1, f"expected ONE json line, got: {r.stdout!r}"
@@ -38,12 +40,19 @@ def test_bench_iter_throughput_contract():
     assert rec["unit"] == "iter/s"
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
+    # provenance trace alongside the JSON line (docs/OBSERVABILITY.md)
+    from dpsvm_tpu.telemetry import load_trace
+    records = load_trace(str(trace))
+    assert records[0]["solver"] == "bench-smo"
+    assert records[-1]["kind"] == "summary"
 
 
-def test_bench_convergence_contract():
+def test_bench_convergence_contract(tmp_path):
+    trace = tmp_path / "conv_trace.jsonl"
     r = _run("bench_convergence.py",
              {"BENCH_N": "600", "BENCH_D": "24", "BENCH_GAMMA": "0.5",
-              "BENCH_MAX_ITER": "20000"})
+              "BENCH_MAX_ITER": "20000",
+              "BENCH_TRACE_OUT": str(trace)})
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [l for l in r.stdout.strip().splitlines() if l]
     assert len(lines) == 1
@@ -53,6 +62,12 @@ def test_bench_convergence_contract():
     assert rec["converged"] is True
     assert rec["n_sv"] > 0
     assert rec["train_accuracy"] > 0.9
+    # BENCH_TRACE_OUT threads into SVMConfig.trace_out
+    from dpsvm_tpu.telemetry import load_trace
+    records = load_trace(str(trace))
+    assert records[-1]["kind"] == "summary"
+    assert records[-1]["converged"] is True
+    assert records[-1]["n_sv"] == rec["n_sv"]
 
 
 def test_burst_runner_records_and_skips(tmp_path):
@@ -85,6 +100,10 @@ def test_burst_runner_records_and_skips(tmp_path):
     assert m["converged"] is True and m["n_sv"] > 0
     # sweep_lib.sh's have() greps this exact literal:
     assert '"tag": "t_conv", "rc": 0' in res.read_text()
+    # provenance trace archived next to the results ledger
+    from dpsvm_tpu.telemetry import load_trace
+    t = load_trace(str(tmp_path / "traces" / "t_conv.jsonl"))
+    assert t[-1]["kind"] == "summary" and t[-1]["converged"] is True
     # wall-budget stop: attempt burned, rate evidence kept
     assert by_tag["t_budget"]["rc"] == 95
     mb = json.loads(by_tag["t_budget"]["stdout"][-1])

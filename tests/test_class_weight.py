@@ -162,3 +162,35 @@ def test_estimator_class_weight_binary_and_multiclass():
     assert clf_b.n_iter_ == ref.n_iter
     with pytest.raises(ValueError, match="not present"):
         DPSVMClassifier(class_weight={9: 2.0}).fit(x, y)
+
+
+def test_nonfinite_weights_rejected_at_config():
+    """ADVICE r5: `w <= 0` lets NaN through (NaN comparisons are all
+    False) and +inf past the positivity check — both must fail
+    validation before any training."""
+    for bad in (float("nan"), float("inf"), -float("inf")):
+        with pytest.raises(ValueError, match="finite"):
+            SVMConfig(weight_pos=bad).validate()
+        with pytest.raises(ValueError, match="finite"):
+            SVMConfig(weight_neg=bad).validate()
+    SVMConfig(weight_pos=2.0, weight_neg=0.5).validate()    # still fine
+
+
+def test_nonfinite_weights_rejected_at_cli_parse():
+    """The CLI rejects non-finite weights at PARSE time — before the
+    (possibly huge) dataset load."""
+    from dpsvm_tpu.cli import build_parser, main
+
+    parser = build_parser()
+    for bad in ("nan", "inf", "-inf", "0", "-2"):
+        with pytest.raises(SystemExit):
+            parser.parse_args(["train", "-f", "x.csv", "-m", "m",
+                               "--weight-pos", bad])
+        with pytest.raises(SystemExit):
+            parser.parse_args(["train", "-f", "x.csv", "-m", "m",
+                               "--weight-neg", bad])
+    # --weight LABEL:W specs: checked from args alone (the dataset
+    # file is never opened — a nonexistent path proves it)
+    for spec in ("1:nan", "1:inf", "1:0"):
+        assert main(["train", "-f", "absent.csv", "--cv", "3",
+                     "--weight", spec]) == 2

@@ -51,6 +51,26 @@ def test_cv_svr():
     assert r["r2"] > 0.9
 
 
+def test_cv_svr_precomputed_kernel():
+    """ADVICE r5: precomputed-kernel CV is NOT classification-only —
+    the SVR path slices the fold's (rows, columns) sub-kernel like any
+    other precomputed problem. Lock the behavior in: identical metrics
+    to the rbf-feature run whose kernel matrix we precompute."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(120, 5)).astype(np.float32)
+    y = (0.5 * x[:, 1] - x[:, 2]).astype(np.float32)
+    base = dict(c=10.0, svr_epsilon=0.05, max_iter=20000)
+    r_rbf = cross_validate(x, y, 3, SVMConfig(gamma=0.5, **base),
+                           task="svr")
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    k = np.exp(-0.5 * d2).astype(np.float32)
+    r_pre = cross_validate(k, y, 3, SVMConfig(kernel="precomputed",
+                                              **base), task="svr")
+    assert r_pre["mse"] == pytest.approx(r_rbf["mse"], rel=1e-5)
+    assert r_pre["r2"] == pytest.approx(r_rbf["r2"], rel=1e-5)
+    assert r_pre["r2"] > 0.5            # a real fit, not a constant
+
+
 def test_cv_rejects_checkpoint(blobs_small):
     x, y = blobs_small
     with pytest.raises(ValueError, match="single-run"):

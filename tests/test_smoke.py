@@ -11,7 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
+                                     shard_map_compat)
 
 
 def test_device_discovery():
@@ -41,8 +42,8 @@ def test_mesh_and_collective():
         local = v * (rank.astype(jnp.float32) + 1.0)
         return jax.lax.psum(local.sum(), SHARD_AXIS)
 
-    f = jax.jit(jax.shard_map(per_shard, mesh=mesh,
-                              in_specs=P(SHARD_AXIS), out_specs=P()))
+    f = jax.jit(shard_map_compat(per_shard, mesh=mesh,
+                                 in_specs=P(SHARD_AXIS), out_specs=P()))
     v = jnp.ones((16,))
     # shard r holds 2 ones scaled by (r+1): total = 2 * sum(1..8) = 72
     assert float(f(v)) == 72.0
@@ -54,9 +55,9 @@ def test_all_gather_roundtrip():
     def gather(v):
         return jax.lax.all_gather(v.sum(), SHARD_AXIS)
 
-    f = jax.jit(jax.shard_map(gather, mesh=mesh,
-                              in_specs=P(SHARD_AXIS),
-                              out_specs=P(SHARD_AXIS)))
+    f = jax.jit(shard_map_compat(gather, mesh=mesh,
+                                 in_specs=P(SHARD_AXIS),
+                                 out_specs=P(SHARD_AXIS)))
     # each of the 4 shards emits the full gathered (4,) vector; the
     # sharded output axis concatenates them
     out = np.asarray(f(jnp.arange(8.0)))

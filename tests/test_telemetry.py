@@ -1,0 +1,367 @@
+"""Run-telemetry tests: trace schema, device-side counters riding the
+packed-stats transfer, and the ``dpsvm report`` round-trip.
+
+The counters' acceptance bar (ISSUE 1): cache hits + misses equal the
+lookup count on a tiny run, distributed counters equal single-device
+counters on the 8-device CPU mesh, and a traced run performs zero
+additional device->host transfers (the counters are read from the SAME
+packed stats array the driver already fetched — asserted structurally
+here by checking the runner output shape).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import train
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.telemetry import (RunTrace, load_trace, render_report,
+                                 selfcheck, summarize_trace)
+from dpsvm_tpu.utils.trace import read_trace, validate_trace
+
+
+def _kinds(records):
+    return [r["kind"] for r in records]
+
+
+def _chunks(records):
+    return [r for r in records if r["kind"] == "chunk"]
+
+
+def _summary(records):
+    return records[-1]
+
+
+# ---------------------------------------------------------------- schema
+
+def test_selfcheck():
+    """The CI schema gate: writer -> validator -> renderer round-trip."""
+    assert selfcheck() == []
+
+
+def test_selfcheck_cli_entrypoint():
+    from dpsvm_tpu.telemetry import main
+    assert main(["--selfcheck"]) == 0
+
+
+def test_single_device_trace_schema(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                    chunk_iters=64, trace_out=path)
+    result = train(x, y, cfg)
+    assert result.converged
+
+    records = load_trace(path)          # raises on any schema problem
+    kinds = _kinds(records)
+    assert kinds[0] == "manifest"
+    assert kinds[-1] == "summary"
+    assert kinds.count("chunk") >= 1
+
+    m = records[0]
+    assert m["n"] == x.shape[0] and m["d"] == x.shape[1]
+    assert m["solver"] == "smo"
+    assert m["kernel"]["kind"] == "rbf"
+    assert m["config"]["c"] == 1.0
+    assert m["env"]["backend"] == "cpu"
+
+    chunks = _chunks(records)
+    iters = [c["n_iter"] for c in chunks]
+    assert iters == sorted(iters)       # monotone
+    # the trace's final state IS the TrainResult's
+    s = _summary(records)
+    assert s["n_iter"] == result.n_iter
+    assert s["converged"] == result.converged
+    assert s["n_sv"] == result.n_sv
+    assert s["gap"] == pytest.approx(result.b_lo - result.b_hi)
+    assert s["b"] == pytest.approx(result.b)
+    # host-loop phase buckets recorded
+    assert "dispatch" in s["phases"] and "poll" in s["phases"]
+
+
+def test_trace_off_by_default(tmp_path, blobs_small):
+    x, y = blobs_small
+    train(x, y, SVMConfig(c=1.0, gamma=0.5, max_iter=5_000))
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_validate_trace_rejects_drift(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    train(x, y, SVMConfig(c=1.0, gamma=0.5, max_iter=20_000,
+                          chunk_iters=64, trace_out=path))
+    records = read_trace(path)
+    assert validate_trace(records) == []
+    # wrong schema version
+    bad = [dict(records[0], schema=999)] + records[1:]
+    assert any("schema" in e for e in validate_trace(bad))
+    # non-monotone n_iter
+    chunk = _chunks(records)[0]
+    tampered = [records[0], dict(chunk, n_iter=100),
+                dict(chunk, n_iter=50)]
+    assert any("monotone" in e for e in validate_trace(tampered))
+    # summary not last
+    assert any("final" in e for e in
+               validate_trace(records + [dict(records[1])]))
+    # missing counter key
+    broken = [({k: v for k, v in r.items() if k != "cache_hits"}
+               if r["kind"] == "chunk" else r) for r in records]
+    assert any("cache_hits" in e for e in validate_trace(broken))
+
+
+def test_partial_trace_without_summary_is_valid():
+    recs = [{"kind": "manifest", "schema": 1, "version": "x",
+             "solver": "smo", "n": 10, "d": 2, "gamma": 0.5,
+             "kernel": {"kind": "rbf", "gamma": 0.5, "coef0": 0.0,
+                        "degree": 3},
+             "mesh": {"shards": 1, "shard_x": True},
+             "env": {"backend": None, "device_kind": None,
+                     "device_count": None},
+             "config": {}, "it0": 0, "time": "t"},
+            {"kind": "chunk", "n_iter": 5, "b_lo": 1.0, "b_hi": -1.0,
+             "gap": 2.0, "n_sv": 1, "cache_hits": 0, "cache_misses": 0,
+             "rounds": 0, "t": 0.1, "phases": {}}]
+    assert validate_trace(recs) == []
+    # a killed run must still render
+    assert "no summary record" in render_report(recs)
+
+
+# ------------------------------------------------------------- counters
+
+def test_cache_counters_match_lookups(tmp_path, blobs_small):
+    """One SMO iteration = one pair fetch = 2 lookups, so
+    hits + misses == 2 * n_iter whenever the cache is on (and the
+    counters ride the one existing packed-stats transfer)."""
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                    chunk_iters=64, cache_size=8, trace_out=path)
+    result = train(x, y, cfg)
+    records = load_trace(path)
+    s = _summary(records)
+    assert s["cache_hits"] + s["cache_misses"] == 2 * result.n_iter
+    assert s["cache_hits"] > 0          # repeated violators do hit
+    assert s["cache_hit_rate"] == pytest.approx(
+        s["cache_hits"] / (2 * result.n_iter), abs=1e-6)
+    # per-chunk counters are cumulative and monotone
+    for key in ("cache_hits", "cache_misses", "n_iter"):
+        vals = [c[key] for c in _chunks(records)]
+        assert vals == sorted(vals)
+
+
+def test_counters_zero_when_cache_off(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    train(x, y, SVMConfig(c=1.0, gamma=0.5, max_iter=20_000,
+                          chunk_iters=64, trace_out=path))
+    s = _summary(load_trace(path))
+    assert s["cache_hits"] == 0 and s["cache_misses"] == 0
+    assert s["cache_hit_rate"] is None
+
+
+def test_distributed_counters_equal_single_device(tmp_path, blobs_small):
+    """8-device CPU mesh: the per-shard key sequence is replicated, so
+    the distributed hit/miss counters must equal the single-device
+    run's exactly (the trajectories are identical — test_distributed
+    already pins n_iter equality)."""
+    x, y = blobs_small
+    p1 = str(tmp_path / "single.jsonl")
+    p8 = str(tmp_path / "dist.jsonl")
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                chunk_iters=64, cache_size=8)
+    r1 = train(x, y, SVMConfig(trace_out=p1, **base))
+    r8 = train(x, y, SVMConfig(trace_out=p8, shards=8, **base))
+    s1 = _summary(load_trace(p1))
+    s8 = _summary(load_trace(p8))
+    assert load_trace(p8)[0]["solver"] == "dist-smo"
+    assert r1.n_iter == r8.n_iter
+    assert s8["cache_hits"] == s1["cache_hits"]
+    assert s8["cache_misses"] == s1["cache_misses"]
+    assert s8["n_sv"] == s1["n_sv"] == r1.n_sv
+
+
+def test_n_sv_rides_stats_on_every_path(tmp_path, blobs_small):
+    """n_sv in the summary must equal the TrainResult's on the
+    distributed, decomposition and fused paths too (it is computed on
+    device inside each chunk program — padding rows never count)."""
+    x, y = blobs_small
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+                chunk_iters=64)
+    for name, extra in (
+            ("dist", dict(shards=8)),
+            ("decomp", dict(working_set=16)),
+            ("distdecomp", dict(shards=4, working_set=16)),
+            ("fused", dict(use_pallas="on"))):
+        path = str(tmp_path / f"{name}.jsonl")
+        r = train(x, y, SVMConfig(trace_out=path, **base, **extra))
+        records = load_trace(path)
+        s = _summary(records)
+        assert s["kind"] == "summary", name
+        assert s["n_sv"] == r.n_sv, name
+        assert s["n_iter"] == r.n_iter, name
+        if "working_set" in extra:
+            assert s["rounds"] > 0, name
+
+
+def test_stats_pack_is_single_array(blobs_small):
+    """Structural zero-extra-transfer check: the chunk runner returns
+    exactly (carry, stats) with every counter inside the ONE stats
+    array — nothing else to fetch."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import host_row_stats
+    from dpsvm_tpu.solver.driver import STATS_WIDTH, read_stats
+    from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
+
+    x, y = blobs_small
+    spec = SVMConfig(gamma=0.5, cache_size=4).kernel_spec(x.shape[1])
+    runner = _build_chunk_runner(1.0, spec, 1e-3, True, "HIGHEST")
+    carry = init_carry(np.asarray(y, np.float32), 4)
+    xd = jnp.asarray(x, jnp.float32)
+    x2 = jnp.asarray(host_row_stats(x, spec))
+    carry, stats = runner(carry, xd, jnp.asarray(y, jnp.float32), x2,
+                          np.int32(100))
+    assert stats.shape == (STATS_WIDTH,)
+    st = read_stats(stats)
+    assert st.n_iter == 100 or st.n_iter < 100       # converged early ok
+    assert st.cache_hits + st.cache_misses == 2 * st.n_iter
+    assert st.n_sv == int(np.sum(np.asarray(carry.alpha) > 0))
+
+
+def test_legacy_three_wide_stats_still_read():
+    """pack_stats with only the three poll scalars (older callers,
+    tests) must stay readable; counters default to zero."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.solver.driver import pack_stats, read_stats
+
+    st = read_stats(pack_stats(jnp.int32(7), jnp.float32(1.5),
+                               jnp.float32(-2.0)))
+    assert (st.n_iter, st.b_lo, st.b_hi) == (7, 1.5, -2.0)
+    assert (st.n_sv, st.cache_hits, st.cache_misses, st.rounds) == \
+        (0, 0, 0, 0)
+
+
+# ------------------------------------------------- events + other paths
+
+def test_shrinking_path_traces_events(tmp_path):
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=600, d=6, seed=5)
+    path = str(tmp_path / "shrink.jsonl")
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=60_000,
+                    chunk_iters=64, shrinking=True, trace_out=path)
+    r = train(x, y, cfg)
+    assert r.converged
+    records = load_trace(path)
+    assert records[0]["solver"] == "shrink"
+    s = _summary(records)
+    assert s["converged"] and s["n_iter"] == r.n_iter
+    events = [e["event"] for e in records if e["kind"] == "event"]
+    # shrink fires on this shape (harmless if not: schema still holds),
+    # and every shrink event carries the active-set transition
+    for e in records:
+        if e.get("event") == "shrink":
+            assert e["n_active_before"] > e["n_active_after"]
+
+
+def test_checkpoint_event_recorded(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "ck.jsonl")
+    ck = str(tmp_path / "state.npz")
+    train(x, y, SVMConfig(c=1.0, gamma=0.5, max_iter=20_000,
+                          chunk_iters=64, checkpoint_path=ck,
+                          checkpoint_every=128, trace_out=path))
+    events = [r["event"] for r in load_trace(path)
+              if r["kind"] == "event"]
+    assert "checkpoint" in events
+
+
+def test_growth_swap_event_and_no_alpha_pull(tmp_path, monkeypatch):
+    """The growth hook reads n_sv from the already-fetched packed stats
+    — never from the carry's alpha (which, pipelined, would block on
+    the just-dispatched speculative chunk)."""
+    import dpsvm_tpu.solver.decomp as decomp
+    from dpsvm_tpu.data.synthetic import make_planted
+
+    x, y = make_planted(800, 16, gamma=0.5, seed=3, noise=0.08)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 128)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 128)
+    path = str(tmp_path / "grow.jsonl")
+    r = train(x, y, SVMConfig(c=50.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=32,
+                              grow_working_set=True, chunk_iters=128,
+                              trace_out=path))
+    assert r.converged
+    events = [e["event"] for e in load_trace(path)
+              if e["kind"] == "event"]
+    assert "program_swap" in events
+
+
+# --------------------------------------------------------------- report
+
+def test_report_round_trip(tmp_path, blobs_small, capsys):
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    result = train(x, y, SVMConfig(c=1.0, gamma=0.5, max_iter=20_000,
+                                   chunk_iters=64, cache_size=8,
+                                   trace_out=path))
+    from dpsvm_tpu.cli import main
+    assert main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "run: smo" in out
+    assert "converged at iter" in out
+    assert "hit rate" in out
+    assert "convergence (gap vs iteration" in out
+
+    assert main(["report", path, "--json"]) == 0
+    digest = json.loads(capsys.readouterr().out)
+    assert digest["summary"]["n_iter"] == result.n_iter
+    assert digest["n_chunks"] >= 1
+    assert digest["manifest"]["solver"] == "smo"
+
+
+def test_report_rejects_invalid(tmp_path, capsys):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"kind": "chunk"}) + "\n")
+    from dpsvm_tpu.cli import main
+    assert main(["report", str(bad)]) == 2
+    assert main(["report", str(tmp_path / "absent.jsonl")]) == 2
+
+
+def test_render_handles_minimal_trace():
+    """Acceptance floor: manifest + one chunk + summary renders."""
+    tr_records = None
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "t.jsonl")
+        tr = RunTrace(p, config={"kernel": "linear"}, n=5, d=2,
+                      gamma=0.1, solver="smo")
+        tr.chunk(n_iter=10, b_lo=0.5, b_hi=-0.5)
+        tr.summary(converged=False, n_iter=10, b=0.0, b_lo=0.5,
+                   b_hi=-0.5, n_sv=3, train_seconds=0.1)
+        tr.close()
+        tr_records = load_trace(p)
+    text = render_report(tr_records)
+    assert "NOT converged" in text
+    digest = summarize_trace(tr_records)
+    assert digest["n_chunks"] == 1
+
+
+# ------------------------------------------------------------ guard rails
+
+def test_trace_out_guard_rails(blobs_small):
+    with pytest.raises(ValueError, match="polish"):
+        SVMConfig(polish=True, trace_out="t.jsonl").validate()
+    with pytest.raises(ValueError, match="numpy"):
+        SVMConfig(backend="numpy", trace_out="t.jsonl").validate()
+    # CV shares one config across folds: one path would be overwritten
+    # per fold — rejected like checkpoint/resume
+    from dpsvm_tpu.models.cv import cross_validate
+    x, y = blobs_small
+    with pytest.raises(ValueError, match="trace"):
+        cross_validate(x, y, 3, SVMConfig(max_iter=1000,
+                                          trace_out="t.jsonl"))
