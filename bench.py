@@ -68,6 +68,14 @@ def main() -> None:
     from dpsvm_tpu.utils.backend_guard import enable_compile_cache
     enable_compile_cache()
 
+    # Soak-mode fault injection (docs/ROBUSTNESS.md): BENCH_FAULT_* /
+    # DPSVM_FAULT_* env knobs arm the deterministic injector here, so a
+    # soak run can exercise NaN-poisoned polls etc. on real hardware.
+    # current() resolves the env once and logs the active plan; inert
+    # (one global read) when no knob is set.
+    from dpsvm_tpu.resilience import faultinject
+    faultinject.current()
+
     from bench_common import standin
     from dpsvm_tpu.ops.kernels import row_norms_sq
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry

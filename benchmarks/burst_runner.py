@@ -260,6 +260,13 @@ def run_conv(spec):
     from bench_convergence import convergence_run
     from dpsvm_tpu.config import SVMConfig
 
+    # Ambient BENCH_FAULT_* / DPSVM_FAULT_* soak knobs apply to
+    # in-process tags too: the conv path runs through the shared host
+    # driver, where the injector's poll/NaN/checkpoint faults fire
+    # (docs/ROBUSTNESS.md). Subprocess tags inherit the env directly.
+    from dpsvm_tpu.resilience import faultinject
+    faultinject.current()
+
     x, y = standin_cached(spec["n"], spec["d"], spec["gamma"])
     trace = trace_path_for(spec)
     os.makedirs(os.path.dirname(trace), exist_ok=True)

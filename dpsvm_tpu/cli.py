@@ -20,8 +20,13 @@ from __future__ import annotations
 
 import argparse
 import math
+import os
 import sys
 from typing import List, Optional
+
+# stdlib-only modules — safe to import before the deferred jax imports.
+from dpsvm_tpu.resilience.health import DivergenceError
+from dpsvm_tpu.resilience.preempt import PREEMPT_EXIT_CODE, PreemptedError
 
 
 def _add_backend_flags(p: argparse.ArgumentParser) -> None:
@@ -92,8 +97,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="solver-state .npz path for periodic checkpoints")
     tr.add_argument("--checkpoint-every", type=int, default=0,
                     help="iterations between checkpoints (0 = off)")
-    tr.add_argument("--resume", default=None,
-                    help="resume training from a checkpoint file")
+    tr.add_argument("--checkpoint-keep", type=int, default=2,
+                    metavar="N",
+                    help="rotation slots kept (state.npz, state.1.npz, "
+                         "...): a corrupt newest file still leaves an "
+                         "intact older state to resume; 1 = no rotation")
+    tr.add_argument("--resume", default=None, type=_existing_checkpoint,
+                    help="resume training from a checkpoint file "
+                         "(validated at parse time; a corrupt file "
+                         "falls back to its newest intact rotation slot)")
+    tr.add_argument("--on-divergence", default="raise",
+                    choices=["raise", "rollback", "ignore"],
+                    help="poll-loop health policy for a sick run "
+                         "(non-finite gap, stagnation, SV collapse): "
+                         "'rollback' restores the newest intact "
+                         "checkpoint and halves the poll chunk "
+                         "(needs --checkpoint)")
+    tr.add_argument("--health-window", type=int, default=0, metavar="I",
+                    help="iterations without best-gap improvement "
+                         "before the stagnation guard trips (0 = off)")
+    tr.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="supervise training in a child process and "
+                         "re-launch up to N times after transient "
+                         "deaths (preemption exit 75, stall/timeout "
+                         "124, SIGTERM/SIGKILL), resuming from the "
+                         "newest intact checkpoint (docs/ROBUSTNESS.md)")
+    tr.add_argument("--retry-backoff", type=float, default=5.0,
+                    metavar="S",
+                    help="base of the exponential retry backoff: "
+                         "attempt k waits S * 2^k seconds (default 5)")
     tr.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace here")
     tr.add_argument("--trace-out", default=None, metavar="PATH",
@@ -343,6 +375,17 @@ def _finite_weight(v: str) -> float:
     return w
 
 
+def _existing_checkpoint(v: str) -> str:
+    """--resume paths are validated at parse time — before the backend
+    probe and the (possibly huge) dataset load — so a typo'd path is a
+    one-line error, not a deferred FileNotFoundError traceback (same
+    policy as the non-finite class-weight rejection)."""
+    if not os.path.isfile(v):
+        raise argparse.ArgumentTypeError(
+            f"no such checkpoint file: {v}")
+    return v
+
+
 def _kernel_name(v: str) -> str:
     """Accept LIBSVM -t integers as aliases for the kernel names; reject
     anything else at parse time (before the dataset is loaded)."""
@@ -572,7 +615,10 @@ def cmd_train(args: argparse.Namespace) -> int:
         verbose=not args.quiet,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
+        checkpoint_keep=args.checkpoint_keep,
         resume_from=args.resume,
+        on_divergence=args.on_divergence,
+        health_window=args.health_window,
         profile_dir=args.profile_dir,
         trace_out=args.trace_out,
         debug_nans=args.debug_nans,
@@ -1090,7 +1136,22 @@ def _init_backend(args: argparse.Namespace) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    raw = list(sys.argv[1:] if argv is None else argv)
     args = build_parser().parse_args(argv)
+    if args.command == "train" and getattr(args, "retries", 0) > 0:
+        # Retry supervisor (resilience/supervisor.py): every attempt is
+        # a child process — that is what lets it recover from the stall
+        # watchdog's os._exit(124) and real SIGTERM preemptions, not
+        # just catchable exceptions. The child runs this same CLI minus
+        # the supervisor flags; the newest intact checkpoint slot is
+        # injected as --resume before every attempt.
+        from dpsvm_tpu.resilience import supervisor
+        child = ([sys.executable, "-m", "dpsvm_tpu.cli"]
+                 + supervisor.strip_flags(raw, ("--retries",
+                                                "--retry-backoff")))
+        return supervisor.supervise(
+            child, retries=args.retries, backoff_s=args.retry_backoff,
+            checkpoint_path=args.checkpoint)
     try:
         if args.command in ("train", "test"):
             rc = _init_backend(args)
@@ -1107,12 +1168,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.command == "report":
             return cmd_report(args)
         return cmd_test(args)
+    except PreemptedError as e:
+        # Resumable by design: the supervisor (or the next manual run)
+        # picks the snapshot up. 75 = EX_TEMPFAIL, the retry cue.
+        print(f"preempted: {e}", file=sys.stderr)
+        return PREEMPT_EXIT_CODE
+    except DivergenceError as e:
+        print(f"error: {e} (see --on-divergence / docs/ROBUSTNESS.md)",
+              file=sys.stderr)
+        return 1
     except FileNotFoundError as e:
         print(f"error: file not found: {e}", file=sys.stderr)
         return 2
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except Exception as e:
+        # CheckpointError (corrupt file with no intact rotation slot)
+        # lives in a module imported lazily with the solvers — resolve
+        # it the same way so `--help` never pays the numpy import.
+        from dpsvm_tpu.utils.checkpoint import CheckpointError
+        if isinstance(e, CheckpointError):
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        raise
 
 
 if __name__ == "__main__":

@@ -178,7 +178,33 @@ class SVMConfig:
     # --- persistence / observability (reference has none — SURVEY §5) ---
     checkpoint_path: Optional[str] = None   # .npz solver-state file
     checkpoint_every: int = 0               # iterations between saves (0=off)
+    checkpoint_keep: int = 2                # rotation slots kept (state.npz,
+                                            # state.1.npz, ...): the newest
+                                            # write can never destroy the
+                                            # only intact state; 1 = no
+                                            # rotation (docs/ROBUSTNESS.md)
     resume_from: Optional[str] = None       # checkpoint to resume from
+                                            # (corrupt file -> automatic
+                                            # fallback to the newest intact
+                                            # rotation slot, traced as a
+                                            # `rollback` event)
+    on_divergence: str = "raise"            # HealthMonitor policy when the
+                                            # poll-loop stats look sick
+                                            # (non-finite gap, stagnation,
+                                            # SV collapse): "raise" fails
+                                            # fast, "rollback" restores the
+                                            # newest intact checkpoint and
+                                            # halves chunk_iters, "ignore"
+                                            # records a trace event only
+    health_window: int = 0                  # iterations without best-gap
+                                            # improvement before the
+                                            # stagnation guard trips; > 0
+                                            # also arms the SV-collapse
+                                            # heuristic. 0 (default) =
+                                            # heuristic guards off; the
+                                            # non-finite-gap guard is
+                                            # ALWAYS armed (a NaN gap is
+                                            # never legitimate)
     profile_dir: Optional[str] = None       # jax.profiler trace output dir
     trace_out: Optional[str] = None         # run-telemetry JSONL path:
                                             # manifest + per-chunk records
@@ -274,6 +300,20 @@ class SVMConfig:
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
         if self.checkpoint_every and not self.checkpoint_path:
             raise ValueError("checkpoint_every set without checkpoint_path")
+        if self.checkpoint_keep < 1:
+            raise ValueError(
+                f"checkpoint_keep must be >= 1, got {self.checkpoint_keep}")
+        if self.on_divergence not in ("raise", "rollback", "ignore"):
+            raise ValueError("on_divergence must be 'raise', 'rollback' "
+                             f"or 'ignore', got {self.on_divergence!r}")
+        if self.health_window < 0:
+            raise ValueError(
+                f"health_window must be >= 0, got {self.health_window}")
+        if self.on_divergence == "rollback" and not self.checkpoint_path:
+            raise ValueError(
+                "on_divergence='rollback' restores the newest intact "
+                "checkpoint; set checkpoint_path (and checkpoint_every) "
+                "so one exists")
         if self.wall_budget_s < 0:
             raise ValueError(
                 f"wall_budget_s must be >= 0, got {self.wall_budget_s}")
@@ -479,7 +519,13 @@ class SVMConfig:
                      "state"),
                     ("profile_dir", bool(self.profile_dir),
                      "the shrinking loop manages its own dispatch; "
-                     "profile the unshrunk path")):
+                     "profile the unshrunk path"),
+                    ("on_divergence", self.on_divergence != "raise",
+                     "the shrinking loop manages its own dispatch; "
+                     "divergence guards ride the shared host driver"),
+                    ("health_window", bool(self.health_window),
+                     "the shrinking loop manages its own dispatch; "
+                     "divergence guards ride the shared host driver")):
                 if bad:
                     raise ValueError(
                         f"shrinking does not support {field}: {what}")
@@ -512,7 +558,9 @@ class SVMConfig:
                 ("resume_from", self.resume_from),
                 ("profile_dir", self.profile_dir),
                 ("trace_out", self.trace_out),
-                ("wall_budget_s", self.wall_budget_s)) if v]
+                ("wall_budget_s", self.wall_budget_s),
+                ("on_divergence", self.on_divergence != "raise"),
+                ("health_window", self.health_window)) if v]
             if unsupported:
                 raise ValueError(
                     f"the numpy backend does not support: {unsupported}")
@@ -587,6 +635,8 @@ def _auto_solver_plan(n: int, d: int, config: "SVMConfig") -> dict:
                             and not config.checkpoint_path
                             and not config.resume_from
                             and not config.profile_dir
+                            and config.on_divergence == "raise"
+                            and not config.health_window
                             and not (config.use_pallas == "on"
                                      and config.working_set == 2))
         plan["shrinking"] = bool(want_shrink and shrink_supported)

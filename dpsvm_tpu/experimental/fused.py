@@ -194,10 +194,26 @@ def train_single_device_fused(x: np.ndarray, y: np.ndarray,
         block_n=block_n, precision_name=precision_name,
         interpret=interpret)
 
+    def carry_from_ckpt(ck):
+        # Divergence-rollback hook (docs/ROBUSTNESS.md): rebuild the
+        # fused carry from checkpoint (alpha, f) — the working set is a
+        # pure function of solver state (init_fused_carry). No budget/
+        # converged mirror dance here: mid-run rollback checkpoints were
+        # written at polls where the gap was still open and n_iter was
+        # under max_iter, so the next dispatched body applies the
+        # recomputed selection exactly like the smo path's next body.
+        a = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(
+            jnp.asarray(ck.alpha, jnp.float32))
+        ff = (-yd).at[0, :n].set(jnp.asarray(ck.f, jnp.float32))
+        c2 = init_fused_carry(a, ff, yd, float(config.c))._replace(
+            n_iter=jnp.int32(ck.n_iter))
+        return jax.device_put(c2, device) if device is not None else c2
+
     return host_training_loop(
         config, gamma, n, d, carry,
         step_chunk=lambda s, lim: run(s, xd, x2, yd, np.int32(lim)),
         carry_to_host=lambda s: (np.asarray(s.alpha[0, :n]),
                                  np.asarray(s.f[0, :n])),
         it0=int(ckpt.n_iter) if ckpt is not None else 0,
+        carry_from_ckpt=carry_from_ckpt,
     )

@@ -321,6 +321,22 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
     else:
         poll_hook = None
 
+    def carry_from_ckpt(ck):
+        # Divergence-rollback hook (docs/ROBUSTNESS.md): sharded carry
+        # from checkpoint state, rounds counter restarting at 0
+        # (telemetry, not solver state).
+        a0 = np.zeros((n_s * mesh.devices.size,), np.float32)
+        a0[:n] = np.asarray(ck.alpha, np.float32)
+        f0 = np.zeros((n_s * mesh.devices.size,), np.float32)
+        f0[:n] = np.asarray(ck.f, np.float32)
+        return DistDecompCarry(
+            alpha=jax.device_put(a0, shard),
+            f=jax.device_put(f0, shard),
+            b_hi=jax.device_put(np.float32(ck.b_hi), repl),
+            b_lo=jax.device_put(np.float32(ck.b_lo), repl),
+            n_iter=jax.device_put(np.int32(ck.n_iter), repl),
+            rounds=jax.device_put(np.int32(0), repl))
+
     return host_training_loop(
         config, gamma, n, d, carry,
         step_chunk=build(q),
@@ -328,4 +344,5 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
                                   to_host(cr.f)[:n]),
         it0=int(init[4]),
         poll_hook=poll_hook,
+        carry_from_ckpt=carry_from_ckpt,
     )

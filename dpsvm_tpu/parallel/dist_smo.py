@@ -585,10 +585,32 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         limit = jax.device_put(np.int32(lim), repl)
         return runner(c, xd, yd, x2, validd, limit)
 
+    def carry_from_ckpt(ck):
+        # Divergence-rollback hook (docs/ROBUSTNESS.md): rebuild the
+        # sharded carry from checkpoint state — same padding as the
+        # resume path above, cache cold like a resume.
+        a0 = np.zeros((n_s * p,), np.float32)
+        a0[:n] = np.asarray(ck.alpha, np.float32)
+        f0 = np.zeros((n_s * p,), np.float32)
+        f0[:n] = np.asarray(ck.f, np.float32)
+        return DistCarry(
+            alpha=jax.device_put(a0, shard),
+            f=jax.device_put(f0, shard),
+            b_hi=jax.device_put(np.float32(ck.b_hi), repl),
+            b_lo=jax.device_put(np.float32(ck.b_lo), repl),
+            n_iter=jax.device_put(np.int32(ck.n_iter), repl),
+            ck=jax.device_put(np.full((p * lines,), -1, np.int32), shard),
+            cs=jax.device_put(np.zeros((p * lines,), np.int32), shard),
+            cr=jax.device_put(np.zeros((p * lines, n_s), np.float32),
+                              row_shard),
+            ch=jax.device_put(np.int32(0), repl),
+            cm=jax.device_put(np.int32(0), repl))
+
     return host_training_loop(
         config, gamma, n, d, carry,
         step_chunk=step_chunk,
         carry_to_host=lambda c: (to_host(c.alpha)[:n],
                                  to_host(c.f)[:n]),
         it0=int(init[4]),
+        carry_from_ckpt=carry_from_ckpt,
     )

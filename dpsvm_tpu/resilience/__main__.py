@@ -1,0 +1,41 @@
+"""CLI gate: ``python -m dpsvm_tpu.resilience --selfcheck``.
+
+Runs on CPU without any accelerator (forces JAX_PLATFORMS=cpu when the
+ambient env doesn't pin a platform) — the CI twin of
+``python -m dpsvm_tpu.telemetry --selfcheck``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.resilience")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="injector + supervisor round-trip on a tiny "
+                        "problem; asserts the resumed trajectory is "
+                        "bitwise-identical to an uninterrupted run")
+    args = p.parse_args(argv)
+    if not args.selfcheck:
+        p.print_help()
+        return 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dpsvm_tpu.resilience import selfcheck
+
+    problems = selfcheck()
+    if problems:
+        print("resilience selfcheck FAILED:", file=sys.stderr)
+        for pr in problems:
+            print(f"  {pr}", file=sys.stderr)
+        return 1
+    print("resilience selfcheck OK (preempt + retry + rotation "
+          "fallback, bitwise-identical resume)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Every failure mode the resilience stack handles — preemption signals,
+corrupted/failed checkpoint writes, non-finite solver state — is rare
+and timing-dependent in the wild, so each one has a deterministic
+injection point that fires at an exact, configured moment. That makes
+the whole subsystem testable in CI on CPU (tests/test_resilience.py,
+``python -m dpsvm_tpu.resilience --selfcheck``) and soakable on real
+hardware (``BENCH_FAULT_*`` through bench.py / benchmarks/
+burst_runner.py).
+
+Knobs (env: ``DPSVM_FAULT_*``, with ``BENCH_FAULT_*`` accepted as
+aliases so benchmark harness configs stay in the BENCH_ namespace; API:
+``install(FaultPlan(...))``):
+
+* ``DPSVM_FAULT_CHECKPOINT_WRITE=k`` — the k-th (1-based)
+  ``save_checkpoint`` call in this process fails after the tmp write,
+  before the rename (exercises atomicity + rotation fallback);
+* ``DPSVM_FAULT_NAN_ITER=j`` — the first stats poll observing
+  ``n_iter >= j`` reports a NaN gap (exercises the HealthMonitor's
+  non-finite detection and the rollback policy);
+* ``DPSVM_FAULT_PREEMPT_POLL=m`` — the m-th (1-based) host poll raises
+  a simulated preemption (exercises snapshot + resumable exit + retry
+  supervisor without OS signal timing races).
+
+Each fault fires exactly ONCE per process: counters live on the
+process-global plan, so a supervisor retry inside the same process (or
+a resumed attempt) runs clean after the injected failure — which is
+exactly the transient-fault model the subsystem exists for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional
+
+
+class InjectedFaultError(OSError):
+    """Raised by the checkpoint-write injection point (an OSError, like
+    the real failures it stands in for)."""
+
+
+def _log(msg: str) -> None:
+    print(f"FAULTINJECT: {msg}", file=sys.stderr, flush=True)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    fail_checkpoint_write: int = 0   # 1-based save counter; 0 = off
+    nan_at_iter: int = 0             # poison first poll with n_iter >= j
+    preempt_at_poll: int = 0         # 1-based host-poll counter
+
+    # process-lifetime counters (fire-once semantics)
+    _writes: int = 0
+    _polls: int = 0
+    _nan_fired: bool = False
+
+    def any(self) -> bool:
+        return bool(self.fail_checkpoint_write or self.nan_at_iter
+                    or self.preempt_at_poll)
+
+    def note_checkpoint_write(self, path: str) -> None:
+        self._writes += 1
+        if (self.fail_checkpoint_write
+                and self._writes == self.fail_checkpoint_write):
+            _log(f"failing checkpoint write #{self._writes} -> {path}")
+            raise InjectedFaultError(
+                f"injected checkpoint-write failure #{self._writes}")
+
+    def note_poll(self) -> bool:
+        """True exactly at the configured poll — the driver then
+        simulates a preemption signal."""
+        self._polls += 1
+        if self.preempt_at_poll and self._polls == self.preempt_at_poll:
+            _log(f"simulating preemption at poll #{self._polls}")
+            return True
+        return False
+
+    def poison_stats(self, st):
+        """Replace b_lo with NaN on the first qualifying poll (a stand-in
+        for device-state corruption observed at the poll boundary)."""
+        if (self.nan_at_iter and not self._nan_fired
+                and st.n_iter >= self.nan_at_iter):
+            self._nan_fired = True
+            _log(f"poisoning stats with NaN gap at iter {st.n_iter}")
+            return st._replace(b_lo=float("nan"))
+        return st
+
+
+_plan: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def _env_int(name: str) -> int:
+    for prefix in ("DPSVM_FAULT_", "BENCH_FAULT_"):
+        v = os.environ.get(prefix + name, "").strip()
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                _log(f"ignoring non-integer {prefix}{name}={v!r}")
+    return 0
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    p = FaultPlan(
+        fail_checkpoint_write=_env_int("CHECKPOINT_WRITE"),
+        nan_at_iter=_env_int("NAN_ITER"),
+        preempt_at_poll=_env_int("PREEMPT_POLL"))
+    return p if p.any() else None
+
+
+def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Explicitly set (or with None, clear) the process fault plan —
+    the API-level seam tests use instead of env vars."""
+    global _plan, _env_checked
+    _plan = plan
+    _env_checked = True
+    return plan
+
+
+def clear() -> None:
+    global _plan, _env_checked
+    _plan = None
+    _env_checked = False
+
+
+def current() -> Optional[FaultPlan]:
+    """The active plan: an installed one, else env-configured (resolved
+    once per process), else None. The no-fault path costs one global
+    read."""
+    global _plan, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        _plan = plan_from_env()
+        if _plan is not None:
+            _log(f"active plan: {_plan}")
+    return _plan
+
+
+def on_checkpoint_write(path: str) -> None:
+    """save_checkpoint's injection point (utils/checkpoint.py)."""
+    p = current()
+    if p is not None:
+        p.note_checkpoint_write(path)

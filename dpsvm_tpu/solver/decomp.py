@@ -378,6 +378,17 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
     if alpha_init is not None:
         carry = carry._replace(alpha=np.asarray(alpha_init, np.float32))
 
+    def carry_from_ckpt(ck):
+        # Initial resume AND the driver's divergence rollback
+        # (docs/ROBUSTNESS.md). The rounds counter restarts at 0 — it is
+        # telemetry, not solver state, like the checkpoint format says.
+        c2 = init_carry(np.asarray(y, np.float32))._replace(
+            alpha=np.asarray(ck.alpha, np.float32),
+            f=np.asarray(ck.f, np.float32),
+            b_hi=np.float32(ck.b_hi), b_lo=np.float32(ck.b_lo),
+            n_iter=np.int32(ck.n_iter))
+        return jax.device_put(c2, device) if device is not None else c2
+
     ckpt = resume_state(config, n, d, gamma)
     if ckpt is not None:
         carry = carry._replace(
@@ -407,6 +418,7 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
         carry_to_host=lambda cr: (np.asarray(cr.alpha), np.asarray(cr.f)),
         it0=int(ckpt.n_iter) if ckpt is not None else 0,
         poll_hook=poll_hook,
+        carry_from_ckpt=carry_from_ckpt,
     )
 
 

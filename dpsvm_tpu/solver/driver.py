@@ -37,6 +37,10 @@ run waiting on polls. Two fixes live here:
 from __future__ import annotations
 
 import contextlib
+import math
+import os
+import signal
+import sys
 import time
 from typing import Callable, NamedTuple, Optional
 
@@ -45,21 +49,65 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.resilience import faultinject, preempt
+from dpsvm_tpu.resilience.health import DivergenceError, HealthMonitor
 from dpsvm_tpu.utils import watchdog
-from dpsvm_tpu.utils.checkpoint import (SolverCheckpoint, load_checkpoint,
-                                        maybe_checkpoint)
+from dpsvm_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                        CheckpointError, SolverCheckpoint,
+                                        checkpoint_candidates,
+                                        load_checkpoint, maybe_checkpoint,
+                                        newest_intact_checkpoint,
+                                        save_checkpoint)
 from dpsvm_tpu.utils.logging import log_progress
 from dpsvm_tpu.utils.timing import PhaseTimer
+
+# Lifecycle facts that become known BEFORE the run trace exists — a
+# resume that skipped corrupt rotation slots, a supervisor retry — queue
+# here and are drained into the trace right after the manifest
+# (begin_trace). Process-local, consumed per trace.
+_PENDING_TRACE_EVENTS: list = []
+
+
+def queue_trace_event(event: str, **extra) -> None:
+    _PENDING_TRACE_EVENTS.append((event, extra))
 
 
 def resume_state(config: SVMConfig, n: int, d: int, gamma: float
                  ) -> Optional[SolverCheckpoint]:
-    """Load + validate the resume checkpoint if one is configured."""
+    """Load + validate the resume checkpoint if one is configured.
+
+    A corrupt ``resume_from`` (truncated, bit-flipped — anything
+    ``load_checkpoint`` rejects) falls back to the newest intact
+    rotation slot (``state.1.npz``, …), logging what was skipped and
+    queueing a ``rollback`` trace event for the run. Only when EVERY
+    slot is unreadable does the error propagate; an intact checkpoint
+    for the wrong problem/config always raises (that is permanent, not
+    transient)."""
     if not config.resume_from:
         return None
-    ckpt = load_checkpoint(config.resume_from)
-    ckpt.validate_against(n, d, config, gamma)
-    return ckpt
+    skipped = []
+    last_err: Optional[CheckpointError] = None
+    for path in checkpoint_candidates(config.resume_from):
+        try:
+            ckpt = load_checkpoint(path)
+        except CheckpointCorruptError as e:
+            print(f"WARNING: {e}; trying older rotation slot",
+                  file=sys.stderr, flush=True)
+            skipped.append(path)
+            last_err = e
+            continue
+        ckpt.validate_against(n, d, config, gamma)
+        if skipped:
+            queue_trace_event("rollback", n_iter=ckpt.n_iter,
+                              reason="corrupt checkpoint on resume",
+                              checkpoint=path, skipped=skipped)
+            print(f"WARNING: resuming from rotation slot {path} "
+                  f"(skipped corrupt: {skipped})",
+                  file=sys.stderr, flush=True)
+        return ckpt
+    raise CheckpointError(
+        f"no intact checkpoint to resume: {config.resume_from} and "
+        f"every rotation slot failed ({skipped})") from last_err
 
 
 @contextlib.contextmanager
@@ -118,13 +166,19 @@ def pack_stats(n_iter, b_lo, b_hi, n_sv=None, cache_hits=None,
 def read_stats(stats) -> ChunkStats:
     """Block until the chunk's packed stats land, then unpack. Tolerates
     the legacy (3,) layout (counters read as 0) so older callers and
-    recorded arrays stay readable."""
+    recorded arrays stay readable. The deterministic NaN fault
+    (resilience/faultinject.py) poisons the result HERE — the one point
+    every consumer (driver loop, benchmarks) reads device state."""
     s = np.asarray(stats)       # blocks until the chunk's stats land
     watchdog.pet()
     b = s[1:3].view(np.float32)
     extra = [int(v) for v in s[3:STATS_WIDTH]]
     extra += [0] * (4 - len(extra))
-    return ChunkStats(int(s[0]), float(b[0]), float(b[1]), *extra)
+    st = ChunkStats(int(s[0]), float(b[0]), float(b[1]), *extra)
+    plan = faultinject.current()
+    if plan is not None:
+        st = plan.poison_stats(st)
+    return st
 
 
 def _read_stats(stats) -> tuple:
@@ -132,6 +186,12 @@ def _read_stats(stats) -> tuple:
     convergence (benchmarks, older tests)."""
     s = read_stats(stats)
     return s.n_iter, s.b_lo, s.b_hi
+
+
+def _finite_converged(b_lo: float, b_hi: float, eps: float) -> bool:
+    """The driver's convergence verdict: gap closed AND finite."""
+    return (math.isfinite(b_lo) and math.isfinite(b_hi)
+            and not (b_lo > b_hi + 2.0 * eps))
 
 
 def device_sv_count(alpha):
@@ -157,12 +217,22 @@ def begin_trace(config: SVMConfig, n: int, d: int, gamma: float,
                 solver: str, it0: int = 0):
     """RunTrace for this run, or None when tracing is off. Shared with
     the shrinking manager (solver/shrink.py) so every producer writes
-    the one schema."""
+    the one schema. Drains the pending-event queue (resume fallbacks,
+    supervisor retries) right after the manifest; a subprocess-mode
+    retry announces itself via ``DPSVM_RETRY_ATTEMPT``
+    (resilience/supervisor.py)."""
+    pending, _PENDING_TRACE_EVENTS[:] = _PENDING_TRACE_EVENTS[:], []
     if not getattr(config, "trace_out", None):
         return None
     from dpsvm_tpu.telemetry import RunTrace
-    return RunTrace(config.trace_out, config=config, n=n, d=d,
-                    gamma=gamma, solver=solver, it0=it0, env=trace_env())
+    trace = RunTrace(config.trace_out, config=config, n=n, d=d,
+                     gamma=gamma, solver=solver, it0=it0, env=trace_env())
+    attempt = os.environ.get("DPSVM_RETRY_ATTEMPT", "").strip()
+    if attempt.isdigit():
+        trace.event("retry", n_iter=it0, attempt=int(attempt))
+    for event, extra in pending:
+        trace.event(event, **extra)
+    return trace
 
 
 def host_training_loop(
@@ -175,6 +245,7 @@ def host_training_loop(
     carry_to_host: Callable,        # carry -> (alpha, f) np arrays
     it0: int = 0,                   # carry's entry iteration (0 or resume)
     poll_hook: Optional[Callable] = None,
+    carry_from_ckpt: Optional[Callable] = None,
 ) -> TrainResult:
     """Run chunks until convergence / max_iter; return the TrainResult.
 
@@ -192,6 +263,26 @@ def host_training_loop(
     With ``config.trace_out`` set, every poll appends a chunk record to
     the run trace (manifest/chunk/summary schema: utils/trace.py) —
     all of it read from the ONE packed-stats transfer above.
+
+    Resilience (docs/ROBUSTNESS.md) — every solver path gets it here:
+
+    * a SIGTERM/SIGINT during the loop (resilience/preempt.trap) is
+      deferred to the next poll boundary, where the loop snapshots a
+      final checkpoint, emits a ``preempt`` trace event and raises
+      ``PreemptedError`` (CLI exit 75, the supervisor's resume cue).
+      Pipelined dispatch STAYS pipelined: only when a signal is
+      actually pending does the loop read the in-flight speculative
+      chunk's stats, which both sequentializes that one poll and makes
+      the snapshot consistent with the carry it describes;
+    * every poll's stats feed a HealthMonitor (resilience/health.py) —
+      non-finite gap, stagnation, SV collapse. Policy
+      ``config.on_divergence``: raise / rollback / ignore. ``rollback``
+      restores the newest intact checkpoint through ``carry_from_ckpt``
+      (a solver-provided callback rebuilding a device carry from a
+      SolverCheckpoint; paths that omit it degrade rollback to raise)
+      and continues with a halved ``chunk_iters``;
+    * deterministic faults (resilience/faultinject.py) fire at their
+      configured poll/iteration, so all of the above runs in CI on CPU.
     """
     eps = float(config.epsilon)
     chunk = config.chunk_iters
@@ -205,6 +296,9 @@ def host_training_loop(
     trace = begin_trace(config, n, d, gamma,
                         SOLVER_NAMES.get(type(carry).__name__,
                                          type(carry).__name__), it0)
+    monitor = HealthMonitor(policy=config.on_divergence,
+                            window=config.health_window)
+    faults = faultinject.current()
     # Host-loop accounting, not device time: "dispatch" buckets the
     # (async) enqueue calls, "poll" the blocking stats reads — device
     # execution overlaps both in pipelined mode. The buckets ride every
@@ -219,8 +313,21 @@ def host_training_loop(
     # Setup (data gen, H2D, host norms) is done once we get here; give
     # the stall watchdog a fresh window for the first chunk's compile.
     watchdog.pet()
+
+    def snapshot(n_iter: int, b_lo: float, b_hi: float) -> SolverCheckpoint:
+        # Closure over the loop's CURRENT carry (the cell, not a copy).
+        alpha, f = carry_to_host(carry)
+        return SolverCheckpoint(
+            alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo, b_hi=b_hi,
+            c=float(config.c), gamma=gamma,
+            epsilon=float(config.epsilon), n=n, d=d,
+            weight_pos=float(config.weight_pos),
+            weight_neg=float(config.weight_neg),
+            kernel=config.kernel, coef0=float(config.coef0),
+            degree=int(config.degree))
+
     try:
-        with profile, _debug_nans(config.debug_nans):
+        with profile, _debug_nans(config.debug_nans), preempt.trap():
             limit = min(it0 + chunk, config.max_iter)
             with timer.phase("dispatch"):
                 carry, stats = step_chunk(carry, limit)
@@ -237,8 +344,15 @@ def host_training_loop(
 
                 with timer.phase("poll"):
                     st = read_stats(stats)
+                if faults is not None and faults.note_poll():
+                    preempt.simulate(signal.SIGTERM)
                 n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
-                converged = not (b_lo > b_hi + 2.0 * eps)
+                # Finite-aware: every NaN comparison is False, so a
+                # plain `not (b_lo > ...)` would declare a NaN gap
+                # CONVERGED and return garbage marked success. A
+                # non-finite gap is never converged — it loops into the
+                # HealthMonitor below instead.
+                converged = _finite_converged(b_lo, b_hi, eps)
                 done = converged or n_iter >= config.max_iter
                 if (not done and config.wall_budget_s
                         and time.perf_counter() - t0
@@ -252,10 +366,45 @@ def host_training_loop(
                         with timer.phase("poll"):
                             st = read_stats(next_stats)
                         n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
-                        converged = not (b_lo > b_hi + 2.0 * eps)
+                        converged = _finite_converged(b_lo, b_hi, eps)
                     done = True
                     if trace is not None:
                         trace.event("wall_budget", n_iter=n_iter)
+
+                if not done and preempt.pending() is not None:
+                    # Preemption snapshot. A completed run ignores the
+                    # signal (its artifacts are about to be written —
+                    # that IS beating the preemption deadline).
+                    if pipeline:
+                        # Sequential fallback only NOW: the carry is the
+                        # in-flight speculative chunk's output, so its
+                        # stats — not the ones just polled — describe
+                        # the state being snapshotted.
+                        with timer.phase("poll"):
+                            st = read_stats(next_stats)
+                        n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
+                    signum = preempt.pending()
+                    saved_to = None
+                    if config.checkpoint_path:
+                        try:
+                            with timer.phase("checkpoint"):
+                                save_checkpoint(
+                                    config.checkpoint_path,
+                                    snapshot(n_iter, b_lo, b_hi),
+                                    keep=config.checkpoint_keep)
+                            saved_to = config.checkpoint_path
+                        except (OSError, CheckpointError) as e:
+                            print(f"WARNING: preemption snapshot failed "
+                                  f"({e}); previous checkpoint kept",
+                                  file=sys.stderr, flush=True)
+                    log_progress(config, n_iter, b_lo, b_hi, final=True,
+                                 prev_iter=prev_polled)
+                    if trace is not None:
+                        trace.event("preempt", n_iter=n_iter,
+                                    signal=int(signum),
+                                    checkpoint=saved_to)
+                    raise preempt.PreemptedError(signum, n_iter,
+                                                 saved_to)
 
                 log_progress(config, n_iter, b_lo, b_hi, final=done,
                              prev_iter=prev_polled)
@@ -267,6 +416,72 @@ def host_training_loop(
                                 rounds=st.rounds,
                                 phases=dict(timer.seconds))
 
+                # Divergence guards — BEFORE maybe_checkpoint, so a sick
+                # state is never saved over a good rotation slot.
+                reason = None if done else monitor.check(
+                    n_iter=n_iter, b_lo=b_lo, b_hi=b_hi, n_sv=st.n_sv)
+                if reason is not None:
+                    policy = monitor.policy
+                    if policy == "rollback" and (
+                            carry_from_ckpt is None
+                            or not config.checkpoint_path
+                            or monitor.exhausted):
+                        why = ("rollback budget exhausted"
+                               if monitor.exhausted else
+                               "this solver path has no rollback hook"
+                               if carry_from_ckpt is None else
+                               "no checkpoint_path configured")
+                        print(f"WARNING: divergence policy 'rollback' "
+                              f"unavailable ({why}); raising",
+                              file=sys.stderr, flush=True)
+                        policy = "raise"
+                    if policy == "ignore":
+                        print(f"WARNING: {reason} at iter {n_iter} "
+                              "(on_divergence='ignore')",
+                              file=sys.stderr, flush=True)
+                        if trace is not None:
+                            trace.event("divergence", n_iter=n_iter,
+                                        reason=reason, action="ignore")
+                    elif policy == "raise":
+                        if trace is not None:
+                            trace.event("divergence", n_iter=n_iter,
+                                        reason=reason, action="raise")
+                        raise DivergenceError(reason, n_iter)
+                    else:
+                        best, skipped = newest_intact_checkpoint(
+                            config.checkpoint_path)
+                        if best is None:
+                            raise DivergenceError(
+                                f"{reason}; rollback found no intact "
+                                f"checkpoint (skipped {skipped})", n_iter)
+                        ck = load_checkpoint(best)
+                        ck.validate_against(n, d, config, gamma)
+                        carry = carry_from_ckpt(ck)
+                        chunk = max(chunk // 2, 1)
+                        monitor.note_rollback(ck.n_iter)
+                        print(f"WARNING: {reason} at iter {n_iter}; "
+                              f"rolled back to {best} (iter "
+                              f"{ck.n_iter}), chunk_iters now {chunk}",
+                              file=sys.stderr, flush=True)
+                        if trace is not None:
+                            trace.event("rollback", n_iter=ck.n_iter,
+                                        reason=reason, checkpoint=best,
+                                        skipped=skipped,
+                                        chunk_iters=chunk)
+                        n_iter = prev_polled = ck.n_iter
+                        last_saved = ck.n_iter
+                        # Dispatch the restored carry and re-enter the
+                        # poll loop. Works in BOTH loop modes: pipelined
+                        # (checkpoint_every=0 with a resume/preempt
+                        # snapshot on disk) re-enters at the top, which
+                        # dispatches the next speculative chunk from
+                        # this limit; the in-flight chunk of the sick
+                        # carry is simply never read.
+                        limit = min(n_iter + chunk, config.max_iter)
+                        with timer.phase("dispatch"):
+                            carry, stats = step_chunk(carry, limit)
+                        continue
+
                 if poll_hook is not None and not done:
                     with timer.phase("hook"):
                         replacement = poll_hook(n_iter, carry, st)
@@ -276,16 +491,7 @@ def host_training_loop(
                             trace.event("program_swap", n_iter=n_iter)
 
                 def make() -> SolverCheckpoint:
-                    alpha, f = carry_to_host(carry)
-                    return SolverCheckpoint(
-                        alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo,
-                        b_hi=b_hi,
-                        c=float(config.c), gamma=gamma,
-                        epsilon=float(config.epsilon), n=n, d=d,
-                        weight_pos=float(config.weight_pos),
-                        weight_neg=float(config.weight_neg),
-                        kernel=config.kernel, coef0=float(config.coef0),
-                        degree=int(config.degree))
+                    return snapshot(n_iter, b_lo, b_hi)
 
                 with timer.phase("checkpoint"):
                     saved = maybe_checkpoint(config, last_saved, n_iter,

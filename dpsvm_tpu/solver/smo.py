@@ -336,6 +336,19 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     if alpha_init is not None:
         carry = carry._replace(alpha=np.asarray(alpha_init, np.float32))
 
+    def carry_from_ckpt(ck):
+        # Shared by the initial resume and the driver's divergence
+        # rollback (docs/ROBUSTNESS.md): a fresh carry from checkpoint
+        # state, cache cold (the checkpoint holds only solver state,
+        # like the reference's model file holds no cache).
+        c2 = init_carry(np.asarray(y, np.float32),
+                        config.cache_size)._replace(
+            alpha=np.asarray(ck.alpha, np.float32),
+            f=np.asarray(ck.f, np.float32),
+            b_hi=np.float32(ck.b_hi), b_lo=np.float32(ck.b_lo),
+            n_iter=np.int32(ck.n_iter))
+        return jax.device_put(c2, device) if device is not None else c2
+
     ckpt = resume_state(config, n, d, gamma)
     if ckpt is not None:
         carry = carry._replace(
@@ -361,4 +374,5 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         step_chunk=lambda c, lim: runner(c, xd, yd, x2, np.int32(lim)),
         carry_to_host=lambda c: (np.asarray(c.alpha), np.asarray(c.f)),
         it0=int(ckpt.n_iter) if ckpt is not None else 0,
+        carry_from_ckpt=carry_from_ckpt,
     )

@@ -28,10 +28,36 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from dpsvm_tpu.utils.trace import (TRACE_SCHEMA_VERSION, TraceWriter,
                                    read_trace, validate_trace)
+
+# Every in-flight RunTrace, so emergency exit paths (the stall watchdog's
+# os._exit) can stamp a terminal event record before the process dies —
+# an abandoned trace with no terminal record is indistinguishable from a
+# live run (docs/ROBUSTNESS.md). Weak: a dropped recorder unregisters
+# itself.
+_OPEN_TRACES: "weakref.WeakSet[RunTrace]" = weakref.WeakSet()
+
+
+def flush_open_traces(event: str, **extra) -> int:
+    """Best-effort: append ``event`` to every still-open trace and close
+    it. Called from exit paths that bypass the driver's finally block
+    (utils/watchdog.py expiry — a different thread, microseconds before
+    os._exit, while the training thread is wedged in a device call, so
+    a concurrent write is not a practical concern). Returns the number
+    of traces flushed; never raises."""
+    count = 0
+    for tr in list(_OPEN_TRACES):
+        try:
+            tr.event(event, **extra)
+            tr.close()
+            count += 1
+        except Exception:
+            pass
+    return count
 
 # Carry-class -> human solver-path name (the driver keys the manifest on
 # the carry type; one table so a new solver fails loudly in tests, not
@@ -95,6 +121,7 @@ class RunTrace:
             "it0": int(it0),
             "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         })
+        _OPEN_TRACES.add(self)
 
     @property
     def path(self) -> str:
@@ -169,6 +196,7 @@ class RunTrace:
 
     def close(self) -> None:
         self._closed = True
+        _OPEN_TRACES.discard(self)
         self._w.close()
 
 

@@ -1,16 +1,29 @@
-"""Mid-training checkpoint / resume.
+"""Mid-training checkpoint / resume, hardened for preemptible hosts.
 
 The reference has NO mid-training persistence — its only artifact is the
 final model file, and a killed `mpirun` job loses everything (SURVEY §5).
-The complete solver state here is tiny — two n-vectors (alpha, f) plus
-three scalars — so checkpoints are a single .npz written every
+The complete solver state here is tiny — two n-vectors (alpha, f) plus a
+handful of scalars — so checkpoints are a single .npz written every
 ``checkpoint_every`` iterations from the host polling loop, and a resumed
 run continues the identical trajectory: the loop condition depends only on
 (alpha, f, b_lo, b_hi, n_iter), all of which are saved.
 
+Hardening (docs/ROBUSTNESS.md):
+
+* **atomic write** — tmp + rename, so a crash mid-save never corrupts the
+  previous checkpoint;
+* **payload CRC32** — stored inside the .npz and verified on load, so a
+  bit-flipped or truncated file raises ``CheckpointCorruptError`` instead
+  of feeding garbage state back into the solver (or surfacing a raw
+  ``BadZipFile``);
+* **keep-N rotation** — ``save_checkpoint(..., keep=N)`` shifts the
+  previous file to ``state.1.npz``, ``state.2.npz``, … before the rename,
+  so one corrupted newest slot still leaves an intact older state for
+  ``resume_state`` (solver/driver.py) to fall back to.
+
 Hyperparameters are stored alongside the state and verified on load; a
-checkpoint from a different problem shape or config is an error, not a
-silent wrong answer.
+checkpoint from a different problem shape or config raises
+``CheckpointMismatchError`` (a ``ValueError``), not a silent wrong answer.
 """
 
 from __future__ import annotations
@@ -18,14 +31,31 @@ from __future__ import annotations
 import dataclasses
 import os
 import tempfile
-from typing import Optional
+import zlib
+from typing import Callable, List, Optional
 
 import numpy as np
 
 from dpsvm_tpu.config import SVMConfig
 
 # LIBSVM -t order; index = the integer stored in the checkpoint scalars.
-_KERNEL_T = ("linear", "poly", "rbf", "sigmoid")
+# "precomputed" is -t 4 (the row data IS the (n, n) kernel matrix).
+_KERNEL_T = ("linear", "poly", "rbf", "sigmoid", "precomputed")
+
+
+class CheckpointError(Exception):
+    """Base of every checkpoint failure this module raises."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file exists but its payload cannot be trusted: truncated or
+    unreadable .npz, missing arrays, or a CRC32 mismatch."""
+
+
+class CheckpointMismatchError(CheckpointError, ValueError):
+    """An intact checkpoint for a DIFFERENT problem/config. Subclasses
+    ValueError so pre-hardening callers' ``except ValueError`` (and the
+    CLI's one-line error path) keep working."""
 
 
 @dataclasses.dataclass
@@ -48,13 +78,20 @@ class SolverCheckpoint:
 
     def validate_against(self, n: int, d: int, config: SVMConfig,
                          gamma: float) -> None:
+        if self.kernel == "precomputed" and self.n != self.d:
+            # -t 4 trains on the square (n, n) kernel matrix; a
+            # non-square record here is a damaged or hand-edited file.
+            raise CheckpointMismatchError(
+                f"checkpoint kernel='precomputed' must be square (n, n), "
+                f"got ({self.n}, {self.d})")
         if (self.n, self.d) != (n, d):
-            raise ValueError(
+            raise CheckpointMismatchError(
                 f"checkpoint is for a ({self.n}, {self.d}) problem, "
                 f"data is ({n}, {d})")
         if self.kernel != config.kernel:
-            raise ValueError(f"checkpoint kernel={self.kernel!r} != "
-                             f"configured kernel={config.kernel!r}")
+            raise CheckpointMismatchError(
+                f"checkpoint kernel={self.kernel!r} != "
+                f"configured kernel={config.kernel!r}")
         for name, mine, theirs in (
                 ("c", self.c, config.c),
                 ("gamma", self.gamma, gamma),
@@ -64,30 +101,85 @@ class SolverCheckpoint:
                 ("weight_pos", self.weight_pos, config.weight_pos),
                 ("weight_neg", self.weight_neg, config.weight_neg)):
             if abs(mine - theirs) > 1e-12 * max(1.0, abs(mine)):
-                raise ValueError(
+                raise CheckpointMismatchError(
                     f"checkpoint {name}={mine} != configured {name}={theirs}")
 
 
-def save_checkpoint(path: str, ckpt: SolverCheckpoint) -> None:
-    """Atomic write (tmp + rename): a crash mid-save never corrupts the
-    previous checkpoint."""
+def _payload(alpha: np.ndarray, f: np.ndarray,
+             scalars: np.ndarray) -> tuple:
+    return (np.ascontiguousarray(alpha, np.float32),
+            np.ascontiguousarray(f, np.float32),
+            np.ascontiguousarray(scalars, np.float64))
+
+
+def _crc32(alpha: np.ndarray, f: np.ndarray, scalars: np.ndarray) -> int:
+    crc = zlib.crc32(alpha.tobytes())
+    crc = zlib.crc32(f.tobytes(), crc)
+    return zlib.crc32(scalars.tobytes(), crc)
+
+
+def rotation_path(path: str, k: int) -> str:
+    """Slot k of a rotation set: ``state.npz`` -> ``state.1.npz``.
+    k=0 is the path itself."""
+    if k == 0:
+        return path
+    base, ext = os.path.splitext(path)
+    return f"{base}.{k}{ext}" if ext else f"{path}.{k}"
+
+
+def checkpoint_candidates(path: str, limit: int = 100) -> List[str]:
+    """Existing rotation slots, newest first: [path, path.1, ...]. The
+    primary path is listed even when absent (so the caller's error names
+    what was asked for); rotated slots only when present."""
+    out = [path]
+    for k in range(1, limit):
+        p = rotation_path(path, k)
+        if not os.path.exists(p):
+            break
+        out.append(p)
+    return out
+
+
+def _rotate(path: str, keep: int) -> None:
+    """Shift path -> path.1 -> ... keeping ``keep`` files total (the
+    about-to-be-written newest counts as one)."""
+    if keep <= 1 or not os.path.exists(path):
+        return
+    for k in range(keep - 1, 0, -1):
+        src = rotation_path(path, k - 1)
+        if os.path.exists(src):
+            os.replace(src, rotation_path(path, k))
+
+
+def save_checkpoint(path: str, ckpt: SolverCheckpoint,
+                    keep: int = 1) -> None:
+    """Atomic write (tmp + rename) with an embedded payload CRC32;
+    ``keep > 1`` rotates the previous file(s) to ``.1``/``.2``/… slots
+    first, so the newest write can never destroy the only intact state."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
+    alpha, f, scalars = _payload(
+        ckpt.alpha, ckpt.f,
+        np.asarray(
+            [ckpt.n_iter, ckpt.b_lo, ckpt.b_hi, ckpt.c, ckpt.gamma,
+             ckpt.epsilon, ckpt.n, ckpt.d, ckpt.weight_pos,
+             ckpt.weight_neg,
+             # kernel family encoded as the LIBSVM -t integer
+             _KERNEL_T.index(ckpt.kernel), ckpt.coef0,
+             ckpt.degree], np.float64))
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as fh:
-            np.savez(
-                fh,
-                alpha=np.asarray(ckpt.alpha, np.float32),
-                f=np.asarray(ckpt.f, np.float32),
-                scalars=np.asarray(
-                    [ckpt.n_iter, ckpt.b_lo, ckpt.b_hi, ckpt.c, ckpt.gamma,
-                     ckpt.epsilon, ckpt.n, ckpt.d, ckpt.weight_pos,
-                     ckpt.weight_neg,
-                     # kernel family encoded as the LIBSVM -t integer
-                     _KERNEL_T.index(ckpt.kernel), ckpt.coef0,
-                     ckpt.degree], np.float64),
-            )
+            np.savez(fh, alpha=alpha, f=f, scalars=scalars,
+                     crc32=np.asarray([_crc32(alpha, f, scalars)],
+                                      np.uint32))
+        # Deterministic fault injection (resilience/faultinject.py) fires
+        # HERE — after the tmp write, before the rename — so an injected
+        # "write failed" exercises both the tmp cleanup and the
+        # old-file-stays-intact guarantee.
+        from dpsvm_tpu.resilience import faultinject
+        faultinject.on_checkpoint_write(path)
+        _rotate(path, keep)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -96,32 +188,86 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint) -> None:
 
 
 def load_checkpoint(path: str) -> SolverCheckpoint:
-    with np.load(path) as z:
-        s = z["scalars"]
-        return SolverCheckpoint(
-            alpha=z["alpha"], f=z["f"],
-            n_iter=int(s[0]), b_lo=float(s[1]), b_hi=float(s[2]),
-            c=float(s[3]), gamma=float(s[4]), epsilon=float(s[5]),
-            n=int(s[6]), d=int(s[7]),
-            # files from before class weights existed carry 8 scalars;
-            # from before kernel families, 10
-            weight_pos=float(s[8]) if len(s) > 8 else 1.0,
-            weight_neg=float(s[9]) if len(s) > 9 else 1.0,
-            kernel=_KERNEL_T[int(s[10])] if len(s) > 10 else "rbf",
-            coef0=float(s[11]) if len(s) > 11 else 0.0,
-            degree=int(s[12]) if len(s) > 12 else 3,
-        )
+    """Read + integrity-check one checkpoint file.
+
+    Raises ``FileNotFoundError`` for a missing path and
+    ``CheckpointCorruptError`` for anything unreadable: truncated or
+    empty file, bad zip structure, missing arrays, or CRC mismatch.
+    Files written before the CRC field existed load without the check.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        with np.load(path) as z:
+            alpha = np.asarray(z["alpha"], np.float32)
+            f = np.asarray(z["f"], np.float32)
+            s = np.asarray(z["scalars"], np.float64)
+            stored_crc = (int(np.asarray(z["crc32"]).ravel()[0])
+                          if "crc32" in z.files else None)
+    except FileNotFoundError:
+        raise
+    except Exception as e:     # BadZipFile, EOFError, KeyError, ValueError…
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint {path}: {type(e).__name__}: {e}") from e
+    if stored_crc is not None:
+        actual = _crc32(*_payload(alpha, f, s))
+        if actual != stored_crc:
+            raise CheckpointCorruptError(
+                f"checkpoint {path} failed its integrity check "
+                f"(crc32 {actual:#010x} != stored {stored_crc:#010x})")
+    if s.ndim != 1 or len(s) < 8 or alpha.ndim != 1 or f.ndim != 1:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has a malformed payload "
+            f"(scalars shape {s.shape}, alpha shape {alpha.shape})")
+    return SolverCheckpoint(
+        alpha=alpha, f=f,
+        n_iter=int(s[0]), b_lo=float(s[1]), b_hi=float(s[2]),
+        c=float(s[3]), gamma=float(s[4]), epsilon=float(s[5]),
+        n=int(s[6]), d=int(s[7]),
+        # files from before class weights existed carry 8 scalars;
+        # from before kernel families, 10
+        weight_pos=float(s[8]) if len(s) > 8 else 1.0,
+        weight_neg=float(s[9]) if len(s) > 9 else 1.0,
+        kernel=_KERNEL_T[int(s[10])] if len(s) > 10 else "rbf",
+        coef0=float(s[11]) if len(s) > 11 else 0.0,
+        degree=int(s[12]) if len(s) > 12 else 3,
+    )
+
+
+def newest_intact_checkpoint(path: str) -> "tuple[Optional[str], List[str]]":
+    """(newest rotation slot that loads cleanly, slots skipped as
+    corrupt/missing). Validation against a config is the caller's job —
+    intact-but-mismatched is a permanent error, not a fallback case."""
+    skipped: List[str] = []
+    for p in checkpoint_candidates(path):
+        try:
+            load_checkpoint(p)
+            return p, skipped
+        except (CheckpointError, FileNotFoundError, OSError):
+            skipped.append(p)
+    return None, skipped
 
 
 def maybe_checkpoint(config: SVMConfig, last_saved_iter: int, n_iter: int,
-                     make: "callable") -> int:
+                     make: Callable[[], SolverCheckpoint]) -> int:
     """Host-loop helper: save when an every-N boundary was crossed.
-    Returns the new last_saved_iter."""
+    Returns the new last_saved_iter. A FAILED periodic save is degraded
+    to a warning — training state is intact and the rotation slots still
+    hold the previous good file, so killing the run over it would be
+    strictly worse (the failure is also injectable: faultinject)."""
     every = getattr(config, "checkpoint_every", 0)
     path: Optional[str] = getattr(config, "checkpoint_path", None)
     if not every or not path:
         return last_saved_iter
     if n_iter // every > last_saved_iter // every:
-        save_checkpoint(path, make())
+        try:
+            save_checkpoint(path, make(),
+                            keep=getattr(config, "checkpoint_keep", 1))
+        except (OSError, CheckpointError) as e:
+            import sys
+            print(f"WARNING: checkpoint save failed at iter {n_iter} "
+                  f"({e}); training continues, previous checkpoint kept",
+                  file=sys.stderr, flush=True)
+            return last_saved_iter
         return n_iter
     return last_saved_iter

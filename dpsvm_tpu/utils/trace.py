@@ -98,7 +98,9 @@ def validate_trace(records: List[dict]) -> List[str]:
     Contract (acceptance bar of docs/OBSERVABILITY.md): exactly one
     leading manifest at the current schema version; >= 0 chunk records
     with monotone non-decreasing n_iter and non-negative counters;
-    at most one summary, and only as the final record."""
+    at most one summary, and only as the final record. A ``rollback``
+    event legitimately rewinds the run to its checkpoint's iteration
+    (docs/ROBUSTNESS.md), so it resets the monotonicity baseline."""
     errors: List[str] = []
     if not records:
         return ["empty trace (no records)"]
@@ -138,6 +140,9 @@ def validate_trace(records: List[dict]) -> List[str]:
             miss = _missing(r, EVENT_KEYS)
             if miss:
                 errors.append(f"record {i}: event missing keys {miss}")
+            elif r.get("event") == "rollback":
+                # The run restarted from a checkpoint at this iteration.
+                prev_iter = r["n_iter"]
         elif kind == "summary":
             miss = _missing(r, SUMMARY_KEYS)
             if miss:

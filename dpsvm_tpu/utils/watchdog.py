@@ -76,4 +76,18 @@ def _watch() -> None:
             print(f"STALL: no device response for {timeout:.0f}s "
                   f"(watchdog armed via BENCH_STALL_TIMEOUT); exiting 124",
                   file=sys.stderr, flush=True)
+            # Stamp a terminal `stall` event into any open run trace so
+            # `dpsvm report` can render the stalled run (an abandoned
+            # trace with no terminal record looks identical to a live
+            # one). Best-effort: the trace layer never raises here, and
+            # the import is deferred so the watchdog stays usable in
+            # processes that never touch telemetry.
+            try:
+                from dpsvm_tpu.telemetry import flush_open_traces
+                flushed = flush_open_traces("stall", timeout_s=timeout)
+                if flushed:
+                    print(f"STALL: flushed {flushed} open run trace(s)",
+                          file=sys.stderr, flush=True)
+            except Exception:
+                pass
             os._exit(124)

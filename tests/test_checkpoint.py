@@ -1,5 +1,9 @@
 """Checkpoint/resume: a resumed run must reproduce the uninterrupted
-trajectory exactly (the full solver state is saved)."""
+trajectory exactly (the full solver state is saved), and a damaged
+checkpoint must fail loudly (CheckpointError hierarchy + CRC) or fall
+back to an intact rotation slot (docs/ROBUSTNESS.md)."""
+
+import os
 
 import numpy as np
 import pytest
@@ -7,7 +11,11 @@ import pytest
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.parallel.dist_smo import train_distributed
 from dpsvm_tpu.solver.smo import train_single_device
-from dpsvm_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from dpsvm_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                        CheckpointError,
+                                        SolverCheckpoint,
+                                        load_checkpoint, rotation_path,
+                                        save_checkpoint)
 
 
 def _base(**kw):
@@ -103,3 +111,119 @@ def test_resume_at_budget_identical_across_paths(tmp_path, blobs_small):
                                   np.asarray(capped.alpha))
     np.testing.assert_array_equal(np.asarray(r_fused.alpha),
                                   np.asarray(capped.alpha))
+
+
+def _tiny_ckpt(n=16, d=4, kernel="rbf", **kw):
+    rng = np.random.default_rng(0)
+    fields = dict(alpha=rng.random(n).astype(np.float32),
+                  f=rng.standard_normal(n).astype(np.float32),
+                  n_iter=123, b_lo=0.5, b_hi=-0.5, c=1.0, gamma=0.25,
+                  epsilon=1e-3, n=n, d=d, kernel=kernel)
+    fields.update(kw)
+    return SolverCheckpoint(**fields)
+
+
+def test_precomputed_kernel_checkpoint_round_trip(tmp_path):
+    """Regression: kernel='precomputed' (LIBSVM -t 4) used to crash
+    save_checkpoint with ValueError (_KERNEL_T had no entry). The
+    round-trip must preserve the family and validate_against must
+    enforce the square (n, n) shape."""
+    path = str(tmp_path / "pre.npz")
+    ck = _tiny_ckpt(n=16, d=16, kernel="precomputed")
+    save_checkpoint(path, ck)
+    back = load_checkpoint(path)
+    assert back.kernel == "precomputed"
+    np.testing.assert_array_equal(back.alpha, ck.alpha)
+    np.testing.assert_array_equal(back.f, ck.f)
+
+    cfg = SVMConfig(kernel="precomputed", gamma=0.25)
+    back.validate_against(16, 16, cfg, 0.25)      # square: OK
+    with pytest.raises(ValueError, match="problem"):
+        back.validate_against(16, 8, cfg, 0.25)
+
+    # A non-square record claiming precomputed is damaged, not resumable.
+    bad = _tiny_ckpt(n=16, d=4, kernel="precomputed")
+    with pytest.raises(ValueError, match="square"):
+        bad.validate_against(16, 4, cfg, 0.25)
+
+
+def test_truncated_checkpoint_raises_checkpoint_error(tmp_path):
+    path = str(tmp_path / "t.npz")
+    save_checkpoint(path, _tiny_ckpt())
+    data = open(path, "rb").read()
+    with open(path, "wb") as fh:
+        fh.write(data[: len(data) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+
+
+def test_bitflipped_checkpoint_raises_checkpoint_error(tmp_path):
+    path = str(tmp_path / "b.npz")
+    save_checkpoint(path, _tiny_ckpt())
+    with open(path, "r+b") as fh:
+        fh.seek(os.path.getsize(path) // 2)
+        byte = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+
+
+def test_empty_checkpoint_raises_checkpoint_error(tmp_path):
+    path = str(tmp_path / "e.npz")
+    open(path, "wb").close()
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+    # ...and corruption is a CheckpointError, never a raw BadZipFile.
+    assert issubclass(CheckpointCorruptError, CheckpointError)
+
+
+def test_missing_checkpoint_still_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "nope.npz"))
+
+
+def test_rotation_keeps_n_slots(tmp_path):
+    path = str(tmp_path / "state.npz")
+    for i in range(4):
+        save_checkpoint(path, _tiny_ckpt(n_iter=100 * (i + 1)), keep=3)
+    assert load_checkpoint(path).n_iter == 400
+    assert load_checkpoint(rotation_path(path, 1)).n_iter == 300
+    assert load_checkpoint(rotation_path(path, 2)).n_iter == 200
+    assert not os.path.exists(rotation_path(path, 3))   # keep=3 total
+
+
+def test_resume_state_falls_back_to_rotation_slot(tmp_path, blobs_small):
+    """Corrupt newest slot -> resume continues from the previous one,
+    and the trajectory still lands exactly on the uninterrupted run."""
+    x, y = blobs_small
+    ckpt = str(tmp_path / "state.npz")
+    full = train_single_device(x, y, _base())
+    train_single_device(
+        x, y, _base(max_iter=100, checkpoint_path=ckpt,
+                    checkpoint_every=50, checkpoint_keep=2))
+    assert load_checkpoint(ckpt).n_iter == 100
+    assert load_checkpoint(rotation_path(ckpt, 1)).n_iter == 50
+    with open(ckpt, "r+b") as fh:       # corrupt the newest slot
+        fh.seek(os.path.getsize(ckpt) // 2)
+        byte = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+
+    resumed = train_single_device(x, y, _base(resume_from=ckpt))
+    assert resumed.converged
+    assert resumed.n_iter == full.n_iter
+    np.testing.assert_array_equal(resumed.alpha, full.alpha)
+
+
+def test_resume_state_raises_when_every_slot_corrupt(tmp_path,
+                                                     blobs_small):
+    x, y = blobs_small
+    ckpt = str(tmp_path / "state.npz")
+    train_single_device(
+        x, y, _base(max_iter=100, checkpoint_path=ckpt,
+                    checkpoint_every=50, checkpoint_keep=2))
+    for p in (ckpt, rotation_path(ckpt, 1)):
+        open(p, "wb").close()
+    with pytest.raises(CheckpointError, match="no intact checkpoint"):
+        train_single_device(x, y, _base(resume_from=ckpt))
