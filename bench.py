@@ -77,6 +77,8 @@ def main() -> None:
     faultinject.current()
 
     from bench_common import standin
+    from dpsvm_tpu.observability import compilewatch
+    from dpsvm_tpu.observability.device import memory_snapshot
     from dpsvm_tpu.ops.kernels import row_norms_sq
     from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
     from dpsvm_tpu.utils.timing import PhaseTimer
@@ -103,8 +105,13 @@ def main() -> None:
         carry = init_carry(y, cache_lines=0)
         jax.block_until_ready((xd, x2))
 
-    # MNIST benchmark hyperparameters (README.md:23).
-    runner = _build_chunk_runner(10.0, 0.25, 1e-3, False, precision)
+    # MNIST benchmark hyperparameters (README.md:23). Compile-accounted
+    # like the training paths (docs/OBSERVABILITY.md): the JSON row and
+    # the provenance trace carry how much of "compile+warmup" was
+    # actually XLA compilation.
+    runner = compilewatch.instrument(
+        _build_chunk_runner(10.0, 0.25, 1e-3, False, precision),
+        "bench-smo-chunk")
 
     from dpsvm_tpu.solver.driver import read_stats
 
@@ -132,7 +139,16 @@ def main() -> None:
     iters = st.n_iter - it0
 
     rate = iters / dt if dt > 0 else 0.0
+    # Device facts for the result row + trace: pending compile
+    # observations and the allocator watermark (None-valued on CPU).
+    compiles = compilewatch.drain()
+    hbm = memory_snapshot(dev)
+    compile_seconds = round(sum(c["seconds"] for c in compiles), 3)
+    est_flops = next((c["flops"] for c in compiles
+                      if c["flops"] is not None), None)
     log(f"phases: {timer.summary()}")
+    log(f"compiles: {len(compiles)} in {compile_seconds}s; hbm peak: "
+        f"{hbm['peak']}")
     log(f"{iters} iters in {dt:.3f}s on ({n}x{d}) -> {rate:.1f} iter/s "
         f"(gap: b_lo={st.b_lo:.4f} b_hi={st.b_hi:.4f})")
 
@@ -154,11 +170,16 @@ def main() -> None:
                     "max_iter": it0 + measure_iters},
             n=n, d=d, gamma=0.25, solver="bench-smo", it0=it0,
             env=trace_env())
+        for c in compiles:
+            trace.compile(program=c["program"], seconds=c["seconds"],
+                          signature=c.get("signature"),
+                          flops=c.get("flops"))
         if warm is not None:
             trace.chunk(n_iter=warm.n_iter, b_lo=warm.b_lo,
                         b_hi=warm.b_hi, n_sv=warm.n_sv, window="warmup")
         trace.chunk(n_iter=st.n_iter, b_lo=st.b_lo, b_hi=st.b_hi,
                     n_sv=st.n_sv, phases=dict(timer.seconds),
+                    phase_counts=dict(timer.counts), hbm=hbm,
                     window="measure")
         trace.summary(converged=not (st.b_lo > st.b_hi + 2e-3),
                       n_iter=st.n_iter, b=(st.b_lo + st.b_hi) / 2.0,
@@ -173,6 +194,14 @@ def main() -> None:
         "value": round(rate, 1),
         "unit": "iter/s",
         "vs_baseline": round(rate / BASELINE_ITERS_PER_SEC, 3),
+        # device-side observability facts (docs/OBSERVABILITY.md): how
+        # much of this row's wall-clock was XLA compilation, what the
+        # HBM high-water mark was, and the cost-model FLOPs/iter —
+        # BENCH_r*.json windows carry compile overhead, not just it/s.
+        "n_compiles": len(compiles),
+        "compile_seconds": compile_seconds,
+        "hbm_peak": hbm["peak"],
+        "est_flops": est_flops,
     }), flush=True)
 
 

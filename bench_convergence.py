@@ -75,6 +75,19 @@ def convergence_run(x, y, config) -> dict:
         f"included) + setup {seconds - result.train_seconds:.2f}s "
         f"(H2D transfer, host norms, alpha readback)")
 
+    # Device-side facts from the run's own trace (docs/OBSERVABILITY.md):
+    # the driver records compile/HBM/FLOP observations into trace_out,
+    # so the result row carries compile overhead, not just it/s. Null
+    # when tracing is off or the trace is unreadable — a provenance
+    # hiccup must not burn a measured row.
+    facts = {}
+    if getattr(config, "trace_out", None):
+        try:
+            from dpsvm_tpu.telemetry import load_trace, trace_facts
+            facts = trace_facts(load_trace(config.trace_out))
+        except (OSError, ValueError) as e:
+            log(f"WARNING: trace facts unavailable ({e})")
+
     return {
         "metric": "mnist_scale_seconds_to_convergence",
         "value": round(seconds, 2),
@@ -90,6 +103,10 @@ def convergence_run(x, y, config) -> dict:
         "shrinking": config.shrinking,
         "polish": config.polish,
         "train_accuracy": round(float(acc), 6),
+        "n_compiles": facts.get("n_compiles"),
+        "compile_seconds": facts.get("compile_seconds"),
+        "hbm_peak": facts.get("hbm_peak"),
+        "est_flops": facts.get("est_flops"),
     }
 
 

@@ -169,13 +169,18 @@ def records(path):
     return out
 
 
-def record(path, tag, rc, secs, stdout_lines, stderr_lines):
+def record(path, tag, rc, secs, stdout_lines, stderr_lines, trace=None):
     # Key order matches sweep_lib.sh exactly: its have() greps the
-    # literal string '"tag": "X", "rc": 0'.
+    # literal string '"tag": "X", "rc": 0'. New keys append AFTER the
+    # greppable prefix: "trace" points a recorded row at its archived
+    # provenance trace, so a later window's row can be gated against it
+    # mechanically (`dpsvm compare <old trace> <new trace>
+    # --fail-on-regress PCT` — docs/OBSERVABILITY.md "Comparing runs").
     line = json.dumps({"tag": tag, "rc": int(rc), "seconds": int(secs),
                        "stdout": stdout_lines,
                        "stderr_tail": stderr_lines[-15:],
-                       "runner": "burst"})
+                       "runner": "burst",
+                       "trace": trace})
     with open(path, "a") as fh:
         fh.write(line + "\n")
 
@@ -404,7 +409,9 @@ def main(argv) -> int:
             out_lines = []
             err_lines = traceback.format_exc().strip().splitlines()
         secs = time.monotonic() - t0
-        record(path, tag, rc, secs, out_lines, err_lines)
+        trace = trace_path_for(spec)
+        record(path, tag, rc, secs, out_lines, err_lines,
+               trace=trace if os.path.exists(trace) else None)
         pend = load_pending()
         pend[tag] = 0
         save_pending(pend)

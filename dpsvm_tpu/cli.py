@@ -331,14 +331,51 @@ def build_parser() -> argparse.ArgumentParser:
     rp = sub.add_parser(
         "report", help="render a run-telemetry trace (train "
                        "--trace-out): convergence curve, phase "
-                       "breakdown, cache hit rate, throughput")
+                       "breakdown, cache hit rate, compile/HBM/FLOP "
+                       "facts")
     rp.add_argument("trace", help="trace JSONL written by --trace-out "
-                                  "(or BENCH_TRACE_OUT)")
+                                  "(or BENCH_TRACE_OUT), or a directory "
+                                  "of traces — the newest *.jsonl is "
+                                  "picked (the burst runner archives "
+                                  "under <results>/traces/)")
     rp.add_argument("--json", action="store_true",
                     help="machine-readable digest instead of the human "
                          "rendering")
     rp.add_argument("--width", type=int, default=60,
                     help="plot width in columns")
+    rp.add_argument("--follow", action="store_true",
+                    help="live mode: tail an in-flight trace and "
+                         "refresh the report until a terminal record "
+                         "(summary / stall / preempt) or a stall "
+                         "timeout — makes tunneled chip runs watchable")
+    rp.add_argument("--interval", type=float, default=1.0, metavar="S",
+                    help="--follow refresh poll interval (default 1 s)")
+    rp.add_argument("--stall-timeout", type=float, default=300.0,
+                    metavar="S",
+                    help="--follow exits 3 when the trace file stops "
+                         "growing for this long (default 300 s; a run "
+                         "killed too hard to stamp its own terminal "
+                         "event)")
+
+    cp = sub.add_parser(
+        "compare", help="delta table + regression gate between two "
+                        "run-telemetry traces (it/s, gap trajectory at "
+                        "matched iteration marks, phase split, cache "
+                        "hit rate, compile count/seconds, HBM peak)")
+    cp.add_argument("a", help="baseline trace JSONL (or a directory — "
+                              "newest *.jsonl)")
+    cp.add_argument("b", help="candidate trace JSONL (or a directory)")
+    cp.add_argument("--json", action="store_true",
+                    help="machine-readable comparison")
+    cp.add_argument("--fail-on-regress", type=float, default=None,
+                    metavar="PCT",
+                    help="exit 1 when the candidate regresses past "
+                         "PCT%% on a gated metric (it/s drop, HBM-peak "
+                         "growth, compile-seconds growth) — the "
+                         "mechanical perf gate for benches and CI")
+    cp.add_argument("--marks", type=int, default=4,
+                    help="iteration marks for the gap-trajectory "
+                         "comparison (default 4)")
     return root
 
 
@@ -1084,27 +1121,95 @@ def cmd_info(args: argparse.Namespace) -> int:
     return 0
 
 
-def cmd_report(args: argparse.Namespace) -> int:
-    """Render a run-telemetry trace. Pure file I/O — no backend init,
-    so it works on a machine with no accelerator (or a dead tunnel)."""
-    import json
-
-    from dpsvm_tpu.telemetry import (load_trace, render_report,
-                                     summarize_trace)
+def _pipe_safe_print(text: str) -> None:
+    """print() for the read-only report surfaces, tolerant of a closed
+    downstream pipe (`dpsvm report run.jsonl | head` is the normal
+    consumption pattern; a BrokenPipeError traceback there reads as a
+    crash). Python re-raises on the shutdown flush too, so stdout is
+    redirected to devnull after the pipe breaks."""
+    import os
 
     try:
-        records = load_trace(args.trace)
-    except FileNotFoundError:
-        print(f"error: no such trace: {args.trace}", file=sys.stderr)
+        print(text)
+        sys.stdout.flush()
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+def cmd_report(args: argparse.Namespace) -> int:
+    """Render a run-telemetry trace. Pure file I/O — no backend init,
+    so it works on a machine with no accelerator (or a dead tunnel).
+    ``--follow`` tails an in-flight trace instead (exit 0 = run
+    finished, 1 = terminal stall/preempt event, 3 = file stopped
+    growing)."""
+    import json
+
+    from dpsvm_tpu.telemetry import (follow_trace, load_trace,
+                                     render_report, resolve_trace_path,
+                                     summarize_trace)
+
+    width = max(int(args.width), 20)
+    if args.follow:
+        # The trace may not exist yet (watching a run about to start):
+        # resolve directories when possible, else follow the raw path.
+        try:
+            path = resolve_trace_path(args.trace)
+        except FileNotFoundError:
+            path = args.trace
+        return follow_trace(path, interval=max(args.interval, 0.01),
+                            stall_timeout=args.stall_timeout,
+                            width=width)
+    try:
+        records = load_trace(resolve_trace_path(args.trace))
+    except FileNotFoundError as e:
+        print(f"error: no such trace: {e}", file=sys.stderr)
         return 2
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.json:
-        print(json.dumps(summarize_trace(records)))
+        _pipe_safe_print(json.dumps(summarize_trace(records)))
     else:
-        print(render_report(records, width=max(int(args.width), 20)))
+        _pipe_safe_print(render_report(records, width=width))
     return 0
+
+
+def cmd_compare(args: argparse.Namespace) -> int:
+    """Two traces in, one verdict out (docs/OBSERVABILITY.md "Comparing
+    runs"). Pure file I/O like report. Exit codes: 0 = no gated
+    regression (or no gate requested), 1 = regression past
+    --fail-on-regress, 2 = unreadable/invalid input."""
+    import json
+
+    from dpsvm_tpu.telemetry import (compare_paths, regressions,
+                                     render_compare)
+
+    try:
+        cmp, ra, rb = compare_paths(args.a, args.b,
+                                    marks=max(int(args.marks), 1))
+    except FileNotFoundError as e:
+        print(f"error: no such trace: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    regress = (regressions(cmp, args.fail_on_regress)
+               if args.fail_on_regress is not None else [])
+    if args.json:
+        _pipe_safe_print(json.dumps(dict(cmp, a_path=ra, b_path=rb,
+                                         regressions=regress)))
+    else:
+        text = render_compare(cmp, label_a=ra, label_b=rb)
+        if args.fail_on_regress is not None:
+            if regress:
+                text += ("\n\nREGRESSION past "
+                         f"{args.fail_on_regress:g}% threshold:")
+                text += "".join(f"\n  {r}" for r in regress)
+            else:
+                text += (f"\n\nno regression past "
+                         f"{args.fail_on_regress:g}% threshold")
+        _pipe_safe_print(text)
+    return 1 if regress else 0
 
 
 def _init_backend(args: argparse.Namespace) -> int:
@@ -1167,6 +1272,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_info(args)
         if args.command == "report":
             return cmd_report(args)
+        if args.command == "compare":
+            return cmd_compare(args)
         return cmd_test(args)
     except PreemptedError as e:
         # Resumable by design: the supervisor (or the next manual run)

@@ -26,6 +26,7 @@ from jax import lax
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.experimental.fused_step import (DEFAULT_BLOCK_N, FusedCarry,
                                       fused_smo_body, pad_to_block)
+from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.ops.kernels import row_norms_sq
 from dpsvm_tpu.ops.selection import masked_extrema
 from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
@@ -188,11 +189,16 @@ def train_single_device_fused(x: np.ndarray, y: np.ndarray,
     if device is not None:
         carry = jax.device_put(carry, device)
 
-    run = functools.partial(
-        _run_chunk, c=float(config.c), gamma=gamma,
-        epsilon=float(config.epsilon), max_iter=int(config.max_iter),
-        block_n=block_n, precision_name=precision_name,
-        interpret=interpret)
+    # Compile accounting rides the partial: the statics live in its
+    # keywords and _run_chunk is the jit whose cache is watched
+    # (observability/compilewatch.py).
+    run = compilewatch.instrument(
+        functools.partial(
+            _run_chunk, c=float(config.c), gamma=gamma,
+            epsilon=float(config.epsilon), max_iter=int(config.max_iter),
+            block_n=block_n, precision_name=precision_name,
+            interpret=interpret),
+        "fused-chunk", jitted=_run_chunk)
 
     def carry_from_ckpt(ck):
         # Divergence-rollback hook (docs/ROBUSTNESS.md): rebuild the

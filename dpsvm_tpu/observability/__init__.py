@@ -1,0 +1,196 @@
+"""Unified run observability: traces, device/compiler accounting,
+reports, live follow, cross-run comparison.
+
+Grown out of ``dpsvm_tpu.telemetry`` (which remains as a re-exporting
+shim): PR 1's RunTrace answered *what the host loop did*; this package
+adds the device/compiler layer — the two things that actually dominate
+TPU wall-clock here are XLA compilation (every growth program swap and
+working-set regrow recompiles the chunk runner; PERF.md attributes
+0.5-3 s per first-compile on the tunneled chip) and device memory (the
+kernel-cache / precomputed-kernel footprint decides whether a shape
+fits at all).
+
+Layout (docs/OBSERVABILITY.md):
+
+* ``schema``       — JSONL record shapes + ``validate_trace`` (v2; v1
+                     still validates). Dependency-free.
+* ``record``       — the ``RunTrace`` recorder every producer writes
+                     through (driver, shrink manager, benchmarks).
+* ``compilewatch`` — compile/retrace detection around the solvers'
+                     chunk runners; drained into traces at poll
+                     boundaries.
+* ``device``       — host-side HBM watermark sampling (None-safe on
+                     CPU).
+* ``report``       — digest + ASCII report + ``--follow`` live tail.
+* ``compare``      — two-trace delta table + regression gate
+                     (``dpsvm compare``).
+
+Importing this package initializes no backend: jax is imported lazily
+inside the functions that need it (compilewatch, device), so ``dpsvm
+report``/``compare`` run on a machine with no accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from dpsvm_tpu.observability.compare import (compare_paths,
+                                             compare_traces,
+                                             regressions,
+                                             render_compare)
+from dpsvm_tpu.observability.record import (SOLVER_NAMES, RunTrace,
+                                            flush_open_traces)
+from dpsvm_tpu.observability.report import (follow_trace, load_trace,
+                                            render_report,
+                                            resolve_trace_path,
+                                            summarize_trace,
+                                            trace_facts)
+from dpsvm_tpu.observability.schema import (TRACE_SCHEMA_VERSION,
+                                            TraceWriter, read_trace,
+                                            validate_trace)
+
+__all__ = [
+    "TRACE_SCHEMA_VERSION", "TraceWriter", "read_trace",
+    "validate_trace", "RunTrace", "SOLVER_NAMES", "flush_open_traces",
+    "load_trace", "render_report", "summarize_trace", "trace_facts",
+    "resolve_trace_path", "follow_trace", "compare_traces",
+    "compare_paths", "render_compare", "regressions", "selfcheck",
+    "main",
+]
+
+# A v1 trace embedded verbatim: the schema gate asserts that old
+# traces keep validating after every v2+ change (the committed file
+# fixture lives at tests/fixtures/trace_v1.jsonl; this inline copy
+# makes the CLI selfcheck self-contained).
+V1_SAMPLE_RECORDS: List[dict] = [
+    {"kind": "manifest", "schema": 1, "version": "0.0", "solver": "smo",
+     "n": 100, "d": 4, "gamma": 0.25,
+     "kernel": {"kind": "rbf", "gamma": 0.25, "coef0": 0.0, "degree": 3},
+     "mesh": {"shards": 1, "shard_x": True},
+     "env": {"backend": "cpu", "device_kind": "host", "device_count": 1},
+     "config": {}, "it0": 0, "time": "2026-01-01T00:00:00+0000"},
+    {"kind": "chunk", "n_iter": 512, "b_lo": 0.5, "b_hi": -0.5,
+     "gap": 1.0, "n_sv": 10, "cache_hits": 0, "cache_misses": 0,
+     "rounds": 0, "t": 0.1, "phases": {"dispatch": 0.01, "poll": 0.05}},
+    {"kind": "event", "event": "checkpoint", "n_iter": 512, "t": 0.2},
+    {"kind": "summary", "converged": True, "n_iter": 900, "iters": 900,
+     "iters_per_sec": 3000.0, "b": 0.1, "b_lo": 0.1004, "b_hi": 0.0996,
+     "gap": 0.0008, "n_sv": 12, "cache_hits": 0, "cache_misses": 0,
+     "cache_hit_rate": None, "train_seconds": 0.3,
+     "phases": {"dispatch": 0.02, "poll": 0.2}, "t": 0.31},
+]
+
+
+def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
+    """Produce a synthetic v2 trace through the real writer, then run
+    it through the real validator, renderer and comparator; also
+    validate the embedded v1 sample. Returns problems (empty = OK).
+    Tier-1 (tests/test_observability.py) and ``python -m
+    dpsvm_tpu.telemetry --selfcheck`` both call this, so a schema drift
+    between producer and validator fails loudly in CI."""
+    import os
+    import tempfile
+
+    problems = []
+    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
+        path = os.path.join(td, "selfcheck.jsonl")
+        tr = RunTrace(path, config={"kernel": "rbf", "shards": 2,
+                                    "shard_x": True, "coef0": 0.0,
+                                    "degree": 3},
+                      n=1000, d=32, gamma=0.5, solver="smo", it0=0,
+                      env={"backend": "cpu", "device_kind": "host",
+                           "device_count": 2})
+        tr.compile(program="smo-chunk", seconds=1.25,
+                   signature="((1000,), float32)", flops=2.0e6)
+        for i, gap in enumerate((1.5, 0.3, 0.0009)):
+            tr.chunk(n_iter=(i + 1) * 512, b_lo=gap / 2, b_hi=-gap / 2,
+                     n_sv=100 * (i + 1), cache_hits=i * 10,
+                     cache_misses=i * 20, rounds=i,
+                     phases={"dispatch": 0.1 * i, "poll": 0.2 * i},
+                     phase_counts={"dispatch": i + 1, "poll": i + 1},
+                     hbm={"in_use": 1 << 28, "peak": (1 << 28) + i,
+                          "limit": 16 << 30})
+        tr.event("checkpoint", n_iter=1024)
+        tr.summary(converged=True, n_iter=1536, b=0.0, b_lo=0.00045,
+                   b_hi=-0.00045, n_sv=300, train_seconds=1.5,
+                   cache_hits=20, cache_misses=40,
+                   phases={"dispatch": 0.3, "poll": 0.6},
+                   phase_counts={"dispatch": 3, "poll": 3})
+        tr.close()
+        try:
+            records = load_trace(path)
+        except ValueError as e:
+            return [str(e)]
+        digest = summarize_trace(records)
+        if digest["n_chunks"] != 3 or digest["summary"] is None:
+            problems.append(f"digest mismatch: {digest['n_chunks']} "
+                            "chunks or missing summary")
+        s = digest["summary"]
+        facts = {k: (s or {}).get(k)
+                 for k in ("n_compiles", "compile_seconds",
+                           "est_flops", "hbm_peak")}
+        if facts != {"n_compiles": 1, "compile_seconds": 1.25,
+                     "est_flops": 2.0e6, "hbm_peak": (1 << 28) + 2}:
+            problems.append(f"summary device facts drifted: {facts}")
+        text = render_report(records)
+        for needle in ("run: smo", "converged at iter 1,536",
+                       "hit rate 33.3%", "checkpoint@1,024",
+                       "compiles: 1 program(s)", "hbm peak:",
+                       "throughput: ~"):
+            if needle not in text:
+                problems.append(f"report rendering lost {needle!r}")
+        # A trace must compare cleanly against itself with zero
+        # regressions at any threshold.
+        cmp = compare_traces(records, records)
+        if regressions(cmp, 0.001):
+            problems.append("self-comparison reported a regression: "
+                            f"{regressions(cmp, 0.001)}")
+        render_compare(cmp)
+    # v1 back-compat: the embedded sample must keep validating and
+    # rendering (hbm/compile facts absent, not invented).
+    v1_errors = validate_trace(V1_SAMPLE_RECORDS)
+    if v1_errors:
+        problems.append(f"v1 sample no longer validates: {v1_errors}")
+    else:
+        v1_text = render_report(V1_SAMPLE_RECORDS)
+        if "hbm peak" in v1_text or "compiles:" in v1_text:
+            problems.append("v1 rendering invented v2 device facts")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+    import sys
+
+    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.telemetry")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="writer -> validator -> renderer -> comparator "
+                        "round-trip on a synthetic trace (the CI schema "
+                        "gate), plus v1 back-compat")
+    p.add_argument("--validate", default=None, metavar="TRACE",
+                   help="validate an existing trace file (or the newest "
+                        "*.jsonl in a directory)")
+    args = p.parse_args(argv)
+    if args.selfcheck:
+        problems = selfcheck()
+        if problems:
+            print("telemetry selfcheck FAILED:", file=sys.stderr)
+            for pr in problems:
+                print(f"  {pr}", file=sys.stderr)
+            return 1
+        print("telemetry selfcheck OK "
+              f"(schema v{TRACE_SCHEMA_VERSION}, v1 accepted)")
+        return 0
+    if args.validate:
+        try:
+            resolved = resolve_trace_path(args.validate)
+            records = load_trace(resolved)
+        except (OSError, ValueError) as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps({"valid": True, "records": len(records),
+                          "path": resolved}))
+        return 0
+    p.print_help()
+    return 2

@@ -1,0 +1,9 @@
+"""``python -m dpsvm_tpu.observability`` — the schema selfcheck /
+validate entry point (identical to ``python -m dpsvm_tpu.telemetry``,
+which remains the documented CI gate)."""
+
+import sys
+
+from dpsvm_tpu.observability import main
+
+sys.exit(main())

@@ -1,0 +1,206 @@
+"""``dpsvm compare A B``: two traces in, one mechanical verdict out.
+
+The ROADMAP's "measurably faster" mandate needs a tool that turns two
+traces into a verdict, not a human eyeballing JSONL — especially with
+BENCH history sparse (tunnel outages). ``compare`` aligns two run
+traces (or the newest trace in each of two directories), prints a
+delta table — it/s, gap trajectory at matched iteration marks, phase
+split, cache hit rate, compile count/seconds, HBM peak — and exits
+non-zero on a regression past ``--fail-on-regress PCT``, so benches
+and CI get a perf gate.
+
+Gated metrics (direction-aware):
+
+* ``iters_per_sec`` — B slower than A by more than PCT%;
+* ``hbm_peak`` — B's high-water mark above A's by more than PCT%;
+* ``compile_seconds`` — B above A by more than PCT% AND by more than
+  1 s absolute (sub-second compile jitter is noise, not regression).
+
+Everything else in the table is context, not a gate: ``train_seconds``
+depends on budgets/shape, gap marks depend on trajectory, and a run
+that is FASTER fails no gate however different it looks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+from dpsvm_tpu.observability.report import (load_trace,
+                                            resolve_trace_path,
+                                            trace_facts)
+
+# Below this absolute delta, compile_seconds differences are jitter.
+COMPILE_SECONDS_NOISE_FLOOR = 1.0
+
+
+def _pct(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    if a is None or b is None or a == 0:
+        return None
+    return (b - a) / abs(a) * 100.0
+
+
+def _gap_at(curve: List[Tuple[int, float]], it: float) -> Optional[float]:
+    """log-space linear interpolation of the gap trajectory at ``it``
+    (gaps decay geometrically, so log-space is the faithful axis)."""
+    pts = [(i, g) for i, g in curve if g is not None and g > 0]
+    if not pts:
+        return None
+    if it <= pts[0][0]:
+        return pts[0][1]
+    if it >= pts[-1][0]:
+        return pts[-1][1]
+    for (i0, g0), (i1, g1) in zip(pts, pts[1:]):
+        if i0 <= it <= i1:
+            if i1 == i0:
+                return g1
+            w = (it - i0) / (i1 - i0)
+            return 10 ** ((1 - w) * math.log10(g0) + w * math.log10(g1))
+    return None
+
+
+def _gap_marks(fa: dict, fb: dict, marks: int = 4) -> List[dict]:
+    """Gap deltas at iteration marks spanning the two curves' common
+    iteration range (empty when the runs share no range — e.g. a
+    resumed run against a fresh one)."""
+    ca, cb = fa["curve"], fb["curve"]
+    if not ca or not cb:
+        return []
+    lo = max(ca[0][0], cb[0][0])
+    hi = min(ca[-1][0], cb[-1][0])
+    if hi <= lo:
+        return []
+    out = []
+    for k in range(1, marks + 1):
+        it = lo + (hi - lo) * k / marks
+        ga, gb = _gap_at(ca, it), _gap_at(cb, it)
+        out.append({"n_iter": int(round(it)), "a": ga, "b": gb,
+                    "delta_pct": _pct(ga, gb)})
+    return out
+
+
+def compare_traces(records_a: List[dict], records_b: List[dict],
+                   marks: int = 4) -> dict:
+    """Machine-readable comparison of two validated traces. ``a`` is
+    the baseline; deltas read as B-relative-to-A."""
+    fa, fb = trace_facts(records_a), trace_facts(records_b)
+    rows = []
+    for key in ("iters_per_sec", "train_seconds", "iters", "n_iter",
+                "gap", "n_sv", "cache_hit_rate", "n_compiles",
+                "compile_seconds", "hbm_peak", "est_flops",
+                "est_flops_per_sec"):
+        rows.append({"metric": key, "a": fa.get(key), "b": fb.get(key),
+                     "delta_pct": _pct(fa.get(key), fb.get(key))})
+    phase_names = sorted(set(fa["phases"]) | set(fb["phases"]))
+    phases = []
+    tot_a = sum(fa["phases"].values()) or 0.0
+    tot_b = sum(fb["phases"].values()) or 0.0
+    for name in phase_names:
+        sa, sb = fa["phases"].get(name), fb["phases"].get(name)
+        phases.append({
+            "phase": name, "a": sa, "b": sb,
+            "a_share": (sa / tot_a) if sa is not None and tot_a else None,
+            "b_share": (sb / tot_b) if sb is not None and tot_b else None,
+            "a_count": fa["phase_counts"].get(name),
+            "b_count": fb["phase_counts"].get(name),
+            "delta_pct": _pct(sa, sb)})
+    return {
+        "a": {k: fa.get(k) for k in ("solver", "n", "d", "schema",
+                                     "converged")},
+        "b": {k: fb.get(k) for k in ("solver", "n", "d", "schema",
+                                     "converged")},
+        "metrics": rows,
+        "gap_marks": _gap_marks(fa, fb, marks),
+        "phases": phases,
+    }
+
+
+def regressions(cmp: dict, pct: float) -> List[str]:
+    """Direction-aware regression verdicts past ``pct`` percent;
+    empty = the gate passes."""
+    by = {r["metric"]: r for r in cmp["metrics"]}
+    out = []
+    ips = by["iters_per_sec"]
+    if (ips["a"] and ips["b"] is not None
+            and ips["b"] < ips["a"] * (1 - pct / 100.0)):
+        out.append(f"iters_per_sec regressed {-ips['delta_pct']:.1f}% "
+                   f"({ips['a']:g} -> {ips['b']:g}, threshold {pct:g}%)")
+    hbm = by["hbm_peak"]
+    if (hbm["a"] and hbm["b"] is not None
+            and hbm["b"] > hbm["a"] * (1 + pct / 100.0)):
+        out.append(f"hbm_peak grew {hbm['delta_pct']:.1f}% "
+                   f"({hbm['a']:,} -> {hbm['b']:,} bytes, "
+                   f"threshold {pct:g}%)")
+    cs = by["compile_seconds"]
+    if (cs["a"] is not None and cs["b"] is not None
+            and cs["b"] > cs["a"] * (1 + pct / 100.0)
+            and cs["b"] - cs["a"] > COMPILE_SECONDS_NOISE_FLOOR):
+        out.append(f"compile_seconds grew {cs['delta_pct']:.1f}% "
+                   f"({cs['a']:g} -> {cs['b']:g} s, threshold {pct:g}% "
+                   f"and > {COMPILE_SECONDS_NOISE_FLOOR:g} s)")
+    return out
+
+
+def _cell(v, metric: str = "") -> str:
+    if v is None:
+        return "n/a"
+    if isinstance(v, bool):
+        return str(v)
+    if metric in ("hbm_peak",):
+        return f"{v:,.0f}"
+    if isinstance(v, float):
+        return f"{v:,.4g}"
+    return f"{v:,}"
+
+
+def render_compare(cmp: dict, label_a: str = "A",
+                   label_b: str = "B") -> str:
+    """The human delta table behind ``dpsvm compare``."""
+    out = []
+    a, b = cmp["a"], cmp["b"]
+    out.append(f"A: {label_a}  [{a['solver']}  {a['n']}x{a['d']}  "
+               f"schema v{a['schema']}  converged={a['converged']}]")
+    out.append(f"B: {label_b}  [{b['solver']}  {b['n']}x{b['d']}  "
+               f"schema v{b['schema']}  converged={b['converged']}]")
+    out.append("")
+    w = 18
+    out.append(f"  {'metric':<{w}} {'A':>14} {'B':>14} {'delta':>9}")
+    for r in cmp["metrics"]:
+        d = (f"{r['delta_pct']:+8.1f}%" if r["delta_pct"] is not None
+             else "      n/a")
+        out.append(f"  {r['metric']:<{w}} {_cell(r['a'], r['metric']):>14} "
+                   f"{_cell(r['b'], r['metric']):>14} {d}")
+    if cmp["gap_marks"]:
+        out.append("")
+        out.append("  gap trajectory at matched iteration marks "
+                   "(lower = further converged):")
+        for m in cmp["gap_marks"]:
+            d = (f"{m['delta_pct']:+8.1f}%" if m["delta_pct"] is not None
+                 else "      n/a")
+            out.append(f"  gap@{m['n_iter']:<{w - 4},} "
+                       f"{_cell(m['a']):>14} {_cell(m['b']):>14} {d}")
+    if cmp["phases"]:
+        out.append("")
+        out.append("  host-loop phase split (seconds, share, calls):")
+        for p in cmp["phases"]:
+            sa = (f"{p['a']:.3f}s/{p['a_share']:.0%}"
+                  if p["a"] is not None and p["a_share"] is not None
+                  else "n/a")
+            sb = (f"{p['b']:.3f}s/{p['b_share']:.0%}"
+                  if p["b"] is not None and p["b_share"] is not None
+                  else "n/a")
+            ca = f"{p['a_count']:,}x" if p["a_count"] else "-"
+            cb = f"{p['b_count']:,}x" if p["b_count"] else "-"
+            out.append(f"  {p['phase']:<{w}} {sa:>14} {sb:>14}   "
+                       f"{ca} vs {cb}")
+    return "\n".join(out)
+
+
+def compare_paths(path_a: str, path_b: str, marks: int = 4
+                  ) -> Tuple[dict, str, str]:
+    """Resolve (file or directory), load+validate, compare. Returns
+    (comparison, resolved_a, resolved_b)."""
+    ra = resolve_trace_path(path_a)
+    rb = resolve_trace_path(path_b)
+    return (compare_traces(load_trace(ra), load_trace(rb), marks=marks),
+            ra, rb)

@@ -1,0 +1,184 @@
+"""Compile/retrace accounting for the solvers' chunk programs.
+
+XLA compilation is one of the two costs that dominate TPU wall-clock
+in this codebase (docs/PERF.md: ~0.5-3 s client compile plus ~3 s
+server-side program load per program on the tunneled chip; every
+``grow_working_set`` swap and every shrinking-manager capacity bucket
+is its own program). PR 1's RunTrace was blind to it — a run that
+spent 12 s compiling and 3 s iterating traced exactly like the
+reverse. This module makes every compile an observable fact:
+
+* ``instrument(fn, program)`` wraps a jitted chunk runner. Each call
+  compares the jit's tracing-cache size before and after: growth means
+  THIS call paid a trace+lower+compile, and the call's wall seconds
+  are (to within one async dispatch, microseconds) the compile cost.
+  A warm cache — e.g. the lru_cached runner builders re-serving a
+  previous run's program, or the persistent XLA compile cache — is
+  correctly observed as zero compiles.
+* detected compiles are appended to a process-global log; the host
+  driver (and the shrink manager / bench harnesses) ``drain()`` it
+  into the run trace as ``compile`` records at the next poll
+  boundary. The log is process-global on purpose: compiles fire
+  inside solver internals that know nothing about traces, and the
+  queue-then-drain pattern matches the driver's pending-event queue.
+* the first compile per program also records a cost_analysis FLOPs
+  estimate (``fn.lower(avals).cost_analysis()`` — host-side tracing
+  only, no second backend compile). On the chunk runners the
+  while-loop body is counted ONCE, so the number reads as
+  ~FLOPs-per-iteration; ``report`` multiplies by the iteration count
+  for its achieved-FLOP/s line (docs/OBSERVABILITY.md).
+
+No jax import at module level: the report/compare CLI path imports
+the observability package without initializing any backend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_LOG: List[dict] = []
+_LOCK = threading.Lock()
+# Fallback signature sets for callables without jit's _cache_size,
+# keyed by id of the underlying callable (shared across instrument()
+# wrappers of the same runner, mirroring the jit cache's lifetime).
+_SEEN: Dict[int, set] = {}
+
+
+def _signature(args, kwargs) -> tuple:
+    """Hashable (shape, dtype) tree of a call's arguments — the retrace
+    key. Non-array leaves (python scalars, static strings) ride as
+    repr, close enough to jit's static-argument hashing for
+    accounting."""
+    import jax
+
+    def leaf(a):
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            return (tuple(shape), str(dtype))
+        return repr(a)
+
+    return tuple(leaf(a)
+                 for a in jax.tree_util.tree_leaves((args, kwargs)))
+
+
+def _cache_size(fn) -> Optional[int]:
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+def _cost_flops(fn, args, kwargs) -> Optional[float]:
+    """cost_analysis 'flops' of the program ``fn`` compiles for this
+    call signature, via a host-side re-lower on avals (no backend
+    compile). None when the backend/abstraction declines — the trace
+    records the fact as null rather than failing the run."""
+    try:
+        import jax
+
+        def aval(a):
+            shape = getattr(a, "shape", None)
+            dtype = getattr(a, "dtype", None)
+            if shape is not None and dtype is not None:
+                return jax.ShapeDtypeStruct(tuple(shape), dtype)
+            return a
+
+        specs = jax.tree_util.tree_map(aval, args)
+        kspecs = jax.tree_util.tree_map(aval, kwargs)
+        ca = fn.lower(*specs, **kspecs).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = (ca or {}).get("flops")
+        return float(flops) if flops is not None else None
+    except Exception:
+        return None
+
+
+def observe(program: str, seconds: float, *,
+            signature: Optional[str] = None,
+            flops: Optional[float] = None) -> None:
+    """Append one compile observation (public so harnesses that compile
+    outside jit — e.g. explicit AOT paths — can report too)."""
+    with _LOCK:
+        _LOG.append({"program": str(program),
+                     "seconds": float(seconds),
+                     "signature": signature,
+                     "flops": flops,
+                     "wall": time.perf_counter()})
+
+
+def drain() -> List[dict]:
+    """Take every pending compile observation (oldest first). The
+    driver calls this at poll boundaries; a consumer with no trace
+    still drains so observations can never leak into the next run."""
+    with _LOCK:
+        out, _LOG[:] = _LOG[:], []
+    return out
+
+
+def pending() -> int:
+    with _LOCK:
+        return len(_LOG)
+
+
+def instrument(fn: Callable, program: str, *,
+               jitted: Any = None) -> Callable:
+    """Wrap a (jitted) chunk runner so every compile/retrace it pays is
+    logged. ``jitted`` points at the underlying jit object when ``fn``
+    itself is a partial/closure over it (the fused path); it is the
+    thing whose tracing cache is watched and whose ``lower`` provides
+    the FLOPs estimate."""
+    import functools
+
+    target = jitted if jitted is not None else fn
+    lowerable = target if hasattr(target, "lower") else None
+    # The fused path wraps a partial over its jit (the statics live in
+    # the partial's keywords); re-lowering needs them back.
+    static_kwargs = (dict(fn.keywords)
+                     if isinstance(fn, functools.partial)
+                     and lowerable is not None and fn.func is lowerable
+                     else {})
+    flops_seen: Dict[str, Optional[float]] = {}
+
+    def wrapped(*args, **kwargs):
+        before = _cache_size(target)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        after = _cache_size(target)
+        if before is None or after is None:
+            # No jit cache probe on this callable: fall back to a
+            # signature set keyed on the callable's id, shared by every
+            # wrapper of the same runner so a program warmed by a
+            # previous run is still observed as zero compiles.
+            seen = _SEEN.setdefault(id(target), set())
+            sig = _signature(args, kwargs)
+            compiled = sig not in seen
+            seen.add(sig)
+        else:
+            compiled = after > before
+        if compiled:
+            seconds = time.perf_counter() - t0
+            sig_s = None
+            try:
+                sig_s = str(_signature(args, kwargs))
+            except Exception:
+                pass
+            flops = None
+            if lowerable is not None and program not in flops_seen:
+                # One estimate per program name: re-lowering is cheap
+                # (host tracing only) but not free, and a retrace of
+                # the same program has the same per-iteration cost.
+                flops = _cost_flops(lowerable, args,
+                                    {**static_kwargs, **kwargs})
+                flops_seen[program] = flops
+            observe(program, seconds, signature=sig_s, flops=flops)
+        return out
+
+    wrapped.__name__ = f"observed[{program}]"
+    return wrapped

@@ -1,0 +1,48 @@
+"""Host-side device facts: HBM watermarks.
+
+``device.memory_stats()`` is a host-side dictionary read — the runtime
+already tracks allocator state, so sampling it at the existing poll
+boundary costs ZERO device->host transfers (the same economics as the
+packed-stats counters, docs/OBSERVABILITY.md). On backends without
+allocator stats (CPU: ``memory_stats()`` returns None) every field is
+null — presence of the keys is the schema contract, not their values.
+
+The kernel-cache / precomputed-kernel footprint decides whether a
+shape fits at all (PERF.md; the "Recipe for Fast Large-scale SVM
+Training" point that memory budget, not iteration count, bounds
+large-scale SVM training), so the high-water mark is a first-class
+summary fact (``hbm_peak``).
+
+jax is imported lazily: the report/compare CLI path must run without
+initializing any backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def memory_snapshot(device=None) -> dict:
+    """{"in_use": bytes|None, "peak": bytes|None, "limit": bytes|None}
+    for ``device`` (default: the first device). Never raises — a
+    backend without stats reports nulls."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return {"in_use": None, "peak": None, "limit": None}
+
+    def grab(*names) -> Optional[int]:
+        for name in names:
+            v = stats.get(name)
+            if v is not None:
+                return int(v)
+        return None
+
+    return {"in_use": grab("bytes_in_use"),
+            "peak": grab("peak_bytes_in_use", "largest_alloc_size"),
+            "limit": grab("bytes_limit", "bytes_reservable_limit")}

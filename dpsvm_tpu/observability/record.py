@@ -1,0 +1,259 @@
+"""The RunTrace recorder: one training run's JSONL artifact.
+
+The reference left its per-phase instrumentation commented out
+(``svmTrain.cu:218-293``) and its duality-gap probe dead
+(``seq.cpp:352-376``); we resurrected both (utils/timing.py,
+ops/diagnostics.py) but they were islands — no single artifact recorded
+what a training run *did*. ``RunTrace`` is that artifact: one JSONL
+file per run (schema in observability/schema.py, prose in
+docs/OBSERVABILITY.md) holding the manifest, a record per host poll,
+compile accounting, solver events, and a summary. Every signal in the
+per-chunk record rides the solvers' existing packed-stats transfer
+(solver/driver.py "Poll economics") or a host-side API read
+(``device.memory_stats()``), so a traced run performs ZERO additional
+device->host transfers.
+
+Producers: the shared host driver (solver/driver.host_training_loop —
+every path through it: single-device, fused, decomposition, and both
+SPMD variants), the shrinking manager (solver/shrink.py), and the
+benchmark harnesses (bench.py, bench_convergence.py via
+``BENCH_TRACE_OUT``). Consumers: the ``dpsvm report`` and ``dpsvm
+compare`` CLI subcommands (observability/report.py, compare.py).
+
+This module never touches a device: callers pass device facts in via
+``env`` / ``hbm`` / the compile log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import weakref
+from typing import Dict, List, Optional
+
+from dpsvm_tpu.observability.schema import (TRACE_SCHEMA_VERSION,
+                                            TraceWriter)
+
+# Every in-flight RunTrace, so emergency exit paths (the stall watchdog's
+# os._exit) can stamp a terminal event record before the process dies —
+# an abandoned trace with no terminal record is indistinguishable from a
+# live run (docs/ROBUSTNESS.md). Weak: a dropped recorder unregisters
+# itself.
+_OPEN_TRACES: "weakref.WeakSet[RunTrace]" = weakref.WeakSet()
+
+
+def flush_open_traces(event: str, **extra) -> int:
+    """Best-effort: append ``event`` to every still-open trace and close
+    it. Called from exit paths that bypass the driver's finally block
+    (utils/watchdog.py expiry — a different thread, microseconds before
+    os._exit, while the training thread is wedged in a device call, so
+    a concurrent write is not a practical concern). Returns the number
+    of traces flushed; never raises."""
+    count = 0
+    for tr in list(_OPEN_TRACES):
+        try:
+            tr.event(event, **extra)
+            tr.close()
+            count += 1
+        except Exception:
+            pass
+    return count
+
+# Carry-class -> human solver-path name (the driver keys the manifest on
+# the carry type; one table so a new solver fails loudly in tests, not
+# silently as its class name).
+SOLVER_NAMES = {
+    "SMOCarry": "smo",
+    "DistCarry": "dist-smo",
+    "DecompCarry": "decomp",
+    "DistDecompCarry": "dist-decomp",
+    "FusedCarry": "fused-pallas",
+}
+
+
+def _config_dict(config) -> dict:
+    if config is None:
+        return {}
+    if dataclasses.is_dataclass(config):
+        return dataclasses.asdict(config)
+    return dict(config)
+
+
+class RunTrace:
+    """One training run's JSONL recorder.
+
+    Construction writes the manifest; ``chunk``/``event``/``compile``
+    append during the run; ``summary`` + ``close`` finish it. All
+    record shapes are owned here so every producer (driver, shrink
+    manager, benchmarks) emits the one schema
+    observability/schema.validate_trace checks.
+
+    The recorder also accumulates the run-level device facts the v2
+    summary carries — compile count/seconds, the FLOPs estimate of the
+    newest program, the high-water HBM mark across polls — so
+    producers only report what they observe and the totals can never
+    drift from the records they summarize.
+    """
+
+    def __init__(self, path: str, *, config=None, n: int = 0, d: int = 0,
+                 gamma: float = 0.0, solver: str = "unknown",
+                 it0: int = 0, env: Optional[dict] = None):
+        config_d = _config_dict(config)
+        kernel = {
+            "kind": config_d.get("kernel", "rbf"),
+            "gamma": float(gamma),
+            "coef0": float(config_d.get("coef0", 0.0)),
+            "degree": int(config_d.get("degree", 3)),
+        }
+        mesh = {"shards": int(config_d.get("shards", 1)),
+                "shard_x": bool(config_d.get("shard_x", True))}
+        from dpsvm_tpu import __version__
+        self._w = TraceWriter(path)
+        self._t0 = time.perf_counter()
+        self._it0 = int(it0)
+        self._closed = False
+        self._n_compiles = 0
+        self._compile_seconds = 0.0
+        self._est_flops: Optional[float] = None
+        self._hbm_peak: Optional[int] = None
+        self._w.write({
+            "kind": "manifest",
+            "schema": TRACE_SCHEMA_VERSION,
+            "version": __version__,
+            "solver": solver,
+            "n": int(n),
+            "d": int(d),
+            "gamma": float(gamma),
+            "kernel": kernel,
+            "mesh": mesh,
+            "env": dict(env or {"backend": None, "device_kind": None,
+                                "device_count": None}),
+            "config": config_d,
+            "it0": int(it0),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        })
+        _OPEN_TRACES.add(self)
+
+    @property
+    def path(self) -> str:
+        return self._w.path
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _t(self) -> float:
+        return round(time.perf_counter() - self._t0, 6)
+
+    def _note_hbm(self, hbm: Optional[dict]) -> Optional[dict]:
+        if not hbm:
+            return {"in_use": None, "peak": None, "limit": None}
+        peak = hbm.get("peak")
+        if peak is not None:
+            self._hbm_peak = max(self._hbm_peak or 0, int(peak))
+        return {"in_use": hbm.get("in_use"), "peak": peak,
+                "limit": hbm.get("limit")}
+
+    def chunk(self, *, n_iter: int, b_lo: float, b_hi: float,
+              n_sv: int = 0, cache_hits: int = 0, cache_misses: int = 0,
+              rounds: int = 0,
+              phases: Optional[Dict[str, float]] = None,
+              phase_counts: Optional[Dict[str, int]] = None,
+              hbm: Optional[dict] = None,
+              **extra) -> None:
+        """One host-poll record. Every argument is already on the host
+        (the packed-stats read; ``hbm`` is a host-side
+        ``device.memory_stats()`` dictionary read) — recording is file
+        I/O only."""
+        rec = {
+            "kind": "chunk",
+            "n_iter": int(n_iter),
+            "b_lo": float(b_lo),
+            "b_hi": float(b_hi),
+            "gap": float(b_lo) - float(b_hi),
+            "n_sv": int(n_sv),
+            "cache_hits": int(cache_hits),
+            "cache_misses": int(cache_misses),
+            "rounds": int(rounds),
+            "t": self._t(),
+            "phases": {k: round(float(v), 6)
+                       for k, v in (phases or {}).items()},
+            "phase_counts": {k: int(v)
+                             for k, v in (phase_counts or {}).items()},
+            "hbm": self._note_hbm(hbm),
+        }
+        rec.update(extra)
+        self._w.write(rec)
+
+    def event(self, event: str, *, n_iter: int = 0, **extra) -> None:
+        """Solver lifecycle marker: checkpoint, program_swap (working-set
+        growth), wall_budget, shrink, unshrink."""
+        rec = {"kind": "event", "event": str(event),
+               "n_iter": int(n_iter), "t": self._t()}
+        rec.update(extra)
+        self._w.write(rec)
+
+    def compile(self, *, program: str, seconds: float,
+                signature: Optional[str] = None,
+                flops: Optional[float] = None, n_iter: int = 0,
+                **extra) -> None:
+        """One XLA compile (or retrace) of a chunk program
+        (observability/compilewatch.py detects them; the driver drains
+        its log here). ``flops`` is the program's cost_analysis
+        estimate — on the chunk runners, the while-loop body counted
+        once, i.e. ~per-iteration FLOPs (docs/OBSERVABILITY.md)."""
+        rec = {"kind": "compile", "program": str(program),
+               "seconds": round(float(seconds), 6),
+               "signature": signature,
+               "flops": float(flops) if flops is not None else None,
+               "n_iter": int(n_iter), "t": self._t()}
+        rec.update(extra)
+        self._n_compiles += 1
+        self._compile_seconds += float(seconds)
+        if flops is not None:
+            self._est_flops = float(flops)
+        self._w.write(rec)
+
+    def summary(self, *, converged: bool, n_iter: int, b: float,
+                b_lo: float, b_hi: float, n_sv: int,
+                train_seconds: float, cache_hits: int = 0,
+                cache_misses: int = 0,
+                phases: Optional[Dict[str, float]] = None,
+                phase_counts: Optional[Dict[str, int]] = None,
+                **extra) -> None:
+        iters = int(n_iter) - self._it0
+        lookups = int(cache_hits) + int(cache_misses)
+        rec = {
+            "kind": "summary",
+            "converged": bool(converged),
+            "n_iter": int(n_iter),
+            "iters": iters,
+            "iters_per_sec": round(iters / train_seconds, 3)
+            if train_seconds > 0 else 0.0,
+            "b": float(b),
+            "b_lo": float(b_lo),
+            "b_hi": float(b_hi),
+            "gap": float(b_lo) - float(b_hi),
+            "n_sv": int(n_sv),
+            "cache_hits": int(cache_hits),
+            "cache_misses": int(cache_misses),
+            "cache_hit_rate": round(cache_hits / lookups, 6)
+            if lookups else None,
+            "train_seconds": round(float(train_seconds), 6),
+            "phases": {k: round(float(v), 6)
+                       for k, v in (phases or {}).items()},
+            "phase_counts": {k: int(v)
+                             for k, v in (phase_counts or {}).items()},
+            "n_compiles": self._n_compiles,
+            "compile_seconds": round(self._compile_seconds, 6),
+            "hbm_peak": self._hbm_peak,
+            "est_flops": self._est_flops,
+            "t": self._t(),
+        }
+        rec.update(extra)
+        self._w.write(rec)
+
+    def close(self) -> None:
+        self._closed = True
+        _OPEN_TRACES.discard(self)
+        self._w.close()

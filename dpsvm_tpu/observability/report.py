@@ -1,0 +1,364 @@
+"""Trace consumers: digest, ASCII report, live ``--follow`` tail.
+
+Everything here is pure file I/O over the JSONL schema
+(observability/schema.py) — no backend init, so reports render on a
+machine with no accelerator (or a dead tunnel), which is exactly when
+they are needed most.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from dpsvm_tpu.observability.schema import (TERMINAL_EVENTS, read_trace,
+                                            validate_trace)
+
+
+def load_trace(path: str) -> List[dict]:
+    """read + validate; raises ValueError with every problem listed."""
+    records = read_trace(path)
+    errors = validate_trace(records)
+    if errors:
+        raise ValueError(f"invalid trace {path}: " + "; ".join(errors))
+    return records
+
+
+def resolve_trace_path(path: str) -> str:
+    """A trace argument may be a directory (the burst runner archives
+    under ``<results>/traces/``): resolve to its newest ``*.jsonl``.
+    Plain files pass through untouched."""
+    if not os.path.isdir(path):
+        return path
+    candidates = [os.path.join(path, f) for f in os.listdir(path)
+                  if f.endswith(".jsonl")]
+    if not candidates:
+        raise FileNotFoundError(
+            f"no *.jsonl trace in directory {path}")
+    return max(candidates, key=os.path.getmtime)
+
+
+def trace_facts(records: List[dict]) -> dict:
+    """The flat per-run metrics dict shared by ``report --json``, the
+    bench harnesses' result rows, and ``dpsvm compare``. Robust to a
+    partial trace (no summary): facts degrade to the last chunk's view
+    so an in-flight or killed run still compares/renders."""
+    manifest = records[0] if records else {}
+    chunks = [r for r in records if r.get("kind") == "chunk"]
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    summary = next((r for r in records if r.get("kind") == "summary"),
+                   None)
+    it0 = int(manifest.get("it0", 0) or 0)
+    src = summary or (chunks[-1] if chunks else {})
+    n_iter = int(src.get("n_iter", it0) or it0)
+    if summary is not None:
+        iters = summary["iters"]
+        seconds = summary["train_seconds"]
+        ips = summary["iters_per_sec"]
+        hbm_peak = summary.get("hbm_peak")
+        n_compiles = summary.get("n_compiles")
+        compile_seconds = summary.get("compile_seconds")
+        est_flops = summary.get("est_flops")
+    else:
+        iters = n_iter - it0
+        seconds = float(src.get("t", 0.0) or 0.0)
+        ips = round(iters / seconds, 3) if seconds > 0 else 0.0
+        peaks = [c.get("hbm", {}).get("peak") for c in chunks]
+        peaks = [p for p in peaks if p is not None]
+        hbm_peak = max(peaks) if peaks else None
+        n_compiles = len(compiles) or None
+        compile_seconds = (round(sum(c.get("seconds", 0.0)
+                                     for c in compiles), 6)
+                           if compiles else None)
+        est_flops = next((c.get("flops") for c in reversed(compiles)
+                          if c.get("flops") is not None), None)
+    hits = int(src.get("cache_hits", 0) or 0)
+    misses = int(src.get("cache_misses", 0) or 0)
+    lookups = hits + misses
+    est_flops_per_sec = (est_flops * iters / seconds
+                         if est_flops and seconds and iters > 0 else None)
+    return {
+        "solver": manifest.get("solver"),
+        "n": manifest.get("n"),
+        "d": manifest.get("d"),
+        "schema": manifest.get("schema"),
+        "converged": (summary or {}).get("converged"),
+        "n_iter": n_iter,
+        "iters": iters,
+        "iters_per_sec": ips,
+        "train_seconds": seconds,
+        "gap": src.get("gap"),
+        "n_sv": src.get("n_sv"),
+        "cache_hit_rate": (hits / lookups) if lookups else None,
+        "n_compiles": n_compiles,
+        "compile_seconds": compile_seconds,
+        "hbm_peak": hbm_peak,
+        "est_flops": est_flops,
+        "est_flops_per_sec": est_flops_per_sec,
+        "phases": dict((summary or {}).get("phases")
+                       or (chunks[-1].get("phases") if chunks else {})
+                       or {}),
+        "phase_counts": dict((summary or {}).get("phase_counts")
+                             or (chunks[-1].get("phase_counts")
+                                 if chunks else {}) or {}),
+        "curve": [(c["n_iter"], c["gap"]) for c in chunks],
+    }
+
+
+def summarize_trace(records: List[dict]) -> dict:
+    """The machine-readable digest ``dpsvm report --json`` prints."""
+    manifest = records[0] if records else {}
+    chunks = [r for r in records if r.get("kind") == "chunk"]
+    events = [r for r in records if r.get("kind") == "event"]
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    summary = next((r for r in records if r.get("kind") == "summary"),
+                   None)
+    return {
+        "manifest": manifest,
+        "summary": summary,
+        "n_chunks": len(chunks),
+        "events": events,
+        "compiles": compiles,
+        "facts": trace_facts(records),
+        "curve": [{"n_iter": c["n_iter"], "gap": c["gap"],
+                   "n_sv": c["n_sv"], "t": c["t"]} for c in chunks],
+    }
+
+
+def _fmt_si(v: float) -> str:
+    return f"{v:,.0f}" if v >= 100 else f"{v:.3g}"
+
+
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:,.1f} {unit}" if unit != "B" else f"{v:,.0f} B"
+        v /= 1024
+    return f"{v:,.1f} TiB"
+
+
+def _fmt_flops(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000 or unit == "P":
+            return f"{v:,.2f} {unit}FLOP"
+        v /= 1000
+    return f"{v:,.2f} PFLOP"
+
+
+def _gap_curve(chunks: List[dict], width: int = 60,
+               height: int = 10) -> List[str]:
+    """ASCII iter-vs-gap plot (log-scale gap). Robust down to a single
+    chunk record (the acceptance floor: manifest + >= 1 chunk +
+    summary)."""
+    pts = [(c["n_iter"], c["gap"]) for c in chunks if c["gap"] > 0]
+    if not pts:
+        return ["  (no open-gap chunk records to plot)"]
+    its = [p[0] for p in pts]
+    lgs = [math.log10(p[1]) for p in pts]
+    it_lo, it_hi = min(its), max(its)
+    lg_lo, lg_hi = min(lgs), max(lgs)
+    it_span = max(it_hi - it_lo, 1)
+    lg_span = max(lg_hi - lg_lo, 1e-9)
+    grid = [[" "] * width for _ in range(height)]
+    for it, lg in zip(its, lgs):
+        col = min(int((it - it_lo) / it_span * (width - 1)), width - 1)
+        row = min(int((lg_hi - lg) / lg_span * (height - 1)), height - 1)
+        grid[row][col] = "*"
+    lines = []
+    for r in range(height):
+        lg = lg_hi - r * lg_span / (height - 1 or 1)
+        label = f"{10 ** lg:8.1e}" if r in (0, height - 1) else " " * 8
+        lines.append(f"  {label} |" + "".join(grid[r]))
+    lines.append("  " + " " * 8 + "+" + "-" * width)
+    left = f"{it_lo:,}"
+    right = f"{it_hi:,}"
+    pad = max(width - len(left) - len(right), 1)
+    lines.append("  " + " " * 9 + left + " " * pad + right)
+    return lines
+
+
+def _phase_bars(phases: Dict[str, float],
+                counts: Optional[Dict[str, int]] = None) -> List[str]:
+    """Per-phase time bars; with counts, each line carries how many
+    times the phase ran — a phase slow because it ran 400x reads very
+    differently from one slow call."""
+    counts = counts or {}
+    total = sum(phases.values())
+    if not phases or total <= 0:
+        return ["  (no phase timings recorded)"]
+    width = max(len(k) for k in phases)
+    lines = []
+    for name, sec in sorted(phases.items(), key=lambda kv: -kv[1]):
+        frac = sec / total
+        bar = "#" * max(int(round(frac * 30)), 1 if sec > 0 else 0)
+        tail = f"  {counts[name]:,}x" if counts.get(name) else ""
+        lines.append(f"  {name:<{width}}  {sec:8.3f} s  {frac:5.1%}  "
+                     f"{bar}{tail}")
+    return lines
+
+
+def render_report(records: List[dict], width: int = 60) -> str:
+    """The human rendering behind ``dpsvm report``."""
+    m = records[0]
+    chunks = [r for r in records if r.get("kind") == "chunk"]
+    events = [r for r in records if r.get("kind") == "event"]
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    s = next((r for r in records if r.get("kind") == "summary"), None)
+    facts = trace_facts(records)
+    k = m["kernel"]
+    env = m.get("env") or {}
+    out = []
+    kern = k["kind"]
+    if kern in ("rbf", "poly", "sigmoid"):
+        kern += f"(gamma={k['gamma']:g})"
+    out.append(f"run: {m['solver']}  {m['n']}x{m['d']}  {kern}  "
+               f"shards={m['mesh']['shards']}  "
+               f"backend={env.get('backend')} "
+               f"{env.get('device_kind') or ''}  "
+               f"dpsvm_tpu {m['version']}")
+    if s is not None:
+        status = "converged" if s["converged"] else "NOT converged"
+        out.append(f"result: {status} at iter {s['n_iter']:,} in "
+                   f"{s['train_seconds']:.2f} s "
+                   f"({_fmt_si(s['iters_per_sec'])} it/s)   "
+                   f"gap {s['gap']:.3g}  b={s['b']:.6g}  "
+                   f"n_sv={s['n_sv']:,}")
+    else:
+        out.append("result: (no summary record — run still in flight "
+                   "or killed)")
+    # Device/compiler layer (schema v2; silent on v1 traces, which
+    # carry none of these facts).
+    if facts.get("n_compiles"):
+        comp_s = facts.get("compile_seconds") or 0.0
+        denom = facts.get("train_seconds") or 0.0
+        share = (f" ({comp_s / denom:.0%} of train time)"
+                 if denom > 0 else "")
+        out.append(f"compiles: {facts['n_compiles']} program(s) in "
+                   f"{comp_s:.2f} s{share}")
+    if facts.get("hbm_peak") is not None:
+        limit = None
+        for c in chunks:
+            limit = (c.get("hbm") or {}).get("limit") or limit
+        head = (f"  ({facts['hbm_peak'] / limit:.0%} of "
+                f"{_fmt_bytes(limit)} limit)" if limit else "")
+        out.append(f"hbm peak: {_fmt_bytes(facts['hbm_peak'])}{head}")
+    if facts.get("est_flops_per_sec") is not None:
+        out.append(f"throughput: ~{_fmt_flops(facts['est_flops_per_sec'])}"
+                   f"/s achieved (cost-model: "
+                   f"{_fmt_flops(facts['est_flops'])}/iter x "
+                   f"{facts['iters']:,} iters)")
+    out.append("")
+    out.append("convergence (gap vs iteration, log scale):")
+    out.extend(_gap_curve(chunks, width=width))
+    out.append("")
+    phases = (s or {}).get("phases") or (
+        chunks[-1]["phases"] if chunks else {})
+    counts = ((s or {}).get("phase_counts")
+              or (chunks[-1].get("phase_counts") if chunks else {}))
+    out.append("host-loop phase time:")
+    out.extend(_phase_bars(phases, counts))
+    out.append("")
+    src = s or (chunks[-1] if chunks else None)
+    if src is not None:
+        lookups = src["cache_hits"] + src["cache_misses"]
+        if lookups:
+            out.append(f"kernel-row cache: {lookups:,} lookups, hit rate "
+                       f"{src['cache_hits'] / lookups:.1%} "
+                       f"({src['cache_hits']:,} hits / "
+                       f"{src['cache_misses']:,} misses)")
+        else:
+            out.append("kernel-row cache: off (cache_size=0)")
+        if src.get("rounds"):
+            out.append(f"decomposition outer rounds: {src['rounds']:,}")
+    if compiles:
+        out.append("compile events: " + ", ".join(
+            f"{c['program']}@{c['seconds']:.2f}s" for c in compiles))
+    if events:
+        out.append("events: " + ", ".join(
+            f"{e['event']}@{e['n_iter']:,}" for e in events))
+    out.append(f"chunk polls recorded: {len(chunks)}")
+    return "\n".join(out)
+
+
+def _is_terminal(records: List[dict]) -> Optional[str]:
+    """'summary' when the run finished, the terminal event name when it
+    died visibly (stall/preempt), None while in flight."""
+    for r in reversed(records):
+        kind = r.get("kind")
+        if kind == "summary":
+            return "summary"
+        if kind == "event" and r.get("event") in TERMINAL_EVENTS:
+            return r["event"]
+    return None
+
+
+def follow_trace(path: str, *, interval: float = 1.0,
+                 stall_timeout: float = 120.0, width: int = 60,
+                 out=None,
+                 render: Optional[Callable[[List[dict]], str]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep) -> int:
+    """Tail an in-flight JSONL trace and re-render the report until a
+    terminal record lands — `dpsvm report --follow`, the watchable
+    version of a tunneled chip run.
+
+    Returns 0 when the run finished (summary record), 1 when it died
+    visibly (stall/preempt terminal event), 3 when the file stopped
+    growing for ``stall_timeout`` seconds (a run killed too hard to
+    stamp its own terminal event — e.g. SIGKILL). A not-yet-created
+    file counts as not-growing, so following a path before the run
+    starts works and still times out if it never does.
+
+    Reads use the torn-line-tolerant reader (the writer flushes per
+    record, so a partial final line only means "mid-write")."""
+    out = out if out is not None else sys.stdout
+    render = render or (lambda recs: render_report(recs, width=width))
+    is_tty = getattr(out, "isatty", lambda: False)()
+    last_size = -1
+    last_grew = clock()
+    shown = 0
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = -1
+        if size != last_size:
+            last_size = size
+            last_grew = clock()
+            records = []
+            if size > 0:
+                try:
+                    records = read_trace(path)
+                except ValueError:
+                    records = []        # interleaved writer mid-line
+            if records and records[0].get("kind") == "manifest":
+                text = render(records)
+                if is_tty:
+                    out.write("\x1b[2J\x1b[H" + text + "\n")
+                else:
+                    if shown:
+                        out.write("\n" + "=" * 8 + " refresh " +
+                                  "=" * 8 + "\n")
+                    out.write(text + "\n")
+                out.flush()
+                shown += 1
+                terminal = _is_terminal(records)
+                if terminal == "summary":
+                    return 0
+                if terminal is not None:
+                    out.write(f"run ended: {terminal}\n")
+                    out.flush()
+                    return 1
+        if clock() - last_grew > stall_timeout:
+            out.write(f"trace stalled: no growth in {stall_timeout:g} s "
+                      f"({path})\n")
+            out.flush()
+            return 3
+        sleep(interval)

@@ -1,0 +1,211 @@
+"""Run-trace JSONL format: writer, reader, schema validation.
+
+One training run = one JSONL file (``SVMConfig.trace_out`` / the train
+CLI's ``--trace-out``): a ``manifest`` record (what was asked for and on
+what hardware), then ``chunk`` records at every host poll (the solver's
+packed-stats transfer already carries n_iter/gap/SV-count/cache
+counters, so tracing adds ZERO device->host transfers — see
+solver/driver.py "Poll economics"), ``compile`` records whenever a
+chunk program pays an XLA compile or retrace (docs/OBSERVABILITY.md
+"Compile accounting"), optional ``event`` records (checkpoint /
+program swap / shrink), and a final ``summary`` record.
+
+This module is deliberately dependency-free (no jax import): the
+``report``/``compare`` CLI subcommands and the schema self-check must
+run without initializing any backend. The recorder that knows about
+solvers lives in ``dpsvm_tpu.observability.record``.
+
+The schema is versioned and validated by ``validate_trace`` — the same
+function backs ``python -m dpsvm_tpu.telemetry --selfcheck`` (tier-1:
+tests/test_observability.py), so a drifting producer fails loudly
+instead of silently writing traces the report renderer can no longer
+read. Version history:
+
+* v1 — manifest/chunk/event/summary (PR 1). Still accepted: a v1
+  manifest selects the v1 key sets and forbids v2-only record kinds.
+* v2 — adds the ``compile`` record kind, per-chunk ``hbm`` watermarks
+  and ``phase_counts``, and the summary's compile/HBM/FLOP facts
+  (``n_compiles``, ``compile_seconds``, ``hbm_peak``, ``est_flops``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO, List, Optional
+
+TRACE_SCHEMA_VERSION = 2
+SUPPORTED_SCHEMAS = (1, 2)
+
+# Required keys per record kind. Values may be null where noted in
+# docs/OBSERVABILITY.md (e.g. env.device_kind on an uninitialized
+# backend, hbm watermarks on CPU); presence is the contract.
+MANIFEST_KEYS = ("schema", "version", "solver", "n", "d", "gamma",
+                 "kernel", "mesh", "env", "config", "it0", "time")
+CHUNK_KEYS_V1 = ("n_iter", "b_lo", "b_hi", "gap", "n_sv", "cache_hits",
+                 "cache_misses", "rounds", "t", "phases")
+CHUNK_KEYS = CHUNK_KEYS_V1 + ("phase_counts", "hbm")
+EVENT_KEYS = ("event", "n_iter", "t")
+COMPILE_KEYS = ("program", "seconds", "t")
+SUMMARY_KEYS_V1 = ("converged", "n_iter", "iters", "iters_per_sec", "b",
+                   "b_lo", "b_hi", "gap", "n_sv", "cache_hits",
+                   "cache_misses", "cache_hit_rate", "train_seconds",
+                   "phases", "t")
+SUMMARY_KEYS = SUMMARY_KEYS_V1 + ("phase_counts", "n_compiles",
+                                  "compile_seconds", "hbm_peak",
+                                  "est_flops")
+KINDS_V1 = ("manifest", "chunk", "event", "summary")
+KINDS = KINDS_V1 + ("compile",)
+
+# Events that may legitimately FOLLOW the summary record: emergency
+# exit paths (the stall watchdog's flush_open_traces, a preemption
+# signal landing between summary and close) stamp their marker into an
+# already-summarized trace rather than lose it (docs/ROBUSTNESS.md).
+# Everything else after a summary is trace corruption or interleaved
+# writers — rejected by validate_trace.
+TERMINAL_EVENTS = ("stall", "preempt")
+
+
+class TraceWriter:
+    """Append-one-JSON-record-per-line writer, flushed per record so a
+    killed run still leaves a parseable partial trace."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[IO[str]] = open(path, "w")
+
+    def write(self, record: dict) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "TraceWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_trace(path: str) -> List[dict]:
+    """Parse a trace file into its records. Raises ValueError on a line
+    that is not JSON (a truncated FINAL line — a run killed mid-write —
+    is tolerated and dropped, matching the flush-per-record writer)."""
+    records: List[dict] = []
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    for i, raw in enumerate(lines):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            records.append(json.loads(raw))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break                   # torn final write of a dead run
+            raise ValueError(f"{path}:{i + 1}: not a JSON record")
+    return records
+
+
+def _missing(record: dict, keys) -> List[str]:
+    return [k for k in keys if k not in record]
+
+
+def validate_trace(records: List[dict]) -> List[str]:
+    """Schema check; returns a list of problems (empty = valid).
+
+    Contract (acceptance bar of docs/OBSERVABILITY.md): exactly one
+    leading manifest at a supported schema version (the version selects
+    the per-kind key sets — v1 traces keep validating); >= 0 chunk
+    records with monotone non-decreasing n_iter and non-negative
+    counters; ``t`` non-decreasing across every record that carries it;
+    at most one summary, followed only by terminal events (stall /
+    preempt — the emergency flush paths). A ``rollback`` event
+    legitimately rewinds the run to its checkpoint's iteration
+    (docs/ROBUSTNESS.md), so it resets the n_iter monotonicity
+    baseline; nothing resets the ``t`` baseline — a time rewind means
+    interleaved writers."""
+    errors: List[str] = []
+    if not records:
+        return ["empty trace (no records)"]
+    head = records[0]
+    schema = head.get("schema") if isinstance(head, dict) else None
+    v1 = schema == 1
+    kinds = KINDS_V1 if v1 else KINDS
+    chunk_keys = CHUNK_KEYS_V1 if v1 else CHUNK_KEYS
+    summary_keys = SUMMARY_KEYS_V1 if v1 else SUMMARY_KEYS
+    for i, r in enumerate(records):
+        if not isinstance(r, dict) or r.get("kind") not in kinds:
+            errors.append(f"record {i}: unknown kind "
+                          f"{r.get('kind') if isinstance(r, dict) else r!r}")
+    if head.get("kind") != "manifest":
+        errors.append("record 0: trace must start with a manifest")
+    else:
+        if schema not in SUPPORTED_SCHEMAS:
+            errors.append(f"manifest: schema {schema!r} not in "
+                          f"supported {SUPPORTED_SCHEMAS}")
+        miss = _missing(head, MANIFEST_KEYS)
+        if miss:
+            errors.append(f"manifest: missing keys {miss}")
+    if sum(isinstance(r, dict) and r.get("kind") == "manifest"
+           for r in records) > 1:
+        errors.append("multiple manifest records")
+
+    prev_iter = None
+    prev_t = None
+    summary_at = None
+    for i, r in enumerate(records):
+        if not isinstance(r, dict):
+            continue
+        kind = r.get("kind")
+        t = r.get("t")
+        if isinstance(t, (int, float)):
+            if prev_t is not None and t < prev_t:
+                errors.append(f"record {i}: t {t} < previous {prev_t} "
+                              "(time must be non-decreasing)")
+            prev_t = t
+        if summary_at is not None and not (
+                kind == "event" and r.get("event") in TERMINAL_EVENTS):
+            errors.append(f"record {i}: only terminal events "
+                          f"({'/'.join(TERMINAL_EVENTS)}) may follow "
+                          f"the final summary at record {summary_at}")
+        if kind == "chunk":
+            miss = _missing(r, chunk_keys)
+            if miss:
+                errors.append(f"record {i}: chunk missing keys {miss}")
+                continue
+            if prev_iter is not None and r["n_iter"] < prev_iter:
+                errors.append(f"record {i}: n_iter {r['n_iter']} < "
+                              f"previous {prev_iter} (not monotone)")
+            prev_iter = r["n_iter"]
+            for k in ("n_sv", "cache_hits", "cache_misses", "rounds"):
+                if r[k] < 0:
+                    errors.append(f"record {i}: {k} = {r[k]} < 0")
+        elif kind == "event":
+            miss = _missing(r, EVENT_KEYS)
+            if miss:
+                errors.append(f"record {i}: event missing keys {miss}")
+            elif r.get("event") == "rollback":
+                # The run restarted from a checkpoint at this iteration.
+                prev_iter = r["n_iter"]
+        elif kind == "compile":
+            miss = _missing(r, COMPILE_KEYS)
+            if miss:
+                errors.append(f"record {i}: compile missing keys {miss}")
+            elif r["seconds"] < 0:
+                errors.append(f"record {i}: compile seconds "
+                              f"{r['seconds']} < 0")
+        elif kind == "summary":
+            miss = _missing(r, summary_keys)
+            if miss:
+                errors.append(f"record {i}: summary missing keys {miss}")
+            if summary_at is not None:
+                errors.append(f"record {i}: second summary (first at "
+                              f"record {summary_at})")
+            else:
+                summary_at = i
+    return errors

@@ -47,6 +47,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.ops.kernels import KernelSpec, rows_from_dots
 from dpsvm_tpu.ops.selection import masked_scores_and_masks
 from dpsvm_tpu.parallel.dist_smo import (_local_slice,
@@ -298,11 +299,15 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
     def build(q_now: int):
         q_now = 2 * min(int(q_now) // 2, n)     # same clamp as above
         cap = int(config.inner_iters) or max(32, q_now // 4)
-        r = _build_dist_decomp_runner(
-            mesh, float(config.c), kspec, eps, n_s, q_now, cap,
-            bool(config.shard_x), config.matmul_precision.upper(),
-            (float(config.weight_pos), float(config.weight_neg)),
-            config.clip == "pairwise")
+        # Per-q program name, like the single-device decomp path: the
+        # trace shows which regrow paid the recompile.
+        r = compilewatch.instrument(
+            _build_dist_decomp_runner(
+                mesh, float(config.c), kspec, eps, n_s, q_now, cap,
+                bool(config.shard_x), config.matmul_precision.upper(),
+                (float(config.weight_pos), float(config.weight_neg)),
+                config.clip == "pairwise"),
+            f"dist-decomp-chunk/q={q_now}")
 
         def step(cr, lim):
             limit = jax.device_put(np.int32(lim), repl)

@@ -48,6 +48,7 @@ from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
+from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
                                      pcast_varying, shard_map_compat,
@@ -570,16 +571,18 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
         cm=jax.device_put(np.int32(0), repl),
     )
 
-    runner = _build_dist_runner(mesh, float(config.c), kspec, eps, n_s,
-                                bool(config.shard_x),
-                                config.matmul_precision.upper(),
-                                config.selection == "second-order",
-                                (float(config.weight_pos),
-                                 float(config.weight_neg)),
-                                use_cache=lines > 0,
-                                packed_select=config.select_impl == "packed",
-                                pairwise_clip=config.clip == "pairwise",
-                                guard_eta=guard_eta)
+    runner = compilewatch.instrument(
+        _build_dist_runner(mesh, float(config.c), kspec, eps, n_s,
+                           bool(config.shard_x),
+                           config.matmul_precision.upper(),
+                           config.selection == "second-order",
+                           (float(config.weight_pos),
+                            float(config.weight_neg)),
+                           use_cache=lines > 0,
+                           packed_select=config.select_impl == "packed",
+                           pairwise_clip=config.clip == "pairwise",
+                           guard_eta=guard_eta),
+        f"dist-smo-chunk/p={p}")
 
     def step_chunk(c, lim):
         limit = jax.device_put(np.int32(lim), repl)

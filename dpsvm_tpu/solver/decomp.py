@@ -50,6 +50,7 @@ from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
 from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
                                    host_row_norms_sq,
                                    rows_from_dots)
+from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.ops.selection import masked_scores_and_masks
 from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
@@ -400,13 +401,18 @@ def train_single_device_decomp(x: np.ndarray, y: np.ndarray,
 
     def build(q_now: int):
         cap = int(config.inner_iters) or max(32, q_now // 4)
-        r = _build_decomp_runner(float(config.c), kspec,
+        # Compile accounting per program: a growth swap builds (and
+        # names) a fresh q so the trace shows WHICH regrow paid the
+        # recompile (docs/OBSERVABILITY.md).
+        r = compilewatch.instrument(
+            _build_decomp_runner(float(config.c), kspec,
                                  float(config.epsilon), q_now, cap,
                                  config.matmul_precision.upper(),
                                  (float(config.weight_pos),
                                   float(config.weight_neg)),
                                  config.clip == "pairwise",
-                                 pallas_inner=config.use_pallas == "on")
+                                 pallas_inner=config.use_pallas == "on"),
+            f"decomp-chunk/q={q_now}")
         return lambda cr, lim: r(cr, xd, yd, x2, np.int32(lim))
 
     poll_hook = (_make_growth_hook(config, n, q, build)

@@ -49,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
+from dpsvm_tpu.observability import compilewatch
+from dpsvm_tpu.observability.device import memory_snapshot
 from dpsvm_tpu.resilience import faultinject, preempt
 from dpsvm_tpu.resilience.health import DivergenceError, HealthMonitor
 from dpsvm_tpu.utils import watchdog
@@ -235,6 +237,20 @@ def begin_trace(config: SVMConfig, n: int, d: int, gamma: float,
     return trace
 
 
+def drain_compiles(trace, n_iter: int = 0) -> None:
+    """Flush pending compile observations (observability/compilewatch)
+    into ``trace`` as ``compile`` records. Draining with tracing off
+    discards them, so one run's compiles can never leak into the next
+    run's trace. Called at poll boundaries by every trace producer
+    (this driver, the shrinking manager, the bench harnesses)."""
+    for rec in compilewatch.drain():
+        if trace is not None:
+            trace.compile(program=rec["program"],
+                          seconds=rec["seconds"],
+                          signature=rec.get("signature"),
+                          flops=rec.get("flops"), n_iter=n_iter)
+
+
 def host_training_loop(
     config: SVMConfig,
     gamma: float,
@@ -347,6 +363,14 @@ def host_training_loop(
                 if faults is not None and faults.note_poll():
                     preempt.simulate(signal.SIGTERM)
                 n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
+                # Device/compiler facts for this poll, all host-side
+                # reads (docs/OBSERVABILITY.md): compile observations
+                # queued by the instrumented chunk runners land as
+                # trace records before the chunk they delayed, and the
+                # allocator watermark is a dictionary read — still
+                # ZERO extra device->host transfers.
+                drain_compiles(trace, n_iter)
+                hbm = memory_snapshot() if trace is not None else None
                 # Finite-aware: every NaN comparison is False, so a
                 # plain `not (b_lo > ...)` would declare a NaN gap
                 # CONVERGED and return garbage marked success. A
@@ -414,7 +438,9 @@ def host_training_loop(
                                 n_sv=st.n_sv, cache_hits=st.cache_hits,
                                 cache_misses=st.cache_misses,
                                 rounds=st.rounds,
-                                phases=dict(timer.seconds))
+                                phases=dict(timer.seconds),
+                                phase_counts=dict(timer.counts),
+                                hbm=hbm)
 
                 # Divergence guards — BEFORE maybe_checkpoint, so a sick
                 # state is never saved over a good rotation slot.
@@ -527,6 +553,7 @@ def host_training_loop(
             degree=int(config.degree),
         )
         if trace is not None:
+            drain_compiles(trace, result.n_iter)
             trace.summary(converged=result.converged,
                           n_iter=result.n_iter, b=result.b,
                           b_lo=result.b_lo, b_hi=result.b_hi,
@@ -535,8 +562,13 @@ def host_training_loop(
                           cache_hits=st.cache_hits,
                           cache_misses=st.cache_misses,
                           rounds=st.rounds,
-                          phases=dict(timer.seconds))
+                          phases=dict(timer.seconds),
+                          phase_counts=dict(timer.counts))
         return result
     finally:
+        # Leftover compile observations (error exits, untraced runs)
+        # must not leak into the next run's trace.
+        drain_compiles(trace if trace is not None and not trace.closed
+                       else None)
         if trace is not None:
             trace.close()

@@ -47,9 +47,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
+from dpsvm_tpu.observability import compilewatch
+from dpsvm_tpu.observability.device import memory_snapshot
 from dpsvm_tpu.ops.kernels import KernelSpec, host_row_norms_sq
 from dpsvm_tpu.ops.selection import iup_ilow_masks_np
-from dpsvm_tpu.solver.driver import begin_trace, read_stats
+from dpsvm_tpu.solver.driver import begin_trace, drain_compiles, read_stats
 from dpsvm_tpu.utils import watchdog
 from dpsvm_tpu.utils.logging import log_progress
 
@@ -208,20 +210,28 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
     else:
         xd_full = jax.device_put(jnp.asarray(x), device)
 
+    # Compile accounting (docs/OBSERVABILITY.md): the ONE masked runner
+    # is reused across capacity buckets, so each new bucket shape shows
+    # up as a retrace of the same program in the trace — exactly the
+    # ≤ log2(n) program economics _bucket_cap promises, now measurable.
     if not dist and decomp:
         from dpsvm_tpu.solver.decomp import (_build_decomp_runner,
                                              init_carry)
-        runner = _build_decomp_runner(
-            float(config.c), kspec, eps, q, inner_cap, precision_name,
-            weights, pairwise, pallas_inner=config.use_pallas == "on",
-            masked=True)
+        runner = compilewatch.instrument(
+            _build_decomp_runner(
+                float(config.c), kspec, eps, q, inner_cap,
+                precision_name, weights, pairwise,
+                pallas_inner=config.use_pallas == "on", masked=True),
+            f"shrink-decomp-chunk/q={q}")
     elif not dist:
         from dpsvm_tpu.solver.smo import _build_chunk_runner, init_carry
-        runner = _build_chunk_runner(
-            float(config.c), kspec, eps, False, precision_name,
-            config.selection == "second-order", weights,
-            config.select_impl == "packed", pairwise,
-            guard_eta=guard_eta, masked=True)
+        runner = compilewatch.instrument(
+            _build_chunk_runner(
+                float(config.c), kspec, eps, False, precision_name,
+                config.selection == "second-order", weights,
+                config.select_impl == "packed", pairwise,
+                guard_eta=guard_eta, masked=True),
+            "shrink-smo-chunk")
 
     def make_active(idx: np.ndarray):
         """(step, pull, carry) for the active subproblem.
@@ -316,10 +326,12 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         if decomp:
             from dpsvm_tpu.parallel.dist_decomp import (
                 DistDecompCarry, _build_dist_decomp_runner)
-            run = _build_dist_decomp_runner(
-                mesh, float(config.c), kspec, eps, n_s, q,
-                inner_cap, bool(config.shard_x), precision_name,
-                weights, pairwise)
+            run = compilewatch.instrument(
+                _build_dist_decomp_runner(
+                    mesh, float(config.c), kspec, eps, n_s, q,
+                    inner_cap, bool(config.shard_x), precision_name,
+                    weights, pairwise),
+                f"shrink-dist-decomp-chunk/n_s={n_s}")
             carry = DistDecompCarry(alpha=a_seed, f=f_seed, b_hi=b_hi0,
                                     b_lo=b_lo0, n_iter=it0,
                                     rounds=jax.device_put(np.int32(0),
@@ -327,13 +339,15 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
         else:
             from dpsvm_tpu.parallel.dist_smo import (DistCarry,
                                                      _build_dist_runner)
-            run = _build_dist_runner(
-                mesh, float(config.c), kspec, eps, n_s,
-                bool(config.shard_x), precision_name,
-                config.selection == "second-order", weights,
-                use_cache=False,
-                packed_select=config.select_impl == "packed",
-                pairwise_clip=pairwise, guard_eta=guard_eta)
+            run = compilewatch.instrument(
+                _build_dist_runner(
+                    mesh, float(config.c), kspec, eps, n_s,
+                    bool(config.shard_x), precision_name,
+                    config.selection == "second-order", weights,
+                    use_cache=False,
+                    packed_select=config.select_impl == "packed",
+                    pairwise_clip=pairwise, guard_eta=guard_eta),
+                f"shrink-dist-smo-chunk/n_s={n_s}")
             carry = DistCarry(
                 alpha=a_seed, f=f_seed, b_hi=b_hi0, b_lo=b_lo0,
                 n_iter=it0,
@@ -390,10 +404,15 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                 log_progress(config, it, b_lo, b_hi, final=False,
                              prev_iter=prev_polled)
             if trace is not None:
+                # Same poll-boundary device facts as the shared driver:
+                # pending compile observations (the manager's own
+                # rebuilds land here) and the HBM watermark.
+                drain_compiles(trace, it)
                 trace.chunk(n_iter=it, b_lo=b_lo, b_hi=b_hi,
                             n_sv=st.n_sv, cache_hits=st.cache_hits,
                             cache_misses=st.cache_misses,
-                            rounds=st.rounds, n_active=len(active))
+                            rounds=st.rounds, n_active=len(active),
+                            hbm=memory_snapshot())
 
             if sub_converged or capped:
                 # Scatter the subproblem's state back.
@@ -475,6 +494,7 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
             degree=int(config.degree),
         )
         if trace is not None:
+            drain_compiles(trace, result.n_iter)
             trace.summary(converged=result.converged,
                           n_iter=result.n_iter, b=result.b,
                           b_lo=result.b_lo, b_hi=result.b_hi,
@@ -482,5 +502,6 @@ def train_shrinking(x: np.ndarray, y: np.ndarray,
                           train_seconds=result.train_seconds)
         return result
     finally:
+        drain_compiles(None)        # never leak into the next run
         if trace is not None:
             trace.close()

@@ -36,6 +36,7 @@ from dpsvm_tpu.ops.kernels import (KernelSpec, host_row_stats,
 from dpsvm_tpu.ops.rowcache import RowCache, cache_fetch_pair, cache_init
 from dpsvm_tpu.ops.selection import (masked_extrema, masked_extrema_packed,
                                      masked_scores_and_masks)
+from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
                                      pack_stats, resume_state)
@@ -358,16 +359,21 @@ def train_single_device(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     if device is not None:
         carry = jax.device_put(carry, device)
 
-    runner = _build_chunk_runner(float(config.c), kspec,
-                                 float(config.epsilon), use_cache,
-                                 config.matmul_precision.upper(),
-                                 config.selection == "second-order",
-                                 (float(config.weight_pos),
-                                  float(config.weight_neg)),
-                                 config.select_impl == "packed",
-                                 config.clip == "pairwise",
-                                 guard_eta=guard_eta,
-                                 nu_selection=nu_selection)
+    # Compile accounting (docs/OBSERVABILITY.md): the wrapper watches
+    # the jit's tracing cache, so a warm program (lru_cached builder,
+    # persistent compile cache) correctly records zero compiles.
+    runner = compilewatch.instrument(
+        _build_chunk_runner(float(config.c), kspec,
+                            float(config.epsilon), use_cache,
+                            config.matmul_precision.upper(),
+                            config.selection == "second-order",
+                            (float(config.weight_pos),
+                             float(config.weight_neg)),
+                            config.select_impl == "packed",
+                            config.clip == "pairwise",
+                            guard_eta=guard_eta,
+                            nu_selection=nu_selection),
+        "smo-chunk")
 
     return host_training_loop(
         config, gamma, n, d, carry,

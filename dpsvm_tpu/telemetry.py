@@ -1,413 +1,33 @@
-"""Unified run telemetry: the RunTrace recorder and the report renderer.
+"""Back-compat shim: run telemetry grew into the
+``dpsvm_tpu.observability`` package (PR 3 — compile accounting, HBM
+watermarks, FLOP/s, live ``report --follow``, ``dpsvm compare``).
 
-The reference left its per-phase instrumentation commented out
-(``svmTrain.cu:218-293``) and its duality-gap probe dead
-(``seq.cpp:352-376``); we resurrected both (utils/timing.py,
-ops/diagnostics.py) but they were islands — no single artifact recorded
-what a training run *did*. ``RunTrace`` is that artifact: one JSONL
-file per run (schema in utils/trace.py, prose in docs/OBSERVABILITY.md)
-holding the manifest, a record per host poll, solver events, and a
-summary. Every signal in the per-chunk record rides the solvers'
-existing packed-stats transfer (solver/driver.py "Poll economics"), so
-a traced run performs ZERO additional device->host transfers.
-
-Producers: the shared host driver (solver/driver.host_training_loop —
-every path through it: single-device, fused, decomposition, and both
-SPMD variants), the shrinking manager (solver/shrink.py), and the
-benchmark harnesses (bench.py, bench_convergence.py via
-``BENCH_TRACE_OUT``). Consumer: the ``dpsvm report`` CLI subcommand
-(this module's ``render_report`` / ``summarize_trace``).
-
-This module never touches a device: ``report`` and the schema
-self-check (``python -m dpsvm_tpu.telemetry --selfcheck``) run without
-initializing any backend. Callers pass device facts in via ``env``.
+Everything PR 1 exported from here still imports from here, and
+``python -m dpsvm_tpu.telemetry --selfcheck`` remains the documented
+CI schema gate; new code should import ``dpsvm_tpu.observability``
+directly (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import math
-import time
-import weakref
-from typing import Dict, List, Optional
+from dpsvm_tpu.observability import (SOLVER_NAMES,                # noqa: F401
+                                     TRACE_SCHEMA_VERSION, RunTrace,
+                                     compare_paths, compare_traces,
+                                     flush_open_traces, follow_trace,
+                                     load_trace, main, regressions,
+                                     render_compare, render_report,
+                                     resolve_trace_path, selfcheck,
+                                     summarize_trace, trace_facts,
+                                     validate_trace)
 
-from dpsvm_tpu.utils.trace import (TRACE_SCHEMA_VERSION, TraceWriter,
-                                   read_trace, validate_trace)
-
-# Every in-flight RunTrace, so emergency exit paths (the stall watchdog's
-# os._exit) can stamp a terminal event record before the process dies —
-# an abandoned trace with no terminal record is indistinguishable from a
-# live run (docs/ROBUSTNESS.md). Weak: a dropped recorder unregisters
-# itself.
-_OPEN_TRACES: "weakref.WeakSet[RunTrace]" = weakref.WeakSet()
-
-
-def flush_open_traces(event: str, **extra) -> int:
-    """Best-effort: append ``event`` to every still-open trace and close
-    it. Called from exit paths that bypass the driver's finally block
-    (utils/watchdog.py expiry — a different thread, microseconds before
-    os._exit, while the training thread is wedged in a device call, so
-    a concurrent write is not a practical concern). Returns the number
-    of traces flushed; never raises."""
-    count = 0
-    for tr in list(_OPEN_TRACES):
-        try:
-            tr.event(event, **extra)
-            tr.close()
-            count += 1
-        except Exception:
-            pass
-    return count
-
-# Carry-class -> human solver-path name (the driver keys the manifest on
-# the carry type; one table so a new solver fails loudly in tests, not
-# silently as its class name).
-SOLVER_NAMES = {
-    "SMOCarry": "smo",
-    "DistCarry": "dist-smo",
-    "DecompCarry": "decomp",
-    "DistDecompCarry": "dist-decomp",
-    "FusedCarry": "fused-pallas",
-}
-
-
-def _config_dict(config) -> dict:
-    if config is None:
-        return {}
-    if dataclasses.is_dataclass(config):
-        return dataclasses.asdict(config)
-    return dict(config)
-
-
-class RunTrace:
-    """One training run's JSONL recorder.
-
-    Construction writes the manifest; ``chunk``/``event`` append during
-    the run; ``summary`` + ``close`` finish it. All record shapes are
-    owned here so every producer (driver, shrink manager, benchmarks)
-    emits the one schema utils/trace.validate_trace checks.
-    """
-
-    def __init__(self, path: str, *, config=None, n: int = 0, d: int = 0,
-                 gamma: float = 0.0, solver: str = "unknown",
-                 it0: int = 0, env: Optional[dict] = None):
-        config_d = _config_dict(config)
-        kernel = {
-            "kind": config_d.get("kernel", "rbf"),
-            "gamma": float(gamma),
-            "coef0": float(config_d.get("coef0", 0.0)),
-            "degree": int(config_d.get("degree", 3)),
-        }
-        mesh = {"shards": int(config_d.get("shards", 1)),
-                "shard_x": bool(config_d.get("shard_x", True))}
-        from dpsvm_tpu import __version__
-        self._w = TraceWriter(path)
-        self._t0 = time.perf_counter()
-        self._it0 = int(it0)
-        self._closed = False
-        self._w.write({
-            "kind": "manifest",
-            "schema": TRACE_SCHEMA_VERSION,
-            "version": __version__,
-            "solver": solver,
-            "n": int(n),
-            "d": int(d),
-            "gamma": float(gamma),
-            "kernel": kernel,
-            "mesh": mesh,
-            "env": dict(env or {"backend": None, "device_kind": None,
-                                "device_count": None}),
-            "config": config_d,
-            "it0": int(it0),
-            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-        })
-        _OPEN_TRACES.add(self)
-
-    @property
-    def path(self) -> str:
-        return self._w.path
-
-    def _t(self) -> float:
-        return round(time.perf_counter() - self._t0, 6)
-
-    def chunk(self, *, n_iter: int, b_lo: float, b_hi: float,
-              n_sv: int = 0, cache_hits: int = 0, cache_misses: int = 0,
-              rounds: int = 0,
-              phases: Optional[Dict[str, float]] = None,
-              **extra) -> None:
-        """One host-poll record. Every argument is already on the host
-        (the packed-stats read) — recording is file I/O only."""
-        rec = {
-            "kind": "chunk",
-            "n_iter": int(n_iter),
-            "b_lo": float(b_lo),
-            "b_hi": float(b_hi),
-            "gap": float(b_lo) - float(b_hi),
-            "n_sv": int(n_sv),
-            "cache_hits": int(cache_hits),
-            "cache_misses": int(cache_misses),
-            "rounds": int(rounds),
-            "t": self._t(),
-            "phases": {k: round(float(v), 6)
-                       for k, v in (phases or {}).items()},
-        }
-        rec.update(extra)
-        self._w.write(rec)
-
-    def event(self, event: str, *, n_iter: int = 0, **extra) -> None:
-        """Solver lifecycle marker: checkpoint, program_swap (working-set
-        growth), wall_budget, shrink, unshrink."""
-        rec = {"kind": "event", "event": str(event),
-               "n_iter": int(n_iter), "t": self._t()}
-        rec.update(extra)
-        self._w.write(rec)
-
-    def summary(self, *, converged: bool, n_iter: int, b: float,
-                b_lo: float, b_hi: float, n_sv: int,
-                train_seconds: float, cache_hits: int = 0,
-                cache_misses: int = 0,
-                phases: Optional[Dict[str, float]] = None,
-                **extra) -> None:
-        iters = int(n_iter) - self._it0
-        lookups = int(cache_hits) + int(cache_misses)
-        rec = {
-            "kind": "summary",
-            "converged": bool(converged),
-            "n_iter": int(n_iter),
-            "iters": iters,
-            "iters_per_sec": round(iters / train_seconds, 3)
-            if train_seconds > 0 else 0.0,
-            "b": float(b),
-            "b_lo": float(b_lo),
-            "b_hi": float(b_hi),
-            "gap": float(b_lo) - float(b_hi),
-            "n_sv": int(n_sv),
-            "cache_hits": int(cache_hits),
-            "cache_misses": int(cache_misses),
-            "cache_hit_rate": round(cache_hits / lookups, 6)
-            if lookups else None,
-            "train_seconds": round(float(train_seconds), 6),
-            "phases": {k: round(float(v), 6)
-                       for k, v in (phases or {}).items()},
-            "t": self._t(),
-        }
-        rec.update(extra)
-        self._w.write(rec)
-
-    def close(self) -> None:
-        self._closed = True
-        _OPEN_TRACES.discard(self)
-        self._w.close()
-
-
-def load_trace(path: str) -> List[dict]:
-    """read + validate; raises ValueError with every problem listed."""
-    records = read_trace(path)
-    errors = validate_trace(records)
-    if errors:
-        raise ValueError(f"invalid trace {path}: " + "; ".join(errors))
-    return records
-
-
-def summarize_trace(records: List[dict]) -> dict:
-    """The machine-readable digest ``dpsvm report --json`` prints."""
-    manifest = records[0] if records else {}
-    chunks = [r for r in records if r.get("kind") == "chunk"]
-    events = [r for r in records if r.get("kind") == "event"]
-    summary = next((r for r in records if r.get("kind") == "summary"),
-                   None)
-    return {
-        "manifest": manifest,
-        "summary": summary,
-        "n_chunks": len(chunks),
-        "events": events,
-        "curve": [{"n_iter": c["n_iter"], "gap": c["gap"],
-                   "n_sv": c["n_sv"], "t": c["t"]} for c in chunks],
-    }
-
-
-def _fmt_si(v: float) -> str:
-    return f"{v:,.0f}" if v >= 100 else f"{v:.3g}"
-
-
-def _gap_curve(chunks: List[dict], width: int = 60,
-               height: int = 10) -> List[str]:
-    """ASCII iter-vs-gap plot (log-scale gap). Robust down to a single
-    chunk record (the acceptance floor: manifest + >= 1 chunk +
-    summary)."""
-    pts = [(c["n_iter"], c["gap"]) for c in chunks if c["gap"] > 0]
-    if not pts:
-        return ["  (no open-gap chunk records to plot)"]
-    its = [p[0] for p in pts]
-    lgs = [math.log10(p[1]) for p in pts]
-    it_lo, it_hi = min(its), max(its)
-    lg_lo, lg_hi = min(lgs), max(lgs)
-    it_span = max(it_hi - it_lo, 1)
-    lg_span = max(lg_hi - lg_lo, 1e-9)
-    grid = [[" "] * width for _ in range(height)]
-    for it, lg in zip(its, lgs):
-        col = min(int((it - it_lo) / it_span * (width - 1)), width - 1)
-        row = min(int((lg_hi - lg) / lg_span * (height - 1)), height - 1)
-        grid[row][col] = "*"
-    lines = []
-    for r in range(height):
-        lg = lg_hi - r * lg_span / (height - 1 or 1)
-        label = f"{10 ** lg:8.1e}" if r in (0, height - 1) else " " * 8
-        lines.append(f"  {label} |" + "".join(grid[r]))
-    lines.append("  " + " " * 8 + "+" + "-" * width)
-    left = f"{it_lo:,}"
-    right = f"{it_hi:,}"
-    pad = max(width - len(left) - len(right), 1)
-    lines.append("  " + " " * 9 + left + " " * pad + right)
-    return lines
-
-
-def _phase_bars(phases: Dict[str, float]) -> List[str]:
-    total = sum(phases.values())
-    if not phases or total <= 0:
-        return ["  (no phase timings recorded)"]
-    width = max(len(k) for k in phases)
-    lines = []
-    for name, sec in sorted(phases.items(), key=lambda kv: -kv[1]):
-        frac = sec / total
-        bar = "#" * max(int(round(frac * 30)), 1 if sec > 0 else 0)
-        lines.append(f"  {name:<{width}}  {sec:8.3f} s  {frac:5.1%}  {bar}")
-    return lines
-
-
-def render_report(records: List[dict], width: int = 60) -> str:
-    """The human rendering behind ``dpsvm report``."""
-    m = records[0]
-    chunks = [r for r in records if r.get("kind") == "chunk"]
-    events = [r for r in records if r.get("kind") == "event"]
-    s = next((r for r in records if r.get("kind") == "summary"), None)
-    k = m["kernel"]
-    env = m.get("env") or {}
-    out = []
-    kern = k["kind"]
-    if kern in ("rbf", "poly", "sigmoid"):
-        kern += f"(gamma={k['gamma']:g})"
-    out.append(f"run: {m['solver']}  {m['n']}x{m['d']}  {kern}  "
-               f"shards={m['mesh']['shards']}  "
-               f"backend={env.get('backend')} "
-               f"{env.get('device_kind') or ''}  "
-               f"dpsvm_tpu {m['version']}")
-    if s is not None:
-        status = "converged" if s["converged"] else "NOT converged"
-        out.append(f"result: {status} at iter {s['n_iter']:,} in "
-                   f"{s['train_seconds']:.2f} s "
-                   f"({_fmt_si(s['iters_per_sec'])} it/s)   "
-                   f"gap {s['gap']:.3g}  b={s['b']:.6g}  "
-                   f"n_sv={s['n_sv']:,}")
-    else:
-        out.append("result: (no summary record — run still in flight "
-                   "or killed)")
-    out.append("")
-    out.append("convergence (gap vs iteration, log scale):")
-    out.extend(_gap_curve(chunks, width=width))
-    out.append("")
-    phases = (s or {}).get("phases") or (
-        chunks[-1]["phases"] if chunks else {})
-    out.append("host-loop phase time:")
-    out.extend(_phase_bars(phases))
-    out.append("")
-    src = s or (chunks[-1] if chunks else None)
-    if src is not None:
-        lookups = src["cache_hits"] + src["cache_misses"]
-        if lookups:
-            out.append(f"kernel-row cache: {lookups:,} lookups, hit rate "
-                       f"{src['cache_hits'] / lookups:.1%} "
-                       f"({src['cache_hits']:,} hits / "
-                       f"{src['cache_misses']:,} misses)")
-        else:
-            out.append("kernel-row cache: off (cache_size=0)")
-        if src.get("rounds"):
-            out.append(f"decomposition outer rounds: {src['rounds']:,}")
-    if events:
-        out.append("events: " + ", ".join(
-            f"{e['event']}@{e['n_iter']:,}" for e in events))
-    out.append(f"chunk polls recorded: {len(chunks)}")
-    return "\n".join(out)
-
-
-def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
-    """Produce a synthetic trace through the real writer, then run it
-    through the real validator and renderer. Returns problems (empty =
-    OK). Tier-1 (tests/test_telemetry.py) and
-    ``python -m dpsvm_tpu.telemetry --selfcheck`` both call this, so a
-    schema drift between producer and validator fails loudly in CI."""
-    import os
-    import tempfile
-
-    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
-        path = os.path.join(td, "selfcheck.jsonl")
-        tr = RunTrace(path, config={"kernel": "rbf", "shards": 2,
-                                    "shard_x": True, "coef0": 0.0,
-                                    "degree": 3},
-                      n=1000, d=32, gamma=0.5, solver="smo", it0=0,
-                      env={"backend": "cpu", "device_kind": "host",
-                           "device_count": 2})
-        for i, gap in enumerate((1.5, 0.3, 0.0009)):
-            tr.chunk(n_iter=(i + 1) * 512, b_lo=gap / 2, b_hi=-gap / 2,
-                     n_sv=100 * (i + 1), cache_hits=i * 10,
-                     cache_misses=i * 20, rounds=i,
-                     phases={"dispatch": 0.1 * i, "poll": 0.2 * i})
-        tr.event("checkpoint", n_iter=1024)
-        tr.summary(converged=True, n_iter=1536, b=0.0, b_lo=0.00045,
-                   b_hi=-0.00045, n_sv=300, train_seconds=1.5,
-                   cache_hits=20, cache_misses=40,
-                   phases={"dispatch": 0.3, "poll": 0.6})
-        tr.close()
-        try:
-            records = load_trace(path)
-        except ValueError as e:
-            return [str(e)]
-        problems = []
-        digest = summarize_trace(records)
-        if digest["n_chunks"] != 3 or digest["summary"] is None:
-            problems.append(f"digest mismatch: {digest['n_chunks']} "
-                            "chunks or missing summary")
-        text = render_report(records)
-        for needle in ("run: smo", "converged at iter 1,536",
-                       "hit rate 33.3%", "checkpoint@1,024"):
-            if needle not in text:
-                problems.append(f"report rendering lost {needle!r}")
-        return problems
-
-
-def main(argv: Optional[List[str]] = None) -> int:
-    import argparse
-    import json
-    import sys
-
-    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.telemetry")
-    p.add_argument("--selfcheck", action="store_true",
-                   help="writer -> validator -> renderer round-trip on "
-                        "a synthetic trace (the CI schema gate)")
-    p.add_argument("--validate", default=None, metavar="TRACE",
-                   help="validate an existing trace file")
-    args = p.parse_args(argv)
-    if args.selfcheck:
-        problems = selfcheck()
-        if problems:
-            print("telemetry selfcheck FAILED:", file=sys.stderr)
-            for pr in problems:
-                print(f"  {pr}", file=sys.stderr)
-            return 1
-        print("telemetry selfcheck OK "
-              f"(schema v{TRACE_SCHEMA_VERSION})")
-        return 0
-    if args.validate:
-        try:
-            records = load_trace(args.validate)
-        except (OSError, ValueError) as e:
-            print(f"INVALID: {e}", file=sys.stderr)
-            return 1
-        print(json.dumps({"valid": True, "records": len(records)}))
-        return 0
-    p.print_help()
-    return 2
-
+__all__ = [
+    "TRACE_SCHEMA_VERSION", "RunTrace", "SOLVER_NAMES",
+    "flush_open_traces", "load_trace", "render_report",
+    "summarize_trace", "trace_facts", "resolve_trace_path",
+    "follow_trace", "compare_traces", "compare_paths",
+    "render_compare", "regressions", "selfcheck", "main",
+    "validate_trace",
+]
 
 if __name__ == "__main__":
     import sys

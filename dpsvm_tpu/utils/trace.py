@@ -1,153 +1,24 @@
-"""Run-trace JSONL format: writer, reader, schema validation.
-
-One training run = one JSONL file (``SVMConfig.trace_out`` / the train
-CLI's ``--trace-out``): a ``manifest`` record (what was asked for and on
-what hardware), then ``chunk`` records at every host poll (the solver's
-packed-stats transfer already carries n_iter/gap/SV-count/cache
-counters, so tracing adds ZERO device->host transfers — see
-solver/driver.py "Poll economics" and docs/OBSERVABILITY.md), optional
-``event`` records (checkpoint / program swap / shrink), and a final
-``summary`` record.
-
-This module is deliberately dependency-free (no jax import): the
-``report`` CLI subcommand and the schema self-check must run without
-initializing any backend. The recorder that knows about solvers lives
-in ``dpsvm_tpu.telemetry``.
-
-The schema is versioned and validated by ``validate_trace`` — the same
-function backs ``python -m dpsvm_tpu.telemetry --selfcheck`` (tier-1:
-tests/test_telemetry.py), so a drifting producer fails loudly instead
-of silently writing traces the report renderer can no longer read.
-"""
+"""Back-compat shim: the trace schema moved to
+``dpsvm_tpu.observability.schema`` when telemetry grew into a package
+(PR 3). Existing importers (tests, external tooling reading PR 1
+traces) keep working; new code should import the observability package
+directly."""
 
 from __future__ import annotations
 
-import json
-from typing import IO, List, Optional
+from dpsvm_tpu.observability.schema import (CHUNK_KEYS,           # noqa: F401
+                                            COMPILE_KEYS, EVENT_KEYS,
+                                            KINDS, MANIFEST_KEYS,
+                                            SUMMARY_KEYS,
+                                            SUPPORTED_SCHEMAS,
+                                            TERMINAL_EVENTS,
+                                            TRACE_SCHEMA_VERSION,
+                                            TraceWriter, read_trace,
+                                            validate_trace)
 
-TRACE_SCHEMA_VERSION = 1
-
-# Required keys per record kind. Values may be null where noted in
-# docs/OBSERVABILITY.md (e.g. env.device_kind on an uninitialized
-# backend); presence is the contract.
-MANIFEST_KEYS = ("schema", "version", "solver", "n", "d", "gamma",
-                 "kernel", "mesh", "env", "config", "it0", "time")
-CHUNK_KEYS = ("n_iter", "b_lo", "b_hi", "gap", "n_sv", "cache_hits",
-              "cache_misses", "rounds", "t", "phases")
-EVENT_KEYS = ("event", "n_iter", "t")
-SUMMARY_KEYS = ("converged", "n_iter", "iters", "iters_per_sec", "b",
-                "b_lo", "b_hi", "gap", "n_sv", "cache_hits",
-                "cache_misses", "cache_hit_rate", "train_seconds",
-                "phases", "t")
-KINDS = ("manifest", "chunk", "event", "summary")
-
-
-class TraceWriter:
-    """Append-one-JSON-record-per-line writer, flushed per record so a
-    killed run still leaves a parseable partial trace."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self._fh: Optional[IO[str]] = open(path, "w")
-
-    def write(self, record: dict) -> None:
-        if self._fh is None:
-            return
-        self._fh.write(json.dumps(record) + "\n")
-        self._fh.flush()
-
-    def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
-
-    def __enter__(self) -> "TraceWriter":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.close()
-
-
-def read_trace(path: str) -> List[dict]:
-    """Parse a trace file into its records. Raises ValueError on a line
-    that is not JSON (a truncated FINAL line — a run killed mid-write —
-    is tolerated and dropped, matching the flush-per-record writer)."""
-    records: List[dict] = []
-    with open(path) as fh:
-        lines = fh.read().splitlines()
-    for i, raw in enumerate(lines):
-        raw = raw.strip()
-        if not raw:
-            continue
-        try:
-            records.append(json.loads(raw))
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:
-                break                   # torn final write of a dead run
-            raise ValueError(f"{path}:{i + 1}: not a JSON record")
-    return records
-
-
-def _missing(record: dict, keys) -> List[str]:
-    return [k for k in keys if k not in record]
-
-
-def validate_trace(records: List[dict]) -> List[str]:
-    """Schema check; returns a list of problems (empty = valid).
-
-    Contract (acceptance bar of docs/OBSERVABILITY.md): exactly one
-    leading manifest at the current schema version; >= 0 chunk records
-    with monotone non-decreasing n_iter and non-negative counters;
-    at most one summary, and only as the final record. A ``rollback``
-    event legitimately rewinds the run to its checkpoint's iteration
-    (docs/ROBUSTNESS.md), so it resets the monotonicity baseline."""
-    errors: List[str] = []
-    if not records:
-        return ["empty trace (no records)"]
-    for i, r in enumerate(records):
-        if not isinstance(r, dict) or r.get("kind") not in KINDS:
-            errors.append(f"record {i}: unknown kind "
-                          f"{r.get('kind') if isinstance(r, dict) else r!r}")
-    head = records[0]
-    if head.get("kind") != "manifest":
-        errors.append("record 0: trace must start with a manifest")
-    else:
-        if head.get("schema") != TRACE_SCHEMA_VERSION:
-            errors.append(f"manifest: schema {head.get('schema')!r} != "
-                          f"supported {TRACE_SCHEMA_VERSION}")
-        miss = _missing(head, MANIFEST_KEYS)
-        if miss:
-            errors.append(f"manifest: missing keys {miss}")
-    if sum(r.get("kind") == "manifest" for r in records) > 1:
-        errors.append("multiple manifest records")
-
-    prev_iter = None
-    for i, r in enumerate(records):
-        kind = r.get("kind")
-        if kind == "chunk":
-            miss = _missing(r, CHUNK_KEYS)
-            if miss:
-                errors.append(f"record {i}: chunk missing keys {miss}")
-                continue
-            if prev_iter is not None and r["n_iter"] < prev_iter:
-                errors.append(f"record {i}: n_iter {r['n_iter']} < "
-                              f"previous {prev_iter} (not monotone)")
-            prev_iter = r["n_iter"]
-            for k in ("n_sv", "cache_hits", "cache_misses", "rounds"):
-                if r[k] < 0:
-                    errors.append(f"record {i}: {k} = {r[k]} < 0")
-        elif kind == "event":
-            miss = _missing(r, EVENT_KEYS)
-            if miss:
-                errors.append(f"record {i}: event missing keys {miss}")
-            elif r.get("event") == "rollback":
-                # The run restarted from a checkpoint at this iteration.
-                prev_iter = r["n_iter"]
-        elif kind == "summary":
-            miss = _missing(r, SUMMARY_KEYS)
-            if miss:
-                errors.append(f"record {i}: summary missing keys {miss}")
-            if i != len(records) - 1:
-                errors.append(f"record {i}: summary must be the final "
-                              "record")
-    return errors
+__all__ = [
+    "TRACE_SCHEMA_VERSION", "SUPPORTED_SCHEMAS", "TraceWriter",
+    "read_trace", "validate_trace", "MANIFEST_KEYS", "CHUNK_KEYS",
+    "EVENT_KEYS", "COMPILE_KEYS", "SUMMARY_KEYS", "KINDS",
+    "TERMINAL_EVENTS",
+]

@@ -1,0 +1,381 @@
+"""Device-side observability tests (ISSUE 3): schema v2 round-trip +
+v1 back-compat, compile/retrace accounting on real CPU runs, HBM/
+phase-count facts, `report --follow` termination, and the `dpsvm
+compare` regression gate on committed fixtures.
+
+The PR-1 surface (counters, report round-trip, packed-stats economics)
+stays pinned by tests/test_telemetry.py; this file owns the v2 layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dpsvm_tpu.api import train
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.telemetry import (follow_trace, load_trace,
+                                 render_report, resolve_trace_path,
+                                 selfcheck, trace_facts, validate_trace)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _compiles(records):
+    return [r for r in records if r["kind"] == "compile"]
+
+
+def _summary(records):
+    return next(r for r in records if r["kind"] == "summary")
+
+
+# ------------------------------------------------------------ schema v2
+
+def test_selfcheck_v2():
+    """Writer -> validator -> renderer -> comparator round-trip at
+    schema v2, plus the embedded v1 sample."""
+    assert selfcheck() == []
+
+
+def test_selfcheck_cli_entrypoints():
+    from dpsvm_tpu.observability import main as obs_main
+    from dpsvm_tpu.telemetry import main as shim_main
+    assert shim_main(["--selfcheck"]) == 0
+    assert obs_main(["--selfcheck"]) == 0
+
+
+def test_v1_fixture_still_validates_and_renders():
+    """A trace written by the PR-1 recorder (schema 1, committed
+    fixture) must keep loading after every v2+ change — and the
+    renderer must not invent device facts v1 never recorded."""
+    records = load_trace(os.path.join(FIXTURES, "trace_v1.jsonl"))
+    assert records[0]["schema"] == 1
+    text = render_report(records)
+    assert "converged at iter" in text
+    assert "hbm peak" not in text and "compiles:" not in text
+    facts = trace_facts(records)
+    assert facts["hbm_peak"] is None and facts["n_compiles"] is None
+
+
+def test_validate_ordering_rules():
+    records = load_trace(os.path.join(FIXTURES, "compare_base.jsonl"))
+    assert validate_trace(records) == []
+    # non-terminal record after the summary
+    chunk = next(r for r in records if r["kind"] == "chunk")
+    bad = records + [dict(chunk, t=records[-1]["t"] + 1)]
+    assert any("terminal" in e for e in validate_trace(bad))
+    # terminal stall/preempt events after the summary are the one
+    # legitimate tail (watchdog flush, docs/ROBUSTNESS.md)
+    ok = records + [{"kind": "event", "event": "stall", "n_iter": 1,
+                     "t": records[-1]["t"] + 1}]
+    assert validate_trace(ok) == []
+    # time must never rewind (interleaved writers)
+    rewound = [dict(r) for r in records]
+    rewound[2]["t"] = 1e9
+    assert any("non-decreasing" in e for e in validate_trace(rewound))
+    # compile records need their keys
+    broken = [records[0],
+              {"kind": "compile", "program": "x", "t": 0.1}] + records[1:]
+    assert any("compile missing" in e for e in validate_trace(broken))
+
+
+# ------------------------------------------- compile/HBM on real runs
+
+def test_traced_run_records_device_layer(tmp_path, blobs_small):
+    """Acceptance: a CPU training run with --trace-out produces >= 1
+    compile event and a summary carrying n_compiles, hbm_peak (null on
+    CPU) and est_flops; chunks carry hbm + phase_counts.
+
+    The c value is unique to this test: compile accounting observes
+    the REAL jit cache, so a config another test already trained would
+    (correctly) record zero compiles here."""
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    result = train(x, y, SVMConfig(c=1.31, gamma=0.5, epsilon=1e-3,
+                                   max_iter=20_000, chunk_iters=64,
+                                   trace_out=path))
+    records = load_trace(path)
+    comp = _compiles(records)
+    assert len(comp) >= 1
+    assert comp[0]["program"] == "smo-chunk"
+    assert comp[0]["seconds"] > 0
+    s = _summary(records)
+    assert s["n_compiles"] == len(comp)
+    assert s["compile_seconds"] == pytest.approx(
+        sum(c["seconds"] for c in comp), abs=1e-3)
+    assert s["hbm_peak"] is None            # CPU: memory_stats() is None
+    assert s["est_flops"] is not None       # cost_analysis works on CPU
+    assert s["phase_counts"]["poll"] >= 1
+    chunk = next(r for r in records if r["kind"] == "chunk")
+    assert chunk["hbm"] == {"in_use": None, "peak": None, "limit": None}
+    assert chunk["phase_counts"]["dispatch"] >= 1
+    # facts view agrees with the summary
+    facts = trace_facts(records)
+    assert facts["n_compiles"] == len(comp)
+    assert facts["iters"] == result.n_iter
+    assert facts["est_flops_per_sec"] > 0
+
+
+def test_warm_program_records_no_new_compile(tmp_path, blobs_small):
+    """Second identical run in-process: the lru_cached runner serves a
+    warm jit cache, so compile accounting must report ZERO compiles
+    (the wrapper watches the cache, it does not guess)."""
+    x, y = blobs_small
+    # unique c: a fresh program for THIS test's first run
+    cfg = dict(c=1.33, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+               chunk_iters=64)
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    train(x, y, SVMConfig(trace_out=p1, **cfg))
+    train(x, y, SVMConfig(trace_out=p2, **cfg))
+    first = _summary(load_trace(p1))["n_compiles"]
+    assert first >= 1
+    assert _summary(load_trace(p2))["n_compiles"] == 0
+
+
+def test_growth_regrow_pays_and_records_compiles(tmp_path, monkeypatch):
+    """Acceptance: compile events appear when a decomp growth run
+    regrows Q — the trace names WHICH q paid each recompile."""
+    import dpsvm_tpu.solver.decomp as decomp
+    from dpsvm_tpu.data.synthetic import make_planted
+
+    x, y = make_planted(800, 16, gamma=0.5, seed=3, noise=0.08)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MIN", 128)
+    monkeypatch.setattr(decomp, "GROW_CHECK_MAX", 128)
+    path = str(tmp_path / "grow.jsonl")
+    r = train(x, y, SVMConfig(c=50.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=300_000, working_set=32,
+                              grow_working_set=True, chunk_iters=128,
+                              trace_out=path))
+    assert r.converged
+    records = load_trace(path)
+    events = [e["event"] for e in records if e["kind"] == "event"]
+    assert "program_swap" in events
+    programs = {c["program"] for c in _compiles(records)}
+    qs = {p for p in programs if p.startswith("decomp-chunk/q=")}
+    assert len(qs) >= 2, f"expected per-q compile events, got {programs}"
+    assert _summary(records)["n_compiles"] >= 2
+
+
+def test_shrinking_path_records_device_layer(tmp_path):
+    from dpsvm_tpu.data.synthetic import make_blobs
+
+    x, y = make_blobs(n=600, d=6, seed=5)
+    path = str(tmp_path / "shrink.jsonl")
+    r = train(x, y, SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=60_000, chunk_iters=64,
+                              shrinking=True, trace_out=path))
+    assert r.converged
+    records = load_trace(path)
+    assert records[0]["solver"] == "shrink"
+    assert _summary(records)["n_compiles"] >= 1
+    assert all(c["program"].startswith("shrink-")
+               for c in _compiles(records))
+
+
+# --------------------------------------------------------------- report
+
+def test_report_renders_compile_hbm_flops_lines(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    # unique c so this run pays (and therefore renders) a compile
+    train(x, y, SVMConfig(c=1.35, gamma=0.5, max_iter=20_000,
+                          chunk_iters=64, trace_out=path))
+    text = render_report(load_trace(path))
+    assert re.search(r"compiles: \d+ program\(s\) in", text)
+    assert "throughput: ~" in text
+    # per-phase call counts ride the phase bars
+    assert re.search(r"poll\s+.*%\s+#+\s+\d+x", text)
+    # CPU: no HBM line rather than a null one
+    assert "hbm peak" not in text
+
+
+def test_report_and_compare_accept_directories(tmp_path, capsys):
+    import shutil
+
+    d = tmp_path / "traces"
+    d.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "compare_base.jsonl"),
+                d / "older.jsonl")
+    time.sleep(0.02)
+    shutil.copy(os.path.join(FIXTURES, "compare_regressed.jsonl"),
+                d / "newer.jsonl")
+    os.utime(d / "newer.jsonl")
+    assert resolve_trace_path(str(d)).endswith("newer.jsonl")
+    from dpsvm_tpu.cli import main
+    assert main(["report", str(d)]) == 0
+    assert "run: smo" in capsys.readouterr().out
+    assert main(["compare", str(d), str(d)]) == 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        resolve_trace_path(str(empty))
+
+
+# --------------------------------------------------------------- follow
+
+def _follow_writer_script(path: str, delay: float, terminal: str) -> str:
+    return f"""
+import time
+from dpsvm_tpu.observability import RunTrace
+tr = RunTrace({path!r}, config={{"kernel": "rbf"}}, n=10, d=2,
+              gamma=0.5, solver="smo")
+for i in range(3):
+    tr.chunk(n_iter=(i + 1) * 64, b_lo=1.0 / (i + 1), b_hi=-1.0 / (i + 1))
+    time.sleep({delay})
+if {terminal!r} == "summary":
+    tr.summary(converged=True, n_iter=192, b=0.0, b_lo=0.001,
+               b_hi=-0.001, n_sv=5, train_seconds=0.2)
+elif {terminal!r} == "stall":
+    tr.event("stall", n_iter=192)
+tr.close()
+"""
+
+
+@pytest.mark.parametrize("terminal,rc", [("summary", 0), ("stall", 1)])
+def test_follow_terminates_on_terminal_record(tmp_path, terminal, rc):
+    """--follow tails a trace being written by another process and
+    stops at the terminal record (summary => 0, stall/preempt => 1)."""
+    import io
+
+    path = str(tmp_path / "live.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _follow_writer_script(path, 0.05, terminal)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        out = io.StringIO()
+        code = follow_trace(path, interval=0.02, stall_timeout=30.0,
+                            out=out)
+        assert code == rc
+        text = out.getvalue()
+        assert "run: smo" in text
+        if terminal == "stall":
+            assert "run ended: stall" in text
+    finally:
+        proc.wait(timeout=30)
+
+
+def test_follow_times_out_on_stalled_trace(tmp_path):
+    """A run killed too hard to stamp a terminal event (SIGKILL): the
+    file stops growing and --follow exits 3 after the stall timeout."""
+    import io
+
+    path = str(tmp_path / "dead.jsonl")
+    from dpsvm_tpu.observability import RunTrace
+    tr = RunTrace(path, config={"kernel": "rbf"}, n=10, d=2, gamma=0.5,
+                  solver="smo")
+    tr.chunk(n_iter=64, b_lo=1.0, b_hi=-1.0)
+    tr.close()                      # no summary: looks in-flight
+    out = io.StringIO()
+    t0 = time.monotonic()
+    assert follow_trace(path, interval=0.02, stall_timeout=0.3,
+                        out=out) == 3
+    assert time.monotonic() - t0 < 10
+    assert "stalled" in out.getvalue()
+    # a path that never appears also times out instead of spinning
+    assert follow_trace(str(tmp_path / "never.jsonl"), interval=0.02,
+                        stall_timeout=0.2, out=io.StringIO()) == 3
+
+
+def test_report_follow_cli_flag(tmp_path, capsys):
+    """The CLI surface: `dpsvm report --follow` on an already-complete
+    trace renders once and exits 0 immediately."""
+    from dpsvm_tpu.cli import main
+    rc = main(["report", os.path.join(FIXTURES, "compare_base.jsonl"),
+               "--follow", "--interval", "0.01",
+               "--stall-timeout", "5"])
+    assert rc == 0
+    assert "run: smo" in capsys.readouterr().out
+
+
+# -------------------------------------------------------------- compare
+
+def test_compare_equal_pair_passes_gate(capsys):
+    from dpsvm_tpu.cli import main
+    base = os.path.join(FIXTURES, "compare_base.jsonl")
+    assert main(["compare", base, base, "--fail-on-regress", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "no regression past 10%" in out
+    assert "iters_per_sec" in out and "hbm_peak" in out
+    assert "compile_seconds" in out and "gap trajectory" in out
+
+
+def test_compare_detects_planted_regression(capsys):
+    """Acceptance: a planted 20% it/s regression fails the 10% gate
+    with a non-zero exit; without the gate flag it reports, exit 0."""
+    from dpsvm_tpu.cli import main
+    base = os.path.join(FIXTURES, "compare_base.jsonl")
+    regr = os.path.join(FIXTURES, "compare_regressed.jsonl")
+    assert main(["compare", base, regr, "--fail-on-regress", "10"]) == 1
+    assert "iters_per_sec regressed 20.0%" in capsys.readouterr().out
+    assert main(["compare", base, regr]) == 0           # report-only
+    capsys.readouterr()
+    # --json carries the verdict machine-readably
+    assert main(["compare", base, regr, "--json",
+                 "--fail-on-regress", "10"]) == 1
+    digest = json.loads(capsys.readouterr().out)
+    assert digest["regressions"]
+    assert any(m["metric"] == "iters_per_sec"
+               and m["delta_pct"] == pytest.approx(-20.0, abs=0.1)
+               for m in digest["metrics"])
+    # the faster direction is NOT a regression
+    assert main(["compare", regr, base, "--fail-on-regress", "10"]) == 0
+    capsys.readouterr()
+
+
+def test_compare_real_cpu_traces(tmp_path, blobs_small, capsys):
+    """Two real traced runs compare cleanly end to end (same config:
+    no gate trip at a generous threshold on identical trajectories)."""
+    x, y = blobs_small
+    cfg = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+               chunk_iters=64)
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    train(x, y, SVMConfig(trace_out=pa, **cfg))
+    train(x, y, SVMConfig(trace_out=pb, **cfg))
+    from dpsvm_tpu.cli import main
+    assert main(["compare", pa, pb]) == 0
+    out = capsys.readouterr().out
+    assert "gap trajectory" in out
+
+
+def test_compare_rejects_invalid_input(tmp_path, capsys):
+    from dpsvm_tpu.cli import main
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"kind": "chunk"}) + "\n")
+    base = os.path.join(FIXTURES, "compare_base.jsonl")
+    assert main(["compare", str(bad), base]) == 2
+    assert main(["compare", str(tmp_path / "absent.jsonl"), base]) == 2
+
+
+# ------------------------------------------------------- bench folding
+
+def test_bench_convergence_row_carries_device_facts(tmp_path,
+                                                    blobs_small):
+    """bench_convergence.convergence_run folds the trace's compile/HBM/
+    FLOP facts into its JSON result row (the burst runner archives the
+    same row into BENCH_r*.json windows)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from bench_convergence import convergence_run
+    finally:
+        sys.path.pop(0)
+    x, y = blobs_small
+    path = str(tmp_path / "bench.jsonl")
+    row = convergence_run(x, y, SVMConfig(
+        c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+        chunk_iters=64, trace_out=path))
+    assert row["n_compiles"] >= 0
+    assert "compile_seconds" in row and "hbm_peak" in row
+    assert "est_flops" in row
+    # tracing off => facts null, row still complete
+    row2 = convergence_run(x, y, SVMConfig(
+        c=1.0, gamma=0.5, epsilon=1e-3, max_iter=20_000,
+        chunk_iters=64))
+    assert row2["n_compiles"] is None
