@@ -41,6 +41,11 @@ Public API
 ``train_multiclass``               one-vs-one multiclass (batched=True:
                                    all pairs in one compiled program)
 ``warm_start``                     continue training from a previous alpha
+``serving``                        online prediction subsystem — the
+                                   micro-batching engine behind
+                                   ``dpsvm serve`` (import
+                                   ``dpsvm_tpu.serving`` explicitly;
+                                   docs/SERVING.md)
 """
 
 from dpsvm_tpu.config import SVMConfig, TrainResult

@@ -286,6 +286,13 @@ def build_parser() -> argparse.ArgumentParser:
     te.add_argument("--predictions", default=None, metavar="PATH",
                     help="also write one predicted label per line "
                          "(binary models: 'label,decision_value')")
+    te.add_argument("--batch", type=int, default=0, metavar="N",
+                    help="stream evaluation through the serving "
+                         "engine's bucket ladder at up to N rows per "
+                         "device pass instead of one monolithic (m, d) "
+                         "pass — bounds host+device memory on large "
+                         "test splits (0 = monolithic; "
+                         "docs/SERVING.md)")
     te.add_argument("--proba", default=None, metavar="PATH",
                     help="binary model: write Platt-calibrated "
                          "P(y=+1|x) per line + Brier/log-loss (needs "
@@ -376,6 +383,76 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("--marks", type=int, default=4,
                     help="iteration marks for the gap-trajectory "
                          "comparison (default 4)")
+
+    sv = sub.add_parser(
+        "serve", help="online prediction server: micro-batched "
+                      "/v1/predict over any saved model (or several), "
+                      "pre-compiled shape buckets, /healthz, /metricsz, "
+                      "hot reload, SIGTERM graceful drain "
+                      "(docs/SERVING.md)")
+    sv.add_argument("-m", "--model", action="append", required=True,
+                    metavar="[NAME=]PATH",
+                    help="model file or multiclass directory to serve "
+                         "(repeatable; an unnamed first model is "
+                         "registered as 'default')")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8317,
+                    help="listen port (0 = OS-assigned; the bound port "
+                         "is printed on the ready line)")
+    sv.add_argument("--max-batch", type=int, default=256,
+                    help="top rung of the compile-warmed bucket ladder "
+                         "AND the micro-batcher's coalescing cap")
+    sv.add_argument("--max-delay-ms", type=float, default=2.0,
+                    help="micro-batching deadline: a batch closes after "
+                         "this long even if not full (idle-server "
+                         "latency floor)")
+    sv.add_argument("--max-queue", type=int, default=4096,
+                    help="admission bound in ROWS; a full queue "
+                         "fast-rejects with HTTP 429 instead of "
+                         "queueing unboundedly")
+    sv.add_argument("--no-b", action="store_true",
+                    help="serve intercept-free decisions like "
+                         "test --no-b")
+    sv.add_argument("--port-file", default=None, metavar="PATH",
+                    help="write the bound port here once listening "
+                         "(for harnesses that pass --port 0)")
+    sv.add_argument("-q", "--quiet", action="store_true")
+    _add_backend_flags(sv)
+
+    lg = sub.add_parser(
+        "loadgen", help="open/closed-loop load generator against a "
+                        "running `dpsvm serve`; prints ONE JSON row "
+                        "with throughput + p50/p95/p99 latency and the "
+                        "sequential batch-1 baseline (docs/SERVING.md)")
+    lg.add_argument("--url", default="http://127.0.0.1:8317",
+                    help="server base URL")
+    lg.add_argument("--model", default="default",
+                    help="registered model name to target")
+    lg.add_argument("-f", "--input", default=None,
+                    help="dataset whose feature rows become request "
+                         "payloads (labels ignored); synthetic rows at "
+                         "the model's width when omitted")
+    lg.add_argument("--mode", choices=["closed", "open"],
+                    default="closed",
+                    help="closed = each worker fires on completion "
+                         "(saturation probe, exercises coalescing); "
+                         "open = fixed-schedule arrivals at --rps")
+    lg.add_argument("--requests", type=int, default=200)
+    lg.add_argument("--batch", type=int, default=1,
+                    help="rows per request")
+    lg.add_argument("--concurrency", type=int, default=8)
+    lg.add_argument("--rps", type=float, default=100.0,
+                    help="open-loop target arrival rate")
+    lg.add_argument("--return", dest="want", default="labels",
+                    metavar="K1,K2",
+                    help="comma list of outputs to request: labels, "
+                         "decision, proba")
+    lg.add_argument("--timeout", type=float, default=30.0)
+    lg.add_argument("--no-compare-sequential", dest="compare_sequential",
+                    action="store_false", default=True,
+                    help="skip the batch-1 single-worker baseline pass "
+                         "(halves runtime; drops the coalesce_speedup "
+                         "fields from the row)")
     return root
 
 
@@ -871,6 +948,21 @@ def cmd_test(args: argparse.Namespace) -> int:
             return d_model
         return args.num_att
 
+    if args.batch < 0:
+        print(f"error: --batch must be >= 0, got {args.batch}",
+              file=sys.stderr)
+        return 2
+
+    def _engine(model, include_b=True):
+        # --batch N: stream evaluation through the serving engine's
+        # bucket ladder (full N-row passes + one padded remainder)
+        # instead of one monolithic (m, d) device pass — same bits,
+        # bounded memory (docs/SERVING.md "Chunked offline eval").
+        from dpsvm_tpu.serving.engine import PredictionEngine
+        return PredictionEngine(model, name="cmd-test",
+                                max_batch=args.batch,
+                                include_b=include_b)
+
     if os.path.isdir(args.model):
         from dpsvm_tpu.models.multiclass import load_multiclass
         mc = load_multiclass(args.model)
@@ -890,7 +982,10 @@ def cmd_test(args: argparse.Namespace) -> int:
                                                  predict_proba_multiclass)
         # One kernel-inference pass per pair, shared by everything
         # below (each pass is a full (m, d) @ (d, n_sv) evaluation).
-        decisions = pairwise_decisions(mc, x, include_b=not args.no_b)
+        if args.batch:
+            decisions = _engine(mc, include_b=not args.no_b).pairwise_list(x)
+        else:
+            decisions = pairwise_decisions(mc, x, include_b=not args.no_b)
         if args.proba:
             # The sigmoids were fit on intercept-included decisions;
             # with-b = intercept-free − b per pair, so no second
@@ -980,7 +1075,12 @@ def cmd_test(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
         from dpsvm_tpu.models.oneclass import predict_oneclass
-        pred = predict_oneclass(model, x)
+        if args.batch:
+            # one-class decisions always include rho (predict_oneclass
+            # hardcodes include_b=True; --no-b does not apply here)
+            pred = _engine(model, include_b=True).predict(x)
+        else:
+            pred = predict_oneclass(model, x)
         if args.predictions:
             with open(args.predictions, "w") as f:
                 f.writelines(f"{int(v)}\n" for v in pred)
@@ -997,12 +1097,15 @@ def cmd_test(args: argparse.Namespace) -> int:
             print("error: --proba applies to classifiers only",
                   file=sys.stderr)
             return 2
-        from dpsvm_tpu.models.svr import evaluate_svr, predict_svr
-        pred = predict_svr(model, x, include_b=not args.no_b)
+        from dpsvm_tpu.models.svr import regression_metrics, predict_svr
+        if args.batch:
+            pred = _engine(model, include_b=not args.no_b).predict(x)
+        else:
+            pred = predict_svr(model, x, include_b=not args.no_b)
         if args.predictions:
             with open(args.predictions, "w") as f:
                 f.writelines(f"{float(v):.9g}\n" for v in pred)
-        m = evaluate_svr(model, x, y, include_b=not args.no_b)
+        m = regression_metrics(pred, y)
         print(f"Number of SVs: {model.n_sv}")
         print(f"Test MSE: {m['mse']:.6f}  MAE: {m['mae']:.6f}  "
               f"R^2: {m['r2']:.6f}")
@@ -1011,7 +1114,10 @@ def cmd_test(args: argparse.Namespace) -> int:
 
     from dpsvm_tpu.models.svm import decision_function
     t_eval = time.perf_counter()
-    dec = decision_function(model, x, include_b=not args.no_b)
+    if args.batch:
+        dec = _engine(model, include_b=not args.no_b).decision_values(x)
+    else:
+        dec = decision_function(model, x, include_b=not args.no_b)
     t_eval = time.perf_counter() - t_eval
     pred = np.where(dec < 0, -1, 1)                    # svmTrain.cu:650-656
     acc = float(np.mean(pred == np.asarray(y, np.int32)))
@@ -1048,6 +1154,98 @@ def cmd_test(args: argparse.Namespace) -> int:
         print(f"Brier score: {brier:.6f}")
         print(f"Log-loss: {logloss:.6f}")
     return 0
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Online prediction server (docs/SERVING.md). Loads + warms every
+    model, prints one ready line, then serves until SIGTERM/SIGINT —
+    which triggers a graceful drain (everything accepted is answered)."""
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    if args.max_batch < 1 or args.max_queue < 1:
+        print("error: --max-batch and --max-queue must be >= 1",
+              file=sys.stderr)
+        return 2
+    registry = ModelRegistry()
+    for i, spec in enumerate(args.model):
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = ("default" if i == 0
+                          else os.path.basename(spec.rstrip("/"))), spec
+        if name in registry.names():
+            print(f"error: duplicate model name {name!r} (use "
+                  "NAME=PATH to disambiguate)", file=sys.stderr)
+            return 2
+        if not os.path.exists(path):
+            print(f"error: no such model: {path}", file=sys.stderr)
+            return 2
+        engine = registry.register(name, path,
+                                   max_batch=args.max_batch,
+                                   include_b=not args.no_b)
+        if not args.quiet:
+            m = engine.manifest
+            print(f"loaded {name!r}: task={m['task']} "
+                  f"n_sv={m['n_sv']} (dropped {m['n_sv_dropped']} "
+                  f"zero-coef) d={m['num_attributes']} "
+                  f"buckets={m['buckets']} "
+                  f"warmup_compiles={m['warmup_compiles']} "
+                  f"({m['warmup_compile_seconds']}s)", file=sys.stderr)
+    srv = ServingServer(registry, args.host, args.port,
+                        max_batch=args.max_batch,
+                        max_delay_ms=args.max_delay_ms,
+                        max_queue=args.max_queue,
+                        verbose=not args.quiet).start()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(srv.port))
+    print(f"serving on http://{args.host}:{srv.port} "
+          f"(models: {', '.join(registry.names())}) — SIGTERM/Ctrl-C "
+          "drains", file=sys.stderr, flush=True)
+    signum = srv.serve_until_signal()
+    if not args.quiet:
+        m = srv.metrics()
+        print(f"drained (signal {signum}): {m['requests']} requests, "
+              f"{m['rejected']} rejected, {m['errors']} errors",
+              file=sys.stderr)
+    return 0
+
+
+def cmd_loadgen(args: argparse.Namespace) -> int:
+    """Load generator (docs/SERVING.md). Pure HTTP + numpy — no
+    backend init; runs from any machine that can reach the server."""
+    import json
+
+    import numpy as np
+
+    from dpsvm_tpu.serving.loadgen import (fetch_manifest, loadgen_row,
+                                           synthetic_rows)
+
+    want = tuple(w for w in args.want.split(",") if w)
+    try:
+        manifest = fetch_manifest(args.url, args.model,
+                                  timeout=args.timeout)
+    except (OSError, RuntimeError) as e:
+        print(f"error: cannot reach {args.url}: {e}", file=sys.stderr)
+        return 2
+    if args.input:
+        from dpsvm_tpu.data.loader import load_dataset
+        rows, _ = load_dataset(args.input, None, None)
+        rows = np.asarray(rows, np.float32)
+        if rows.shape[1] != manifest["num_attributes"]:
+            print(f"error: dataset has {rows.shape[1]} attributes, "
+                  f"model {args.model!r} expects "
+                  f"{manifest['num_attributes']}", file=sys.stderr)
+            return 2
+    else:
+        rows = synthetic_rows(manifest["num_attributes"])
+    row = loadgen_row(args.url, rows, model=args.model,
+                      requests=args.requests, batch=args.batch,
+                      concurrency=args.concurrency, mode=args.mode,
+                      rps=args.rps, want=want, timeout=args.timeout,
+                      compare_sequential=args.compare_sequential)
+    print(json.dumps(row), flush=True)
+    return 0 if row["errors"] == 0 else 1
 
 
 def cmd_scale(args: argparse.Namespace) -> int:
@@ -1258,7 +1456,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             child, retries=args.retries, backoff_s=args.retry_backoff,
             checkpoint_path=args.checkpoint)
     try:
-        if args.command in ("train", "test"):
+        if args.command in ("train", "test", "serve"):
             rc = _init_backend(args)
             if rc:
                 return rc
@@ -1274,6 +1472,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_report(args)
         if args.command == "compare":
             return cmd_compare(args)
+        if args.command == "serve":
+            return cmd_serve(args)
+        if args.command == "loadgen":
+            return cmd_loadgen(args)
         return cmd_test(args)
     except PreemptedError as e:
         # Resumable by design: the supervisor (or the next manual run)

@@ -1,0 +1,231 @@
+"""Online serving subsystem: dynamic micro-batching inference on top
+of the trained-model stack.
+
+The ROADMAP's north star serves heavy traffic from millions of users;
+until this package the repo could only do one-shot batch eval
+(``dpsvm test``). The pieces (docs/SERVING.md):
+
+* ``engine``   — ``PredictionEngine``: any saved model (binary SVC /
+                 SVR / one-class / precomputed / multiclass directory)
+                 packed into device-resident buffers once, served
+                 through a pre-compiled bucket ladder of batch shapes —
+                 zero steady-state retraces, bitwise parity with
+                 ``decision_function``.
+* ``batcher``  — ``MicroBatcher``: size-or-deadline request coalescing
+                 with bounded-queue admission control (fast 429-style
+                 reject under overload).
+* ``registry`` — named multi-model registry with explicit, atomic hot
+                 reload.
+* ``server``   — stdlib ``ThreadingHTTPServer``: ``POST /v1/predict``,
+                 ``GET /healthz`` / ``/metricsz`` / ``/v1/models``,
+                 ``POST /v1/reload``; SIGTERM graceful drain via the
+                 ``resilience/preempt`` deferred-signal trap.
+* ``loadgen``  — open/closed-loop generator printing one bench-harness
+                 JSON row (throughput + p50/p95/p99 + the sequential
+                 batch-1 baseline and coalescing speedup).
+
+CLI: ``dpsvm serve`` / ``dpsvm loadgen`` (``dpsvm_tpu/cli.py``).
+
+CI gate: ``python -m dpsvm_tpu.serving --selfcheck`` — builds a model,
+loads it through the engine, and asserts the two properties the whole
+design rests on: ZERO compile events across mixed-size post-warmup
+traffic (via ``observability/compilewatch``), and bitwise-identical
+outputs between the batched engine and direct ``decision_function``
+for the same rows. The sibling of the telemetry and resilience
+selfchecks; wired into tier-1 by ``tests/test_serving.py``.
+
+Importing this package (or ``batcher``/``registry``/``server``/
+``loadgen``) initializes no backend; only ``engine`` pulls jax, and it
+is imported lazily.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
+                                       MicroBatcher, QueueFullError)
+from dpsvm_tpu.serving.registry import ModelRegistry
+
+__all__ = [
+    "KNOWN_OUTPUTS", "BatcherClosedError", "MicroBatcher",
+    "QueueFullError", "ModelRegistry", "PredictionEngine",
+    "ServingServer", "bucket_ladder", "compact_model", "loadgen_row",
+    "run_loadgen", "selfcheck", "main",
+]
+
+_LAZY = {
+    "PredictionEngine": ("dpsvm_tpu.serving.engine", "PredictionEngine"),
+    "bucket_ladder": ("dpsvm_tpu.serving.engine", "bucket_ladder"),
+    "compact_model": ("dpsvm_tpu.serving.engine", "compact_model"),
+    "ServingServer": ("dpsvm_tpu.serving.server", "ServingServer"),
+    "run_loadgen": ("dpsvm_tpu.serving.loadgen", "run_loadgen"),
+    "loadgen_row": ("dpsvm_tpu.serving.loadgen", "loadgen_row"),
+}
+
+
+def __getattr__(name: str):
+    """PEP 562 lazy re-exports: the engine (and with it jax) only loads
+    when something actually asks for it — ``dpsvm loadgen`` and the
+    pure-HTTP pieces stay accelerator-free."""
+    try:
+        mod, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(mod), attr)
+
+
+def _mixed_sizes(max_batch: int) -> List[int]:
+    """>= 20 request sizes covering every rung, the rung boundaries,
+    and the multi-chunk path (> max_batch)."""
+    sizes = [1, 2, 3, 4, 5, 7, 8, 9, 12, 15, 16, 17, 20, 24, 28, 31,
+             32, 30, 6, 10, 2, 1]
+    sizes = [min(s, max_batch) for s in sizes]
+    sizes.append(max_batch + 3)             # chunked: full pass + pad
+    return sizes
+
+
+def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
+    """Run the serving subsystem end to end on a synthetic model;
+    return a list of problems (empty = healthy). See module docstring
+    for what is asserted and why."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    problems: List[str] = []
+    ctx = (tempfile.TemporaryDirectory() if tmp_dir is None else None)
+    base = tmp_dir if tmp_dir is not None else ctx.name
+    try:
+        from dpsvm_tpu.models.calibration import save_platt, sigmoid_proba
+        from dpsvm_tpu.models.io import load_model, save_model
+        from dpsvm_tpu.models.svm import SVMModel, decision_function
+        from dpsvm_tpu.observability import compilewatch
+        from dpsvm_tpu.serving.engine import PredictionEngine
+
+        rng = np.random.default_rng(7)
+        n_sv, d, max_batch = 48, 6, 32
+        model = SVMModel(
+            x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+            alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+            y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(np.int32),
+            b=0.25, gamma=0.5)
+        path = os.path.join(base, "selfcheck.svm")
+        save_model(model, path)
+        save_platt(path, -1.2, 0.1)
+
+        engine = PredictionEngine.load(path, max_batch=max_batch)
+        if engine.warmup_compiles and len(engine.warmup_compiles) > \
+                len(engine.buckets):
+            problems.append(
+                f"warmup compiled {len(engine.warmup_compiles)} programs "
+                f"for a {len(engine.buckets)}-rung ladder")
+
+        # 1) zero compiles across mixed-size post-warmup traffic
+        compilewatch.drain()
+        sizes = _mixed_sizes(max_batch)
+        queries = [rng.standard_normal((s, d)).astype(np.float32)
+                   for s in sizes]
+        outs = [engine.infer(q, want=("labels", "decision", "proba"))
+                for q in queries]
+        stray = compilewatch.drain()
+        if stray:
+            progs = sorted({c["program"] for c in stray})
+            problems.append(
+                f"{len(stray)} compile event(s) across "
+                f"{len(sizes)} post-warmup requests (programs: {progs}) "
+                "— the bucket ladder is leaking retraces")
+
+        # 2) bitwise parity with the direct evaluation path
+        loaded = load_model(path)
+        for q, out in zip(queries, outs):
+            direct = decision_function(loaded, q)
+            if not np.array_equal(
+                    out["decision"].view(np.int32),
+                    np.asarray(direct, np.float32).view(np.int32)):
+                problems.append(
+                    f"engine decision differs from decision_function "
+                    f"at batch size {q.shape[0]} (max abs err "
+                    f"{np.max(np.abs(out['decision'] - direct)):.3g})")
+                break
+            want_labels = np.where(direct < 0, -1, 1).astype(np.int32)
+            if not np.array_equal(out["labels"], want_labels):
+                problems.append(f"engine labels differ at batch size "
+                                f"{q.shape[0]}")
+                break
+            want_proba = sigmoid_proba(direct, -1.2, 0.1)
+            if not np.array_equal(out["proba"], want_proba):
+                problems.append(f"engine proba differs at batch size "
+                                f"{q.shape[0]}")
+                break
+
+        # 3) the batcher answers exactly like the engine it fronts
+        from dpsvm_tpu.serving.batcher import MicroBatcher
+        bat = MicroBatcher(engine.infer, max_batch=max_batch,
+                           max_delay_ms=20.0, start=False)
+        tickets = [bat.submit(q, want=("decision",)) for q in queries[:8]]
+        bat.start()
+        for q, t, out in zip(queries, tickets, outs):
+            got = t.wait(timeout=30.0)["decision"]
+            if not np.array_equal(got.view(np.int32),
+                                  out["decision"].view(np.int32)):
+                problems.append("batched submission answered differently "
+                                "from a direct engine call")
+                break
+        st = bat.stats()
+        if not any(int(k) > sizes[0] for k in
+                   st["batch_rows_histogram"]):
+            problems.append("staged queue did not coalesce "
+                            f"(histogram: {st['batch_rows_histogram']})")
+        bat.close()
+
+        # 4) registry hot reload swaps generations atomically
+        from dpsvm_tpu.serving.registry import ModelRegistry
+        reg = ModelRegistry()
+        reg.register("m", path, max_batch=8)
+        import dataclasses
+        save_model(dataclasses.replace(model, b=model.b + 1.0), path)
+        reg.reload("m")
+        man = reg.manifests()["m"]
+        if man["generation"] != 2:
+            problems.append(f"reload generation {man['generation']} != 2")
+        row = queries[0][:1]
+        d_old = decision_function(model, row)
+        d_new = np.asarray(reg.engine("m").decision_values(row))
+        if not np.allclose(d_new, d_old - 1.0, atol=1e-6):
+            problems.append("hot reload did not serve the new artifact")
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.serving")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="engine/batcher/registry round-trip on a "
+                        "synthetic model: asserts zero post-warmup "
+                        "compiles and bitwise parity with "
+                        "decision_function")
+    args = p.parse_args(argv)
+    if not args.selfcheck:
+        p.print_help()
+        return 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    problems = selfcheck()
+    if problems:
+        print("serving selfcheck FAILED:", file=sys.stderr)
+        for pr in problems:
+            print(f"  {pr}", file=sys.stderr)
+        return 1
+    print("serving selfcheck OK (zero post-warmup compiles across "
+          "mixed-size traffic; engine bitwise == decision_function; "
+          "batcher + hot reload consistent)")
+    return 0

@@ -1,0 +1,9 @@
+"""``python -m dpsvm_tpu.serving`` — the serving selfcheck CI gate
+(sibling of ``python -m dpsvm_tpu.telemetry`` and ``python -m
+dpsvm_tpu.resilience``)."""
+
+import sys
+
+from dpsvm_tpu.serving import main
+
+sys.exit(main())

@@ -1,0 +1,251 @@
+"""Dynamic micro-batching: many small requests, few big MXU passes.
+
+The amortization argument the training side already made (one big
+compiled pass beats many launches — "Recipe for Fast Large-scale SVM
+Training", arXiv:2207.01016) applies unchanged to inference: a single
+``(64, d) @ (d, n_sv)`` pass costs barely more than a ``(1, d)`` one,
+so concurrent single-row requests should ride the same device pass.
+
+One worker thread owns the engine. Requests enqueue; the worker takes
+the oldest request and keeps coalescing until either ``max_batch`` rows
+are gathered or ``max_delay_ms`` has passed since the batch opened —
+the classic size-or-deadline rule, so an idle server adds at most
+``max_delay_ms`` latency and a busy one converges to full buckets.
+
+Admission control is a bounded ROW queue: when ``max_queue`` rows are
+already waiting, ``submit`` raises ``QueueFullError`` immediately — a
+fast reject the HTTP layer turns into 429, instead of unbounded queue
+latency (the failure mode where an overloaded server times every
+client out instead of telling any of them to back off).
+
+Correctness does not depend on how traffic happens to coalesce: engine
+output rows are independent of their batch-mates (bitwise — see
+``engine.py``), so a request answered in a 64-row batch is answered
+identically to one served alone. ``tests/test_serving.py`` pins this
+by replaying the same requests under forced-coalesced and sequential
+scheduling.
+
+Stdlib-only on purpose (no jax import): the module is importable on a
+machine with no accelerator, and unit tests can drive it with a stub
+engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+#: outputs the engine's ``infer`` understands; "proba" additionally
+#: needs calibration. Lives here (stdlib-only module) so the HTTP
+#: layer can validate without importing the jax-backed engine.
+KNOWN_OUTPUTS = ("labels", "decision", "proba")
+
+
+class QueueFullError(RuntimeError):
+    """Admission reject: the pending-row queue is at capacity. The
+    caller should shed load (HTTP 429), not wait."""
+
+
+class BatcherClosedError(RuntimeError):
+    """Submitted after close() — the server is draining."""
+
+
+class _Ticket:
+    """One request's future: wait() blocks until the worker publishes
+    this request's slice of the batch result (or its error)."""
+
+    __slots__ = ("rows", "want", "event", "result", "error", "t_submit")
+
+    def __init__(self, rows: np.ndarray, want: Tuple[str, ...]):
+        self.rows = rows
+        self.want = want
+        self.event = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+
+    def wait(self, timeout: Optional[float] = None) -> dict:
+        if not self.event.wait(timeout):
+            raise TimeoutError("prediction did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class MicroBatcher:
+    """Size-or-deadline request coalescing in front of an engine.
+
+    ``infer_fn(x, want)`` is the engine call (resolved per batch, so a
+    registry hot-reload takes effect without rebuilding the batcher).
+    ``start=False`` leaves the worker unstarted — tests use it to stage
+    a deterministic queue, then ``start()`` to coalesce it in one batch.
+    """
+
+    def __init__(self, infer_fn: Callable[[np.ndarray, Tuple[str, ...]],
+                                          dict],
+                 *, max_batch: int = 256, max_delay_ms: float = 2.0,
+                 max_queue: int = 4096, start: bool = True):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._infer = infer_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = max(float(max_delay_ms), 0.0) / 1000.0
+        self.max_queue = int(max_queue)
+        self._q: deque = deque()
+        self._rows_queued = 0
+        self._cond = threading.Condition()
+        self._closing = False
+        self._drain = True
+        self._worker: Optional[threading.Thread] = None
+        # batch-size histogram: coalesced rows per engine call
+        self._batch_rows: Dict[int, int] = {}
+        self._n_batches = 0
+        self._n_requests = 0
+        self._n_rejected = 0
+        if start:
+            self.start()
+
+    # -- client side --------------------------------------------------
+
+    def submit(self, rows, want: Sequence[str] = ("labels",)) -> _Ticket:
+        """Enqueue one request (rows: (k, d) float32). Returns a ticket
+        to ``wait()`` on. Raises ``QueueFullError`` (fast, no blocking)
+        at capacity, ``BatcherClosedError`` while draining."""
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        n = int(rows.shape[0])
+        if n == 0:
+            raise ValueError("empty request")
+        t = _Ticket(rows, tuple(want))
+        with self._cond:
+            if self._closing:
+                raise BatcherClosedError("server is draining")
+            if self._rows_queued + n > self.max_queue:
+                self._n_rejected += 1
+                raise QueueFullError(
+                    f"queue full ({self._rows_queued} rows waiting, "
+                    f"max {self.max_queue}) — retry with backoff")
+            self._q.append(t)
+            self._rows_queued += n
+            self._n_requests += 1
+            self._cond.notify()
+        return t
+
+    def infer(self, rows, want: Sequence[str] = ("labels",),
+              timeout: Optional[float] = 60.0) -> dict:
+        """submit + wait — the HTTP handler's one call."""
+        return self.submit(rows, want).wait(timeout)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._worker = threading.Thread(target=self._run,
+                                        name="dpsvm-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting; with ``drain`` the worker finishes every
+        queued request first (the SIGTERM graceful-drain semantics),
+        otherwise pending tickets fail with BatcherClosedError."""
+        with self._cond:
+            self._closing = True
+            self._drain = drain
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+
+    # -- stats --------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return self._rows_queued
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "requests": self._n_requests,
+                "rejected": self._n_rejected,
+                "batches": self._n_batches,
+                "queue_depth_rows": self._rows_queued,
+                "batch_rows_histogram": {str(k): v for k, v in
+                                         sorted(self._batch_rows.items())},
+            }
+
+    # -- worker -------------------------------------------------------
+
+    def _take_batch(self) -> Optional[List[_Ticket]]:
+        """Block for the first request, then coalesce until max_batch
+        rows or the deadline. None = closed and (drained or no-drain)."""
+        with self._cond:
+            while not self._q:
+                if self._closing:
+                    return None
+                self._cond.wait()
+            if self._closing and not self._drain:
+                return None
+            first = self._q.popleft()
+            self._rows_queued -= first.rows.shape[0]
+            batch = [first]
+            rows = int(first.rows.shape[0])
+            deadline = time.perf_counter() + self.max_delay_s
+            while rows < self.max_batch:
+                if self._q:
+                    nxt = int(self._q[0].rows.shape[0])
+                    if rows + nxt > self.max_batch:
+                        break
+                    t = self._q.popleft()
+                    self._rows_queued -= nxt
+                    batch.append(t)
+                    rows += nxt
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self._closing:
+                    break
+                self._cond.wait(remaining)
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                if not self._drain:
+                    with self._cond:
+                        leftovers = list(self._q)
+                        self._q.clear()
+                        self._rows_queued = 0
+                    for t in leftovers:
+                        t.error = BatcherClosedError("server shut down")
+                        t.event.set()
+                return
+            x = (batch[0].rows if len(batch) == 1
+                 else np.concatenate([t.rows for t in batch]))
+            want = tuple(dict.fromkeys(w for t in batch for w in t.want))
+            with self._cond:
+                self._n_batches += 1
+                self._batch_rows[int(x.shape[0])] = \
+                    self._batch_rows.get(int(x.shape[0]), 0) + 1
+            try:
+                res = self._infer(x, want)
+            except BaseException as e:     # noqa: BLE001 — published to
+                for t in batch:            # every waiting ticket
+                    t.error = e
+                    t.event.set()
+                continue
+            lo = 0
+            for t in batch:
+                hi = lo + int(t.rows.shape[0])
+                t.result = {k: v[lo:hi] for k, v in res.items()
+                            if k in t.want}
+                t.event.set()
+                lo = hi

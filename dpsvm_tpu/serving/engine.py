@@ -1,0 +1,469 @@
+"""Online prediction engine: device-resident SV buffers + a shape
+ladder that never retraces.
+
+Training already turned the reference's per-iteration GPU launches into
+big compiled MXU passes; this module does the same for *serving*. The
+reference's tester scored one example at a time on the host
+(``seq_test.cpp:187-210``); ``models/svm.py`` beat that with a single
+``(m, d) @ (d, n_sv)`` pass per call — but every distinct ``m``
+compiles a fresh XLA program, so naive online traffic (every request a
+new batch size) would retrace constantly, and compilation is the
+dominant wall-clock cost on the tunneled chip (docs/PERF.md).
+
+The engine fixes the shape economy once, at load time:
+
+* **SV packing + compaction** — support vectors, duals and squared
+  norms go to the device exactly once per model. Zero-coefficient SVs
+  (possible in hand-assembled or imported models; our own writers
+  already drop them) are compacted away first, shrinking every
+  subsequent ``(m, d) @ (d, n_sv)`` pass; the dropped count is recorded
+  in the engine manifest.
+* **Bucket ladder** — incoming batches are padded up to a small ladder
+  of batch shapes: powers of two, capped by ``max_batch`` (which is
+  itself the top rung). A request of 37 rows runs at bucket 64; a
+  request of 5000 rows against ``max_batch=256`` streams as full
+  256-row passes plus one padded remainder bucket.
+* **Compile warmup** — every bucket is compiled at construction, so
+  steady-state serving pays ZERO retraces. This is not a hope but an
+  observable fact: the jitted programs are wrapped with
+  ``observability/compilewatch.instrument``, warmup drains the compile
+  log into ``warmup_compiles``, and the serving selfcheck
+  (``python -m dpsvm_tpu.serving --selfcheck``) asserts the log stays
+  empty across mixed-size post-warmup traffic.
+
+Output parity is bitwise, not approximate: each output row of the
+kernel matmul depends only on its own input row, so a row evaluated at
+bucket 64 is bit-identical to the same row through a direct
+``decision_function`` call — the selfcheck asserts this too. (The
+engine reuses the exact jitted programs ``models/svm.py`` evaluates
+with, so there is one definition of the decision math in the repo.)
+
+Model coverage = everything ``models/io.py`` / ``models/multiclass.py``
+can persist: binary SVC (with optional Platt sidecar), SVR, one-class,
+precomputed-kernel models (pure-NumPy column gather — trivially
+zero-compile), and one-vs-one multiclass directories (same-spec pairs
+collapse into the one concatenated-SV pass of
+``models/multiclass.pairwise_decisions``; mixed-spec directories fall
+back to per-pair passes, each with its own warmed ladder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from dpsvm_tpu.models.multiclass import (MulticlassModel, load_multiclass,
+                                         predict_multiclass,
+                                         predict_proba_multiclass)
+from dpsvm_tpu.models.svm import SVMModel
+from dpsvm_tpu.observability import compilewatch
+from dpsvm_tpu.serving.batcher import KNOWN_OUTPUTS
+
+AnyModel = Union[SVMModel, MulticlassModel]
+
+
+def bucket_ladder(max_batch: int) -> List[int]:
+    """Powers of two below ``max_batch``, plus ``max_batch`` itself as
+    the top rung (NOT rounded up: padding 10000 to 16384 would waste
+    60% of every full pass, so the cap is always an exact shape)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    ladder = []
+    b = 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(int(max_batch))
+    return ladder
+
+
+def compact_model(model: SVMModel) -> Tuple[SVMModel, int]:
+    """Drop zero-coefficient support vectors before device packing.
+
+    A zero alpha contributes nothing to the decision sum but still
+    costs a column in every kernel matmul. Our writers never persist
+    them, but imported LIBSVM files and hand-assembled models can carry
+    them. Returns (model, n_dropped); the model is returned unchanged
+    (same object) when there is nothing to drop, so the common path
+    keeps bitwise parity with ``decision_function`` trivially."""
+    alpha = np.asarray(model.alpha)
+    keep = alpha != 0
+    dropped = int(keep.size - np.count_nonzero(keep))
+    if dropped == 0:
+        return model, 0
+    model = dataclasses.replace(
+        model,
+        x_sv=np.ascontiguousarray(np.asarray(model.x_sv)[keep]),
+        alpha=np.ascontiguousarray(alpha[keep]),
+        y_sv=np.ascontiguousarray(np.asarray(model.y_sv)[keep]),
+        sv_idx=(np.asarray(model.sv_idx)[keep]
+                if model.sv_idx is not None else None),
+    )
+    return model, dropped
+
+
+def _load_binary_platt(path: str) -> Optional[Tuple[float, float]]:
+    from dpsvm_tpu.models.calibration import load_platt, sidecar_path
+    if os.path.exists(sidecar_path(path)):
+        return load_platt(path)
+    return None
+
+
+class PredictionEngine:
+    """One loaded model, packed for serving (see module docstring).
+
+    ``infer``/``predict``/``decision_values`` are safe to call from any
+    single thread at a time; the serving stack funnels all calls
+    through one MicroBatcher worker per model, and a lock here keeps
+    direct concurrent use (tests, ad-hoc scripts) correct too.
+    """
+
+    def __init__(self, model: AnyModel, *, name: str = "default",
+                 max_batch: int = 256, include_b: bool = True,
+                 platt: Optional[Tuple[float, float]] = None,
+                 source: Optional[str] = None, warmup: bool = True):
+        self.name = str(name)
+        self.include_b = bool(include_b)
+        self.source = source
+        self.max_batch = int(max_batch)
+        self.buckets = bucket_ladder(self.max_batch)
+        self.multiclass = isinstance(model, MulticlassModel)
+        self.warmup_compiles: List[dict] = []
+        self.n_sv_dropped = 0
+        self._lock = threading.Lock()
+        self._bucket_counts: Dict[int, int] = {b: 0 for b in self.buckets}
+        if self.multiclass:
+            pairs = []
+            for m in model.models:
+                m, dropped = compact_model(m)
+                self.n_sv_dropped += dropped
+                pairs.append(m)
+            model = dataclasses.replace(model, models=pairs)
+            self.platt = None           # per-pair sigmoids live in model
+            self.task = "multiclass"
+        else:
+            model, self.n_sv_dropped = compact_model(model)
+            self.platt = platt
+            self.task = model.task
+        self.model = model
+        self._build()
+        if warmup:
+            self._warmup()
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str, **kwargs) -> "PredictionEngine":
+        """Load any saved model: a multiclass directory
+        (``models/multiclass.py``) or a binary/SVR/one-class model file
+        (``models/io.py``, LIBSVM format auto-detected), picking up the
+        Platt sidecar when one sits next to a binary model."""
+        if os.path.isdir(path):
+            model: AnyModel = load_multiclass(path)
+            platt = None
+        else:
+            from dpsvm_tpu.models.io import load_model
+            model = load_model(path)
+            platt = _load_binary_platt(path)
+        kwargs.setdefault("platt", platt)
+        kwargs.setdefault("name", os.path.basename(path.rstrip("/"))
+                          or "default")
+        return cls(model, source=path, **kwargs)
+
+    def _build(self) -> None:
+        """Pack device-resident buffers and select the per-block
+        decision program."""
+        if self.multiclass:
+            ms = self.model.models
+            specs = {(m.kernel, float(m.gamma), float(m.coef0),
+                      int(m.degree)) for m in ms}
+            if len(specs) == 1 and ms[0].kernel != "precomputed":
+                self._build_mc_batched()
+            else:
+                # mixed kernel specs (hand-assembled directory) — one
+                # warmed ladder per pair; still zero steady-state
+                # compiles, just P passes per block.
+                self._pair_deciders = [self._make_binary_decider(m, i)
+                                       for i, m in enumerate(ms)]
+                self._decide_block = self._decide_mc_per_pair
+            return
+        self._decide_block = self._make_binary_decider(self.model, None)
+
+    def _make_binary_decider(self, model: SVMModel, pair: Optional[int]):
+        tag = f"serve[{self.name}]" + (f"-pair{pair}" if pair is not None
+                                       else "")
+        if model.kernel == "precomputed":
+            coef = (np.asarray(model.alpha, np.float32)
+                    * np.asarray(model.y_sv, np.float32))
+            sv_idx = np.asarray(model.sv_idx)
+            b = np.float32(model.b)
+
+            def decide(block: np.ndarray) -> np.ndarray:
+                # K(test, train) column gather — host NumPy, no XLA
+                # program, zero compiles by construction.
+                dual = block[:, sv_idx] @ coef
+                if self.include_b:
+                    dual = dual - b
+                return dual.astype(np.float32)
+
+            return decide
+
+        import jax.numpy as jnp
+
+        from dpsvm_tpu.models.svm import _decision_jit
+        from dpsvm_tpu.ops.kernels import row_norms_sq
+
+        x_sv = jnp.asarray(np.asarray(model.x_sv, np.float32))
+        coef = jnp.asarray(np.asarray(model.alpha, np.float32)
+                           * np.asarray(model.y_sv, np.float32))
+        sv2 = row_norms_sq(x_sv)
+        b = jnp.float32(model.b)
+        gamma = jnp.float32(model.gamma)
+        coef0 = jnp.float32(model.coef0)
+        run = compilewatch.instrument(_decision_jit, f"{tag}-decision")
+        kind, degree, include_b = model.kernel, int(model.degree), \
+            self.include_b
+
+        def decide(block: np.ndarray) -> np.ndarray:
+            return np.asarray(run(jnp.asarray(block), x_sv, coef, sv2,
+                                  b, gamma, coef0, kind, degree,
+                                  include_b))
+
+        return decide
+
+    def _build_mc_batched(self) -> None:
+        import jax.numpy as jnp
+
+        from dpsvm_tpu.models.svm import _pairwise_decisions_jit
+
+        ms = self.model.models
+        self._sv_all = jnp.asarray(np.concatenate(
+            [np.asarray(m.x_sv, np.float32) for m in ms]))
+        self._coef = jnp.asarray(np.concatenate(
+            [np.asarray(m.alpha, np.float32)
+             * np.asarray(m.y_sv, np.float32) for m in ms]))
+        self._seg_ids = jnp.asarray(np.repeat(
+            np.arange(len(ms), dtype=np.int32),
+            [int(m.n_sv) for m in ms]))
+        self._b_vec = jnp.asarray(np.asarray([m.b for m in ms],
+                                             np.float32))
+        spec = ms[0]
+        self._mc_kw = dict(kind=spec.kernel, degree=int(spec.degree),
+                           include_b=self.include_b,
+                           num_segments=len(ms))
+        self._gamma = jnp.float32(spec.gamma)
+        self._coef0 = jnp.float32(spec.coef0)
+        self._mc_run = compilewatch.instrument(
+            _pairwise_decisions_jit, f"serve[{self.name}]-pairwise")
+
+        def decide(block: np.ndarray) -> np.ndarray:
+            import jax.numpy as jnp
+            return np.asarray(self._mc_run(
+                jnp.asarray(block), self._sv_all, self._coef,
+                self._seg_ids, self._b_vec, self._gamma, self._coef0,
+                **self._mc_kw))
+
+        self._decide_block = decide
+
+    def _decide_mc_per_pair(self, block: np.ndarray) -> np.ndarray:
+        return np.stack([d(block) for d in self._pair_deciders], axis=1)
+
+    def _warmup(self) -> None:
+        """Compile every ladder bucket up front; record what it cost.
+
+        Drains the process-global compile log afterwards — engines are
+        constructed at process startup (server boot, eval commands),
+        never concurrently with a traced training run."""
+        compilewatch.drain()            # foreign observations out first
+        d = self.num_attributes
+        for bucket in self.buckets:
+            self._decide_block(np.zeros((bucket, d), np.float32))
+        self.warmup_compiles = compilewatch.drain()
+
+    # -- facts --------------------------------------------------------
+
+    @property
+    def num_attributes(self) -> int:
+        if self.multiclass:
+            return int(self.model.models[0].num_attributes)
+        return int(self.model.num_attributes)
+
+    @property
+    def n_sv(self) -> int:
+        if self.multiclass:
+            return int(sum(m.n_sv for m in self.model.models))
+        return int(self.model.n_sv)
+
+    @property
+    def calibrated(self) -> bool:
+        if self.multiclass:
+            return self.model.platt is not None
+        return self.platt is not None
+
+    @property
+    def manifest(self) -> dict:
+        """Everything an operator (or /v1/models) needs to know about
+        the loaded model — including the compile-warmup receipt and the
+        SV-compaction count."""
+        out = {
+            "name": self.name,
+            "task": self.task,
+            "source": self.source,
+            "num_attributes": self.num_attributes,
+            "n_sv": self.n_sv,
+            "n_sv_dropped": self.n_sv_dropped,
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "include_b": self.include_b,
+            "calibrated": self.calibrated,
+            "warmup_compiles": len(self.warmup_compiles),
+            "warmup_compile_seconds": round(
+                sum(c["seconds"] for c in self.warmup_compiles), 3),
+        }
+        if self.multiclass:
+            out["classes"] = [int(c) for c in self.model.classes]
+            out["n_pairs"] = len(self.model.models)
+        else:
+            out["kernel"] = self.model.kernel
+        return out
+
+    def bucket_counts(self) -> Dict[int, int]:
+        """How many device passes each ladder rung has served (the
+        /metricsz bucket histogram)."""
+        with self._lock:
+            return dict(self._bucket_counts)
+
+    def _bucket_for(self, m: int) -> int:
+        for b in self.buckets:
+            if b >= m:
+                return b
+        return self.max_batch
+
+    # -- evaluation ---------------------------------------------------
+
+    def _check(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2:
+            raise ValueError(f"instances must be (m, {self.num_attributes})"
+                             f", got shape {x.shape}")
+        if x.shape[1] != self.num_attributes:
+            raise ValueError(
+                f"instances have {x.shape[1]} attributes, model "
+                f"{self.name!r} expects {self.num_attributes}")
+        return x
+
+    def _decisions(self, x: np.ndarray) -> np.ndarray:
+        """(m,) decision values (binary tasks) or (m, P) pairwise
+        decisions (multiclass), streamed through the bucket ladder:
+        full ``max_batch`` passes, then one padded remainder bucket."""
+        x = self._check(x)
+        m = x.shape[0]
+        out = None
+        lo = 0
+        while lo < m:
+            take = min(self.max_batch, m - lo)
+            bucket = self._bucket_for(take)
+            block = np.zeros((bucket, x.shape[1]), np.float32)
+            block[:take] = x[lo:lo + take]
+            with self._lock:
+                vals = self._decide_block(block)
+                self._bucket_counts[bucket] += 1
+            if out is None:
+                out = np.empty((m,) + vals.shape[1:], vals.dtype)
+            out[lo:lo + take] = vals[:take]
+            lo += take
+        return out
+
+    def decision_values(self, x) -> np.ndarray:
+        """Binary tasks: the (m,) decision/score/prediction vector.
+        Multiclass: the (m, P) pairwise decision matrix."""
+        return self._decisions(x)
+
+    def pairwise_list(self, x) -> List[np.ndarray]:
+        """Multiclass pairwise decisions in the per-pair-list shape
+        ``models/multiclass.pairwise_decisions`` returns (the shape
+        ``cmd_test`` and the couplers consume)."""
+        if not self.multiclass:
+            raise ValueError("pairwise_list applies to multiclass models")
+        dec = self._decisions(x)
+        return [dec[:, p] for p in range(dec.shape[1])]
+
+    def _with_b(self, dec: np.ndarray):
+        """Decision values WITH the intercept folded in, from whatever
+        ``include_b`` produced (the Platt sigmoids are defined on
+        intercept-included decisions)."""
+        if self.include_b:
+            return dec
+        if self.multiclass:
+            bs = np.asarray([m.b for m in self.model.models], np.float32)
+            return dec - bs[None, :]
+        return dec - np.float32(self.model.b)
+
+    def infer(self, x, want: Sequence[str] = ("labels",)) -> dict:
+        """One decision pass, every requested output derived from it.
+
+        Returns a dict with any of: ``labels`` (class labels; floats
+        for SVR; +1/-1 inlier for one-class), ``decision`` (decision
+        values / scores; (m, P) pairwise matrix for multiclass),
+        ``proba`` (Platt probability of +1 for binary; (m, k) coupled
+        class probabilities for multiclass). Requesting ``proba`` from
+        an uncalibrated model raises ValueError."""
+        unknown = [w for w in want if w not in KNOWN_OUTPUTS]
+        if unknown:
+            raise ValueError(f"unknown outputs {unknown}; "
+                             f"pick from {list(KNOWN_OUTPUTS)}")
+        if "proba" in want and not self.calibrated:
+            raise ValueError(
+                f"model {self.name!r} has no probability calibration — "
+                "train with --probability (binary models also need the "
+                ".platt.json sidecar next to the model file)")
+        x = self._check(x)
+        dec = self._decisions(x)
+        out: dict = {}
+        if self.multiclass:
+            cols = [dec[:, p] for p in range(dec.shape[1])]
+            if "proba" in want:
+                cols_b = [c for c in
+                          np.moveaxis(self._with_b(dec), 1, 0)]
+                proba = predict_proba_multiclass(self.model, x,
+                                                 decisions=cols_b)
+                out["proba"] = proba
+                if "labels" in want:
+                    # LIBSVM -b 1 semantics: predict by the coupled
+                    # argmax so labels stay consistent with proba
+                    # (cmd_test's rule).
+                    out["labels"] = self.model.classes[
+                        np.argmax(proba, axis=1)]
+            if "labels" in want and "labels" not in out:
+                out["labels"] = predict_multiclass(
+                    self.model, x, include_b=self.include_b,
+                    decisions=cols)
+            if "decision" in want:
+                out["decision"] = dec
+            return out
+        if "decision" in want:
+            out["decision"] = dec
+        if "labels" in want:
+            if self.task == "svr":
+                out["labels"] = dec
+            else:
+                out["labels"] = np.where(dec < 0, -1, 1).astype(np.int32)
+        if "proba" in want:
+            from dpsvm_tpu.models.calibration import sigmoid_proba
+            pa, pb = self.platt
+            out["proba"] = sigmoid_proba(self._with_b(dec), pa, pb)
+        return out
+
+    def predict(self, x) -> np.ndarray:
+        """Labels (classification), predictions (SVR), +1/-1 inlier
+        flags (one-class) — ``infer``'s ``labels`` output."""
+        return self.infer(x, want=("labels",))["labels"]
+
+    def predict_proba(self, x) -> np.ndarray:
+        return self.infer(x, want=("proba",))["proba"]

@@ -1,0 +1,207 @@
+"""Load generator for the serving stack: open/closed-loop HTTP traffic
+against ``dpsvm serve``, reported as one bench-harness JSON row.
+
+Closed loop (default): N workers, each firing its next request the
+moment the previous answer lands — throughput is latency-bound, the
+classic saturation probe, and the shape that exercises server-side
+micro-batching (concurrent in-flight requests coalesce).
+
+Open loop: requests depart on a fixed schedule (``rps``) regardless of
+completions — the arrival process real traffic has; latency here
+includes any queueing the server builds up, so it surfaces overload
+honestly (no coordinated omission: a worker that falls behind schedule
+records its lateness inside the measured latency).
+
+``compare_sequential`` re-runs the same request count single-worker
+with one row per request — the no-batching baseline. The headline row
+then carries both numbers and their ratio, so "coalesced batching
+beats batch-1 sequential submission" is a printed fact, not a claim.
+
+Stdlib HTTP (``http.client`` with keep-alive) + numpy percentiles; no
+jax — the loadgen runs from any machine that can reach the server.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+import numpy as np
+
+
+def synthetic_rows(d: int, n: int = 512, seed: int = 0) -> np.ndarray:
+    """Feature rows for a model of width d when no dataset is given.
+    Inference cost depends only on shapes, so random rows measure the
+    same thing real ones would."""
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, d)).astype(np.float32)
+
+
+def fetch_manifest(url: str, model: str = "default",
+                   timeout: float = 10.0) -> dict:
+    """GET /v1/models and return the named model's manifest (the
+    loadgen needs the feature width to synthesize rows)."""
+    host, port = _host_port(url)
+    conn = _Conn(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/v1/models")
+        resp = conn.getresponse()
+        body = json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+    if resp.status != 200:
+        raise RuntimeError(f"GET /v1/models -> {resp.status}: {body}")
+    models = body.get("models", {})
+    if model not in models:
+        raise RuntimeError(f"server has no model {model!r} "
+                           f"(models: {sorted(models)})")
+    return models[model]
+
+
+def _host_port(url: str) -> Tuple[str, int]:
+    parts = urlsplit(url if "//" in url else f"http://{url}")
+    return parts.hostname or "127.0.0.1", parts.port or 80
+
+
+class _Conn(http.client.HTTPConnection):
+    """Keep-alive connection with Nagle off: headers and body are
+    separate writes, and the 40 ms delayed-ACK stall would otherwise
+    dominate every latency percentile this tool exists to measure."""
+
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
+                requests: int = 200, batch: int = 1,
+                concurrency: int = 8, mode: str = "closed",
+                rps: float = 100.0, want: Sequence[str] = ("labels",),
+                timeout: float = 30.0) -> dict:
+    """Fire ``requests`` requests of ``batch`` rows each; return the
+    result row (throughput + latency percentiles + error count)."""
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    if requests < 1 or batch < 1 or concurrency < 1:
+        raise ValueError("requests, batch and concurrency must be >= 1")
+    rows = np.asarray(rows, np.float32)
+    host, port = _host_port(url)
+    # Pre-serialize every request body: the generator must measure the
+    # server, not its own json.dumps.
+    n_rows = rows.shape[0]
+    bodies: List[bytes] = []
+    for i in range(requests):
+        take = [(i * batch + j) % n_rows for j in range(batch)]
+        bodies.append(json.dumps({
+            "model": model, "return": list(want),
+            "instances": rows[take].tolist()}).encode())
+
+    next_idx = [0]
+    idx_lock = threading.Lock()
+    lat_ms: List[float] = []
+    statuses: List[int] = []
+    out_lock = threading.Lock()
+    t_start = [0.0]
+
+    def worker(wid: int) -> None:
+        conn = _Conn(host, port, timeout=timeout)
+        try:
+            while True:
+                with idx_lock:
+                    i = next_idx[0]
+                    if i >= requests:
+                        return
+                    next_idx[0] += 1
+                if mode == "open":
+                    # fixed departure schedule; lateness is NOT slept
+                    # away (that would be coordinated omission)
+                    due = t_start[0] + i / rps
+                    delay = due - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    t0 = due if due > t_start[0] else time.perf_counter()
+                else:
+                    t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/v1/predict", body=bodies[i],
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    status = resp.status
+                except (http.client.HTTPException, OSError):
+                    status = -1
+                    conn.close()
+                    conn = _Conn(host, port, timeout=timeout)
+                ms = (time.perf_counter() - t0) * 1000.0
+                with out_lock:
+                    lat_ms.append(ms)
+                    statuses.append(status)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    t_start[0] = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start[0]
+
+    lat = np.asarray(lat_ms, np.float64)
+    ok = sum(1 for s in statuses if s == 200)
+    errors = len(statuses) - ok
+    p50, p95, p99 = (np.percentile(lat, [50.0, 95.0, 99.0])
+                     if lat.size else (float("nan"),) * 3)
+    return {
+        "mode": mode,
+        "requests": requests,
+        "batch": batch,
+        "concurrency": concurrency,
+        "wall_s": round(wall, 4),
+        "throughput_rps": round(ok / wall, 2) if wall > 0 else 0.0,
+        "examples_per_sec": round(ok * batch / wall, 2) if wall > 0
+        else 0.0,
+        "p50_ms": round(float(p50), 3),
+        "p95_ms": round(float(p95), 3),
+        "p99_ms": round(float(p99), 3),
+        "errors": errors,
+        **({"target_rps": rps} if mode == "open" else {}),
+    }
+
+
+def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
+                requests: int = 200, batch: int = 1,
+                concurrency: int = 8, mode: str = "closed",
+                rps: float = 100.0, want: Sequence[str] = ("labels",),
+                timeout: float = 30.0,
+                compare_sequential: bool = True) -> dict:
+    """The one-line result row ``dpsvm loadgen`` prints: the main
+    measurement, plus (by default) the batch-1 single-worker sequential
+    baseline and the coalescing speedup over it."""
+    main = run_loadgen(url, rows, model=model, requests=requests,
+                       batch=batch, concurrency=concurrency, mode=mode,
+                       rps=rps, want=want, timeout=timeout)
+    row = {
+        "metric": "serving_examples_per_sec",
+        "value": main["examples_per_sec"],
+        "unit": "ex/s",
+        **main,
+    }
+    if compare_sequential:
+        seq = run_loadgen(url, rows, model=model, requests=requests,
+                          batch=1, concurrency=1, mode="closed",
+                          want=want, timeout=timeout)
+        row["seq1_examples_per_sec"] = seq["examples_per_sec"]
+        row["seq1_p50_ms"] = seq["p50_ms"]
+        row["seq1_errors"] = seq["errors"]
+        row["coalesce_speedup"] = (
+            round(main["examples_per_sec"] / seq["examples_per_sec"], 3)
+            if seq["examples_per_sec"] > 0 else None)
+    return row

@@ -1,0 +1,107 @@
+"""Named multi-model registry with explicit hot reload.
+
+One server process serves many models: each registered name owns a
+warmed ``PredictionEngine``. Reload is EXPLICIT (an operator action —
+``POST /v1/reload`` or ``ModelRegistry.reload``), never an mtime
+watcher: a model file mid-write must not be half-loaded, and the
+operator decides when the new artifact is ready.
+
+Reload builds the replacement engine COMPLETELY (load, compact, pack,
+warm every bucket) before the swap, then swaps under the lock — so
+traffic never sees a cold or partially-constructed engine, and a load
+failure (corrupt file, wrong width) leaves the old engine serving. The
+``generation`` counter increments per successful reload so /v1/models
+exposes which artifact generation is live.
+
+No jax at module import (engine is imported lazily): the registry and
+the HTTP server around it stay importable without touching a backend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _Entry:
+    __slots__ = ("engine", "source", "kwargs", "generation", "loaded_at")
+
+    def __init__(self, engine, source, kwargs):
+        self.engine = engine
+        self.source = source
+        self.kwargs = kwargs
+        self.generation = 1
+        self.loaded_at = time.time()
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+
+    def register(self, name: str, source: Optional[str] = None, *,
+                 model=None, **engine_kwargs):
+        """Load + warm a model under ``name``. ``source`` is a model
+        file or multiclass directory; alternatively pass an in-memory
+        ``model`` (then reload is unavailable). Returns the engine."""
+        from dpsvm_tpu.serving.engine import PredictionEngine
+
+        if (source is None) == (model is None):
+            raise ValueError("register needs exactly one of source= "
+                             "(a path) or model= (an in-memory model)")
+        engine_kwargs.setdefault("name", name)
+        if source is not None:
+            engine = PredictionEngine.load(source, **engine_kwargs)
+        else:
+            engine = PredictionEngine(model, **engine_kwargs)
+        with self._lock:
+            self._entries[name] = _Entry(engine, source, engine_kwargs)
+        return engine
+
+    def engine(self, name: str):
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"no model named {name!r} "
+                           f"(registered: {self.names()})")
+        return entry.engine
+
+    def reload(self, name: str):
+        """Re-load ``name`` from its source path and swap atomically.
+        The old engine serves until the new one is fully warmed."""
+        from dpsvm_tpu.serving.engine import PredictionEngine
+
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"no model named {name!r} "
+                               f"(registered: {list(self._entries)})")
+            source, kwargs = entry.source, entry.kwargs
+        if source is None:
+            raise ValueError(f"model {name!r} was registered in-memory; "
+                             "there is no source path to reload from")
+        fresh = PredictionEngine.load(source, **kwargs)   # may raise —
+        with self._lock:                                  # old stays live
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"model {name!r} was removed mid-reload")
+            entry.engine = fresh
+            entry.generation += 1
+            entry.loaded_at = time.time()
+        return fresh
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def manifests(self) -> Dict[str, dict]:
+        with self._lock:
+            entries = dict(self._entries)
+        out = {}
+        for name, e in entries.items():
+            m = dict(e.engine.manifest)
+            m["generation"] = e.generation
+            m["loaded_at_unix"] = round(e.loaded_at, 3)
+            out[name] = m
+        return out

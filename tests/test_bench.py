@@ -22,9 +22,16 @@ def _run(script: str, extra_env: dict, timeout: int = 240):
     env.pop("PYTHONPATH", None)              # drop the axon site hook
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra_env)
-    return subprocess.run(
-        [sys.executable, os.path.join(REPO, script)],
-        capture_output=True, text=True, env=env, timeout=timeout)
+    for attempt in (0, 1):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, script)],
+            capture_output=True, text=True, env=env, timeout=timeout)
+        if r.returncode >= 0:
+            break
+        # Killed by a signal: the known CPU SIGSEGV flake under the
+        # virtual-device env (8/12 on the pristine baseline) — one
+        # retry, same policy as the burst runner's case isolation.
+    return r
 
 
 def test_bench_iter_throughput_contract(tmp_path):
@@ -121,6 +128,38 @@ def test_burst_runner_records_and_skips(tmp_path):
     assert "SKIP t_budget" in r3.stderr
     assert len([json.loads(l) for l in res.read_text().splitlines()
                 if '"t_budget"' in l]) == 2
+
+
+def test_burst_runner_signal_death_yields_degraded_row(tmp_path):
+    """A case killed by a signal (the CPU SIGSEGV flake) gets one
+    retry; a deterministic crash records a marked-degraded row and the
+    harness CONTINUES — it neither dies nor trips the dead-environment
+    abort."""
+    res = tmp_path / "sweep.jsonl"
+    crash = [sys.executable, "-c",
+             "import os, signal; os.kill(os.getpid(), signal.SIGSEGV)"]
+    ok = [sys.executable, "-c",
+          "import json; print(json.dumps({'metric': 'x', 'value': 1}))"]
+    tags = [
+        {"tag": "t_crash", "file": str(res), "budget": 30, "kind": "sub",
+         "cmd": crash, "env": {}},
+        {"tag": "t_after", "file": str(res), "budget": 30, "kind": "sub",
+         "cmd": ok, "env": {}},
+    ]
+    spec = tmp_path / "tags.json"
+    spec.write_text(json.dumps(tags))
+    r = _run("benchmarks/burst_runner.py",
+             {"BURST_TAGS_JSON": str(spec), "BENCH_PLATFORM": "cpu",
+              "BURST_PENDING": str(tmp_path / "pending.json")},
+             timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stderr[-1500:])
+    assert "RETRY t_crash" in r.stderr
+    recs = [json.loads(l) for l in res.read_text().splitlines()]
+    by_tag = {rec["tag"]: rec for rec in recs}
+    assert by_tag["t_crash"]["rc"] < 0
+    assert by_tag["t_crash"]["degraded"] is True
+    assert by_tag["t_after"]["rc"] == 0           # harness survived
+    assert "degraded" not in by_tag["t_after"]
 
 
 def test_burst_runner_aborts_after_consecutive_dead_errors(tmp_path):
