@@ -40,7 +40,79 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def approx_vs_exact() -> None:
+    """BENCH_CASE=approx-vs-exact: same dataset, same C/gamma, exact
+    dual solve vs approx-rff primal solve (docs/APPROX.md). One JSON
+    row with the wall-clock speedup and the held-out accuracy delta —
+    the number that prices the O(n*D) trade against the O(n^2) paths.
+    Shape knobs: BENCH_N / BENCH_D / BENCH_APPROX_DIM; the approx run
+    writes its run-telemetry trace to $BENCH_TRACE_OUT so the burst
+    runner's archive carries gap/phase/compile provenance for the row
+    (`dpsvm compare` gates it like any other trace)."""
+    n = int(os.environ.get("BENCH_N", 30_000))
+    d = int(os.environ.get("BENCH_D", 64))
+    approx_dim = int(os.environ.get("BENCH_APPROX_DIM", 1024))
+    max_iter = int(os.environ.get("BENCH_MAX_ITER", 400_000))
+    c, gamma = 1.0, 0.25
+
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+    dev = require_devices()[0]
+    enable_compile_cache()
+    log(f"device: {dev} ({dev.platform})")
+
+    from bench_common import standin
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.svm import evaluate
+
+    # One draw, split: the planted generator's cluster geometry is
+    # seed-dependent, so held-out rows must come from the SAME draw.
+    n_test = max(2000, n // 10)
+    xa, ya = standin(n=n + n_test, d=d, gamma=gamma, seed=0)
+    x, y = xa[:n], ya[:n]
+    xt, yt = xa[n:], ya[n:]
+
+    base = dict(c=c, gamma=gamma, epsilon=1e-3, max_iter=max_iter,
+                matmul_precision=os.environ.get("BENCH_PRECISION",
+                                                "default").lower())
+    trace_out = os.environ.get("BENCH_TRACE_OUT") or None
+    approx_cfg = SVMConfig(solver="approx-rff", approx_dim=approx_dim,
+                           trace_out=trace_out, **base)
+    exact_cfg = SVMConfig(**base)
+
+    m_approx, r_approx = fit(x, y, approx_cfg)
+    log(f"approx: {r_approx.n_iter} iters in "
+        f"{r_approx.train_seconds:.2f}s (converged={r_approx.converged})")
+    m_exact, r_exact = fit(x, y, exact_cfg)
+    log(f"exact: {r_exact.n_iter} iters in "
+        f"{r_exact.train_seconds:.2f}s (converged={r_exact.converged})")
+
+    acc_exact = evaluate(m_exact, xt, yt)
+    acc_approx = evaluate(m_approx, xt, yt)
+    speedup = (r_exact.train_seconds / r_approx.train_seconds
+               if r_approx.train_seconds > 0 else 0.0)
+    print(json.dumps({
+        "metric": "approx_vs_exact_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "accuracy_exact": round(acc_exact, 5),
+        "accuracy_approx": round(acc_approx, 5),
+        "accuracy_delta": round(acc_exact - acc_approx, 5),
+        "exact_seconds": round(r_exact.train_seconds, 3),
+        "approx_seconds": round(r_approx.train_seconds, 3),
+        "exact_converged": bool(r_exact.converged),
+        "approx_converged": bool(r_approx.converged),
+        "n": n, "d": d, "approx_dim": approx_dim,
+        "c": c, "gamma": gamma,
+    }), flush=True)
+
+
 def main() -> None:
+    if os.environ.get("BENCH_CASE", "").replace("_", "-") == \
+            "approx-vs-exact":
+        approx_vs_exact()
+        return
     n = int(os.environ.get("BENCH_N", 60_000))
     d = int(os.environ.get("BENCH_D", 784))
     # 6000-iter window: short windows under-read steady state because a

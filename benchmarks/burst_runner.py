@@ -112,6 +112,14 @@ TAGS = [
          **ADULT),
     conv("conv_decomp12288_cap256_shrink", R4, 300, working_set=12288,
          inner_iters=256, shrinking=True, **MNIST),
+    # Approx-vs-exact pricing row (docs/APPROX.md): same dataset, same
+    # C/gamma; the JSON row carries held-out accuracy delta + speedup,
+    # and the approx run's trace lands in traces/approx_vs_exact.jsonl
+    # (BENCH_TRACE_OUT is pinned by run_sub) so `dpsvm compare` can
+    # gate the row like any conv tag.
+    sub("approx_vs_exact", R4, 900, [sys.executable, "bench.py"],
+        BENCH_CASE="approx-vs-exact", BENCH_N=100_000, BENCH_D=64,
+        BENCH_APPROX_DIM=1024, BENCH_PRECISION="DEFAULT"),
     sub("inference", R3, 240,
         [sys.executable, "benchmarks/inference_bench.py"],
         BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),

@@ -54,6 +54,11 @@ def train(x: np.ndarray, y: np.ndarray,
     """
     config = config or SVMConfig()
     config.validate()
+    if config.solver != "exact":
+        raise ValueError(
+            "approx solvers have no dual alpha vector to return — train "
+            "through api.fit (which returns an ApproxSVMModel) or "
+            "approx.fit_approx directly")
     x, y = _check_xy(x, y)
     # Concretize any "auto" solver-path sentinels now that the problem
     # shape is known; every path below sees only concrete values.
@@ -143,7 +148,18 @@ def train(x: np.ndarray, y: np.ndarray,
 
 def fit(x: np.ndarray, y: np.ndarray,
         config: Optional[SVMConfig] = None) -> Tuple[SVMModel, TrainResult]:
-    """train + SV compaction in one call."""
+    """train + SV compaction in one call.
+
+    ``config.solver = "approx-rff" | "approx-nystrom"`` dispatches to
+    the kernel-approximation subsystem (docs/APPROX.md) and returns an
+    ``ApproxSVMModel`` instead — same (model, result) contract, and
+    every downstream consumer (``models/svm.decision_function``,
+    ``models/io``, the serving engine, CV, multiclass) dispatches on
+    the model kind."""
+    config = config or SVMConfig()
+    if config.solver != "exact":
+        from dpsvm_tpu.approx.primal import fit_approx
+        return fit_approx(x, y, config)
     from dpsvm_tpu.utils import densify
 
     x = densify(x)      # from_train_result consumes x too
@@ -166,6 +182,11 @@ def sweep_c(x: np.ndarray, y: np.ndarray, cs,
 
     x, y = _check_xy(x, y)
     config = config or SVMConfig()
+    if config.solver != "exact":
+        raise ValueError("the batched C/gamma sweep is a dual-solver "
+                         "program; approx solvers sweep by refitting "
+                         "(the feature map is shared work, see "
+                         "docs/APPROX.md)")
     results = train_c_sweep(x, y, cs, config, gammas=gammas)
     return [(SVMModel.from_train_result(x, y, r), r) for r in results]
 
@@ -191,6 +212,11 @@ def warm_start(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
 
     config = config or SVMConfig()
     config.validate()
+    if config.solver != "exact":
+        raise ValueError("warm_start continues a DUAL trajectory from "
+                         "alpha; approx solvers have no dual — resume "
+                         "a primal run via checkpoint_path/resume_from "
+                         "instead")
     if config.polish:
         raise ValueError("warm_start IS the refinement mechanism polish "
                          "is built from — call it with "

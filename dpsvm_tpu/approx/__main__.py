@@ -1,0 +1,9 @@
+"""``python -m dpsvm_tpu.approx`` — the kernel-approximation selfcheck
+CI gate (sibling of ``python -m dpsvm_tpu.telemetry``,
+``-m dpsvm_tpu.resilience`` and ``-m dpsvm_tpu.serving``)."""
+
+import sys
+
+from dpsvm_tpu.approx import main
+
+sys.exit(main())

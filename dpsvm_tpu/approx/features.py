@@ -1,0 +1,247 @@
+"""Kernel-approximating feature maps: RFF and Nystrom.
+
+The exact solvers pay O(n^2) kernel work per training run — the dual
+problem touches K one (or q) rows at a time, which caps the "millions
+of rows" north star at tens of thousands. The fast-large-scale-SVM
+recipe (arXiv:2207.01016; GPU primal learning, arXiv:2008.03433) trades
+the dual kernel solve for an EXPLICIT finite-dimensional feature map
+phi with phi(x).phi(z) ~= K(x, z), then solves the linearized problem
+in the primal (approx/primal.py) — one O(n*D) dense matmul pipeline,
+exactly the shape the MXU is built for.
+
+Two maps, both deterministic in (seed, shape) so a persisted model
+rebuilds the identical map at serving time:
+
+* **RFF** (Rahimi-Recht random Fourier features, RBF only): the RBF
+  kernel's spectral measure is N(0, 2*gamma*I), so with W ~ that law,
+  phi(x) = sqrt(2/D) [cos(xW), sin(xW)] gives E[phi(x).phi(z)] =
+  exp(-gamma ||x-z||^2). The cos/sin pairing (rather than random
+  phases) halves the estimator variance and makes ||phi(x)||^2 == 1
+  exactly — which the primal solver exploits for its step size. The
+  map is (d, D/2) float32 of pure seed-derived noise: nothing about
+  the data is stored.
+* **Nystrom** (any vector kernel): m <= D landmark rows subsampled
+  from the training set, K_mm eigendecomposed, phi(x) =
+  K(x, landmarks) @ U diag(lambda^-1/2) (rank-truncated at numerical
+  zero, so the effective dim can come out below approx_dim). Data-
+  adaptive — tighter than RFF at equal D on clustered data — at the
+  cost of persisting the (m, d) landmarks with the model.
+
+Featurization is CHUNKED: X is streamed through one compiled
+fixed-shape block transform (pad-to-chunk, the decision_function
+scheme), so X never needs to sit in memory alongside its full (n, D)
+feature matrix during the transform, and the block program compiles
+exactly once. With ``shards > 1`` the resulting feature matrix is laid
+out row-sharded over the existing 1-D data mesh
+(``parallel/mesh.make_data_mesh``), which makes every downstream
+primal matmul a sharded MXU pass with XLA-inserted reductions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dpsvm_tpu.ops.kernels import KernelSpec
+
+# Rank cutoff for the Nystrom eigenspectrum, relative to the largest
+# eigenvalue: below this a direction is numerical noise and dividing by
+# sqrt(lambda) would amplify it into the features.
+_NYSTROM_RCOND = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureMap:
+    """One built feature map — everything needed to featurize new rows
+    (and to persist / rebuild the map bit-identically)."""
+
+    kind: str                       # "rff" | "nystrom"
+    d: int                          # input width
+    dim: int                        # output feature dim (post-truncation
+                                    # for nystrom; always the built value)
+    seed: int
+    gamma: float
+    kernel: str = "rbf"             # base kernel family (nystrom may use
+                                    # any vector kernel)
+    coef0: float = 0.0
+    degree: int = 3
+    # rff: (d, dim/2) frequency matrix, derived from seed (re-derivable,
+    # but kept so featurize never re-runs the RNG). nystrom: None.
+    omega: Optional[np.ndarray] = None
+    # nystrom only: (m, d) landmark rows and the (m, dim) whitening
+    # projection U diag(lambda^-1/2).
+    landmarks: Optional[np.ndarray] = None
+    proj: Optional[np.ndarray] = None
+
+    @property
+    def kernel_spec(self) -> KernelSpec:
+        return KernelSpec(kind=self.kernel, gamma=float(self.gamma),
+                          coef0=float(self.coef0), degree=int(self.degree))
+
+
+def rff_omega(d: int, dim: int, gamma: float, seed: int) -> np.ndarray:
+    """The (d, dim/2) RFF frequency matrix — N(0, 2*gamma) i.i.d.,
+    deterministic in (d, dim, gamma, seed)."""
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((d, dim // 2))
+            * math.sqrt(2.0 * gamma)).astype(np.float32)
+
+
+def build_feature_map(kind: str, x: np.ndarray, dim: int, seed: int,
+                      spec: KernelSpec) -> FeatureMap:
+    """Build a map for training data ``x`` (rff only reads its width)."""
+    x = np.asarray(x, np.float32)
+    n, d = x.shape
+    if kind == "rff":
+        if spec.kind != "rbf":
+            raise ValueError("rff approximates the RBF kernel only")
+        return FeatureMap(kind="rff", d=d, dim=int(dim), seed=int(seed),
+                          gamma=float(spec.gamma),
+                          omega=rff_omega(d, int(dim), float(spec.gamma),
+                                          int(seed)))
+    if kind != "nystrom":
+        raise ValueError(f"unknown feature map kind {kind!r}")
+    m = min(int(dim), n)
+    rng = np.random.default_rng(seed)
+    idx = np.sort(rng.choice(n, size=m, replace=False))
+    landmarks = np.ascontiguousarray(x[idx])
+    kmm = _host_kernel(landmarks, landmarks, spec).astype(np.float64)
+    # Symmetrize against float noise before eigh; truncate the spectrum
+    # at numerical zero so 1/sqrt(lambda) never amplifies noise.
+    lam, u = np.linalg.eigh((kmm + kmm.T) / 2.0)
+    keep = lam > max(lam[-1], 0.0) * _NYSTROM_RCOND
+    if not keep.any():
+        raise ValueError("nystrom landmark kernel is numerically zero — "
+                         "check gamma / feature scaling")
+    lam, u = lam[keep], u[:, keep]
+    proj = (u / np.sqrt(lam)[None, :]).astype(np.float32)
+    return FeatureMap(kind="nystrom", d=d, dim=int(proj.shape[1]),
+                      seed=int(seed), gamma=float(spec.gamma),
+                      kernel=spec.kind, coef0=float(spec.coef0),
+                      degree=int(spec.degree), landmarks=landmarks,
+                      proj=proj)
+
+
+def _host_kernel(a: np.ndarray, b: np.ndarray,
+                 spec: KernelSpec) -> np.ndarray:
+    """Small dense K(a, b) on the host (landmark-sized only)."""
+    dots = a.astype(np.float64) @ b.astype(np.float64).T
+    if spec.kind == "linear":
+        return dots
+    if spec.kind == "poly":
+        return (spec.gamma * dots + spec.coef0) ** spec.degree
+    if spec.kind == "sigmoid":
+        return np.tanh(spec.gamma * dots + spec.coef0)
+    a2 = np.sum(a.astype(np.float64) ** 2, axis=1)
+    b2 = np.sum(b.astype(np.float64) ** 2, axis=1)
+    return np.exp(-spec.gamma * np.maximum(
+        a2[:, None] - 2.0 * dots + b2[None, :], 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "degree"))
+def _featurize_block_jit(block, omega_or_landmarks, proj, gamma, coef0,
+                         kind: str, degree: int):
+    """One fixed-shape featurization block. rff: proj is unused (pass a
+    dummy); nystrom: omega_or_landmarks holds the landmark rows."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
+
+    if kind == "rff":
+        z = block @ omega_or_landmarks                     # (m, D/2)
+        scale = jnp.float32(math.sqrt(2.0 / (2 * z.shape[1])))
+        return scale * jnp.concatenate([jnp.cos(z), jnp.sin(z)], axis=1)
+    spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
+    b2 = row_norms_sq(block)
+    l2 = row_norms_sq(omega_or_landmarks)
+    k = kernel_rows(block, b2, omega_or_landmarks, l2, spec)   # (m, L)
+    return k @ proj
+
+
+def _block_args(fmap: FeatureMap):
+    import jax.numpy as jnp
+    if fmap.kind == "rff":
+        return (jnp.asarray(fmap.omega), jnp.zeros((1,), jnp.float32),
+                jnp.float32(fmap.gamma), jnp.float32(fmap.coef0))
+    return (jnp.asarray(fmap.landmarks), jnp.asarray(fmap.proj),
+            jnp.float32(fmap.gamma), jnp.float32(fmap.coef0))
+
+
+def featurize_fn(fmap: FeatureMap):
+    """A ``block -> phi_block`` callable over device arrays, suitable
+    for ``observability/compilewatch.instrument`` wrapping (the serving
+    engine's approx decider builds on this)."""
+    args = _block_args(fmap)
+    kind, degree = fmap.kind, int(fmap.degree)
+    # rff's base kernel kind is irrelevant to the block program; the
+    # static `kind` IS the map kind so both maps share one jit site.
+    base = "rff" if kind == "rff" else fmap.kernel
+
+    def run(block):
+        return _featurize_block_jit(block, *args,
+                                    kind=base if kind != "rff" else "rff",
+                                    degree=degree)
+
+    return run
+
+
+def featurize(fmap: FeatureMap, x: np.ndarray,
+              chunk: int = 8192) -> np.ndarray:
+    """phi(x) as host float32, streamed in fixed-shape chunks.
+
+    Pads the tail chunk to the block shape (one compile total) and
+    never materializes more than one (chunk, D) block on device beside
+    the accumulating host output — X never sits next to its full
+    feature matrix on the accelerator.
+    """
+    import jax.numpy as jnp
+
+    x = np.asarray(x, np.float32)
+    n = x.shape[0]
+    run = featurize_fn(fmap)
+    if n <= chunk:
+        return np.asarray(run(jnp.asarray(x)))
+    out = np.empty((n, fmap.dim), np.float32)
+    block = np.zeros((chunk, x.shape[1]), np.float32)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        block[: hi - lo] = x[lo:hi]
+        block[hi - lo:] = 0.0
+        out[lo:hi] = np.asarray(run(jnp.asarray(block)))[: hi - lo]
+    return out
+
+
+def featurize_padded(fmap: FeatureMap, x: np.ndarray, n_pad: int,
+                     chunk: int = 8192) -> np.ndarray:
+    """featurize + zero-pad rows to ``n_pad`` (the primal solver's
+    aligned-minibatch layout; padding rows are masked out of the loss
+    by the row-weight vector, not by their feature values)."""
+    phi = featurize(fmap, x, chunk=chunk)
+    if n_pad == phi.shape[0]:
+        return phi
+    out = np.zeros((n_pad, phi.shape[1]), np.float32)
+    out[: phi.shape[0]] = phi
+    return out
+
+
+def shard_rows(arr: np.ndarray, shards: int):
+    """Place a host array on the 1-D data mesh, sharded along rows
+    (replicated trailing dims) — the layout every primal-solver matmul
+    consumes. Returns a device array; shards == 1 returns a plain
+    single-device put."""
+    import jax
+    import jax.numpy as jnp
+
+    if shards <= 1:
+        return jnp.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dpsvm_tpu.parallel.mesh import SHARD_AXIS, make_data_mesh
+    mesh = make_data_mesh(shards)
+    spec = PartitionSpec(SHARD_AXIS, *([None] * (arr.ndim - 1)))
+    return jax.device_put(np.asarray(arr), NamedSharding(mesh, spec))

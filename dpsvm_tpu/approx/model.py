@@ -1,0 +1,210 @@
+"""Approx-model representation, decision math and persistence.
+
+An approx model has NO support vectors: it is a feature map plus one
+(D,) primal weight vector and an intercept. Its decision keeps the SV
+models' sign convention — ``decision = phi(x).w - b`` — so everything
+downstream that folds intercepts (``serving/engine._with_b``, Platt
+sidecars, ``--no-b``) works unchanged on either model kind.
+
+Persistence is one ``.npz`` (the text SV format has no place for a
+frequency matrix): ``models/io.save_model``/``load_model`` dispatch on
+the zip magic, so every consumer — ``dpsvm test``, the serving engine,
+multiclass directories — round-trips approx models through the same
+entry points as SV models. RFF maps persist only (seed, dims, gamma):
+the frequency matrix is re-derived bit-identically on load. Nystrom
+persists its landmarks and whitening projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpsvm_tpu.approx.features import (FeatureMap, _block_args,
+                                       _featurize_block_jit, rff_omega)
+
+_FORMAT = "dpsvm-approx-v1"
+
+
+@dataclasses.dataclass
+class ApproxSVMModel:
+    """Feature map + primal weights (see module docstring)."""
+
+    fmap: FeatureMap
+    w: np.ndarray                 # (fmap.dim,) f32 feature weights
+    b: float                      # decision = phi.w - b (SV convention)
+    task: str = "svc"             # "svc" | "svr"
+
+    # Duck-typed markers consumed by the dispatch sites (models/svm.py,
+    # serving/engine.py, models/multiclass.py).
+    is_approx: bool = dataclasses.field(default=True, init=False,
+                                        repr=False)
+
+    @property
+    def model_kind(self) -> str:
+        return f"approx-{self.fmap.kind}"
+
+    @property
+    def kernel(self) -> str:
+        return self.fmap.kernel
+
+    @property
+    def gamma(self) -> float:
+        return float(self.fmap.gamma)
+
+    @property
+    def coef0(self) -> float:
+        return float(self.fmap.coef0)
+
+    @property
+    def degree(self) -> int:
+        return int(self.fmap.degree)
+
+    @property
+    def num_attributes(self) -> int:
+        return int(self.fmap.d)
+
+    @property
+    def n_sv(self) -> int:
+        # No SV set exists; 0 keeps n_sv-printing surfaces truthful.
+        return 0
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "degree",
+                                             "include_b"))
+def _approx_decision_jit(block, omega_or_landmarks, proj, gamma, coef0,
+                         w, b, kind: str, degree: int, include_b: bool):
+    """Featurize one fixed-shape block and dot with the weights — ONE
+    program, shared by ``decision_function`` and the serving engine's
+    approx decider, so matched shapes are bitwise-identical between
+    the two (the SV engine's parity property, kept here)."""
+    phi = _featurize_block_jit(block, omega_or_landmarks, proj, gamma,
+                               coef0, kind=kind, degree=degree)
+    dual = phi @ w
+    if include_b:
+        dual = dual - b
+    return dual
+
+
+def _decider_args(model: ApproxSVMModel):
+    fmap = model.fmap
+    kind = "rff" if fmap.kind == "rff" else fmap.kernel
+    return (_block_args(fmap) + (jnp.asarray(model.w),
+                                 jnp.float32(model.b)),
+            dict(kind=kind, degree=int(fmap.degree)))
+
+
+def decision_function(model: ApproxSVMModel, x_test: np.ndarray,
+                      include_b: bool = True,
+                      batch_size: Optional[int] = 8192) -> np.ndarray:
+    """phi(t_i).w [- b], streamed at a fixed block shape."""
+    x_test = np.asarray(x_test, np.float32)
+    if x_test.ndim == 1:
+        x_test = x_test[None, :]
+    if x_test.shape[1] != model.num_attributes:
+        raise ValueError(
+            f"approx evaluation needs {model.num_attributes} "
+            f"attributes, got {x_test.shape[1]}")
+    args, kw = _decider_args(model)
+    m = x_test.shape[0]
+    if batch_size is None or m <= batch_size:
+        return np.asarray(_approx_decision_jit(
+            jnp.asarray(x_test), *args, include_b=include_b, **kw))
+    out = np.empty((m,), np.float32)
+    block = np.zeros((batch_size, x_test.shape[1]), np.float32)
+    for lo in range(0, m, batch_size):
+        hi = min(lo + batch_size, m)
+        block[: hi - lo] = x_test[lo:hi]
+        block[hi - lo:] = 0.0
+        out[lo:hi] = np.asarray(_approx_decision_jit(
+            jnp.asarray(block), *args, include_b=include_b,
+            **kw))[: hi - lo]
+    return out
+
+
+def predict(model: ApproxSVMModel, x_test: np.ndarray,
+            include_b: bool = True) -> np.ndarray:
+    dec = decision_function(model, x_test, include_b=include_b)
+    if model.task == "svr":
+        return dec
+    return np.where(dec < 0, -1, 1).astype(np.int32)
+
+
+def save_approx_model(model: ApproxSVMModel, path: str) -> int:
+    """Write the one-file .npz; returns 0 (no SV lines exist — callers
+    printing the count report the honest zero)."""
+    fmap = model.fmap
+    arrays = dict(
+        format=np.str_(_FORMAT),
+        task=np.str_(model.task),
+        kind=np.str_(fmap.kind),
+        kernel=np.str_(fmap.kernel),
+        w=np.asarray(model.w, np.float32),
+        b=np.float64(model.b),
+        gamma=np.float64(fmap.gamma),
+        coef0=np.float64(fmap.coef0),
+        degree=np.int64(fmap.degree),
+        seed=np.int64(fmap.seed),
+        dim=np.int64(fmap.dim),
+        d=np.int64(fmap.d),
+    )
+    if fmap.kind == "nystrom":
+        arrays["landmarks"] = np.asarray(fmap.landmarks, np.float32)
+        arrays["proj"] = np.asarray(fmap.proj, np.float32)
+    import os
+    import tempfile
+    # tmp + rename: a crash mid-save never leaves a half-written model
+    # (the checkpoint writer's policy).
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return 0
+
+
+def load_approx_model(path: str) -> ApproxSVMModel:
+    with np.load(path, allow_pickle=False) as z:
+        if "format" not in z.files or str(z["format"]) != _FORMAT:
+            raise ValueError(f"{path}: not a dpsvm approx model "
+                             "(missing/unknown format marker)")
+        kind = str(z["kind"])
+        d, dim, seed = int(z["d"]), int(z["dim"]), int(z["seed"])
+        gamma = float(z["gamma"])
+        if kind == "rff":
+            fmap = FeatureMap(kind="rff", d=d, dim=dim, seed=seed,
+                              gamma=gamma,
+                              omega=rff_omega(d, dim, gamma, seed))
+        else:
+            fmap = FeatureMap(kind="nystrom", d=d, dim=dim, seed=seed,
+                              gamma=gamma, kernel=str(z["kernel"]),
+                              coef0=float(z["coef0"]),
+                              degree=int(z["degree"]),
+                              landmarks=np.asarray(z["landmarks"],
+                                                   np.float32),
+                              proj=np.asarray(z["proj"], np.float32))
+        w = np.asarray(z["w"], np.float32)
+        if w.shape != (fmap.dim,):
+            raise ValueError(f"{path}: weight vector {w.shape} does not "
+                             f"match feature dim {fmap.dim}")
+        return ApproxSVMModel(fmap=fmap, w=w, b=float(z["b"]),
+                              task=str(z["task"]))
+
+
+def is_approx_model_file(path: str) -> bool:
+    """Approx models are .npz (zip) files; every text model format
+    (reference / LIBSVM) cannot start with the zip magic."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == b"PK\x03\x04"
+    except OSError:
+        return False

@@ -172,6 +172,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "(repeatable; LIBSVM -wi for any label set): "
                          "each OvO pair trains with C*W on that "
                          "label's examples; unlisted labels weigh 1")
+    tr.add_argument("--solver", default="exact",
+                    choices=["exact", "approx-rff", "approx-nystrom"],
+                    help="'exact' = the dual SMO/decomposition paths "
+                         "(reference parity). 'approx-rff'/'approx-"
+                         "nystrom' = explicit feature map + primal "
+                         "linear solver: O(n*D) matmul work instead of "
+                         "O(n^2) kernel work — the million-row path; "
+                         "the model file is a .npz with no support "
+                         "vectors (docs/APPROX.md)")
+    tr.add_argument("--approx-dim", type=int, default=1024, metavar="D",
+                    help="approx solvers: feature-map dimension "
+                         "(accuracy-vs-cost knob; RFF needs it even)")
+    tr.add_argument("--approx-seed", type=int, default=0,
+                    help="approx solvers: deterministic feature-map "
+                         "seed (persisted with the model)")
     tr.add_argument("--selection", default="first-order",
                     choices=["first-order", "second-order"],
                     help="working-set rule: 'first-order' = reference "
@@ -534,6 +549,25 @@ def cmd_train(args: argparse.Namespace) -> int:
         print("error: --gamma-sweep extends --c-sweep (pass both)",
               file=sys.stderr)
         return 2
+    if args.solver != "exact":
+        # Approx-solver conflicts detectable from args alone (the
+        # config guard table rejects the solver-level ones).
+        for flag, on, hint in (
+                ("--c-sweep", args.c_sweep is not None,
+                 " (the batched sweep is a dual-solver program)"),
+                ("--batched", args.batched,
+                 " (the batched program solves the dual iteration)"),
+                ("--check-kkt", args.check_kkt,
+                 " (KKT/duality-gap reporting is dual-specific; the "
+                 "primal path reports its gradient-norm metric in the "
+                 "run trace)"),
+                ("--model-format libsvm", args.model_format == "libsvm",
+                 " (approx models persist as .npz — no SV lines to "
+                 "write)")):
+            if on:
+                print(f"error: {flag} does not apply to --solver "
+                      f"{args.solver}{hint}", file=sys.stderr)
+                return 2
     if args.c_sweep is not None and not args.cv:
         print("error: --c-sweep requires --cv K (it selects C by "
               "cross-validated accuracy)", file=sys.stderr)
@@ -690,6 +724,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         nu_multiclass = args.multiclass and mode == "--nu-svc"
         conflicts = [("--multiclass",
                       args.multiclass and mode != "--nu-svc"),
+                     # one-class/nu duals live on equality constraints
+                     # the primal squared-hinge objective does not have;
+                     # approx SVC/SVR are the supported primal tasks
+                     (f"--solver {args.solver}",
+                      args.solver != "exact" and mode != "--svr"),
                      # nu-SVC multiclass supports --probability (sigmoid
                      # on training decisions); --probability-cv stays
                      # rejected (its held-out refits are C-SVC)
@@ -748,6 +787,9 @@ def cmd_train(args: argparse.Namespace) -> int:
         weight_pos=args.weight_pos,
         weight_neg=args.weight_neg,
         clip=args.clip or "independent",
+        solver=args.solver,
+        approx_dim=args.approx_dim,
+        approx_seed=args.approx_seed,
     )
     if args.multiclass:
         from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
@@ -869,7 +911,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.svr:
         from dpsvm_tpu.models.svr import evaluate_svr, train_svr
         model, result = train_svr(x, y, config)
-        if model.n_sv == 0:
+        if model.n_sv == 0 and not getattr(model, "is_approx", False):
             print("error: the fitted tube contains every target "
                   f"(svr_epsilon={config.svr_epsilon}) — the model has no "
                   "support vectors and predicts the constant "
@@ -877,7 +919,11 @@ def cmd_train(args: argparse.Namespace) -> int:
             return 1
         n_sv = save_model(model, args.model)
         m = evaluate_svr(model, x, y)
-        print(f"Number of SVs: {n_sv}")
+        if getattr(model, "is_approx", False):
+            print(f"Approx model: {model.model_kind} "
+                  f"dim={model.fmap.dim} (no SV set)")
+        else:
+            print(f"Number of SVs: {n_sv}")
         print(f"b: {result.b:.6f}")
         print(f"Training iterations: {result.n_iter}"
               + ("" if result.converged else " (NOT converged)"))
@@ -890,7 +936,11 @@ def cmd_train(args: argparse.Namespace) -> int:
     n_sv = save_model(model, args.model)
     acc = evaluate(model, x, y)
     # Same closing report the reference prints (svmTrainMain.cpp:313-336).
-    print(f"Number of SVs: {n_sv}")
+    if getattr(model, "is_approx", False):
+        print(f"Approx model: {model.model_kind} dim={model.fmap.dim} "
+              "(no SV set)")
+    else:
+        print(f"Number of SVs: {n_sv}")
     print(f"b: {result.b:.6f}")
     print(f"Training iterations: {result.n_iter}"
           + ("" if result.converged else " (max-iter reached, NOT converged)"))
@@ -1055,6 +1105,7 @@ def cmd_test(args: argparse.Namespace) -> int:
             x = np.pad(x, ((0, 0),
                            (0, model.num_attributes - x.shape[1])))
         elif (x.shape[1] > model.num_attributes
+                and not getattr(model, "is_approx", False)
                 and is_libsvm_model(args.model)):
             if model.kernel == "precomputed":
                 # LIBSVM stores no n_train; serials only bound it from

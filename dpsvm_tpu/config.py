@@ -124,6 +124,28 @@ class SVMConfig:
                                         # required by one-class, where
                                         # the constraint value nu*n is
                                         # part of the model)
+    solver: str = "exact"               # "exact" = the dual SMO /
+                                        # decomposition paths (the paper's
+                                        # solver; everything above applies).
+                                        # "approx-rff" / "approx-nystrom" =
+                                        # explicit feature map + primal
+                                        # linear solver (dpsvm_tpu/approx/):
+                                        # O(n*D) matmul pipeline instead of
+                                        # O(n^2) kernel work — the
+                                        # million-row path (docs/APPROX.md).
+                                        # Approx models have no support
+                                        # vectors; api.fit returns an
+                                        # ApproxSVMModel.
+    approx_dim: int = 1024              # feature-map dimension D (approx
+                                        # solvers only): RFF uses D/2
+                                        # frequency pairs (D must be even);
+                                        # Nystrom uses up to D landmarks
+                                        # (capped by n, rank-truncated)
+    approx_seed: int = 0                # feature-map seed: RFF frequencies
+                                        # / Nystrom landmark subsample are
+                                        # deterministic in (seed, shape) —
+                                        # persisted with the model so
+                                        # serving rebuilds the identical map
     select_impl: str = "argminmax"      # first-order selection lowering:
                                         # "argminmax" (two jnp.arg* +
                                         # gathers, XLA fuses) or "packed"
@@ -360,6 +382,61 @@ class SVMConfig:
                     "gather path")
         if self.kernel == "poly" and self.degree < 1:
             raise ValueError(f"poly degree must be >= 1, got {self.degree}")
+        if self.solver not in ("exact", "approx-rff", "approx-nystrom"):
+            raise ValueError("solver must be 'exact', 'approx-rff' or "
+                             f"'approx-nystrom', got {self.solver!r}")
+        if self.approx_dim < 2:
+            raise ValueError(
+                f"approx_dim must be >= 2, got {self.approx_dim}")
+        if self.solver != "exact":
+            if self.solver == "approx-rff":
+                if self.kernel != "rbf":
+                    raise ValueError(
+                        "approx-rff is the RBF spectral feature map "
+                        "(Rahimi-Recht); for other kernels use "
+                        "approx-nystrom or the exact solver")
+                if self.approx_dim % 2:
+                    raise ValueError(
+                        "approx-rff pairs cos/sin features, so "
+                        f"approx_dim must be even, got {self.approx_dim}")
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "approx solvers evaluate kernels between new rows "
+                    "and landmarks/frequencies; a precomputed K has no "
+                    "row vectors to featurize")
+            # No-silent-ignore (the select_impl/working_set policy): the
+            # primal linear solver has no dual alpha step, so every
+            # dual-path knob below would be silently meaningless.
+            for field, bad, what in (
+                    ("backend", self.backend == "numpy",
+                     "the golden oracle is the dual SMO reference; the "
+                     "primal path has its own convergence test"),
+                    ("selection", self.selection != "first-order",
+                     "there is no working-set selection in the primal "
+                     "solver"),
+                    ("select_impl", self.select_impl != "argminmax",
+                     "there is no extrema selection to lower"),
+                    ("working_set", self.working_set not in (0, 2),
+                     "there is no dual working set; the minibatch size "
+                     "is chosen by the primal solver"),
+                    ("inner_iters", bool(self.inner_iters),
+                     "there is no decomposition subsolve"),
+                    ("grow_working_set", self.grow_working_set,
+                     "there is no working set to grow"),
+                    ("shrinking", self.shrinking is True,
+                     "there is no active set; every row rides the "
+                     "feature matmul"),
+                    ("cache_size", self.cache_size > 0,
+                     "there are no kernel rows to cache"),
+                    ("use_pallas", self.use_pallas == "on",
+                     "the Pallas kernels implement the dual iteration"),
+                    ("polish", self.polish,
+                     "the two-phase precision schedule refines a dual "
+                     "trajectory; set matmul_precision directly")):
+                if bad:
+                    raise ValueError(
+                        f"solver={self.solver!r} does not support "
+                        f"{field}: {what}")
         if self.selection not in ("first-order", "second-order"):
             raise ValueError(f"selection must be 'first-order' or "
                              f"'second-order', got {self.selection!r}")

@@ -68,7 +68,9 @@ class _ParamsMixin:
                     max_iter=self.max_iter, selection=self.selection,
                     shards=self.shards, working_set=self.working_set,
                     shrinking=self.shrinking,
-                    matmul_precision=self.matmul_precision)
+                    matmul_precision=self.matmul_precision,
+                    solver=self.solver, approx_dim=self.approx_dim,
+                    approx_seed=self.approx_seed)
 
 
 class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
@@ -93,7 +95,9 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                  polish: bool = False,
                  probability: "Union[bool, str]" = False,
                  batched: bool = False,
-                 class_weight: "Optional[dict]" = None):
+                 class_weight: "Optional[dict]" = None,
+                 solver: str = "exact", approx_dim: int = 1024,
+                 approx_seed: int = 0):
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -117,11 +121,18 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
         # weight_neg/weight_pos; multiclass passes per-label weights
         # through to every OvO pair (sequential path only).
         self.class_weight = class_weight
+        # Kernel-approximation path (docs/APPROX.md): "approx-rff" /
+        # "approx-nystrom" fit a primal linear model over an explicit
+        # feature map — no SV set, so n_support_ is None after fit.
+        self.solver = solver
+        self.approx_dim = approx_dim
+        self.approx_seed = approx_seed
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "tol",
                     "max_iter", "selection", "shards", "matmul_precision",
                     "working_set", "shrinking", "polish", "probability",
-                    "batched", "class_weight")
+                    "batched", "class_weight", "solver", "approx_dim",
+                    "approx_seed")
     _FITTED_ATTR = "classes_"
 
     def _config(self) -> SVMConfig:
@@ -169,8 +180,9 @@ class DPSVMClassifier(_ParamsMixin, *_CLF_BASES):
                 n_iter_=result.n_iter,
                 converged_=result.converged,
                 intercept_=np.array([-result.b]),
-                n_support_=np.array([int(np.sum(model.y_sv < 0)),
-                                     int(np.sum(model.y_sv > 0))]))
+                n_support_=(None if getattr(model, "is_approx", False)
+                            else np.array([int(np.sum(model.y_sv < 0)),
+                                           int(np.sum(model.y_sv > 0))])))
             if self.probability:
                 from dpsvm_tpu.models.calibration import (fit_platt,
                                                           fit_platt_cv)
@@ -254,7 +266,9 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
                  tol: float = 1e-3, max_iter: int = 150_000,
                  selection: str = "first-order", shards: int = 1,
                  matmul_precision: str = "highest",
-                 working_set: int = 2, shrinking: bool = False):
+                 working_set: int = 2, shrinking: bool = False,
+                 solver: str = "exact", approx_dim: int = 1024,
+                 approx_seed: int = 0):
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -268,10 +282,14 @@ class DPSVMRegressor(_ParamsMixin, *_REG_BASES):
         self.matmul_precision = matmul_precision
         self.working_set = working_set
         self.shrinking = shrinking
+        self.solver = solver
+        self.approx_dim = approx_dim
+        self.approx_seed = approx_seed
 
     _PARAM_NAMES = ("C", "kernel", "degree", "gamma", "coef0", "epsilon",
                     "tol", "max_iter", "selection", "shards",
-                    "matmul_precision", "working_set", "shrinking")
+                    "matmul_precision", "working_set", "shrinking",
+                    "solver", "approx_dim", "approx_seed")
 
     def _config(self) -> SVMConfig:
         return SVMConfig(svr_epsilon=self.epsilon,

@@ -39,7 +39,15 @@ from dpsvm_tpu.native import load_native_lib
 
 
 def save_model(model: SVMModel, path: str) -> int:
-    """Write the model file; returns the number of SV lines written."""
+    """Write the model file; returns the number of SV lines written.
+
+    Approx models (``dpsvm_tpu/approx``) have no SV lines — they
+    persist as one ``.npz`` (feature-map spec + primal weights) behind
+    this same entry point, so every caller round-trips either model
+    kind without knowing which it holds."""
+    if getattr(model, "is_approx", False):
+        from dpsvm_tpu.approx.model import save_approx_model
+        return save_approx_model(model, path)
     alpha = np.ascontiguousarray(model.alpha, np.float32)
     y = np.ascontiguousarray(model.y_sv, np.int32)
     x = np.ascontiguousarray(model.x_sv, np.float32)
@@ -155,6 +163,14 @@ def load_model(path: str, n_features=None) -> SVMModel:
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+    # Approx models are .npz archives — dispatch on the zip magic
+    # BEFORE any text sniffing (reading a binary file as text would
+    # produce a garbage error, not a model). Checked inline so the
+    # jax-importing approx package only loads for actual approx files.
+    with open(path, "rb") as f:
+        if f.read(4) == b"PK\x03\x04":
+            from dpsvm_tpu.approx.model import load_approx_model
+            return load_approx_model(path)
     if is_libsvm_model(path):
         from dpsvm_tpu.models.libsvm_io import load_libsvm_model
         return load_libsvm_model(path, n_features=n_features)

@@ -150,7 +150,16 @@ def _decision_jit(x_test, x_sv, coef, sv2, b, gamma, coef0,
 def decision_function(model: SVMModel, x_test: np.ndarray,
                       include_b: bool = True,
                       batch_size: Optional[int] = 8192) -> np.ndarray:
-    """dual_i = sum_j alpha_j y_j K(x_j, t_i) [- b], batched on the MXU."""
+    """dual_i = sum_j alpha_j y_j K(x_j, t_i) [- b], batched on the MXU.
+
+    Approx models (``dpsvm_tpu/approx``) dispatch to their
+    featurize-and-dot program here, so every consumer written against
+    this signature — CV, multiclass, ``dpsvm test``, calibration —
+    evaluates either model kind through the one entry point."""
+    if getattr(model, "is_approx", False):
+        from dpsvm_tpu.approx.model import decision_function as _approx
+        return _approx(model, x_test, include_b=include_b,
+                       batch_size=batch_size)
     x_test = np.asarray(x_test, np.float32)
     if model.kernel == "precomputed":
         # x_test is K(test, train): the decision is a column gather of

@@ -53,6 +53,11 @@ def train_svr(x: np.ndarray, y: np.ndarray,
     from dpsvm_tpu.utils import densify
     x = densify(x)
     config = config or SVMConfig()
+    if config.solver != "exact":
+        # Approx SVR solves the epsilon-insensitive loss directly in
+        # the primal — no 2n dual stacking (docs/APPROX.md).
+        from dpsvm_tpu.approx.primal import fit_approx
+        return fit_approx(x, y, config, task="svr")
     precomp = config.kernel == "precomputed"
     config.validate()
     if config.weight_pos != 1.0 or config.weight_neg != 1.0:

@@ -68,6 +68,7 @@ SOLVER_NAMES = {
     "DecompCarry": "decomp",
     "DistDecompCarry": "dist-decomp",
     "FusedCarry": "fused-pallas",
+    "PrimalCarry": "approx-primal",
 }
 
 

@@ -89,7 +89,11 @@ def compact_model(model: SVMModel) -> Tuple[SVMModel, int]:
     them, but imported LIBSVM files and hand-assembled models can carry
     them. Returns (model, n_dropped); the model is returned unchanged
     (same object) when there is nothing to drop, so the common path
-    keeps bitwise parity with ``decision_function`` trivially."""
+    keeps bitwise parity with ``decision_function`` trivially.
+
+    Approx models have no SV set to compact — returned unchanged."""
+    if getattr(model, "is_approx", False):
+        return model, 0
     alpha = np.asarray(model.alpha)
     keep = alpha != 0
     dropped = int(keep.size - np.count_nonzero(keep))
@@ -181,7 +185,9 @@ class PredictionEngine:
             ms = self.model.models
             specs = {(m.kernel, float(m.gamma), float(m.coef0),
                       int(m.degree)) for m in ms}
-            if len(specs) == 1 and ms[0].kernel != "precomputed":
+            if (len(specs) == 1 and ms[0].kernel != "precomputed"
+                    and not any(getattr(m, "is_approx", False)
+                                for m in ms)):
                 self._build_mc_batched()
             else:
                 # mixed kernel specs (hand-assembled directory) — one
@@ -196,6 +202,28 @@ class PredictionEngine:
     def _make_binary_decider(self, model: SVMModel, pair: Optional[int]):
         tag = f"serve[{self.name}]" + (f"-pair{pair}" if pair is not None
                                        else "")
+        if getattr(model, "is_approx", False):
+            # EXPLICIT model-kind dispatch: an approx model has no SV
+            # buffers — falling through to the SV path would crash on
+            # model.x_sv (or worse, serve garbage). The decider is the
+            # featurize-and-dot program ``approx/model.py`` evaluates
+            # with, so matched shapes stay bitwise-identical to
+            # ``decision_function``, like the SV path.
+            import jax.numpy as jnp
+
+            from dpsvm_tpu.approx.model import (_approx_decision_jit,
+                                                _decider_args)
+            args, kw = _decider_args(model)
+            run = compilewatch.instrument(_approx_decision_jit,
+                                          f"{tag}-approx-decision")
+            include_b = self.include_b
+
+            def decide(block: np.ndarray) -> np.ndarray:
+                return np.asarray(run(jnp.asarray(block), *args,
+                                      include_b=include_b, **kw))
+
+            return decide
+
         if model.kernel == "precomputed":
             coef = (np.asarray(model.alpha, np.float32)
                     * np.asarray(model.y_sv, np.float32))
@@ -305,6 +333,15 @@ class PredictionEngine:
         return self.platt is not None
 
     @property
+    def model_kind(self) -> str:
+        """Which decision machinery serves this model: "sv" (device SV
+        buffers), "approx-rff"/"approx-nystrom" (featurize + dot, no SV
+        buffers), or "multiclass" (per-pair kinds in the manifest)."""
+        if self.multiclass:
+            return "multiclass"
+        return getattr(self.model, "model_kind", "sv")
+
+    @property
     def manifest(self) -> dict:
         """Everything an operator (or /v1/models) needs to know about
         the loaded model — including the compile-warmup receipt and the
@@ -312,6 +349,7 @@ class PredictionEngine:
         out = {
             "name": self.name,
             "task": self.task,
+            "model_kind": self.model_kind,
             "source": self.source,
             "num_attributes": self.num_attributes,
             "n_sv": self.n_sv,
@@ -327,8 +365,14 @@ class PredictionEngine:
         if self.multiclass:
             out["classes"] = [int(c) for c in self.model.classes]
             out["n_pairs"] = len(self.model.models)
+            out["pair_kinds"] = sorted(
+                {getattr(m, "model_kind", "sv")
+                 for m in self.model.models})
         else:
             out["kernel"] = self.model.kernel
+            if self.model_kind.startswith("approx"):
+                out["approx_dim"] = int(self.model.fmap.dim)
+                out["approx_seed"] = int(self.model.fmap.seed)
         return out
 
     def bucket_counts(self) -> Dict[int, int]:
