@@ -431,6 +431,36 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--port-file", default=None, metavar="PATH",
                     help="write the bound port here once listening "
                          "(for harnesses that pass --port 0)")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas per model: a wedged or "
+                         "NaN-poisoned replica is ejected (circuit "
+                         "breaker) and rebuilt in the background while "
+                         "the rest keep serving (docs/SERVING.md "
+                         "Resilience)")
+    sv.add_argument("--deadline-ms", type=float, default=30000.0,
+                    help="server-wide request deadline budget; a "
+                         "blown budget answers 504 + Retry-After. "
+                         "Clients may ask for LESS via timeout_ms / "
+                         "X-Deadline-Ms")
+    sv.add_argument("--hedge-ms", default="off", metavar="MS|auto|off",
+                    help="re-dispatch a still-unanswered request to a "
+                         "second replica after this delay ('auto' = "
+                         "p99-based); needs --replicas >= 2")
+    sv.add_argument("--no-degrade", dest="degrade",
+                    action="store_false", default=True,
+                    help="disable the overload shed ladder "
+                         "(proba->decision, then the sibling model) — "
+                         "queue-full 429 only")
+    sv.add_argument("--degrade-to", action="append", default=[],
+                    metavar="NAME=SIBLING",
+                    help="tier-2 shed target: under deep overload "
+                         "NAME's requests are served by SIBLING (a "
+                         "registered, width-compatible model — e.g. "
+                         "an approx twin); repeatable")
+    sv.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a serving trace (JSONL): manifest, "
+                         "eject/rebuild/shed/hedge events, summary at "
+                         "drain")
     sv.add_argument("-q", "--quiet", action="store_true")
     _add_backend_flags(sv)
 
@@ -468,6 +498,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the batch-1 single-worker baseline pass "
                          "(halves runtime; drops the coalesce_speedup "
                          "fields from the row)")
+    lg.add_argument("--chaos", action="store_true",
+                    help="chaos-drill report: arm DPSVM_FAULT_SERVE_* "
+                         "on the serve process, run this, and the row "
+                         "carries availability of accepted requests + "
+                         "the /metricsz robustness-counter deltas "
+                         "(ejections, rebuilds, hedges, sheds)")
+    lg.add_argument("--saturate", action="store_true",
+                    help="drive-to-saturation instead: step open-loop "
+                         "RPS by --rps-factor until p99 exceeds "
+                         "--p99-target-ms and print ONE SLO row (max "
+                         "sustained throughput at p99 < target + "
+                         "availability)")
+    lg.add_argument("--p99-target-ms", type=float, default=50.0)
+    lg.add_argument("--start-rps", type=float, default=25.0)
+    lg.add_argument("--rps-factor", type=float, default=2.0)
+    lg.add_argument("--max-steps", type=int, default=8)
+    lg.add_argument("--step-requests", type=int, default=100,
+                    help="requests per saturation step")
     return root
 
 
@@ -1218,6 +1266,29 @@ def cmd_serve(args: argparse.Namespace) -> int:
         print("error: --max-batch and --max-queue must be >= 1",
               file=sys.stderr)
         return 2
+    if args.replicas < 1:
+        print("error: --replicas must be >= 1", file=sys.stderr)
+        return 2
+    if not (args.deadline_ms > 0):
+        print("error: --deadline-ms must be > 0", file=sys.stderr)
+        return 2
+    # --hedge-ms: "off", "auto" (p99-based), or a fixed delay in ms
+    hedge = args.hedge_ms
+    if hedge not in ("off", "auto"):
+        try:
+            hedge = float(hedge) / 1000.0
+        except ValueError:
+            print(f"error: --hedge-ms must be a number, 'auto' or "
+                  f"'off', got {args.hedge_ms!r}", file=sys.stderr)
+            return 2
+    siblings = {}
+    for spec in args.degrade_to:
+        name, sep, sib = spec.partition("=")
+        if not sep or not name or not sib:
+            print(f"error: --degrade-to needs NAME=SIBLING, got "
+                  f"{spec!r}", file=sys.stderr)
+            return 2
+        siblings[name] = sib
     registry = ModelRegistry()
     for i, spec in enumerate(args.model):
         name, sep, path = spec.partition("=")
@@ -1242,11 +1313,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
                   f"buckets={m['buckets']} "
                   f"warmup_compiles={m['warmup_compiles']} "
                   f"({m['warmup_compile_seconds']}s)", file=sys.stderr)
-    srv = ServingServer(registry, args.host, args.port,
-                        max_batch=args.max_batch,
-                        max_delay_ms=args.max_delay_ms,
-                        max_queue=args.max_queue,
-                        verbose=not args.quiet).start()
+    unknown = [s for pair in siblings.items() for s in pair
+               if s not in registry.names()]
+    if unknown:
+        print(f"error: --degrade-to names unregistered model(s) "
+              f"{sorted(set(unknown))} (loaded: {registry.names()})",
+              file=sys.stderr)
+        return 2
+    try:
+        srv = ServingServer(registry, args.host, args.port,
+                            max_batch=args.max_batch,
+                            max_delay_ms=args.max_delay_ms,
+                            max_queue=args.max_queue,
+                            predict_timeout=args.deadline_ms / 1000.0,
+                            replicas=args.replicas, hedge=hedge,
+                            degrade=args.degrade, siblings=siblings,
+                            trace_out=args.trace_out,
+                            verbose=not args.quiet).start()
+    except ValueError as e:                 # width-mismatched sibling
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     if args.port_file:
         with open(args.port_file, "w") as f:
             f.write(str(srv.port))
@@ -1270,7 +1356,7 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
     import numpy as np
 
     from dpsvm_tpu.serving.loadgen import (fetch_manifest, loadgen_row,
-                                           synthetic_rows)
+                                           run_saturate, synthetic_rows)
 
     want = tuple(w for w in args.want.split(",") if w)
     try:
@@ -1290,12 +1376,30 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
             return 2
     else:
         rows = synthetic_rows(manifest["num_attributes"])
+    if args.saturate:
+        row = run_saturate(args.url, rows, model=args.model,
+                           p99_target_ms=args.p99_target_ms,
+                           start_rps=args.start_rps,
+                           rps_factor=args.rps_factor,
+                           max_steps=args.max_steps,
+                           step_requests=args.step_requests,
+                           batch=args.batch,
+                           concurrency=args.concurrency, want=want,
+                           timeout=args.timeout)
+        print(json.dumps(row), flush=True)
+        return 0 if row["slo_met"] else 1
     row = loadgen_row(args.url, rows, model=args.model,
                       requests=args.requests, batch=args.batch,
                       concurrency=args.concurrency, mode=args.mode,
                       rps=args.rps, want=want, timeout=args.timeout,
+                      chaos=args.chaos,
                       compare_sequential=args.compare_sequential)
     print(json.dumps(row), flush=True)
+    if args.chaos:
+        # a chaos drill EXPECTS some failures; the verdict is the
+        # availability of accepted requests (the acceptance bar)
+        avail = row.get("availability_pct")
+        return 0 if (avail is not None and avail >= 99.0) else 1
     return 0 if row["errors"] == 0 else 1
 
 

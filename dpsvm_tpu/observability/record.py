@@ -71,6 +71,43 @@ SOLVER_NAMES = {
     "PrimalCarry": "approx-primal",
 }
 
+# Event types the resilient serving layer emits into a serving trace
+# (docs/SERVING.md "Resilience", docs/ROBUSTNESS.md "Self-healing
+# serving"): replica circuit-breaker transitions (`eject`/`rebuild`),
+# overload shedding tier activations (`shed`), duplicate dispatches
+# (`hedge`), and the model-lifecycle loop (`drift` detected ->
+# `retrain` finished -> `promote` with ok=True on hot-swap / ok=False
+# when the eval gate kept the old generation). The schema treats event
+# names as free strings; this table is the documented vocabulary so
+# consumers (report rendering, tests) have one source of truth.
+SERVING_EVENTS = ("eject", "rebuild", "shed", "hedge", "drift",
+                  "retrain", "promote")
+
+
+def open_serving_trace(path: str, *, models: Optional[dict] = None,
+                       env: Optional[dict] = None) -> "RunTrace":
+    """A RunTrace for a SERVING process: manifest solver="serving",
+    no chunk records — just the manifest, `SERVING_EVENTS` markers as
+    they happen, and a close_serving_trace() summary at drain. The
+    artifact validates under the ordinary v2 schema, so `dpsvm report`
+    and the trace tooling consume it unchanged."""
+    return RunTrace(path, solver="serving",
+                    config={"models": dict(models or {})}, env=env)
+
+
+def close_serving_trace(tr: "RunTrace", *, requests: int = 0,
+                        errors: int = 0, seconds: float = 0.0,
+                        **extra) -> None:
+    """Stamp the zero-filled solver summary a serving trace ends with
+    (the solver fields are schema-required; a serving process has no
+    duals, so they read as zeros) plus the serving counters."""
+    if tr.closed:
+        return
+    tr.summary(converged=True, n_iter=0, b=0.0, b_lo=0.0, b_hi=0.0,
+               n_sv=0, train_seconds=float(seconds),
+               requests=int(requests), errors=int(errors), **extra)
+    tr.close()
+
 
 def _config_dict(config) -> dict:
     if config is None:

@@ -23,6 +23,25 @@ aliases so benchmark harness configs stay in the BENCH_ namespace; API:
   a simulated preemption (exercises snapshot + resumable exit + retry
   supervisor without OS signal timing races).
 
+Serving-side knobs (``DPSVM_FAULT_SERVE_*``, consumed by
+``serving/pool.py`` / ``serving/registry.py`` — docs/SERVING.md
+"Resilience"):
+
+* ``DPSVM_FAULT_SERVE_WEDGE_REPLICA=k`` — replica **#k** (1-based)
+  wedges: its worker blocks forever at the next compute (release with
+  ``release_serve_wedge()`` in in-process tests; a chaos subprocess
+  just abandons the daemon thread). Combine with
+  ``DPSVM_FAULT_SERVE_WEDGE_AFTER=m`` to delay the wedge until the
+  pool has served ``m`` computes (fault mid-loadgen, after warmup);
+* ``DPSVM_FAULT_SERVE_NAN_AFTER=m`` — the replica that serves the
+  m-th pool compute becomes NaN-poisoned: every output it produces
+  from then on is non-finite, until the pool rebuilds it (the poison
+  is pinned to the replica *generation*, so the rebuilt replica is
+  clean — the transient device-buffer-corruption model);
+* ``DPSVM_FAULT_SERVE_FAIL_RELOAD=j`` — the j-th (1-based) engine
+  reload/rebuild in this process fails (exercises
+  failed-reload-keeps-serving and the rebuild retry loop).
+
 Each fault fires exactly ONCE per process: counters live on the
 process-global plan, so a supervisor retry inside the same process (or
 a resumed attempt) runs clean after the injected failure — which is
@@ -34,12 +53,18 @@ from __future__ import annotations
 import dataclasses
 import os
 import sys
-from typing import Optional
+import threading
+from typing import Optional, Tuple
 
 
 class InjectedFaultError(OSError):
     """Raised by the checkpoint-write injection point (an OSError, like
     the real failures it stands in for)."""
+
+
+#: serve hooks are hit from concurrent replica workers (the training
+#: hooks are single-threaded and stay lock-free)
+_SERVE_LOCK = threading.Lock()
 
 
 def _log(msg: str) -> None:
@@ -51,15 +76,27 @@ class FaultPlan:
     fail_checkpoint_write: int = 0   # 1-based save counter; 0 = off
     nan_at_iter: int = 0             # poison first poll with n_iter >= j
     preempt_at_poll: int = 0         # 1-based host-poll counter
+    # serving-side (docstring above): replica NUMBERS are 1-based,
+    # matching the other knobs' "the k-th" convention; 0 = off.
+    serve_wedge_replica: int = 0     # replica #k wedges at a compute
+    serve_wedge_after: int = 0       # ...once pool computes >= m
+    serve_nan_after: int = 0         # poison the replica serving
+    #                                  compute #m until it is rebuilt
+    serve_fail_reload: int = 0       # 1-based reload/rebuild counter
 
     # process-lifetime counters (fire-once semantics)
     _writes: int = 0
     _polls: int = 0
     _nan_fired: bool = False
+    _serve_computes: int = 0
+    _serve_reloads: int = 0
+    _wedge_fired: bool = False
+    _poisoned: Optional[Tuple[int, int]] = None  # (replica, generation)
 
     def any(self) -> bool:
         return bool(self.fail_checkpoint_write or self.nan_at_iter
-                    or self.preempt_at_poll)
+                    or self.preempt_at_poll or self.serve_wedge_replica
+                    or self.serve_nan_after or self.serve_fail_reload)
 
     def note_checkpoint_write(self, path: str) -> None:
         self._writes += 1
@@ -88,6 +125,53 @@ class FaultPlan:
             return st._replace(b_lo=float("nan"))
         return st
 
+    # -- serving-side injection points (serving/pool.py). Unlike the
+    # single-threaded training hooks, these are hit from concurrent
+    # replica workers — counters advance under the module serve lock.
+
+    def note_serve_compute(self, replica_idx: int,
+                           generation: int) -> bool:
+        """Called by a replica worker as a compute begins. Returns True
+        exactly when THIS compute should wedge (the worker then blocks
+        on the module wedge event). Also arms the NaN poison: the
+        replica serving the m-th pool compute becomes poisoned for its
+        current generation."""
+        with _SERVE_LOCK:
+            self._serve_computes += 1
+            if (self.serve_wedge_replica and not self._wedge_fired
+                    and replica_idx == self.serve_wedge_replica - 1
+                    and self._serve_computes >= self.serve_wedge_after):
+                self._wedge_fired = True
+                _log(f"wedging replica #{self.serve_wedge_replica} at "
+                     f"pool compute #{self._serve_computes}")
+                return True
+            if (self.serve_nan_after and self._poisoned is None
+                    and self._serve_computes >= self.serve_nan_after):
+                self._poisoned = (int(replica_idx), int(generation))
+                _log(f"NaN-poisoning replica {replica_idx} "
+                     f"(generation {generation}) from pool compute "
+                     f"#{self._serve_computes}")
+            return False
+
+    def serve_poisoned(self, replica_idx: int, generation: int) -> bool:
+        """True while (replica, generation) is the poisoned one — a
+        rebuilt replica (new generation) runs clean, which is the
+        transient corrupted-buffer model."""
+        with _SERVE_LOCK:
+            return self._poisoned == (int(replica_idx), int(generation))
+
+    def note_serve_reload(self) -> None:
+        """Reload/rebuild injection point (registry.reload + pool
+        rebuild). The j-th call in this process fails."""
+        with _SERVE_LOCK:
+            self._serve_reloads += 1
+            fire = (self.serve_fail_reload
+                    and self._serve_reloads == self.serve_fail_reload)
+            n = self._serve_reloads
+        if fire:
+            _log(f"failing serve reload #{n}")
+            raise InjectedFaultError(f"injected reload failure #{n}")
+
 
 _plan: Optional[FaultPlan] = None
 _env_checked = False
@@ -108,7 +192,11 @@ def plan_from_env() -> Optional[FaultPlan]:
     p = FaultPlan(
         fail_checkpoint_write=_env_int("CHECKPOINT_WRITE"),
         nan_at_iter=_env_int("NAN_ITER"),
-        preempt_at_poll=_env_int("PREEMPT_POLL"))
+        preempt_at_poll=_env_int("PREEMPT_POLL"),
+        serve_wedge_replica=_env_int("SERVE_WEDGE_REPLICA"),
+        serve_wedge_after=_env_int("SERVE_WEDGE_AFTER"),
+        serve_nan_after=_env_int("SERVE_NAN_AFTER"),
+        serve_fail_reload=_env_int("SERVE_FAIL_RELOAD"))
     return p if p.any() else None
 
 
@@ -145,3 +233,31 @@ def on_checkpoint_write(path: str) -> None:
     p = current()
     if p is not None:
         p.note_checkpoint_write(path)
+
+
+# Wedged replica workers block here. In-process tests release them at
+# teardown; a chaos subprocess just exits around the daemon thread.
+_WEDGE_EVENT = threading.Event()
+
+
+def serve_wedge_wait(timeout: Optional[float] = None) -> None:
+    """Block the calling replica worker until released (the wedge)."""
+    _WEDGE_EVENT.wait(timeout)
+
+
+def release_serve_wedge() -> None:
+    """Unstick every wedged worker (test teardown)."""
+    _WEDGE_EVENT.set()
+
+
+def reset_serve_wedge() -> None:
+    """Re-arm the wedge barrier (paired with ``clear()`` in tests)."""
+    global _WEDGE_EVENT
+    _WEDGE_EVENT = threading.Event()
+
+
+def on_serve_reload() -> None:
+    """registry.reload / pool-rebuild injection point."""
+    p = current()
+    if p is not None:
+        p.note_serve_reload()

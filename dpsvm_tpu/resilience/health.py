@@ -38,6 +38,8 @@ forever); ``"ignore"`` records a trace event and keeps going.
 from __future__ import annotations
 
 import math
+import threading
+from collections import deque
 from typing import Optional
 
 POLICIES = ("raise", "rollback", "ignore")
@@ -125,3 +127,66 @@ class HealthMonitor:
                 f"{int(n_iter) - self._best_iter} iterations "
                 f"(window {self.window})")
         return None
+
+
+class ReplicaMonitor:
+    """Serving-side health: the HealthMonitor's window shape applied
+    to a prediction replica's two observable vitals (serving/pool.py).
+
+    * **non-finite outputs** — like the training-side NaN-gap guard,
+      always armed and never legitimate: the HTTP layer rejects
+      non-finite *inputs* at admission and model parameters are
+      finite, so a NaN/inf decision value means corrupted replica
+      state (a poisoned device buffer). One occurrence is grounds for
+      ejection.
+    * **latency** — a rolling window of per-dispatch wall times. A
+      dispatch that blows the pool deadline while *running* on the
+      replica marks it wedged (the pool decides that; the monitor
+      records it). The window also feeds the p99-based hedge delay
+      (serving/budget.hedge_delay_s).
+
+    Thread-safe: workers record, the reaper and /metricsz read."""
+
+    def __init__(self, window: int = 256):
+        self._lat_ms: deque = deque(maxlen=int(window))
+        self._lock = threading.Lock()
+        self._dispatches = 0
+        self._nonfinite = 0
+        self._timeouts = 0
+
+    def note_latency(self, ms: float) -> None:
+        with self._lock:
+            self._dispatches += 1
+            self._lat_ms.append(float(ms))
+
+    def note_nonfinite(self) -> None:
+        """One compute returned non-finite values — never legitimate
+        (see class docstring); the pool ejects on the first report."""
+        with self._lock:
+            self._nonfinite += 1
+
+    def note_timeout(self) -> None:
+        with self._lock:
+            self._timeouts += 1
+
+    @property
+    def nonfinite(self) -> int:
+        with self._lock:
+            return self._nonfinite
+
+    def latencies_ms(self) -> "list[float]":
+        with self._lock:
+            return list(self._lat_ms)
+
+    def stats(self) -> dict:
+        with self._lock:
+            lat = list(self._lat_ms)
+            out = {"dispatches": self._dispatches,
+                   "nonfinite": self._nonfinite,
+                   "timeouts": self._timeouts}
+        if lat:
+            s = sorted(lat)
+            out["p50_ms"] = round(s[len(s) // 2], 3)
+            out["p99_ms"] = round(s[min(len(s) - 1,
+                                        int(len(s) * 0.99))], 3)
+        return out

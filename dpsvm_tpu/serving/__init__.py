@@ -16,22 +16,37 @@ until this package the repo could only do one-shot batch eval
                  reject under overload).
 * ``registry`` — named multi-model registry with explicit, atomic hot
                  reload.
+* ``pool``     — ``ReplicaPool``: N failure-isolated engine replicas
+                 with per-replica circuit breakers (wedge/NaN eject ->
+                 background rebuild -> half-open probe -> close) and
+                 optional hedged re-dispatch (docs/SERVING.md
+                 "Resilience").
+* ``budget``   — per-request deadline budgets (blown budget = 504,
+                 never a 400), the p99-based hedge delay, and the
+                 tiered overload-degradation controller.
+* ``lifecycle``— the self-healing model loop: KS drift detection on
+                 the live score window -> supervised retrain -> eval
+                 gate (accuracy floor + ``dpsvm compare``) -> atomic
+                 hot-swap (docs/ROBUSTNESS.md).
 * ``server``   — stdlib ``ThreadingHTTPServer``: ``POST /v1/predict``,
                  ``GET /healthz`` / ``/metricsz`` / ``/v1/models``,
                  ``POST /v1/reload``; SIGTERM graceful drain via the
                  ``resilience/preempt`` deferred-signal trap.
 * ``loadgen``  — open/closed-loop generator printing one bench-harness
                  JSON row (throughput + p50/p95/p99 + the sequential
-                 batch-1 baseline and coalescing speedup).
+                 batch-1 baseline and coalescing speedup); ``--chaos``
+                 fault-drill reporting and ``--saturate`` SLO probing.
 
 CLI: ``dpsvm serve`` / ``dpsvm loadgen`` (``dpsvm_tpu/cli.py``).
 
 CI gate: ``python -m dpsvm_tpu.serving --selfcheck`` — builds a model,
-loads it through the engine, and asserts the two properties the whole
+loads it through the engine, and asserts the properties the whole
 design rests on: ZERO compile events across mixed-size post-warmup
-traffic (via ``observability/compilewatch``), and bitwise-identical
+traffic (via ``observability/compilewatch``), bitwise-identical
 outputs between the batched engine and direct ``decision_function``
-for the same rows. The sibling of the telemetry and resilience
+for the same rows, and the replica pool's failure isolation under
+fault injection (wedge -> 504 -> eject -> rebuild -> recovery, zero
+stray retraces). The sibling of the telemetry and resilience
 selfchecks; wired into tier-1 by ``tests/test_serving.py``.
 
 Importing this package (or ``batcher``/``registry``/``server``/
@@ -48,11 +63,17 @@ from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
                                        MicroBatcher, QueueFullError)
 from dpsvm_tpu.serving.registry import ModelRegistry
 
+from dpsvm_tpu.serving.budget import (Budget, DeadlineExceededError,
+                                      DegradeController)
+
 __all__ = [
     "KNOWN_OUTPUTS", "BatcherClosedError", "MicroBatcher",
-    "QueueFullError", "ModelRegistry", "PredictionEngine",
-    "ServingServer", "bucket_ladder", "compact_model", "loadgen_row",
-    "run_loadgen", "selfcheck", "main",
+    "QueueFullError", "ModelRegistry", "Budget",
+    "DeadlineExceededError", "DegradeController", "PredictionEngine",
+    "ReplicaPool", "PoolUnavailableError", "DriftDetector",
+    "LifecycleLoop", "RetrainResult", "ServingServer", "bucket_ladder",
+    "compact_model", "loadgen_row", "run_loadgen", "run_saturate",
+    "selfcheck", "main",
 ]
 
 _LAZY = {
@@ -60,8 +81,15 @@ _LAZY = {
     "bucket_ladder": ("dpsvm_tpu.serving.engine", "bucket_ladder"),
     "compact_model": ("dpsvm_tpu.serving.engine", "compact_model"),
     "ServingServer": ("dpsvm_tpu.serving.server", "ServingServer"),
+    "ReplicaPool": ("dpsvm_tpu.serving.pool", "ReplicaPool"),
+    "PoolUnavailableError": ("dpsvm_tpu.serving.pool",
+                             "PoolUnavailableError"),
+    "DriftDetector": ("dpsvm_tpu.serving.lifecycle", "DriftDetector"),
+    "LifecycleLoop": ("dpsvm_tpu.serving.lifecycle", "LifecycleLoop"),
+    "RetrainResult": ("dpsvm_tpu.serving.lifecycle", "RetrainResult"),
     "run_loadgen": ("dpsvm_tpu.serving.loadgen", "run_loadgen"),
     "loadgen_row": ("dpsvm_tpu.serving.loadgen", "loadgen_row"),
+    "run_saturate": ("dpsvm_tpu.serving.loadgen", "run_saturate"),
 }
 
 
@@ -198,6 +226,65 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
         d_new = np.asarray(reg.engine("m").decision_values(row))
         if not np.allclose(d_new, d_old - 1.0, atol=1e-6):
             problems.append("hot reload did not serve the new artifact")
+
+        # 5) replica pool: a wedged replica is a 504 for the dispatch
+        # that hit it and an eject->rebuild->recovery for the pool —
+        # with zero steady-state retraces across all survivors
+        # (docs/SERVING.md "Resilience")
+        import time as _time
+
+        from dpsvm_tpu.resilience import faultinject
+        from dpsvm_tpu.serving.budget import DeadlineExceededError
+        from dpsvm_tpu.serving.pool import ReplicaPool
+
+        faultinject.reset_serve_wedge()
+        faultinject.install(faultinject.FaultPlan(serve_wedge_replica=1))
+        pool = ReplicaPool(
+            lambda i: PredictionEngine.load(path, max_batch=max_batch),
+            3, name="selfcheck", deadline_s=1.5, watch_compiles=True)
+        try:
+            n_504 = n_ok = 0
+            for q in queries:
+                try:
+                    pool.infer(q, ("labels", "decision"))
+                    n_ok += 1
+                except DeadlineExceededError:
+                    n_504 += 1
+            if n_504 != 1:
+                problems.append(
+                    f"expected exactly 1 deadline 504 from the wedged "
+                    f"replica, got {n_504} (of {len(queries)})")
+            if n_ok != len(queries) - 1:
+                problems.append(
+                    f"only {n_ok}/{len(queries) - 1} dispatches "
+                    "survived one wedged replica")
+            give_up = _time.perf_counter() + 30.0
+            while (pool.replica_states() != [
+                    "closed", "closed", "closed"]
+                    and _time.perf_counter() < give_up):
+                try:                       # traffic probes the rebuild
+                    pool.infer(queries[0], ("labels",))
+                except DeadlineExceededError:
+                    pass
+                _time.sleep(0.02)
+            if pool.replica_states() != ["closed", "closed", "closed"]:
+                problems.append(
+                    "ejected replica did not recover to closed: "
+                    f"{pool.replica_states()}")
+            seq = [e["event"] for e in pool.events]
+            if seq[:2] != ["eject", "rebuild"]:
+                problems.append(
+                    f"expected eject->rebuild event sequence, got {seq}")
+            stray = pool.stray_compiles()
+            if stray:
+                problems.append(
+                    f"{stray} stray compile(s) across pool traffic "
+                    "incl. an ejection + rebuild — replicas are "
+                    "leaking retraces")
+        finally:
+            faultinject.release_serve_wedge()
+            faultinject.clear()
+            pool.close()
     finally:
         if ctx is not None:
             ctx.cleanup()
@@ -227,5 +314,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     print("serving selfcheck OK (zero post-warmup compiles across "
           "mixed-size traffic; engine bitwise == decision_function; "
-          "batcher + hot reload consistent)")
+          "batcher + hot reload consistent; pool ejects a wedged "
+          "replica, 504s its dispatch, rebuilds and recovers with "
+          "zero stray retraces)")
     return 0

@@ -32,6 +32,7 @@ engine.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
@@ -39,6 +40,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dpsvm_tpu.serving.budget import DeadlineExceededError
 
 #: outputs the engine's ``infer`` understands; "proba" additionally
 #: needs calibration. Lives here (stdlib-only module) so the HTTP
@@ -57,21 +59,48 @@ class BatcherClosedError(RuntimeError):
 
 class _Ticket:
     """One request's future: wait() blocks until the worker publishes
-    this request's slice of the batch result (or its error)."""
+    this request's slice of the batch result (or its error).
 
-    __slots__ = ("rows", "want", "event", "result", "error", "t_submit")
+    A ticket may carry an absolute ``deadline`` (perf_counter). A
+    waiter that times out marks the ticket ``cancelled``, and the
+    worker drops cancelled/expired tickets at batch-formation time
+    instead of computing for nobody — the expired work is counted in
+    ``stats()["expired"]``, never silently burned."""
 
-    def __init__(self, rows: np.ndarray, want: Tuple[str, ...]):
+    __slots__ = ("rows", "want", "event", "result", "error", "t_submit",
+                 "deadline", "cancelled")
+
+    def __init__(self, rows: np.ndarray, want: Tuple[str, ...],
+                 deadline: Optional[float] = None):
         self.rows = rows
         self.want = want
         self.event = threading.Event()
         self.result: Optional[dict] = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        self.deadline = deadline
+        self.cancelled = False
 
     def wait(self, timeout: Optional[float] = None) -> dict:
+        """Block for the result. The wait is bounded by BOTH the given
+        timeout and the ticket's own deadline; on expiry the ticket is
+        cancelled (so the worker won't compute it) and
+        ``DeadlineExceededError`` — a TimeoutError — is raised (the
+        HTTP layer maps it to 504, never a 400)."""
+        if self.deadline is not None:
+            rem = self.deadline - time.perf_counter()
+            timeout = rem if timeout is None else min(timeout, rem)
+        if timeout is not None and timeout <= 0:
+            self.cancelled = True
+            raise DeadlineExceededError(
+                "deadline exhausted before the prediction completed")
         if not self.event.wait(timeout):
-            raise TimeoutError("prediction did not complete in time")
+            # Mark first, then re-check: the worker may have published
+            # between the wait timing out and the cancel landing.
+            self.cancelled = True
+            if not self.event.is_set():
+                raise DeadlineExceededError(
+                    "prediction did not complete in time")
         if self.error is not None:
             raise self.error
         return self.result
@@ -93,6 +122,13 @@ class MicroBatcher:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._infer = infer_fn
+        # Deadline-aware engines (the replica pool) take the batch's
+        # deadline as a keyword; plain engines keep the 2-arg shape.
+        try:
+            self._pass_deadline = ("deadline" in
+                                   inspect.signature(infer_fn).parameters)
+        except (TypeError, ValueError):
+            self._pass_deadline = False
         self.max_batch = int(max_batch)
         self.max_delay_s = max(float(max_delay_ms), 0.0) / 1000.0
         self.max_queue = int(max_queue)
@@ -107,22 +143,26 @@ class MicroBatcher:
         self._n_batches = 0
         self._n_requests = 0
         self._n_rejected = 0
+        self._n_expired = 0
         if start:
             self.start()
 
     # -- client side --------------------------------------------------
 
-    def submit(self, rows, want: Sequence[str] = ("labels",)) -> _Ticket:
+    def submit(self, rows, want: Sequence[str] = ("labels",),
+               deadline: Optional[float] = None) -> _Ticket:
         """Enqueue one request (rows: (k, d) float32). Returns a ticket
         to ``wait()`` on. Raises ``QueueFullError`` (fast, no blocking)
-        at capacity, ``BatcherClosedError`` while draining."""
+        at capacity, ``BatcherClosedError`` while draining.
+        ``deadline`` (absolute perf_counter) bounds the whole journey:
+        an expired ticket is dropped at batch formation, not computed."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 1:
             rows = rows[None, :]
         n = int(rows.shape[0])
         if n == 0:
             raise ValueError("empty request")
-        t = _Ticket(rows, tuple(want))
+        t = _Ticket(rows, tuple(want), deadline)
         with self._cond:
             if self._closing:
                 raise BatcherClosedError("server is draining")
@@ -176,6 +216,7 @@ class MicroBatcher:
             return {
                 "requests": self._n_requests,
                 "rejected": self._n_rejected,
+                "expired": self._n_expired,
                 "batches": self._n_batches,
                 "queue_depth_rows": self._rows_queued,
                 "batch_rows_histogram": {str(k): v for k, v in
@@ -184,22 +225,50 @@ class MicroBatcher:
 
     # -- worker -------------------------------------------------------
 
+    def _prune_head(self) -> None:
+        """Drop dead tickets from the queue head (holding the lock).
+        Cancelled tickets (their waiter already gave up) and
+        deadline-expired ones are dropped here — at batch-formation
+        time — instead of being computed for nobody; an expired
+        ticket's waiter (if any is still blocked on a caller-supplied
+        timeout) is woken with DeadlineExceededError. Both count as
+        ``expired`` in stats()."""
+        now = time.perf_counter()
+        while self._q:
+            t = self._q[0]
+            expired = (t.deadline is not None and t.deadline <= now)
+            if not (t.cancelled or expired):
+                return
+            self._q.popleft()
+            self._rows_queued -= int(t.rows.shape[0])
+            self._n_expired += 1
+            if not t.cancelled:
+                t.error = DeadlineExceededError(
+                    "deadline passed while queued")
+                t.event.set()
+
     def _take_batch(self) -> Optional[List[_Ticket]]:
         """Block for the first request, then coalesce until max_batch
-        rows or the deadline. None = closed and (drained or no-drain)."""
+        rows or the deadline. None = closed and (drained or no-drain).
+        May return an empty list when every queued ticket had already
+        expired — the worker just takes the next batch."""
         with self._cond:
-            while not self._q:
+            while True:
+                self._prune_head()
+                if self._q:
+                    break
                 if self._closing:
                     return None
                 self._cond.wait()
             if self._closing and not self._drain:
                 return None
             first = self._q.popleft()
-            self._rows_queued -= first.rows.shape[0]
+            self._rows_queued -= int(first.rows.shape[0])
             batch = [first]
             rows = int(first.rows.shape[0])
             deadline = time.perf_counter() + self.max_delay_s
             while rows < self.max_batch:
+                self._prune_head()
                 if self._q:
                     nxt = int(self._q[0].rows.shape[0])
                     if rows + nxt > self.max_batch:
@@ -228,6 +297,8 @@ class MicroBatcher:
                         t.error = BatcherClosedError("server shut down")
                         t.event.set()
                 return
+            if not batch:                  # all queued tickets expired
+                continue
             x = (batch[0].rows if len(batch) == 1
                  else np.concatenate([t.rows for t in batch]))
             want = tuple(dict.fromkeys(w for t in batch for w in t.want))
@@ -236,7 +307,16 @@ class MicroBatcher:
                 self._batch_rows[int(x.shape[0])] = \
                     self._batch_rows.get(int(x.shape[0]), 0) + 1
             try:
-                res = self._infer(x, want)
+                if self._pass_deadline:
+                    # the batch stays interesting until its LAST
+                    # member's deadline (earlier members 504 on their
+                    # own wait; later ones still want the result)
+                    ds = [t.deadline for t in batch]
+                    deadline = (None if any(d is None for d in ds)
+                                else max(ds))
+                    res = self._infer(x, want, deadline=deadline)
+                else:
+                    res = self._infer(x, want)
             except BaseException as e:     # noqa: BLE001 — published to
                 for t in batch:            # every waiting ticket
                     t.error = e

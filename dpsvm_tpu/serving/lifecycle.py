@@ -1,0 +1,314 @@
+"""Self-healing model lifecycle: drift -> retrain -> gate -> hot-swap.
+
+A serving model decays silently: the traffic distribution moves and
+the frozen decision function keeps scoring it with stale confidence.
+"Parallel SVMs in Practice" (arXiv:1404.1066) names model refresh as
+the deployment concern that dominates one-shot training; the cheap
+retrain that makes an AUTOMATED refresh affordable is exactly the
+``approx/`` path ("Recipe for Fast Large-scale SVM Training",
+arXiv:2207.01016). This module closes that loop with parts the repo
+already has:
+
+1. **Drift detection** — a deterministic two-sample Kolmogorov-
+   Smirnov distance between a reference score sample (recorded when
+   the serving generation was promoted) and the live rolling
+   score-distribution window ``/metricsz`` already keeps. No model
+   labels needed: a moved input distribution moves the decision-value
+   distribution first.
+2. **Supervised retrain** — ``resilience.supervisor.run_with_retries``
+   wraps the caller's ``retrain_fn``, so a preempted retrain resumes
+   from its checkpoint instead of aborting the refresh.
+3. **Eval gate** — the candidate must clear a held-out accuracy floor
+   AND (when both runs traced) the ``dpsvm compare`` regression gate
+   (``observability.compare.regressions``) against the serving
+   generation's training trace. A refresh that fails the gate changes
+   NOTHING: the old generation keeps serving, and the failure is a
+   trace event, not a page.
+4. **Atomic hot-swap** — only a passing candidate is promoted:
+   ``os.replace`` onto the registry source path (atomic at the
+   filesystem level), then the registry's explicit reload (new engine
+   fully warmed before the swap) and the replica pool's rolling
+   refresh.
+
+Everything is deterministic and injectable, so the whole loop — drift
+in, promote or gate-hold out — runs as a CPU CI test
+(tests/test_serving_resilience.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+def ks_distance(a, b) -> float:
+    """Two-sample Kolmogorov-Smirnov distance sup_x |F_a(x) - F_b(x)|
+    — deterministic, rank-based (scale-free), in [0, 1]."""
+    a = np.sort(np.asarray(a, np.float64).ravel())
+    b = np.sort(np.asarray(b, np.float64).ravel())
+    if a.size == 0 or b.size == 0:
+        return 0.0
+    allv = np.concatenate([a, b])
+    cdf_a = np.searchsorted(a, allv, side="right") / a.size
+    cdf_b = np.searchsorted(b, allv, side="right") / b.size
+    return float(np.max(np.abs(cdf_a - cdf_b)))
+
+
+class DriftDetector:
+    """KS drift test of the live score window against a reference
+    sample (the promoted generation's own score distribution).
+
+    ``threshold`` is the KS distance that counts as drift; with the
+    default 0.25 a pure location shift of ~0.7 reference standard
+    deviations trips it while sampling noise at ``min_count=64`` stays
+    an order of magnitude below (KS noise ~ sqrt(1/n) ~ 0.125 at worst
+    for the 99th percentile of the null — the margin is the point:
+    this arms a RETRAIN, so false positives cost real compute)."""
+
+    def __init__(self, reference, *, threshold: float = 0.25,
+                 min_count: int = 64):
+        if not (0.0 < threshold <= 1.0):
+            raise ValueError(f"threshold must be in (0, 1], "
+                             f"got {threshold}")
+        self._lock = threading.Lock()
+        self.threshold = float(threshold)
+        self.min_count = int(min_count)
+        self.rearm(reference)
+
+    def rearm(self, reference) -> None:
+        """Swap the reference sample — called at every promotion so
+        drift is always measured against the GENERATION NOW SERVING."""
+        ref = np.asarray(reference, np.float64).ravel()
+        if ref.size < 2:
+            raise ValueError("reference sample needs >= 2 scores")
+        with self._lock:
+            self._ref = np.sort(ref)
+
+    def check(self, window) -> Optional[dict]:
+        """None = no drift; else the drift facts (the `drift` event's
+        payload)."""
+        win = np.asarray(window, np.float64).ravel()
+        win = win[np.isfinite(win)]
+        if win.size < self.min_count:
+            return None
+        with self._lock:
+            ref = self._ref
+        ks = ks_distance(ref, win)
+        if ks <= self.threshold:
+            return None
+        return {"ks": round(ks, 6), "threshold": self.threshold,
+                "window_n": int(win.size), "reference_n": int(ref.size)}
+
+
+@dataclasses.dataclass
+class RetrainResult:
+    """What ``retrain_fn`` hands back: the candidate artifact (a model
+    file the serving engine can load), optionally its training trace
+    (enables the compare gate) and a fresh reference score sample
+    (re-arms the drift detector at promotion)."""
+    model_path: str
+    trace_path: Optional[str] = None
+    reference_scores: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class GateResult:
+    passed: bool
+    accuracy: Optional[float]
+    floor: float
+    problems: "list[str]"
+
+
+class LifecycleLoop:
+    """One model's refresh loop (module docstring).
+
+    * ``score_source()`` -> the live score window (the server's
+      ``score_window()``; any 1-D float sequence works).
+    * ``retrain_fn(resume_from, attempt)`` -> ``RetrainResult``. Runs
+      under ``run_with_retries`` with ``checkpoint_path``, so a
+      preempted attempt resumes.
+    * ``eval_fn(model_path)`` -> held-out accuracy in [0, 1].
+    * ``baseline_trace`` — the serving generation's training trace;
+      with it (and a candidate trace) the ``dpsvm compare`` regression
+      gate arms at ``fail_on_regress_pct``.
+    * ``on_event(name, **extra)`` — trace/metrics sink (`drift`,
+      `retrain`, `promote` with ok True/False).
+    * ``on_promote(name)`` — post-swap hook (the server refreshes the
+      replica pool here).
+    """
+
+    def __init__(self, *, registry, name: str,
+                 detector: DriftDetector,
+                 score_source: Callable[[], Sequence[float]],
+                 retrain_fn: Callable[[Optional[str], int],
+                                      RetrainResult],
+                 eval_fn: Callable[[str], float],
+                 accuracy_floor: float,
+                 baseline_trace: Optional[str] = None,
+                 fail_on_regress_pct: Optional[float] = None,
+                 retries: int = 1, backoff_s: float = 0.0,
+                 checkpoint_path: Optional[str] = None,
+                 cooldown_s: float = 0.0,
+                 on_event: Optional[Callable[..., None]] = None,
+                 on_promote: Optional[Callable[[str], None]] = None):
+        source = registry.source(name)
+        if source is None:
+            raise ValueError(
+                f"model {name!r} was registered in-memory; the "
+                "lifecycle loop needs a source path to hot-swap")
+        if os.path.isdir(source):
+            raise ValueError(
+                "lifecycle hot-swap supports single-file model "
+                f"artifacts; {source!r} is a directory (multiclass)")
+        self.registry = registry
+        self.name = name
+        self.detector = detector
+        self.score_source = score_source
+        self.retrain_fn = retrain_fn
+        self.eval_fn = eval_fn
+        self.accuracy_floor = float(accuracy_floor)
+        self.baseline_trace = baseline_trace
+        self.fail_on_regress_pct = fail_on_regress_pct
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.checkpoint_path = checkpoint_path
+        self.cooldown_s = float(cooldown_s)
+        self._on_event = on_event
+        self._on_promote = on_promote
+        self._last_action_t = 0.0
+        self.history: "list[dict]" = []
+
+    def _emit(self, event: str, **extra) -> None:
+        self.history.append({"event": event, **extra})
+        if self._on_event is not None:
+            try:
+                self._on_event(event, **extra)
+            except Exception:
+                pass
+
+    # -- the loop body ------------------------------------------------
+
+    def step(self) -> str:
+        """One poll. Returns the outcome: ``"no-drift"``, ``"cooldown"``,
+        ``"promoted"``, ``"gate-held"`` (candidate rejected, old
+        generation untouched) or ``"retrain-failed"``."""
+        if (self.cooldown_s and
+                time.monotonic() - self._last_action_t < self.cooldown_s):
+            return "cooldown"
+        drift = self.detector.check(self.score_source())
+        if drift is None:
+            return "no-drift"
+        self._emit("drift", model=self.name, **drift)
+        self._last_action_t = time.monotonic()
+        try:
+            result = self._retrain()
+        except Exception as e:         # noqa: BLE001 — reported, loop
+            self._emit("retrain", model=self.name, ok=False,
+                       error=str(e))  # survives to the next poll
+            return "retrain-failed"
+        self._emit("retrain", model=self.name, ok=True,
+                   candidate=result.model_path)
+        gate = self.gate(result)
+        if not gate.passed:
+            self._emit("promote", model=self.name, ok=False,
+                       accuracy=gate.accuracy, floor=gate.floor,
+                       problems=gate.problems)
+            return "gate-held"
+        self.promote(result, accuracy=gate.accuracy)
+        return "promoted"
+
+    def _retrain(self) -> RetrainResult:
+        from dpsvm_tpu.resilience.supervisor import run_with_retries
+
+        result = run_with_retries(
+            self.retrain_fn, retries=self.retries,
+            backoff_s=self.backoff_s,
+            checkpoint_path=self.checkpoint_path)
+        if not isinstance(result, RetrainResult):
+            raise TypeError("retrain_fn must return a RetrainResult, "
+                            f"got {type(result).__name__}")
+        if not os.path.exists(result.model_path):
+            raise FileNotFoundError(
+                f"retrain_fn reported {result.model_path!r} but wrote "
+                "no such artifact")
+        return result
+
+    # -- gate ---------------------------------------------------------
+
+    def gate(self, result: RetrainResult) -> GateResult:
+        """Held-out accuracy floor + (when traces exist on both sides)
+        the mechanical ``dpsvm compare`` regression verdicts."""
+        problems: "list[str]" = []
+        accuracy: Optional[float] = None
+        try:
+            accuracy = float(self.eval_fn(result.model_path))
+        except Exception as e:         # noqa: BLE001 — a gate that
+            problems.append(f"eval failed: {e}")   # crashes must HOLD
+        if accuracy is not None and accuracy < self.accuracy_floor:
+            problems.append(f"held-out accuracy {accuracy:.4f} below "
+                            f"floor {self.accuracy_floor:.4f}")
+        if (self.baseline_trace and result.trace_path
+                and self.fail_on_regress_pct is not None):
+            try:
+                from dpsvm_tpu.observability.compare import (
+                    compare_paths, regressions)
+                cmp_, _, _ = compare_paths(self.baseline_trace,
+                                           result.trace_path)
+                problems.extend(regressions(cmp_,
+                                            self.fail_on_regress_pct))
+            except Exception as e:     # noqa: BLE001
+                problems.append(f"trace compare failed: {e}")
+        return GateResult(passed=not problems, accuracy=accuracy,
+                          floor=self.accuracy_floor, problems=problems)
+
+    # -- swap ---------------------------------------------------------
+
+    def promote(self, result: RetrainResult,
+                accuracy: Optional[float] = None) -> None:
+        """Atomically replace the serving artifact and hot-reload: the
+        candidate file moves onto the registry source path with
+        ``os.replace`` (atomic; readers see old bytes or new bytes,
+        never a torn file), then the registry builds + warms the new
+        engine and swaps it in, then the pool refreshes. Any failure
+        here leaves the OLD artifact bytes gone only after the replace
+        — which is why the replace is last-resort-recoverable: the
+        reload failing keeps the old ENGINE serving from memory."""
+        source = self.registry.source(self.name)
+        os.replace(result.model_path, source)
+        self.registry.reload(self.name)
+        if result.trace_path:
+            self.baseline_trace = result.trace_path
+        if result.reference_scores is not None:
+            self.detector.rearm(result.reference_scores)
+        if self._on_promote is not None:
+            self._on_promote(self.name)
+        gen = self.registry.manifests()[self.name]["generation"]
+        self._emit("promote", model=self.name, ok=True,
+                   generation=gen, accuracy=accuracy)
+
+    # -- background form ----------------------------------------------
+
+    def run(self, interval_s: float,
+            stop: Optional[threading.Event] = None) -> threading.Thread:
+        """Poll ``step()`` every ``interval_s`` on a daemon thread
+        until ``stop`` is set. Returns the thread."""
+        stop = stop or threading.Event()
+        self.stop_event = stop
+
+        def loop():
+            while not stop.is_set():
+                try:
+                    self.step()
+                except Exception:      # noqa: BLE001 — the loop must
+                    pass               # outlive a bad poll
+                stop.wait(interval_s)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"dpsvm-lifecycle[{self.name}]")
+        t.start()
+        return t

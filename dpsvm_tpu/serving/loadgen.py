@@ -159,6 +159,13 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     errors = len(statuses) - ok
     p50, p95, p99 = (np.percentile(lat, [50.0, 95.0, 99.0])
                      if lat.size else (float("nan"),) * 3)
+    counts: dict = {}
+    for s in statuses:
+        counts[str(s)] = counts.get(str(s), 0) + 1
+    # availability over ACCEPTED requests: a 429 is the server saying
+    # "not now" — explicit backpressure, not a failure; everything else
+    # non-200 (504s, 5xx, connection drops) counts against it.
+    accepted = len(statuses) - counts.get("429", 0)
     return {
         "mode": mode,
         "requests": requests,
@@ -172,19 +179,101 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
         "p95_ms": round(float(p95), 3),
         "p99_ms": round(float(p99), 3),
         "errors": errors,
+        "status_counts": {k: counts[k] for k in sorted(counts)},
+        "accepted": accepted,
+        "availability_pct": (round(100.0 * ok / accepted, 3)
+                             if accepted else None),
         **({"target_rps": rps} if mode == "open" else {}),
     }
+
+
+def fetch_metrics(url: str, timeout: float = 10.0) -> dict:
+    """GET /metricsz — the chaos report reads the server-side
+    robustness counters (ejections, rebuilds, hedges, sheds) before
+    and after the run."""
+    host, port = _host_port(url)
+    conn = _Conn(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/metricsz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+    if resp.status != 200:
+        raise RuntimeError(f"GET /metricsz -> {resp.status}: {body}")
+    return body
+
+
+#: robustness counters the chaos row deltas out of /metricsz
+CHAOS_COUNTERS = ("ejections", "rebuilds", "hedges_fired", "hedges_won",
+                  "redispatches", "deadline_504", "shed_proba",
+                  "shed_sibling", "expired", "rejected")
+
+
+def run_saturate(url: str, rows: np.ndarray, *,
+                 model: str = "default", p99_target_ms: float = 50.0,
+                 start_rps: float = 25.0, rps_factor: float = 2.0,
+                 max_steps: int = 8, step_requests: int = 100,
+                 batch: int = 1, concurrency: int = 16,
+                 want: Sequence[str] = ("labels",),
+                 timeout: float = 30.0) -> dict:
+    """Drive-to-saturation: step open-loop RPS by ``rps_factor`` until
+    p99 exceeds the target (or errors appear), and report ONE SLO row —
+    the max sustained throughput at p99 < target, with availability.
+    The open loop is the honest probe here: a closed loop slows its own
+    arrivals under overload and never finds the knee."""
+    steps = []
+    best = None
+    rps = float(start_rps)
+    for _ in range(int(max_steps)):
+        r = run_loadgen(url, rows, model=model, requests=step_requests,
+                        batch=batch, concurrency=concurrency,
+                        mode="open", rps=rps, want=want,
+                        timeout=timeout)
+        met = (r["errors"] == 0
+               and np.isfinite(r["p99_ms"])
+               and r["p99_ms"] <= p99_target_ms)
+        steps.append({"rps": rps, "p99_ms": r["p99_ms"],
+                      "throughput_rps": r["throughput_rps"],
+                      "availability_pct": r["availability_pct"],
+                      "errors": r["errors"], "slo_met": met})
+        if not met:
+            break
+        best = (rps, r)
+        rps *= float(rps_factor)
+    row = {
+        "metric": "serving_slo_max_rps",
+        "unit": "req/s",
+        "p99_target_ms": float(p99_target_ms),
+        "steps": steps,
+    }
+    if best is None:
+        row.update(value=0.0, slo_met=False, availability_pct=None)
+    else:
+        srps, r = best
+        row.update(value=r["throughput_rps"], slo_met=True,
+                   sustained_rps=srps, p99_ms=r["p99_ms"],
+                   availability_pct=r["availability_pct"])
+    return row
 
 
 def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                 requests: int = 200, batch: int = 1,
                 concurrency: int = 8, mode: str = "closed",
                 rps: float = 100.0, want: Sequence[str] = ("labels",),
-                timeout: float = 30.0,
+                timeout: float = 30.0, chaos: bool = False,
                 compare_sequential: bool = True) -> dict:
     """The one-line result row ``dpsvm loadgen`` prints: the main
     measurement, plus (by default) the batch-1 single-worker sequential
-    baseline and the coalescing speedup over it."""
+    baseline and the coalescing speedup over it.
+
+    ``chaos=True`` is the chaos-drill report: the fault itself is
+    armed server-side (``DPSVM_FAULT_SERVE_*`` env on the serve
+    process — it fires mid-run, at the configured request count) and
+    the row additionally carries the availability of accepted requests
+    plus the delta of the server's robustness counters (ejections,
+    rebuilds, hedges, sheds) across the run, read from /metricsz."""
+    before = fetch_metrics(url, timeout=timeout) if chaos else None
     main = run_loadgen(url, rows, model=model, requests=requests,
                        batch=batch, concurrency=concurrency, mode=mode,
                        rps=rps, want=want, timeout=timeout)
@@ -194,6 +283,17 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
         "unit": "ex/s",
         **main,
     }
+    if chaos:
+        after = fetch_metrics(url, timeout=timeout)
+        row["chaos"] = {
+            k: int(after.get(k, 0)) - int(before.get(k, 0))
+            for k in CHAOS_COUNTERS}
+        row["chaos"]["stray_compiles"] = int(
+            after.get("stray_compiles", 0))
+        row["replica_states"] = [
+            r.get("state")
+            for m in after.get("models", {}).values()
+            for r in m.get("pool", {}).get("replicas", [])]
     if compare_sequential:
         seq = run_loadgen(url, rows, model=model, requests=requests,
                           batch=1, concurrency=1, mode="closed",

@@ -25,11 +25,13 @@ from typing import Dict, List, Optional
 
 
 class _Entry:
-    __slots__ = ("engine", "source", "kwargs", "generation", "loaded_at")
+    __slots__ = ("engine", "source", "model", "kwargs", "generation",
+                 "loaded_at")
 
-    def __init__(self, engine, source, kwargs):
+    def __init__(self, engine, source, model, kwargs):
         self.engine = engine
         self.source = source
+        self.model = model            # kept for in-memory rebuilds
         self.kwargs = kwargs
         self.generation = 1
         self.loaded_at = time.time()
@@ -44,7 +46,8 @@ class ModelRegistry:
                  model=None, **engine_kwargs):
         """Load + warm a model under ``name``. ``source`` is a model
         file or multiclass directory; alternatively pass an in-memory
-        ``model`` (then reload is unavailable). Returns the engine."""
+        ``model`` (then reload is unavailable, but replica rebuilds
+        still are — the model object is retained). Returns the engine."""
         from dpsvm_tpu.serving.engine import PredictionEngine
 
         if (source is None) == (model is None):
@@ -56,8 +59,38 @@ class ModelRegistry:
         else:
             engine = PredictionEngine(model, **engine_kwargs)
         with self._lock:
-            self._entries[name] = _Entry(engine, source, engine_kwargs)
+            self._entries[name] = _Entry(engine, source, model,
+                                         engine_kwargs)
         return engine
+
+    def build(self, name: str):
+        """Construct a FRESH, fully-warmed engine for ``name`` from its
+        current source (or retained in-memory model) WITHOUT touching
+        the registered entry — the replica pool's rebuild path
+        (serving/pool.py): every pool replica beyond the shared first
+        one, and every post-ejection rebuild, is its own engine with
+        its own device buffers."""
+        from dpsvm_tpu.serving.engine import PredictionEngine
+
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"no model named {name!r} "
+                               f"(registered: {list(self._entries)})")
+            source, model, kwargs = entry.source, entry.model, entry.kwargs
+        if source is not None:
+            return PredictionEngine.load(source, **kwargs)
+        return PredictionEngine(model, **kwargs)
+
+    def source(self, name: str) -> Optional[str]:
+        """The artifact path ``name`` was registered from (None for
+        in-memory models) — the lifecycle loop's hot-swap target."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"no model named {name!r} "
+                               f"(registered: {list(self._entries)})")
+            return entry.source
 
     def engine(self, name: str):
         with self._lock:
@@ -70,6 +103,7 @@ class ModelRegistry:
     def reload(self, name: str):
         """Re-load ``name`` from its source path and swap atomically.
         The old engine serves until the new one is fully warmed."""
+        from dpsvm_tpu.resilience import faultinject
         from dpsvm_tpu.serving.engine import PredictionEngine
 
         with self._lock:
@@ -81,6 +115,8 @@ class ModelRegistry:
         if source is None:
             raise ValueError(f"model {name!r} was registered in-memory; "
                              "there is no source path to reload from")
+        faultinject.on_serve_reload()   # DPSVM_FAULT_SERVE_FAIL_RELOAD:
+        #                                 raises OSError; old stays live
         fresh = PredictionEngine.load(source, **kwargs)   # may raise —
         with self._lock:                                  # old stays live
             entry = self._entries.get(name)

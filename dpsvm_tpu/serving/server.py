@@ -18,7 +18,25 @@ Endpoints (docs/SERVING.md):
 
 Overload: a full batcher queue fast-rejects with HTTP 429 (+
 ``Retry-After``) instead of queueing unboundedly — clients learn to
-back off while p99 stays bounded.
+back off while p99 stays bounded. Before that cliff there is a slope
+(docs/SERVING.md "Resilience"): as queue fill crosses the shed
+thresholds the server first drops ``proba`` to ``decision``
+(``serving/budget.DegradeController`` tier 1), then sheds whole
+requests to a registered cheaper sibling model (tier 2, e.g. the
+``approx/`` twin), marking degraded responses with a ``degraded``
+field.
+
+Resilience: requests carry a deadline budget (``timeout_ms`` in the
+body or ``X-Deadline-Ms`` header, capped by ``--deadline-ms``) that
+bounds queue wait AND device dispatch; a blown budget is **504** +
+``Retry-After`` (never a 400 — the client did nothing wrong). With
+``replicas > 1`` each model serves from a ``serving/pool.ReplicaPool``
+— wedged/NaN-poisoned replicas are ejected and rebuilt in the
+background while the rest keep answering, and hedged re-dispatch
+(``--hedge-ms``) converts tail stalls into second chances. /metricsz
+carries the robustness counters (504s, ejections, rebuilds, hedges,
+shed tiers, expired tickets) and the rolling score-distribution
+window the drift detector (``serving/lifecycle.py``) reads.
 
 Shutdown reuses the deferred-signal pattern of ``resilience/preempt``:
 ``serve_until_signal`` traps SIGTERM/SIGINT, and on delivery performs a
@@ -35,6 +53,7 @@ HTTP layer never calls jit directly.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from collections import deque
@@ -45,6 +64,11 @@ import numpy as np
 
 from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
                                        MicroBatcher, QueueFullError)
+from dpsvm_tpu.serving.budget import (TIER_NONE, TIER_SHED_PROBA,
+                                      TIER_SHED_SIBLING, Budget,
+                                      DeadlineExceededError,
+                                      DegradeController)
+from dpsvm_tpu.serving.pool import PoolUnavailableError, ReplicaPool
 from dpsvm_tpu.serving.registry import ModelRegistry
 
 #: request bodies above this are rejected (413) before parsing.
@@ -156,6 +180,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": f"reload failed (old model still "
                                       f"serving): {e}"})
             return
+        owner.refresh_pool(name)        # replicas pick the new gen up
         man = dict(engine.manifest)
         man["generation"] = owner.registry.manifests()[name]["generation"]
         self._send(200, {"reloaded": name, "manifest": man})
@@ -221,14 +246,38 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": f"unknown outputs {bad}; pick "
                                       f"from {list(KNOWN_OUTPUTS)}"})
             return
-        if "proba" in want and not engine.calibrated:
+        # Deadline budget: fixed at admission, bounds queue wait AND
+        # device dispatch. A blown budget is 504 (see below).
+        try:
+            budget = owner.budget_for(
+                body.get("timeout_ms",
+                         self.headers.get("X-Deadline-Ms")))
+        except ValueError as e:
             owner.count("errors")
-            self._send(400, {"error": f"model {name!r} has no "
+            self._send(400, {"error": str(e)})
+            return
+        # Degradation ladder: shed the optional expensive output, then
+        # shed the whole request to the registered sibling, BEFORE the
+        # queue-full 429 cliff.
+        eff_name, eff_want, degraded = owner.degrade(name, want)
+        if eff_name != name:
+            try:
+                engine = owner.registry.engine(eff_name)
+            except KeyError:
+                eff_name, degraded = name, None    # sibling vanished
+        if "proba" in eff_want and not engine.calibrated:
+            owner.count("errors")
+            self._send(400, {"error": f"model {eff_name!r} has no "
                                       "probability calibration"})
             return
         try:
-            res = owner.batcher(name).infer(x, want,
-                                            timeout=owner.predict_timeout)
+            # Always ride "decision" along: the engine derives every
+            # output from the one decision pass anyway, and the server
+            # feeds the values to the drift detector's score window.
+            ride = tuple(dict.fromkeys(eff_want + ("decision",)))
+            ticket = owner.batcher(eff_name).submit(
+                x, ride, deadline=budget.deadline)
+            res = ticket.wait(budget.remaining())
         except QueueFullError as e:
             owner.count("rejected")
             self._send(429, {"error": str(e)},
@@ -238,26 +287,49 @@ class _Handler(BaseHTTPRequestHandler):
             owner.count("errors")
             self._send(503, {"error": "draining"})
             return
-        except (ValueError, TimeoutError) as e:
-            # bad width / unknown output / uncalibrated proba / timeout
+        except (DeadlineExceededError, TimeoutError) as e:
+            # the satellite bugfix: a timeout is the SERVER's miss —
+            # 504 + Retry-After, never the 400 family
+            owner.count("deadline_504")
+            self._send(504, {"error": str(e)},
+                       headers=(("Retry-After", "1"),))
+            return
+        except PoolUnavailableError as e:
+            owner.count("errors")
+            self._send(503, {"error": str(e)},
+                       headers=(("Retry-After", "1"),))
+            return
+        except ValueError as e:
+            # bad width / unknown output / uncalibrated proba
             owner.count("errors")
             self._send(400, {"error": str(e)})
             return
+        owner.observe_scores(res.get("decision"))
         ms = (time.perf_counter() - t0) * 1000.0
         owner.observe_latency(ms)
         owner.count("requests")
-        out = {k: _jsonable(v) for k, v in res.items()}
+        out = {k: _jsonable(v) for k, v in res.items() if k in eff_want}
         out.update(model=name, n=int(x.shape[0]), ms=round(ms, 3))
+        if degraded:
+            out["degraded"] = degraded
         self._send(200, out)
 
 
 class ServingServer:
-    """Registry + per-model batchers + the HTTP front end."""
+    """Registry + per-model replica pools + batchers + the HTTP front
+    end (module docstring for the resilience pieces)."""
 
     def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
                  port: int = 0, *, max_batch: int = 256,
                  max_delay_ms: float = 2.0, max_queue: int = 4096,
-                 predict_timeout: float = 60.0, verbose: bool = False):
+                 predict_timeout: float = 60.0, replicas: int = 1,
+                 hedge="off", degrade: bool = True,
+                 shed_proba_fill: float = 0.5,
+                 shed_sibling_fill: float = 0.8,
+                 siblings: Optional[Dict[str, str]] = None,
+                 score_window: int = 4096,
+                 trace_out: Optional[str] = None,
+                 verbose: bool = False):
         self.registry = registry
         self.host = host
         self.requested_port = int(port)
@@ -265,15 +337,31 @@ class ServingServer:
         self.max_delay_ms = float(max_delay_ms)
         self.max_queue = int(max_queue)
         self.predict_timeout = float(predict_timeout)
+        self.replicas = int(replicas)
+        self.hedge = hedge
         self.verbose = verbose
         self.draining = False
         self._batchers: Dict[str, MicroBatcher] = {}
+        self._pools: Dict[str, ReplicaPool] = {}
+        self._siblings: Dict[str, str] = {}
+        self.degrader = DegradeController(
+            enabled=degrade, shed_proba_fill=shed_proba_fill,
+            shed_sibling_fill=shed_sibling_fill)
         self._lock = threading.Lock()
+        self._pool_create_lock = threading.Lock()
         self._lat_ms: deque = deque(maxlen=8192)
-        self._counters = {"requests": 0, "errors": 0, "rejected": 0}
+        self._scores: deque = deque(maxlen=int(score_window))
+        self._counters = {"requests": 0, "errors": 0, "rejected": 0,
+                          "deadline_504": 0, "shed_proba": 0,
+                          "shed_sibling": 0}
+        self._events: deque = deque(maxlen=512)
+        self._trace = None
+        self._trace_out = trace_out
         self._t0 = time.monotonic()
         self._httpd: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
+        for name, sib in (siblings or {}).items():
+            self.set_sibling(name, sib)
 
     # -- metrics ------------------------------------------------------
 
@@ -289,11 +377,99 @@ class ServingServer:
         with self._lock:
             self._lat_ms.append(ms)
 
+    def observe_scores(self, decision) -> None:
+        """Feed decision values into the rolling score-distribution
+        window — what the drift detector (serving/lifecycle.py) and
+        /metricsz's ``score_window`` read. Multiclass (m, P) pairwise
+        matrices are flattened: drift in ANY pair's scores counts."""
+        if decision is None:
+            return
+        vals = np.asarray(decision, np.float64).ravel()
+        with self._lock:
+            self._scores.extend(float(v) for v in vals)
+
+    def score_window(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(self._scores, np.float64)
+
+    # -- resilience policy --------------------------------------------
+
+    def budget_for(self, raw) -> Budget:
+        """The request's deadline budget: ``timeout_ms`` (body) /
+        ``X-Deadline-Ms`` (header), capped by the server-wide
+        ``predict_timeout``. Invalid values are a 400 (ValueError)."""
+        if raw is None:
+            return Budget(self.predict_timeout)
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            raise ValueError(f"timeout_ms must be a number, got {raw!r}")
+        if not (math.isfinite(ms) and ms > 0):
+            raise ValueError(f"timeout_ms must be finite and > 0, "
+                             f"got {raw!r}")
+        return Budget(min(ms / 1000.0, self.predict_timeout))
+
+    def set_sibling(self, name: str, sibling: str) -> None:
+        """Register ``sibling`` as the tier-2 degradation target for
+        ``name`` (typically the approx twin of an exact model). Both
+        must be registered and agree on feature width."""
+        e, s = self.registry.engine(name), self.registry.engine(sibling)
+        if e.num_attributes != s.num_attributes:
+            raise ValueError(
+                f"sibling {sibling!r} has {s.num_attributes} "
+                f"attributes, {name!r} expects {e.num_attributes}")
+        with self._lock:
+            self._siblings[name] = sibling
+
+    def sibling(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._siblings.get(name)
+
+    def degrade(self, name: str, want: tuple
+                ) -> "tuple[str, tuple, Optional[str]]":
+        """Apply the shed ladder for one request: returns
+        (effective model, effective want, degraded marker or None)."""
+        tier = self.degrader.tier_for(
+            self.batcher(name).queue_depth, self.max_queue)
+        if self.degrader.note(tier) and tier != TIER_NONE:
+            self.emit_event("shed", model=name, tier=tier)
+        if tier == TIER_NONE:
+            return name, want, None
+        degraded = None
+        if tier >= TIER_SHED_PROBA and "proba" in want:
+            want = tuple(w for w in want if w != "proba") or ("decision",)
+            degraded = "shed_proba"
+            self.count("shed_proba")
+        if tier >= TIER_SHED_SIBLING:
+            sib = self.sibling(name)
+            if sib is not None:
+                self.count("shed_sibling")
+                return sib, want, f"sibling:{sib}"
+        return name, want, degraded
+
+    # -- events + serving trace ---------------------------------------
+
+    def emit_event(self, event: str, **extra) -> None:
+        """Robustness event sink: in-memory ring (for /metricsz and
+        tests) + the serving trace when one is open."""
+        with self._lock:
+            self._events.append({"event": event, "t": round(
+                self.uptime, 3), **extra})
+            tr = self._trace
+        if tr is not None:
+            try:
+                tr.event(event, **extra)
+            except Exception:
+                pass                   # tracing must not kill serving
+
     def metrics(self) -> dict:
         with self._lock:
             counters = dict(self._counters)
             lat = np.asarray(self._lat_ms, np.float64)
+            scores = np.asarray(self._scores, np.float64)
             batchers = dict(self._batchers)
+            pools = dict(self._pools)
+            events = list(self._events)
         out = dict(counters)
         out["uptime_s"] = round(self.uptime, 3)
         out["draining"] = self.draining
@@ -306,9 +482,30 @@ class ServingServer:
         else:
             out["latency_ms"] = {"count": 0, "p50": None, "p95": None,
                                  "p99": None}
+        # the rolling score-distribution window the drift detector
+        # reads (summary over HTTP; LifecycleLoop reads score_window())
+        if scores.size:
+            q5, q50, q95 = np.percentile(scores, [5.0, 50.0, 95.0])
+            out["score_window"] = {
+                "count": int(scores.size),
+                "mean": round(float(np.mean(scores)), 6),
+                "std": round(float(np.std(scores)), 6),
+                "p5": round(float(q5), 6), "p50": round(float(q50), 6),
+                "p95": round(float(q95), 6)}
+        else:
+            out["score_window"] = {"count": 0, "mean": None,
+                                   "std": None, "p5": None, "p50": None,
+                                   "p95": None}
+        out["degrade"] = self.degrader.stats()
+        # pool-level robustness counters, totalled and per model
+        totals = {"ejections": 0, "rebuilds": 0, "hedges_fired": 0,
+                  "hedges_won": 0, "redispatches": 0, "timeouts": 0,
+                  "stray_compiles": 0}
+        out["expired"] = 0
         models = {}
         for name, b in batchers.items():
             st = b.stats()
+            out["expired"] += st.get("expired", 0)
             try:
                 st["bucket_histogram"] = {
                     str(k): v for k, v in sorted(
@@ -316,20 +513,70 @@ class ServingServer:
                     if v}
             except KeyError:
                 pass
+            pool = pools.get(name)
+            if pool is not None:
+                pm = pool.metrics()
+                st["pool"] = pm
+                for k in totals:
+                    totals[k] += pm.get(k, 0)
             models[name] = st
+        out.update(totals)
         out["models"] = models
+        out["events"] = events[-64:]
         return out
 
-    # -- batchers -----------------------------------------------------
+    # -- pools + batchers ---------------------------------------------
+
+    def pool(self, name: str) -> ReplicaPool:
+        """The model's replica pool (created on first use; ``start()``
+        pre-creates one per registered model so the replica builds are
+        paid at boot, not on the first request). The first replica
+        shares the registry's already-warm engine; later replicas —
+        and every post-ejection rebuild — are fresh engines built from
+        the CURRENT registry source."""
+        with self._lock:
+            p = self._pools.get(name)
+        if p is not None:
+            return p
+        with self._pool_create_lock:   # serialize expensive builds
+            with self._lock:
+                p = self._pools.get(name)
+            if p is not None:
+                return p
+            shared = {"used": False}
+
+            def build(i, _name=name):
+                if not shared["used"]:
+                    shared["used"] = True
+                    return self.registry.engine(_name)
+                return self.registry.build(_name)
+
+            p = ReplicaPool(build, self.replicas, name=name,
+                            deadline_s=self.predict_timeout,
+                            hedge=self.hedge, watch_compiles=True,
+                            on_event=self.emit_event)
+            with self._lock:
+                self._pools[name] = p
+            return p
+
+    def refresh_pool(self, name: str) -> None:
+        """Rolling-rebuild the model's replicas from the current
+        registry generation — the pool side of a hot reload/promote."""
+        with self._lock:
+            p = self._pools.get(name)
+        if p is not None:
+            p.refresh()
 
     def batcher(self, name: str) -> MicroBatcher:
         with self._lock:
             b = self._batchers.get(name)
             if b is None:
-                # Resolve the engine per batch (closure over the
-                # registry), so a hot reload swaps under a live batcher.
-                def infer_fn(x, want, _name=name):
-                    return self.registry.engine(_name).infer(x, want)
+                # All device work routes through the replica pool; the
+                # pool resolves engines per dispatch, so a hot reload
+                # (pool refresh) swaps under a live batcher.
+                def infer_fn(x, want, deadline=None, _name=name):
+                    return self.pool(_name).infer(x, want,
+                                                  deadline=deadline)
                 b = MicroBatcher(infer_fn, max_batch=self.max_batch,
                                  max_delay_ms=self.max_delay_ms,
                                  max_queue=self.max_queue)
@@ -349,6 +596,14 @@ class ServingServer:
         return f"http://{self.host}:{self.port}"
 
     def start(self) -> "ServingServer":
+        if self._trace_out:
+            from dpsvm_tpu.observability.record import open_serving_trace
+            self._trace = open_serving_trace(
+                self._trace_out,
+                models={n: {"replicas": self.replicas}
+                        for n in self.registry.names()})
+        for name in self.registry.names():
+            self.pool(name)                 # replica builds paid at boot
         self._httpd = _Server((self.host, self.requested_port), _Handler)
         self._httpd.owner = self
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -366,11 +621,25 @@ class ServingServer:
             batchers = list(self._batchers.values())
         for b in batchers:                  # finish every queued batch
             b.close(drain=True, timeout=timeout)
+        with self._lock:
+            pools = list(self._pools.values())
+        for p in pools:
+            p.close()
         if self._httpd is not None:
             self._httpd.shutdown()          # stop the accept loop
             self._httpd.server_close()      # join handler threads
         if self._thread is not None:
             self._thread.join(timeout)
+        with self._lock:
+            tr, self._trace = self._trace, None
+            counters = dict(self._counters)
+        if tr is not None:
+            from dpsvm_tpu.observability.record import close_serving_trace
+            close_serving_trace(tr, requests=counters["requests"],
+                                errors=counters["errors"],
+                                seconds=self.uptime,
+                                rejected=counters["rejected"],
+                                deadline_504=counters["deadline_504"])
 
     def serve_until_signal(self) -> int:
         """Run until SIGTERM/SIGINT, then drain. Returns the signal

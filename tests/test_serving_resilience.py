@@ -1,0 +1,894 @@
+"""Self-healing serving tests (docs/SERVING.md "Resilience",
+docs/ROBUSTNESS.md "Self-healing serving").
+
+What must hold, per component:
+
+* budget    — deadlines are absolute and shared across stages; a blown
+              budget is DeadlineExceededError (HTTP 504), never a 400;
+              hedge delay arms only on a warm latency window; the shed
+              ladder escalates monotonically with queue fill.
+* batcher   — an expired/cancelled ticket is dropped at batch-formation
+              time (never computed for nobody) and counted in stats.
+* pool      — a wedged replica 504s its dispatch and is ejected
+              (circuit OPEN) + rebuilt (HALF_OPEN) + probe-closed while
+              the others keep serving; a NaN-poisoned replica never
+              leaks non-finite outputs to a client; a hedge rescues the
+              dispatch AND the wedge is still detected; failed rebuilds
+              retry; all-circuits-open is a fast PoolUnavailableError.
+* server    — timeout_ms -> 504 + Retry-After; /metricsz carries the
+              robustness counters and the score window; the shed ladder
+              degrades proba -> sibling before the 429 cliff.
+* lifecycle — drift (KS) -> supervised retrain -> accuracy +
+              `dpsvm compare` gate -> atomic hot-swap; a failed gate
+              keeps the old generation serving bit-identically.
+* chaos     — subprocess acceptance: wedging 1 of 3 replicas
+              mid-loadgen keeps availability of accepted requests at
+              >= 99% with zero stray compiles and no process restart,
+              and the trace records eject -> rebuild.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def _mk_model(n_sv=40, d=5, seed=0, b=0.2, gamma=0.5):
+    from dpsvm_tpu.models.svm import SVMModel
+    rng = np.random.default_rng(seed)
+    return SVMModel(
+        x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+        alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+        y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(np.int32),
+        b=b, gamma=gamma)
+
+
+def _rows(n, d, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, d)).astype(np.float32)
+
+
+class StubEngine:
+    """Deterministic jax-free engine for pool/batcher unit tests."""
+
+    num_attributes = 4
+    calibrated = False
+
+    def __init__(self, delay_s: float = 0.0, value: float = 0.5):
+        self.delay_s = delay_s
+        self.value = value
+
+    def infer(self, x, want):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        n = int(np.shape(x)[0])
+        out = {}
+        if "labels" in want:
+            out["labels"] = np.ones(n, np.int32)
+        if "decision" in want:
+            out["decision"] = np.full(n, self.value, np.float32)
+        return out
+
+    def bucket_counts(self):
+        return {}
+
+
+@pytest.fixture()
+def faults():
+    """Install a FaultPlan for the test; guaranteed teardown (release
+    any wedged worker, clear the process plan)."""
+    from dpsvm_tpu.resilience import faultinject
+
+    def arm(**kw):
+        faultinject.reset_serve_wedge()
+        return faultinject.install(faultinject.FaultPlan(**kw))
+
+    yield arm
+    faultinject.release_serve_wedge()
+    faultinject.clear()
+
+
+# ---------------------------------------------------------------------
+# budget: deadlines, hedge delay, shed ladder
+# ---------------------------------------------------------------------
+
+def test_budget_is_absolute_and_expires():
+    from dpsvm_tpu.serving.budget import Budget, DeadlineExceededError
+
+    b = Budget(0.05)
+    assert not b.expired() and b.remaining() > 0
+    b.check("admission")                     # does not raise while live
+    time.sleep(0.06)
+    assert b.expired() and b.remaining() == 0.0
+    with pytest.raises(DeadlineExceededError, match="admission"):
+        b.check("admission")
+    # DeadlineExceededError IS a TimeoutError (504 mapping relies on
+    # it), and never a ValueError (the 400 family)
+    assert issubclass(DeadlineExceededError, TimeoutError)
+    assert not issubclass(DeadlineExceededError, ValueError)
+    with pytest.raises(ValueError):
+        Budget(0.0)
+
+
+def test_hedge_delay_arms_only_on_warm_window():
+    from dpsvm_tpu.serving.budget import (HEDGE_MAX_S, HEDGE_MIN_S,
+                                          hedge_delay_s)
+
+    # cold window: the conservative cap (hedging effectively off)
+    assert hedge_delay_s([5.0] * 3) == HEDGE_MAX_S
+    # warm window: p99-based, clamped
+    lat = [10.0] * 50 + [100.0] * 50         # p99 ~ 100 ms
+    d = hedge_delay_s(lat)
+    assert 0.09 <= d <= 0.12
+    assert hedge_delay_s([0.001] * 64) == HEDGE_MIN_S
+
+
+def test_degrade_controller_tiers_and_activations():
+    from dpsvm_tpu.serving.budget import (TIER_NONE, TIER_SHED_PROBA,
+                                          TIER_SHED_SIBLING,
+                                          DegradeController)
+
+    c = DegradeController(shed_proba_fill=0.5, shed_sibling_fill=0.8)
+    assert c.tier_for(0, 100) == TIER_NONE
+    assert c.tier_for(49, 100) == TIER_NONE
+    assert c.tier_for(50, 100) == TIER_SHED_PROBA
+    assert c.tier_for(80, 100) == TIER_SHED_SIBLING
+    # note() reports True exactly on escalation (the `shed` event)
+    assert c.note(TIER_SHED_PROBA) is True
+    assert c.note(TIER_SHED_PROBA) is False
+    assert c.note(TIER_SHED_SIBLING) is True
+    assert c.note(TIER_NONE) is False        # de-escalation is silent
+    st = c.stats()
+    assert st["activations"] == {"shed_proba": 1, "shed_sibling": 1}
+    assert DegradeController(enabled=False).tier_for(99, 100) == TIER_NONE
+    with pytest.raises(ValueError):
+        DegradeController(shed_proba_fill=0.9, shed_sibling_fill=0.5)
+
+
+# ---------------------------------------------------------------------
+# batcher: the expired-ticket bugfix
+# ---------------------------------------------------------------------
+
+def test_batcher_expired_ticket_dropped_at_batch_formation():
+    """The satellite bugfix: a ticket whose waiter gave up (or whose
+    deadline passed while queued) must NOT be computed — before this,
+    the worker burned a device pass and delivered into an abandoned
+    ticket."""
+    from dpsvm_tpu.serving.batcher import MicroBatcher
+    from dpsvm_tpu.serving.budget import DeadlineExceededError
+
+    computed = []
+
+    def infer_fn(x, want):
+        computed.append(int(x.shape[0]))
+        return {"labels": np.zeros(x.shape[0], np.int32)}
+
+    bat = MicroBatcher(infer_fn, max_batch=8, max_delay_ms=0.0,
+                       start=False)
+    # deadline already in the past -> wait() raises immediately and the
+    # worker (started later) never computes it
+    dead = bat.submit(_rows(3, 4), deadline=time.perf_counter() - 1.0)
+    live = bat.submit(_rows(2, 4))
+    with pytest.raises(DeadlineExceededError):
+        dead.wait(timeout=5.0)
+    bat.start()
+    assert live.wait(10.0)["labels"].shape == (2,)
+    bat.close(drain=True)
+    assert computed == [2], "expired rows must never reach the engine"
+    st = bat.stats()
+    assert st["expired"] == 1
+    assert st["requests"] == 2
+
+
+def test_batcher_waiter_timeout_cancels_ticket():
+    """A wait() that times out (no explicit deadline) cancels the
+    ticket; the stalled worker drops it at the next batch formation."""
+    from dpsvm_tpu.serving.batcher import MicroBatcher
+    from dpsvm_tpu.serving.budget import DeadlineExceededError
+
+    release = threading.Event()
+    computed = []
+
+    def infer_fn(x, want):
+        computed.append(int(x.shape[0]))
+        release.wait(20.0)
+        return {"labels": np.zeros(x.shape[0], np.int32)}
+
+    bat = MicroBatcher(infer_fn, max_batch=4, max_delay_ms=0.0)
+    t1 = bat.submit(_rows(1, 4))             # occupies the worker
+    deadline = time.perf_counter() + 5.0
+    while not computed and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    t2 = bat.submit(_rows(2, 4))             # queued behind the stall
+    with pytest.raises(DeadlineExceededError):
+        t2.wait(timeout=0.05)                # waiter gives up
+    t3 = bat.submit(_rows(3, 4))             # still-wanted work
+    release.set()
+    assert t1.wait(10.0)["labels"].shape == (1,)
+    assert t3.wait(10.0)["labels"].shape == (3,)
+    bat.close(drain=True)
+    assert 2 not in computed, "cancelled ticket must be skipped"
+    assert bat.stats()["expired"] == 1
+
+
+# ---------------------------------------------------------------------
+# replica pool
+# ---------------------------------------------------------------------
+
+def _wait_until(pred, timeout_s=10.0, interval_s=0.01):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+def test_pool_wedge_504_eject_rebuild_recover(faults):
+    from dpsvm_tpu.serving.budget import DeadlineExceededError
+    from dpsvm_tpu.serving.pool import ReplicaPool
+
+    faults(serve_wedge_replica=1)
+    pool = ReplicaPool(lambda i: StubEngine(), 3, name="wedge",
+                       deadline_s=0.3)
+    try:
+        outcomes = []
+        for _ in range(12):
+            try:
+                pool.infer(_rows(1, 4), ("labels",))
+                outcomes.append("ok")
+            except DeadlineExceededError:
+                outcomes.append("504")
+        # exactly the dispatch that hit the wedged replica 504s; the
+        # other replicas keep answering throughout
+        assert outcomes.count("504") == 1
+        assert outcomes.count("ok") == 11
+        assert _wait_until(lambda: pool.metrics()["rebuilds"] >= 1)
+        # the rebuilt replica re-enters through a probe in ordinary
+        # rotation: open -> half-open -> closed
+        for _ in range(6):
+            pool.infer(_rows(1, 4), ("labels",))
+        assert _wait_until(
+            lambda: pool.replica_states() == ["closed"] * 3), \
+            pool.replica_states()
+        seq = [e["event"] for e in pool.events]
+        assert seq[:2] == ["eject", "rebuild"], seq
+        m = pool.metrics()
+        assert m["ejections"] == 1 and m["rebuilds"] == 1
+        assert m["n_healthy"] == 3
+    finally:
+        pool.close()
+
+
+def test_pool_nan_poison_never_reaches_client(faults):
+    """A poisoned replica (non-finite outputs) is ejected on first
+    occurrence and its dispatch re-answered by a healthy replica — the
+    client sees finite values or an error, never NaN. The poison is
+    generation-pinned: the rebuilt replica runs clean."""
+    from dpsvm_tpu.serving.pool import ReplicaPool
+
+    faults(serve_nan_after=3)
+    pool = ReplicaPool(lambda i: StubEngine(), 3, name="poison",
+                       deadline_s=5.0)
+    try:
+        for _ in range(12):
+            out = pool.infer(_rows(1, 4), ("labels", "decision"))
+            assert np.all(np.isfinite(out["decision"]))
+        m = pool.metrics()
+        assert m["ejections"] == 1 and m["redispatches"] >= 1
+        assert _wait_until(lambda: pool.metrics()["rebuilds"] >= 1)
+        # rebuilt (next generation) replica serves clean
+        for _ in range(6):
+            out = pool.infer(_rows(2, 4), ("labels", "decision"))
+            assert np.all(np.isfinite(out["decision"]))
+        assert _wait_until(
+            lambda: pool.replica_states() == ["closed"] * 3)
+    finally:
+        pool.close()
+
+
+def test_pool_hedge_rescues_dispatch_and_wedge_still_ejected(faults):
+    """Hedging converts the wedged dispatch into a fast second answer,
+    AND the wedge is still detected via the replica's compute age —
+    a won hedge must not mask a stuck worker forever."""
+    from dpsvm_tpu.serving.pool import ReplicaPool
+
+    faults(serve_wedge_replica=1)
+    pool = ReplicaPool(lambda i: StubEngine(), 3, name="hedge",
+                       deadline_s=0.4, hedge=0.03)
+    try:
+        t0 = time.perf_counter()
+        out = pool.infer(_rows(1, 4), ("labels",))
+        assert out["labels"].shape == (1,)
+        assert time.perf_counter() - t0 < 0.3, \
+            "hedge must answer well before the deadline"
+        m = pool.metrics()
+        assert m["hedges_fired"] == 1 and m["hedges_won"] == 1
+        assert _wait_until(lambda: pool.metrics()["ejections"] >= 1)
+        assert _wait_until(lambda: pool.metrics()["rebuilds"] >= 1)
+    finally:
+        pool.close()
+
+
+def test_pool_failed_rebuild_retries_then_succeeds(faults):
+    from dpsvm_tpu.serving.pool import ReplicaPool
+
+    faults(serve_nan_after=1, serve_fail_reload=1)
+    pool = ReplicaPool(lambda i: StubEngine(), 2, name="rb",
+                       deadline_s=5.0, rebuild_backoff_s=0.01)
+    try:
+        out = pool.infer(_rows(1, 4), ("decision",))
+        assert np.all(np.isfinite(out["decision"]))
+        assert _wait_until(lambda: pool.metrics()["rebuilds"] >= 1)
+        m = pool.metrics()
+        assert m["rebuild_failures"] == 1
+        evs = [(e["event"], e.get("ok")) for e in pool.events]
+        assert ("rebuild", False) in evs and ("rebuild", True) in evs
+    finally:
+        pool.close()
+
+
+def test_pool_all_circuits_open_fast_503(faults):
+    from dpsvm_tpu.serving.pool import PoolUnavailableError, ReplicaPool
+
+    faults(serve_nan_after=1)
+    pool = ReplicaPool(lambda i: StubEngine(), 1, name="solo",
+                       deadline_s=5.0, rebuild=False)
+    try:
+        with pytest.raises(PoolUnavailableError):
+            pool.infer(_rows(1, 4), ("decision",))
+        t0 = time.perf_counter()
+        with pytest.raises(PoolUnavailableError):
+            pool.infer(_rows(1, 4), ("decision",))
+        assert time.perf_counter() - t0 < 0.5, \
+            "all-circuits-open must reject fast, not queue"
+        assert pool.n_healthy == 0
+    finally:
+        pool.close()
+
+
+def test_pool_refresh_swaps_generations_while_serving():
+    from dpsvm_tpu.serving.pool import ReplicaPool
+
+    vals = iter([1.0, 2.0, 2.0, 2.0])
+
+    def build(i):
+        return StubEngine(value=next(vals))
+
+    pool = ReplicaPool(build, 2, name="gen", deadline_s=5.0)
+    try:
+        # replica 0 serves 1.0, replica 1 serves 2.0 (round-robin)
+        got = {float(pool.infer(_rows(1, 4),
+                                ("decision",))["decision"][0])
+               for _ in range(4)}
+        assert got == {1.0, 2.0}
+        pool.refresh()
+        got = {float(pool.infer(_rows(1, 4),
+                                ("decision",))["decision"][0])
+               for _ in range(4)}
+        assert got == {2.0}, "refresh must serve the new generation"
+        assert all(r["generation"] == 2
+                   for r in pool.metrics()["replicas"])
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------
+# server: 504 mapping, metricsz counters, shed ladder
+# ---------------------------------------------------------------------
+
+@pytest.fixture()
+def resilient_server(tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    model = _mk_model(seed=21)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    srv = ServingServer(reg, port=0, max_batch=8, max_delay_ms=1.0,
+                        max_queue=64, replicas=2).start()
+    yield srv, model, path
+    srv.drain(timeout=10.0)
+
+
+def _post_raw(url, payload, timeout=15.0):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_server_deadline_maps_to_504_not_400(resilient_server):
+    srv, _model, _path = resilient_server
+    q = _rows(2, 5, seed=22)
+    code, body, headers = _post_raw(
+        srv.url + "/v1/predict",
+        {"instances": q.tolist(), "timeout_ms": 0.001})
+    assert code == 504, (code, body)
+    assert "Retry-After" in headers
+    # an invalid budget is the CLIENT's mistake: 400
+    code, body, _ = _post_raw(
+        srv.url + "/v1/predict",
+        {"instances": q.tolist(), "timeout_ms": -5})
+    assert code == 400
+    code, body, _ = _post_raw(
+        srv.url + "/v1/predict",
+        {"instances": q.tolist(), "timeout_ms": "soon"})
+    assert code == 400
+    # a sane budget still answers
+    code, body, _ = _post_raw(
+        srv.url + "/v1/predict",
+        {"instances": q.tolist(), "timeout_ms": 30000})
+    assert code == 200
+
+
+def test_server_metricsz_robustness_counters(resilient_server):
+    import urllib.request
+    srv, _model, _path = resilient_server
+    q = _rows(3, 5, seed=23)
+    _post_raw(srv.url + "/v1/predict", {"instances": q.tolist()})
+    _post_raw(srv.url + "/v1/predict",
+              {"instances": q.tolist(), "timeout_ms": 0.001})
+    with urllib.request.urlopen(srv.url + "/metricsz") as r:
+        m = json.loads(r.read())
+    for key in ("deadline_504", "rejected", "expired", "ejections",
+                "rebuilds", "hedges_fired", "hedges_won",
+                "shed_proba", "shed_sibling", "stray_compiles"):
+        assert key in m, key
+    assert m["deadline_504"] >= 1
+    assert m["degrade"]["tier_name"] == "none"
+    # the rolling score-distribution window the drift detector reads
+    assert m["score_window"]["count"] >= 3
+    assert m["score_window"]["std"] is not None
+    pool = m["models"]["default"]["pool"]
+    assert pool["n_replicas"] == 2 and pool["n_healthy"] == 2
+    assert pool["stray_compiles"] == 0
+    assert [r["state"] for r in pool["replicas"]] == ["closed"] * 2
+    win = srv.score_window()
+    assert win.size >= 3 and np.all(np.isfinite(win))
+
+
+def test_server_shed_ladder_proba_then_sibling(tmp_path):
+    """Under queue pressure the server first drops proba (tier 1),
+    then serves from the registered sibling (tier 2) — before the
+    queue-full 429 cliff. Driven through the public degrade() policy
+    seam with real registered engines."""
+    from dpsvm_tpu.models.calibration import save_platt
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    main = _mk_model(seed=24)
+    sib = _mk_model(seed=25)
+    mpath, spath = str(tmp_path / "m.svm"), str(tmp_path / "s.svm")
+    save_model(main, mpath)
+    save_platt(mpath, -1.0, 0.0)
+    save_model(sib, spath)
+    reg = ModelRegistry()
+    reg.register("default", mpath, max_batch=4)
+    reg.register("approx-twin", spath, max_batch=4)
+    srv = ServingServer(reg, port=0, max_batch=4, max_queue=10,
+                        siblings={"default": "approx-twin"},
+                        shed_proba_fill=0.3, shed_sibling_fill=0.6)
+    try:
+        # tier is a pure function of queue fill; drive it directly
+        want = ("labels", "proba")
+        assert srv.degrade("default", want) == ("default", want, None)
+        srv.degrader.note(0)
+        # fill >= 0.3 -> proba shed
+        srv.batcher("default")._rows_queued = 3
+        name, eff, marker = srv.degrade("default", want)
+        assert name == "default" and "proba" not in eff
+        assert marker == "shed_proba"
+        # fill >= 0.6 -> whole request shed to the sibling
+        srv.batcher("default")._rows_queued = 7
+        name, eff, marker = srv.degrade("default", want)
+        assert name == "approx-twin" and marker == "sibling:approx-twin"
+        assert "proba" not in eff
+        srv.batcher("default")._rows_queued = 0
+        m = srv.metrics()
+        assert m["shed_proba"] >= 1 and m["shed_sibling"] >= 1
+        shed_events = [e for e in m["events"] if e["event"] == "shed"]
+        assert len(shed_events) == 2, "one event per ESCALATION"
+        # width mismatch is rejected at registration
+        wide = _mk_model(seed=26, d=7)
+        wpath = str(tmp_path / "w.svm")
+        save_model(wide, wpath)
+        reg.register("wide", wpath, max_batch=4)
+        with pytest.raises(ValueError, match="attributes"):
+            srv.set_sibling("default", "wide")
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_registry_failed_reload_fault_keeps_old_generation(tmp_path,
+                                                           faults):
+    """DPSVM_FAULT_SERVE_FAIL_RELOAD: the injected reload failure
+    surfaces as an error and the old generation keeps serving."""
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.resilience.faultinject import InjectedFaultError
+    from dpsvm_tpu.serving import ModelRegistry
+
+    model = _mk_model(seed=27)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("m", path, max_batch=4)
+    q = _rows(2, 5, seed=28)
+    before = np.asarray(reg.engine("m").decision_values(q))
+    faults(serve_fail_reload=1)
+    with pytest.raises(InjectedFaultError):
+        reg.reload("m")
+    assert reg.manifests()["m"]["generation"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(reg.engine("m").decision_values(q)), before)
+    # fire-once: the next reload succeeds
+    reg.reload("m")
+    assert reg.manifests()["m"]["generation"] == 2
+
+
+# ---------------------------------------------------------------------
+# lifecycle: drift -> retrain -> gate -> hot-swap
+# ---------------------------------------------------------------------
+
+def test_ks_distance_and_drift_detector():
+    from dpsvm_tpu.serving.lifecycle import DriftDetector, ks_distance
+
+    rng = np.random.default_rng(0)
+    ref = rng.standard_normal(512)
+    same = np.random.default_rng(1).standard_normal(512)
+    shifted = 2.0 + np.random.default_rng(2).standard_normal(512)
+    assert ks_distance(ref, ref) == 0.0
+    assert ks_distance(ref, same) < 0.1
+    assert ks_distance(ref, shifted) > 0.6
+    assert 0.0 <= ks_distance(ref, shifted) <= 1.0
+
+    det = DriftDetector(ref, threshold=0.25, min_count=64)
+    assert det.check(same) is None
+    assert det.check(shifted[:32]) is None, "below min_count: no verdict"
+    drift = det.check(shifted)
+    assert drift is not None and drift["ks"] > 0.25
+    # rearm against the shifted distribution -> no longer drift
+    det.rearm(shifted)
+    assert det.check(2.0 + np.random.default_rng(3).standard_normal(
+        256)) is None
+    # non-finite scores are excluded from the window, not counted
+    with_nan = np.concatenate([same, [np.nan] * 50])
+    assert det.check(with_nan) is not None  # vs shifted reference
+    with pytest.raises(ValueError):
+        DriftDetector(ref, threshold=0.0)
+    with pytest.raises(ValueError):
+        DriftDetector([1.0])
+
+
+def _blobs_csvless(n=240, d=4, seed=7):
+    from dpsvm_tpu.data.synthetic import make_blobs
+    x, y = make_blobs(n=n, d=d, seed=seed)
+    return (np.asarray(x, np.float32),
+            np.asarray(y, np.int32))
+
+
+def test_lifecycle_end_to_end_real_retrain_and_hot_swap(tmp_path):
+    """The acceptance loop on a real (tiny) training problem: injected
+    drift -> run_with_retries-supervised retrain (traced) -> held-out
+    accuracy + `dpsvm compare` gate -> atomic hot-swap through the
+    registry; the detector re-arms against the promoted generation."""
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import decision_function
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.lifecycle import (DriftDetector,
+                                             LifecycleLoop,
+                                             RetrainResult)
+
+    x, y = _blobs_csvless()
+    x_tr, y_tr = x[:180], y[:180]
+    x_ho, y_ho = x[180:], y[180:]
+    base_trace = str(tmp_path / "base.jsonl")
+    model, _ = fit(x_tr, y_tr, SVMConfig(c=5.0, gamma=0.5,
+                                         trace_out=base_trace))
+    path = str(tmp_path / "serving.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+
+    ref_scores = np.asarray(decision_function(model, x_tr), np.float64)
+    det = DriftDetector(ref_scores, threshold=0.25, min_count=64)
+    live_window = ref_scores + 3.0           # injected location drift
+
+    def retrain(resume_from, attempt):
+        cand_trace = str(tmp_path / "cand.jsonl")
+        cand, _ = fit(x_tr, y_tr, SVMConfig(c=5.0, gamma=0.5,
+                                            trace_out=cand_trace))
+        cand_path = str(tmp_path / "candidate.svm")
+        save_model(cand, cand_path)
+        return RetrainResult(
+            model_path=cand_path, trace_path=cand_trace,
+            reference_scores=np.asarray(
+                decision_function(cand, x_tr), np.float64) + 3.0)
+
+    def evaluate(model_path):
+        from dpsvm_tpu.models.io import load_model
+        cand = load_model(model_path)
+        pred = np.where(np.asarray(decision_function(cand, x_ho)) < 0,
+                        -1, 1)
+        return float(np.mean(pred == y_ho))
+
+    events = []
+    loop = LifecycleLoop(
+        registry=reg, name="default", detector=det,
+        score_source=lambda: live_window,
+        retrain_fn=retrain, eval_fn=evaluate, accuracy_floor=0.7,
+        baseline_trace=base_trace, fail_on_regress_pct=50.0,
+        on_event=lambda e, **kw: events.append((e, kw)))
+    assert loop.step() == "promoted"
+    assert [e for e, _ in events] == ["drift", "retrain", "promote"]
+    assert events[-1][1]["ok"] is True
+    assert reg.manifests()["default"]["generation"] == 2
+    # the swap was atomic through the source path: the registry engine
+    # serves exactly the promoted artifact
+    from dpsvm_tpu.models.io import load_model
+    promoted = load_model(path)
+    q = x_ho[:8]
+    np.testing.assert_allclose(
+        np.asarray(reg.engine("default").decision_values(q)),
+        decision_function(promoted, q), atol=1e-5)
+    # re-armed against the promoted generation: same window, no drift
+    assert loop.step() == "no-drift"
+
+
+def test_lifecycle_failed_gate_keeps_old_generation_bit_identical(
+        tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import decision_function
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.lifecycle import (DriftDetector,
+                                             LifecycleLoop,
+                                             RetrainResult)
+
+    model = _mk_model(seed=30)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    q = _rows(6, 5, seed=31)
+    before = np.asarray(reg.engine("default").decision_values(q))
+
+    ref = np.random.default_rng(0).standard_normal(256)
+    cand = _mk_model(seed=32, b=9.0)
+
+    def retrain(resume_from, attempt):
+        cand_path = str(tmp_path / "cand.svm")
+        save_model(cand, cand_path)
+        return RetrainResult(model_path=cand_path)
+
+    events = []
+    loop = LifecycleLoop(
+        registry=reg, name="default",
+        detector=DriftDetector(ref, threshold=0.25),
+        score_source=lambda: 3.0 + ref,
+        retrain_fn=retrain, eval_fn=lambda p: 0.40,
+        accuracy_floor=0.90,
+        on_event=lambda e, **kw: events.append((e, kw)))
+    assert loop.step() == "gate-held"
+    promote = [kw for e, kw in events if e == "promote"]
+    assert promote and promote[0]["ok"] is False
+    assert "floor" in str(promote[0]["problems"])
+    # nothing moved: generation AND served bytes are identical
+    assert reg.manifests()["default"]["generation"] == 1
+    after = np.asarray(reg.engine("default").decision_values(q))
+    assert np.array_equal(before.view(np.int32), after.view(np.int32))
+    # a crashing eval gate also HOLDS (never promotes on uncertainty)
+    loop2 = LifecycleLoop(
+        registry=reg, name="default",
+        detector=DriftDetector(ref, threshold=0.25),
+        score_source=lambda: 3.0 + ref,
+        retrain_fn=retrain,
+        eval_fn=lambda p: (_ for _ in ()).throw(RuntimeError("boom")),
+        accuracy_floor=0.5)
+    assert loop2.step() == "gate-held"
+    assert reg.manifests()["default"]["generation"] == 1
+
+
+def test_lifecycle_compare_gate_blocks_regressed_candidate(tmp_path):
+    """The `dpsvm compare` arm of the gate, pinned on the committed
+    fixture pair (compare_regressed plants a 20% it/s regression)."""
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.lifecycle import (DriftDetector,
+                                             LifecycleLoop,
+                                             RetrainResult)
+
+    model = _mk_model(seed=33)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    ref = np.random.default_rng(0).standard_normal(256)
+    cand = _mk_model(seed=34)
+
+    def retrain(resume_from, attempt):
+        cand_path = str(tmp_path / "cand.svm")
+        save_model(cand, cand_path)
+        return RetrainResult(
+            model_path=cand_path,
+            trace_path=os.path.join(FIXTURES, "compare_regressed.jsonl"))
+
+    loop = LifecycleLoop(
+        registry=reg, name="default",
+        detector=DriftDetector(ref, threshold=0.25),
+        score_source=lambda: 3.0 + ref,
+        retrain_fn=retrain, eval_fn=lambda p: 0.99, accuracy_floor=0.5,
+        baseline_trace=os.path.join(FIXTURES, "compare_base.jsonl"),
+        fail_on_regress_pct=10.0)
+    assert loop.step() == "gate-held"
+    assert reg.manifests()["default"]["generation"] == 1
+    gate = loop.gate(retrain(None, 0))
+    assert not gate.passed
+    assert any("regressed" in p for p in gate.problems)
+
+
+def test_lifecycle_supervised_retrain_retries_preemption(tmp_path):
+    """The retrain runs under run_with_retries: a PreemptedError on
+    attempt 0 is retried, and the refresh still lands."""
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.resilience.preempt import PreemptedError
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.lifecycle import (DriftDetector,
+                                             LifecycleLoop,
+                                             RetrainResult)
+
+    model = _mk_model(seed=35)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    ref = np.random.default_rng(0).standard_normal(256)
+    attempts = []
+
+    def retrain(resume_from, attempt):
+        attempts.append(attempt)
+        if attempt == 0:
+            raise PreemptedError(signal.SIGTERM, n_iter=10)
+        cand_path = str(tmp_path / "cand.svm")
+        save_model(_mk_model(seed=36), cand_path)
+        return RetrainResult(model_path=cand_path)
+
+    loop = LifecycleLoop(
+        registry=reg, name="default",
+        detector=DriftDetector(ref, threshold=0.25),
+        score_source=lambda: 3.0 + ref,
+        retrain_fn=retrain, eval_fn=lambda p: 0.99, accuracy_floor=0.5,
+        retries=2, backoff_s=0.0)
+    assert loop.step() == "promoted"
+    assert attempts == [0, 1]
+    assert reg.manifests()["default"]["generation"] == 2
+
+
+# ---------------------------------------------------------------------
+# chaos acceptance (subprocess) + saturate smoke
+# ---------------------------------------------------------------------
+
+def _serve_proc(tmp_path, model_path, extra=(), fault_env=()):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(dict(fault_env))
+    port_file = tmp_path / "port.txt"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "serve", "-m",
+         model_path, "--port", "0", "--port-file", str(port_file),
+         "--max-batch", "16", *extra],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if port_file.exists() and port_file.read_text().strip():
+            break
+        if p.poll() is not None:
+            raise AssertionError(f"serve died: {p.communicate()[1]}")
+        time.sleep(0.2)
+    else:
+        p.kill()
+        raise AssertionError("serve never wrote its port file")
+    return p, int(port_file.read_text())
+
+
+def test_chaos_wedged_replica_availability_and_recovery(tmp_path):
+    """THE chaos acceptance: wedge 1 of 3 replicas mid-loadgen.
+    Availability of accepted requests stays >= 99%, the trace records
+    eject -> rebuild, post-warmup compile count stays 0 across all
+    surviving replicas, and the process never restarts (one pid, exit
+    0 on drain)."""
+    from dpsvm_tpu.models.io import save_model
+    model = _mk_model(seed=40, n_sv=48, d=6)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    trace = str(tmp_path / "chaos_trace.jsonl")
+    p, port = _serve_proc(
+        tmp_path, path,
+        extra=("--replicas", "3", "--deadline-ms", "500",
+               "--hedge-ms", "50", "--trace-out", trace, "-q"),
+        fault_env=(("DPSVM_FAULT_SERVE_WEDGE_REPLICA", "2"),
+                   ("DPSVM_FAULT_SERVE_WEDGE_AFTER", "40")))
+    first_pid = p.pid
+    try:
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "dpsvm_tpu.cli", "loadgen", "--url",
+             f"http://127.0.0.1:{port}", "--requests", "600",
+             "--concurrency", "6", "--chaos",
+             "--no-compare-sequential"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+        row = json.loads(r.stdout.strip().splitlines()[-1])
+        assert row["availability_pct"] >= 99.0, row
+        chaos = row["chaos"]
+        assert chaos["ejections"] >= 1, chaos
+        assert chaos["rebuilds"] >= 1, chaos
+        assert chaos["stray_compiles"] == 0, \
+            "surviving replicas must not retrace under chaos"
+        # the wedged replica was rebuilt and recovered
+        assert row["replica_states"].count("closed") == 3, row
+    finally:
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=120)
+    assert p.pid == first_pid and p.returncode == 0, err[-2000:]
+    events = [json.loads(l) for l in open(trace)
+              if json.loads(l).get("kind") == "event"]
+    names = [e["event"] for e in events]
+    assert "eject" in names and "rebuild" in names
+    assert names.index("eject") < names.index("rebuild"), names
+    # the trace is a valid v2 artifact (report/compare consume it)
+    from dpsvm_tpu.observability.report import load_trace
+    from dpsvm_tpu.observability.schema import validate_trace
+    validate_trace(load_trace(trace))
+
+
+def test_saturate_smoke_slo_row(resilient_server):
+    """`loadgen --saturate` shape-and-sanity (no absolute-perf assert
+    on CPU): a generous p99 target yields a met SLO row with sustained
+    throughput; an impossible target yields slo_met=False with the
+    stepped evidence attached."""
+    from dpsvm_tpu.serving.loadgen import run_saturate
+
+    srv, _model, _path = resilient_server
+    rows = _rows(64, 5, seed=50)
+    row = run_saturate(srv.url, rows, p99_target_ms=60000.0,
+                       start_rps=40.0, rps_factor=2.0, max_steps=2,
+                       step_requests=40, concurrency=8)
+    assert row["metric"] == "serving_slo_max_rps"
+    assert row["slo_met"] is True
+    assert row["value"] > 0 and row["sustained_rps"] > 0
+    assert row["availability_pct"] == 100.0
+    assert 1 <= len(row["steps"]) <= 2
+    assert all(s["slo_met"] for s in row["steps"])
+
+    row = run_saturate(srv.url, rows, p99_target_ms=1e-6,
+                       start_rps=40.0, max_steps=3, step_requests=20)
+    assert row["slo_met"] is False and row["value"] == 0.0
+    assert len(row["steps"]) == 1, "first unmet step must stop stepping"
