@@ -120,6 +120,14 @@ TAGS = [
     sub("approx_vs_exact", R4, 900, [sys.executable, "bench.py"],
         BENCH_CASE="approx-vs-exact", BENCH_N=100_000, BENCH_D=64,
         BENCH_APPROX_DIM=1024, BENCH_PRECISION="DEFAULT"),
+    # Elastic distributed fault drill: the resilience selfcheck now
+    # includes the kill-one-shard -> degraded-mesh-resume drill
+    # (resilience/elastic.py), so this tag proves the recovery loop on
+    # the round's actual hardware, not just virtual CPU devices —
+    # desync/heartbeat probes ride the ordinary packed-stats transfer,
+    # so the run doubles as a "probes cost nothing on chip" check.
+    sub("dist_fault_drill", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.resilience", "--selfcheck"]),
     sub("inference", R3, 240,
         [sys.executable, "benchmarks/inference_bench.py"],
         BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),

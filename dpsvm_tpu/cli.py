@@ -59,6 +59,11 @@ def _add_data_flags(p: argparse.ArgumentParser,
                    help="attribute count (inferred when omitted)")
     p.add_argument("-x", "--num-ex", type=int, default=None,
                    help="example count (inferred when omitted)")
+    p.add_argument("--allow-nonfinite", action="store_true",
+                   help="escape hatch: load rows containing NaN/Inf "
+                        "features instead of rejecting the file (the "
+                        "solver will NOT converge on them — use only "
+                        "to inspect a damaged dataset)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -349,6 +354,27 @@ def build_parser() -> argparse.ArgumentParser:
                      help="seconds to wait for backend initialization "
                           "before reporting it unreachable (a tunneled "
                           "TPU that is down would otherwise hang here)")
+
+    dr = sub.add_parser(
+        "doctor", help="distributed-training preflight: device/mesh "
+                       "topology, a timed tiny shard_map collective "
+                       "probe, checkpoint-dir writability + "
+                       "newest-slot integrity; exits non-zero with a "
+                       "one-line diagnosis (docs/DISTRIBUTED.md "
+                       "'Elastic training')")
+    dr.add_argument("--shards", type=int, default=0,
+                    help="mesh size to probe (0 = every visible "
+                         "device)")
+    dr.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="checkpoint path a run would use: the doctor "
+                         "checks the directory is writable and the "
+                         "newest rotation slot is intact (reporting "
+                         "its recorded mesh/iteration)")
+    dr.add_argument("--timeout", type=float, default=60.0,
+                    help="bounded wait for backend init AND for the "
+                         "collective probe (a hung interconnect "
+                         "surfaces here in seconds, not an hour into "
+                         "a run)")
 
     rp = sub.add_parser(
         "report", help="render a run-telemetry trace (train "
@@ -805,7 +831,8 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     x, y = load_dataset(args.input, args.num_ex, args.num_att,
                         float_labels=(args.svr or args.one_class
-                                      or args.nu_svr))
+                                      or args.nu_svr),
+                        allow_nonfinite=args.allow_nonfinite)
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, kernel=args.kernel,
         degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
@@ -1070,7 +1097,8 @@ def cmd_test(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
         d_model = mc.models[0].num_attributes
-        x, y = load_dataset(args.input, args.num_ex, _width_hint(d_model))
+        x, y = load_dataset(args.input, args.num_ex, _width_hint(d_model),
+                            allow_nonfinite=args.allow_nonfinite)
         if x.shape[1] != d_model:
             print(f"error: dataset has {x.shape[1]} attributes, model has "
                   f"{d_model}", file=sys.stderr)
@@ -1142,7 +1170,8 @@ def cmd_test(args: argparse.Namespace) -> int:
     # SV. Dense CSVs carry their true width — a mismatch there (or a
     # wider dataset against a reference-format model) is a real error.
     x, y = load_dataset(args.input, args.num_ex, args.num_att,
-                        float_labels=model.task == "svr")
+                        float_labels=model.task == "svr",
+                        allow_nonfinite=args.allow_nonfinite)
     if x.shape[1] != model.num_attributes:
         import dataclasses
 
@@ -1623,6 +1652,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_scale(args)
         if args.command == "info":
             return cmd_info(args)
+        if args.command == "doctor":
+            from dpsvm_tpu.resilience.doctor import run_doctor
+            return run_doctor(shards=args.shards,
+                              checkpoint_path=args.checkpoint,
+                              timeout_s=args.timeout)
         if args.command == "report":
             return cmd_report(args)
         if args.command == "compare":
@@ -1649,9 +1683,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     except Exception as e:
         # CheckpointError (corrupt file with no intact rotation slot)
-        # lives in a module imported lazily with the solvers — resolve
-        # it the same way so `--help` never pays the numpy import.
+        # and ShardLostError live in modules imported lazily with the
+        # solvers — resolve them the same way so `--help` never pays
+        # the numpy import.
+        from dpsvm_tpu.resilience.elastic import ShardLostError
         from dpsvm_tpu.utils.checkpoint import CheckpointError
+        if isinstance(e, ShardLostError):
+            # Transient like a preemption: the run resumes from the
+            # newest intact checkpoint — on whatever mesh the relaunch
+            # sees (the elastic re-shard path). 75 is the supervisor's
+            # retry cue.
+            print(f"shard lost: {e}", file=sys.stderr)
+            return PREEMPT_EXIT_CODE
         if isinstance(e, CheckpointError):
             print(f"error: {e}", file=sys.stderr)
             return 2

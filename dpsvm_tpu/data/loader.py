@@ -56,6 +56,7 @@ def load_csv(
     num_examples: Optional[int] = None,
     num_attributes: Optional[int] = None,
     float_labels: bool = False,
+    allow_nonfinite: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load a dense ``label,f1,...,fd`` CSV into (x, y) NumPy arrays.
 
@@ -63,7 +64,10 @@ def load_csv(
     shape arguments are given (reference ``-a``/``-x`` flag parity), only
     that many rows/columns are read. ``float_labels=True`` keeps y as
     float32 (regression targets; the pure-Python parse path — the native
-    fast path emits int labels).
+    fast path emits int labels). NaN/Inf feature values are rejected
+    with an error naming the offending row (the solver would silently
+    never converge on them); ``allow_nonfinite=True`` is the explicit
+    escape hatch (warns, loads anyway — CLI ``--allow-nonfinite``).
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
@@ -87,7 +91,7 @@ def load_csv(
             n, d,
         )
         if got == n:
-            return _check_finite(x, path), y
+            return _check_finite(x, path, allow_nonfinite), y
         # Malformed / short file: fall through to the Python parser for a
         # readable error.
 
@@ -111,11 +115,11 @@ def load_csv(
             i += 1
     if i < n:
         raise ValueError(f"{path}: expected {n} rows, found {i}")
-    return _check_finite(xs, path), ys
+    return _check_finite(xs, path, allow_nonfinite), ys
 
 
 def _load_libsvm_native(lib, path, num_examples, num_attributes,
-                        float_labels):
+                        float_labels, allow_nonfinite=False):
     """C++ fast path for load_libsvm; None = fall back to Python (both
     for hard parse errors, so the user sees the line-numbered message,
     and for validation failures the scalar return code cannot carry)."""
@@ -156,7 +160,7 @@ def _load_libsvm_native(lib, path, num_examples, num_attributes,
         if not np.array_equal(yi.astype(np.float32), y):
             return None                  # non-integer labels: Python error
         y = yi
-    return _check_finite(x, path), y
+    return _check_finite(x, path, allow_nonfinite), y
 
 
 def load_libsvm(
@@ -164,6 +168,7 @@ def load_libsvm(
     num_examples: Optional[int] = None,
     num_attributes: Optional[int] = None,
     float_labels: bool = False,
+    allow_nonfinite: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load a libsvm/svmlight sparse file ``<label> idx:val ...`` directly.
 
@@ -191,7 +196,7 @@ def load_libsvm(
     lib = load_native_lib()
     if lib is not None:
         out = _load_libsvm_native(lib, path, num_examples, num_attributes,
-                                  float_labels)
+                                  float_labels, allow_nonfinite)
         if out is not None:
             return out
         # Malformed input (or short file): fall through to the Python
@@ -250,7 +255,7 @@ def load_libsvm(
     for i, (idxs, vals) in enumerate(rows):
         keep = idxs <= d
         x[i, idxs[keep] - 1] = vals[keep]
-    return _check_finite(x, path), np.asarray(
+    return _check_finite(x, path, allow_nonfinite), np.asarray(
         labels, dtype=np.float32 if float_labels else np.int32)
 
 
@@ -278,6 +283,7 @@ def load_dataset(
     num_examples: Optional[int] = None,
     num_attributes: Optional[int] = None,
     float_labels: bool = False,
+    allow_nonfinite: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load a dataset in either supported format (sniffed per file).
 
@@ -288,17 +294,32 @@ def load_dataset(
     overrides with identical semantics (short files error).
     """
     if sniff_format(path) == "libsvm":
-        return load_libsvm(path, num_examples, num_attributes, float_labels)
-    return load_csv(path, num_examples, num_attributes, float_labels)
+        return load_libsvm(path, num_examples, num_attributes,
+                           float_labels, allow_nonfinite)
+    return load_csv(path, num_examples, num_attributes, float_labels,
+                    allow_nonfinite)
 
 
-def _check_finite(x: np.ndarray, path: str) -> np.ndarray:
+def _check_finite(x: np.ndarray, path: str,
+                  allow: bool = False) -> np.ndarray:
     """NaN/Inf features would silently poison f and never converge
-    (the solver is exp/argmin-based); fail at load time instead."""
+    (the solver is exp/argmin-based); fail at load time instead,
+    naming the offending row. ``allow=True`` (the ``--allow-nonfinite``
+    escape hatch) degrades the rejection to a stderr warning for
+    deliberately inspecting damaged datasets."""
     if not np.isfinite(x).all():
         bad = np.argwhere(~np.isfinite(x))[0]
-        raise ValueError(
+        msg = (
             f"{path}: non-finite feature value at row {int(bad[0])}, "
             f"column {int(bad[1])} (x[{int(bad[0])},{int(bad[1])}] = "
             f"{x[bad[0], bad[1]]})")
+        if not allow:
+            raise ValueError(
+                msg + " — rejected at load; pass --allow-nonfinite / "
+                "allow_nonfinite=True to load anyway")
+        import sys
+        n_bad = int((~np.isfinite(x)).sum())
+        print(f"WARNING: {msg}; loading anyway with {n_bad} "
+              "non-finite value(s) (--allow-nonfinite)",
+              file=sys.stderr, flush=True)
     return x

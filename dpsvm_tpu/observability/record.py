@@ -83,6 +83,17 @@ SOLVER_NAMES = {
 SERVING_EVENTS = ("eject", "rebuild", "shed", "hedge", "drift",
                   "retrain", "promote")
 
+# Event types the ELASTIC distributed layer emits into a training
+# trace (resilience/elastic.py, docs/DISTRIBUTED.md "Elastic
+# training"): `desync` = shards disagree on replicated-by-construction
+# poll state (carries `shards`; feeds the on_divergence policy),
+# `shard_lost` = a mesh shard died mid-run (the kill-shard drill /
+# a real host loss), `reshard` = a resume re-sliced the global
+# checkpoint state onto a different mesh (carries `from_shards` /
+# `to_shards`; rewinds the n_iter baseline like `rollback` —
+# observability/schema.REWIND_EVENTS).
+DIST_EVENTS = ("desync", "shard_lost", "reshard")
+
 
 def open_serving_trace(path: str, *, models: Optional[dict] = None,
                        env: Optional[dict] = None) -> "RunTrace":

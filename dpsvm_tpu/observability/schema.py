@@ -26,6 +26,14 @@ read. Version history:
 * v2 — adds the ``compile`` record kind, per-chunk ``hbm`` watermarks
   and ``phase_counts``, and the summary's compile/HBM/FLOP facts
   (``n_compiles``, ``compile_seconds``, ``hbm_peak``, ``est_flops``).
+  Additively (still v2): the elastic distributed events — ``desync``
+  (cross-shard disagreement; must carry ``shards``), ``reshard``
+  (resume re-sliced onto a different mesh; must carry ``from_shards``
+  and ``to_shards``, and like ``rollback`` it legitimately rewinds the
+  n_iter baseline to its checkpoint's iteration) and ``shard_lost``
+  (a mesh shard died mid-run) — docs/DISTRIBUTED.md "Elastic
+  training". Chunk records of distributed runs may carry
+  ``shard_ages`` (per-shard heartbeat ages, seconds).
 """
 
 from __future__ import annotations
@@ -63,6 +71,20 @@ KINDS = KINDS_V1 + ("compile",)
 # Everything else after a summary is trace corruption or interleaved
 # writers — rejected by validate_trace.
 TERMINAL_EVENTS = ("stall", "preempt")
+
+# Events that rewind the chunk-record n_iter baseline to their own
+# n_iter: `rollback` (checkpoint restored after divergence/corruption)
+# and `reshard` (resume re-sliced onto a different mesh — the
+# checkpoint's iteration restarts the count on the new mesh).
+REWIND_EVENTS = ("rollback", "reshard")
+
+# Required extra keys per elastic event type (beyond EVENT_KEYS):
+# a `desync` without its mesh size or a `reshard` without both mesh
+# sizes is useless to every consumer, so the validator rejects them.
+EVENT_EXTRA_KEYS = {
+    "desync": ("shards",),
+    "reshard": ("from_shards", "to_shards"),
+}
 
 
 class TraceWriter:
@@ -187,10 +209,13 @@ def validate_trace(records: List[dict]) -> List[str]:
                     errors.append(f"record {i}: {k} = {r[k]} < 0")
         elif kind == "event":
             miss = _missing(r, EVENT_KEYS)
+            extra = EVENT_EXTRA_KEYS.get(r.get("event"), ())
+            miss += _missing(r, extra)
             if miss:
                 errors.append(f"record {i}: event missing keys {miss}")
-            elif r.get("event") == "rollback":
-                # The run restarted from a checkpoint at this iteration.
+            elif r.get("event") in REWIND_EVENTS:
+                # The run restarted from a checkpoint at this iteration
+                # (rollback), possibly on a different mesh (reshard).
                 prev_iter = r["n_iter"]
         elif kind == "compile":
             miss = _missing(r, COMPILE_KEYS)

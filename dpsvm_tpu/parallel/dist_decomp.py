@@ -54,7 +54,7 @@ from dpsvm_tpu.parallel.dist_smo import (_local_slice,
                                          prepare_distributed_inputs)
 from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
                                      pcast_varying, shard_map_compat,
-                                     to_host)
+                                     shard_probe, to_host)
 from dpsvm_tpu.solver.decomp import inner_subsolve
 from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
                                      pack_stats, resume_state)
@@ -242,10 +242,14 @@ def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
             n_iter=pcast_varying(carry.n_iter),
             rounds=pcast_varying(carry.rounds))
         out = lax.while_loop(cond, body, carry)
+        # Pre-pmax per-shard probe for the desync detector
+        # (parallel/mesh.shard_probe, resilience/elastic.py).
+        probe = shard_probe(out.n_iter, out.b_lo, out.b_hi)
         return out._replace(b_hi=lax.pmax(out.b_hi, SHARD_AXIS),
                             b_lo=lax.pmax(out.b_lo, SHARD_AXIS),
                             n_iter=lax.pmax(out.n_iter, SHARD_AXIS),
-                            rounds=lax.pmax(out.rounds, SHARD_AXIS))
+                            rounds=lax.pmax(out.rounds, SHARD_AXIS)), \
+            probe
 
     carry_specs = DistDecompCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
                                   b_hi=P(), b_lo=P(), n_iter=P(),
@@ -254,13 +258,14 @@ def _build_dist_decomp_runner(mesh: jax.sharding.Mesh, c: float, kspec,
         run, mesh=mesh,
         in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec,
                   P(SHARD_AXIS), P()),
-        out_specs=carry_specs)
+        out_specs=(carry_specs, P(SHARD_AXIS)))
 
     def run_with_stats(carry, xs, ys, x2s, valid, limit):
-        final = mapped(carry, xs, ys, x2s, valid, limit)
-        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi,
-                                 n_sv=device_sv_count(final.alpha),
-                                 rounds=final.rounds)
+        final, probe = mapped(carry, xs, ys, x2s, valid, limit)
+        return final, jnp.concatenate([
+            pack_stats(final.n_iter, final.b_lo, final.b_hi,
+                       n_sv=device_sv_count(final.alpha),
+                       rounds=final.rounds), probe])
 
     return jax.jit(run_with_stats, donate_argnums=(0,))
 
@@ -281,7 +286,7 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
     eps = float(config.epsilon)
     q = 2 * min(int(config.working_set) // 2, n)
 
-    ckpt = resume_state(config, n, d, gamma)
+    ckpt = resume_state(config, n, d, gamma, shards=mesh.devices.size)
     di = prepare_distributed_inputs(x, y, config, mesh, ckpt,
                                     f_init, alpha_init)
     n_s = di.n_s
@@ -350,4 +355,5 @@ def train_distributed_decomp(x: np.ndarray, y: np.ndarray,
         it0=int(init[4]),
         poll_hook=poll_hook,
         carry_from_ckpt=carry_from_ckpt,
+        shards=mesh.devices.size,
     )

@@ -52,7 +52,7 @@ from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.ops.update import alpha_pair_step
 from dpsvm_tpu.parallel.mesh import (SHARD_AXIS, make_data_mesh,
                                      pcast_varying, shard_map_compat,
-                                     to_host)
+                                     shard_probe, to_host)
 from dpsvm_tpu.solver.driver import (device_sv_count, host_training_loop,
                                      pack_stats, resume_state)
 
@@ -414,10 +414,14 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
             ch=pcast_varying(carry.ch),
             cm=pcast_varying(carry.cm))
         out = lax.while_loop(cond, body, carry)
+        # The probe reads the PRE-pmax per-shard values: the fold below
+        # erases exactly the cross-shard disagreement the desync
+        # detector watches for (parallel/mesh.shard_probe).
+        probe = shard_probe(out.n_iter, out.b_lo, out.b_hi)
         return out._replace(b_hi=lax.pmax(out.b_hi, SHARD_AXIS),
                             b_lo=lax.pmax(out.b_lo, SHARD_AXIS),
                             ch=lax.pmax(out.ch, SHARD_AXIS),
-                            cm=lax.pmax(out.cm, SHARD_AXIS))
+                            cm=lax.pmax(out.cm, SHARD_AXIS)), probe
 
     carry_specs = DistCarry(alpha=P(SHARD_AXIS), f=P(SHARD_AXIS),
                             b_hi=P(), b_lo=P(), n_iter=P(),
@@ -427,19 +431,21 @@ def _build_dist_runner(mesh: jax.sharding.Mesh, c: float, kspec,
         run, mesh=mesh,
         in_specs=(carry_specs, x_spec, P(SHARD_AXIS), x_spec, P(SHARD_AXIS),
                   P()),
-        out_specs=carry_specs)
+        out_specs=(carry_specs, P(SHARD_AXIS)))
 
     def run_with_stats(carry, xs, ys, x2s, valid, limit):
-        final = mapped(carry, xs, ys, x2s, valid, limit)
+        final, probe = mapped(carry, xs, ys, x2s, valid, limit)
         # Packed poll scalars + telemetry counters as a second output
         # of the SAME compiled program — one D2H transfer per chunk, no
         # auxiliary XLA program (solver/driver.py "Poll economics").
         # The SV count reduces the global sharded alpha; padding rows
-        # hold alpha == 0 and never count.
-        return final, pack_stats(final.n_iter, final.b_lo, final.b_hi,
-                                 n_sv=device_sv_count(final.alpha),
-                                 cache_hits=final.ch,
-                                 cache_misses=final.cm)
+        # hold alpha == 0 and never count. The (3P,) per-shard probe
+        # tail rides the same array (resilience/elastic.py).
+        return final, jnp.concatenate([
+            pack_stats(final.n_iter, final.b_lo, final.b_hi,
+                       n_sv=device_sv_count(final.alpha),
+                       cache_hits=final.ch,
+                       cache_misses=final.cm), probe])
 
     return jax.jit(run_with_stats, donate_argnums=(0,))
 
@@ -543,7 +549,7 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
     kspec = config.kernel_spec(d)
     eps = float(config.epsilon)
 
-    ckpt = resume_state(config, n, d, gamma)
+    ckpt = resume_state(config, n, d, gamma, shards=p)
     di = prepare_distributed_inputs(x, y, config, mesh, ckpt,
                                     f_init, alpha_init)
     n_s = di.n_s
@@ -616,4 +622,5 @@ def train_distributed(x: np.ndarray, y: np.ndarray, config: SVMConfig,
                                  to_host(c.f)[:n]),
         it0=int(init[4]),
         carry_from_ckpt=carry_from_ckpt,
+        shards=p,
     )

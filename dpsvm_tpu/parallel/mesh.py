@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
@@ -53,6 +54,26 @@ def pcast_varying(v):
         return lax.pcast(v, (SHARD_AXIS,), to="varying")
     except ValueError:
         return v
+
+
+def shard_probe(n_iter, b_lo, b_hi):
+    """This shard's (3,) i32 probe: [n_iter, b_lo bits, b_hi bits].
+
+    Emitted PER SHARD (out_spec ``P(SHARD_AXIS)``) by both SPMD chunk
+    runners and appended to the packed-stats array, so the host reads
+    every shard's own view of the replicated-by-construction poll
+    scalars in the SAME single D2H transfer. Disagreement between rows
+    is a desynchronized mesh (resilience/elastic.py). Floats ride as
+    exact bit patterns, like the replicated stats lanes
+    (solver/driver.pack_stats). Called on the PRE-pmax loop outputs —
+    the pmax fold would erase exactly the per-shard disagreement this
+    probe exists to expose."""
+    bits = lax.bitcast_convert_type(
+        jnp.stack([jnp.asarray(b_lo, jnp.float32),
+                   jnp.asarray(b_hi, jnp.float32)]), jnp.int32)
+    head = jnp.reshape(
+        pcast_varying(jnp.asarray(n_iter, jnp.int32)), (1,))
+    return jnp.concatenate([head, bits])
 
 
 def to_host(arr) -> np.ndarray:

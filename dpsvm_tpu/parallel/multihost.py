@@ -81,3 +81,24 @@ def process_info() -> str:
     return (f"process {jax.process_index()}/{jax.process_count()}, "
             f"{jax.local_device_count()} local / "
             f"{jax.device_count()} global devices")
+
+
+def topology() -> dict:
+    """Device/process topology facts as one dictionary — consumed by
+    ``dpsvm doctor`` (resilience/doctor.py) and useful for logs. Safe
+    to call any time after the backend is up; initializes the backend
+    if it is not (callers wanting a bounded wait go through
+    ``utils.backend_guard.probe_devices`` first)."""
+    try:
+        devs = jax.devices()
+        return {
+            "platform": devs[0].platform,
+            "global_devices": len(devs),
+            "local_devices": jax.local_device_count(),
+            "processes": jax.process_count(),
+            "process_id": jax.process_index(),
+            "device_kinds": sorted({str(getattr(d, "device_kind", "?"))
+                                    for d in devs}),
+        }
+    except Exception as e:               # dead backend: report, not raise
+        return {"error": f"{type(e).__name__}: {e}"}

@@ -18,12 +18,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--selfcheck", action="store_true",
                    help="injector + supervisor round-trip on a tiny "
                         "problem; asserts the resumed trajectory is "
-                        "bitwise-identical to an uninterrupted run")
+                        "bitwise-identical to an uninterrupted run "
+                        "(incl. the kill-one-shard degraded-mesh "
+                        "drill on a virtual-device mesh)")
     args = p.parse_args(argv)
     if not args.selfcheck:
         p.print_help()
         return 2
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ["JAX_PLATFORMS"] == "cpu":
+        # The kill-shard drill needs a mesh: force virtual CPU devices
+        # unless the caller already pinned a device count (same pattern
+        # as tests/conftest.py).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
     from dpsvm_tpu.resilience import selfcheck
 
     problems = selfcheck()
@@ -33,7 +44,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  {pr}", file=sys.stderr)
         return 1
     print("resilience selfcheck OK (preempt + retry + rotation "
-          "fallback, bitwise-identical resume)")
+          "fallback + kill-shard degraded-mesh drill, "
+          "bitwise-identical resume)")
     return 0
 
 

@@ -1,0 +1,166 @@
+"""``dpsvm doctor``: is the cluster sane before burning an hour?
+
+"Parallel SVMs in Practice" (arXiv:1404.1066) observes that most
+wasted cluster time is spent discovering *environmental* failures —
+dead devices, hung interconnects, unwritable storage — an hour into a
+job instead of a second before it. The doctor is that second: a
+preflight that exercises exactly the three things a distributed
+training run depends on, each with a bounded wait, and exits non-zero
+with a one-line diagnosis.
+
+1. **Topology** — backend reachable within ``--timeout`` (the
+   tunneled-TPU hang is the motivating failure: utils/backend_guard),
+   device/mesh/process facts printed (parallel/multihost.topology).
+2. **Collective probe** — a tiny ``shard_map`` psum over the requested
+   mesh, run in a worker thread with a deadline: a hung ICI/DCN link
+   or a wedged device surfaces here in seconds, not after the first
+   real chunk. The probe result is also checked for correctness
+   (psum of ones == P) — a wrong answer is a worse sign than a hang.
+3. **Checkpoint health** — directory writability (create + remove a
+   probe file) and newest-slot integrity: the rotation set is scanned
+   exactly like a resume would (``newest_intact_checkpoint``), and the
+   newest intact slot's recorded mesh/iteration are reported so the
+   operator knows what a restart would resume (a mesh different from
+   ``--shards`` is reported as a pending re-shard, not an error —
+   docs/DISTRIBUTED.md "Elastic training").
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Callable, List, Optional, Tuple
+
+
+def _collective_probe(shards: int, timeout_s: float
+                      ) -> Tuple[bool, str]:
+    """psum(ones) over a ``shards``-device mesh with a deadline.
+    Returns (ok, detail). Runs in a daemon worker so a hung collective
+    cannot wedge the doctor past its budget."""
+    result: dict = {}
+
+    def work():
+        try:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            from dpsvm_tpu.parallel.mesh import (SHARD_AXIS,
+                                                 make_data_mesh,
+                                                 shard_map_compat)
+
+            mesh = make_data_mesh(shards)
+            probe = shard_map_compat(
+                lambda v: lax.psum(jnp.sum(v), SHARD_AXIS),
+                mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
+            got = float(jax.jit(probe)(jnp.ones((shards,))))
+            result["got"] = got
+        except Exception as e:
+            result["err"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=work, daemon=True,
+                         name="dpsvm-doctor-collective")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return False, (f"collective probe TIMED OUT after {timeout_s:g}s "
+                       f"on a {shards}-device mesh — suspect a hung "
+                       "interconnect or wedged device")
+    if "err" in result:
+        return False, f"collective probe failed: {result['err']}"
+    if result.get("got") != float(shards):
+        return False, (f"collective probe returned {result.get('got')} "
+                       f"!= {float(shards)} — a device is computing "
+                       "wrong answers")
+    return True, (f"psum over {shards} device"
+                  f"{'s' if shards != 1 else ''} OK "
+                  f"(= {result['got']:g})")
+
+
+def _checkpoint_probe(path: str, shards: int) -> Tuple[bool, List[str]]:
+    """Writability + newest-slot integrity of a checkpoint path."""
+    from dpsvm_tpu.utils.checkpoint import (load_checkpoint,
+                                            newest_intact_checkpoint)
+
+    lines: List[str] = []
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        os.makedirs(directory, exist_ok=True)
+        fd, probe = tempfile.mkstemp(dir=directory,
+                                     suffix=".doctor-probe")
+        os.close(fd)
+        os.unlink(probe)
+        lines.append(f"checkpoint dir writable: {directory}")
+    except OSError as e:
+        lines.append(f"checkpoint dir NOT writable: {directory} ({e})")
+        return False, lines
+    if not os.path.exists(path):
+        lines.append(f"no checkpoint yet at {path} (a fresh run "
+                     "starts from scratch)")
+        return True, lines
+    best, skipped = newest_intact_checkpoint(path)
+    if skipped:
+        lines.append(f"corrupt/unreadable slot(s) skipped: {skipped}")
+    if best is None:
+        lines.append(f"NO intact checkpoint slot at {path} — a "
+                     "restart cannot resume")
+        return False, lines
+    ck = load_checkpoint(best)
+    bad = ck.verify_shard_crcs()
+    if bad:
+        lines.append(f"newest intact slot {best} has damaged shard "
+                     f"region(s) {bad}")
+        return False, lines
+    note = ""
+    if ck.needs_reshard(shards):
+        note = (f" — saved on a {ck.mesh_desc()}, this mesh is "
+                f"{shards}: resume will RE-SHARD (not an error)")
+    lines.append(f"newest intact slot: {best} (iter {ck.n_iter}, "
+                 f"({ck.n}, {ck.d}) problem, {ck.shards}-shard "
+                 f"manifest){note}")
+    return True, lines
+
+
+def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
+               timeout_s: float = 60.0,
+               out: Callable[[str], None] = print) -> int:
+    """The full preflight; returns the process exit code (0 = sane).
+    Prints its findings through ``out`` and always ends with one
+    DOCTOR line carrying the verdict."""
+    from dpsvm_tpu.utils.backend_guard import probe_devices
+
+    devices, reason = probe_devices(timeout_s)
+    if devices is None:
+        out(f"backend: UNREACHABLE ({reason})")
+        out(f"DOCTOR FAIL: backend unreachable — {reason}")
+        return 3
+    from dpsvm_tpu.parallel.multihost import topology
+
+    topo = topology()
+    out(f"backend: {topo.get('platform')} "
+        f"({topo.get('global_devices')} device(s), "
+        f"{topo.get('local_devices')} local, "
+        f"process {topo.get('process_id')}/{topo.get('processes')}, "
+        f"kinds {topo.get('device_kinds')})")
+    p = int(shards) or len(devices)
+    if p > len(devices):
+        out(f"DOCTOR FAIL: asked for {p} shards but only "
+            f"{len(devices)} devices are visible")
+        return 4
+    ok, detail = _collective_probe(p, timeout_s)
+    out(f"collective: {detail}")
+    if not ok:
+        out(f"DOCTOR FAIL: {detail}")
+        return 5
+    if checkpoint_path:
+        ck_ok, lines = _checkpoint_probe(checkpoint_path, p)
+        for ln in lines:
+            out(f"checkpoint: {ln}")
+        if not ck_ok:
+            out(f"DOCTOR FAIL: {lines[-1]}")
+            return 6
+    out(f"DOCTOR OK: {p}-shard mesh sane"
+        + (", checkpoint path healthy" if checkpoint_path else ""))
+    return 0

@@ -1,0 +1,260 @@
+"""Elastic distributed training: the fault model for the mesh.
+
+The reference's cluster story dies with its weakest rank: a lost MPI
+process kills the whole ``mpirun`` job (``svmTrainMain.cpp:153``), and
+at cluster scale node loss and stragglers are the DOMINANT failure
+modes (arXiv:1406.5161 §6, arXiv:1404.1066 §4). This module gives the
+SPMD trainers (parallel/dist_smo.py, dist_decomp.py) the pieces the
+single-process resilience stack (preempt/health/supervisor) cannot
+provide on its own:
+
+* **shard probes** — each shard appends its own view of the
+  replicated-by-construction poll scalars (n_iter, b_lo, b_hi) to the
+  packed-stats transfer (one extra ``(3P,)`` i32 tail on the SAME
+  device array — still ONE D2H transfer per chunk). Disagreement
+  between shards on values that are replicated by construction means a
+  desynchronized mesh (corrupted collective, flaky interconnect):
+  ``DesyncDetector`` reports it once, the driver emits a ``desync``
+  trace event and feeds the existing ``on_divergence`` policy
+  (raise → ``DesyncError``; rollback → restore the newest intact
+  checkpoint, exactly the recovery a desync needs);
+* **shard heartbeats** — host-side per-shard freshness derived from the
+  probes: a shard whose reported progress stops advancing while the
+  others move is a straggler. Ages ride every chunk record
+  (``shard_ages``) and feed the stall watchdog's dist-aware verdict
+  (host stall vs collective hang vs straggler — ``stall_extras``);
+* **shard loss + degraded-mesh resume** — ``ShardLostError`` is the
+  transient "a host died" signal (injectable via
+  ``DPSVM_FAULT_DIST_KILL_SHARD``); ``run_elastic`` is the supervisor
+  loop that catches it, shrinks the mesh to the survivors, and resumes
+  from the newest intact shard-aware checkpoint (utils/checkpoint.py
+  records the save-time mesh + per-shard CRCs; the state is the global
+  unpadded (alpha, f), so ``prepare_distributed_inputs`` re-pads it
+  onto ANY device count — ``reshard`` + ``retry`` trace events, final
+  model bit-compatible with an uninterrupted run).
+
+Everything here is CPU-testable: the fault injector
+(resilience/faultinject.py ``DPSVM_FAULT_DIST_*``) makes each behavior
+a deterministic drill on virtual devices
+(``XLA_FLAGS=--xla_force_host_platform_device_count``), wired into
+``python -m dpsvm_tpu.resilience --selfcheck`` and
+tests/test_elastic.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+#: Per-shard probe lanes appended to the packed stats by the SPMD chunk
+#: runners: [n_iter, b_lo bits, b_hi bits] as i32 (floats ride as exact
+#: bit patterns, like the replicated lanes — solver/driver.pack_stats).
+PROBE_WIDTH = 3
+
+
+class ShardLostError(RuntimeError):
+    """A mesh shard (host/device) was lost mid-run. TRANSIENT: the run
+    is resumable on the surviving mesh from the newest intact
+    checkpoint (``run_elastic`` automates exactly that loop)."""
+
+    def __init__(self, shard: int, shards: int, n_iter: int):
+        self.shard = int(shard)          # 0-based lost shard
+        self.shards = int(shards)        # mesh size at loss
+        self.n_iter = int(n_iter)
+        super().__init__(
+            f"shard {shard}/{shards} lost at iteration {n_iter}; "
+            f"resume on the surviving mesh from the newest intact "
+            f"checkpoint (run_elastic / dpsvm train --retries)")
+
+
+def probe_values(probes: np.ndarray) -> List[dict]:
+    """Decode a (P, 3) i32 probe block into per-shard host values."""
+    probes = np.asarray(probes, np.int32).reshape(-1, PROBE_WIDTH)
+    out = []
+    for row in probes:
+        b = row[1:3].view(np.float32)
+        out.append({"n_iter": int(row[0]), "b_lo": float(b[0]),
+                    "b_hi": float(b[1])})
+    return out
+
+
+def desync_reason(probes: np.ndarray) -> Optional[str]:
+    """Reason string when shards disagree on replicated-by-construction
+    values (None = consistent). Bit-level comparison: the loop's
+    all_gather makes every shard's (b_lo, b_hi) at a given n_iter
+    identical down to the bit pattern, so shards reporting the SAME
+    iteration with different gap bits are a desynchronized mesh, not
+    numerical noise. Shards at DIFFERENT iterations are lag, not
+    desync — that is the straggler signal, owned by the heartbeat ages
+    (``ShardHeartbeats``), so a slow shard never false-positives the
+    desync guard."""
+    probes = np.asarray(probes, np.int32).reshape(-1, PROBE_WIDTH)
+    if len(probes) < 2:
+        return None
+    lead = int(probes[:, 0].max())
+    lead_mask = probes[:, 0] == lead
+    ref_idx = int(np.argmax(lead_mask))
+    ref = probes[ref_idx]
+    bad = [k for k in range(len(probes))
+           if lead_mask[k] and not bool((probes[k] == ref).all())]
+    if not bad:
+        return None
+    vals = probe_values(probes)
+    return (f"cross-shard desync on replicated poll state at iteration "
+            f"{lead}: shard(s) {bad} disagree with shard {ref_idx} "
+            f"(shard {ref_idx}: {vals[ref_idx]}; "
+            f"shard {bad[0]}: {vals[bad[0]]})")
+
+
+class DesyncDetector:
+    """Once-per-incident desync reporter fed by the driver at each
+    poll; ``reset()`` after a rollback re-arms it (the restored state
+    must re-earn a clean bill)."""
+
+    def __init__(self):
+        self._reported = False
+
+    def check(self, probes) -> Optional[str]:
+        if probes is None or self._reported:
+            return None
+        reason = desync_reason(probes)
+        if reason is not None:
+            self._reported = True
+        return reason
+
+    def reset(self) -> None:
+        self._reported = False
+
+
+class ShardHeartbeats:
+    """Host-side per-shard freshness from the poll probes.
+
+    A shard's heartbeat is the wall-clock time since its reported
+    n_iter last ADVANCED. Under healthy SPMD every shard advances at
+    every poll, so ages hover near zero; a shard whose probe stops
+    moving while others advance (straggler, wedged host — simulated by
+    ``DPSVM_FAULT_DIST_SLOW_SHARD``) ages visibly. The ages ride every
+    chunk record and back the stall watchdog's dist verdict."""
+
+    def __init__(self, shards: int):
+        self.shards = int(shards)
+        self._last_iter = np.full((self.shards,), -1, np.int64)
+        self._last_seen = np.full((self.shards,), time.monotonic())
+        self._last_poll = time.monotonic()
+
+    def note_poll(self, probes) -> List[float]:
+        """Record one poll's probes; return per-shard ages (seconds,
+        rounded) for the chunk record."""
+        now = time.monotonic()
+        self._last_poll = now
+        if probes is not None:
+            probes = np.asarray(probes, np.int32).reshape(
+                -1, PROBE_WIDTH)
+            for k in range(min(self.shards, len(probes))):
+                if int(probes[k, 0]) > self._last_iter[k]:
+                    self._last_iter[k] = int(probes[k, 0])
+                    self._last_seen[k] = now
+        return [round(now - t, 3) for t in self._last_seen]
+
+    def ages(self) -> List[float]:
+        now = time.monotonic()
+        return [round(now - t, 3) for t in self._last_seen]
+
+    def poll_age(self) -> float:
+        return time.monotonic() - self._last_poll
+
+
+# The one active dist run's heartbeats, consulted by the stall
+# watchdog's emergency exit (utils/watchdog.py) from its own thread —
+# microseconds before os._exit, while the training thread is wedged in
+# a device call, so a lock suffices for the handoff.
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[ShardHeartbeats] = None
+
+
+def register_heartbeats(hb: Optional[ShardHeartbeats]) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = hb
+
+
+def stall_extras() -> dict:
+    """Dist-aware facts for the watchdog's ``stall`` event: a verdict
+    separating *host stall / collective hang* (the whole mesh stopped
+    answering — every shard exactly as stale as the last poll) from a
+    *straggler* (one shard's progress lags the rest). Empty for
+    single-device runs — the stall event stays exactly what it was."""
+    with _ACTIVE_LOCK:
+        hb = _ACTIVE
+    if hb is None:
+        return {}
+    ages = hb.ages()
+    poll_age = round(hb.poll_age(), 3)
+    spread = max(ages) - min(ages)
+    if spread > max(1.0, 0.5 * poll_age):
+        verdict = (f"straggler-shard-"
+                   f"{int(np.argmax(np.asarray(ages)))}")
+    else:
+        verdict = "collective-hang"
+    return {"dist_verdict": verdict, "shards": hb.shards,
+            "shard_ages": ages, "poll_age": poll_age}
+
+
+def surviving_shards(shards: int, min_shards: int = 1) -> int:
+    """Mesh size after losing one shard: the survivors. Any size works
+    — the checkpoint state is global and re-pads to any mesh — so the
+    policy is simply P-1, floored at ``min_shards``."""
+    return max(int(shards) - 1, int(min_shards), 1)
+
+
+def run_elastic(fn: Callable[[Optional[str], int, int], object], *,
+                shards: int, retries: int,
+                checkpoint_path: Optional[str] = None,
+                min_shards: int = 1, backoff_s: float = 0.0,
+                sleep: Callable[[float], None] = time.sleep):
+    """Elastic supervisor: ``fn(resume_from, shards, attempt)`` runs
+    the training; a ``ShardLostError`` shrinks the mesh to the
+    survivors and resumes from the newest intact checkpoint (with
+    ``reshard`` + ``retry`` queued into the next attempt's trace); a
+    ``PreemptedError`` retries on the SAME mesh (the in-process
+    supervisor's behavior). Anything else — including a
+    ``DivergenceError`` the rollback budget could not absorb — fails
+    fast. The sibling of ``supervisor.run_with_retries`` for meshes."""
+    from dpsvm_tpu.resilience.preempt import PreemptedError
+    from dpsvm_tpu.resilience.supervisor import _log, newest_intact
+
+    attempt = 0
+    p = int(shards)
+    while True:
+        resume, skipped = newest_intact(checkpoint_path)
+        if skipped and resume:
+            _log(f"skipping unreadable checkpoint slot(s) "
+                 f"{skipped} -> resuming {resume}")
+        try:
+            return fn(resume, p, attempt)
+        except (ShardLostError, PreemptedError) as e:
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            attempt += 1
+            from dpsvm_tpu.solver import driver
+            if isinstance(e, ShardLostError):
+                # The `reshard` trace event itself comes from
+                # resume_state when the next attempt loads the
+                # checkpoint (it knows the recorded vs current mesh);
+                # the supervisor only shrinks and retries.
+                survivors = surviving_shards(p, min_shards)
+                _log(f"shard {e.shard}/{p} lost at iter {e.n_iter}; "
+                     f"retry {attempt}/{retries} on the surviving "
+                     f"{survivors}-shard mesh in {delay:.1f}s")
+                p = survivors
+            else:
+                _log(f"preempted at iter {e.n_iter}; retry "
+                     f"{attempt}/{retries} in {delay:.1f}s")
+            driver.queue_trace_event("retry", attempt=attempt,
+                                     resumed_from=resume)
+            if delay > 0:
+                sleep(delay)

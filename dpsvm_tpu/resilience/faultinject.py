@@ -23,6 +23,25 @@ aliases so benchmark harness configs stay in the BENCH_ namespace; API:
   a simulated preemption (exercises snapshot + resumable exit + retry
   supervisor without OS signal timing races).
 
+Distributed knobs (``DPSVM_FAULT_DIST_*``, consumed by the shared
+driver on multi-shard runs — docs/DISTRIBUTED.md "Elastic training"):
+
+* ``DPSVM_FAULT_DIST_KILL_SHARD=k`` — shard **#k** (1-based) "dies" at
+  a distributed host poll (``DPSVM_FAULT_DIST_KILL_POLL=m`` picks the
+  poll; default the 2nd): the driver raises ``ShardLostError``, the
+  transient signal ``elastic.run_elastic`` answers by resuming on the
+  surviving mesh from the newest intact shard-aware checkpoint — the
+  kill-one-shard drill;
+* ``DPSVM_FAULT_DIST_DESYNC_AT=j`` — the first poll observing
+  ``n_iter >= j`` reports one shard's probe (``DESYNC_SHARD``,
+  default the last shard) disagreeing with the rest (exercises
+  cross-shard desync detection -> ``desync`` event -> the
+  ``on_divergence`` policy);
+* ``DPSVM_FAULT_DIST_SLOW_SHARD=k`` — shard #k's probe stops advancing
+  (every poll replays its first-seen value): the straggler model —
+  its heartbeat age grows in the chunk records and the stall
+  watchdog's dist verdict fingers it.
+
 Serving-side knobs (``DPSVM_FAULT_SERVE_*``, consumed by
 ``serving/pool.py`` / ``serving/registry.py`` — docs/SERVING.md
 "Resilience"):
@@ -83,6 +102,12 @@ class FaultPlan:
     serve_nan_after: int = 0         # poison the replica serving
     #                                  compute #m until it is rebuilt
     serve_fail_reload: int = 0       # 1-based reload/rebuild counter
+    # distributed-mesh knobs (docstring above): shard NUMBERS 1-based
+    dist_kill_shard: int = 0         # shard #k lost at a dist poll
+    dist_kill_poll: int = 0          # ...the m-th dist poll (default 2)
+    dist_desync_at: int = 0          # poison a probe at n_iter >= j
+    dist_desync_shard: int = 0       # which shard lies (default last)
+    dist_slow_shard: int = 0         # shard #k's probe stops advancing
 
     # process-lifetime counters (fire-once semantics)
     _writes: int = 0
@@ -92,11 +117,17 @@ class FaultPlan:
     _serve_reloads: int = 0
     _wedge_fired: bool = False
     _poisoned: Optional[Tuple[int, int]] = None  # (replica, generation)
+    _dist_polls: int = 0
+    _kill_fired: bool = False
+    _desync_fired: bool = False
+    _slow_probe: Optional[tuple] = None   # frozen probe row replayed
 
     def any(self) -> bool:
         return bool(self.fail_checkpoint_write or self.nan_at_iter
                     or self.preempt_at_poll or self.serve_wedge_replica
-                    or self.serve_nan_after or self.serve_fail_reload)
+                    or self.serve_nan_after or self.serve_fail_reload
+                    or self.dist_kill_shard or self.dist_desync_at
+                    or self.dist_slow_shard)
 
     def note_checkpoint_write(self, path: str) -> None:
         self._writes += 1
@@ -117,13 +148,63 @@ class FaultPlan:
 
     def poison_stats(self, st):
         """Replace b_lo with NaN on the first qualifying poll (a stand-in
-        for device-state corruption observed at the poll boundary)."""
+        for device-state corruption observed at the poll boundary), and
+        apply the dist probe faults (desync / slow shard) to the
+        per-shard probe tail when one rides the stats."""
         if (self.nan_at_iter and not self._nan_fired
                 and st.n_iter >= self.nan_at_iter):
             self._nan_fired = True
             _log(f"poisoning stats with NaN gap at iter {st.n_iter}")
-            return st._replace(b_lo=float("nan"))
+            st = st._replace(b_lo=float("nan"))
+        probes = getattr(st, "shard_probes", None)
+        if probes is not None and (self.dist_desync_at
+                                   or self.dist_slow_shard):
+            st = st._replace(
+                shard_probes=self.poison_probes(probes, st.n_iter))
         return st
+
+    def poison_probes(self, probes, n_iter: int):
+        """Dist probe faults, applied host-side to the (P, 3) probe
+        block exactly where real mesh corruption would surface (the one
+        poll read): desync flips one shard's n_iter lane once; the slow
+        shard replays its first-seen row every poll so its reported
+        progress freezes (heartbeat age grows)."""
+        probes = probes.copy()
+        p = len(probes)
+        if (self.dist_desync_at and not self._desync_fired
+                and n_iter >= self.dist_desync_at and p > 1):
+            self._desync_fired = True
+            k = ((self.dist_desync_shard - 1) % p
+                 if self.dist_desync_shard else p - 1)
+            # One-ulp disagreement on the replicated gap bound at the
+            # SAME iteration — the smallest possible desync (flipping
+            # n_iter instead would read as a straggler, which is the
+            # heartbeat path's signal, not the desync guard's).
+            probes[k, 1] ^= 1
+            _log(f"desyncing shard {k} probe at iter {n_iter}")
+        if self.dist_slow_shard and p >= self.dist_slow_shard:
+            k = self.dist_slow_shard - 1
+            if self._slow_probe is None:
+                self._slow_probe = tuple(int(v) for v in probes[k])
+                _log(f"freezing shard {k} probe (straggler model)")
+            probes[k] = self._slow_probe
+        return probes
+
+    def dist_kill_now(self) -> int:
+        """Counted per DISTRIBUTED host poll; returns the 1-based shard
+        to lose exactly once (0 = keep running). The driver raises
+        ``elastic.ShardLostError`` — no snapshot, like a real host
+        death: recovery starts from the newest PERIODIC checkpoint."""
+        if not self.dist_kill_shard:
+            return 0
+        self._dist_polls += 1
+        at = self.dist_kill_poll or 2
+        if not self._kill_fired and self._dist_polls >= at:
+            self._kill_fired = True
+            _log(f"killing shard #{self.dist_kill_shard} at dist poll "
+                 f"#{self._dist_polls}")
+            return self.dist_kill_shard
+        return 0
 
     # -- serving-side injection points (serving/pool.py). Unlike the
     # single-threaded training hooks, these are hit from concurrent
@@ -196,7 +277,12 @@ def plan_from_env() -> Optional[FaultPlan]:
         serve_wedge_replica=_env_int("SERVE_WEDGE_REPLICA"),
         serve_wedge_after=_env_int("SERVE_WEDGE_AFTER"),
         serve_nan_after=_env_int("SERVE_NAN_AFTER"),
-        serve_fail_reload=_env_int("SERVE_FAIL_RELOAD"))
+        serve_fail_reload=_env_int("SERVE_FAIL_RELOAD"),
+        dist_kill_shard=_env_int("DIST_KILL_SHARD"),
+        dist_kill_poll=_env_int("DIST_KILL_POLL"),
+        dist_desync_at=_env_int("DIST_DESYNC_AT"),
+        dist_desync_shard=_env_int("DIST_DESYNC_SHARD"),
+        dist_slow_shard=_env_int("DIST_SLOW_SHARD"))
     return p if p.any() else None
 
 

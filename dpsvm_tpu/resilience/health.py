@@ -63,6 +63,14 @@ class DivergenceError(RuntimeError):
             f"training diverged at iteration {n_iter}: {reason}")
 
 
+class DesyncError(DivergenceError):
+    """Cross-shard desync (resilience/elastic.py): shards disagree on
+    replicated-by-construction poll state. Subclasses DivergenceError
+    because it rides the same ``on_divergence`` policy — callers that
+    catch divergence handle desync too, and ones that care WHICH guard
+    tripped can still tell."""
+
+
 class HealthMonitor:
     """Per-run divergence detector, fed one ChunkStats-shaped poll at a
     time by host_training_loop. check() returns a reason string on the

@@ -82,11 +82,28 @@ def with_resume(argv: Sequence[str], resume_path: str) -> List[str]:
 def newest_intact(checkpoint_path: Optional[str]
                   ) -> "tuple[Optional[str], List[str]]":
     """Newest loadable rotation slot (+ the corrupt/missing ones it
-    skipped). Thin re-export so callers need only this module."""
+    skipped). Thin re-export so callers need only this module.
+
+    Mesh note: a slot recorded under a different mesh size is INTACT —
+    never skipped as corrupt or rolled past to an older slot. Resuming
+    it on the current mesh is the elastic re-shard path
+    (solver/driver.resume_state records the ``reshard`` event); the
+    supervisor just logs what the slot was saved under."""
     if not checkpoint_path:
         return None, []
-    from dpsvm_tpu.utils.checkpoint import newest_intact_checkpoint
-    return newest_intact_checkpoint(checkpoint_path)
+    from dpsvm_tpu.utils.checkpoint import (load_checkpoint,
+                                            newest_intact_checkpoint)
+    best, skipped = newest_intact_checkpoint(checkpoint_path)
+    if best:
+        try:
+            ck = load_checkpoint(best)
+            if int(getattr(ck, "shards", 1)) != 1:
+                _log(f"{best} was saved on a {ck.mesh_desc()} "
+                     f"(iter {ck.n_iter}); a different current mesh "
+                     "re-shards on load")
+        except Exception:
+            pass                      # the resume path re-reports
+    return best, skipped
 
 
 def supervise(argv: Sequence[str], *, retries: int,

@@ -51,8 +51,9 @@ import numpy as np
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.observability.device import memory_snapshot
-from dpsvm_tpu.resilience import faultinject, preempt
-from dpsvm_tpu.resilience.health import DivergenceError, HealthMonitor
+from dpsvm_tpu.resilience import elastic, faultinject, preempt
+from dpsvm_tpu.resilience.health import (DesyncError, DivergenceError,
+                                         HealthMonitor)
 from dpsvm_tpu.utils import watchdog
 from dpsvm_tpu.utils.checkpoint import (CheckpointCorruptError,
                                         CheckpointError, SolverCheckpoint,
@@ -74,8 +75,8 @@ def queue_trace_event(event: str, **extra) -> None:
     _PENDING_TRACE_EVENTS.append((event, extra))
 
 
-def resume_state(config: SVMConfig, n: int, d: int, gamma: float
-                 ) -> Optional[SolverCheckpoint]:
+def resume_state(config: SVMConfig, n: int, d: int, gamma: float,
+                 shards: int = 1) -> Optional[SolverCheckpoint]:
     """Load + validate the resume checkpoint if one is configured.
 
     A corrupt ``resume_from`` (truncated, bit-flipped — anything
@@ -84,7 +85,14 @@ def resume_state(config: SVMConfig, n: int, d: int, gamma: float
     queueing a ``rollback`` trace event for the run. Only when EVERY
     slot is unreadable does the error propagate; an intact checkpoint
     for the wrong problem/config always raises (that is permanent, not
-    transient)."""
+    transient).
+
+    ``shards`` is the current run's mesh size. A checkpoint recorded
+    under a DIFFERENT mesh is NOT a mismatch — it is the elastic
+    re-shard-on-load path (docs/DISTRIBUTED.md "Elastic training"):
+    the state is the global unpadded (alpha, f), the trainers' pad-
+    and-shard protocol re-slices it for the new device count, and the
+    run records a ``reshard`` trace event naming both meshes."""
     if not config.resume_from:
         return None
     skipped = []
@@ -98,13 +106,21 @@ def resume_state(config: SVMConfig, n: int, d: int, gamma: float
             skipped.append(path)
             last_err = e
             continue
-        ckpt.validate_against(n, d, config, gamma)
+        ckpt.validate_against(n, d, config, gamma, shards=shards)
         if skipped:
             queue_trace_event("rollback", n_iter=ckpt.n_iter,
                               reason="corrupt checkpoint on resume",
                               checkpoint=path, skipped=skipped)
             print(f"WARNING: resuming from rotation slot {path} "
                   f"(skipped corrupt: {skipped})",
+                  file=sys.stderr, flush=True)
+        if ckpt.needs_reshard(shards):
+            queue_trace_event("reshard", n_iter=ckpt.n_iter,
+                              from_shards=int(ckpt.shards),
+                              to_shards=int(shards), checkpoint=path)
+            print(f"RESHARD: checkpoint {path} was saved on a "
+                  f"{ckpt.mesh_desc()}; resuming on {shards} — "
+                  f"re-slicing the global state onto the new mesh",
                   file=sys.stderr, flush=True)
         return ckpt
     raise CheckpointError(
@@ -133,7 +149,10 @@ STATS_WIDTH = 7
 
 class ChunkStats(NamedTuple):
     """Host-side view of one packed-stats read (docs/OBSERVABILITY.md
-    "Counter semantics")."""
+    "Counter semantics"). ``shard_probes`` is the per-shard probe block
+    ((P, 3) i32: n_iter + the gap bounds as bit patterns) the SPMD
+    runners append to the same transfer — None on single-device
+    paths (resilience/elastic.py)."""
     n_iter: int
     b_lo: float
     b_hi: float
@@ -141,6 +160,7 @@ class ChunkStats(NamedTuple):
     cache_hits: int = 0
     cache_misses: int = 0
     rounds: int = 0
+    shard_probes: Optional[object] = None
 
 
 def pack_stats(n_iter, b_lo, b_hi, n_sv=None, cache_hits=None,
@@ -176,7 +196,15 @@ def read_stats(stats) -> ChunkStats:
     b = s[1:3].view(np.float32)
     extra = [int(v) for v in s[3:STATS_WIDTH]]
     extra += [0] * (4 - len(extra))
-    st = ChunkStats(int(s[0]), float(b[0]), float(b[1]), *extra)
+    # The SPMD runners append per-shard probe lanes after the seven
+    # replicated ones — same array, same single transfer
+    # (resilience/elastic.py "shard probes").
+    probes = None
+    if len(s) > STATS_WIDTH:
+        probes = np.asarray(s[STATS_WIDTH:], np.int32).reshape(
+            -1, elastic.PROBE_WIDTH)
+    st = ChunkStats(int(s[0]), float(b[0]), float(b[1]), *extra,
+                    shard_probes=probes)
     plan = faultinject.current()
     if plan is not None:
         st = plan.poison_stats(st)
@@ -262,6 +290,7 @@ def host_training_loop(
     it0: int = 0,                   # carry's entry iteration (0 or resume)
     poll_hook: Optional[Callable] = None,
     carry_from_ckpt: Optional[Callable] = None,
+    shards: int = 1,                # mesh size (dist paths; 1 = single)
 ) -> TrainResult:
     """Run chunks until convergence / max_iter; return the TrainResult.
 
@@ -299,6 +328,19 @@ def host_training_loop(
       and continues with a halved ``chunk_iters``;
     * deterministic faults (resilience/faultinject.py) fire at their
       configured poll/iteration, so all of the above runs in CI on CPU.
+
+    Elastic extensions (``shards > 1`` — resilience/elastic.py,
+    docs/DISTRIBUTED.md "Elastic training"): the per-shard probe block
+    riding the same packed-stats transfer feeds (a) cross-shard desync
+    detection — disagreement on replicated-by-construction values
+    emits a ``desync`` trace event and rides the same ``on_divergence``
+    policy (raise -> ``DesyncError``, rollback -> checkpoint restore);
+    (b) per-shard heartbeat ages on every chunk record plus the stall
+    watchdog's dist verdict; (c) the kill-shard drill
+    (``DPSVM_FAULT_DIST_KILL_SHARD``) raising ``ShardLostError`` — the
+    transient signal ``elastic.run_elastic`` answers by resuming on
+    the surviving mesh. Checkpoints record the save-time mesh and
+    per-shard CRCs.
     """
     eps = float(config.epsilon)
     chunk = config.chunk_iters
@@ -314,6 +356,12 @@ def host_training_loop(
                                          type(carry).__name__), it0)
     monitor = HealthMonitor(policy=config.on_divergence,
                             window=config.health_window)
+    # Elastic instruments for the SPMD paths (no-ops at shards == 1):
+    # desync detection + heartbeats over the per-shard probe block.
+    desync = elastic.DesyncDetector()
+    heartbeats = (elastic.ShardHeartbeats(shards) if shards > 1
+                  else None)
+    elastic.register_heartbeats(heartbeats)
     faults = faultinject.current()
     # Host-loop accounting, not device time: "dispatch" buckets the
     # (async) enqueue calls, "poll" the blocking stats reads — device
@@ -340,7 +388,9 @@ def host_training_loop(
             weight_pos=float(config.weight_pos),
             weight_neg=float(config.weight_neg),
             kernel=config.kernel, coef0=float(config.coef0),
-            degree=int(config.degree))
+            degree=int(config.degree),
+            shards=int(shards))     # shard-aware manifest + per-shard
+                                    # CRCs (utils/checkpoint.py)
 
     try:
         with profile, _debug_nans(config.debug_nans), preempt.trap():
@@ -363,6 +413,20 @@ def host_training_loop(
                 if faults is not None and faults.note_poll():
                     preempt.simulate(signal.SIGTERM)
                 n_iter, b_lo, b_hi = st.n_iter, st.b_lo, st.b_hi
+                if faults is not None and shards > 1:
+                    # Kill-shard drill: the injected "host died" —
+                    # raised WITHOUT a snapshot, like the real thing
+                    # (recovery starts from the newest periodic
+                    # checkpoint, on the surviving mesh).
+                    lost = faults.dist_kill_now()
+                    if lost:
+                        if trace is not None:
+                            trace.event("shard_lost", n_iter=n_iter,
+                                        shard=lost - 1, shards=shards)
+                        raise elastic.ShardLostError(lost - 1, shards,
+                                                     n_iter)
+                shard_ages = (heartbeats.note_poll(st.shard_probes)
+                              if heartbeats is not None else None)
                 # Device/compiler facts for this poll, all host-side
                 # reads (docs/OBSERVABILITY.md): compile observations
                 # queued by the instrumented chunk runners land as
@@ -440,12 +504,23 @@ def host_training_loop(
                                 rounds=st.rounds,
                                 phases=dict(timer.seconds),
                                 phase_counts=dict(timer.counts),
-                                hbm=hbm)
+                                hbm=hbm,
+                                **({"shard_ages": shard_ages}
+                                   if shard_ages is not None else {}))
 
                 # Divergence guards — BEFORE maybe_checkpoint, so a sick
-                # state is never saved over a good rotation slot.
+                # state is never saved over a good rotation slot. The
+                # cross-shard desync check rides the same policy: a
+                # desynchronized mesh IS a divergent run, and rollback
+                # (restore a known-good global state everywhere) is
+                # exactly its recovery.
                 reason = None if done else monitor.check(
                     n_iter=n_iter, b_lo=b_lo, b_hi=b_hi, n_sv=st.n_sv)
+                ev_kind = "divergence"
+                if reason is None and not done:
+                    reason = desync.check(st.shard_probes)
+                    if reason is not None:
+                        ev_kind = "desync"
                 if reason is not None:
                     policy = monitor.policy
                     if policy == "rollback" and (
@@ -461,18 +536,26 @@ def host_training_loop(
                               f"unavailable ({why}); raising",
                               file=sys.stderr, flush=True)
                         policy = "raise"
+                    # `desync` events carry the mesh size (the schema
+                    # validator checks it — observability/schema.py).
+                    ev_extra = ({"shards": int(shards)}
+                                if ev_kind == "desync" else {})
                     if policy == "ignore":
                         print(f"WARNING: {reason} at iter {n_iter} "
                               "(on_divergence='ignore')",
                               file=sys.stderr, flush=True)
                         if trace is not None:
-                            trace.event("divergence", n_iter=n_iter,
-                                        reason=reason, action="ignore")
+                            trace.event(ev_kind, n_iter=n_iter,
+                                        reason=reason, action="ignore",
+                                        **ev_extra)
                     elif policy == "raise":
                         if trace is not None:
-                            trace.event("divergence", n_iter=n_iter,
-                                        reason=reason, action="raise")
-                        raise DivergenceError(reason, n_iter)
+                            trace.event(ev_kind, n_iter=n_iter,
+                                        reason=reason, action="raise",
+                                        **ev_extra)
+                        err = (DesyncError if ev_kind == "desync"
+                               else DivergenceError)
+                        raise err(reason, n_iter)
                     else:
                         best, skipped = newest_intact_checkpoint(
                             config.checkpoint_path)
@@ -480,11 +563,17 @@ def host_training_loop(
                             raise DivergenceError(
                                 f"{reason}; rollback found no intact "
                                 f"checkpoint (skipped {skipped})", n_iter)
+                        if trace is not None and ev_kind == "desync":
+                            trace.event(ev_kind, n_iter=n_iter,
+                                        reason=reason,
+                                        action="rollback", **ev_extra)
                         ck = load_checkpoint(best)
-                        ck.validate_against(n, d, config, gamma)
+                        ck.validate_against(n, d, config, gamma,
+                                            shards=shards)
                         carry = carry_from_ckpt(ck)
                         chunk = max(chunk // 2, 1)
                         monitor.note_rollback(ck.n_iter)
+                        desync.reset()   # restored state re-earns trust
                         print(f"WARNING: {reason} at iter {n_iter}; "
                               f"rolled back to {best} (iter "
                               f"{ck.n_iter}), chunk_iters now {chunk}",
@@ -538,6 +627,15 @@ def host_training_loop(
         # on entry; max_iter => limit == n_iter), so its state equals
         # the final state.
         alpha, _ = carry_to_host(carry)
+        # OWN the returned duals. np.asarray of a CPU-backend jax array
+        # is a ZERO-COPY view of the device buffer; once `carry` is
+        # garbage-collected the buffer is recycled by whatever compiles
+        # or runs next, and result.alpha silently mutates after the
+        # fact (observed as garbage ±1e11 coefficients in a model built
+        # from a returned result — the long-standing "bench flake").
+        # One n-vector memcpy at run end buys a result that cannot be
+        # corrupted by anything that happens later.
+        alpha = np.array(alpha, np.float32, copy=True)
         result = TrainResult(
             alpha=alpha,
             b=(b_lo + b_hi) / 2.0,           # svmTrainMain.cpp:329
@@ -568,6 +666,7 @@ def host_training_loop(
     finally:
         # Leftover compile observations (error exits, untraced runs)
         # must not leak into the next run's trace.
+        elastic.register_heartbeats(None)
         drain_compiles(trace if trace is not None and not trace.closed
                        else None)
         if trace is not None:

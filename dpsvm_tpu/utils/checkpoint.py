@@ -24,6 +24,18 @@ Hardening (docs/ROBUSTNESS.md):
 Hyperparameters are stored alongside the state and verified on load; a
 checkpoint from a different problem shape or config raises
 ``CheckpointMismatchError`` (a ``ValueError``), not a silent wrong answer.
+
+Shard-aware manifest (docs/DISTRIBUTED.md "Elastic training"): files
+written since the elastic format (``CKPT_FORMAT_VERSION >= 2``) also
+record the mesh they were saved under — shard count plus a per-shard
+CRC32 over each shard's (alpha, f) region — so (a) a corrupted file can
+name WHICH shard region is damaged, and (b) a resume on a different
+mesh size is a recognized **re-shard**, not a mismatch: the state is the
+global unpadded (alpha, f), so ``prepare_distributed_inputs`` re-pads it
+for any device count and the trajectory continues bit-compatibly
+(``reshard`` trace event). Pre-elastic files (no mesh fields) load
+unchanged as single-shard records — pinned by
+``tests/fixtures/ckpt_pre_elastic.npz``.
 """
 
 from __future__ import annotations
@@ -41,6 +53,34 @@ from dpsvm_tpu.config import SVMConfig
 # LIBSVM -t order; index = the integer stored in the checkpoint scalars.
 # "precomputed" is -t 4 (the row data IS the (n, n) kernel matrix).
 _KERNEL_T = ("linear", "poly", "rbf", "sigmoid", "precomputed")
+
+#: On-disk format version stored in the ``mesh`` array. 2 = the elastic
+#: shard-aware manifest (mesh shape + per-shard CRCs); files without the
+#: array are version 1 (pre-elastic) and load as single-shard records.
+CKPT_FORMAT_VERSION = 2
+
+
+def shard_slices(n: int, shards: int) -> "List[tuple]":
+    """The per-shard (lo, hi) row ranges of the save-time layout:
+    contiguous equal shards of n padded up to a multiple of ``shards``,
+    clipped to the true row count (the same contiguous protocol
+    ``prepare_distributed_inputs`` pads to). The partition is part of
+    the checkpoint FORMAT — per-shard CRCs are computed over exactly
+    these slices, so a reader on any mesh can verify them."""
+    shards = max(int(shards), 1)
+    n_s = (n + shards - 1) // shards
+    return [(min(k * n_s, n), min((k + 1) * n_s, n))
+            for k in range(shards)]
+
+
+def _shard_crcs(alpha: np.ndarray, f: np.ndarray,
+                shards: int) -> np.ndarray:
+    out = np.zeros((max(int(shards), 1),), np.uint32)
+    for k, (lo, hi) in enumerate(shard_slices(len(alpha), shards)):
+        crc = zlib.crc32(np.ascontiguousarray(alpha[lo:hi]).tobytes())
+        out[k] = zlib.crc32(np.ascontiguousarray(f[lo:hi]).tobytes(),
+                            crc)
+    return out
 
 
 class CheckpointError(Exception):
@@ -75,9 +115,30 @@ class SolverCheckpoint:
     kernel: str = "rbf"
     coef0: float = 0.0
     degree: int = 3
+    # Elastic manifest (CKPT_FORMAT_VERSION 2): the mesh the state was
+    # saved under + per-shard CRC32s over the shard_slices partition.
+    # Pre-elastic files read as shards=1, shard_crcs=None.
+    shards: int = 1
+    shard_crcs: "Optional[np.ndarray]" = None
+
+    def mesh_desc(self) -> str:
+        """Human mesh summary for error messages and logs."""
+        return (f"({self.shards},)-mesh / {self.shards} device"
+                f"{'s' if self.shards != 1 else ''}")
 
     def validate_against(self, n: int, d: int, config: SVMConfig,
-                         gamma: float) -> None:
+                         gamma: float,
+                         shards: "Optional[int]" = None) -> None:
+        """Raise ``CheckpointMismatchError`` on a permanent mismatch.
+
+        ``shards`` is the CURRENT run's mesh size, used to make the
+        error name expected-vs-found mesh shape and device count. A
+        mesh-size difference ALONE is never a mismatch — the state is
+        the global unpadded (alpha, f), so it re-shards onto any mesh
+        (``needs_reshard`` / the driver's reshard path)."""
+        here = (f"({shards},)-mesh / {shards} device"
+                f"{'s' if shards != 1 else ''}"
+                if shards is not None else "this run's mesh")
         if self.kernel == "precomputed" and self.n != self.d:
             # -t 4 trains on the square (n, n) kernel matrix; a
             # non-square record here is a damaged or hand-edited file.
@@ -86,8 +147,9 @@ class SolverCheckpoint:
                 f"got ({self.n}, {self.d})")
         if (self.n, self.d) != (n, d):
             raise CheckpointMismatchError(
-                f"checkpoint is for a ({self.n}, {self.d}) problem, "
-                f"data is ({n}, {d})")
+                f"checkpoint is for a ({self.n}, {self.d}) problem "
+                f"saved on a {self.mesh_desc()}; "
+                f"data is ({n}, {d}) on {here}")
         if self.kernel != config.kernel:
             raise CheckpointMismatchError(
                 f"checkpoint kernel={self.kernel!r} != "
@@ -103,6 +165,25 @@ class SolverCheckpoint:
             if abs(mine - theirs) > 1e-12 * max(1.0, abs(mine)):
                 raise CheckpointMismatchError(
                     f"checkpoint {name}={mine} != configured {name}={theirs}")
+
+    def needs_reshard(self, shards: int) -> bool:
+        """True when the recorded mesh differs from the current one —
+        the resume must re-slice (pad-aware) onto the new mesh. Not an
+        error: the caller records a ``reshard`` trace event."""
+        return int(self.shards) != int(shards)
+
+    def verify_shard_crcs(self) -> "List[int]":
+        """Indices of shard regions whose recorded CRC does not match
+        the loaded payload (empty = all intact, or no manifest)."""
+        if self.shard_crcs is None:
+            return []
+        actual = _shard_crcs(
+            np.ascontiguousarray(self.alpha, np.float32),
+            np.ascontiguousarray(self.f, np.float32), self.shards)
+        want = np.asarray(self.shard_crcs, np.uint32)
+        if len(actual) != len(want):
+            return list(range(len(want)))
+        return [k for k in range(len(want)) if actual[k] != want[k]]
 
 
 def _payload(alpha: np.ndarray, f: np.ndarray,
@@ -167,12 +248,18 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint,
              # kernel family encoded as the LIBSVM -t integer
              _KERNEL_T.index(ckpt.kernel), ckpt.coef0,
              ckpt.degree], np.float64))
+    # Elastic manifest: the save-time mesh + per-shard CRCs over the
+    # shard_slices partition (docs/DISTRIBUTED.md "Elastic training").
+    shards = max(int(getattr(ckpt, "shards", 1) or 1), 1)
+    mesh = np.asarray([CKPT_FORMAT_VERSION, shards], np.int64)
+    shard_crc = _shard_crcs(alpha, f, shards)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as fh:
             np.savez(fh, alpha=alpha, f=f, scalars=scalars,
                      crc32=np.asarray([_crc32(alpha, f, scalars)],
-                                      np.uint32))
+                                      np.uint32),
+                     mesh=mesh, shard_crc=shard_crc)
         # Deterministic fault injection (resilience/faultinject.py) fires
         # HERE — after the tmp write, before the rename — so an injected
         # "write failed" exercises both the tmp cleanup and the
@@ -187,13 +274,75 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint,
         raise
 
 
+def _bad_shards(alpha, f, mesh, shard_crc) -> "Optional[List[int]]":
+    """Shard regions whose payload bytes fail the recorded per-shard
+    CRC. None when the file predates the shard manifest (nothing to
+    compare); an empty list when every region verifies — then any
+    whole-payload mismatch lives in the scalars/metadata instead."""
+    shards = int(mesh[1]) if mesh is not None and len(mesh) > 1 else 1
+    if shard_crc is None or len(shard_crc) != shards:
+        return None
+    actual = _shard_crcs(np.asarray(alpha, np.float32),
+                         np.asarray(f, np.float32), shards)
+    want = np.asarray(shard_crc, np.uint32)
+    return [k for k in range(shards) if actual[k] != want[k]]
+
+
+def _integrity_detail(alpha, f, s, mesh, shard_crc) -> str:
+    """The '; damaged shard region(s) …' suffix for corruption errors
+    (empty when the file has no shard manifest)."""
+    bad = _bad_shards(alpha, f, mesh, shard_crc)
+    if bad is None:
+        return ""
+    shards = int(mesh[1]) if mesh is not None and len(mesh) > 1 else 1
+    return (f"; damaged shard region(s) {bad or ['scalars']} "
+            f"of {shards}")
+
+
+def _salvage_npz(path: str) -> dict:
+    """Read an .npz's member arrays BYPASSING the zip per-member CRC.
+
+    A bit-flipped payload normally dies inside ``np.load`` as a
+    ``BadZipFile`` ("Bad CRC-32 for file 'alpha.npy'"), which masks
+    the much more useful per-shard diagnosis: WHICH shard region of
+    the solver state is damaged. This reads each stored member's raw
+    bytes straight from the local file headers (npz members are
+    STORED; deflated members are inflated without the CRC gate) so the
+    caller's own payload CRCs can produce the named-shard error. Only
+    used on the diagnosis path — an intact file never comes through
+    here."""
+    import io
+    import struct
+    import zipfile
+
+    out: dict = {}
+    with zipfile.ZipFile(path) as zf, open(path, "rb") as fh:
+        for info in zf.infolist():
+            fh.seek(info.header_offset)
+            hdr = fh.read(30)
+            if len(hdr) < 30 or hdr[:4] != b"PK\x03\x04":
+                raise ValueError(f"bad local header for {info.filename}")
+            fn_len, extra_len = struct.unpack("<HH", hdr[26:30])
+            fh.seek(info.header_offset + 30 + fn_len + extra_len)
+            data = fh.read(info.compress_size)
+            if info.compress_type == zipfile.ZIP_DEFLATED:
+                data = zlib.decompressobj(-15).decompress(data)
+            name = (info.filename[:-4]
+                    if info.filename.endswith(".npy") else info.filename)
+            out[name] = np.lib.format.read_array(io.BytesIO(data),
+                                                 allow_pickle=False)
+    return out
+
+
 def load_checkpoint(path: str) -> SolverCheckpoint:
     """Read + integrity-check one checkpoint file.
 
     Raises ``FileNotFoundError`` for a missing path and
     ``CheckpointCorruptError`` for anything unreadable: truncated or
     empty file, bad zip structure, missing arrays, or CRC mismatch.
-    Files written before the CRC field existed load without the check.
+    Files written before the CRC field existed load without the check;
+    files with the elastic shard manifest additionally name WHICH shard
+    region(s) fail their per-shard CRC on a payload mismatch.
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
@@ -204,17 +353,47 @@ def load_checkpoint(path: str) -> SolverCheckpoint:
             s = np.asarray(z["scalars"], np.float64)
             stored_crc = (int(np.asarray(z["crc32"]).ravel()[0])
                           if "crc32" in z.files else None)
+            mesh = (np.asarray(z["mesh"], np.int64)
+                    if "mesh" in z.files else None)
+            shard_crc = (np.asarray(z["shard_crc"], np.uint32)
+                         if "shard_crc" in z.files else None)
     except FileNotFoundError:
         raise
     except Exception as e:     # BadZipFile, EOFError, KeyError, ValueError…
+        # A flipped payload bit dies as the zip's OWN member CRC before
+        # ours can run, masking the useful diagnosis (WHICH shard
+        # region is damaged). Salvage the raw member bytes purely to
+        # NAME the damage — a file the zip layer rejects is corrupt
+        # regardless of what the salvage finds.
+        where = ""
+        try:
+            z = _salvage_npz(path)
+            where = _integrity_detail(
+                np.asarray(z["alpha"], np.float32),
+                np.asarray(z["f"], np.float32),
+                np.asarray(z["scalars"], np.float64),
+                z.get("mesh"), z.get("shard_crc"))
+        except Exception:
+            pass
         raise CheckpointCorruptError(
-            f"unreadable checkpoint {path}: {type(e).__name__}: {e}") from e
+            f"unreadable checkpoint {path}: "
+            f"{type(e).__name__}: {e}{where}") from e
+    shards = int(mesh[1]) if mesh is not None and len(mesh) > 1 else 1
     if stored_crc is not None:
         actual = _crc32(*_payload(alpha, f, s))
         if actual != stored_crc:
+            where = _integrity_detail(alpha, f, s, mesh, shard_crc)
             raise CheckpointCorruptError(
                 f"checkpoint {path} failed its integrity check "
-                f"(crc32 {actual:#010x} != stored {stored_crc:#010x})")
+                f"(crc32 {actual:#010x} != stored {stored_crc:#010x})"
+                + where)
+        # Whole payload verified: a per-shard mismatch now means the
+        # shard-CRC MANIFEST itself is damaged — the slot still cannot
+        # be trusted (the doctor and the re-shard path both read it).
+        if _bad_shards(alpha, f, mesh, shard_crc):
+            raise CheckpointCorruptError(
+                f"checkpoint {path} has a damaged shard-CRC manifest "
+                f"(payload verifies, shard records do not)")
     if s.ndim != 1 or len(s) < 8 or alpha.ndim != 1 or f.ndim != 1:
         raise CheckpointCorruptError(
             f"checkpoint {path} has a malformed payload "
@@ -231,6 +410,8 @@ def load_checkpoint(path: str) -> SolverCheckpoint:
         kernel=_KERNEL_T[int(s[10])] if len(s) > 10 else "rbf",
         coef0=float(s[11]) if len(s) > 11 else 0.0,
         degree=int(s[12]) if len(s) > 12 else 3,
+        shards=shards,
+        shard_crcs=shard_crc,
     )
 
 

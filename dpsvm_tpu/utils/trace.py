@@ -7,8 +7,11 @@ directly."""
 from __future__ import annotations
 
 from dpsvm_tpu.observability.schema import (CHUNK_KEYS,           # noqa: F401
-                                            COMPILE_KEYS, EVENT_KEYS,
+                                            COMPILE_KEYS,
+                                            EVENT_EXTRA_KEYS,
+                                            EVENT_KEYS,
                                             KINDS, MANIFEST_KEYS,
+                                            REWIND_EVENTS,
                                             SUMMARY_KEYS,
                                             SUPPORTED_SCHEMAS,
                                             TERMINAL_EVENTS,
@@ -20,5 +23,5 @@ __all__ = [
     "TRACE_SCHEMA_VERSION", "SUPPORTED_SCHEMAS", "TraceWriter",
     "read_trace", "validate_trace", "MANIFEST_KEYS", "CHUNK_KEYS",
     "EVENT_KEYS", "COMPILE_KEYS", "SUMMARY_KEYS", "KINDS",
-    "TERMINAL_EVENTS",
+    "TERMINAL_EVENTS", "REWIND_EVENTS", "EVENT_EXTRA_KEYS",
 ]

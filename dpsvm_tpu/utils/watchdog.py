@@ -76,6 +76,22 @@ def _watch() -> None:
             print(f"STALL: no device response for {timeout:.0f}s "
                   f"(watchdog armed via BENCH_STALL_TIMEOUT); exiting 124",
                   file=sys.stderr, flush=True)
+            # Dist-aware verdict: when a multi-shard run is active, its
+            # heartbeat state distinguishes a collective hang (the
+            # whole mesh stopped answering together) from a straggler
+            # shard (resilience/elastic.stall_extras). Empty for
+            # single-device runs — the stall event is unchanged there.
+            extras = {}
+            try:
+                from dpsvm_tpu.resilience import elastic
+                extras = elastic.stall_extras()
+                if extras:
+                    print(f"STALL: dist verdict "
+                          f"{extras.get('dist_verdict')} "
+                          f"(shard ages {extras.get('shard_ages')})",
+                          file=sys.stderr, flush=True)
+            except Exception:
+                pass
             # Stamp a terminal `stall` event into any open run trace so
             # `dpsvm report` can render the stalled run (an abandoned
             # trace with no terminal record looks identical to a live
@@ -84,7 +100,8 @@ def _watch() -> None:
             # processes that never touch telemetry.
             try:
                 from dpsvm_tpu.telemetry import flush_open_traces
-                flushed = flush_open_traces("stall", timeout_s=timeout)
+                flushed = flush_open_traces("stall", timeout_s=timeout,
+                                            **extras)
                 if flushed:
                     print(f"STALL: flushed {flushed} open run trace(s)",
                           file=sys.stderr, flush=True)

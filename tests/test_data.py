@@ -109,6 +109,46 @@ def test_loader_rejects_non_finite(tmp_path):
         load_csv(str(p2))
 
 
+def test_loader_nonfinite_names_row_and_escape_hatch(tmp_path, capsys):
+    """The rejection names the offending row/column; the explicit
+    allow_nonfinite escape hatch (CLI --allow-nonfinite) degrades it
+    to a warning and loads the file anyway."""
+    from dpsvm_tpu.data.loader import load_dataset
+
+    p = tmp_path / "bad.csv"
+    p.write_text("1,0.5,2.0\n-1,nan,1.0\n1,0.25,0.5\n")
+    with pytest.raises(ValueError) as exc:
+        load_dataset(str(p))
+    assert "row 1" in str(exc.value) and "column 0" in str(exc.value)
+    assert "allow-nonfinite" in str(exc.value)
+
+    x, y = load_dataset(str(p), allow_nonfinite=True)
+    assert x.shape == (3, 2) and len(y) == 3
+    assert np.isnan(x[1, 0])
+    assert "WARNING" in capsys.readouterr().err
+
+    # libsvm path honors the same hatch
+    p2 = tmp_path / "bad.libsvm"
+    p2.write_text("1 1:0.5 2:inf\n-1 1:0.25\n")
+    with pytest.raises(ValueError, match="non-finite"):
+        load_dataset(str(p2))
+    x2, _ = load_dataset(str(p2), allow_nonfinite=True)
+    assert np.isinf(x2[0, 1])
+
+
+def test_cli_allow_nonfinite_flag(tmp_path):
+    """--allow-nonfinite is a parseable train/test flag (the loaders'
+    escape hatch); without it a damaged dataset is a one-line error."""
+    from dpsvm_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["train", "-f", "x.csv", "-m", "m.svm", "--allow-nonfinite"])
+    assert args.allow_nonfinite
+    args = build_parser().parse_args(
+        ["test", "-f", "x.csv", "-m", "m.svm"])
+    assert not args.allow_nonfinite
+
+
 def test_load_libsvm_direct(tmp_path):
     """Sparse libsvm files load natively — the reference needed an
     offline convert step (scripts/convert_adult.py)."""

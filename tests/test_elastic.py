@@ -1,0 +1,431 @@
+"""Elastic distributed training (dpsvm_tpu/resilience/elastic.py,
+docs/DISTRIBUTED.md "Elastic training"): shard-aware checkpoints,
+degraded-mesh resume, cross-shard desync detection, shard heartbeats,
+the kill-one-shard drill, and the `dpsvm doctor` preflight.
+
+The acceptance flows: a run saved on P virtual devices resumes
+bit-compatibly on P' (the power-of-two matrix 4 -> 2 -> 1 and 1 -> 4
+pins BITWISE equality to an uninterrupted run — the same tolerance
+test_resilience.py pins for same-mesh resume); a shard killed mid-run
+is recovered by run_elastic on the surviving mesh with reshard/retry
+events on a schema-valid trace; an injected desync emits a `desync`
+event and rides the on_divergence policy through to rollback."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.parallel.dist_smo import train_distributed
+from dpsvm_tpu.resilience import elastic, faultinject
+from dpsvm_tpu.resilience.health import DesyncError, DivergenceError
+from dpsvm_tpu.telemetry import load_trace, validate_trace
+from dpsvm_tpu.utils.checkpoint import (CheckpointMismatchError,
+                                        SolverCheckpoint,
+                                        load_checkpoint, save_checkpoint,
+                                        shard_slices)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "ckpt_pre_elastic.npz")
+
+
+def _base(**kw):
+    # epsilon far below f32 resolution: runs always spend the full
+    # max_iter budget, so end states are exactly comparable
+    # (test_resilience.py's convention).
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-12, max_iter=300,
+                chunk_iters=25)
+    base.update(kw)
+    return SVMConfig(**base)
+
+
+def _events(path):
+    return [r for r in load_trace(path) if r.get("kind") == "event"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    return make_blobs(n=101, d=5, seed=7)
+
+
+# --------------------------------------------------------------------
+# Shard-aware checkpoint format
+# --------------------------------------------------------------------
+
+def test_checkpoint_mesh_manifest_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    ck = SolverCheckpoint(
+        alpha=rng.uniform(0, 1, 101).astype(np.float32),
+        f=rng.normal(size=101).astype(np.float32),
+        n_iter=100, b_lo=1.0, b_hi=-1.0, c=1.0, gamma=0.5,
+        epsilon=1e-12, n=101, d=5, shards=4)
+    path = str(tmp_path / "s.npz")
+    save_checkpoint(path, ck)
+    back = load_checkpoint(path)
+    assert back.shards == 4
+    assert back.shard_crcs is not None and len(back.shard_crcs) == 4
+    assert back.verify_shard_crcs() == []
+    # the shard partition covers [0, n) contiguously
+    bounds = shard_slices(101, 4)
+    assert bounds[0][0] == 0 and bounds[-1][1] == 101
+    assert all(a[1] == b[0] for a, b in zip(bounds, bounds[1:]))
+
+
+def test_pre_elastic_checkpoint_fixture_still_loads():
+    """Back-compat pin: a file written BEFORE the elastic manifest
+    existed (committed fixture) loads as a single-shard record."""
+    ck = load_checkpoint(FIXTURE)
+    assert ck.n_iter == 250 and (ck.n, ck.d) == (96, 6)
+    assert ck.shards == 1 and ck.shard_crcs is None
+    assert ck.verify_shard_crcs() == []          # nothing to verify
+    assert not ck.needs_reshard(1)
+    # ...and validates against its own problem/config
+    ck.validate_against(96, 6, SVMConfig(c=1.0, gamma=0.5,
+                                         epsilon=1e-12), 0.5)
+
+
+def test_mismatch_error_names_mesh_and_counts(tmp_path):
+    """Satellite: the shape-mismatch error must name expected-vs-found
+    mesh shape and device count, not just the (n, d) pair."""
+    ck = SolverCheckpoint(
+        alpha=np.zeros(64, np.float32), f=np.zeros(64, np.float32),
+        n_iter=10, b_lo=1.0, b_hi=-1.0, c=1.0, gamma=0.5,
+        epsilon=1e-12, n=64, d=4, shards=4)
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-12)
+    with pytest.raises(CheckpointMismatchError) as exc:
+        ck.validate_against(101, 5, cfg, 0.5, shards=2)
+    msg = str(exc.value)
+    assert "(64, 4)" in msg and "(101, 5)" in msg
+    assert "4 devices" in msg and "2 devices" in msg
+    # a mesh-size difference ALONE is a re-shard, never a mismatch
+    ck.validate_against(64, 4, cfg, 0.5, shards=2)
+    assert ck.needs_reshard(2) and not ck.needs_reshard(4)
+
+
+def test_corrupt_shard_region_is_named(tmp_path):
+    rng = np.random.default_rng(1)
+    ck = SolverCheckpoint(
+        alpha=rng.uniform(0, 1, 4096).astype(np.float32),
+        f=rng.normal(size=4096).astype(np.float32),
+        n_iter=10, b_lo=1.0, b_hi=-1.0, c=1.0, gamma=0.5,
+        epsilon=1e-12, n=4096, d=8, shards=4)
+    path = str(tmp_path / "s.npz")
+    save_checkpoint(path, ck)
+    # flip a bit inside shard 2's alpha region, located by content
+    # (npz members are stored uncompressed, so the payload bytes are
+    # findable in the raw file)
+    raw = bytearray(open(path, "rb").read())
+    needle = np.ascontiguousarray(
+        ck.alpha[2200:2208], np.float32).tobytes()
+    pos = raw.find(needle)
+    assert pos > 0
+    raw[pos] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    from dpsvm_tpu.utils.checkpoint import CheckpointCorruptError
+    with pytest.raises(CheckpointCorruptError) as exc:
+        load_checkpoint(path)
+    assert "shard region(s) [2]" in str(exc.value)
+
+
+# --------------------------------------------------------------------
+# Degraded-mesh resume matrix (virtual devices)
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("p_save,p_resume", [(4, 2), (4, 1), (1, 4)])
+def test_degraded_mesh_resume_bitwise(tmp_path, blobs, p_save,
+                                      p_resume):
+    """Save on P shards -> resume on P': final model BITWISE-identical
+    to an uninterrupted P-shard run (power-of-two meshes tile the
+    kernel d-reduction identically, so the trajectory is exact — the
+    same tolerance test_resilience.py pins for same-mesh resume)."""
+    x, y = blobs
+    ck = str(tmp_path / "state.npz")
+    train_distributed(x, y, _base(
+        shards=p_save, max_iter=200, checkpoint_path=ck,
+        checkpoint_every=100))
+    saved = load_checkpoint(ck)
+    assert saved.shards == p_save
+    assert len(saved.shard_crcs) == p_save
+
+    trace = str(tmp_path / "resume.jsonl")
+    resumed = train_distributed(x, y, _base(
+        shards=p_resume, max_iter=400, resume_from=ck,
+        trace_out=trace))
+    ref = train_distributed(x, y, _base(shards=p_save, max_iter=400))
+    assert resumed.n_iter == ref.n_iter == 400
+    np.testing.assert_array_equal(np.asarray(resumed.alpha),
+                                  np.asarray(ref.alpha))
+    records = load_trace(trace)
+    assert validate_trace(records) == []
+    reshard = [e for e in _events(trace) if e["event"] == "reshard"]
+    assert len(reshard) == 1
+    assert reshard[0]["from_shards"] == p_save
+    assert reshard[0]["to_shards"] == p_resume
+
+
+# --------------------------------------------------------------------
+# Desync detection -> on_divergence policy
+# --------------------------------------------------------------------
+
+def test_desync_unit_checks():
+    probes = np.array([[100, 7, 8]] * 4, np.int32)
+    assert elastic.desync_reason(probes) is None
+    # a LAGGING shard is a straggler (heartbeats), never a desync
+    lag = probes.copy()
+    lag[2, 0] = 75
+    assert elastic.desync_reason(lag) is None
+    # same iteration, different replicated gap bits = desync
+    probes[2, 1] ^= 1
+    reason = elastic.desync_reason(probes)
+    assert reason is not None and "[2]" in reason
+    det = elastic.DesyncDetector()
+    assert det.check(probes) == reason
+    assert det.check(probes) is None          # once per incident
+    det.reset()
+    assert det.check(probes) == reason
+    assert det.check(None) is None
+
+
+def test_desync_raises_with_event(tmp_path, blobs):
+    x, y = blobs
+    trace = str(tmp_path / "t.jsonl")
+    faultinject.install(faultinject.FaultPlan(dist_desync_at=100))
+    with pytest.raises(DesyncError, match="desync") as exc:
+        train_distributed(x, y, _base(shards=4, trace_out=trace))
+    assert isinstance(exc.value, DivergenceError)   # same policy family
+    ev = [e for e in _events(trace) if e["event"] == "desync"]
+    assert ev and ev[0]["action"] == "raise" and ev[0]["shards"] == 4
+    assert validate_trace(load_trace(trace)) == []
+
+
+def test_desync_rollback_recovers_bitwise(tmp_path, blobs):
+    """Injected desync under on_divergence='rollback': the driver
+    restores the newest intact checkpoint (the right recovery for a
+    desynced mesh — every shard reloads a known-good global state),
+    emits desync -> rollback on the trace, and the fire-once fault
+    means the run completes on the reference trajectory."""
+    x, y = blobs
+    ck = str(tmp_path / "state.npz")
+    trace = str(tmp_path / "t.jsonl")
+    faultinject.install(faultinject.FaultPlan(dist_desync_at=120))
+    rolled = train_distributed(x, y, _base(
+        shards=4, checkpoint_path=ck, checkpoint_every=50,
+        checkpoint_keep=2, on_divergence="rollback", trace_out=trace))
+    faultinject.clear()
+    ref = train_distributed(x, y, _base(shards=4))
+    assert rolled.n_iter == ref.n_iter == 300
+    np.testing.assert_array_equal(np.asarray(rolled.alpha),
+                                  np.asarray(ref.alpha))
+    events = [e["event"] for e in _events(trace)]
+    assert "desync" in events and "rollback" in events
+    assert events.index("desync") < events.index("rollback")
+    assert validate_trace(load_trace(trace)) == []
+
+
+# --------------------------------------------------------------------
+# Kill-one-shard drill: ShardLostError -> run_elastic degraded resume
+# --------------------------------------------------------------------
+
+def test_kill_shard_drill_resumes_on_surviving_mesh(tmp_path, blobs):
+    x, y = blobs
+    ck = str(tmp_path / "state.npz")
+    ref = train_distributed(x, y, _base(shards=4))
+
+    faultinject.install(faultinject.FaultPlan(dist_kill_shard=2,
+                                              dist_kill_poll=3))
+
+    def attempt(resume_from, shards, k):
+        return train_distributed(x, y, _base(
+            shards=shards, checkpoint_path=ck, checkpoint_every=50,
+            checkpoint_keep=2, resume_from=resume_from,
+            trace_out=str(tmp_path / f"a{k}.jsonl")))
+
+    res = elastic.run_elastic(attempt, shards=4, retries=1,
+                              backoff_s=0.0, checkpoint_path=ck)
+    faultinject.clear()
+
+    # survivors = 3: cross-mesh agreement is tolerance-pinned (a
+    # non-power-of-two mesh can tile the d-reduction one ulp apart,
+    # flipping near-tie selections; the 4->2->1 matrix above pins the
+    # bitwise case)
+    assert res.n_iter == ref.n_iter == 300
+    np.testing.assert_allclose(np.asarray(res.alpha),
+                               np.asarray(ref.alpha),
+                               rtol=0.0, atol=1e-4)
+
+    ev0 = [e["event"] for e in _events(str(tmp_path / "a0.jsonl"))]
+    assert "shard_lost" in ev0
+    lost = next(e for e in _events(str(tmp_path / "a0.jsonl"))
+                if e["event"] == "shard_lost")
+    assert lost["shard"] == 1 and lost["shards"] == 4
+    ev1 = _events(str(tmp_path / "a1.jsonl"))
+    names = [e["event"] for e in ev1]
+    assert "retry" in names and "reshard" in names
+    reshard = next(e for e in ev1 if e["event"] == "reshard")
+    assert reshard["from_shards"] == 4 and reshard["to_shards"] == 3
+    assert validate_trace(load_trace(str(tmp_path / "a1.jsonl"))) == []
+
+
+def test_run_elastic_exhausts_and_propagates(blobs):
+    x, y = blobs
+    calls = []
+
+    def attempt(resume_from, shards, k):
+        calls.append(shards)
+        raise elastic.ShardLostError(0, shards, 50)
+
+    with pytest.raises(elastic.ShardLostError):
+        elastic.run_elastic(attempt, shards=4, retries=2,
+                            backoff_s=0.0)
+    assert calls == [4, 3, 2]           # shrinks once per loss
+    assert elastic.surviving_shards(1) == 1   # floored
+
+
+def test_dist_kill_env_knobs(monkeypatch):
+    faultinject.clear()
+    monkeypatch.setenv("DPSVM_FAULT_DIST_KILL_SHARD", "2")
+    monkeypatch.setenv("DPSVM_FAULT_DIST_DESYNC_AT", "99")
+    monkeypatch.setenv("DPSVM_FAULT_DIST_SLOW_SHARD", "3")
+    plan = faultinject.current()
+    assert plan.dist_kill_shard == 2
+    assert plan.dist_desync_at == 99
+    assert plan.dist_slow_shard == 3
+    faultinject.clear()
+
+
+# --------------------------------------------------------------------
+# Heartbeats / straggler surfacing + stall verdict
+# --------------------------------------------------------------------
+
+def test_slow_shard_ages_in_chunk_records(tmp_path, blobs):
+    x, y = blobs
+    trace = str(tmp_path / "t.jsonl")
+    faultinject.install(faultinject.FaultPlan(dist_slow_shard=2))
+    train_distributed(x, y, _base(shards=4, trace_out=trace))
+    faultinject.clear()
+    chunks = [r for r in load_trace(trace) if r.get("kind") == "chunk"]
+    assert chunks and all(len(c["shard_ages"]) == 4 for c in chunks)
+    last = chunks[-1]["shard_ages"]
+    # the frozen shard (index 1) is the stalest; fresh shards reset
+    # their age at every poll
+    assert last[1] == max(last) and last[1] >= last[0]
+    assert validate_trace(load_trace(trace)) == []
+
+
+def test_stall_verdict_unit():
+    hb = elastic.ShardHeartbeats(4)
+    probes = np.array([[100, 1, 2]] * 4, np.int32)
+    hb.note_poll(probes)
+    elastic.register_heartbeats(hb)
+    try:
+        # everything equally fresh => the mesh stopped together
+        extras = elastic.stall_extras()
+        assert extras["dist_verdict"] == "collective-hang"
+        assert extras["shards"] == 4 and len(extras["shard_ages"]) == 4
+        # one shard's progress frozen far behind the rest => straggler
+        hb._last_seen[2] -= 100.0
+        extras = elastic.stall_extras()
+        assert extras["dist_verdict"] == "straggler-shard-2"
+    finally:
+        elastic.register_heartbeats(None)
+    assert elastic.stall_extras() == {}     # single-device: unchanged
+
+
+# --------------------------------------------------------------------
+# Validator rules for the new event types
+# --------------------------------------------------------------------
+
+def test_validator_reshard_desync_rules(tmp_path, blobs):
+    x, y = blobs
+    trace = str(tmp_path / "t.jsonl")
+    train_distributed(x, y, _base(shards=2, max_iter=100,
+                                  trace_out=trace))
+    records = load_trace(trace)
+    assert validate_trace(records) == []
+    manifest, rest = records[0], records[1:]
+
+    # reshard rewinds the n_iter baseline (like rollback)
+    chunk = next(r for r in rest if r["kind"] == "chunk")
+    reshard = {"kind": "event", "event": "reshard", "n_iter": 0,
+               "from_shards": 4, "to_shards": 2, "t": chunk["t"]}
+    rewound = dict(chunk, n_iter=0)
+    assert validate_trace([manifest, chunk, reshard, rewound]
+                          + rest[rest.index(chunk) + 1:]) == []
+    # without the rewind marker the same sequence is invalid
+    errs = validate_trace([manifest, chunk, rewound]
+                          + rest[rest.index(chunk) + 1:])
+    assert any("monotone" in e for e in errs)
+
+    # desync/reshard events missing their required extras are rejected
+    bad_desync = {"kind": "event", "event": "desync", "n_iter": 5,
+                  "t": chunk["t"]}
+    errs = validate_trace([manifest, chunk, bad_desync]
+                          + rest[rest.index(chunk) + 1:])
+    assert any("shards" in e for e in errs)
+    bad_reshard = {"kind": "event", "event": "reshard", "n_iter": 0,
+                   "t": chunk["t"]}
+    errs = validate_trace([manifest, chunk, bad_reshard]
+                          + rest[rest.index(chunk) + 1:])
+    assert any("from_shards" in e for e in errs)
+
+
+# --------------------------------------------------------------------
+# Doctor preflight
+# --------------------------------------------------------------------
+
+def test_doctor_ok_and_reports_reshard_pending(tmp_path, blobs):
+    from dpsvm_tpu.resilience.doctor import run_doctor
+
+    x, y = blobs
+    ck = str(tmp_path / "state.npz")
+    train_distributed(x, y, _base(shards=4, max_iter=100,
+                                  checkpoint_path=ck,
+                                  checkpoint_every=50))
+    lines = []
+    rc = run_doctor(shards=2, checkpoint_path=ck, timeout_s=60.0,
+                    out=lines.append)
+    text = "\n".join(lines)
+    assert rc == 0, text
+    assert "DOCTOR OK" in text
+    assert "psum over 2 devices OK" in text
+    assert "RE-SHARD" in text            # 4-shard slot on a 2-shard ask
+
+
+def test_doctor_fails_on_unwritable_dir_and_bad_slot(tmp_path):
+    from dpsvm_tpu.resilience.doctor import run_doctor
+
+    # unwritable directory (a FILE where the dir should be)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    lines = []
+    rc = run_doctor(shards=1,
+                    checkpoint_path=str(blocker / "state.npz"),
+                    timeout_s=60.0, out=lines.append)
+    assert rc != 0 and any("DOCTOR FAIL" in ln for ln in lines)
+
+    # every rotation slot corrupt -> non-zero with a diagnosis
+    ck = tmp_path / "state.npz"
+    ck.write_bytes(b"not a zip at all")
+    lines = []
+    rc = run_doctor(shards=1, checkpoint_path=str(ck),
+                    timeout_s=60.0, out=lines.append)
+    assert rc != 0
+    assert any("NO intact checkpoint" in ln for ln in lines)
+
+
+def test_doctor_cli_surface(tmp_path, capsys):
+    from dpsvm_tpu import cli
+
+    rc = cli.main(["doctor", "--shards", "2",
+                   "--checkpoint", str(tmp_path / "state.npz")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "DOCTOR OK" in out

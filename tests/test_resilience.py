@@ -149,11 +149,16 @@ def test_corrupt_newest_slot_fallback_traces_retry_rollback(
     assert load_checkpoint(ck).n_iter == 400
     assert load_checkpoint(rotation_path(ck, 1)).n_iter == 300
 
-    with open(ck, "r+b") as fh:         # corrupt the newest slot
-        fh.seek(os.path.getsize(ck) // 2)
-        byte = fh.read(1)
-        fh.seek(-1, os.SEEK_CUR)
-        fh.write(bytes([byte[0] ^ 0xFF]))
+    # Corrupt the newest slot INSIDE the alpha payload (located by
+    # content — npz members are stored uncompressed, and a fixed-offset
+    # flip can land in dead zip-header bytes as the format grows).
+    snap = load_checkpoint(ck)
+    raw = bytearray(open(ck, "rb").read())
+    payload = np.ascontiguousarray(snap.alpha, np.float32).tobytes()
+    pos = raw.find(payload)
+    assert pos > 0
+    raw[pos + len(payload) // 2] ^= 0xFF
+    open(ck, "wb").write(bytes(raw))
 
     # A supervisor retry announces itself to the attempt via env.
     monkeypatch.setenv("DPSVM_RETRY_ATTEMPT", "1")
@@ -397,6 +402,23 @@ def test_watchdog_expiry_flushes_stall_event_into_trace(tmp_path):
 def test_resilience_selfcheck():
     from dpsvm_tpu.resilience import selfcheck
     assert selfcheck() == []
+
+
+def test_train_result_alpha_owns_its_memory(blobs_small):
+    """Regression: result.alpha used to be a zero-copy VIEW of the
+    final carry's device buffer (np.asarray on the CPU backend); once
+    the carry was garbage-collected the buffer was recycled by the
+    next compile/execution and the returned duals silently mutated —
+    models built from the result intermittently carried garbage
+    coefficients (the long-standing bench flake). The shared driver
+    now copies at the return boundary, so every solver path returns
+    owned memory."""
+    x, y = blobs_small
+    r = train_single_device(x, y, _base(max_iter=100))
+    assert np.asarray(r.alpha).flags["OWNDATA"]
+    from dpsvm_tpu.parallel.dist_smo import train_distributed
+    r2 = train_distributed(x, y, _base(max_iter=100, shards=2))
+    assert np.asarray(r2.alpha).flags["OWNDATA"]
 
 
 def test_max_rollbacks_bounded():
