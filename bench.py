@@ -92,7 +92,7 @@ def approx_vs_exact() -> None:
     acc_approx = evaluate(m_approx, xt, yt)
     speedup = (r_exact.train_seconds / r_approx.train_seconds
                if r_approx.train_seconds > 0 else 0.0)
-    print(json.dumps({
+    row = {
         "metric": "approx_vs_exact_speedup",
         "value": round(speedup, 2),
         "unit": "x",
@@ -105,7 +105,13 @@ def approx_vs_exact() -> None:
         "approx_converged": bool(r_approx.converged),
         "n": n, "d": d, "approx_dim": approx_dim,
         "c": c, "gamma": gamma,
-    }), flush=True)
+    }
+    print(json.dumps(row), flush=True)
+    # Perf-ledger provenance (docs/OBSERVABILITY.md "Perf ledger"):
+    # the row joins the persistent history `dpsvm perf gate` checks.
+    from dpsvm_tpu.observability import ledger
+    ledger.append(row["metric"], row, kind="bench",
+                  trace=trace_out, backend=dev.platform)
 
 
 def main() -> None:
@@ -220,7 +226,7 @@ def main() -> None:
                       if c["flops"] is not None), None)
     log(f"phases: {timer.summary()}")
     log(f"compiles: {len(compiles)} in {compile_seconds}s; hbm peak: "
-        f"{hbm['peak']}")
+        f"{hbm['peak'] if hbm['peak'] is not None else 'n/a'}")
     log(f"{iters} iters in {dt:.3f}s on ({n}x{d}) -> {rate:.1f} iter/s "
         f"(gap: b_lo={st.b_lo:.4f} b_hi={st.b_hi:.4f})")
 
@@ -261,7 +267,7 @@ def main() -> None:
         trace.close()
         log(f"trace: {trace_path}")
 
-    print(json.dumps({
+    row = {
         "metric": "smo_iters_per_sec_mnist_scale",
         "value": round(rate, 1),
         "unit": "iter/s",
@@ -274,7 +280,12 @@ def main() -> None:
         "compile_seconds": compile_seconds,
         "hbm_peak": hbm["peak"],
         "est_flops": est_flops,
-    }), flush=True)
+    }
+    print(json.dumps(row), flush=True)
+    # Perf-ledger provenance (docs/OBSERVABILITY.md "Perf ledger").
+    from dpsvm_tpu.observability import ledger
+    ledger.append(row["metric"], row, kind="bench",
+                  trace=trace_path or None, backend=dev.platform)
 
 
 if __name__ == "__main__":

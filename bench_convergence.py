@@ -176,7 +176,16 @@ def main() -> None:
                        wall_budget_s=wall_budget,
                        trace_out=trace_out)
 
-    print(json.dumps(convergence_run(x, y, config)), flush=True)
+    row = convergence_run(x, y, config)
+    print(json.dumps(row), flush=True)
+    # Perf-ledger provenance (docs/OBSERVABILITY.md "Perf ledger"):
+    # the case tag defaults to the metric name; the burst runner tags
+    # its own rows per sweep tag, so standalone runs may pin
+    # BENCH_LEDGER_CASE to keep shapes' histories separate.
+    from dpsvm_tpu.observability import ledger
+    ledger.append(os.environ.get("BENCH_LEDGER_CASE") or row["metric"],
+                  row, kind="bench", trace=trace_out,
+                  backend=dev.platform)
 
 
 if __name__ == "__main__":

@@ -212,6 +212,30 @@ def record(path, tag, rc, secs, stdout_lines, stderr_lines, trace=None,
         row["degraded"] = True
     with open(path, "a") as fh:
         fh.write(json.dumps(row) + "\n")
+    # Every row also joins the persistent perf ledger, per TAG, so
+    # `dpsvm perf gate` has cross-window history from run one
+    # (docs/OBSERVABILITY.md "Perf ledger"). The measurement payload is
+    # the tag's own JSON line when one was printed; degraded /
+    # no-output rows still land (rc + seconds) so failures are history
+    # too. Best-effort by design — a ledger hiccup must not burn a
+    # recorded measurement.
+    try:
+        from dpsvm_tpu.observability import ledger
+        measurement = None
+        for ln in stdout_lines:
+            try:
+                parsed = json.loads(ln)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            if isinstance(parsed, dict) and "metric" in parsed:
+                measurement = parsed
+        metrics = dict(measurement or {})
+        metrics.update(rc=int(rc), seconds=int(secs))
+        if degraded:
+            metrics["degraded"] = True
+        ledger.append(tag, metrics, kind="burst", trace=trace)
+    except Exception as e:                  # noqa: BLE001 — provenance only
+        log(f"WARNING: perf-ledger append failed for {tag}: {e}")
 
 
 def load_pending():

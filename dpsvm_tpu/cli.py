@@ -132,7 +132,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="base of the exponential retry backoff: "
                          "attempt k waits S * 2^k seconds (default 5)")
     tr.add_argument("--profile-dir", default=None,
-                    help="write a jax.profiler trace here")
+                    help="write an auto-windowed jax.profiler trace "
+                         "here (warmup compiles skipped, K steady-state "
+                         "polls captured, phases annotated) plus a "
+                         "profile_summary.json sidecar — render with "
+                         "`dpsvm profile summarize DIR` "
+                         "(docs/OBSERVABILITY.md)")
+    tr.add_argument("--metrics-port", type=int, default=None,
+                    metavar="N",
+                    help="opt-in read-only metrics sidecar: serve the "
+                         "live metric registry on this port (0 = OS-"
+                         "assigned; bound port printed to stderr) as "
+                         "/metricsz JSON and /metricsz?format="
+                         "prometheus, torn down at run end — fed from "
+                         "the existing packed-stats polls, zero extra "
+                         "device transfers")
+    tr.add_argument("--metrics-out", default=None, metavar="FILE",
+                    help="scrape-less CI: rewrite FILE with the "
+                         "Prometheus text exposition at every poll "
+                         "(atomic replace)")
     tr.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write a run-telemetry JSONL here (manifest + "
                          "per-chunk gap/SV-count/cache-counter records "
@@ -425,6 +443,55 @@ def build_parser() -> argparse.ArgumentParser:
                     help="iteration marks for the gap-trajectory "
                          "comparison (default 4)")
 
+    pf = sub.add_parser(
+        "perf", help="persistent perf ledger: per-case measurement "
+                     "history and the historical regression gate "
+                     "(median-of-last-N baseline) that catches drift "
+                     "accumulating across individually-passing PRs "
+                     "(docs/OBSERVABILITY.md 'Perf ledger')")
+    pf.add_argument("action", nargs="?", default="history",
+                    choices=["history", "gate"],
+                    help="history (default): render per-case trends; "
+                         "gate: fail on a historical regression")
+    pf.add_argument("--ledger", default=None, metavar="PATH",
+                    help="ledger JSONL (default: $DPSVM_PERF_LEDGER, "
+                         "else benchmarks/results/perf_ledger.jsonl)")
+    pf.add_argument("--case", default=None,
+                    help="restrict to one case tag (default: all)")
+    pf.add_argument("--metric", default="value",
+                    help="reading to plot/gate: 'value' (the row's "
+                         "headline) or any numeric key of the "
+                         "record's metrics dict")
+    pf.add_argument("--window", type=int, default=5, metavar="N",
+                    help="gate baseline: median of the last N records "
+                         "before the newest (default 5)")
+    pf.add_argument("--fail-on-regress", type=float, default=10.0,
+                    metavar="PCT",
+                    help="gate threshold percent (direction-aware "
+                         "like `dpsvm compare`; default 10)")
+    pf.add_argument("--last", type=int, default=12,
+                    help="history rows rendered per case (default 12)")
+    pf.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
+    pr = sub.add_parser(
+        "profile", help="render the reconciliation sidecar of a "
+                        "`train --profile-dir` capture: phase-"
+                        "attributed host wall split next to the run "
+                        "trace's phase_counts, plus the device-trace "
+                        "artifact inventory (docs/OBSERVABILITY.md "
+                        "'Profiling')")
+    pr.add_argument("action", choices=["summarize"],
+                    help="summarize: the one reconciliation table")
+    pr.add_argument("dir", help="the --profile-dir directory")
+    pr.add_argument("--trace", default=None, metavar="PATH",
+                    help="run-telemetry trace (or directory) to "
+                         "reconcile against: its phase_counts are "
+                         "printed next to the profile's phases and "
+                         "the match is verified")
+    pr.add_argument("--json", action="store_true",
+                    help="machine-readable summary")
+
     sv = sub.add_parser(
         "serve", help="online prediction server: micro-batched "
                       "/v1/predict over any saved model (or several), "
@@ -519,6 +586,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma list of outputs to request: labels, "
                          "decision, proba")
     lg.add_argument("--timeout", type=float, default=30.0)
+    lg.add_argument("--trace", default=None, metavar="PATH",
+                    help="provenance trace pointer carried in the "
+                         "result row (the serving side's --trace-out "
+                         "artifact, or an archived copy) — the same "
+                         "field burst-runner rows carry, so serving "
+                         "SLO rows are ledger- and compare-traceable "
+                         "like training rows (default: "
+                         "$BENCH_TRACE_OUT when set)")
+    lg.add_argument("--no-ledger", dest="ledger", action="store_false",
+                    default=True,
+                    help="skip the perf-ledger append "
+                         "(docs/OBSERVABILITY.md 'Perf ledger')")
     lg.add_argument("--no-compare-sequential", dest="compare_sequential",
                     action="store_false", default=True,
                     help="skip the batch-1 single-worker baseline pass "
@@ -848,6 +927,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         on_divergence=args.on_divergence,
         health_window=args.health_window,
         profile_dir=args.profile_dir,
+        metrics_port=args.metrics_port,
+        metrics_out=args.metrics_out,
         trace_out=args.trace_out,
         debug_nans=args.debug_nans,
         matmul_precision=args.precision,
@@ -1349,6 +1430,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
               f"{sorted(set(unknown))} (loaded: {registry.names()})",
               file=sys.stderr)
         return 2
+    # The CLI server exposes the PROCESS-wide registry — the same one
+    # a training run in this process would feed — so /metricsz?format=
+    # prometheus is the single scrape surface (docs/OBSERVABILITY.md
+    # "Metrics").
+    from dpsvm_tpu.observability.metrics import default_registry
     try:
         srv = ServingServer(registry, args.host, args.port,
                             max_batch=args.max_batch,
@@ -1358,6 +1444,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             replicas=args.replicas, hedge=hedge,
                             degrade=args.degrade, siblings=siblings,
                             trace_out=args.trace_out,
+                            metrics_registry=default_registry(),
                             verbose=not args.quiet).start()
     except ValueError as e:                 # width-mismatched sibling
         print(f"error: {e}", file=sys.stderr)
@@ -1405,6 +1492,18 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
             return 2
     else:
         rows = synthetic_rows(manifest["num_attributes"])
+    trace = args.trace or os.environ.get("BENCH_TRACE_OUT") or None
+
+    def _ledger_append(row):
+        # serving rows join the same persistent perf ledger training
+        # rows feed, so `dpsvm perf gate` sees both halves
+        # (docs/OBSERVABILITY.md "Perf ledger"); best-effort.
+        if not args.ledger:
+            return
+        from dpsvm_tpu.observability import ledger
+        ledger.append(row.get("metric", "loadgen"), row,
+                      kind="loadgen", trace=row.get("trace"))
+
     if args.saturate:
         row = run_saturate(args.url, rows, model=args.model,
                            p99_target_ms=args.p99_target_ms,
@@ -1414,16 +1513,19 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
                            step_requests=args.step_requests,
                            batch=args.batch,
                            concurrency=args.concurrency, want=want,
-                           timeout=args.timeout)
+                           timeout=args.timeout, trace=trace)
         print(json.dumps(row), flush=True)
+        _ledger_append(row)
         return 0 if row["slo_met"] else 1
     row = loadgen_row(args.url, rows, model=args.model,
                       requests=args.requests, batch=args.batch,
                       concurrency=args.concurrency, mode=args.mode,
                       rps=args.rps, want=want, timeout=args.timeout,
                       chaos=args.chaos,
-                      compare_sequential=args.compare_sequential)
+                      compare_sequential=args.compare_sequential,
+                      trace=trace)
     print(json.dumps(row), flush=True)
+    _ledger_append(row)
     if args.chaos:
         # a chaos drill EXPECTS some failures; the verdict is the
         # availability of accepted requests (the acceptance bar)
@@ -1577,6 +1679,22 @@ def cmd_compare(args: argparse.Namespace) -> int:
         return 2
     regress = (regressions(cmp, args.fail_on_regress)
                if args.fail_on_regress is not None else [])
+    if args.fail_on_regress is not None:
+        # Gated verdicts join the perf ledger: pairwise outcomes
+        # become history `dpsvm perf gate` can check for accumulated
+        # drift the pairwise gate cannot see. Best-effort (a ledger
+        # hiccup must not change the compare verdict).
+        import os as _os
+
+        from dpsvm_tpu.observability import ledger
+        by = {r["metric"]: r for r in cmp["metrics"]}
+        ips_b = (by.get("iters_per_sec") or {}).get("b")
+        ledger.append(
+            _os.path.splitext(_os.path.basename(rb))[0],
+            {"passed": not regress, "regressions": regress,
+             "threshold_pct": args.fail_on_regress,
+             "a": ra, "b": rb, "value": ips_b, "unit": "iter/s"},
+            kind="compare", trace=rb)
     if args.json:
         _pipe_safe_print(json.dumps(dict(cmp, a_path=ra, b_path=rb,
                                          regressions=regress)))
@@ -1592,6 +1710,105 @@ def cmd_compare(args: argparse.Namespace) -> int:
                          f"{args.fail_on_regress:g}% threshold")
         _pipe_safe_print(text)
     return 1 if regress else 0
+
+
+def cmd_perf(args: argparse.Namespace) -> int:
+    """Perf-ledger history + historical regression gate
+    (docs/OBSERVABILITY.md "Perf ledger"). Pure file I/O like
+    report/compare — no backend init. Exit codes: 0 = OK (or gate
+    passed), 1 = gate regression, 2 = no/unreadable ledger."""
+    import json
+
+    from dpsvm_tpu.observability import ledger
+
+    path = ledger.ledger_path(args.ledger)
+    if path is None or not os.path.isfile(path):
+        where = path or "(ledger disabled: DPSVM_PERF_LEDGER is empty)"
+        print(f"error: no perf ledger at {where} — bench/burst/"
+              "loadgen/compare runs append to it automatically",
+              file=sys.stderr)
+        return 2
+    try:
+        records = ledger.read(path)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.action == "gate":
+        try:
+            verdicts = ledger.gate(records, window=args.window,
+                                   threshold_pct=args.fail_on_regress,
+                                   case=args.case, metric=args.metric)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            _pipe_safe_print(json.dumps({
+                "ledger": path, "window": args.window,
+                "threshold_pct": args.fail_on_regress,
+                "cases": ledger.cases(records),
+                "regressions": verdicts}))
+        elif verdicts:
+            print(f"HISTORICAL REGRESSION past "
+                  f"{args.fail_on_regress:g}% (window {args.window}):")
+            for v in verdicts:
+                print(f"  {v}")
+        else:
+            n = len([args.case] if args.case
+                    else ledger.cases(records))
+            print(f"no historical regression past "
+                  f"{args.fail_on_regress:g}% across {n} case(s) "
+                  f"(median-of-last-{args.window} baseline, {path})")
+        return 1 if verdicts else 0
+    if args.json:
+        out = {"ledger": path, "cases": {}}
+        for c in ([args.case] if args.case
+                  else ledger.cases(records)):
+            out["cases"][c] = ledger.series(records, c,
+                                            metric=args.metric)
+            for h in out["cases"][c]:
+                h.pop("record", None)
+        _pipe_safe_print(json.dumps(out))
+        return 0
+    if args.case and args.case not in ledger.cases(records):
+        print(f"error: no case {args.case!r} in {path} "
+              f"(cases: {ledger.cases(records)})", file=sys.stderr)
+        return 2
+    _pipe_safe_print(f"perf ledger: {path} "
+                     f"({len(records)} record(s))\n"
+                     + ledger.render_history(
+                         records, case=args.case, metric=args.metric,
+                         last=args.last))
+    return 0
+
+
+def cmd_profile(args: argparse.Namespace) -> int:
+    """`dpsvm profile summarize DIR`: the reconciliation table of an
+    auto-windowed --profile-dir capture (observability/profiler.py).
+    Pure file I/O — no backend init."""
+    import json
+
+    from dpsvm_tpu.observability import profiler
+
+    try:
+        result = profiler.summarize_profile(args.dir,
+                                            trace_path=args.trace)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"error: unreadable profile summary: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        _pipe_safe_print(json.dumps(result))
+        return 0
+    text = profiler.render_summary(
+        result, trace_phase_counts=result.get("trace_phase_counts"))
+    if args.trace is not None and not result.get("phases_match", True):
+        text += ("\nWARNING: trace phases missing from the profile's "
+                 "annotation vocabulary")
+    _pipe_safe_print(text)
+    return 0
 
 
 def _init_backend(args: argparse.Namespace) -> int:
@@ -1661,6 +1878,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_report(args)
         if args.command == "compare":
             return cmd_compare(args)
+        if args.command == "perf":
+            return cmd_perf(args)
+        if args.command == "profile":
+            return cmd_profile(args)
         if args.command == "serve":
             return cmd_serve(args)
         if args.command == "loadgen":

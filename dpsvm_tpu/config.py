@@ -227,7 +227,30 @@ class SVMConfig:
                                             # non-finite-gap guard is
                                             # ALWAYS armed (a NaN gap is
                                             # never legitimate)
-    profile_dir: Optional[str] = None       # jax.profiler trace output dir
+    profile_dir: Optional[str] = None       # jax.profiler trace output dir:
+                                            # auto-windowed capture (skip
+                                            # warmup compiles, K steady-state
+                                            # polls) with TraceAnnotation
+                                            # spans named after the PhaseTimer
+                                            # phases + a profile_summary.json
+                                            # sidecar `dpsvm profile
+                                            # summarize` renders
+                                            # (observability/profiler.py)
+    metrics_port: Optional[int] = None      # opt-in read-only metrics
+                                            # sidecar: serve the process
+                                            # metric registry on this port
+                                            # (0 = OS-assigned) as
+                                            # /metricsz JSON +
+                                            # /metricsz?format=prometheus,
+                                            # torn down at run end — zero
+                                            # extra D2H transfers (the
+                                            # registry is fed from the same
+                                            # packed-stats polls tracing
+                                            # rides)
+    metrics_out: Optional[str] = None       # scrape-less CI: rewrite this
+                                            # file with the Prometheus text
+                                            # exposition at every poll
+                                            # (atomic tmp+rename)
     trace_out: Optional[str] = None         # run-telemetry JSONL path:
                                             # manifest + per-chunk records
                                             # (gap, SV count, cache
@@ -339,6 +362,11 @@ class SVMConfig:
         if self.wall_budget_s < 0:
             raise ValueError(
                 f"wall_budget_s must be >= 0, got {self.wall_budget_s}")
+        if self.metrics_port is not None and not (
+                0 <= int(self.metrics_port) <= 65535):
+            raise ValueError(
+                f"metrics_port must be in [0, 65535], got "
+                f"{self.metrics_port}")
         # Finite AND positive: `w <= 0` alone lets NaN through (every
         # NaN comparison is False) and inf past the positivity check —
         # either would poison the box bound silently (ADVICE r5).
@@ -597,6 +625,12 @@ class SVMConfig:
                     ("profile_dir", bool(self.profile_dir),
                      "the shrinking loop manages its own dispatch; "
                      "profile the unshrunk path"),
+                    ("metrics_port/metrics_out",
+                     self.metrics_port is not None
+                     or bool(self.metrics_out),
+                     "the shrinking loop manages its own dispatch; "
+                     "the metrics exporters ride the shared host "
+                     "driver"),
                     ("on_divergence", self.on_divergence != "raise",
                      "the shrinking loop manages its own dispatch; "
                      "divergence guards ride the shared host driver"),
@@ -634,6 +668,8 @@ class SVMConfig:
                 ("checkpoint_every", self.checkpoint_every),
                 ("resume_from", self.resume_from),
                 ("profile_dir", self.profile_dir),
+                ("metrics_port", self.metrics_port is not None),
+                ("metrics_out", self.metrics_out),
                 ("trace_out", self.trace_out),
                 ("wall_budget_s", self.wall_budget_s),
                 ("on_divergence", self.on_divergence != "raise"),
@@ -712,6 +748,8 @@ def _auto_solver_plan(n: int, d: int, config: "SVMConfig") -> dict:
                             and not config.checkpoint_path
                             and not config.resume_from
                             and not config.profile_dir
+                            and config.metrics_port is None
+                            and not config.metrics_out
                             and config.on_divergence == "raise"
                             and not config.health_window
                             and not (config.use_pallas == "on"

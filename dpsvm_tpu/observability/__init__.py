@@ -24,10 +24,21 @@ Layout (docs/OBSERVABILITY.md):
 * ``report``       — digest + ASCII report + ``--follow`` live tail.
 * ``compare``      — two-trace delta table + regression gate
                      (``dpsvm compare``).
+* ``metrics``      — process-wide metric registry (counters / gauges /
+                     histograms), Prometheus text exposition +
+                     grammar validator, the training-poll feeder and
+                     the ``--metrics-port`` sidecar.
+* ``profiler``     — auto-windowed ``jax.profiler`` capture with
+                     phase-named TraceAnnotation spans and the
+                     ``dpsvm profile summarize`` reconciliation
+                     sidecar.
+* ``ledger``       — persistent append-only perf ledger + the
+                     ``dpsvm perf gate`` historical regression check.
 
 Importing this package initializes no backend: jax is imported lazily
-inside the functions that need it (compilewatch, device), so ``dpsvm
-report``/``compare`` run on a machine with no accelerator.
+inside the functions that need it (compilewatch, device, profiler), so
+``dpsvm report``/``compare``/``perf`` run on a machine with no
+accelerator.
 """
 
 from __future__ import annotations
@@ -45,6 +56,9 @@ from dpsvm_tpu.observability.report import (follow_trace, load_trace,
                                             resolve_trace_path,
                                             summarize_trace,
                                             trace_facts)
+from dpsvm_tpu.observability.metrics import (MetricsRegistry,
+                                             default_registry,
+                                             validate_exposition)
 from dpsvm_tpu.observability.schema import (TRACE_SCHEMA_VERSION,
                                             TraceWriter, read_trace,
                                             validate_trace)
@@ -54,8 +68,9 @@ __all__ = [
     "validate_trace", "RunTrace", "SOLVER_NAMES", "flush_open_traces",
     "load_trace", "render_report", "summarize_trace", "trace_facts",
     "resolve_trace_path", "follow_trace", "compare_traces",
-    "compare_paths", "render_compare", "regressions", "selfcheck",
-    "main",
+    "compare_paths", "render_compare", "regressions",
+    "MetricsRegistry", "default_registry", "validate_exposition",
+    "selfcheck", "main",
 ]
 
 # A v1 trace embedded verbatim: the schema gate asserts that old
@@ -155,6 +170,87 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
         v1_text = render_report(V1_SAMPLE_RECORDS)
         if "hbm peak" in v1_text or "compiles:" in v1_text:
             problems.append("v1 rendering invented v2 device facts")
+    problems += _selfcheck_metrics()
+    problems += _selfcheck_ledger(tmp_dir)
+    return problems
+
+
+def _selfcheck_metrics() -> List[str]:
+    """Registry -> exposition -> grammar validator round-trip, plus a
+    tamper check (the validator must actually reject broken text) —
+    the schema gate of the metrics surface, sibling of the trace
+    writer/validator round-trip above."""
+    problems = []
+    reg = MetricsRegistry()
+    c = reg.counter("dpsvm_check_requests_total", "selfcheck counter",
+                    labels=("model",))
+    c.labels(model="default").inc(3)
+    c.labels(model='odd"name\nwith escapes').inc()
+    reg.gauge("dpsvm_check_gap", "selfcheck gauge").set(0.125)
+    h = reg.histogram("dpsvm_check_latency_ms", "selfcheck histogram",
+                      buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    errs = validate_exposition(text)
+    if errs:
+        problems.append(f"exposition no longer validates: {errs}")
+    if c.labels(model="default").value != 3:
+        problems.append("counter read-back drifted")
+    tampered = text.replace('le="+Inf"} 4', 'le="+Inf"} 3')
+    if not validate_exposition(tampered):
+        problems.append("exposition validator accepted a broken "
+                        "histogram (+Inf bucket != _count)")
+    snap = reg.snapshot()
+    if snap.get("dpsvm_check_gap", {}).get("series", [{}])[0].get(
+            "value") != 0.125:
+        problems.append("JSON snapshot lost the gauge value")
+    return problems
+
+
+def _selfcheck_ledger(tmp_dir: Optional[str] = None) -> List[str]:
+    """Perf-ledger append/read/gate round-trip: a planted 20%
+    historical regression MUST fail the gate; a clean history and a
+    single-run case must pass (docs/OBSERVABILITY.md "Perf ledger")."""
+    import os
+    import tempfile
+
+    from dpsvm_tpu.observability import ledger
+
+    problems = []
+    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
+        path = os.path.join(td, "ledger.jsonl")
+        for v in (100.0, 101.0, 99.0, 100.0, 100.0, 100.5):
+            ledger.append("clean_case", {"value": v, "unit": "iter/s"},
+                          kind="bench", path=path, strict=True)
+        for v in (100.0, 100.0, 101.0, 99.0, 100.0, 80.0):
+            ledger.append("planted_regression",
+                          {"value": v, "unit": "iter/s"},
+                          kind="bench", path=path, strict=True,
+                          trace="traces/planted.jsonl")
+        ledger.append("single_run", {"value": 5.0, "unit": "s"},
+                      kind="burst", path=path, strict=True)
+        records = ledger.read(path)
+        if len(records) != 13:
+            problems.append(f"ledger round-trip lost records "
+                            f"({len(records)}/13)")
+        clean = ledger.gate(records, window=5, threshold_pct=10.0,
+                            case="clean_case")
+        if clean:
+            problems.append(f"clean history failed the gate: {clean}")
+        planted = ledger.gate(records, window=5, threshold_pct=10.0,
+                              case="planted_regression")
+        if not planted:
+            problems.append("planted 20% regression PASSED the "
+                            "historical gate")
+        if ledger.gate(records, window=5, threshold_pct=10.0,
+                       case="single_run"):
+            problems.append("single-run case (no history) failed the "
+                            "gate")
+        # the full-ledger sweep must flag exactly the planted case
+        allv = ledger.gate(records, window=5, threshold_pct=10.0)
+        if [v.split(":")[0] for v in allv] != ["planted_regression"]:
+            problems.append(f"full-ledger gate verdicts drifted: {allv}")
     return problems
 
 
@@ -180,7 +276,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"  {pr}", file=sys.stderr)
             return 1
         print("telemetry selfcheck OK "
-              f"(schema v{TRACE_SCHEMA_VERSION}, v1 accepted)")
+              f"(schema v{TRACE_SCHEMA_VERSION}, v1 accepted; metrics "
+              "exposition + ledger gate checked)")
         return 0
     if args.validate:
         try:

@@ -59,24 +59,39 @@ def _gap_at(curve: List[Tuple[int, float]], it: float) -> Optional[float]:
     return None
 
 
-def _gap_marks(fa: dict, fb: dict, marks: int = 4) -> List[dict]:
+def _gap_marks(fa: dict, fb: dict, marks: int = 4
+               ) -> Tuple[List[dict], int]:
     """Gap deltas at iteration marks spanning the two curves' common
     iteration range (empty when the runs share no range — e.g. a
-    resumed run against a fresh one)."""
+    resumed run against a fresh one). Returns (marks, marks_used).
+
+    Marks are CLAMPED to the polls actually recorded: a short run with
+    2 chunk records has exactly one interpolation segment, and asking
+    for 4 marks there produced duplicated/clamped-endpoint rows that
+    read as a real trajectory — the table notes the clamp instead
+    (`render_compare`)."""
     ca, cb = fa["curve"], fb["curve"]
     if not ca or not cb:
-        return []
+        return [], 0
     lo = max(ca[0][0], cb[0][0])
     hi = min(ca[-1][0], cb[-1][0])
     if hi <= lo:
-        return []
+        return [], 0
+    # polls per trace inside the common range: the interpolation has
+    # min(polls)-1 real segments; more marks than that only re-sample
+    # the same segments (and round to duplicate n_iter rows on short
+    # runs).
+    avail = min(sum(1 for i, _g in c if lo <= i <= hi)
+                for c in (ca, cb))
+    used = max(1, min(int(marks), avail - 1 if avail > 1 else 1,
+                      int(hi - lo)))
     out = []
-    for k in range(1, marks + 1):
-        it = lo + (hi - lo) * k / marks
+    for k in range(1, used + 1):
+        it = lo + (hi - lo) * k / used
         ga, gb = _gap_at(ca, it), _gap_at(cb, it)
         out.append({"n_iter": int(round(it)), "a": ga, "b": gb,
                     "delta_pct": _pct(ga, gb)})
-    return out
+    return out, used
 
 
 def compare_traces(records_a: List[dict], records_b: List[dict],
@@ -104,13 +119,16 @@ def compare_traces(records_a: List[dict], records_b: List[dict],
             "a_count": fa["phase_counts"].get(name),
             "b_count": fb["phase_counts"].get(name),
             "delta_pct": _pct(sa, sb)})
+    gap_marks, marks_used = _gap_marks(fa, fb, marks)
     return {
         "a": {k: fa.get(k) for k in ("solver", "n", "d", "schema",
                                      "converged")},
         "b": {k: fb.get(k) for k in ("solver", "n", "d", "schema",
                                      "converged")},
         "metrics": rows,
-        "gap_marks": _gap_marks(fa, fb, marks),
+        "gap_marks": gap_marks,
+        "marks_requested": int(marks),
+        "marks_used": marks_used,
         "phases": phases,
     }
 
@@ -172,8 +190,14 @@ def render_compare(cmp: dict, label_a: str = "A",
                    f"{_cell(r['b'], r['metric']):>14} {d}")
     if cmp["gap_marks"]:
         out.append("")
+        clamp = ""
+        used = cmp.get("marks_used", len(cmp["gap_marks"]))
+        req = cmp.get("marks_requested", used)
+        if used < req:
+            clamp = (f" [marks clamped {req} -> {used}: short run, "
+                     "too few chunk polls in the common range]")
         out.append("  gap trajectory at matched iteration marks "
-                   "(lower = further converged):")
+                   f"(lower = further converged):{clamp}")
         for m in cmp["gap_marks"]:
             d = (f"{m['delta_pct']:+8.1f}%" if m["delta_pct"] is not None
                  else "      n/a")

@@ -1,0 +1,348 @@
+"""First-class ``jax.profiler`` integration (docs/OBSERVABILITY.md
+"Profiling").
+
+``train --profile-dir DIR`` used to wrap the WHOLE training loop in
+``jax.profiler.trace`` — on a real run that artifact is dominated by
+the first chunk's XLA compile and grows with run length, so two runs'
+profiles were never comparable. ``ProfileSession`` fixes both:
+
+* **Auto-windowed capture** — the device trace starts at a poll
+  boundary after ``skip_polls`` polls (the first chunk's compile has
+  already happened by the time the first poll returns, so even
+  ``skip_polls=0`` excludes it) and stops after ``capture_polls``
+  steady-state polls. The artifact is small and shaped the same for
+  every run of the same config. Env overrides:
+  ``DPSVM_PROFILE_SKIP_POLLS`` / ``DPSVM_PROFILE_POLLS``.
+* **Phase annotations** — the driver's ``PhaseTimer`` buckets
+  (``dispatch`` / ``poll`` / ``checkpoint`` / ``hook``) and the poll
+  boundaries are wrapped in ``jax.profiler.TraceAnnotation`` spans
+  named exactly after the phases, so the XLA timeline carries the same
+  vocabulary as the run trace's ``phase_counts``.
+* **Reconciliation sidecar** — ``close()`` writes
+  ``profile_summary.json`` next to the device artifact: the host-side
+  phase seconds/call-counts *inside the captured window*, the window
+  bounds, and the device artifact inventory. ``dpsvm profile
+  summarize DIR`` renders it (optionally against a run trace) so
+  XLA-level time and host-level accounting line up in one table —
+  without needing TensorBoard to open the xplane protobuf.
+
+jax is imported lazily and every profiler call is wrapped: a backend
+whose profiler is unavailable degrades to the sidecar-only summary
+instead of killing the run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+SUMMARY_FILE = "profile_summary.json"
+SUMMARY_SCHEMA = 1
+
+#: device-trace artifact suffixes jax's profiler writes under
+#: <dir>/plugins/profile/<run>/
+ARTIFACT_SUFFIXES = (".xplane.pb", ".trace.json.gz", ".json.gz",
+                     ".pb", ".json")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def find_artifacts(log_dir: str) -> List[dict]:
+    """Inventory of device-trace files under ``log_dir`` (relative
+    path + size), newest first."""
+    out = []
+    for root, _dirs, files in os.walk(log_dir):
+        for f in files:
+            if f == SUMMARY_FILE:
+                continue
+            if any(f.endswith(s) for s in ARTIFACT_SUFFIXES):
+                p = os.path.join(root, f)
+                try:
+                    out.append({
+                        "path": os.path.relpath(p, log_dir),
+                        "bytes": os.path.getsize(p),
+                        "mtime": os.path.getmtime(p),
+                    })
+                except OSError:
+                    pass
+    out.sort(key=lambda a: -a["mtime"])
+    for a in out:
+        a.pop("mtime", None)
+    return out
+
+
+class ProfileSession:
+    """One training run's programmatic profiler window.
+
+    Lifecycle (driven by the shared host driver):
+
+    * construction — decides the window; nothing starts yet;
+    * ``annotation(name)`` — the PhaseTimer hook: returns a
+      ``TraceAnnotation(name)`` context manager (a no-op outside an
+      active trace; the names are recorded either way so the sidecar
+      knows the annotation vocabulary);
+    * ``note_poll()`` — called once per host poll; starts the device
+      trace when the skip window ends, stops it when the capture
+      window is full;
+    * ``close()`` — stops a still-open trace (run ended early) and
+      writes the ``profile_summary.json`` sidecar.
+    """
+
+    def __init__(self, log_dir: str, *, skip_polls: Optional[int] = None,
+                 capture_polls: Optional[int] = None,
+                 solver: str = "unknown"):
+        self.log_dir = log_dir
+        self.skip_polls = max(_env_int("DPSVM_PROFILE_SKIP_POLLS", 0)
+                              if skip_polls is None else int(skip_polls),
+                              0)
+        self.capture_polls = max(_env_int("DPSVM_PROFILE_POLLS", 4)
+                                 if capture_polls is None
+                                 else int(capture_polls), 1)
+        self.solver = solver
+        self._timer = None
+        self._polls = 0
+        self._active = False
+        self._closed = False
+        self._started_at_poll: Optional[int] = None
+        self._stopped_at_poll: Optional[int] = None
+        self._t_start: Optional[float] = None
+        self._window_seconds = 0.0
+        self._phases_seen: List[str] = []
+        self._snap_seconds: Dict[str, float] = {}
+        self._snap_counts: Dict[str, int] = {}
+        self._win_seconds: Dict[str, float] = {}
+        self._win_counts: Dict[str, int] = {}
+        self._error: Optional[str] = None
+        os.makedirs(log_dir, exist_ok=True)
+
+    # -- PhaseTimer hook ----------------------------------------------
+
+    def attach_timer(self, timer) -> None:
+        """The PhaseTimer whose buckets bound the captured window (the
+        driver's); snapshotted at trace start/stop so the sidecar
+        reports window-local phase time, not whole-run time."""
+        self._timer = timer
+
+    def annotation(self, name: str):
+        if name not in self._phases_seen:
+            self._phases_seen.append(name)
+        try:
+            import jax
+            return jax.profiler.TraceAnnotation(str(name))
+        except Exception:
+            return contextlib.nullcontext()
+
+    # -- window management --------------------------------------------
+
+    def _snapshot_timer(self) -> None:
+        if self._timer is not None:
+            self._snap_seconds = dict(self._timer.seconds)
+            self._snap_counts = dict(self._timer.counts)
+
+    def _window_delta(self) -> None:
+        if self._timer is None:
+            return
+        self._win_seconds = {
+            k: round(v - self._snap_seconds.get(k, 0.0), 6)
+            for k, v in self._timer.seconds.items()}
+        self._win_counts = {
+            k: v - self._snap_counts.get(k, 0)
+            for k, v in self._timer.counts.items()}
+
+    def _start(self) -> None:
+        self._snapshot_timer()
+        self._t_start = time.perf_counter()
+        self._started_at_poll = self._polls
+        try:
+            import jax
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        except Exception as e:     # profiler unavailable: sidecar only
+            self._error = f"start_trace failed: {e}"
+
+    def _stop(self) -> None:
+        self._stopped_at_poll = self._polls
+        if self._t_start is not None:
+            self._window_seconds = round(
+                time.perf_counter() - self._t_start, 6)
+        self._window_delta()
+        if self._active:
+            self._active = False
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:
+                self._error = f"stop_trace failed: {e}"
+
+    def note_poll(self) -> None:
+        """One host poll boundary passed. With an annotation so the
+        poll cadence is visible on the device timeline too."""
+        if self._closed or self._stopped_at_poll is not None:
+            return
+        if (self._started_at_poll is None
+                and self._polls >= self.skip_polls):
+            self._start()
+        elif (self._started_at_poll is not None
+              and self._polls - self._started_at_poll
+              >= self.capture_polls):
+            self._stop()
+        self._polls += 1
+
+    def close(self, extra: Optional[dict] = None) -> Optional[str]:
+        """Stop a still-open window, write the sidecar, return its
+        path. Idempotent; never raises (profiling must not take the
+        run down)."""
+        if self._closed:
+            return None
+        self._closed = True
+        try:
+            if (self._started_at_poll is not None
+                    and self._stopped_at_poll is None):
+                self._stop()
+            summary = {
+                "schema": SUMMARY_SCHEMA,
+                "solver": self.solver,
+                "skip_polls": self.skip_polls,
+                "capture_polls": self.capture_polls,
+                "polls_seen": self._polls,
+                "window": {
+                    "started_at_poll": self._started_at_poll,
+                    "stopped_at_poll": self._stopped_at_poll,
+                    "seconds": self._window_seconds,
+                },
+                "phases": {
+                    name: {"seconds": self._win_seconds.get(name, 0.0),
+                           "calls": self._win_counts.get(name, 0)}
+                    for name in sorted(set(self._phases_seen)
+                                       | set(self._win_seconds))},
+                "annotations": list(self._phases_seen),
+                "artifacts": find_artifacts(self.log_dir),
+                "error": self._error,
+                "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            }
+            if extra:
+                summary.update(extra)
+            path = os.path.join(self.log_dir, SUMMARY_FILE)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(summary, fh, indent=1)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+
+# ---------------------------------------------------------------------
+# `dpsvm profile summarize`
+# ---------------------------------------------------------------------
+
+def load_summary(profile_dir: str) -> dict:
+    """Read the reconciliation sidecar; FileNotFoundError names the
+    expected file if the dir holds only raw device artifacts."""
+    path = os.path.join(profile_dir, SUMMARY_FILE)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"{path} (was this profile captured by `train "
+            "--profile-dir`? raw jax.profiler dirs carry no summary)")
+    with open(path) as fh:
+        summary = json.load(fh)
+    # re-walk: artifacts may have landed after close() on some backends
+    summary["artifacts"] = find_artifacts(profile_dir)
+    return summary
+
+
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return (f"{v:,.1f} {unit}" if unit != "B"
+                    else f"{v:,.0f} B")
+        v /= 1024
+    return f"{v:,.1f} GiB"
+
+
+def render_summary(summary: dict,
+                   trace_phase_counts: Optional[Dict[str, int]] = None
+                   ) -> str:
+    """The one reconciliation table: per phase, the host wall seconds
+    and call count inside the captured window, next to the run
+    trace's own ``phase_counts`` when a trace is supplied — the
+    host-level accounting and the device timeline share the phase
+    vocabulary by construction (the annotations ARE the phase names),
+    so this is where they line up."""
+    w = summary.get("window") or {}
+    out = [f"profile: {summary.get('solver', 'unknown')}  "
+           f"window {w.get('seconds', 0.0):.3f} s  "
+           f"(polls {w.get('started_at_poll')}.."
+           f"{w.get('stopped_at_poll')} of {summary.get('polls_seen')}"
+           f", skipped {summary.get('skip_polls')} warmup)"]
+    arts = summary.get("artifacts") or []
+    if arts:
+        total = sum(a.get("bytes", 0) for a in arts)
+        out.append(f"device artifacts: {len(arts)} file(s), "
+                   f"{_fmt_bytes(total)} "
+                   f"(newest: {arts[0]['path']})")
+    else:
+        note = summary.get("error") or "window never opened"
+        out.append(f"device artifacts: none ({note})")
+    phases = summary.get("phases") or {}
+    total_s = sum(p.get("seconds", 0.0) for p in phases.values()) or 0.0
+    out.append("")
+    header = (f"  {'phase':<12} {'host_s':>9} {'share':>7} "
+              f"{'calls':>7}")
+    if trace_phase_counts is not None:
+        header += f" {'trace_calls':>12} {'match':>6}"
+    out.append(header)
+    names = sorted(phases,
+                   key=lambda k: -phases[k].get("seconds", 0.0))
+    for name in names:
+        p = phases[name]
+        sec = p.get("seconds", 0.0)
+        share = f"{sec / total_s:6.1%}" if total_s > 0 else "   n/a"
+        line = (f"  {name:<12} {sec:9.4f} {share:>7} "
+                f"{p.get('calls', 0):>7,}")
+        if trace_phase_counts is not None:
+            tc = trace_phase_counts.get(name)
+            line += (f" {tc if tc is not None else 'n/a':>12} "
+                     f"{'yes' if tc is not None else 'NO':>6}")
+        out.append(line)
+    if trace_phase_counts is not None:
+        missing = sorted(set(trace_phase_counts) - set(phases))
+        if missing:
+            out.append(f"  (trace phases with no annotation: "
+                       f"{missing})")
+        else:
+            out.append("  (every trace phase has a matching "
+                       "annotation)")
+    ann = summary.get("annotations") or []
+    out.append("")
+    out.append("annotation spans on the device timeline: "
+               + (", ".join(ann) if ann else "(none)"))
+    return "\n".join(out)
+
+
+def summarize_profile(profile_dir: str,
+                      trace_path: Optional[str] = None) -> dict:
+    """Machine-readable reconciliation: the sidecar summary, plus the
+    run trace's ``phase_counts`` and the annotation/phase match
+    verdict when a trace is given."""
+    summary = load_summary(profile_dir)
+    result = dict(summary, profile_dir=profile_dir)
+    if trace_path:
+        from dpsvm_tpu.observability.report import (load_trace,
+                                                    resolve_trace_path,
+                                                    trace_facts)
+        facts = trace_facts(load_trace(resolve_trace_path(trace_path)))
+        counts = facts.get("phase_counts") or {}
+        result["trace_phase_counts"] = counts
+        result["phases_match"] = (
+            set(counts) <= set(summary.get("phases") or {}))
+    return result

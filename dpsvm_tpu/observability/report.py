@@ -234,7 +234,11 @@ def render_report(records: List[dict], width: int = 60) -> str:
         out.append("result: (no summary record — run still in flight "
                    "or killed)")
     # Device/compiler layer (schema v2; silent on v1 traces, which
-    # carry none of these facts).
+    # carry none of these facts). A v2 trace whose backend reports no
+    # allocator stats / cost model (CPU) renders an explicit `n/a` —
+    # never the Python literal `None`, and never a silently absent
+    # line a reader could mistake for a v1 trace.
+    v2 = (m.get("schema") or 1) >= 2
     if facts.get("n_compiles"):
         comp_s = facts.get("compile_seconds") or 0.0
         denom = facts.get("train_seconds") or 0.0
@@ -249,11 +253,23 @@ def render_report(records: List[dict], width: int = 60) -> str:
         head = (f"  ({facts['hbm_peak'] / limit:.0%} of "
                 f"{_fmt_bytes(limit)} limit)" if limit else "")
         out.append(f"hbm peak: {_fmt_bytes(facts['hbm_peak'])}{head}")
-    if facts.get("est_flops_per_sec") is not None:
+    elif v2:
+        out.append("hbm peak: n/a (no allocator stats on this backend)")
+    if facts.get("est_flops") is None:
+        if v2:
+            out.append("throughput: n/a (no cost-model FLOP estimate "
+                       "recorded)")
+    elif facts.get("est_flops_per_sec") is not None:
         out.append(f"throughput: ~{_fmt_flops(facts['est_flops_per_sec'])}"
                    f"/s achieved (cost-model: "
                    f"{_fmt_flops(facts['est_flops'])}/iter x "
                    f"{facts['iters']:,} iters)")
+    else:
+        # est_flops recorded but no measurable window (0 iters or 0 s):
+        # keep the cost model, suppress the achieved-FLOP/s claim.
+        out.append(f"throughput: n/a (cost-model: "
+                   f"{_fmt_flops(facts['est_flops'])}/iter; no "
+                   "measured window to divide by)")
     out.append("")
     out.append("convergence (gap vs iteration, log scale):")
     out.extend(_gap_curve(chunks, width=width))

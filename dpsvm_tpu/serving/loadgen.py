@@ -216,12 +216,18 @@ def run_saturate(url: str, rows: np.ndarray, *,
                  max_steps: int = 8, step_requests: int = 100,
                  batch: int = 1, concurrency: int = 16,
                  want: Sequence[str] = ("labels",),
-                 timeout: float = 30.0) -> dict:
+                 timeout: float = 30.0,
+                 trace: Optional[str] = None) -> dict:
     """Drive-to-saturation: step open-loop RPS by ``rps_factor`` until
     p99 exceeds the target (or errors appear), and report ONE SLO row —
     the max sustained throughput at p99 < target, with availability.
     The open loop is the honest probe here: a closed loop slows its own
-    arrivals under overload and never finds the knee."""
+    arrivals under overload and never finds the knee.
+
+    ``trace`` is the provenance pointer the row carries (the serving
+    process's ``--trace-out`` artifact or an archived copy) — the same
+    field burst-runner rows carry, so an SLO row is ledger- and
+    ``compare``-traceable like a training row."""
     steps = []
     best = None
     rps = float(start_rps)
@@ -246,6 +252,7 @@ def run_saturate(url: str, rows: np.ndarray, *,
         "unit": "req/s",
         "p99_target_ms": float(p99_target_ms),
         "steps": steps,
+        "trace": trace,
     }
     if best is None:
         row.update(value=0.0, slo_met=False, availability_pct=None)
@@ -262,7 +269,8 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                 concurrency: int = 8, mode: str = "closed",
                 rps: float = 100.0, want: Sequence[str] = ("labels",),
                 timeout: float = 30.0, chaos: bool = False,
-                compare_sequential: bool = True) -> dict:
+                compare_sequential: bool = True,
+                trace: Optional[str] = None) -> dict:
     """The one-line result row ``dpsvm loadgen`` prints: the main
     measurement, plus (by default) the batch-1 single-worker sequential
     baseline and the coalescing speedup over it.
@@ -281,6 +289,9 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
         "metric": "serving_examples_per_sec",
         "value": main["examples_per_sec"],
         "unit": "ex/s",
+        # provenance pointer (burst-runner row parity): the serving
+        # trace this measurement ran against, when one was archived
+        "trace": trace,
         **main,
     }
     if chaos:

@@ -52,6 +52,7 @@ from typing import Callable, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from dpsvm_tpu.observability.metrics import MetricsRegistry
 from dpsvm_tpu.resilience import faultinject
 from dpsvm_tpu.resilience.health import ReplicaMonitor
 from dpsvm_tpu.serving.budget import DeadlineExceededError, hedge_delay_s
@@ -143,6 +144,21 @@ class _Replica:
         return out
 
 
+#: pool robustness counters: one registry counter family each, labeled
+#: by model — the hand-rolled dict these replaced lives on only as the
+#: keys of `metrics()` (docs/OBSERVABILITY.md "Metrics")
+_POOL_COUNTER_HELP = {
+    "dispatches": "batches dispatched to a replica",
+    "ejections": "replicas ejected by the circuit breaker",
+    "rebuilds": "successful background replica rebuilds",
+    "rebuild_failures": "failed replica rebuild attempts",
+    "hedges_fired": "hedged re-dispatches fired",
+    "hedges_won": "hedged re-dispatches that answered first",
+    "redispatches": "dispatches retried on another replica",
+    "timeouts": "dispatches failed on a blown deadline",
+}
+
+
 class ReplicaPool:
     """N replicas behind one dispatch interface (module docstring).
 
@@ -153,6 +169,12 @@ class ReplicaPool:
 
     ``hedge``: ``"off"`` (default), ``"auto"`` (p99-based delay from
     the pool's rolling latency window), or a float delay in seconds.
+
+    ``metrics``: the ``observability.metrics.MetricsRegistry`` the
+    pool's robustness counters live in (labeled ``model=<name>``) —
+    the ServingServer passes its own so `/metricsz?format=prometheus`
+    exposes them; a standalone pool gets a private registry and
+    behaves exactly as before.
     """
 
     def __init__(self, build_fn: Callable[[int], object],
@@ -161,7 +183,8 @@ class ReplicaPool:
                  rebuild: bool = True, rebuild_backoff_s: float = 0.05,
                  reap_interval_s: float = 0.005,
                  watch_compiles: bool = False,
-                 on_event: Optional[Callable[..., None]] = None):
+                 on_event: Optional[Callable[..., None]] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.name = str(name)
@@ -180,10 +203,17 @@ class ReplicaPool:
         self._lat_ms: deque = deque(maxlen=512)
         self._building = 0
         self._stray = 0
-        self._counters = {"dispatches": 0, "ejections": 0, "rebuilds": 0,
-                          "rebuild_failures": 0, "hedges_fired": 0,
-                          "hedges_won": 0, "redispatches": 0,
-                          "timeouts": 0}
+        # Robustness counters migrated onto the unified metric
+        # registry (observability/metrics.py): one counter family per
+        # key, this pool's series labeled by model name. `metrics()`
+        # reads the same series back, so the JSON view and the
+        # Prometheus exposition can never disagree.
+        self._mreg = metrics if metrics is not None else MetricsRegistry()
+        self._counters = {
+            key: self._mreg.counter(f"dpsvm_pool_{key}_total", help_,
+                                    labels=("model",))
+            .labels(model=self.name)
+            for key, help_ in _POOL_COUNTER_HELP.items()}
         self._stop = threading.Event()
         self._replicas: List[_Replica] = []
         for i in range(int(n_replicas)):
@@ -299,7 +329,7 @@ class ReplicaPool:
                 f"(all {len(self._replicas)} circuits open; rebuilding)")
         d.primary_idx = r.idx
         with self._lock:
-            self._counters["dispatches"] += 1
+            self._counters["dispatches"].inc()
             self._inflight.add(d)
         r.enqueue(d)
         try:
@@ -331,7 +361,7 @@ class ReplicaPool:
                 "redispatch"))
             return
         with self._lock:
-            self._counters["redispatches"] += 1
+            self._counters["redispatches"].inc()
         r.enqueue(d)
 
     # -- worker -------------------------------------------------------
@@ -409,7 +439,7 @@ class ReplicaPool:
         won = d.complete(result=res, winner=replica.idx)
         if won and d.hedge_fired and replica.idx != d.primary_idx:
             with self._lock:
-                self._counters["hedges_won"] += 1
+                self._counters["hedges_won"].inc()
         if replica.state == HALF_OPEN:
             # a finite, timely compute is the probe's verdict whether
             # or not it won the publish race: close the circuit
@@ -434,7 +464,7 @@ class ReplicaPool:
                 return
             replica.retired = True
             replica.state = OPEN
-            self._counters["ejections"] += 1
+            self._counters["ejections"].inc()
         self._emit("eject", replica=replica.idx,
                    generation=replica.generation, reason=reason)
         for d in replica.drain_queue():
@@ -456,7 +486,7 @@ class ReplicaPool:
                     engine = self.build_fn(idx)
             except Exception as e:     # noqa: BLE001 — retried/reported
                 with self._lock:
-                    self._counters["rebuild_failures"] += 1
+                    self._counters["rebuild_failures"].inc()
                 self._emit("rebuild", replica=idx, ok=False,
                            attempt=attempt, error=str(e))
                 if attempt >= REBUILD_MAX_ATTEMPTS:
@@ -469,7 +499,7 @@ class ReplicaPool:
                               state=HALF_OPEN)
             with self._lock:
                 self._replicas[idx] = new
-                self._counters["rebuilds"] += 1
+                self._counters["rebuilds"].inc()
             self._emit("rebuild", replica=idx, ok=True,
                        generation=new.generation, attempt=attempt)
             return
@@ -502,7 +532,7 @@ class ReplicaPool:
         if not completed:
             return
         with self._lock:
-            self._counters["timeouts"] += 1
+            self._counters["timeouts"].inc()
         for r in computing:
             r.monitor.note_timeout()
             self._eject(r, "wedge (deadline blown while computing)")
@@ -534,7 +564,7 @@ class ReplicaPool:
                     r2 = self._choose(exclude=busy | {d.primary_idx})
                     if r2 is not None:
                         with self._lock:
-                            self._counters["hedges_fired"] += 1
+                            self._counters["hedges_fired"].inc()
                         self._emit("hedge", primary=d.primary_idx,
                                    hedge=r2.idx)
                         r2.enqueue(d)
@@ -573,9 +603,10 @@ class ReplicaPool:
 
     def metrics(self) -> dict:
         with self._lock:
-            counters = dict(self._counters)
             reps = list(self._replicas)
-        out = dict(counters)
+        # the registry series ARE the counters now; the JSON view reads
+        # them back so the two surfaces cannot drift
+        out = {k: int(c.value) for k, c in self._counters.items()}
         out["n_replicas"] = len(reps)
         out["n_healthy"] = sum(1 for r in reps
                                if not r.retired and r.state == CLOSED)

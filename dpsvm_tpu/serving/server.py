@@ -62,6 +62,10 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dpsvm_tpu.observability.metrics import (DEFAULT_LATENCY_BUCKETS_MS,
+                                             PROMETHEUS_CONTENT_TYPE,
+                                             MetricsRegistry,
+                                             wants_prometheus)
 from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
                                        MicroBatcher, QueueFullError)
 from dpsvm_tpu.serving.budget import (TIER_NONE, TIER_SHED_PROBA,
@@ -122,6 +126,18 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass                             # client went away; fine
 
+    def _send_text(self, code: int, text: str,
+                   content_type: str = "text/plain") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     def _body(self) -> Optional[dict]:
         n = int(self.headers.get("Content-Length") or 0)
         if n > MAX_BODY_BYTES:
@@ -150,8 +166,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, {"status": "ok",
                                  "models": owner.registry.names(),
                                  "uptime_s": round(owner.uptime, 3)})
-        elif self.path == "/metricsz":
-            self._send(200, owner.metrics())
+        elif self.path.startswith("/metricsz"):
+            # ?format=prometheus = the text exposition of the unified
+            # metric registry (observability/metrics.py) — what a
+            # scraper consumes; the bare endpoint keeps the JSON blob.
+            if wants_prometheus(self.path):
+                self._send_text(200, owner.metrics_text(),
+                                PROMETHEUS_CONTENT_TYPE)
+            else:
+                self._send(200, owner.metrics())
         elif self.path == "/v1/models":
             self._send(200, {"models": owner.registry.manifests()})
         else:
@@ -329,6 +352,7 @@ class ServingServer:
                  siblings: Optional[Dict[str, str]] = None,
                  score_window: int = 4096,
                  trace_out: Optional[str] = None,
+                 metrics_registry: Optional[MetricsRegistry] = None,
                  verbose: bool = False):
         self.registry = registry
         self.host = host
@@ -351,9 +375,47 @@ class ServingServer:
         self._pool_create_lock = threading.Lock()
         self._lat_ms: deque = deque(maxlen=8192)
         self._scores: deque = deque(maxlen=int(score_window))
-        self._counters = {"requests": 0, "errors": 0, "rejected": 0,
-                          "deadline_504": 0, "shed_proba": 0,
-                          "shed_sibling": 0}
+        # The hand-rolled request counters now live in the unified
+        # metric registry (observability/metrics.py): the JSON
+        # /metricsz keys read the same series the Prometheus
+        # exposition renders, so the two surfaces cannot drift. The
+        # CLI passes the process-wide default_registry() (one surface
+        # per process — training and serving alike); library/test
+        # instances default to a private registry so per-instance
+        # counter assertions stay exact.
+        self.mreg = (metrics_registry if metrics_registry is not None
+                     else MetricsRegistry())
+        self._counters = {
+            key: self.mreg.counter(f"dpsvm_serving_{key}_total", help_)
+            .labels()
+            for key, help_ in (
+                ("requests", "requests answered 200"),
+                ("errors", "client/server errors (4xx/5xx except "
+                           "429/504)"),
+                ("rejected", "fast-rejected on a full queue (429)"),
+                ("deadline_504", "deadline budget blown (504)"),
+                ("shed_proba", "tier-1 shed: proba dropped to "
+                               "decision"),
+                ("shed_sibling", "tier-2 shed: served by the sibling "
+                                 "model"))}
+        self._h_latency = self.mreg.histogram(
+            "dpsvm_serving_request_latency_ms",
+            "request wall latency (admission to response)",
+            buckets=DEFAULT_LATENCY_BUCKETS_MS).labels()
+        self._g_queue = self.mreg.gauge(
+            "dpsvm_serving_queue_depth",
+            "micro-batcher queue depth in rows", labels=("model",))
+        self._g_healthy = self.mreg.gauge(
+            "dpsvm_serving_replicas_healthy",
+            "replicas with a closed circuit", labels=("model",))
+        self._g_uptime = self.mreg.gauge("dpsvm_serving_uptime_seconds",
+                                         "seconds since server start")
+        self._g_draining = self.mreg.gauge("dpsvm_serving_draining",
+                                           "1 while draining")
+        self._g_expired = self.mreg.gauge(
+            "dpsvm_serving_expired_tickets",
+            "tickets dropped at batch formation (deadline passed)")
+        self.mreg.add_collector(self._collect_gauges)
         self._events: deque = deque(maxlen=512)
         self._trace = None
         self._trace_out = trace_out
@@ -370,12 +432,34 @@ class ServingServer:
         return time.monotonic() - self._t0
 
     def count(self, key: str) -> None:
-        with self._lock:
-            self._counters[key] += 1
+        self._counters[key].inc()
 
     def observe_latency(self, ms: float) -> None:
+        self._h_latency.observe(ms)      # the Prometheus histogram
         with self._lock:
-            self._lat_ms.append(ms)
+            self._lat_ms.append(ms)      # exact percentiles for JSON
+
+    def _collect_gauges(self) -> None:
+        """Pre-scrape hook (mreg collector): gauges derived from live
+        state, refreshed at render/snapshot time."""
+        self._g_uptime.set(self.uptime)
+        self._g_draining.set(1 if self.draining else 0)
+        with self._lock:
+            batchers = dict(self._batchers)
+            pools = dict(self._pools)
+        expired = 0
+        for name, b in batchers.items():
+            self._g_queue.labels(model=name).set(b.queue_depth)
+            expired += b.stats().get("expired", 0)
+        self._g_expired.set(expired)
+        for name, p in pools.items():
+            self._g_healthy.labels(model=name).set(p.n_healthy)
+
+    def metrics_text(self) -> str:
+        """`/metricsz?format=prometheus`: the registry's text
+        exposition (collectors run first, so derived gauges are
+        fresh)."""
+        return self.mreg.render_prometheus()
 
     def observe_scores(self, decision) -> None:
         """Feed decision values into the rolling score-distribution
@@ -463,8 +547,8 @@ class ServingServer:
                 pass                   # tracing must not kill serving
 
     def metrics(self) -> dict:
+        counters = {k: int(c.value) for k, c in self._counters.items()}
         with self._lock:
-            counters = dict(self._counters)
             lat = np.asarray(self._lat_ms, np.float64)
             scores = np.asarray(self._scores, np.float64)
             batchers = dict(self._batchers)
@@ -554,7 +638,8 @@ class ServingServer:
             p = ReplicaPool(build, self.replicas, name=name,
                             deadline_s=self.predict_timeout,
                             hedge=self.hedge, watch_compiles=True,
-                            on_event=self.emit_event)
+                            on_event=self.emit_event,
+                            metrics=self.mreg)
             with self._lock:
                 self._pools[name] = p
             return p
@@ -632,7 +717,7 @@ class ServingServer:
             self._thread.join(timeout)
         with self._lock:
             tr, self._trace = self._trace, None
-            counters = dict(self._counters)
+        counters = {k: int(c.value) for k, c in self._counters.items()}
         if tr is not None:
             from dpsvm_tpu.observability.record import close_serving_trace
             close_serving_trace(tr, requests=counters["requests"],

@@ -50,6 +50,8 @@ import numpy as np
 
 from dpsvm_tpu.config import SVMConfig, TrainResult
 from dpsvm_tpu.observability import compilewatch
+from dpsvm_tpu.observability import metrics as metricslib
+from dpsvm_tpu.observability import profiler as profilerlib
 from dpsvm_tpu.observability.device import memory_snapshot
 from dpsvm_tpu.resilience import elastic, faultinject, preempt
 from dpsvm_tpu.resilience.health import (DesyncError, DivergenceError,
@@ -265,11 +267,12 @@ def begin_trace(config: SVMConfig, n: int, d: int, gamma: float,
     return trace
 
 
-def drain_compiles(trace, n_iter: int = 0) -> None:
+def drain_compiles(trace, n_iter: int = 0, metrics=None) -> None:
     """Flush pending compile observations (observability/compilewatch)
-    into ``trace`` as ``compile`` records. Draining with tracing off
-    discards them, so one run's compiles can never leak into the next
-    run's trace. Called at poll boundaries by every trace producer
+    into ``trace`` as ``compile`` records and, when given, the metric
+    registry feeder (``metrics.TrainingMetrics``). Draining with both
+    off discards them, so one run's compiles can never leak into the
+    next run's trace. Called at poll boundaries by every trace producer
     (this driver, the shrinking manager, the bench harnesses)."""
     for rec in compilewatch.drain():
         if trace is not None:
@@ -277,6 +280,8 @@ def drain_compiles(trace, n_iter: int = 0) -> None:
                           seconds=rec["seconds"],
                           signature=rec.get("signature"),
                           flops=rec.get("flops"), n_iter=n_iter)
+        if metrics is not None:
+            metrics.on_compile(rec)
 
 
 def host_training_loop(
@@ -363,14 +368,42 @@ def host_training_loop(
                   else None)
     elastic.register_heartbeats(heartbeats)
     faults = faultinject.current()
+    # Auto-windowed jax.profiler capture (observability/profiler.py):
+    # the session starts/stops the device trace at poll boundaries and
+    # its annotation hook wraps every PhaseTimer phase in a
+    # TraceAnnotation span of the same name, so the XLA timeline and
+    # the trace's phase_counts share one vocabulary.
+    session = (profilerlib.ProfileSession(
+        config.profile_dir,
+        solver=SOLVER_NAMES.get(type(carry).__name__,
+                                type(carry).__name__))
+        if config.profile_dir else None)
     # Host-loop accounting, not device time: "dispatch" buckets the
     # (async) enqueue calls, "poll" the blocking stats reads — device
     # execution overlaps both in pipelined mode. The buckets ride every
     # chunk record and the trace summary.
-    timer = PhaseTimer()
-
-    profile = (jax.profiler.trace(config.profile_dir)
-               if config.profile_dir else contextlib.nullcontext())
+    timer = PhaseTimer(annotate=session.annotation
+                       if session is not None else None)
+    if session is not None:
+        session.attach_timer(timer)
+    # Live metrics surface (observability/metrics.py): the process
+    # registry is fed from the SAME packed-stats reads the trace rides
+    # — host dict arithmetic only, zero extra D2H transfers (pinned in
+    # tests/test_metrics.py). Exporters are opt-in: the read-only HTTP
+    # sidecar (--metrics-port) and the per-poll text snapshot file
+    # (--metrics-out); both torn down in the finally block.
+    train_metrics = metricslib.TrainingMetrics(
+        solver=SOLVER_NAMES.get(type(carry).__name__,
+                                type(carry).__name__), n=n, d=d)
+    exporting = (config.metrics_port is not None
+                 or bool(config.metrics_out))
+    sidecar = None
+    if config.metrics_port is not None:
+        sidecar = metricslib.MetricsServer(train_metrics.registry,
+                                           port=config.metrics_port)
+        print(f"metrics: http://127.0.0.1:{sidecar.port}/metricsz"
+              "?format=prometheus (read-only, down at run end)",
+              file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
     prev_polled = it0
@@ -393,7 +426,7 @@ def host_training_loop(
                                     # CRCs (utils/checkpoint.py)
 
     try:
-        with profile, _debug_nans(config.debug_nans), preempt.trap():
+        with _debug_nans(config.debug_nans), preempt.trap():
             limit = min(it0 + chunk, config.max_iter)
             with timer.phase("dispatch"):
                 carry, stats = step_chunk(carry, limit)
@@ -433,8 +466,11 @@ def host_training_loop(
                 # trace records before the chunk they delayed, and the
                 # allocator watermark is a dictionary read — still
                 # ZERO extra device->host transfers.
-                drain_compiles(trace, n_iter)
-                hbm = memory_snapshot() if trace is not None else None
+                drain_compiles(trace, n_iter, metrics=train_metrics)
+                hbm = (memory_snapshot()
+                       if trace is not None or exporting else None)
+                if session is not None:
+                    session.note_poll()
                 # Finite-aware: every NaN comparison is False, so a
                 # plain `not (b_lo > ...)` would declare a NaN gap
                 # CONVERGED and return garbage marked success. A
@@ -507,6 +543,17 @@ def host_training_loop(
                                 hbm=hbm,
                                 **({"shard_ages": shard_ages}
                                    if shard_ages is not None else {}))
+                # Same values, second consumer: the live metric
+                # registry (every argument is already host-side).
+                train_metrics.on_poll(
+                    n_iter=n_iter, b_lo=b_lo, b_hi=b_hi, n_sv=st.n_sv,
+                    cache_hits=st.cache_hits,
+                    cache_misses=st.cache_misses,
+                    phases=timer.seconds, phase_counts=timer.counts,
+                    hbm=hbm, shard_ages=shard_ages)
+                if config.metrics_out:
+                    metricslib.write_snapshot(train_metrics.registry,
+                                              config.metrics_out)
 
                 # Divergence guards — BEFORE maybe_checkpoint, so a sick
                 # state is never saved over a good rotation slot. The
@@ -650,8 +697,10 @@ def host_training_loop(
             coef0=float(config.coef0),
             degree=int(config.degree),
         )
+        train_metrics.on_done(converged=result.converged,
+                              n_iter=result.n_iter)
         if trace is not None:
-            drain_compiles(trace, result.n_iter)
+            drain_compiles(trace, result.n_iter, metrics=train_metrics)
             trace.summary(converged=result.converged,
                           n_iter=result.n_iter, b=result.b,
                           b_lo=result.b_lo, b_hi=result.b_hi,
@@ -668,6 +717,16 @@ def host_training_loop(
         # must not leak into the next run's trace.
         elastic.register_heartbeats(None)
         drain_compiles(trace if trace is not None and not trace.closed
-                       else None)
+                       else None, metrics=train_metrics)
         if trace is not None:
             trace.close()
+        # Exporter teardown: final snapshot for the scrape-less file,
+        # sidecar listener down, profiler window closed + sidecar
+        # summary written — none of these may raise over a dying run.
+        if config.metrics_out:
+            metricslib.write_snapshot(train_metrics.registry,
+                                      config.metrics_out)
+        if sidecar is not None:
+            sidecar.close()
+        if session is not None:
+            session.close()

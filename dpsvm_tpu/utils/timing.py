@@ -20,9 +20,17 @@ import jax
 
 
 class PhaseTimer:
-    def __init__(self) -> None:
+    def __init__(self, annotate: Optional[Callable[[str], object]] = None
+                 ) -> None:
+        """annotate: optional hook returning a context manager for a
+        phase name — the profiler integration point
+        (observability/profiler.ProfileSession.annotation wraps each
+        phase in a jax.profiler.TraceAnnotation span of the SAME name,
+        so the device timeline and the host buckets share one
+        vocabulary). None = timing only."""
         self.seconds: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self._annotate = annotate
 
     @contextlib.contextmanager
     def phase(self, name: str, fence: Optional[Callable[[], object]] = None):
@@ -30,12 +38,19 @@ class PhaseTimer:
         block_until_ready'd so the bucket measures completed device work,
         not dispatch. (A callable, because the arrays to fence on are
         usually created inside the block.)"""
+        ann = (self._annotate(name) if self._annotate is not None
+               else contextlib.nullcontext())
         t0 = time.perf_counter()
         try:
-            yield
+            with ann:
+                try:
+                    yield
+                finally:
+                    # fence inside the annotation span: the blocked
+                    # device wait is attributed to the phase it ends
+                    if fence is not None:
+                        jax.block_until_ready(fence())
         finally:
-            if fence is not None:
-                jax.block_until_ready(fence())
             self.seconds[name] += time.perf_counter() - t0
             self.counts[name] += 1
 

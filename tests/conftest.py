@@ -13,6 +13,13 @@ import os
 # env var is already baked in — override through jax.config instead (before
 # any backend is initialized).
 os.environ["JAX_PLATFORMS"] = os.environ.get("DPSVM_TEST_PLATFORM", "cpu")
+
+# The perf ledger (observability/ledger.py) defaults to an in-repo
+# path; tests must never append to the real measurement history, so
+# the suite runs with the ledger disabled (empty env = off). Tests of
+# the ledger itself monkeypatch.setenv a tmp path; the setting is
+# inherited by every subprocess the suite spawns (bench/burst/CLI).
+os.environ.setdefault("DPSVM_PERF_LEDGER", "")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

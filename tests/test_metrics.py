@@ -1,0 +1,580 @@
+"""Unified metrics registry, profiling hooks, perf ledger (ISSUE 8).
+
+What must hold, per piece:
+
+* registry   — counters/gauges/histograms with labels; thread-safe
+               under concurrent updates (exact totals); exposition
+               passes the line-by-line Prometheus grammar check and
+               the tamper cases fail it.
+* training   — a run with --metrics-out / --metrics-port exposes
+               valid Prometheus text fed from the SAME packed-stats
+               polls: the poll count is UNCHANGED vs an unmetered run
+               (the zero-extra-D2H acceptance pin).
+* serving    — /metricsz?format=prometheus serves the registry's
+               exposition while the JSON blob keeps its keys, both
+               reading the same series.
+* profiler   — --profile-dir produces a device trace + a
+               profile_summary.json whose phase annotations match the
+               run trace's phase_counts; `dpsvm profile summarize`
+               reconciles them (CPU smoke).
+* ledger     — append/read/gate round-trip: a planted accumulated
+               regression (pairwise steps each under threshold) FAILS
+               the historical gate while clean history passes; the
+               CLI (`dpsvm perf`) renders and gates it.
+* satellites — compare clamps gap marks to available polls; loadgen
+               rows carry the burst-style `trace` pointer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.observability.metrics import (MetricsRegistry,
+                                             default_registry,
+                                             validate_exposition)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _blobs(n=300, d=6, seed=0):
+    from dpsvm_tpu.data.synthetic import make_blobs
+    return make_blobs(n=n, d=d, seed=seed)
+
+
+# ---------------------------------------------------------------------
+# registry + exposition grammar
+# ---------------------------------------------------------------------
+
+def test_registry_exposition_validates_line_by_line():
+    reg = MetricsRegistry()
+    c = reg.counter("dpsvm_t_requests_total", "requests",
+                    labels=("model",))
+    c.labels(model="default").inc(5)
+    c.labels(model='esc"ape\nme\\now').inc()
+    reg.gauge("dpsvm_t_gap", "gap").set(1.5e-3)
+    h = reg.histogram("dpsvm_t_latency_ms", "latency",
+                      labels=("model",), buckets=(1.0, 10.0, 100.0))
+    for v in (0.2, 5.0, 50.0, 5000.0):
+        h.labels(model="default").observe(v)
+    text = reg.render_prometheus()
+    assert validate_exposition(text) == []
+    lines = text.splitlines()
+    # HELP/TYPE precede samples, families contiguous
+    assert lines[0].startswith("# HELP ")
+    assert lines[1].startswith("# TYPE ")
+    # label escaping survived the round trip
+    assert r'model="esc\"ape\nme\\now"' in text
+    # histogram series shape
+    assert 'dpsvm_t_latency_ms_bucket{model="default",le="+Inf"} 4' \
+        in text
+    assert 'dpsvm_t_latency_ms_count{model="default"} 4' in text
+    assert any(ln.startswith("dpsvm_t_latency_ms_sum")
+               for ln in lines)
+
+
+@pytest.mark.parametrize("tamper, why", [
+    (lambda t: t.replace('le="+Inf"} 4', 'le="+Inf"} 3'),
+     "+Inf bucket != _count"),
+    (lambda t: t.replace('le="10"} 2', 'le="10"} 0'),
+     "non-cumulative buckets"),
+    (lambda t: "\n".join(ln for ln in t.splitlines()
+                         if "_sum" not in ln) + "\n",
+     "missing _sum"),
+    (lambda t: t.replace("# TYPE dpsvm_t_latency_ms histogram",
+                         "# TYPE dpsvm_t_latency_ms flamingo"),
+     "unknown TYPE"),
+    (lambda t: t + "not a sample line at all }{\n",
+     "bad sample grammar"),
+    (lambda t: t + t.splitlines()[2] + "\n",
+     "duplicate series / reopened family"),
+])
+def test_exposition_validator_rejects_tampered_text(tamper, why):
+    reg = MetricsRegistry()
+    h = reg.histogram("dpsvm_t_latency_ms", "latency",
+                      buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    assert validate_exposition(text) == []
+    assert validate_exposition(tamper(text)), why
+
+
+def test_registry_kind_and_label_mismatch_raise():
+    reg = MetricsRegistry()
+    reg.counter("dpsvm_t_thing_total", "x", labels=("model",))
+    # get-or-create: same scheme returns the same family
+    again = reg.counter("dpsvm_t_thing_total", "x", labels=("model",))
+    again.labels(model="m").inc()
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("dpsvm_t_thing_total", "x", labels=("model",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("dpsvm_t_thing_total", "x", labels=("other",))
+    with pytest.raises(ValueError):
+        reg.counter("bad name!", "x")
+    with pytest.raises(ValueError):
+        reg.counter("dpsvm_t_c_total", "x", labels=("bad-label",))
+    with pytest.raises(ValueError, match="cannot decrease"):
+        again.labels(model="m").inc(-1)
+
+
+def test_registry_thread_safety_exact_totals():
+    """Concurrent serving-style updates: N threads hammer one counter
+    family, one gauge and one histogram; totals must be exact (the
+    acceptance's thread-safety bar, not a smoke test)."""
+    reg = MetricsRegistry()
+    c = reg.counter("dpsvm_t_hits_total", "hits", labels=("worker",))
+    h = reg.histogram("dpsvm_t_ms", "ms", buckets=(1.0, 10.0))
+    g = reg.gauge("dpsvm_t_depth", "depth")
+    N_THREADS, N_OPS = 8, 2000
+    barrier = threading.Barrier(N_THREADS)
+
+    def work(wid):
+        mine = c.labels(worker=str(wid))
+        barrier.wait()
+        for i in range(N_OPS):
+            mine.inc()
+            h.observe(float(i % 20))
+            g.set(i)
+
+    threads = [threading.Thread(target=work, args=(w,))
+               for w in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for w in range(N_THREADS):
+        assert c.labels(worker=str(w)).value == N_OPS
+    _buckets, _sum, count = h.labels().histogram_state()
+    assert count == N_THREADS * N_OPS
+    assert validate_exposition(reg.render_prometheus()) == []
+
+
+# ---------------------------------------------------------------------
+# training half: same polls, zero extra D2H, live exporters
+# ---------------------------------------------------------------------
+
+def test_training_metrics_add_zero_device_polls(tmp_path, monkeypatch):
+    """THE acceptance pin: the packed-stats poll count of a metered
+    run (metrics-out + registry feeding) equals the unmetered run's —
+    the registry rides the existing transfer, it never adds one."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.solver import driver
+
+    x, y = _blobs(n=400, d=6, seed=3)
+    calls = {"n": 0}
+    real = driver.read_stats
+
+    def counting(stats):
+        calls["n"] += 1
+        return real(stats)
+
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=30_000,
+                chunk_iters=64)
+    monkeypatch.setattr(driver, "read_stats", counting)
+    r1 = train(x, y, SVMConfig(**base))
+    plain = calls["n"]
+    calls["n"] = 0
+    out = str(tmp_path / "m.prom")
+    r2 = train(x, y, SVMConfig(metrics_out=out, **base))
+    metered = calls["n"]
+    assert r1.n_iter == r2.n_iter and r1.converged and r2.converged
+    assert metered == plain, \
+        f"metrics export changed the poll count ({plain} -> {metered})"
+    text = open(out).read()
+    assert validate_exposition(text) == []
+    assert "dpsvm_train_iterations" in text
+    assert "dpsvm_train_polls_total" in text
+
+
+def test_train_feeds_process_default_registry():
+    """The training driver feeds the PROCESS-wide registry (the one
+    `dpsvm serve` exposes): after a run, the shared surface carries
+    the run's facts and renders parser-valid text."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = _blobs(n=400, d=6, seed=4)
+    r = train(x, y, SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=30_000, chunk_iters=64))
+    assert r.converged
+    reg = default_registry()
+    text = reg.render_prometheus()
+    assert validate_exposition(text) == []
+    assert "dpsvm_train_iterations " in text.replace("\n", " ")
+    assert "dpsvm_train_run_info" in text
+    assert reg.get("dpsvm_train_converged").value == 1
+    assert reg.get("dpsvm_train_iterations").value == r.n_iter
+
+
+def test_train_metrics_port_http_scrape(tmp_path):
+    """Full HTTP path: a subprocess CLI train with --metrics-port and
+    a scraper thread that GETs /metricsz?format=prometheus while the
+    run is live. Parser-validated — the acceptance's training half."""
+    data = str(tmp_path / "train.csv")
+    x, y = _blobs(n=2000, d=8, seed=5)
+    with open(data, "w") as fh:
+        for yi, xi in zip(y, x):
+            fh.write(f"{int(yi)}," + ",".join(f"{v:.5f}" for v in xi)
+                     + "\n")
+    model = str(tmp_path / "m.svm")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DPSVM_PERF_LEDGER="")
+    # epsilon far below reachable: the run spends its full max_iter
+    # budget, leaving a wide window for the live scrape
+    p = subprocess.Popen(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "train", "-f", data,
+         "-m", model, "-c", "1.0", "-e", "1e-9", "-n", "60000",
+         "--metrics-port", "0", "-q"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        # the ready line carries the bound port
+        port = None
+        for _ in range(600):
+            line = p.stderr.readline()
+            if not line:
+                break
+            if line.startswith("metrics: http://127.0.0.1:"):
+                port = int(line.split("127.0.0.1:")[1].split("/")[0])
+                break
+        assert port, "sidecar ready line never appeared"
+        text = None
+        for _ in range(100):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metricsz"
+                        "?format=prometheus", timeout=5) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "text/plain")
+                    text = r.read().decode()
+                break
+            except OSError:
+                if p.poll() is not None:
+                    break
+        assert text is not None, "never scraped the live sidecar"
+        assert validate_exposition(text) == []
+        # JSON twin on the same handler
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metricsz", timeout=5) as r:
+            snap = json.loads(r.read())
+        assert "dpsvm_train_iterations" in snap
+    finally:
+        out, err = p.communicate(timeout=180)
+    assert p.returncode == 0, err
+    # torn down at exit: the port must be closed now
+    with pytest.raises(OSError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metricsz",
+                               timeout=2)
+
+
+# ---------------------------------------------------------------------
+# serving half: same registry, prometheus endpoint
+# ---------------------------------------------------------------------
+
+@pytest.fixture()
+def prom_server(tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import SVMModel
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    rng = np.random.default_rng(7)
+    model = SVMModel(
+        x_sv=rng.standard_normal((30, 5)).astype(np.float32),
+        alpha=rng.uniform(0.05, 2.0, 30).astype(np.float32),
+        y_sv=np.where(rng.random(30) < 0.5, -1, 1).astype(np.int32),
+        b=0.1, gamma=0.5, task="svc")
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    srv = ServingServer(reg, port=0, max_batch=8, max_delay_ms=1.0,
+                        max_queue=64).start()
+    yield srv
+    srv.drain(timeout=10.0)
+
+
+def test_serving_prometheus_endpoint_and_json_agree(prom_server):
+    srv = prom_server
+    q = np.random.default_rng(8).standard_normal((3, 5)).astype(
+        np.float32)
+    body = json.dumps({"instances": q.tolist()}).encode()
+    req = urllib.request.Request(
+        srv.url + "/v1/predict", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    for _ in range(3):
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status == 200
+            r.read()
+    with urllib.request.urlopen(srv.url + "/metricsz?format=prometheus",
+                                timeout=15) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert validate_exposition(text) == []
+    with urllib.request.urlopen(srv.url + "/metricsz", timeout=15) as r:
+        m = json.loads(r.read())
+    # the JSON keys read the same registry series the exposition
+    # renders — extract the exposition's counter value and compare
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("dpsvm_serving_requests_total"))
+    assert int(float(line.split()[-1])) == m["requests"] >= 3
+    # request latencies landed in the histogram
+    assert "dpsvm_serving_request_latency_ms_bucket" in text
+    # pool counters are in the same exposition, labeled by model
+    assert 'dpsvm_pool_dispatches_total{model="default"}' in text
+    # derived gauges collected at scrape time
+    assert "dpsvm_serving_replicas_healthy" in text
+    # JSON /metricsz kept its whole legacy shape
+    for key in ("requests", "errors", "rejected", "deadline_504",
+                "latency_ms", "models", "score_window", "expired"):
+        assert key in m, key
+
+
+# ---------------------------------------------------------------------
+# profiler: auto-window + reconciliation
+# ---------------------------------------------------------------------
+
+def test_profile_dir_reconciles_with_trace_phases(tmp_path):
+    """--profile-dir (CPU smoke): device artifact + sidecar whose
+    phase annotations cover the run trace's phase_counts; `dpsvm
+    profile summarize` renders the reconciliation table."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.cli import main as cli_main
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.observability import profiler
+    from dpsvm_tpu.telemetry import load_trace, trace_facts
+
+    x, y = _blobs(n=400, d=6, seed=6)
+    pdir = str(tmp_path / "prof")
+    tpath = str(tmp_path / "run.jsonl")
+    r = train(x, y, SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3,
+                              max_iter=30_000, chunk_iters=64,
+                              profile_dir=pdir, trace_out=tpath))
+    assert r.converged
+    summary = json.load(open(os.path.join(pdir, "profile_summary.json")))
+    assert summary["schema"] == 1
+    assert summary["window"]["started_at_poll"] is not None
+    facts = trace_facts(load_trace(tpath))
+    trace_phases = set(facts["phase_counts"])
+    assert trace_phases, "trace carries no phase_counts"
+    # the acceptance: annotations match the trace's phase vocabulary
+    assert trace_phases <= set(summary["annotations"]), (
+        trace_phases, summary["annotations"])
+    assert summary["artifacts"], "no device-trace artifact captured"
+    # machine-readable reconciliation agrees
+    rec = profiler.summarize_profile(pdir, trace_path=tpath)
+    assert rec["phases_match"] is True
+    # CLI table renders both accountings in one place
+    rc = cli_main(["profile", "summarize", pdir, "--trace", tpath])
+    assert rc == 0
+    text = profiler.render_summary(
+        rec, trace_phase_counts=rec["trace_phase_counts"])
+    assert "trace_calls" in text and "dispatch" in text
+    assert "every trace phase has a matching annotation" in text
+
+
+def test_profile_summarize_missing_dir_errors(tmp_path):
+    from dpsvm_tpu.cli import main as cli_main
+    assert cli_main(["profile", "summarize",
+                     str(tmp_path / "nope")]) == 2
+
+
+# ---------------------------------------------------------------------
+# ledger: round-trip + historical gate + CLI
+# ---------------------------------------------------------------------
+
+def test_ledger_gate_catches_accumulated_drift(tmp_path, monkeypatch):
+    """The headline acceptance: a drift whose every pairwise step
+    passes a 10% `compare`-style gate still fails the HISTORICAL gate,
+    and clean history passes."""
+    from dpsvm_tpu.observability import ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", path)
+    assert ledger.ledger_path() == path
+    # clean: jitter around 100
+    for v in (100.0, 101.0, 99.5, 100.2, 100.0, 100.3):
+        ledger.append("clean", {"value": v, "unit": "iter/s"})
+    # drift: 4% per run — every pairwise step passes at 10%
+    v = 100.0
+    vals = [v]
+    for _ in range(6):
+        v *= 0.96
+        vals.append(round(v, 3))
+    for val in vals:
+        ledger.append("drift", {"value": val, "unit": "iter/s"},
+                      trace="traces/drift.jsonl")
+    records = ledger.read(path)
+    for prev, cur in zip(vals, vals[1:]):
+        assert cur > prev * 0.9, "pairwise step should pass at 10%"
+    assert ledger.gate(records, window=5, threshold_pct=10.0,
+                       case="clean") == []
+    verdicts = ledger.gate(records, window=5, threshold_pct=10.0,
+                           case="drift")
+    assert verdicts and "drift" in verdicts[0]
+    # direction-aware: seconds GROWING is the regression
+    for s in (10.0, 10.1, 9.9, 10.0, 13.0):
+        ledger.append("secs", {"value": s, "unit": "s"})
+    assert ledger.gate(ledger.read(path), window=5, threshold_pct=20.0,
+                       case="secs")
+    # provenance fields ride every record
+    rec = [r for r in records if r["case"] == "drift"][-1]
+    assert rec["schema"] == 1 and rec["kind"] == "bench"
+    assert rec["trace"] == "traces/drift.jsonl"
+    assert "time" in rec and "git_sha" in rec and "backend" in rec
+
+
+def test_ledger_disabled_and_torn_line(tmp_path, monkeypatch):
+    from dpsvm_tpu.observability import ledger
+
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", "")
+    assert ledger.ledger_path() is None
+    assert ledger.append("x", {"value": 1.0}) is None
+    path = str(tmp_path / "l.jsonl")
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", path)
+    ledger.append("x", {"value": 1.0})
+    ledger.append("x", {"value": 2.0})
+    with open(path, "a") as fh:
+        fh.write('{"torn": ')             # producer killed mid-write
+    assert [r["value"] for r in ledger.read(path)] == [1.0, 2.0]
+    with open(path, "w") as fh:
+        fh.write('{"ok": 1}\n{"torn": \n{"ok": 2}\n')
+    with pytest.raises(ValueError, match="not a JSON record"):
+        ledger.read(path)
+
+
+def test_perf_cli_history_and_gate(tmp_path, monkeypatch, capsys):
+    from dpsvm_tpu.cli import main as cli_main
+    from dpsvm_tpu.observability import ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", path)
+    for v in (100.0, 100.0, 101.0, 99.0, 100.0, 80.0):
+        ledger.append("planted", {"value": v, "unit": "iter/s"},
+                      kind="burst")
+    assert cli_main(["perf"]) == 0
+    out = capsys.readouterr().out
+    assert "planted" in out and "iter/s" in out
+    assert cli_main(["perf", "gate", "--window", "5",
+                     "--fail-on-regress", "10"]) == 1
+    out = capsys.readouterr().out
+    assert "HISTORICAL REGRESSION" in out
+    assert cli_main(["perf", "gate", "--window", "5",
+                     "--fail-on-regress", "30"]) == 0
+    capsys.readouterr()
+    # --json machine path
+    assert cli_main(["perf", "gate", "--json", "--window", "5",
+                     "--fail-on-regress", "10"]) == 1
+    row = json.loads(capsys.readouterr().out)
+    assert row["regressions"] and row["cases"] == ["planted"]
+    # no ledger -> 2
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", str(tmp_path / "none.jsonl"))
+    assert cli_main(["perf"]) == 2
+
+
+def test_selfcheck_includes_metrics_and_ledger_gate():
+    """The CI gate: metrics exposition + planted-regression ledger
+    fixture are part of `python -m dpsvm_tpu.observability
+    --selfcheck` (tier-1 already runs selfcheck; this pins the new
+    sections exist and pass)."""
+    from dpsvm_tpu.observability import (_selfcheck_ledger,
+                                         _selfcheck_metrics, selfcheck)
+    assert _selfcheck_metrics() == []
+    assert _selfcheck_ledger() == []
+    assert selfcheck() == []
+
+
+def test_compare_verdict_appends_to_ledger(tmp_path, monkeypatch,
+                                           capsys):
+    from dpsvm_tpu.cli import main as cli_main
+    from dpsvm_tpu.observability import ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", path)
+    base = os.path.join(REPO, "tests", "fixtures",
+                        "compare_base.jsonl")
+    regressed = os.path.join(REPO, "tests", "fixtures",
+                             "compare_regressed.jsonl")
+    assert cli_main(["compare", base, regressed,
+                     "--fail-on-regress", "10"]) == 1
+    capsys.readouterr()
+    records = ledger.read(path)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["kind"] == "compare"
+    assert rec["metrics"]["passed"] is False
+    assert rec["metrics"]["regressions"]
+    assert rec["trace"] == regressed
+
+
+# ---------------------------------------------------------------------
+# satellites: compare marks clamp, loadgen trace pointer
+# ---------------------------------------------------------------------
+
+def _mini_trace(path, iters_gaps):
+    from dpsvm_tpu.telemetry import RunTrace
+    tr = RunTrace(str(path), config={"kernel": "rbf"}, n=100, d=4,
+                  gamma=0.5, solver="smo",
+                  env={"backend": "cpu", "device_kind": None,
+                       "device_count": 1})
+    for it, gap in iters_gaps:
+        tr.chunk(n_iter=it, b_lo=gap / 2, b_hi=-gap / 2, n_sv=10)
+    it, gap = iters_gaps[-1]
+    tr.summary(converged=True, n_iter=it, b=0.0, b_lo=gap / 2,
+               b_hi=-gap / 2, n_sv=10, train_seconds=1.0)
+    tr.close()
+
+
+def test_compare_clamps_marks_to_available_polls(tmp_path):
+    """Satellite: a short run (2 chunk records) cannot honestly carry
+    4 interpolation marks — the comparison clamps and the table says
+    so instead of printing duplicated rows."""
+    from dpsvm_tpu.telemetry import compare_paths, render_compare
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _mini_trace(a, [(100, 1.0), (200, 0.1)])
+    _mini_trace(b, [(100, 1.0), (200, 0.2)])
+    cmp, _ra, _rb = compare_paths(str(a), str(b), marks=4)
+    assert cmp["marks_requested"] == 4
+    assert cmp["marks_used"] == 1
+    assert len(cmp["gap_marks"]) == 1
+    iters = [m["n_iter"] for m in cmp["gap_marks"]]
+    assert len(iters) == len(set(iters)), "duplicated marks"
+    text = render_compare(cmp)
+    assert "marks clamped 4 -> 1" in text
+    # long curves keep the full mark count, no note
+    c, d = tmp_path / "c.jsonl", tmp_path / "d.jsonl"
+    curve = [(100 * (i + 1), 10.0 ** (-i)) for i in range(8)]
+    _mini_trace(c, curve)
+    _mini_trace(d, curve)
+    cmp2, _, _ = compare_paths(str(c), str(d), marks=4)
+    assert cmp2["marks_used"] == 4
+    assert len(cmp2["gap_marks"]) == 4
+    assert "clamped" not in render_compare(cmp2)
+
+
+def test_loadgen_rows_carry_trace_pointer(prom_server):
+    """Satellite: loadgen rows gain the burst-runner-style `trace`
+    provenance field, so serving SLO rows are ledger-traceable."""
+    from dpsvm_tpu.serving.loadgen import loadgen_row, synthetic_rows
+
+    srv = prom_server
+    rows = synthetic_rows(5, n=16)
+    row = loadgen_row(srv.url, rows, requests=6, batch=2,
+                      concurrency=2, compare_sequential=False,
+                      trace="traces/serving.jsonl")
+    assert row["errors"] == 0
+    assert row["trace"] == "traces/serving.jsonl"
+    row2 = loadgen_row(srv.url, rows, requests=4, batch=1,
+                       concurrency=2, compare_sequential=False)
+    assert row2["trace"] is None
