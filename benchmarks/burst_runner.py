@@ -128,6 +128,14 @@ TAGS = [
     # so the run doubles as a "probes cost nothing on chip" check.
     sub("dist_fault_drill", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.resilience", "--selfcheck"]),
+    # Streaming-ingest fault drill: the data selfcheck's convert ->
+    # stream-train -> quarantine (injected corrupt shard + transient
+    # read failure) -> bitwise-resume -> byte-identical-manifest loop
+    # (data/stream.py, docs/DATA.md), proven on the round's hardware —
+    # the chip run doubles as a "fixed shard shapes pin zero retraces
+    # on device" check.
+    sub("stream_fault_drill", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.data", "--selfcheck"]),
     sub("inference", R3, 240,
         [sys.executable, "benchmarks/inference_bench.py"],
         BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),

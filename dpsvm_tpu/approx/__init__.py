@@ -40,8 +40,9 @@ import sys
 from typing import List, Optional
 
 __all__ = ["ApproxSVMModel", "FeatureMap", "build_feature_map",
-           "featurize", "fit_approx", "load_approx_model",
-           "save_approx_model", "selfcheck", "main"]
+           "featurize", "fit_approx", "fit_approx_stream",
+           "load_approx_model", "save_approx_model", "selfcheck",
+           "main"]
 
 _LAZY = {
     "ApproxSVMModel": ("dpsvm_tpu.approx.model", "ApproxSVMModel"),
@@ -52,6 +53,8 @@ _LAZY = {
                           "build_feature_map"),
     "featurize": ("dpsvm_tpu.approx.features", "featurize"),
     "fit_approx": ("dpsvm_tpu.approx.primal", "fit_approx"),
+    "fit_approx_stream": ("dpsvm_tpu.approx.primal",
+                          "fit_approx_stream"),
 }
 
 

@@ -73,7 +73,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dpsvm_tpu.approx.features import (FeatureMap, build_feature_map,
+from dpsvm_tpu.approx.features import (FeatureMap, _featurize_block_jit,
+                                       build_feature_map,
                                        featurize_padded, shard_rows)
 from dpsvm_tpu.approx.model import ApproxSVMModel
 from dpsvm_tpu.config import SENTINEL, SVMConfig, TrainResult
@@ -311,6 +312,311 @@ def _build_primal_runner(task: str, n_pad: int, dp: int, batch: int,
         return final, stats(final)
 
     return jax.jit(run, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_stream_programs(task: str, dp: int, n_real: int, lam: float,
+                           big_l: float, epsilon: float, svr_eps: float,
+                           precision_name: str):
+    """Compiled programs for the OUT-OF-CORE full-batch path
+    (``fit_approx_stream``): the host streams shards through ``acc``
+    (partial data-gradient at the Nesterov lookahead point, one fixed
+    shape for every shard) and applies ``upd`` once per step (the
+    in-memory full-batch body — spectral metric, gradient restart,
+    plateau decay — gated on the same ``metric > 2 eps & n_iter <
+    limit`` condition the in-memory while_loop checks, so a converged
+    carry passes through untouched). ``stats_of`` packs the poll
+    array for the zero-step edge (a speculative chunk dispatched after
+    max_iter). All three compile exactly once per geometry."""
+    precision = getattr(lax.Precision, precision_name)
+    lr, beta = 1.0 / big_l, _MOMENTUM
+    reg_mask = np.ones((dp,), np.float32)
+    reg_mask[-1] = 0.0          # the bias lane is not regularized
+
+    def residual_grad(f, yb, rb):
+        if task == "svr":
+            r = f - yb
+            z = jnp.abs(r) - svr_eps
+            act = z > 0
+            return jnp.where(act, 2.0 * jnp.sign(r) * z, 0.0) * rb, act
+        z = 1.0 - yb * f
+        act = z > 0
+        return jnp.where(act, -2.0 * z * yb, 0.0) * rb, act
+
+    def acc(gacc, nacc, w, v, phi, yb, rb, scale):
+        # Pad rows ride with rb == 0, which zeroes their residual
+        # gradient — so neither the feature values a zero-padded row
+        # featurizes to nor the constant bias lane (folded in here as
+        # `+ u[-1]`, never materialized as a column) can leak into the
+        # accumulated gradient.
+        u = w + beta * v
+        f = jnp.matmul(phi, u[:-1], precision=precision) + u[-1]
+        g, act = residual_grad(f, yb, rb)
+        gpart = jnp.concatenate(
+            [jnp.matmul(g, phi, precision=precision),
+             jnp.reshape(jnp.sum(g), (1,))])
+        npart = jnp.sum(act & (rb > 0), dtype=jnp.int32)
+        return (gacc * scale + gpart,
+                jnp.where(scale > 0, nacc, 0) + npart)
+
+    def upd(s: PrimalCarry, gacc, nacc, limit):
+        u = s.w + beta * s.v
+        grad = gacc / jnp.float32(n_real) + lam * u * reg_mask
+        metric = jnp.sqrt(jnp.sum(grad * grad))
+        alive = (s.metric > 2.0 * epsilon) & (s.n_iter < limit)
+        v_new = beta * s.v - (lr * s.lrf) * grad
+        w_new = s.w + v_new
+        # Adaptive gradient restart (the in-memory full-batch move):
+        # zero the momentum when it points uphill.
+        v_new = jnp.where(jnp.vdot(grad, v_new) > 0,
+                          jnp.zeros_like(v_new), v_new)
+        t = s.n_iter + 1
+        refresh = (t % 256) == 0        # full-batch decay window
+        fresh = s.best >= jnp.float32(SENTINEL) * 0.5
+        decay = refresh & ~fresh & (metric >= s.best)
+        lrf = jnp.maximum(jnp.where(decay, s.lrf * 0.5, s.lrf),
+                          jnp.float32(1.0 / 4096.0))
+        best = jnp.where(refresh, jnp.minimum(s.best, metric), s.best)
+        stepped = PrimalCarry(w=w_new, v=v_new, metric=metric,
+                              best=best, lrf=lrf, n_iter=t, nact=nacc)
+        out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(alive, a, b), stepped, s)
+        return out, pack_stats(out.n_iter, out.metric,
+                               jnp.float32(0.0), n_sv=out.nact)
+
+    def stats_of(s: PrimalCarry):
+        return pack_stats(s.n_iter, s.metric, jnp.float32(0.0),
+                          n_sv=s.nact)
+
+    return (jax.jit(acc, donate_argnums=(0,)),
+            jax.jit(upd, donate_argnums=(0,)),
+            jax.jit(stats_of))
+
+
+def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
+                      task: str = "svc",
+                      allow_nonfinite: bool = False
+                      ) -> Tuple[ApproxSVMModel, TrainResult]:
+    """Featurize + primal-solve a ``data.stream.ShardedDataset`` that
+    never fully materializes — the out-of-core training path
+    (docs/DATA.md, docs/APPROX.md "Streaming").
+
+    Deterministic FULL-batch gradient steps: each iteration streams
+    every live shard through one compiled fixed-shape featurize +
+    accumulate pass (the shard geometry is fixed by the manifest, so
+    steady state pins ZERO retraces) and applies one compiled update —
+    the exact global-gradient metric of the in-memory full-batch mode,
+    evaluated every step. The host side is the shared
+    ``solver/driver.host_training_loop``: traces, the packed-stats
+    poll (count pinned equal to an in-memory run's — ingest accounting
+    adds no transfers), checkpoints/preemption, health guards, retry
+    supervision and compile accounting all work unchanged, and resume
+    is bitwise-identical (the trajectory is a pure function of the
+    carry, the shard bytes, and the manifest order — there is no
+    shuffle: full-batch gradients are order-independent up to the
+    fixed shard reduction order).
+
+    Robustness semantics: shard reads apply ``config.on_bad_shard``
+    (quarantine emits a ``quarantine`` trace event at the next poll
+    and the shard is skipped by every later epoch; the data-term
+    divisor stays the manifest's n so the objective does not silently
+    renormalize around lost rows), transient I/O errors retry with
+    backoff, and ``config.mem_budget_mb`` refuses an over-budget
+    per-shard working set up front.
+    """
+    from dpsvm_tpu.data import stream as streamlib
+    from dpsvm_tpu.solver.driver import queue_trace_event
+
+    config = config or SVMConfig()
+    config.validate()
+    if config.solver == "exact":
+        raise ValueError(
+            "streaming training is the approx primal path (the exact "
+            "dual solvers touch O(n^2) kernel state and need X "
+            "materialized): use solver='approx-rff'/'approx-nystrom', "
+            "or materialize the shards via data.loader.load_dataset")
+    if task not in ("svc", "svr"):
+        raise ValueError(f"task must be 'svc' or 'svr', got {task!r}")
+    if config.shards != 1:
+        raise ValueError(
+            "fit_approx_stream is single-process: the sharded "
+            "full-batch path (config.shards > 1) consumes in-memory "
+            "arrays — materialize, or stream on one process")
+    n, d = ds.n, ds.d
+    gamma = float(config.resolve_gamma(d))
+    spec = config.kernel_spec(d)
+    kind = config.solver.split("-", 1)[1]
+    streamlib.check_stream_budget(
+        config.mem_budget_mb, n=n, d=d,
+        rows_per_shard=ds.rows_per_shard, feat_dim=config.approx_dim,
+        what=ds.directory)
+
+    if kind == "rff":
+        # The RFF map only reads the input width — no data touched.
+        fmap = build_feature_map("rff", np.zeros((1, d), np.float32),
+                                 config.approx_dim, config.approx_seed,
+                                 spec)
+    else:
+        # Nystrom landmarks: a deterministic global subsample gathered
+        # from only the shards that hold them (strict integrity — the
+        # persisted map must be rebuildable forever).
+        m = min(int(config.approx_dim), n)
+        rng = np.random.default_rng(config.approx_seed)
+        idx = np.sort(rng.choice(n, size=m, replace=False))
+        fmap = build_feature_map("nystrom", ds.gather_rows(idx), m,
+                                 config.approx_seed, spec)
+    dp = fmap.dim + 1
+    srows = ds.rows_per_shard
+
+    feat_raw = compilewatch.instrument(_featurize_block_jit,
+                                       "stream-featurize")
+    feat_args = _feat_call_args(fmap)
+
+    def featurize_block(xk: np.ndarray):
+        block = xk
+        if xk.shape[0] != srows:
+            block = np.zeros((srows, d), np.float32)
+            block[: xk.shape[0]] = xk
+        return feat_raw(block, *feat_args[0], **feat_args[1])
+
+    policy = config.on_bad_shard
+
+    def padded(arrs, fill=0.0):
+        out = np.full((srows,), np.float32(fill))
+        out[: len(arrs)] = arrs
+        return out
+
+    def shard_lanes(k, y):
+        if task == "svc":
+            labels = np.unique(y)
+            if not np.all(np.isin(labels, (-1, 1))):
+                raise ValueError(
+                    f"shard {k}: labels must be +/-1 for binary "
+                    f"training, got {labels[:10]} — multiclass shard "
+                    "sets train via materialization")
+            yv = np.asarray(y, np.float32)
+            rw = np.where(yv > 0, np.float32(config.weight_pos),
+                          np.float32(config.weight_neg))
+        else:
+            yv = np.asarray(y, np.float32)
+            rw = np.ones((len(yv),), np.float32)
+        return padded(yv), padded(rw)
+
+    # Prologue epoch: every shard verified once (quarantine fires HERE
+    # first — deterministically, so an interrupted run and its resume
+    # see the identical live set) while the curvature stat accumulates
+    # over real rows. One extra I/O pass buys the same tuning-free
+    # step size the in-memory path measures.
+    msq_num = 0.0
+    seen = 0
+    for k in range(ds.n_shards):
+        got = ds.read_shard_checked(k, on_bad_shard=policy,
+                                    allow_nonfinite=allow_nonfinite)
+        if got is None:
+            continue
+        xk, yk = got
+        shard_lanes(k, yk)              # label sanity up front
+        phi = np.asarray(featurize_block(xk))
+        msq_num += float(np.sum(phi[: len(yk)].astype(np.float64) ** 2))
+        seen += len(yk)
+    if seen == 0:
+        raise streamlib.IngestAbortError(
+            f"{ds.directory}: no readable shard survived the prologue")
+    msq = msq_num / seen + 1.0          # + the bias lane
+    lam = 1.0 / (float(config.c) * n)
+    maxrw = (max(float(config.weight_pos), float(config.weight_neg))
+             if task == "svc" else 1.0)
+    # Trace bound only: the spectral estimate needs power-iteration
+    # passes over all shards (an epoch of I/O each); the plateau decay
+    # recovers the difference in step count (docs/APPROX.md).
+    big_l = lam + 2.0 * maxrw * msq
+
+    acc_j, upd_j, stats_j = _build_stream_programs(
+        task, dp, n, lam, big_l, float(config.epsilon),
+        float(config.svr_epsilon), config.matmul_precision.upper())
+    acc = compilewatch.instrument(acc_j, "stream-acc")
+    upd = compilewatch.instrument(upd_j, "stream-upd")
+
+    carry = init_carry(dp)
+    ckpt = resume_state(config, n, dp, gamma)
+    if ckpt is not None:
+        carry = unpack_state(ckpt, dp)
+        queue_trace_event("ingest_resume", n_iter=int(ckpt.n_iter),
+                          shards=int(ds.n_shards),
+                          quarantined=len(ds.quarantined))
+    carry = jax.device_put(carry)
+    it0 = int(ckpt.n_iter) if ckpt is not None else 0
+
+    state = {"it": it0, "carry": carry,
+             "gacc": jnp.zeros((dp,), jnp.float32),
+             "nacc": jnp.zeros((), jnp.int32)}
+
+    def step_chunk(c, limit):
+        limit = int(limit)
+        g, na = state["gacc"], state["nacc"]
+        stats = None
+        while state["it"] < limit:
+            first = True
+            for k in range(ds.n_shards):
+                got = ds.read_shard_checked(
+                    k, on_bad_shard=policy,
+                    allow_nonfinite=allow_nonfinite)
+                if got is None:
+                    continue
+                xk, yk = got
+                yp, rp = shard_lanes(k, yk)
+                phi = featurize_block(xk)
+                g, na = acc(g, na, c.w, c.v, phi, yp, rp,
+                            np.float32(0.0 if first else 1.0))
+                first = False
+            if first:
+                raise streamlib.IngestAbortError(
+                    f"{ds.directory}: every shard is quarantined")
+            c, stats = upd(c, g, na, np.int32(limit))
+            state["it"] += 1
+        if stats is None:
+            # Zero-step dispatch (speculative chunk at max_iter):
+            # report the carry as-is — no data pass, no extra reads.
+            stats = stats_j(c)
+        state["gacc"], state["nacc"] = g, na
+        state["carry"] = c
+        return c, stats
+
+    def carry_from_ckpt(ck):
+        # Rollback restores BOTH halves of the trajectory state: the
+        # device carry and the host epoch cursor.
+        state["it"] = int(ck.n_iter)
+        return jax.device_put(unpack_state(ck, dp))
+
+    result = host_training_loop(
+        config, gamma, n, dp, carry,
+        step_chunk=step_chunk,
+        carry_to_host=lambda c: pack_state(
+            jax.tree_util.tree_map(np.asarray, c)),
+        it0=it0,
+        carry_from_ckpt=carry_from_ckpt,
+    )
+
+    final = jax.tree_util.tree_map(np.asarray, state["carry"])
+    w_out = np.asarray(final.w, np.float32)
+    model = ApproxSVMModel(fmap=fmap, w=w_out[:-1].copy(),
+                           b=-float(w_out[-1]), task=task)
+    result = dataclasses.replace(
+        result, b=model.b, n_sv=int(final.nact), gamma=gamma,
+        kernel=config.kernel, coef0=float(config.coef0),
+        degree=int(config.degree))
+    return model, result
+
+
+def _feat_call_args(fmap: FeatureMap):
+    """(positional, keyword) arguments binding ``_featurize_block_jit``
+    for one map — the streaming path calls the SHARED jit directly
+    (instead of a per-fit closure) so compilewatch's cache probe sees a
+    warm second run as zero compiles."""
+    from dpsvm_tpu.approx.features import _block_args
+    kind = "rff" if fmap.kind == "rff" else fmap.kernel
+    return ((*_block_args(fmap),),
+            {"kind": kind, "degree": int(fmap.degree)})
 
 
 def _power_lambda_max(phi: np.ndarray, n: int) -> float:

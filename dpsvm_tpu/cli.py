@@ -49,8 +49,20 @@ def _add_backend_flags(p: argparse.ArgumentParser) -> None:
 
 def _add_data_flags(p: argparse.ArgumentParser,
                     model_required: bool = True) -> None:
-    p.add_argument("-f", "--input", required=True, help="dataset: dense CSV 'label,f1,...' or libsvm "
-                        "sparse 'label idx:val ...' (format sniffed)")
+    p.add_argument("-f", "--input", required=True,
+                   help="dataset: dense CSV 'label,f1,...', libsvm "
+                        "sparse 'label idx:val ...' (format sniffed), "
+                        "or a shard DIRECTORY from `dpsvm convert "
+                        "shards` (integrity-checked streaming reads — "
+                        "docs/DATA.md)")
+    p.add_argument("--mem-budget-mb", type=float, default=None,
+                   metavar="MB",
+                   help="host-memory admission guard: refuse (up "
+                        "front, with the shard-count math) any load "
+                        "whose materialized arrays exceed this many "
+                        "MiB, instead of OOMing mid-run; for shard "
+                        "directories the streaming train path bounds "
+                        "its per-shard working set by the same budget")
     p.add_argument("-m", "--model", required=model_required,
                    default=None, help="model file path"
                    + ("" if model_required
@@ -118,6 +130,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "'rollback' restores the newest intact "
                          "checkpoint and halves the poll chunk "
                          "(needs --checkpoint)")
+    tr.add_argument("--on-bad-shard", default="raise",
+                    choices=["raise", "quarantine"],
+                    help="streaming-ingest policy when a shard fails "
+                         "its manifest CRC or finiteness check "
+                         "(shard-directory inputs): 'quarantine' "
+                         "drops the shard — traced as a `quarantine` "
+                         "event naming shard + reason, bounded by the "
+                         "bad-fraction abort (docs/DATA.md)")
     tr.add_argument("--health-window", type=int, default=0, metavar="I",
                     help="iterations without best-gap improvement "
                          "before the stagnation guard trips (0 = off)")
@@ -341,17 +361,46 @@ def build_parser() -> argparse.ArgumentParser:
                          "train --probability")
 
     cv = sub.add_parser(
-        "convert", help="dataset converters (the reference's scripts/)")
-    cv.add_argument("format", choices=["libsvm", "mnist-odd-even"],
+        "convert", help="dataset converters (the reference's scripts/ "
+                        "+ the out-of-core shard format, docs/DATA.md)")
+    cv.add_argument("format", choices=["libsvm", "mnist-odd-even",
+                                       "shards"],
                     help="libsvm: sparse 'label idx:val ...' -> dense CSV "
                          "(scripts/convert_adult.py); mnist-odd-even: "
                          "'digit,p1,...' -> +/-1 even/odd with /255 pixels "
-                         "(scripts/convert_mnist_to_odd_even.py)")
+                         "(scripts/convert_mnist_to_odd_even.py); "
+                         "shards: any loader-supported file -> a "
+                         "directory of fixed-shape .npz shards + a "
+                         "CRC-carrying manifest, streamed row-by-row "
+                         "(never materialized) and RESUMABLE — a "
+                         "killed conversion picks up at the last "
+                         "durable shard and lands a byte-identical "
+                         "manifest")
     cv.add_argument("src", help="input file")
-    cv.add_argument("dst", help="output CSV")
+    cv.add_argument("dst", help="output CSV (or, for shards, the "
+                                "output DIRECTORY)")
     cv.add_argument("-a", "--num-att", type=int, default=None,
-                    help="libsvm only: force the dense width (default: "
-                         "max feature index seen)")
+                    help="libsvm/shards: force the dense width "
+                         "(default: max feature index seen)")
+    cv.add_argument("--rows-per-shard", type=int, default=4096,
+                    metavar="R",
+                    help="shards: rows per fixed-shape chunk shard "
+                         "(the streaming train path's compiled block "
+                         "shape AND its per-shard memory peak; "
+                         "default 4096)")
+    cv.add_argument("--float-labels", action="store_true",
+                    help="shards: store float32 labels (regression "
+                         "targets); default int32 classification "
+                         "labels, non-integer labels rejected")
+    cv.add_argument("--allow-nonfinite", action="store_true",
+                    help="shards: shard rows containing NaN/Inf "
+                         "instead of rejecting the conversion (the "
+                         "streaming reader will re-flag or quarantine "
+                         "them)")
+    cv.add_argument("--no-resume", dest="resume", action="store_false",
+                    default=True,
+                    help="shards: ignore a previous conversion's "
+                         "cursor and restart from row 0")
 
     sc = sub.add_parser(
         "scale", help="feature scaling (svm-scale analog; LIBSVM-"
@@ -388,6 +437,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "checks the directory is writable and the "
                          "newest rotation slot is intact (reporting "
                          "its recorded mesh/iteration)")
+    dr.add_argument("--data", default=None, metavar="DIR",
+                    help="shard-dataset directory to probe: manifest "
+                         "parse + shard CRC spot-check (first/middle/"
+                         "last), free disk space, and a one-shard "
+                         "timed read (docs/DATA.md); distinct exit "
+                         "codes 7 (integrity) / 8 (disk space)")
     dr.add_argument("--timeout", type=float, default=60.0,
                     help="bounded wait for backend init AND for the "
                          "collective probe (a hung interconnect "
@@ -679,6 +734,73 @@ def _kernel_name(v: str) -> str:
     return name
 
 
+def _train_streaming(args: argparse.Namespace, config) -> int:
+    """Plain train on a shard directory: the out-of-core approx path
+    (docs/DATA.md "Streaming training"). The data never materializes;
+    training metrics come from a second streamed pass through the same
+    integrity-checked reader (so a quarantined shard is excluded from
+    the reported accuracy exactly as it was from the gradient)."""
+    import numpy as np
+
+    from dpsvm_tpu.approx.primal import fit_approx_stream
+    from dpsvm_tpu.data.stream import ShardedDataset
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import decision_function
+
+    if args.probability_cv:
+        print("error: --probability-cv refits on held-out folds, "
+              "which needs the materialized dataset; use "
+              "--probability (streamed decisions) or materialize",
+              file=sys.stderr)
+        return 2
+    if args.num_ex is not None or args.num_att is not None:
+        # No-silent-ignore: the manifest owns the shard geometry.
+        print("error: -x/--num-ex and -a/--num-att do not apply to "
+              "streaming shard training — the manifest fixes the "
+              "shapes (re-convert to change them)", file=sys.stderr)
+        return 2
+    ds = ShardedDataset.open(args.input)
+    task = "svr" if args.svr else "svc"
+    model, result = fit_approx_stream(
+        ds, config, task=task, allow_nonfinite=args.allow_nonfinite)
+    save_model(model, args.model)
+    print(f"Approx model: {model.model_kind} dim={model.fmap.dim} "
+          f"(no SV set; streamed from {ds.n_shards} shard(s)"
+          + (f", {len(ds.quarantined)} quarantined"
+             if ds.quarantined else "") + ")")
+    print(f"b: {result.b:.6f}")
+    print(f"Training iterations: {result.n_iter}"
+          + ("" if result.converged
+             else " (max-iter reached, NOT converged)"))
+    decs = []
+    labs = []
+    for _k, xk, yk in ds.iter_shards(on_bad_shard=config.on_bad_shard,
+                                     allow_nonfinite=args.allow_nonfinite):
+        decs.append(np.asarray(decision_function(model, xk)))
+        labs.append(np.asarray(yk))
+    dec = np.concatenate(decs)
+    lab = np.concatenate(labs)
+    if task == "svc":
+        pred = np.where(dec < 0, -1, 1)
+        print(f"Training accuracy: "
+              f"{float(np.mean(pred == lab.astype(np.int32))):.6f} "
+              f"(streamed, {len(lab)} rows)")
+    else:
+        err = dec - lab.astype(np.float64)
+        print(f"Training MSE: {float(np.mean(err ** 2)):.6f}  "
+              f"MAE: {float(np.mean(np.abs(err))):.6f} "
+              f"(streamed, {len(lab)} rows)")
+    print(f"Training time: {result.train_seconds:.3f} s")
+    if args.probability and task == "svc":
+        from dpsvm_tpu.models.calibration import fit_platt, save_platt
+        pa, pb = fit_platt(dec, lab)
+        save_platt(args.model, pa, pb)
+        print(f"Platt calibration: A={pa:.6f} B={pb:.6f} "
+              f"(saved {args.model}.platt.json; fit on streamed "
+              "decisions)")
+    return 0
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     # Imports deferred so --help / arg errors don't pay the jax import.
     import numpy as np
@@ -908,10 +1030,32 @@ def cmd_train(args: argparse.Namespace) -> int:
                       file=sys.stderr)
                 return 2
 
-    x, y = load_dataset(args.input, args.num_ex, args.num_att,
-                        float_labels=(args.svr or args.one_class
-                                      or args.nu_svr),
-                        allow_nonfinite=args.allow_nonfinite)
+    # Shard-directory inputs (docs/DATA.md): an approx-solver plain
+    # train STREAMS the shards (the data never materializes —
+    # approx/primal.fit_approx_stream); every other mode reads the
+    # directory through load_dataset's materializing integrity path,
+    # subject to the same --mem-budget-mb admission guard as files.
+    from dpsvm_tpu.data import stream as streamlib
+    stream_train = False
+    if streamlib.is_shard_dir(args.input):
+        restricted = (args.cv or args.multiclass or args.one_class
+                      or args.nu_svc or args.nu_svr)
+        if args.solver != "exact" and not restricted:
+            stream_train = True
+        elif args.solver != "exact":
+            print("note: this mode materializes the shard directory "
+                  "(streaming covers plain --solver approx-* "
+                  "training); reads stay integrity-checked and "
+                  "budget-guarded", file=sys.stderr)
+    if stream_train:
+        x = y = None
+    else:
+        x, y = load_dataset(args.input, args.num_ex, args.num_att,
+                            float_labels=(args.svr or args.one_class
+                                          or args.nu_svr),
+                            allow_nonfinite=args.allow_nonfinite,
+                            mem_budget_mb=args.mem_budget_mb,
+                            on_bad_shard=args.on_bad_shard)
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, kernel=args.kernel,
         degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
@@ -946,7 +1090,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         solver=args.solver,
         approx_dim=args.approx_dim,
         approx_seed=args.approx_seed,
+        mem_budget_mb=args.mem_budget_mb,
+        on_bad_shard=args.on_bad_shard,
     )
+    if stream_train:
+        return _train_streaming(args, config)
     if args.multiclass:
         from dpsvm_tpu.models.multiclass import (evaluate_multiclass,
                                                  save_multiclass,
@@ -1149,8 +1297,10 @@ def cmd_test(args: argparse.Namespace) -> int:
         # libsvm files have no explicit width: a test split whose max
         # feature index is below the model's width (a9a.t is 122 vs
         # 123) must be loaded AT the model's width. CSV files carry
-        # their width; leave them alone so mismatches surface below.
-        if args.num_att is None and sniff_format(args.input) == "libsvm":
+        # their width — and so do shard directories (the manifest) —
+        # leave those alone so mismatches surface below.
+        if (args.num_att is None and not os.path.isdir(args.input)
+                and sniff_format(args.input) == "libsvm"):
             return d_model
         return args.num_att
 
@@ -1179,7 +1329,8 @@ def cmd_test(args: argparse.Namespace) -> int:
             return 2
         d_model = mc.models[0].num_attributes
         x, y = load_dataset(args.input, args.num_ex, _width_hint(d_model),
-                            allow_nonfinite=args.allow_nonfinite)
+                            allow_nonfinite=args.allow_nonfinite,
+                            mem_budget_mb=args.mem_budget_mb)
         if x.shape[1] != d_model:
             print(f"error: dataset has {x.shape[1]} attributes, model has "
                   f"{d_model}", file=sys.stderr)
@@ -1252,12 +1403,14 @@ def cmd_test(args: argparse.Namespace) -> int:
     # wider dataset against a reference-format model) is a real error.
     x, y = load_dataset(args.input, args.num_ex, args.num_att,
                         float_labels=model.task == "svr",
-                        allow_nonfinite=args.allow_nonfinite)
+                        allow_nonfinite=args.allow_nonfinite,
+                        mem_budget_mb=args.mem_budget_mb)
     if x.shape[1] != model.num_attributes:
         import dataclasses
 
         from dpsvm_tpu.models.io import is_libsvm_model
         data_is_libsvm = (args.num_att is None
+                          and not os.path.isdir(args.input)
                           and sniff_format(args.input) == "libsvm")
         if x.shape[1] < model.num_attributes and data_is_libsvm:
             x = np.pad(x, ((0, 0),
@@ -1550,6 +1703,21 @@ def cmd_convert(args: argparse.Namespace) -> int:
     from dpsvm_tpu.data.convert import (libsvm_to_dense_csv,
                                         mnist_to_odd_even_csv)
 
+    if args.format == "shards":
+        from dpsvm_tpu.data.stream import convert_to_shards
+        manifest = convert_to_shards(
+            args.src, args.dst,
+            rows_per_shard=args.rows_per_shard,
+            num_attributes=args.num_att,
+            float_labels=args.float_labels,
+            allow_nonfinite=args.allow_nonfinite,
+            resume=args.resume)
+        print(f"Wrote {manifest['n']} rows x {manifest['d']} features "
+              f"as {len(manifest['shards'])} shard(s) of "
+              f"{manifest['rows_per_shard']} rows to {args.dst} "
+              "(manifest.json carries per-shard CRC32s + scaling "
+              "stats)")
+        return 0
     if args.format == "libsvm":
         rows = libsvm_to_dense_csv(args.src, args.dst, args.num_att)
     else:
@@ -1873,6 +2041,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             from dpsvm_tpu.resilience.doctor import run_doctor
             return run_doctor(shards=args.shards,
                               checkpoint_path=args.checkpoint,
+                              data_path=args.data,
                               timeout_s=args.timeout)
         if args.command == "report":
             return cmd_report(args)
@@ -1907,8 +2076,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # and ShardLostError live in modules imported lazily with the
         # solvers — resolve them the same way so `--help` never pays
         # the numpy import.
+        from dpsvm_tpu.data.stream import StreamError
         from dpsvm_tpu.resilience.elastic import ShardLostError
         from dpsvm_tpu.utils.checkpoint import CheckpointError
+        if isinstance(e, StreamError):
+            # Shard corruption with on_bad_shard='raise', the bounded
+            # bad-fraction abort, or a mem-budget refusal: all are
+            # one-line operator errors, not tracebacks.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         if isinstance(e, ShardLostError):
             # Transient like a preemption: the run resumes from the
             # newest intact checkpoint — on whatever mesh the relaunch

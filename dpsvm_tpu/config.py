@@ -184,6 +184,23 @@ class SVMConfig:
                                         # same epsilon. Final KKT holds
                                         # in exact arithmetic at near-
                                         # bf16 wall-clock.
+    mem_budget_mb: Optional[float] = None   # host-memory admission
+                                        # guard (docs/DATA.md): a load
+                                        # or streaming block that would
+                                        # exceed this many MiB refuses
+                                        # UP FRONT with the shard-count
+                                        # math instead of OOMing an
+                                        # hour in (CLI --mem-budget-mb;
+                                        # None = no guard)
+    on_bad_shard: str = "raise"         # streaming-ingest policy when a
+                                        # shard fails its manifest CRC
+                                        # or finiteness check
+                                        # (data/stream.py): "raise"
+                                        # fails fast; "quarantine"
+                                        # drops the shard (trace event
+                                        # naming shard + reason),
+                                        # bounded by the bad-fraction
+                                        # abort
     verbose: bool = False
     log_every: int = 0                  # 0 = no per-chunk logging
     wall_budget_s: float = 0.0          # stop dispatching chunks once this
@@ -362,6 +379,12 @@ class SVMConfig:
         if self.wall_budget_s < 0:
             raise ValueError(
                 f"wall_budget_s must be >= 0, got {self.wall_budget_s}")
+        if self.mem_budget_mb is not None and self.mem_budget_mb <= 0:
+            raise ValueError(
+                f"mem_budget_mb must be > 0, got {self.mem_budget_mb}")
+        if self.on_bad_shard not in ("raise", "quarantine"):
+            raise ValueError("on_bad_shard must be 'raise' or "
+                             f"'quarantine', got {self.on_bad_shard!r}")
         if self.metrics_port is not None and not (
                 0 <= int(self.metrics_port) <= 65535):
             raise ValueError(

@@ -1,0 +1,10 @@
+"""``python -m dpsvm_tpu.data`` — the streaming-data selfcheck CI gate
+(sibling of ``python -m dpsvm_tpu.telemetry``, ``-m
+dpsvm_tpu.resilience``, ``-m dpsvm_tpu.serving`` and ``-m
+dpsvm_tpu.approx``)."""
+
+import sys
+
+from dpsvm_tpu.data import main
+
+sys.exit(main())

@@ -202,12 +202,54 @@ def load_libsvm(
         # Malformed input (or short file): fall through to the Python
         # parser, which produces line-numbered error messages.
 
-    labels = []
-    rows = []          # list of (idx_array, val_array), 1-based indices
+    # Pure-Python parse, in the TARGET dtypes end-to-end: a cheap
+    # text-only scan discovers the shape, then tokens stream straight
+    # into the final (n, d) float32 matrix. The old implementation
+    # staged every row as an (int64 indices, float32 values) pair and
+    # kept ALL of them alive while filling x — 12+ bytes per nonzero
+    # of intermediates beside the 4-byte target cell, i.e. peak host
+    # RAM of the largest supported in-memory loads more than doubled
+    # on near-dense files. Peak is pinned by test_data.py
+    # (test_libsvm_python_peak_ram_is_final_matrix).
+    n_rows = 0
     max_idx = 0
+    if num_examples is None or num_attributes is None:
+        with open(path, "r") as f:
+            for lineno, line in enumerate(f, 1):
+                if num_examples is not None and n_rows >= num_examples:
+                    break
+                parts = line.split()
+                if not parts or parts[0].startswith("#"):
+                    continue
+                n_rows += 1
+                if num_attributes is None:
+                    for tok in parts[1:]:
+                        try:
+                            idx = int(tok.split(":", 1)[0])
+                        except ValueError:
+                            continue     # the fill pass owns the error
+                        if idx < 1:
+                            raise ValueError(
+                                f"{path}:{lineno}: feature indices "
+                                "are 1-based")
+                        max_idx = max(max_idx, idx)
+        if n_rows == 0:
+            raise ValueError(f"empty dataset: {path!r}")
+        if num_examples is not None and n_rows < num_examples:
+            raise ValueError(f"{path}: expected {num_examples} rows, "
+                             f"found {n_rows}")
+        n = num_examples if num_examples is not None else n_rows
+    else:
+        n = num_examples
+    d = num_attributes if num_attributes is not None else max_idx
+    if d <= 0:
+        raise ValueError(f"{path}: no features found")
+    x = np.zeros((n, d), dtype=np.float32)
+    ys = np.empty((n,), dtype=np.float32 if float_labels else np.int32)
+    i = 0
     with open(path, "r") as f:
         for lineno, line in enumerate(f, 1):
-            if num_examples is not None and len(rows) >= num_examples:
+            if i >= n:
                 break
             parts = line.split()
             if not parts or parts[0].startswith("#"):
@@ -218,7 +260,7 @@ def load_libsvm(
                 raise ValueError(
                     f"{path}:{lineno}: bad label {parts[0]!r}") from e
             if float_labels:
-                labels.append(lab_f)
+                ys[i] = lab_f
             else:
                 lab = int(lab_f)
                 if lab != lab_f:
@@ -226,37 +268,26 @@ def load_libsvm(
                         f"{path}:{lineno}: non-integer label {parts[0]!r} "
                         "(classification labels must be integers; "
                         "regression loads with float_labels=True)")
-                labels.append(lab)
-            idxs = np.empty(len(parts) - 1, dtype=np.int64)
-            vals = np.empty(len(parts) - 1, dtype=np.float32)
-            for k, tok in enumerate(parts[1:]):
+                ys[i] = lab
+            for tok in parts[1:]:
                 try:
                     idx_s, val_s = tok.split(":", 1)
-                    idxs[k] = int(idx_s)
-                    vals[k] = float(val_s)
+                    idx = int(idx_s)
+                    val = np.float32(val_s)
                 except ValueError as e:
                     raise ValueError(
                         f"{path}:{lineno}: bad feature token {tok!r}") from e
-            if len(idxs) and idxs.min() < 1:
-                raise ValueError(
-                    f"{path}:{lineno}: feature indices are 1-based")
-            if len(idxs):
-                max_idx = max(max_idx, int(idxs.max()))
-            rows.append((idxs, vals))
-    n = len(rows)
-    if n == 0:
+                if idx < 1:
+                    raise ValueError(
+                        f"{path}:{lineno}: feature indices are 1-based")
+                if idx <= d:     # -a narrowing drops higher indices
+                    x[i, idx - 1] = val
+            i += 1
+    if i == 0:
         raise ValueError(f"empty dataset: {path!r}")
-    if num_examples is not None and n < num_examples:
-        raise ValueError(f"{path}: expected {num_examples} rows, found {n}")
-    d = num_attributes if num_attributes is not None else max_idx
-    if d <= 0:
-        raise ValueError(f"{path}: no features found")
-    x = np.zeros((n, d), dtype=np.float32)
-    for i, (idxs, vals) in enumerate(rows):
-        keep = idxs <= d
-        x[i, idxs[keep] - 1] = vals[keep]
-    return _check_finite(x, path, allow_nonfinite), np.asarray(
-        labels, dtype=np.float32 if float_labels else np.int32)
+    if i < n:
+        raise ValueError(f"{path}: expected {n} rows, found {i}")
+    return _check_finite(x, path, allow_nonfinite), ys
 
 
 def sniff_format(path: str) -> str:
@@ -284,15 +315,54 @@ def load_dataset(
     num_attributes: Optional[int] = None,
     float_labels: bool = False,
     allow_nonfinite: bool = False,
+    mem_budget_mb: Optional[float] = None,
+    on_bad_shard: str = "raise",
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Load a dataset in either supported format (sniffed per file).
+    """Load a dataset in any supported form — THE source API every
+    consumer (train, test, CV, serving warmup, loadgen) reads through.
 
-    Dense CSV ``label,f1,...,fd`` (the reference's format, parse.cpp:10)
-    or libsvm sparse ``label idx:val ...`` (the format the reference's
-    datasets ship in upstream). Returns (x float32 (n, d), y int32).
-    Both paths honor the reference's explicit ``-x``/``-a`` shape
-    overrides with identical semantics (short files error).
+    ``path`` may be a dense CSV ``label,f1,...,fd`` (the reference's
+    format, parse.cpp:10), a libsvm sparse file ``label idx:val ...``
+    (sniffed), or a converted SHARD DIRECTORY (``dpsvm convert
+    shards`` — docs/DATA.md): shard reads go through the manifest-CRC
+    integrity path with bounded retry and the ``on_bad_shard`` policy.
+    Returns (x float32 (n, d), y int32/float32). File paths honor the
+    reference's explicit ``-x``/``-a`` shape overrides with identical
+    semantics (short files error).
+
+    ``mem_budget_mb`` is the admission guard: a load whose
+    materialized arrays would exceed it refuses UP FRONT (naming the
+    shard-count math — ``stream.MemBudgetError``) instead of OOMing
+    after minutes of parsing. Training on data that must NOT
+    materialize is ``approx.fit_approx_stream`` over the shard
+    directory itself.
     """
+    from dpsvm_tpu.data import stream as streamlib
+    if streamlib.is_shard_dir(path):
+        ds = streamlib.ShardedDataset.open(path)
+        if num_attributes is not None and num_attributes != ds.d:
+            raise ValueError(
+                f"{path}: shard dataset is {ds.d} wide; -a "
+                f"{num_attributes} cannot re-shape fixed shards "
+                "(re-convert the source instead)")
+        x, y = ds.materialize(mem_budget_mb=mem_budget_mb,
+                              on_bad_shard=on_bad_shard,
+                              allow_nonfinite=allow_nonfinite)
+        if num_examples is not None:
+            if num_examples > len(y):
+                raise ValueError(f"{path}: expected {num_examples} "
+                                 f"rows, found {len(y)}")
+            x, y = x[:num_examples], y[:num_examples]
+        if float_labels:
+            y = np.asarray(y, np.float32)
+        return x, y
+    if mem_budget_mb:
+        n_est, d_est, _fmt = streamlib.source_shape(path)
+        streamlib.check_materialize_budget(
+            mem_budget_mb,
+            n=num_examples if num_examples is not None else n_est,
+            d=num_attributes if num_attributes is not None else d_est,
+            what=path)
     if sniff_format(path) == "libsvm":
         return load_libsvm(path, num_examples, num_attributes,
                            float_labels, allow_nonfinite)
@@ -306,7 +376,16 @@ def _check_finite(x: np.ndarray, path: str,
     (the solver is exp/argmin-based); fail at load time instead,
     naming the offending row. ``allow=True`` (the ``--allow-nonfinite``
     escape hatch) degrades the rejection to a stderr warning for
-    deliberately inspecting damaged datasets."""
+    deliberately inspecting damaged datasets.
+
+    The clean path is a pair of reductions, not a mask: min/max are
+    finite iff every element is (NaN propagates through min, +/-inf
+    survives max), so the common case allocates NOTHING — the old
+    ``np.isfinite(x)`` mask was a +25% peak-RAM spike on the largest
+    in-memory loads. The mask is only built on the failure path, to
+    name the offending cell."""
+    if x.size and np.isfinite(x.min()) and np.isfinite(x.max()):
+        return x
     if not np.isfinite(x).all():
         bad = np.argwhere(~np.isfinite(x))[0]
         msg = (

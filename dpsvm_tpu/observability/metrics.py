@@ -720,6 +720,54 @@ class TrainingMetrics:
 
 
 # ---------------------------------------------------------------------
+# the ingest half: shard reads -> registry (data/stream.py)
+# ---------------------------------------------------------------------
+
+class DataMetrics:
+    """The ``dpsvm_data_*`` instrument family the streaming data layer
+    feeds (docs/DATA.md): shards read/quarantined, transient-I/O
+    retries, rows delivered, and wall seconds spent in ingest. Every
+    update happens on the host around a file read — a metered
+    streaming run performs ZERO additional device->host transfers
+    (its packed-stats poll count is pinned equal to an in-memory run's
+    in tests/test_stream.py)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self._c_read = reg.counter("dpsvm_data_shards_read_total",
+                                   "shard reads that passed CRC + "
+                                   "finiteness checks")
+        self._c_rows = reg.counter("dpsvm_data_rows_read_total",
+                                   "dataset rows delivered by shard "
+                                   "reads")
+        self._c_quar = reg.counter("dpsvm_data_shards_quarantined_total",
+                                   "shards quarantined by the "
+                                   "on_bad_shard=quarantine policy")
+        self._c_retry = reg.counter("dpsvm_data_io_retries_total",
+                                    "transient shard-read failures "
+                                    "recovered by retry-with-backoff")
+        self._c_secs = reg.counter("dpsvm_data_ingest_seconds_total",
+                                   "wall seconds spent reading + "
+                                   "verifying shards")
+
+    def on_read(self, rows: int = 0) -> None:
+        self._c_read.inc()
+        if rows:
+            self._c_rows.inc(int(rows))
+
+    def on_quarantine(self) -> None:
+        self._c_quar.inc()
+
+    def on_retry(self) -> None:
+        self._c_retry.inc()
+
+    def on_ingest_seconds(self, seconds: float) -> None:
+        if seconds > 0:
+            self._c_secs.inc(float(seconds))
+
+
+# ---------------------------------------------------------------------
 # exporters: sidecar HTTP server + scrape-less file snapshots
 # ---------------------------------------------------------------------
 

@@ -94,6 +94,16 @@ SERVING_EVENTS = ("eject", "rebuild", "shed", "hedge", "drift",
 # observability/schema.REWIND_EVENTS).
 DIST_EVENTS = ("desync", "shard_lost", "reshard")
 
+# Event types the streaming data layer emits into a training trace
+# (data/stream.py, docs/DATA.md): `quarantine` = a data shard failed
+# its CRC / finiteness check under on_bad_shard="quarantine" and was
+# dropped from every later pass (carries `shard` + `reason` — the
+# schema validator requires both); `ingest_resume` = a streaming train
+# resumed from a checkpoint (carries the shard count; it rewinds
+# NOTHING — deliberately not in schema.REWIND_EVENTS, the resumed
+# n_iter baseline stands).
+INGEST_EVENTS = ("quarantine", "ingest_resume")
+
 
 def open_serving_trace(path: str, *, models: Optional[dict] = None,
                        env: Optional[dict] = None) -> "RunTrace":

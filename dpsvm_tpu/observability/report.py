@@ -49,6 +49,8 @@ def trace_facts(records: List[dict]) -> dict:
     manifest = records[0] if records else {}
     chunks = [r for r in records if r.get("kind") == "chunk"]
     compiles = [r for r in records if r.get("kind") == "compile"]
+    quarantines = [r for r in records if r.get("kind") == "event"
+                   and r.get("event") == "quarantine"]
     summary = next((r for r in records if r.get("kind") == "summary"),
                    None)
     it0 = int(manifest.get("it0", 0) or 0)
@@ -98,6 +100,7 @@ def trace_facts(records: List[dict]) -> dict:
         "hbm_peak": hbm_peak,
         "est_flops": est_flops,
         "est_flops_per_sec": est_flops_per_sec,
+        "quarantined_shards": len(quarantines),
         "phases": dict((summary or {}).get("phases")
                        or (chunks[-1].get("phases") if chunks else {})
                        or {}),
@@ -296,6 +299,13 @@ def render_report(records: List[dict], width: int = 60) -> str:
     if compiles:
         out.append("compile events: " + ", ".join(
             f"{c['program']}@{c['seconds']:.2f}s" for c in compiles))
+    quarantines = [e for e in events if e.get("event") == "quarantine"]
+    if quarantines:
+        rows = sum(int(e.get("rows", 0) or 0) for e in quarantines)
+        shards_q = ", ".join(str(e.get("shard")) for e in quarantines)
+        out.append(f"quarantined shards: {len(quarantines)} "
+                   f"({rows:,} rows dropped; shard {shards_q}) — "
+                   "see docs/DATA.md")
     if events:
         out.append("events: " + ", ".join(
             f"{e['event']}@{e['n_iter']:,}" for e in events))

@@ -78,12 +78,19 @@ TERMINAL_EVENTS = ("stall", "preempt")
 # checkpoint's iteration restarts the count on the new mesh).
 REWIND_EVENTS = ("rollback", "reshard")
 
-# Required extra keys per elastic event type (beyond EVENT_KEYS):
-# a `desync` without its mesh size or a `reshard` without both mesh
-# sizes is useless to every consumer, so the validator rejects them.
+# Required extra keys per elastic/ingest event type (beyond
+# EVENT_KEYS): a `desync` without its mesh size, a `reshard` without
+# both mesh sizes, or a `quarantine` without the shard and reason is
+# useless to every consumer, so the validator rejects them. Note the
+# ingest vocabulary's asymmetry: `quarantine` marks a data shard
+# dropped mid-run, and `ingest_resume` (a streaming train picking up
+# from a checkpoint) REWINDS NOTHING — unlike rollback/reshard it is
+# deliberately absent from REWIND_EVENTS, so a chunk record whose
+# n_iter regresses after one is still trace corruption.
 EVENT_EXTRA_KEYS = {
     "desync": ("shards",),
     "reshard": ("from_shards", "to_shards"),
+    "quarantine": ("shard", "reason"),
 }
 
 

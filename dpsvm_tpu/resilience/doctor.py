@@ -17,12 +17,20 @@ with a one-line diagnosis.
    real chunk. The probe result is also checked for correctness
    (psum of ones == P) — a wrong answer is a worse sign than a hang.
 3. **Checkpoint health** — directory writability (create + remove a
-   probe file) and newest-slot integrity: the rotation set is scanned
-   exactly like a resume would (``newest_intact_checkpoint``), and the
-   newest intact slot's recorded mesh/iteration are reported so the
-   operator knows what a restart would resume (a mesh different from
-   ``--shards`` is reported as a pending re-shard, not an error —
+   probe file), free disk space, and newest-slot integrity: the
+   rotation set is scanned exactly like a resume would
+   (``newest_intact_checkpoint``), and the newest intact slot's
+   recorded mesh/iteration are reported so the operator knows what a
+   restart would resume (a mesh different from ``--shards`` is
+   reported as a pending re-shard, not an error —
    docs/DISTRIBUTED.md "Elastic training").
+4. **Data health** (``--data DIR``, docs/DATA.md) — manifest parse,
+   a shard CRC spot-check (first / middle / last, the same verified
+   read a training run performs), free disk space for the shard
+   directory, and a one-shard TIMED read (a degraded disk or slow
+   network filesystem surfaces as MB/s before the run starts, not as
+   a mystery stall an hour in). Distinct exit codes: 7 = integrity,
+   8 = disk space.
 """
 
 from __future__ import annotations
@@ -123,7 +131,69 @@ def _checkpoint_probe(path: str, shards: int) -> Tuple[bool, List[str]]:
     return True, lines
 
 
+#: free-space floor for the disk probes: below this a checkpoint
+#: rotation (or the next shard write) is one bad day from ENOSPC.
+MIN_FREE_BYTES = 64 * 1024 * 1024
+
+
+def _free_disk_probe(directory: str, need_bytes: int
+                     ) -> Tuple[bool, str]:
+    """Free space on ``directory``'s filesystem vs what the caller is
+    about to write (floored at MIN_FREE_BYTES)."""
+    try:
+        st = os.statvfs(directory)
+    except OSError as e:
+        return False, f"cannot stat filesystem of {directory}: {e}"
+    free = st.f_bavail * st.f_frsize
+    need = max(int(need_bytes), MIN_FREE_BYTES)
+    mb = 1024.0 * 1024.0
+    if free < need:
+        return False, (f"{directory}: only {free / mb:.0f} MiB free "
+                       f"(< {need / mb:.0f} MiB needed) — the next "
+                       "write will ENOSPC")
+    return True, f"{directory}: {free / mb:,.0f} MiB free"
+
+
+def _data_probe(path: str, out: Callable[[str], None]
+                ) -> Tuple[bool, int]:
+    """Shard-dataset health: manifest + CRC spot-check + free disk +
+    one-shard timed read. Returns (ok, exit_code)."""
+    from dpsvm_tpu.data.stream import ShardedDataset, StreamError
+
+    try:
+        ds = ShardedDataset.open(path)
+    except (FileNotFoundError, StreamError) as e:
+        out(f"data: {e}")
+        out(f"DOCTOR FAIL: {e}")
+        return False, 7
+    out(f"data: {path}: {ds.n} rows x {ds.d} features in "
+        f"{ds.n_shards} shard(s) of {ds.rows_per_shard} "
+        f"({ds.manifest.get('label_dtype')} labels)")
+    ok, detail = _free_disk_probe(path, MIN_FREE_BYTES)
+    out(f"data: disk: {detail}")
+    if not ok:
+        out(f"DOCTOR FAIL: {detail}")
+        return False, 8
+    problems = ds.verify(spot=3)
+    if problems:
+        for p in problems:
+            out(f"data: INTEGRITY: {p}")
+        out(f"DOCTOR FAIL: {problems[0]} — a training run would "
+            "raise (or quarantine) here")
+        return False, 7
+    import time
+    t0 = time.perf_counter()
+    x, _y = ds.read_shard(0)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    mb = x.nbytes / (1024.0 * 1024.0)
+    out(f"data: timed read: shard 0 ({mb:.1f} MiB) in {dt * 1e3:.1f} "
+        f"ms ({mb / dt:,.0f} MB/s) — CRC spot-check OK on "
+        f"{min(3, ds.n_shards)} shard(s)")
+    return True, 0
+
+
 def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
+               data_path: Optional[str] = None,
                timeout_s: float = 60.0,
                out: Callable[[str], None] = print) -> int:
     """The full preflight; returns the process exit code (0 = sane).
@@ -161,6 +231,18 @@ def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
         if not ck_ok:
             out(f"DOCTOR FAIL: {lines[-1]}")
             return 6
+        directory = (os.path.dirname(os.path.abspath(checkpoint_path))
+                     or ".")
+        disk_ok, detail = _free_disk_probe(directory, MIN_FREE_BYTES)
+        out(f"checkpoint: disk: {detail}")
+        if not disk_ok:
+            out(f"DOCTOR FAIL: {detail}")
+            return 8
+    if data_path:
+        data_ok, code = _data_probe(data_path, out)
+        if not data_ok:
+            return code
     out(f"DOCTOR OK: {p}-shard mesh sane"
-        + (", checkpoint path healthy" if checkpoint_path else ""))
+        + (", checkpoint path healthy" if checkpoint_path else "")
+        + (", shard data healthy" if data_path else ""))
     return 0

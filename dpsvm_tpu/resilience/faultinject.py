@@ -42,6 +42,25 @@ driver on multi-shard runs — docs/DISTRIBUTED.md "Elastic training"):
   its heartbeat age grows in the chunk records and the stall
   watchdog's dist verdict fingers it.
 
+Data-pipeline knobs (``DPSVM_FAULT_IO_*``, consumed by the shard
+reader in ``data/stream.py`` — docs/DATA.md "Failure playbook"):
+
+* ``DPSVM_FAULT_IO_READ_FAIL_ONCE=k`` — the k-th (1-based) shard read
+  in this process raises a TRANSIENT ``OSError`` exactly once
+  (exercises the bounded retry-with-backoff path; the retry re-read
+  succeeds);
+* ``DPSVM_FAULT_IO_CORRUPT_SHARD=k`` — shard **#k** (1-based) reads
+  with a flipped payload byte on EVERY read (persistent corruption —
+  a rotted file stays rotted), so the manifest CRC check fails and the
+  ``on_bad_shard`` policy fires (quarantine event / raise);
+* ``DPSVM_FAULT_IO_TRUNCATE_SHARD=k`` — shard #k reads as a file cut
+  to half its bytes on every read (the killed-writer / torn-copy
+  model; surfaces as an unreadable-npz corruption);
+* ``DPSVM_FAULT_IO_SLOW_READ_MS=t`` — every shard read sleeps ``t``
+  milliseconds first (the degraded-disk / network-filesystem model;
+  exercises the doctor's timed-read probe and ingest-seconds
+  accounting).
+
 Serving-side knobs (``DPSVM_FAULT_SERVE_*``, consumed by
 ``serving/pool.py`` / ``serving/registry.py`` — docs/SERVING.md
 "Resilience"):
@@ -108,6 +127,12 @@ class FaultPlan:
     dist_desync_at: int = 0          # poison a probe at n_iter >= j
     dist_desync_shard: int = 0       # which shard lies (default last)
     dist_slow_shard: int = 0         # shard #k's probe stops advancing
+    # data-pipeline knobs (docstring above): shard NUMBERS 1-based
+    io_read_fail_once: int = 0       # the k-th shard read fails once
+    io_corrupt_shard: int = 0        # shard #k payload bit-flipped
+    #                                  (every read — persistent rot)
+    io_truncate_shard: int = 0       # shard #k reads half its bytes
+    io_slow_read_ms: int = 0         # every shard read sleeps this
 
     # process-lifetime counters (fire-once semantics)
     _writes: int = 0
@@ -121,13 +146,17 @@ class FaultPlan:
     _kill_fired: bool = False
     _desync_fired: bool = False
     _slow_probe: Optional[tuple] = None   # frozen probe row replayed
+    _io_reads: int = 0
+    _io_fail_fired: bool = False
 
     def any(self) -> bool:
         return bool(self.fail_checkpoint_write or self.nan_at_iter
                     or self.preempt_at_poll or self.serve_wedge_replica
                     or self.serve_nan_after or self.serve_fail_reload
                     or self.dist_kill_shard or self.dist_desync_at
-                    or self.dist_slow_shard)
+                    or self.dist_slow_shard or self.io_read_fail_once
+                    or self.io_corrupt_shard or self.io_truncate_shard
+                    or self.io_slow_read_ms)
 
     def note_checkpoint_write(self, path: str) -> None:
         self._writes += 1
@@ -206,6 +235,39 @@ class FaultPlan:
             return self.dist_kill_shard
         return 0
 
+    # -- data-pipeline injection points (data/stream.py). Like the
+    # training hooks these are single-threaded (one reader loop).
+
+    def io_read_begin(self, shard_idx: int) -> None:
+        """Called as a shard read starts: applies the slow-read latency
+        and raises the one transient read failure (an OSError, so the
+        reader's bounded retry recovers it — the transient model)."""
+        if self.io_slow_read_ms:
+            import time
+            time.sleep(self.io_slow_read_ms / 1000.0)
+        self._io_reads += 1
+        if (self.io_read_fail_once and not self._io_fail_fired
+                and self._io_reads >= self.io_read_fail_once):
+            self._io_fail_fired = True
+            _log(f"failing shard read #{self._io_reads} "
+                 f"(shard {shard_idx}) once")
+            raise InjectedFaultError(
+                f"injected transient read failure at shard read "
+                f"#{self._io_reads}")
+
+    def io_corrupt_now(self, shard_idx: int) -> bool:
+        """True when shard #(idx+1) should read with a flipped payload
+        byte — EVERY read (a rotted file stays rotted), unlike the
+        fire-once transient knobs."""
+        return bool(self.io_corrupt_shard
+                    and shard_idx + 1 == self.io_corrupt_shard)
+
+    def io_truncate_now(self, shard_idx: int) -> bool:
+        """True when shard #(idx+1) should read as a half-length file
+        (torn copy / killed writer) — every read, like corruption."""
+        return bool(self.io_truncate_shard
+                    and shard_idx + 1 == self.io_truncate_shard)
+
     # -- serving-side injection points (serving/pool.py). Unlike the
     # single-threaded training hooks, these are hit from concurrent
     # replica workers — counters advance under the module serve lock.
@@ -282,7 +344,11 @@ def plan_from_env() -> Optional[FaultPlan]:
         dist_kill_poll=_env_int("DIST_KILL_POLL"),
         dist_desync_at=_env_int("DIST_DESYNC_AT"),
         dist_desync_shard=_env_int("DIST_DESYNC_SHARD"),
-        dist_slow_shard=_env_int("DIST_SLOW_SHARD"))
+        dist_slow_shard=_env_int("DIST_SLOW_SHARD"),
+        io_read_fail_once=_env_int("IO_READ_FAIL_ONCE"),
+        io_corrupt_shard=_env_int("IO_CORRUPT_SHARD"),
+        io_truncate_shard=_env_int("IO_TRUNCATE_SHARD"),
+        io_slow_read_ms=_env_int("IO_SLOW_READ_MS"))
     return p if p.any() else None
 
 

@@ -77,6 +77,22 @@ def queue_trace_event(event: str, **extra) -> None:
     _PENDING_TRACE_EVENTS.append((event, extra))
 
 
+def drain_queued_events(trace) -> None:
+    """Mid-run lifecycle facts queued by subsystems with no trace
+    handle — the streaming data layer's ``quarantine`` events fire
+    inside a chunk dispatch — land in the trace at the next poll
+    boundary, the same queue-then-drain pattern the compilewatch log
+    uses. Draining with tracing off discards them, so one run's events
+    can never leak into the next run's trace."""
+    if not _PENDING_TRACE_EVENTS:
+        return
+    pending, _PENDING_TRACE_EVENTS[:] = _PENDING_TRACE_EVENTS[:], []
+    if trace is None:
+        return
+    for event, extra in pending:
+        trace.event(event, **extra)
+
+
 def resume_state(config: SVMConfig, n: int, d: int, gamma: float,
                  shards: int = 1) -> Optional[SolverCheckpoint]:
     """Load + validate the resume checkpoint if one is configured.
@@ -467,6 +483,7 @@ def host_training_loop(
                 # allocator watermark is a dictionary read — still
                 # ZERO extra device->host transfers.
                 drain_compiles(trace, n_iter, metrics=train_metrics)
+                drain_queued_events(trace)
                 hbm = (memory_snapshot()
                        if trace is not None or exporting else None)
                 if session is not None:
@@ -701,6 +718,7 @@ def host_training_loop(
                               n_iter=result.n_iter)
         if trace is not None:
             drain_compiles(trace, result.n_iter, metrics=train_metrics)
+            drain_queued_events(trace)
             trace.summary(converged=result.converged,
                           n_iter=result.n_iter, b=result.b,
                           b_lo=result.b_lo, b_hi=result.b_hi,
@@ -718,6 +736,8 @@ def host_training_loop(
         elastic.register_heartbeats(None)
         drain_compiles(trace if trace is not None and not trace.closed
                        else None, metrics=train_metrics)
+        drain_queued_events(trace if trace is not None
+                            and not trace.closed else None)
         if trace is not None:
             trace.close()
         # Exporter teardown: final snapshot for the scrape-less file,

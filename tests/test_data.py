@@ -304,6 +304,56 @@ def test_cli_multiclass_on_libsvm_input(tmp_path):
     assert main(["test", "-f", str(p), "-m", str(mdir)]) == 0
 
 
+def test_libsvm_python_peak_ram_is_final_matrix(tmp_path, monkeypatch):
+    """The loader bugfix pin: the pure-Python libsvm parse must not
+    stage per-row intermediate arrays beside the final (n, d) float32
+    matrix (the old path held int64-index/value pairs for EVERY row
+    alive while filling x — >2x peak on near-dense files). Peak
+    traced allocation stays within a small constant of the final
+    matrix."""
+    import tracemalloc
+
+    from dpsvm_tpu.data.loader import load_libsvm
+
+    rng = np.random.default_rng(0)
+    n, d = 400, 600
+    p = str(tmp_path / "dense.libsvm")
+    with open(p, "w") as f:
+        for i in range(n):
+            toks = " ".join(f"{j + 1}:{v:.4f}" for j, v in
+                            enumerate(rng.normal(size=d)))
+            f.write(f"{(-1) ** i} {toks}\n")
+    monkeypatch.setenv("DPSVM_NO_NATIVE", "1")
+    import dpsvm_tpu.native.build as nb
+    monkeypatch.setattr(nb, "_cached", None)
+    final_bytes = n * d * 4
+    tracemalloc.start()
+    x, y = load_libsvm(p)
+    _current, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert x.shape == (n, d) and x.dtype == np.float32
+    # generous slack for the parse loop's transient strings; the old
+    # staging path measured >2.5x here
+    assert peak < 1.5 * final_bytes, (
+        f"peak {peak / 1e6:.1f} MB vs final matrix "
+        f"{final_bytes / 1e6:.1f} MB")
+
+
+def test_check_finite_clean_path_allocates_no_mask(monkeypatch):
+    """The clean-path finiteness check is reduction-only — no (n, d)
+    boolean mask allocation (a +25% peak spike at scale)."""
+    import tracemalloc
+
+    from dpsvm_tpu.data.loader import _check_finite
+
+    x = np.ones((512, 512), np.float32)
+    tracemalloc.start()
+    _check_finite(x, "mem")
+    _current, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < x.nbytes // 8        # a mask alone would be nbytes/4
+
+
 class TestMakePlanted:
     """The planted-boundary benchmark generator: every property the
     round-2 verdict found missing from make_mnist_like."""

@@ -1,0 +1,621 @@
+"""Out-of-core streaming shards (data/stream.py, docs/DATA.md):
+integrity manifest, quarantine-and-continue, resumable conversion,
+memory-budget guards, and the streaming approx training path."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data import stream as streamlib
+from dpsvm_tpu.data.loader import load_dataset
+from dpsvm_tpu.data.synthetic import make_blobs, save_csv
+from dpsvm_tpu.resilience import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def _make_shards(tmp_path, n=384, d=6, rows=96, seed=7, name="shards"):
+    x, y = make_blobs(n=n, d=d, seed=seed)
+    src = str(tmp_path / f"src_{name}.csv")
+    save_csv(src, x, y)
+    sdir = str(tmp_path / name)
+    streamlib.convert_to_shards(src, sdir, rows_per_shard=rows)
+    return x.astype(np.float32), y, src, sdir
+
+
+def _corrupt_shard(sdir, k):
+    """Flip one payload byte INSIDE the npz member so the manifest CRC
+    catches it (container still parses)."""
+    path = os.path.join(sdir, streamlib.shard_filename(k))
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(raw)
+
+
+class TestShardFormat:
+    def test_convert_roundtrip_manifest_and_crcs(self, tmp_path):
+        x, y, _src, sdir = _make_shards(tmp_path)
+        ds = streamlib.ShardedDataset.open(sdir)
+        assert (ds.n, ds.d, ds.n_shards) == (384, 6, 4)
+        assert ds.verify() == []
+        m = ds.manifest
+        assert m["label_dtype"] == "int32"
+        assert len(m["stats"]["feature_min"]) == 6
+        np.testing.assert_allclose(m["stats"]["feature_min"],
+                                   x.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(m["stats"]["feature_max"],
+                                   x.max(axis=0), rtol=1e-6)
+        xm, ym = ds.materialize()
+        np.testing.assert_array_equal(xm, x)
+        np.testing.assert_array_equal(ym, y)
+
+    def test_load_dataset_reads_shard_dirs(self, tmp_path):
+        """The ONE source API: load_dataset materializes a shard
+        directory through the integrity path (what test/CV/loadgen
+        consume)."""
+        x, y, _src, sdir = _make_shards(tmp_path)
+        xm, ym = load_dataset(sdir)
+        np.testing.assert_array_equal(xm, x)
+        np.testing.assert_array_equal(ym, y)
+        xs, ys = load_dataset(sdir, 100)        # -x prefix semantics
+        assert xs.shape == (100, 6) and len(ys) == 100
+        with pytest.raises(ValueError, match="cannot re-shape"):
+            load_dataset(sdir, None, 4)
+
+    def test_partial_directory_rejected(self, tmp_path):
+        _x, _y, src, sdir = _make_shards(tmp_path)
+        assert not streamlib.is_shard_dir(str(tmp_path / "nope"))
+        # a second conversion into a completed directory is an error
+        with pytest.raises(streamlib.StreamError, match="already"):
+            streamlib.convert_to_shards(src, sdir, rows_per_shard=96)
+
+    def test_float_labels_and_nonint_rejection(self, tmp_path):
+        src = tmp_path / "reg.csv"
+        src.write_text("0.5,1.0,2.0\n-1.25,0.5,0.25\n")
+        with pytest.raises(ValueError, match="non-integer label"):
+            streamlib.convert_to_shards(str(src),
+                                        str(tmp_path / "bad"),
+                                        rows_per_shard=8)
+        streamlib.convert_to_shards(str(src), str(tmp_path / "reg"),
+                                    rows_per_shard=8,
+                                    float_labels=True)
+        _x, y = load_dataset(str(tmp_path / "reg"), float_labels=True)
+        assert y.dtype == np.float32
+        np.testing.assert_allclose(y, [0.5, -1.25])
+
+
+class TestResumableConversion:
+    def test_stop_and_resume_byte_identical_manifest(self, tmp_path):
+        x, y = make_blobs(n=384, d=6, seed=7)
+        src = str(tmp_path / "s.csv")
+        save_csv(src, x, y)
+        one = str(tmp_path / "oneshot")
+        streamlib.convert_to_shards(src, one, rows_per_shard=96)
+        killed = str(tmp_path / "killed")
+        part = streamlib.convert_to_shards(src, killed,
+                                           rows_per_shard=96,
+                                           _stop_after_shards=2)
+        assert part["rows_done"] == 192
+        assert os.path.exists(os.path.join(killed,
+                                           streamlib.CURSOR_NAME))
+        assert not streamlib.is_shard_dir(killed)
+        streamlib.convert_to_shards(src, killed, rows_per_shard=96)
+        with open(os.path.join(one, streamlib.MANIFEST_NAME), "rb") as f:
+            a = f.read()
+        with open(os.path.join(killed, streamlib.MANIFEST_NAME),
+                  "rb") as f:
+            b = f.read()
+        assert a == b
+        assert not os.path.exists(os.path.join(killed,
+                                               streamlib.CURSOR_NAME))
+
+    def test_kill_mid_convert_subprocess_resumes(self, tmp_path):
+        """The real kill: SIGKILL a converting subprocess mid-flight,
+        resume via the CLI, and the manifest is byte-identical to an
+        uninterrupted conversion's."""
+        x, y = make_blobs(n=2000, d=16, seed=5)
+        src = str(tmp_path / "big.csv")
+        save_csv(src, x, y)
+        one = str(tmp_path / "oneshot")
+        streamlib.convert_to_shards(src, one, rows_per_shard=100)
+        kdir = str(tmp_path / "killed")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DPSVM_FAULT_IO_SLOW_READ_MS="0")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dpsvm_tpu.cli", "convert",
+             "shards", src, kdir, "--rows-per-shard", "100"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.time() + 60
+        try:
+            while time.time() < deadline:
+                done = streamlib.is_shard_dir(kdir)
+                shards = [f for f in os.listdir(kdir)
+                          if f.startswith("shard-")] \
+                    if os.path.isdir(kdir) else []
+                if done or len(shards) >= 3:
+                    break
+                time.sleep(0.01)
+            proc.send_signal(signal.SIGKILL)
+        finally:
+            proc.wait(30)
+        if not streamlib.is_shard_dir(kdir):  # killed in time
+            assert os.path.exists(os.path.join(kdir,
+                                               streamlib.CURSOR_NAME))
+            streamlib.convert_to_shards(src, kdir, rows_per_shard=100)
+        with open(os.path.join(one, streamlib.MANIFEST_NAME),
+                  "rb") as f:
+            a = f.read()
+        with open(os.path.join(kdir, streamlib.MANIFEST_NAME),
+                  "rb") as f:
+            b = f.read()
+        assert a == b
+        ds = streamlib.ShardedDataset.open(kdir)
+        assert ds.verify() == []
+
+
+class TestQuarantine:
+    def test_corrupt_shard_raises_naming_shard(self, tmp_path):
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        _corrupt_shard(sdir, 1)
+        ds = streamlib.ShardedDataset.open(sdir)
+        with pytest.raises(streamlib.ShardCorruptError,
+                           match="shard 1"):
+            ds.materialize()
+
+    def test_quarantine_policy_drops_and_counts(self, tmp_path):
+        from dpsvm_tpu.observability.metrics import (DataMetrics,
+                                                     MetricsRegistry)
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        _corrupt_shard(sdir, 1)
+        ds = streamlib.ShardedDataset.open(sdir)
+        events = []
+        got = ds.read_shard_checked(
+            1, on_bad_shard="quarantine",
+            on_quarantine=lambda k, r: events.append((k, r)))
+        assert got is None
+        assert 1 in ds.quarantined
+        assert events and events[0][0] == 1
+        assert "CRC" in events[0][1]
+        # later passes skip it without re-reading
+        assert ds.read_shard_checked(1, on_bad_shard="quarantine") is None
+        xm, ym = ds.materialize(on_bad_shard="quarantine")
+        assert len(ym) == 384 - 96
+        # the metric series exist on a fresh registry feed
+        reg = MetricsRegistry()
+        dm = DataMetrics(reg)
+        dm.on_read(rows=5)
+        dm.on_quarantine()
+        dm.on_retry()
+        dm.on_ingest_seconds(0.25)
+        snap = reg.snapshot()
+        for name in ("dpsvm_data_shards_read_total",
+                     "dpsvm_data_rows_read_total",
+                     "dpsvm_data_shards_quarantined_total",
+                     "dpsvm_data_io_retries_total",
+                     "dpsvm_data_ingest_seconds_total"):
+            assert name in snap, name
+
+    def test_bad_fraction_abort(self, tmp_path):
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        for k in (0, 1):
+            _corrupt_shard(sdir, k)
+        ds = streamlib.ShardedDataset.open(sdir)
+        with pytest.raises(streamlib.IngestAbortError,
+                           match="bad-fraction"):
+            ds.materialize(on_bad_shard="quarantine")
+
+    def test_transient_read_retries(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DPSVM_IO_RETRY_BACKOFF_S", "0.001")
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        ds = streamlib.ShardedDataset.open(sdir)
+        faultinject.install(faultinject.FaultPlan(io_read_fail_once=1))
+        x0, _y0 = ds.read_shard(0)         # fails once, retry succeeds
+        assert x0.shape == (96, 6)
+
+    def test_truncate_fault_is_corruption(self, tmp_path):
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        ds = streamlib.ShardedDataset.open(sdir)
+        faultinject.install(faultinject.FaultPlan(io_truncate_shard=3))
+        with pytest.raises(streamlib.ShardCorruptError,
+                           match="shard 2"):
+            ds.read_shard(2)
+
+    def test_nonfinite_streaming_names_row_and_escape_hatch(
+            self, tmp_path):
+        """Satellite: the --allow-nonfinite hatch and row-naming
+        rejection on the STREAMING path (the in-memory loader path is
+        covered in test_data.py)."""
+        src = tmp_path / "bad.csv"
+        rows = ["1," + ",".join(["0.5"] * 3)] * 7
+        rows[5] = "-1,0.25,nan,0.5"
+        src.write_text("\n".join(rows) + "\n")
+        with pytest.raises(ValueError, match="row 5, column 1"):
+            streamlib.convert_to_shards(str(src),
+                                        str(tmp_path / "rej"),
+                                        rows_per_shard=4)
+        sdir = str(tmp_path / "ok")
+        streamlib.convert_to_shards(str(src), sdir, rows_per_shard=4,
+                                    allow_nonfinite=True)
+        ds = streamlib.ShardedDataset.open(sdir)
+        with pytest.raises(streamlib.ShardCorruptError,
+                           match="dataset row 5"):
+            ds.read_shard_checked(1)
+        ds2 = streamlib.ShardedDataset.open(sdir)
+        got = ds2.read_shard_checked(1, allow_nonfinite=True)
+        assert got is not None and np.isnan(got[0][1, 1])
+        ds3 = streamlib.ShardedDataset.open(sdir)
+        ds3.max_bad_fraction = 0.6     # the bad shard holds 3/7 rows
+        assert ds3.read_shard_checked(
+            1, on_bad_shard="quarantine") is None
+        assert "row" in ds3.quarantined[1]
+
+
+class TestMemBudget:
+    def test_materialize_refusal_names_shard_math(self, tmp_path):
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        ds = streamlib.ShardedDataset.open(sdir)
+        with pytest.raises(streamlib.MemBudgetError) as exc:
+            ds.materialize(mem_budget_mb=0.001)
+        msg = str(exc.value)
+        assert "rows-per-shard" in msg and "shards" in msg
+        assert "ceil(384/" in msg
+        # within budget: loads
+        xm, _ym = ds.materialize(mem_budget_mb=64)
+        assert xm.shape == (384, 6)
+
+    def test_file_load_budget_guard(self, tmp_path):
+        x, y = make_blobs(n=300, d=10, seed=1)
+        src = str(tmp_path / "f.csv")
+        save_csv(src, x, y)
+        with pytest.raises(streamlib.MemBudgetError,
+                           match="convert shards"):
+            load_dataset(src, mem_budget_mb=0.001)
+        xm, _ym = load_dataset(src, mem_budget_mb=64)
+        assert xm.shape == (300, 10)
+
+    def test_stream_budget_guard(self):
+        with pytest.raises(streamlib.MemBudgetError,
+                           match="rows-per-shard <="):
+            streamlib.check_stream_budget(
+                1.0, n=1_000_000, d=784, rows_per_shard=65536,
+                feat_dim=1024)
+        streamlib.check_stream_budget(512.0, n=1_000_000, d=784,
+                                      rows_per_shard=4096,
+                                      feat_dim=1024)
+
+
+class TestStreamingTraining:
+    def test_stream_train_matches_inmemory_quality(self, tmp_path):
+        from dpsvm_tpu.approx.primal import fit_approx, fit_approx_stream
+        from dpsvm_tpu.models.svm import decision_function
+        x, y, _src, sdir = _make_shards(tmp_path, n=512, d=6, rows=128,
+                                        seed=3)
+        ds = streamlib.ShardedDataset.open(sdir)
+        cfg = dict(solver="approx-rff", approx_dim=64, c=10.0,
+                   epsilon=5e-3, max_iter=800, chunk_iters=64,
+                   verbose=False)
+        ms, rs = fit_approx_stream(ds, SVMConfig(**cfg))
+        mi, _ri = fit_approx(x, y, SVMConfig(**cfg))
+        for m in (ms, mi):
+            pred = np.where(np.asarray(decision_function(m, x)) < 0,
+                            -1, 1)
+            assert float(np.mean(pred == y)) >= 0.95
+        assert rs.converged
+
+    def test_poll_parity_and_zero_steady_state_retraces(self, tmp_path):
+        """Acceptance pins: the streaming run's poll (chunk-record)
+        count equals the in-memory run's at a matched iteration budget
+        — ingest accounting rides the existing packed-stats transfer —
+        and each streaming program compiles exactly once, before
+        steady state (zero retraces after the first poll)."""
+        from dpsvm_tpu.approx.primal import fit_approx, fit_approx_stream
+        from dpsvm_tpu.observability.schema import (read_trace,
+                                                    validate_trace)
+        x, y, _src, sdir = _make_shards(tmp_path, n=512, d=6, rows=128,
+                                        seed=3)
+        ds = streamlib.ShardedDataset.open(sdir)
+        cfg = dict(solver="approx-rff", approx_dim=64, c=10.0,
+                   epsilon=1e-9, max_iter=96, chunk_iters=32,
+                   verbose=False)
+        ts = str(tmp_path / "stream.jsonl")
+        ti = str(tmp_path / "inmem.jsonl")
+        fit_approx_stream(ds, SVMConfig(trace_out=ts, **cfg))
+        fit_approx(x, y, SVMConfig(trace_out=ti, **cfg))
+        rs = read_trace(ts)
+        ri = read_trace(ti)
+        assert validate_trace(rs) == [] and validate_trace(ri) == []
+        chunks_s = [r for r in rs if r.get("kind") == "chunk"]
+        chunks_i = [r for r in ri if r.get("kind") == "chunk"]
+        assert len(chunks_s) == len(chunks_i)
+        compiles = [r for r in rs if r.get("kind") == "compile"]
+        by_prog = {}
+        for c in compiles:
+            by_prog[c["program"]] = by_prog.get(c["program"], 0) + 1
+        assert all(v == 1 for v in by_prog.values()), by_prog
+        # every compile observed at the FIRST poll's drain — nothing
+        # retraced in steady state
+        assert all(c["n_iter"] <= chunks_s[0]["n_iter"]
+                   for c in compiles)
+
+    def test_acceptance_drill(self, tmp_path, monkeypatch):
+        """The ISSUE acceptance: total data over the enforced
+        mem-budget (streaming admitted, materialization refused), one
+        injected corrupt shard -> quarantine event, one injected
+        transient read failure -> retry; completes with a schema-valid
+        trace; killed-then-resumed lands bitwise-identical."""
+        monkeypatch.setenv("DPSVM_IO_RETRY_BACKOFF_S", "0.001")
+        from dpsvm_tpu.approx.primal import fit_approx_stream
+        from dpsvm_tpu.observability.schema import (read_trace,
+                                                    validate_trace)
+        from dpsvm_tpu.resilience.preempt import PreemptedError
+        x, y, _src, sdir = _make_shards(tmp_path, n=512, d=6, rows=16,
+                                        seed=3)
+        ds = streamlib.ShardedDataset.open(sdir)
+        # A budget the FULL dataset cannot fit (materialization must
+        # refuse) but one 16-row shard block can (streaming admitted).
+        budget = 0.005
+        with pytest.raises(streamlib.MemBudgetError):
+            ds.materialize(mem_budget_mb=budget)
+        base = dict(solver="approx-rff", approx_dim=32, c=10.0,
+                    epsilon=1e-9, max_iter=64, chunk_iters=32,
+                    on_bad_shard="quarantine", mem_budget_mb=budget,
+                    verbose=False)
+        trace = str(tmp_path / "drill.jsonl")
+        faultinject.install(faultinject.FaultPlan(io_corrupt_shard=2,
+                                                  io_read_fail_once=2))
+        try:
+            m_full, _ = fit_approx_stream(
+                ds, SVMConfig(trace_out=trace, **base))
+        finally:
+            faultinject.clear()
+        recs = read_trace(trace)
+        assert validate_trace(recs) == []
+        quar = [r for r in recs if r.get("kind") == "event"
+                and r.get("event") == "quarantine"]
+        assert len(quar) == 1 and quar[0]["shard"] == 1
+        assert "reason" in quar[0]
+        # killed-then-resumed == uninterrupted, bitwise, under the
+        # same persistent corruption
+        ck = str(tmp_path / "ck.npz")
+        ds2 = streamlib.ShardedDataset.open(sdir)
+        faultinject.install(faultinject.FaultPlan(io_corrupt_shard=2,
+                                                  preempt_at_poll=1))
+        try:
+            with pytest.raises(PreemptedError):
+                fit_approx_stream(ds2, SVMConfig(
+                    checkpoint_path=ck, checkpoint_every=32, **base))
+        finally:
+            faultinject.clear()
+        ds3 = streamlib.ShardedDataset.open(sdir)
+        faultinject.install(faultinject.FaultPlan(io_corrupt_shard=2))
+        try:
+            m_res, _ = fit_approx_stream(
+                ds3, SVMConfig(resume_from=ck, **base))
+        finally:
+            faultinject.clear()
+        np.testing.assert_array_equal(m_full.w, m_res.w)
+        # the resumed trace would carry ingest_resume; cheaper: the
+        # event queue path is exercised via a traced resume
+        tr2 = str(tmp_path / "resume.jsonl")
+        ds4 = streamlib.ShardedDataset.open(sdir)
+        faultinject.install(faultinject.FaultPlan(io_corrupt_shard=2))
+        try:
+            fit_approx_stream(ds4, SVMConfig(resume_from=ck,
+                                             trace_out=tr2, **base))
+        finally:
+            faultinject.clear()
+        r2 = read_trace(tr2)
+        assert validate_trace(r2) == []
+        assert any(r.get("event") == "ingest_resume" for r in r2
+                   if r.get("kind") == "event")
+
+    def test_raise_policy_fails_fast(self, tmp_path):
+        from dpsvm_tpu.approx.primal import fit_approx_stream
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        _corrupt_shard(sdir, 0)
+        ds = streamlib.ShardedDataset.open(sdir)
+        with pytest.raises(streamlib.ShardCorruptError, match="shard 0"):
+            fit_approx_stream(ds, SVMConfig(solver="approx-rff",
+                                            approx_dim=32,
+                                            max_iter=32,
+                                            verbose=False))
+
+    def test_nystrom_streaming(self, tmp_path):
+        from dpsvm_tpu.approx.primal import fit_approx_stream
+        from dpsvm_tpu.models.svm import decision_function
+        x, y, _src, sdir = _make_shards(tmp_path, n=384, d=6, rows=96,
+                                        seed=9, name="nys")
+        ds = streamlib.ShardedDataset.open(sdir)
+        m, _r = fit_approx_stream(ds, SVMConfig(
+            solver="approx-nystrom", approx_dim=48, c=10.0,
+            epsilon=5e-3, max_iter=600, chunk_iters=64, verbose=False))
+        pred = np.where(np.asarray(decision_function(m, x)) < 0, -1, 1)
+        assert float(np.mean(pred == y)) >= 0.95
+
+
+class TestTraceVocabulary:
+    def _base(self):
+        return [{"kind": "manifest", "schema": 2, "version": "t",
+                 "solver": "approx-primal", "n": 4, "d": 2,
+                 "gamma": 0.5,
+                 "kernel": {"kind": "rbf", "gamma": 0.5,
+                            "coef0": 0.0, "degree": 3},
+                 "mesh": {"shards": 1, "shard_x": True},
+                 "env": {"backend": "cpu", "device_kind": "cpu",
+                         "device_count": 1},
+                 "config": {}, "it0": 0, "time": "t"}]
+
+    def _chunk(self, n_iter, t):
+        return {"kind": "chunk", "n_iter": n_iter, "b_lo": 1.0,
+                "b_hi": 0.0, "gap": 1.0, "n_sv": 0, "cache_hits": 0,
+                "cache_misses": 0, "rounds": 0, "t": t, "phases": {},
+                "phase_counts": {}, "hbm": {}}
+
+    def test_quarantine_requires_shard_and_reason(self):
+        from dpsvm_tpu.observability.schema import validate_trace
+        recs = self._base() + [{"kind": "event", "event": "quarantine",
+                                "n_iter": 0, "t": 0.1}]
+        errs = validate_trace(recs)
+        assert errs and "shard" in errs[0] and "reason" in errs[0]
+        recs[-1].update(shard=3, reason="CRC mismatch")
+        assert validate_trace(recs) == []
+
+    def test_ingest_resume_rewinds_nothing(self):
+        """`ingest_resume` is NOT a rewind event: a chunk whose n_iter
+        regresses after one is still trace corruption (unlike after
+        rollback/reshard)."""
+        from dpsvm_tpu.observability.schema import (REWIND_EVENTS,
+                                                    validate_trace)
+        assert "ingest_resume" not in REWIND_EVENTS
+        recs = self._base() + [
+            self._chunk(64, 0.1),
+            {"kind": "event", "event": "ingest_resume", "n_iter": 10,
+             "t": 0.2, "shards": 4},
+            self._chunk(10, 0.3),
+        ]
+        errs = validate_trace(recs)
+        assert errs and "not monotone" in errs[0]
+
+    def test_report_renders_quarantine_counts(self, tmp_path):
+        from dpsvm_tpu.observability.report import (render_report,
+                                                    trace_facts)
+        recs = self._base() + [
+            self._chunk(32, 0.1),
+            {"kind": "event", "event": "quarantine", "n_iter": 32,
+             "t": 0.2, "shard": 1, "reason": "CRC mismatch",
+             "rows": 96},
+        ]
+        assert trace_facts(recs)["quarantined_shards"] == 1
+        text = render_report(recs)
+        assert "quarantined shards: 1" in text
+        assert "96" in text
+
+    def test_ingest_events_vocabulary_exported(self):
+        from dpsvm_tpu.observability.record import INGEST_EVENTS
+        assert set(INGEST_EVENTS) == {"quarantine", "ingest_resume"}
+
+
+class TestDoctorDataProbes:
+    def test_healthy_dataset_ok(self, tmp_path, capsys):
+        from dpsvm_tpu.resilience.doctor import run_doctor
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        lines = []
+        rc = run_doctor(shards=1, data_path=sdir, out=lines.append)
+        assert rc == 0, lines
+        joined = "\n".join(lines)
+        assert "timed read" in joined and "MB/s" in joined
+        assert "MiB free" in joined
+        assert "DOCTOR OK" in lines[-1]
+        assert "shard data healthy" in lines[-1]
+
+    def test_corrupt_dataset_exit_7(self, tmp_path):
+        from dpsvm_tpu.resilience.doctor import run_doctor
+        _x, _y, _src, sdir = _make_shards(tmp_path)
+        _corrupt_shard(sdir, 0)
+        lines = []
+        rc = run_doctor(shards=1, data_path=sdir, out=lines.append)
+        assert rc == 7
+        assert any("INTEGRITY" in ln for ln in lines)
+        assert "DOCTOR FAIL" in "\n".join(lines)
+
+    def test_not_a_dataset_exit_7(self, tmp_path):
+        from dpsvm_tpu.resilience.doctor import run_doctor
+        lines = []
+        rc = run_doctor(shards=1, data_path=str(tmp_path),
+                        out=lines.append)
+        assert rc == 7
+
+    def test_checkpoint_disk_probe_line(self, tmp_path):
+        from dpsvm_tpu.resilience.doctor import run_doctor
+        lines = []
+        rc = run_doctor(shards=1,
+                        checkpoint_path=str(tmp_path / "ck.npz"),
+                        out=lines.append)
+        assert rc == 0
+        assert any("disk:" in ln and "checkpoint" in ln
+                   for ln in lines)
+
+
+class TestCLI:
+    def test_convert_train_test_on_shards(self, tmp_path):
+        from dpsvm_tpu.cli import main
+        x, y = make_blobs(n=400, d=6, seed=2)
+        src = str(tmp_path / "t.csv")
+        save_csv(src, x, y)
+        sdir = str(tmp_path / "sh")
+        assert main(["convert", "shards", src, sdir,
+                     "--rows-per-shard", "128"]) == 0
+        model = str(tmp_path / "m.npz")
+        assert main(["train", "-f", sdir, "-m", model,
+                     "--solver", "approx-rff", "--approx-dim", "64",
+                     "-c", "10", "-e", "0.005",
+                     "--mem-budget-mb", "64", "-q"]) == 0
+        assert main(["test", "-f", sdir, "-m", model]) == 0
+        # exact solver on a shard dir materializes (same source API)
+        em = str(tmp_path / "em.svm")
+        assert main(["train", "-f", sdir, "-m", em, "-c", "10",
+                     "-q"]) == 0
+        assert main(["test", "-f", sdir, "-m", em]) == 0
+
+    def test_cli_budget_refusal_is_one_line(self, tmp_path, capsys):
+        from dpsvm_tpu.cli import main
+        x, y = make_blobs(n=400, d=6, seed=2)
+        src = str(tmp_path / "t.csv")
+        save_csv(src, x, y)
+        rc = main(["train", "-f", src, "-m", str(tmp_path / "m.svm"),
+                   "--mem-budget-mb", "0.001", "-q"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "error:" in err and "convert shards" in err
+
+    def test_cli_quarantine_flag_parses(self):
+        from dpsvm_tpu.cli import build_parser
+        args = build_parser().parse_args(
+            ["train", "-f", "x", "-m", "m", "--on-bad-shard",
+             "quarantine", "--mem-budget-mb", "256"])
+        assert args.on_bad_shard == "quarantine"
+        assert args.mem_budget_mb == 256.0
+
+
+def test_io_fault_knobs_parse_from_env(monkeypatch):
+    monkeypatch.setenv("DPSVM_FAULT_IO_READ_FAIL_ONCE", "2")
+    monkeypatch.setenv("DPSVM_FAULT_IO_CORRUPT_SHARD", "3")
+    monkeypatch.setenv("DPSVM_FAULT_IO_TRUNCATE_SHARD", "4")
+    monkeypatch.setenv("DPSVM_FAULT_IO_SLOW_READ_MS", "1")
+    plan = faultinject.plan_from_env()
+    assert plan is not None and plan.any()
+    assert (plan.io_read_fail_once, plan.io_corrupt_shard,
+            plan.io_truncate_shard, plan.io_slow_read_ms) == (2, 3, 4, 1)
+    assert plan.io_corrupt_now(2) and not plan.io_corrupt_now(1)
+    assert plan.io_truncate_now(3)
+
+
+def test_data_selfcheck(tmp_path):
+    from dpsvm_tpu.data import selfcheck
+    assert selfcheck(str(tmp_path)) == []
+
+
+@pytest.mark.slow
+def test_data_selfcheck_cli_entrypoint(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "dpsvm_tpu.data", "--selfcheck"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    assert "data selfcheck OK" in r.stdout
