@@ -40,6 +40,124 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def preflight_or_degrade(metric: str) -> None:
+    """Deadline-bounded doctor preflight before the round
+    (bench_common.doctor_preflight): an unresponsive TPU tunnel
+    degrades to ONE clear ``"degraded": true`` verdict row + exit 3
+    instead of a burned round (BENCH_r03–r05 all hung this way)."""
+    from bench_common import doctor_preflight
+    verdict = doctor_preflight()
+    if verdict is None:
+        return
+    log(f"PREFLIGHT FAIL: {verdict}")
+    row = {"metric": metric, "degraded": True, "verdict": verdict}
+    print(json.dumps(row), flush=True)
+    try:
+        from dpsvm_tpu.observability import ledger
+        ledger.append(metric, row, kind="bench")
+    except Exception as e:                  # noqa: BLE001 — provenance only
+        log(f"WARNING: ledger append failed: {e}")
+    raise SystemExit(3)
+
+
+def cascade_vs_exact() -> None:
+    """BENCH_CASE=cascade-vs-exact: same dataset, same C/gamma, full
+    exact dual solve vs the three-stage cascade (docs/APPROX.md
+    "Cascade"). One JSON row with the wall-clock speedup AND the
+    exactness facts the cascade claims: held-out decision-function
+    parity (max |delta|, prediction agreement) plus the zero
+    post-repair KKT-violator certificate. Shape knobs: BENCH_N /
+    BENCH_D / BENCH_APPROX_DIM / BENCH_SCREEN_MARGIN; the cascade run
+    writes its run trace to $BENCH_TRACE_OUT so the ledger row carries
+    screen/polish/readmit provenance (`dpsvm compare`-gatable like
+    any other trace)."""
+    from dpsvm_tpu.config import SCREEN_MARGIN_DEFAULT
+    n = int(os.environ.get("BENCH_N", 30_000))
+    d = int(os.environ.get("BENCH_D", 64))
+    approx_dim = int(os.environ.get("BENCH_APPROX_DIM", 1024))
+    margin = float(os.environ.get("BENCH_SCREEN_MARGIN",
+                                  SCREEN_MARGIN_DEFAULT))
+    max_iter = int(os.environ.get("BENCH_MAX_ITER", 600_000))
+    c = float(os.environ.get("BENCH_C", 1.0))
+    gamma = float(os.environ.get("BENCH_GAMMA", 0.25))
+
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+    dev = require_devices()[0]
+    enable_compile_cache()
+    log(f"device: {dev} ({dev.platform})")
+
+    import numpy as np
+
+    from bench_common import standin
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.svm import decision_function, evaluate
+
+    n_test = max(2000, n // 10)
+    xa, ya = standin(n=n + n_test, d=d, gamma=gamma, seed=0)
+    x, y = xa[:n], ya[:n]
+    xt, yt = xa[n:], ya[n:]
+
+    base = dict(c=c, gamma=gamma, epsilon=1e-3, max_iter=max_iter,
+                matmul_precision=os.environ.get("BENCH_PRECISION",
+                                                "default").lower())
+    trace_out = os.environ.get("BENCH_TRACE_OUT") or None
+    # BENCH_SHRINKING=1 turns on active-set shrinking for the POLISH
+    # stage (a measured CPU wall win on SV-screenable subproblems);
+    # the exact baseline stays the solver's default path — the number
+    # every prior bench row prices against.
+    shrink = os.environ.get("BENCH_SHRINKING", "").strip() not in ("", "0")
+    casc_cfg = SVMConfig(solver="cascade", approx_dim=approx_dim,
+                         screen_margin=margin, trace_out=trace_out,
+                         shrinking=shrink, **base)
+    exact_cfg = SVMConfig(**base)
+
+    m_casc, r_casc = fit(x, y, casc_cfg)
+    log(f"cascade: {r_casc.n_iter} iters "
+        f"(approx {r_casc.approx_iters} + polish {r_casc.polish_iters}"
+        f", {r_casc.readmit_rounds} round(s)) in "
+        f"{r_casc.train_seconds:.2f}s: screened {r_casc.n_total} -> "
+        f"{r_casc.n_kept}, {r_casc.n_readmitted} re-admitted, "
+        f"{r_casc.kkt_violators} violator(s)")
+    m_exact, r_exact = fit(x, y, exact_cfg)
+    log(f"exact: {r_exact.n_iter} iters in "
+        f"{r_exact.train_seconds:.2f}s (converged={r_exact.converged})")
+
+    dec_e = np.asarray(decision_function(m_exact, xt))
+    dec_c = np.asarray(decision_function(m_casc, xt))
+    agree = float(np.mean(np.sign(dec_e) == np.sign(dec_c)))
+    max_delta = float(np.max(np.abs(dec_e - dec_c)))
+    speedup = (r_exact.train_seconds / r_casc.train_seconds
+               if r_casc.train_seconds > 0 else 0.0)
+    row = {
+        "metric": "cascade_vs_exact_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "prediction_agreement": round(agree, 6),
+        "max_decision_delta": round(max_delta, 5),
+        "kkt_violators": int(r_casc.kkt_violators),
+        "accuracy_exact": round(evaluate(m_exact, xt, yt), 5),
+        "accuracy_cascade": round(evaluate(m_casc, xt, yt), 5),
+        "exact_seconds": round(r_exact.train_seconds, 3),
+        "cascade_seconds": round(r_casc.train_seconds, 3),
+        "n_kept": int(r_casc.n_kept),
+        "n_readmitted": int(r_casc.n_readmitted),
+        "readmit_rounds": int(r_casc.readmit_rounds),
+        "exact_converged": bool(r_exact.converged),
+        "cascade_converged": bool(r_casc.converged),
+        "n": n, "d": d, "approx_dim": approx_dim,
+        "screen_margin": margin, "c": c, "gamma": gamma,
+        "gen": os.environ.get("BENCH_GEN", "planted"),
+        "n_sv": int(m_casc.n_sv),
+        "shrinking_polish": shrink,
+    }
+    print(json.dumps(row), flush=True)
+    from dpsvm_tpu.observability import ledger
+    ledger.append(row["metric"], row, kind="bench",
+                  trace=trace_out, backend=dev.platform)
+
+
 def approx_vs_exact() -> None:
     """BENCH_CASE=approx-vs-exact: same dataset, same C/gamma, exact
     dual solve vs approx-rff primal solve (docs/APPROX.md). One JSON
@@ -115,9 +233,16 @@ def approx_vs_exact() -> None:
 
 
 def main() -> None:
-    if os.environ.get("BENCH_CASE", "").replace("_", "-") == \
-            "approx-vs-exact":
+    case = os.environ.get("BENCH_CASE", "").replace("_", "-")
+    metric = {"approx-vs-exact": "approx_vs_exact_speedup",
+              "cascade-vs-exact": "cascade_vs_exact_speedup"}.get(
+                  case, "smo_iters_per_sec_mnist_scale")
+    preflight_or_degrade(metric)
+    if case == "approx-vs-exact":
         approx_vs_exact()
+        return
+    if case == "cascade-vs-exact":
+        cascade_vs_exact()
         return
     n = int(os.environ.get("BENCH_N", 60_000))
     d = int(os.environ.get("BENCH_D", 784))

@@ -56,9 +56,10 @@ def train(x: np.ndarray, y: np.ndarray,
     config.validate()
     if config.solver != "exact":
         raise ValueError(
-            "approx solvers have no dual alpha vector to return — train "
-            "through api.fit (which returns an ApproxSVMModel) or "
-            "approx.fit_approx directly")
+            "approx solvers have no dual alpha vector to return, and "
+            "the cascade is a multi-stage schedule — train through "
+            "api.fit (which returns the right model kind), or "
+            "approx.fit_approx / solver.cascade.fit_cascade directly")
     x, y = _check_xy(x, y)
     # Concretize any "auto" solver-path sentinels now that the problem
     # shape is known; every path below sees only concrete values.
@@ -155,8 +156,16 @@ def fit(x: np.ndarray, y: np.ndarray,
     ``ApproxSVMModel`` instead — same (model, result) contract, and
     every downstream consumer (``models/svm.decision_function``,
     ``models/io``, the serving engine, CV, multiclass) dispatches on
-    the model kind."""
+    the model kind.
+
+    ``config.solver = "cascade"`` dispatches to the three-stage
+    approx-warm-start -> SV-screening -> exact-dual-polish schedule
+    (docs/APPROX.md "Cascade") and returns an ordinary ``SVMModel``
+    whose decision function matches a full exact solve."""
     config = config or SVMConfig()
+    if config.solver == "cascade":
+        from dpsvm_tpu.solver.cascade import fit_cascade
+        return fit_cascade(x, y, config)
     if config.solver != "exact":
         from dpsvm_tpu.approx.primal import fit_approx
         return fit_approx(x, y, config)
@@ -214,9 +223,10 @@ def warm_start(x: np.ndarray, y: np.ndarray, alpha: np.ndarray,
     config.validate()
     if config.solver != "exact":
         raise ValueError("warm_start continues a DUAL trajectory from "
-                         "alpha; approx solvers have no dual — resume "
-                         "a primal run via checkpoint_path/resume_from "
-                         "instead")
+                         "alpha; approx solvers have no dual, and the "
+                         "cascade CALLS warm_start for its polish stage "
+                         "— pass solver='exact' (resume a primal run "
+                         "via checkpoint_path/resume_from instead)")
     if config.polish:
         raise ValueError("warm_start IS the refinement mechanism polish "
                          "is built from — call it with "

@@ -31,7 +31,10 @@ telemetry/resilience/serving gates. Asserts (1) the RFF kernel-
 approximation error bound on an embedded sample, and that it shrinks
 as approx_dim grows; (2) the jit-compile economy: a second identical
 training triggers ZERO new compiles (the chunk-runner builder is
-warm); (3) checkpoint/resume bitwise-identity of the final weights.
+warm); (3) checkpoint/resume bitwise-identity of the final weights;
+(4) the cascade gate (solver/cascade.py): screen -> polish -> zero
+remaining screened-out KKT violators, plus the bitwise
+stage-boundary kill->resume drill at every boundary.
 """
 
 from __future__ import annotations
@@ -173,6 +176,52 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
         if not np.array_equal(decision_function(model2, x[:32]),
                               decision_function(loaded, x[:32])):
             problems.append("save/load round trip changed decisions")
+
+        # 4. Cascade gate (solver/cascade.py, docs/APPROX.md
+        # "Cascade"): screen -> polish -> ZERO remaining screened-out
+        # KKT violators, then the stage-boundary kill->resume drill —
+        # a run killed right after each durable stage boundary must
+        # resume to a BITWISE-identical model.
+        from dpsvm_tpu.resilience import faultinject
+        from dpsvm_tpu.solver.cascade import (CascadeInterrupted,
+                                              fit_cascade)
+
+        xc, yc = make_blobs(n=320, d=8, seed=23)
+        casc_cfg = SVMConfig(solver="cascade", approx_dim=64,
+                             c=5.0, gamma=0.25, epsilon=1e-3,
+                             max_iter=100_000)
+        model_c, res_c = fit_cascade(xc, yc, casc_cfg)
+        if not res_c.converged or res_c.kkt_violators != 0:
+            problems.append(
+                f"cascade gate: converged={res_c.converged}, "
+                f"{res_c.kkt_violators} screened-out KKT violator(s) "
+                "after repair (expected a converged run with zero)")
+        if not (0 < res_c.n_kept <= 320):
+            problems.append(
+                f"cascade gate: implausible kept count {res_c.n_kept}")
+        prior_plan = faultinject.current()
+        try:
+            for stage in (1, 2, 3):
+                ck = os.path.join(base, f"casc_s{stage}.npz")
+                cfg_k = _dc.replace(casc_cfg, checkpoint_path=ck)
+                faultinject.install(faultinject.FaultPlan(
+                    cascade_stop_stage=stage))
+                try:
+                    fit_cascade(xc, yc, cfg_k)
+                    problems.append(
+                        f"cascade stage-{stage} kill point never fired")
+                except CascadeInterrupted:
+                    pass
+                faultinject.install(None)
+                model_r, _res_r = fit_cascade(xc, yc, cfg_k)
+                if not (np.array_equal(model_c.alpha, model_r.alpha)
+                        and np.array_equal(model_c.x_sv, model_r.x_sv)
+                        and model_c.b == model_r.b):
+                    problems.append(
+                        f"cascade stage-{stage} kill->resume is not "
+                        "bitwise-identical to the uninterrupted run")
+        finally:
+            faultinject.install(prior_plan)
     except Exception as e:                      # pragma: no cover
         problems.append(f"selfcheck crashed: {type(e).__name__}: {e}")
     finally:
@@ -200,5 +249,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     print("approx selfcheck OK (RFF error bound + monotone dim "
           "improvement, zero warm-path recompiles, bitwise "
-          "checkpoint/resume, save/load parity)")
+          "checkpoint/resume, save/load parity, cascade "
+          "screen->polish->zero-violators + bitwise stage-boundary "
+          "resume)")
     return 0

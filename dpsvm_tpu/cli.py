@@ -25,6 +25,7 @@ import sys
 from typing import List, Optional
 
 # stdlib-only modules — safe to import before the deferred jax imports.
+from dpsvm_tpu.config import SCREEN_MARGIN_DEFAULT
 from dpsvm_tpu.resilience.health import DivergenceError
 from dpsvm_tpu.resilience.preempt import PREEMPT_EXIT_CODE, PreemptedError
 
@@ -216,14 +217,35 @@ def build_parser() -> argparse.ArgumentParser:
                          "each OvO pair trains with C*W on that "
                          "label's examples; unlisted labels weigh 1")
     tr.add_argument("--solver", default="exact",
-                    choices=["exact", "approx-rff", "approx-nystrom"],
+                    choices=["exact", "approx-rff", "approx-nystrom",
+                             "cascade"],
                     help="'exact' = the dual SMO/decomposition paths "
                          "(reference parity). 'approx-rff'/'approx-"
                          "nystrom' = explicit feature map + primal "
                          "linear solver: O(n*D) matmul work instead of "
                          "O(n^2) kernel work — the million-row path; "
                          "the model file is a .npz with no support "
-                         "vectors (docs/APPROX.md)")
+                         "vectors (docs/APPROX.md). 'cascade' = approx "
+                         "warm-start -> margin-band SV screening -> "
+                         "exact dual polish on the screened subproblem "
+                         "with KKT re-admission repair: exact-quality "
+                         "decisions at a fraction of the exact cost, "
+                         "out-of-core capable (docs/APPROX.md "
+                         "\"Cascade\"); writes an ordinary SV model")
+    tr.add_argument("--screen-margin", type=float,
+                    default=SCREEN_MARGIN_DEFAULT,
+                    metavar="DELTA",
+                    help="cascade stage 2: margin-band safety delta — "
+                         "a row survives screening when its approx "
+                         "margin y*f(x) <= 1 + DELTA (bigger = safer "
+                         "band, bigger exact subproblem; the KKT "
+                         "repair loop re-admits anything the band "
+                         "missed)")
+    tr.add_argument("--screen-cap", type=int, default=0, metavar="N",
+                    help="cascade stage 2: hard cap on the screened "
+                         "subproblem's rows (0 = auto: derived from "
+                         "--mem-budget-mb when set, else uncapped); "
+                         "over-cap rows drop best-margin-first")
     tr.add_argument("--approx-dim", type=int, default=1024, metavar="D",
                     help="approx solvers: feature-map dimension "
                          "(accuracy-vs-cost knob; RFF needs it even)")
@@ -759,15 +781,37 @@ def _train_streaming(args: argparse.Namespace, config) -> int:
               "streaming shard training — the manifest fixes the "
               "shapes (re-convert to change them)", file=sys.stderr)
         return 2
+    if args.check_kkt:
+        print("error: --check-kkt recomputes the KKT residual over the "
+              "materialized training set; streaming shard training "
+              "never materializes it", file=sys.stderr)
+        return 2
     ds = ShardedDataset.open(args.input)
     task = "svr" if args.svr else "svc"
-    model, result = fit_approx_stream(
-        ds, config, task=task, allow_nonfinite=args.allow_nonfinite)
-    save_model(model, args.model)
-    print(f"Approx model: {model.model_kind} dim={model.fmap.dim} "
-          f"(no SV set; streamed from {ds.n_shards} shard(s)"
-          + (f", {len(ds.quarantined)} quarantined"
-             if ds.quarantined else "") + ")")
+    if config.solver == "cascade":
+        # Out-of-core cascade (docs/APPROX.md "Cascade"): approx
+        # warm-start + screening stream shard-by-shard; only the
+        # screened exact subproblem materializes (budget-guarded).
+        from dpsvm_tpu.solver.cascade import fit_cascade_stream
+        model, result = fit_cascade_stream(
+            ds, config, allow_nonfinite=args.allow_nonfinite)
+        n_sv = save_model(model, args.model)
+        print(f"Number of SVs: {n_sv}")
+        print(f"Cascade: screened {result.n_total} -> {result.n_kept} "
+              f"rows ({result.readmit_rounds} polish round(s), "
+              f"{result.n_readmitted} re-admitted, "
+              f"{result.kkt_violators} KKT violator(s); streamed from "
+              f"{ds.n_shards} shard(s)"
+              + (f", {len(ds.quarantined)} quarantined"
+                 if ds.quarantined else "") + ")")
+    else:
+        model, result = fit_approx_stream(
+            ds, config, task=task, allow_nonfinite=args.allow_nonfinite)
+        save_model(model, args.model)
+        print(f"Approx model: {model.model_kind} dim={model.fmap.dim} "
+              f"(no SV set; streamed from {ds.n_shards} shard(s)"
+              + (f", {len(ds.quarantined)} quarantined"
+                 if ds.quarantined else "") + ")")
     print(f"b: {result.b:.6f}")
     print(f"Training iterations: {result.n_iter}"
           + ("" if result.converged
@@ -825,20 +869,25 @@ def cmd_train(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     if args.solver != "exact":
-        # Approx-solver conflicts detectable from args alone (the
-        # config guard table rejects the solver-level ones).
+        # Approx/cascade-solver conflicts detectable from args alone
+        # (the config capability table rejects the solver-level ones).
+        # The cascade's outputs are ordinary SV models with full-length
+        # duals, so --check-kkt and --model-format libsvm stay valid
+        # there; the batched sweep programs stay dual-solver-only.
+        approx = args.solver.startswith("approx")
         for flag, on, hint in (
                 ("--c-sweep", args.c_sweep is not None,
                  " (the batched sweep is a dual-solver program)"),
                 ("--batched", args.batched,
                  " (the batched program solves the dual iteration)"),
-                ("--check-kkt", args.check_kkt,
+                ("--check-kkt", approx and args.check_kkt,
                  " (KKT/duality-gap reporting is dual-specific; the "
                  "primal path reports its gradient-norm metric in the "
-                 "run trace)"),
-                ("--model-format libsvm", args.model_format == "libsvm",
+                 "run trace — --solver cascade supports it)"),
+                ("--model-format libsvm",
+                 approx and args.model_format == "libsvm",
                  " (approx models persist as .npz — no SV lines to "
-                 "write)")):
+                 "write; --solver cascade writes ordinary SV models)")):
             if on:
                 print(f"error: {flag} does not apply to --solver "
                       f"{args.solver}{hint}", file=sys.stderr)
@@ -1002,8 +1051,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                      # one-class/nu duals live on equality constraints
                      # the primal squared-hinge objective does not have;
                      # approx SVC/SVR are the supported primal tasks
+                     # (the cascade's screening band is a
+                     # classification-margin rule: SVC only)
                      (f"--solver {args.solver}",
-                      args.solver != "exact" and mode != "--svr"),
+                      args.solver != "exact"
+                      and (mode != "--svr"
+                           or args.solver == "cascade")),
                      # nu-SVC multiclass supports --probability (sigmoid
                      # on training decisions); --probability-cv stays
                      # rejected (its held-out refits are C-SVC)
@@ -1090,6 +1143,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         solver=args.solver,
         approx_dim=args.approx_dim,
         approx_seed=args.approx_seed,
+        screen_margin=args.screen_margin,
+        screen_cap=args.screen_cap,
         mem_budget_mb=args.mem_budget_mb,
         on_bad_shard=args.on_bad_shard,
     )
@@ -1245,6 +1300,11 @@ def cmd_train(args: argparse.Namespace) -> int:
               "(no SV set)")
     else:
         print(f"Number of SVs: {n_sv}")
+    if hasattr(result, "n_kept"):
+        print(f"Cascade: screened {result.n_total} -> {result.n_kept} "
+              f"rows ({result.readmit_rounds} polish round(s), "
+              f"{result.n_readmitted} re-admitted, "
+              f"{result.kkt_violators} KKT violator(s))")
     print(f"b: {result.b:.6f}")
     print(f"Training iterations: {result.n_iter}"
           + ("" if result.converged else " (max-iter reached, NOT converged)"))

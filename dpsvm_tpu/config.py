@@ -35,6 +35,57 @@ from typing import Optional
 # (svmTrain.cu:59,66 use +/-1e9); kept identical for parity.
 SENTINEL = 1.0e9
 
+_SOLVERS = ("exact", "approx-rff", "approx-nystrom", "cascade")
+
+# Default cascade screening band (SVMConfig.screen_margin): one name
+# so the field default, the capability table's "is the knob set" test
+# and the cascade's stage sub-config resets can never drift apart.
+SCREEN_MARGIN_DEFAULT = 0.35
+
+# Per-solver knob capability table. One row per solver-path knob that
+# only SOME solver families implement: (field label, is-set predicate,
+# solvers that accept it, why the others reject it). validate() walks
+# it once, and a rejection names the solver(s) that WOULD accept the
+# knob — a misplaced flag is a redirect, not a dead end. The cascade
+# accepts BOTH families' knobs: its stage 1 is an approx primal train
+# (approx_dim/approx_seed), its stage 3 an exact dual polish
+# (selection/working_set/shrinking/... pass through to the subproblem
+# solve — solver/cascade.py).
+_DUAL = ("exact", "cascade")
+_CASCADE = ("cascade",)
+_KNOB_TABLE = (
+    ("backend", lambda c: c.backend == "numpy", ("exact",),
+     "the golden oracle is the dual SMO reference; the primal path "
+     "has its own convergence test and the cascade orchestrates "
+     "compiled stages"),
+    ("selection", lambda c: c.selection != "first-order", _DUAL,
+     "there is no working-set selection in the primal solver"),
+    ("select_impl", lambda c: c.select_impl != "argminmax", _DUAL,
+     "there is no extrema selection to lower"),
+    ("working_set", lambda c: c.working_set not in (0, 2), _DUAL,
+     "there is no dual working set; the minibatch size is chosen by "
+     "the primal solver"),
+    ("inner_iters", lambda c: bool(c.inner_iters), _DUAL,
+     "there is no decomposition subsolve"),
+    ("grow_working_set", lambda c: c.grow_working_set, _DUAL,
+     "there is no working set to grow"),
+    ("shrinking", lambda c: c.shrinking is True, _DUAL,
+     "there is no active set; every row rides the feature matmul"),
+    ("cache_size", lambda c: c.cache_size > 0, _DUAL,
+     "there are no kernel rows to cache"),
+    ("use_pallas", lambda c: c.use_pallas == "on", _DUAL,
+     "the Pallas kernels implement the dual iteration"),
+    ("polish", lambda c: c.polish, ("exact",),
+     "the two-phase precision schedule refines a dual trajectory — "
+     "and the cascade is itself a screen-and-polish schedule; set "
+     "matmul_precision directly"),
+    ("screen_margin",
+     lambda c: c.screen_margin != SCREEN_MARGIN_DEFAULT, _CASCADE,
+     "margin-band SV screening is the cascade's stage-2 knob"),
+    ("screen_cap", lambda c: c.screen_cap != 0, _CASCADE,
+     "the screened-subproblem row cap is the cascade's stage-2 knob"),
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class SVMConfig:
@@ -136,6 +187,16 @@ class SVMConfig:
                                         # Approx models have no support
                                         # vectors; api.fit returns an
                                         # ApproxSVMModel.
+                                        # "cascade" = approx warm-start ->
+                                        # margin-band SV screening -> exact
+                                        # dual polish on the screened
+                                        # subproblem + KKT re-admission
+                                        # repair (solver/cascade.py,
+                                        # docs/APPROX.md "Cascade"):
+                                        # exact-quality decisions at a
+                                        # fraction of the exact cost.
+                                        # api.fit returns an ordinary
+                                        # SVMModel.
     approx_dim: int = 1024              # feature-map dimension D (approx
                                         # solvers only): RFF uses D/2
                                         # frequency pairs (D must be even);
@@ -146,6 +207,24 @@ class SVMConfig:
                                         # deterministic in (seed, shape) —
                                         # persisted with the model so
                                         # serving rebuilds the identical map
+    screen_margin: float = SCREEN_MARGIN_DEFAULT
+                                        # cascade stage 2: the margin-band
+                                        # safety delta — a row survives
+                                        # screening when its CALIBRATED
+                                        # approx margin y*f(x) <= 1 +
+                                        # screen_margin (every confident
+                                        # non-SV is screened out; the KKT
+                                        # repair loop re-admits any the
+                                        # band missed). Bigger = safer
+                                        # band, bigger exact subproblem.
+    screen_cap: int = 0                 # cascade stage 2: hard cap on the
+                                        # screened subproblem's row count
+                                        # (0 = auto: derived from
+                                        # mem_budget_mb when set, else
+                                        # uncapped). Over-cap rows are
+                                        # dropped worst-margin-first, i.e.
+                                        # the rows most likely to be SVs
+                                        # are kept.
     select_impl: str = "argminmax"      # first-order selection lowering:
                                         # "argminmax" (two jnp.arg* +
                                         # gathers, XLA fuses) or "packed"
@@ -433,61 +512,73 @@ class SVMConfig:
                     "gather path")
         if self.kernel == "poly" and self.degree < 1:
             raise ValueError(f"poly degree must be >= 1, got {self.degree}")
-        if self.solver not in ("exact", "approx-rff", "approx-nystrom"):
-            raise ValueError("solver must be 'exact', 'approx-rff' or "
-                             f"'approx-nystrom', got {self.solver!r}")
+        if self.solver not in _SOLVERS:
+            raise ValueError(f"solver must be one of {_SOLVERS}, got "
+                             f"{self.solver!r}")
         if self.approx_dim < 2:
             raise ValueError(
                 f"approx_dim must be >= 2, got {self.approx_dim}")
+        # No-silent-ignore, per solver family (the select_impl /
+        # working_set policy): a knob only SOME solver paths implement
+        # is rejected by the others, and the error names the solver(s)
+        # that WOULD accept it (_KNOB_TABLE below).
+        for field, is_set, accepted, what in _KNOB_TABLE:
+            if self.solver not in accepted and is_set(self):
+                raise ValueError(
+                    f"solver={self.solver!r} does not support {field}: "
+                    f"{what} (accepted by solver "
+                    f"{', '.join(repr(s) for s in accepted)})")
         if self.solver != "exact":
-            if self.solver == "approx-rff":
-                if self.kernel != "rbf":
-                    raise ValueError(
-                        "approx-rff is the RBF spectral feature map "
-                        "(Rahimi-Recht); for other kernels use "
-                        "approx-nystrom or the exact solver")
-                if self.approx_dim % 2:
-                    raise ValueError(
-                        "approx-rff pairs cos/sin features, so "
-                        f"approx_dim must be even, got {self.approx_dim}")
+            if self.solver == "approx-rff" and self.kernel != "rbf":
+                raise ValueError(
+                    "approx-rff is the RBF spectral feature map "
+                    "(Rahimi-Recht); for other kernels use "
+                    "approx-nystrom or the exact solver")
+            if (self.approx_dim % 2
+                    and (self.solver == "approx-rff"
+                         or (self.solver == "cascade"
+                             and self.kernel == "rbf"))):
+                raise ValueError(
+                    "approx-rff pairs cos/sin features, so "
+                    f"approx_dim must be even, got {self.approx_dim}"
+                    + (" (the cascade's RBF warm-start stage is "
+                       "approx-rff)" if self.solver == "cascade" else ""))
             if self.kernel == "precomputed":
                 raise ValueError(
                     "approx solvers evaluate kernels between new rows "
                     "and landmarks/frequencies; a precomputed K has no "
-                    "row vectors to featurize")
-            # No-silent-ignore (the select_impl/working_set policy): the
-            # primal linear solver has no dual alpha step, so every
-            # dual-path knob below would be silently meaningless.
-            for field, bad, what in (
-                    ("backend", self.backend == "numpy",
-                     "the golden oracle is the dual SMO reference; the "
-                     "primal path has its own convergence test"),
-                    ("selection", self.selection != "first-order",
-                     "there is no working-set selection in the primal "
-                     "solver"),
-                    ("select_impl", self.select_impl != "argminmax",
-                     "there is no extrema selection to lower"),
-                    ("working_set", self.working_set not in (0, 2),
-                     "there is no dual working set; the minibatch size "
-                     "is chosen by the primal solver"),
-                    ("inner_iters", bool(self.inner_iters),
-                     "there is no decomposition subsolve"),
-                    ("grow_working_set", self.grow_working_set,
-                     "there is no working set to grow"),
-                    ("shrinking", self.shrinking is True,
-                     "there is no active set; every row rides the "
-                     "feature matmul"),
-                    ("cache_size", self.cache_size > 0,
-                     "there are no kernel rows to cache"),
-                    ("use_pallas", self.use_pallas == "on",
-                     "the Pallas kernels implement the dual iteration"),
-                    ("polish", self.polish,
-                     "the two-phase precision schedule refines a dual "
-                     "trajectory; set matmul_precision directly")):
-                if bad:
-                    raise ValueError(
-                        f"solver={self.solver!r} does not support "
-                        f"{field}: {what}")
+                    "row vectors to featurize"
+                    + (" (the cascade's warm-start stage is an approx "
+                       "train)" if self.solver == "cascade" else ""))
+        if self.solver == "cascade":
+            if not (math.isfinite(self.screen_margin)
+                    and self.screen_margin > 0):
+                raise ValueError("screen_margin must be finite and > 0, "
+                                 f"got {self.screen_margin}")
+            if self.screen_cap < 0:
+                raise ValueError(
+                    f"screen_cap must be >= 0, got {self.screen_cap}")
+            # Stage state lives UNDER checkpoint_path (stage-boundary
+            # files, auto-resumed — solver/cascade.py); the periodic /
+            # explicit-resume machinery is a single-trajectory contract
+            # the three-stage cascade does not have.
+            if self.resume_from:
+                raise ValueError(
+                    "cascade does not support resume_from: it "
+                    "auto-resumes from its stage-boundary state files "
+                    "under checkpoint_path (delete them to restart)")
+            if self.checkpoint_every:
+                raise ValueError(
+                    "cascade does not support checkpoint_every: stage "
+                    "boundaries are its checkpoint cadence — set "
+                    "checkpoint_path alone to name where stage state "
+                    "lives")
+            if self.profile_dir:
+                raise ValueError(
+                    "cascade does not support profile_dir: the "
+                    "auto-windowed capture profiles ONE chunk-runner "
+                    "steady state and the cascade is three runs — "
+                    "profile a stage's solver directly")
         if self.selection not in ("first-order", "second-order"):
             raise ValueError(f"selection must be 'first-order' or "
                              f"'second-order', got {self.selection!r}")
@@ -627,6 +718,12 @@ class SVMConfig:
             # active-set manager (same no-silent-ignore policy).
             # ("auto" is exempt: the resolver never picks shrinking
             # when a conflicting field is set, then re-validates.)
+            # For solver="cascade" the ORCHESTRATION fields (checkpoint
+            # /resume/profile/metrics/divergence) belong to the cascade
+            # driver and are stripped before the shrinking polish
+            # sub-run ever sees them — only the solver-level conflicts
+            # apply there.
+            cascade = self.solver == "cascade"
             for field, bad, what in (
                     ("backend", self.backend == "numpy",
                      "the golden oracle keeps the reference's full-set "
@@ -639,7 +736,8 @@ class SVMConfig:
                      "the 2-violator fused kernel hard-codes the "
                      "full-problem init (the decomposition's inner "
                      "kernel composes fine)"),
-                    ("checkpoint_path", bool(self.checkpoint_path),
+                    ("checkpoint_path",
+                     bool(self.checkpoint_path) and not cascade,
                      "checkpoint/resume does not capture active-set "
                      "state"),
                     ("resume_from", bool(self.resume_from),
@@ -649,15 +747,17 @@ class SVMConfig:
                      "the shrinking loop manages its own dispatch; "
                      "profile the unshrunk path"),
                     ("metrics_port/metrics_out",
-                     self.metrics_port is not None
-                     or bool(self.metrics_out),
+                     (self.metrics_port is not None
+                      or bool(self.metrics_out)) and not cascade,
                      "the shrinking loop manages its own dispatch; "
                      "the metrics exporters ride the shared host "
                      "driver"),
-                    ("on_divergence", self.on_divergence != "raise",
+                    ("on_divergence",
+                     self.on_divergence != "raise" and not cascade,
                      "the shrinking loop manages its own dispatch; "
                      "divergence guards ride the shared host driver"),
-                    ("health_window", bool(self.health_window),
+                    ("health_window",
+                     bool(self.health_window) and not cascade,
                      "the shrinking loop manages its own dispatch; "
                      "divergence guards ride the shared host driver")):
                 if bad:

@@ -481,6 +481,14 @@ def stream_peak_bytes(rows_per_shard: int, d: int,
     return rows_per_shard * (d + feat_dim) * 4 + rows_per_shard * 8
 
 
+def budget_admit_rows(budget_mb: float, d: int) -> int:
+    """How many d-wide rows a ``budget_mb`` materialization admits —
+    the inverse of ``materialize_bytes``, shared by the refusal math
+    below and the cascade's auto screen-cap (solver/cascade.py: the
+    screened subproblem must be a materialization that fits)."""
+    return max(int(budget_mb * 1024 * 1024 / (d * 4 + 4)), 1)
+
+
 def check_materialize_budget(budget_mb: Optional[float], *, n: int,
                              d: int, what: str = "dataset") -> None:
     """Refuse a full materialization that cannot fit ``budget_mb`` —
@@ -490,7 +498,7 @@ def check_materialize_budget(budget_mb: Optional[float], *, n: int,
     need = materialize_bytes(n, d)
     if _mb(need) <= float(budget_mb):
         return
-    admits = max(int(budget_mb * 1024 * 1024 / (d * 4 + 4)), 1)
+    admits = budget_admit_rows(budget_mb, d)
     rps = max(min(DEFAULT_ROWS_PER_SHARD, admits // 4), 1)
     n_shards = -(-n // rps)
     raise MemBudgetError(
@@ -498,8 +506,9 @@ def check_materialize_budget(budget_mb: Optional[float], *, n: int,
         f"{_fmt_mb(need)} but --mem-budget-mb {budget_mb:g} admits "
         f"~{admits} rows. Stream it instead: `dpsvm convert shards SRC "
         f"DIR --rows-per-shard {rps}` -> {n_shards} shards "
-        f"(ceil({n}/{rps})), then train --solver approx-rff on the "
-        f"shard directory (per-shard peak "
+        f"(ceil({n}/{rps})), then train --solver approx-rff (or "
+        f"--solver cascade for exact-quality decisions) on the shard "
+        f"directory (per-shard peak "
         f"~{_fmt_mb(stream_peak_bytes(rps, d))})")
 
 

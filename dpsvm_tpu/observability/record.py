@@ -104,6 +104,18 @@ DIST_EVENTS = ("desync", "shard_lost", "reshard")
 # n_iter baseline stands).
 INGEST_EVENTS = ("quarantine", "ingest_resume")
 
+# Event types the cascade solver emits into its run trace
+# (solver/cascade.py, docs/APPROX.md "Cascade"): `screen` = stage-2
+# margin-band selection done (carries `n_kept`/`n_total` — the
+# subproblem split), `polish` = one exact warm-started solve of the
+# kept subproblem finished (carries `round`/`n_kept`), `readmit` =
+# the KKT verify of the screened-out rows found violators and grew
+# the kept set (carries `round`/`n_readmitted`), `cascade_resume` =
+# the run picked up from a durable stage-boundary state file. The
+# schema validator enforces the stage ordering
+# (observability/schema.py EVENT_EXTRA_KEYS comment).
+CASCADE_EVENTS = ("screen", "polish", "readmit", "cascade_resume")
+
 
 def open_serving_trace(path: str, *, models: Optional[dict] = None,
                        env: Optional[dict] = None) -> "RunTrace":

@@ -299,6 +299,21 @@ def render_report(records: List[dict], width: int = 60) -> str:
     if compiles:
         out.append("compile events: " + ", ".join(
             f"{c['program']}@{c['seconds']:.2f}s" for c in compiles))
+    screens = [e for e in events if e.get("event") == "screen"]
+    if screens:
+        # Cascade stage split (solver/cascade.py): the LAST screen
+        # event carries the final subproblem size; polish/readmit
+        # events carry the repair history.
+        sc = screens[-1]
+        polishes = [e for e in events if e.get("event") == "polish"]
+        readmits = [e for e in events if e.get("event") == "readmit"]
+        readmitted = sum(int(e.get("n_readmitted", 0) or 0)
+                         for e in readmits)
+        out.append(f"cascade: screened {sc.get('n_total', 0):,} -> "
+                   f"{sc.get('n_kept', 0):,} rows; "
+                   f"{len(polishes)} polish round(s), "
+                   f"{readmitted:,} re-admitted — see docs/APPROX.md "
+                   "\"Cascade\"")
     quarantines = [e for e in events if e.get("event") == "quarantine"]
     if quarantines:
         rows = sum(int(e.get("rows", 0) or 0) for e in quarantines)

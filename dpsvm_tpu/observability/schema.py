@@ -78,7 +78,7 @@ TERMINAL_EVENTS = ("stall", "preempt")
 # checkpoint's iteration restarts the count on the new mesh).
 REWIND_EVENTS = ("rollback", "reshard")
 
-# Required extra keys per elastic/ingest event type (beyond
+# Required extra keys per elastic/ingest/cascade event type (beyond
 # EVENT_KEYS): a `desync` without its mesh size, a `reshard` without
 # both mesh sizes, or a `quarantine` without the shard and reason is
 # useless to every consumer, so the validator rejects them. Note the
@@ -87,10 +87,22 @@ REWIND_EVENTS = ("rollback", "reshard")
 # from a checkpoint) REWINDS NOTHING — unlike rollback/reshard it is
 # deliberately absent from REWIND_EVENTS, so a chunk record whose
 # n_iter regresses after one is still trace corruption.
+#
+# Cascade events (solver/cascade.py, docs/APPROX.md "Cascade"):
+# `screen` carries the kept/total row split, `polish` the repair-round
+# index and subproblem size, `readmit` the round index and how many
+# KKT violators were re-admitted. Ordering is part of the schema
+# (validate_trace): `polish`/`readmit` may only follow a `screen`,
+# `readmit` only a `polish`, and readmit round indices never decrease
+# — a trace violating any of these was written by a broken (or
+# interleaved) producer.
 EVENT_EXTRA_KEYS = {
     "desync": ("shards",),
     "reshard": ("from_shards", "to_shards"),
     "quarantine": ("shard", "reason"),
+    "screen": ("n_kept", "n_total"),
+    "polish": ("round", "n_kept"),
+    "readmit": ("round", "n_readmitted"),
 }
 
 
@@ -157,7 +169,9 @@ def validate_trace(records: List[dict]) -> List[str]:
     legitimately rewinds the run to its checkpoint's iteration
     (docs/ROBUSTNESS.md), so it resets the n_iter monotonicity
     baseline; nothing resets the ``t`` baseline — a time rewind means
-    interleaved writers."""
+    interleaved writers. Cascade stage events are ordered (see
+    EVENT_EXTRA_KEYS): ``polish`` only after ``screen``, ``readmit``
+    only after ``polish``, readmit rounds non-decreasing."""
     errors: List[str] = []
     if not records:
         return ["empty trace (no records)"]
@@ -187,6 +201,9 @@ def validate_trace(records: List[dict]) -> List[str]:
     prev_iter = None
     prev_t = None
     summary_at = None
+    saw_screen = False
+    saw_polish = False
+    prev_readmit_round = None
     for i, r in enumerate(records):
         if not isinstance(r, dict):
             continue
@@ -224,6 +241,27 @@ def validate_trace(records: List[dict]) -> List[str]:
                 # The run restarted from a checkpoint at this iteration
                 # (rollback), possibly on a different mesh (reshard).
                 prev_iter = r["n_iter"]
+            elif r.get("event") == "screen":
+                saw_screen = True
+            elif r.get("event") == "polish":
+                if not saw_screen:
+                    errors.append(f"record {i}: polish event before "
+                                  "any screen event (cascade stages "
+                                  "are ordered)")
+                saw_polish = True
+            elif r.get("event") == "readmit":
+                if not saw_polish:
+                    errors.append(f"record {i}: readmit event before "
+                                  "any polish event (re-admission "
+                                  "repairs a polished model)")
+                rnd = r["round"]
+                if (prev_readmit_round is not None
+                        and rnd < prev_readmit_round):
+                    errors.append(
+                        f"record {i}: readmit round {rnd} < previous "
+                        f"{prev_readmit_round} (rounds must not "
+                        "decrease)")
+                prev_readmit_round = rnd
         elif kind == "compile":
             miss = _missing(r, COMPILE_KEYS)
             if miss:

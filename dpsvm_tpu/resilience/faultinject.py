@@ -80,6 +80,21 @@ Serving-side knobs (``DPSVM_FAULT_SERVE_*``, consumed by
   reload/rebuild in this process fails (exercises
   failed-reload-keeps-serving and the rebuild retry loop).
 
+Cascade / bench-infra knobs (``solver/cascade.py``, ``bench_common.py``
+— docs/APPROX.md "Cascade"):
+
+* ``DPSVM_FAULT_CASCADE_STOP_STAGE=k`` — the cascade raises
+  ``CascadeInterrupted`` right after its stage-#k boundary state is
+  durable on disk (1 = approx warm-start, 2 = screening, 3 = the
+  first polish round): the kill->resume drill's deterministic kill
+  point — re-running the same command must land a bitwise-identical
+  model;
+* ``DPSVM_FAULT_PREFLIGHT_WEDGE_S=t`` — the bench doctor preflight's
+  device probe hangs ``t`` seconds (the dead-TPU-tunnel model): with
+  ``t`` past the doctor deadline, bench.py / the burst runner must
+  exit with a clear ``"degraded": true`` verdict row instead of
+  burning the round.
+
 Each fault fires exactly ONCE per process: counters live on the
 process-global plan, so a supervisor retry inside the same process (or
 a resumed attempt) runs clean after the injected failure — which is
@@ -133,6 +148,18 @@ class FaultPlan:
     #                                  (every read — persistent rot)
     io_truncate_shard: int = 0       # shard #k reads half its bytes
     io_slow_read_ms: int = 0         # every shard read sleeps this
+    # cascade / bench-infra knobs (solver/cascade.py, bench_common.py)
+    cascade_stop_stage: int = 0      # kill the cascade right after the
+    #                                  stage-#k boundary state is
+    #                                  durable (1=approx, 2=screen,
+    #                                  3=first polish round): the
+    #                                  kill->resume drill's
+    #                                  deterministic kill point
+    preflight_wedge_s: int = 0       # the bench doctor preflight's
+    #                                  device probe hangs this many
+    #                                  seconds (simulated dead TPU
+    #                                  tunnel; > the doctor deadline =
+    #                                  a degraded verdict row)
 
     # process-lifetime counters (fire-once semantics)
     _writes: int = 0
@@ -148,6 +175,7 @@ class FaultPlan:
     _slow_probe: Optional[tuple] = None   # frozen probe row replayed
     _io_reads: int = 0
     _io_fail_fired: bool = False
+    _cascade_fired: bool = False
 
     def any(self) -> bool:
         return bool(self.fail_checkpoint_write or self.nan_at_iter
@@ -156,7 +184,21 @@ class FaultPlan:
                     or self.dist_kill_shard or self.dist_desync_at
                     or self.dist_slow_shard or self.io_read_fail_once
                     or self.io_corrupt_shard or self.io_truncate_shard
-                    or self.io_slow_read_ms)
+                    or self.io_slow_read_ms or self.cascade_stop_stage
+                    or self.preflight_wedge_s)
+
+    def cascade_stop_now(self, stage: int) -> bool:
+        """True exactly once, when the cascade has made the stage-#k
+        boundary state durable (k = ``cascade_stop_stage``) — the
+        orchestrator then raises ``CascadeInterrupted``, and the
+        kill->resume drill re-runs the same command to prove the
+        resumed model is bitwise-identical (solver/cascade.py)."""
+        if (self.cascade_stop_stage and not self._cascade_fired
+                and stage >= self.cascade_stop_stage):
+            self._cascade_fired = True
+            _log(f"stopping cascade after stage-{stage} boundary")
+            return True
+        return False
 
     def note_checkpoint_write(self, path: str) -> None:
         self._writes += 1
@@ -348,7 +390,9 @@ def plan_from_env() -> Optional[FaultPlan]:
         io_read_fail_once=_env_int("IO_READ_FAIL_ONCE"),
         io_corrupt_shard=_env_int("IO_CORRUPT_SHARD"),
         io_truncate_shard=_env_int("IO_TRUNCATE_SHARD"),
-        io_slow_read_ms=_env_int("IO_SLOW_READ_MS"))
+        io_slow_read_ms=_env_int("IO_SLOW_READ_MS"),
+        cascade_stop_stage=_env_int("CASCADE_STOP_STAGE"),
+        preflight_wedge_s=_env_int("PREFLIGHT_WEDGE_S"))
     return p if p.any() else None
 
 

@@ -1,0 +1,444 @@
+"""Cascade solver (solver/cascade.py, docs/APPROX.md "Cascade"):
+approx warm-start -> calibrated SV screening -> exact dual polish with
+KKT re-admission repair.
+
+The bars under test, in the ISSUE's words:
+
+* cascade-vs-exact agreement — decision-function parity with the full
+  exact solve plus ZERO screened-out KKT violators after repair;
+* a planted adversarial case where the margin band misses true SVs
+  and the re-admission loop must recover them;
+* bitwise kill->resume at each cascade stage boundary;
+* shard-by-shard screening on a shard-directory dataset whose FULL
+  problem exceeds --mem-budget-mb (only the screened subproblem
+  materializes), with the budget check naming the size that fits;
+* the per-solver knob capability table (config.py) that lets the
+  cascade accept both solver families' knobs and points a rejected
+  knob at the solver that would accept it;
+* the screen/polish/readmit trace vocabulary + ordering rules;
+* the bench doctor preflight degrading to a clear verdict row under a
+  simulated hung backend, within the deadline.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import fit
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.models.svm import decision_function
+from dpsvm_tpu.resilience import faultinject
+from dpsvm_tpu.solver.cascade import (CascadeInterrupted,
+                                      CascadeStateError, fit_cascade)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KW = dict(c=5.0, gamma=1.0 / 16, epsilon=1e-3, max_iter=200_000)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    return make_blobs(n=800, d=16, seed=3)
+
+
+@pytest.fixture(scope="module")
+def exact_fit(blobs):
+    x, y = blobs
+    return fit(x, y, SVMConfig(**KW))
+
+
+@pytest.fixture(scope="module")
+def cascade_fit(blobs):
+    x, y = blobs
+    return fit(x, y, SVMConfig(solver="cascade", approx_dim=256, **KW))
+
+
+# ---------------------------------------------------------------------
+# agreement with the full exact solve
+# ---------------------------------------------------------------------
+
+def test_cascade_matches_exact(blobs, exact_fit, cascade_fit):
+    """The headline bar: decisions match the full exact solve at the
+    eps-KKT level (both runs stop inside the same 2-eps-flat region,
+    so the comparison tolerance is flatness-scale, not bitwise), with
+    identical predictions and the zero-violator certificate."""
+    x, y = blobs
+    m_e, _ = exact_fit
+    m_c, r_c = cascade_fit
+    assert r_c.converged
+    assert r_c.kkt_violators == 0
+    de = decision_function(m_e, x)
+    dc = decision_function(m_c, x)
+    assert float(np.max(np.abs(de - dc))) < 0.1
+    assert np.array_equal(np.sign(de), np.sign(dc))
+    # The exact SV set is recovered up to eps-flat boundary wobble.
+    assert abs(m_c.n_sv - m_e.n_sv) <= max(5, 0.05 * m_e.n_sv)
+
+
+def test_cascade_result_shape_and_model_kind(blobs, cascade_fit):
+    """An ordinary SVMModel + a full-length dual vector: --check-kkt
+    and SVMModel.from_train_result consume the cascade result like
+    any exact one (alpha is scattered; screened-out rows hold 0)."""
+    x, y = blobs
+    m_c, r_c = cascade_fit
+    assert not getattr(m_c, "is_approx", False)
+    assert r_c.alpha.shape == (x.shape[0],)
+    assert int(np.sum(r_c.alpha > 0)) == m_c.n_sv
+    assert 0 < r_c.n_kept < r_c.n_total == x.shape[0]
+    # Screened-out rows carry exactly zero dual mass.
+    kept = np.zeros(x.shape[0], bool)
+    kept[r_c._kept_idx] = True
+    assert not np.any(r_c.alpha[~kept] > 0)
+
+
+def test_cascade_kkt_residual_matches_exact_class(blobs, exact_fit,
+                                                  cascade_fit):
+    """The recomputed full-problem KKT residual of the cascade's
+    scattered duals sits in the same 2-eps class as the exact run's —
+    the '--check-kkt works' property in library form."""
+    from dpsvm_tpu.ops.diagnostics import kkt_violation
+    x, y = blobs
+    _, r_e = exact_fit
+    _, r_c = cascade_fit
+    resid_c = kkt_violation(x, y, r_c.alpha, KW["gamma"], KW["c"])
+    resid_e = kkt_violation(x, y, r_e.alpha, KW["gamma"], KW["c"])
+    assert resid_c <= max(2.0 * KW["epsilon"] + 5e-4, resid_e + 1e-3)
+
+
+# ---------------------------------------------------------------------
+# adversarial screening -> the re-admission loop must recover
+# ---------------------------------------------------------------------
+
+def test_readmission_recovers_missed_svs(blobs, exact_fit):
+    """Planted adversarial case: a crude approx map (D=8) plus a
+    near-zero safety margin make the band miss true SVs; the KKT
+    verify must re-admit them and the repaired result must still
+    match the exact solve."""
+    x, y = blobs
+    m_e, _ = exact_fit
+    cfg = SVMConfig(solver="cascade", approx_dim=8,
+                    screen_margin=1e-3, **KW)
+    m_c, r_c = fit(x, y, cfg)
+    assert r_c.n_readmitted > 0          # the band provably missed SVs
+    assert r_c.readmit_rounds >= 2       # ...and repair actually ran
+    assert r_c.kkt_violators == 0
+    assert r_c.converged
+    de = decision_function(m_e, x)
+    dc = decision_function(m_c, x)
+    assert float(np.max(np.abs(de - dc))) < 0.1
+    assert np.array_equal(np.sign(de), np.sign(dc))
+
+
+# ---------------------------------------------------------------------
+# stage-boundary kill -> bitwise resume
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_stage_boundary_kill_resume_bitwise(blobs, cascade_fit, stage,
+                                            tmp_path):
+    """DPSVM_FAULT_CASCADE_STOP_STAGE=k kills the run right after the
+    stage-k boundary state is durable; re-running the same command
+    must resume there and land a model bitwise-identical to the
+    uninterrupted run's (stage files are cleaned on success)."""
+    x, y = blobs
+    m_ref, _ = cascade_fit
+    ck = str(tmp_path / "state.npz")
+    cfg = SVMConfig(solver="cascade", approx_dim=256,
+                    checkpoint_path=ck, **KW)
+    faultinject.install(faultinject.FaultPlan(cascade_stop_stage=stage))
+    try:
+        with pytest.raises(CascadeInterrupted):
+            fit(x, y, cfg)
+    finally:
+        faultinject.install(None)
+        faultinject.clear()
+    assert os.path.exists(ck + ".cascade.npz")
+    m_res, r_res = fit(x, y, cfg)
+    assert np.array_equal(m_ref.alpha, m_res.alpha)
+    assert np.array_equal(m_ref.x_sv, m_res.x_sv)
+    assert m_ref.b == m_res.b
+    assert not os.path.exists(ck + ".cascade.npz")   # cleaned
+
+
+def test_stale_stage_state_is_rejected(blobs, tmp_path):
+    """Stage state written for a different config must raise a clear
+    mismatch error, never silently resume the wrong problem."""
+    x, y = blobs
+    ck = str(tmp_path / "state.npz")
+    cfg = SVMConfig(solver="cascade", approx_dim=256,
+                    checkpoint_path=ck, **KW)
+    faultinject.install(faultinject.FaultPlan(cascade_stop_stage=1))
+    try:
+        with pytest.raises(CascadeInterrupted):
+            fit(x, y, cfg)
+    finally:
+        faultinject.install(None)
+        faultinject.clear()
+    other = dataclasses.replace(cfg, c=9.0)
+    with pytest.raises(CascadeStateError, match="stale"):
+        fit(x, y, other)
+
+
+# ---------------------------------------------------------------------
+# out-of-core: shard-by-shard screening under a memory budget
+# ---------------------------------------------------------------------
+
+def test_stream_cascade_screens_under_budget(tmp_path, capsys):
+    """The acceptance drill: a shard-directory dataset whose FULL
+    problem exceeds --mem-budget-mb trains via the cascade (approx +
+    screening stream shard-by-shard; only the screened subproblem
+    materializes), and the budget check names the screened size that
+    fits. The result matches the exact solve of the materialized
+    data."""
+    from dpsvm_tpu.data import stream as streamlib
+    from dpsvm_tpu.solver.cascade import fit_cascade_stream
+
+    x, y = make_blobs(n=4000, d=24, seed=7)
+    csv = tmp_path / "data.csv"
+    with open(csv, "w") as fh:
+        for yi, xi in zip(y, x):
+            fh.write(f"{int(yi)},"
+                     + ",".join(f"{v:.7g}" for v in xi) + "\n")
+    shards = str(tmp_path / "shards")
+    streamlib.convert_to_shards(str(csv), shards, rows_per_shard=256)
+    ds = streamlib.ShardedDataset.open(shards)
+    budget = 0.3                       # MiB; the full (x, y) needs ~0.38
+    with pytest.raises(streamlib.MemBudgetError):
+        ds.materialize(mem_budget_mb=budget)
+    cfg = SVMConfig(solver="cascade", approx_dim=128, c=5.0,
+                    gamma=1.0 / 24, epsilon=1e-3, max_iter=200_000,
+                    mem_budget_mb=budget)
+    model, res = fit_cascade_stream(ds, cfg)
+    assert res.converged and res.kkt_violators == 0
+    assert res.n_kept < res.n_total == 4000
+    # The kept subproblem respects the budget the full problem broke.
+    assert streamlib.materialize_bytes(res.n_kept, 24) \
+        <= budget * 1024 * 1024
+    err = capsys.readouterr().err
+    assert "screened subproblem" in err and "fits --mem-budget-mb" in err
+    m_e, _ = fit(x, y, SVMConfig(c=5.0, gamma=1.0 / 24, epsilon=1e-3,
+                                 max_iter=200_000))
+    de = decision_function(m_e, x)
+    dc = decision_function(model, x)
+    agree = float(np.mean(np.sign(de) == np.sign(dc)))
+    assert agree >= 0.999
+    assert float(np.max(np.abs(de - dc))) < 0.25
+
+
+def test_screen_cap_bounds_subproblem(blobs):
+    """An explicit screen_cap must bound the kept set, dropping
+    best-margin rows first (the cap keeps the likeliest SVs)."""
+    x, y = blobs
+    cfg = SVMConfig(solver="cascade", approx_dim=256, screen_cap=300,
+                    **KW)
+    m_c, r_c = fit(x, y, cfg)
+    # Repair may re-admit past the cap — the cap bounds SCREENING, the
+    # exactness loop may legitimately grow it back.
+    assert r_c.n_kept <= 300 + r_c.n_readmitted
+    assert r_c.kkt_violators == 0
+
+
+# ---------------------------------------------------------------------
+# config capability table
+# ---------------------------------------------------------------------
+
+def test_capability_table_redirects_to_accepting_solver():
+    """A rejected knob's error names the solver(s) that WOULD accept
+    it — the table's whole point."""
+    with pytest.raises(ValueError, match="cascade"):
+        SVMConfig(solver="approx-rff", working_set=64).validate()
+    with pytest.raises(ValueError, match="cascade"):
+        SVMConfig(solver="exact", screen_margin=0.7).validate()
+    with pytest.raises(ValueError, match="exact"):
+        SVMConfig(solver="cascade", polish=True).validate()
+    with pytest.raises(ValueError, match="exact"):
+        SVMConfig(solver="approx-nystrom", cache_size=4).validate()
+
+
+def test_cascade_accepts_both_knob_families():
+    """The cascade's stage 1 is an approx train, its stage 3 an exact
+    dual polish — knobs of BOTH families must validate."""
+    SVMConfig(solver="cascade", approx_dim=64, approx_seed=7,
+              selection="second-order", shrinking=True,
+              screen_margin=0.2, screen_cap=1000).validate()
+    SVMConfig(solver="cascade", working_set=64, inner_iters=8).validate()
+
+
+def test_cascade_specific_rejections():
+    for kw, frag in (
+            (dict(solver="cascade", kernel="precomputed"), "featurize"),
+            (dict(solver="cascade", approx_dim=65), "even"),
+            (dict(solver="cascade", screen_margin=-1.0), "screen_margin"),
+            (dict(solver="cascade", screen_cap=-2), "screen_cap"),
+            (dict(solver="cascade", resume_from="x.npz"), "stage"),
+            (dict(solver="cascade", checkpoint_path="x.npz",
+                  checkpoint_every=10), "cadence"),
+            (dict(solver="cascade", profile_dir="/tmp/p"), "profile"),
+            (dict(solver="cascade", backend="numpy"), "backend")):
+        with pytest.raises(ValueError, match=frag):
+            SVMConfig(**kw).validate()
+
+
+def test_train_and_warm_start_reject_cascade(blobs):
+    from dpsvm_tpu.api import train, warm_start
+    x, y = blobs
+    with pytest.raises(ValueError, match="api.fit"):
+        train(x, y, SVMConfig(solver="cascade"))
+    with pytest.raises(ValueError, match="polish stage"):
+        warm_start(x, y, np.zeros(len(y)), SVMConfig(solver="cascade"))
+
+
+# ---------------------------------------------------------------------
+# trace schema: events, ordering, report rendering
+# ---------------------------------------------------------------------
+
+def test_cascade_trace_schema_and_report(blobs, tmp_path):
+    from dpsvm_tpu.observability.report import render_report
+    from dpsvm_tpu.observability.schema import read_trace, validate_trace
+
+    x, y = blobs
+    tp = str(tmp_path / "cascade.jsonl")
+    cfg = SVMConfig(solver="cascade", approx_dim=8, screen_margin=1e-3,
+                    trace_out=tp, **KW)
+    fit(x, y, cfg)                       # adversarial: forces readmits
+    recs = read_trace(tp)
+    assert validate_trace(recs) == []
+    events = [r["event"] for r in recs if r.get("kind") == "event"]
+    assert "screen" in events and "polish" in events
+    assert "readmit" in events
+    sc = next(r for r in recs if r.get("event") == "screen")
+    assert sc["n_kept"] > 0 and sc["n_total"] == len(y)
+    summary = next(r for r in recs if r.get("kind") == "summary")
+    assert set(summary["phases"]) >= {"approx", "screen", "polish",
+                                      "verify"}
+    rep = render_report(recs)
+    assert "cascade: screened" in rep
+
+
+def test_trace_ordering_rules_reject_bad_producers():
+    """The schema's cascade ordering contract: polish before screen,
+    readmit before polish, and decreasing readmit rounds are all
+    trace corruption."""
+    from dpsvm_tpu.observability.schema import validate_trace
+
+    def trace_with(events):
+        recs = [{"kind": "manifest", "schema": 2, "version": "t",
+                 "solver": "cascade", "n": 1, "d": 1, "gamma": 1.0,
+                 "kernel": {}, "mesh": {}, "env": {}, "config": {},
+                 "it0": 0, "time": "t"}]
+        t = 0.0
+        for ev, extra in events:
+            t += 1.0
+            recs.append({"kind": "event", "event": ev, "n_iter": 0,
+                         "t": t, **extra})
+        return recs
+
+    ok = trace_with([
+        ("screen", {"n_kept": 5, "n_total": 9}),
+        ("polish", {"round": 1, "n_kept": 5}),
+        ("readmit", {"round": 1, "n_readmitted": 2}),
+        ("polish", {"round": 2, "n_kept": 7}),
+        ("readmit", {"round": 2, "n_readmitted": 1})])
+    assert validate_trace(ok) == []
+    bad = validate_trace(trace_with([("polish", {"round": 1,
+                                                 "n_kept": 5})]))
+    assert any("before any screen" in e for e in bad)
+    bad = validate_trace(trace_with([
+        ("screen", {"n_kept": 5, "n_total": 9}),
+        ("readmit", {"round": 1, "n_readmitted": 2})]))
+    assert any("before any polish" in e for e in bad)
+    bad = validate_trace(trace_with([
+        ("screen", {"n_kept": 5, "n_total": 9}),
+        ("polish", {"round": 1, "n_kept": 5}),
+        ("readmit", {"round": 2, "n_readmitted": 2}),
+        ("readmit", {"round": 1, "n_readmitted": 1})]))
+    assert any("must not decrease" in e for e in bad)
+    bad = validate_trace(trace_with([("screen", {"n_total": 9})]))
+    assert any("missing keys" in e and "n_kept" in e for e in bad)
+
+
+# ---------------------------------------------------------------------
+# CLI end to end
+# ---------------------------------------------------------------------
+
+def test_cli_cascade_train_and_test(tmp_path):
+    x, y = make_blobs(n=400, d=8, seed=5)
+    csv = tmp_path / "train.csv"
+    with open(csv, "w") as fh:
+        for yi, xi in zip(y, x):
+            fh.write(f"{int(yi)},"
+                     + ",".join(f"{v:.7g}" for v in xi) + "\n")
+    model = str(tmp_path / "model.svm")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DPSVM_PERF_LEDGER="")
+    p = subprocess.run(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "train", "-f",
+         str(csv), "-m", model, "--solver", "cascade",
+         "--approx-dim", "64", "--screen-margin", "0.3",
+         "-c", "5", "-g", "0.125", "-q"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr
+    assert "Cascade: screened" in p.stdout
+    assert "Number of SVs:" in p.stdout
+    p2 = subprocess.run(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "test", "-f",
+         str(csv), "-m", model],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    assert p2.returncode == 0, p2.stderr
+    assert "Accuracy" in p2.stdout or "accuracy" in p2.stdout
+
+
+def test_cli_rejects_cascade_mode_conflicts():
+    from dpsvm_tpu.cli import main
+    rc = main(["train", "-f", "x.csv", "-m", "m", "--solver",
+               "cascade", "--svr"])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------
+# bench preflight drill
+# ---------------------------------------------------------------------
+
+def test_bench_preflight_degrades_on_wedged_backend(tmp_path):
+    """The acceptance drill: with a simulated hung backend (the
+    PREFLIGHT_WEDGE fault hook), a bench round exits with a clear
+    degraded verdict within the doctor deadline instead of hanging."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DPSVM_PERF_LEDGER="",
+               BENCH_FAULT_PREFLIGHT_WEDGE_S="60",
+               BENCH_DOCTOR_TIMEOUT="2")
+    p = subprocess.run([sys.executable, "bench.py"], cwd=ROOT, env=env,
+                       capture_output=True, text=True, timeout=90)
+    assert p.returncode == 3
+    row = json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["degraded"] is True
+    assert "TIMED OUT" in row["verdict"]
+
+
+def test_burst_runner_preflight_degrades(tmp_path):
+    """Same drill through the burst runner: the round aborts with ONE
+    degraded verdict row in the results ledger and rc=3, backlog
+    preserved."""
+    results = tmp_path / "results.jsonl"
+    tags = [{"tag": "dummy", "file": str(results), "budget": 30,
+             "kind": "sub", "cmd": [sys.executable, "-c", "print(1)"],
+             "env": {}}]
+    tags_file = tmp_path / "tags.json"
+    tags_file.write_text(json.dumps(tags))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DPSVM_PERF_LEDGER="",
+               BURST_TAGS_JSON=str(tags_file),
+               BURST_PENDING=str(tmp_path / "pending.json"),
+               BENCH_FAULT_PREFLIGHT_WEDGE_S="60",
+               BENCH_DOCTOR_TIMEOUT="2")
+    p = subprocess.run([sys.executable, "benchmarks/burst_runner.py"],
+                       cwd=ROOT, env=env, capture_output=True,
+                       text=True, timeout=120)
+    assert p.returncode == 3
+    rows = [json.loads(ln) for ln in
+            results.read_text().strip().splitlines()]
+    assert rows and rows[-1]["tag"] == "preflight"
+    assert rows[-1]["degraded"] is True
