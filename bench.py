@@ -349,6 +349,16 @@ def main() -> None:
     compile_seconds = round(sum(c["seconds"] for c in compiles), 3)
     est_flops = next((c["flops"] for c in compiles
                       if c["flops"] is not None), None)
+    est_bytes = next((c.get("bytes") for c in compiles
+                      if c.get("bytes") is not None), None)
+    # Roofline column (observability/roofline.py): achieved/peak
+    # FLOP/s against the per-chip peak table — null on CPU/unknown
+    # hardware, a gateable fraction on the chip (`dpsvm perf gate
+    # --metric roofline_fraction`).
+    from dpsvm_tpu.observability import roofline
+    roof = roofline.fraction(
+        est_flops=est_flops, iters=iters, seconds=dt,
+        device_kind=getattr(dev, "device_kind", None))
     log(f"phases: {timer.summary()}")
     log(f"compiles: {len(compiles)} in {compile_seconds}s; hbm peak: "
         f"{hbm['peak'] if hbm['peak'] is not None else 'n/a'}")
@@ -376,7 +386,7 @@ def main() -> None:
         for c in compiles:
             trace.compile(program=c["program"], seconds=c["seconds"],
                           signature=c.get("signature"),
-                          flops=c.get("flops"))
+                          flops=c.get("flops"), bytes=c.get("bytes"))
         if warm is not None:
             trace.chunk(n_iter=warm.n_iter, b_lo=warm.b_lo,
                         b_hi=warm.b_hi, n_sv=warm.n_sv, window="warmup")
@@ -405,6 +415,8 @@ def main() -> None:
         "compile_seconds": compile_seconds,
         "hbm_peak": hbm["peak"],
         "est_flops": est_flops,
+        "est_bytes": est_bytes,
+        "roofline_fraction": roof,
     }
     print(json.dumps(row), flush=True)
     # Perf-ledger provenance (docs/OBSERVABILITY.md "Perf ledger").

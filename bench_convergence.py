@@ -107,6 +107,11 @@ def convergence_run(x, y, config) -> dict:
         "compile_seconds": facts.get("compile_seconds"),
         "hbm_peak": facts.get("hbm_peak"),
         "est_flops": facts.get("est_flops"),
+        "est_bytes": facts.get("est_bytes"),
+        # achieved/peak FLOP/s vs the per-backend peak table
+        # (observability/roofline.py) — null on CPU/unknown hardware,
+        # gateable via `dpsvm perf gate --metric roofline_fraction`
+        "roofline_fraction": facts.get("roofline_fraction"),
     }
 
 

@@ -629,8 +629,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "an approx twin); repeatable")
     sv.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write a serving trace (JSONL): manifest, "
-                         "eject/rebuild/shed/hedge events, summary at "
-                         "drain")
+                         "eject/rebuild/shed/hedge events, per-request "
+                         "span trees for sampled requests "
+                         "(--trace-sample-rate), summary at drain")
+    sv.add_argument("--trace-sample-rate", type=float, default=1.0,
+                    metavar="R",
+                    help="fraction of requests whose span tree (queue "
+                         "wait / batch formation / device dispatch / "
+                         "...) is recorded into --trace-out (0..1, "
+                         "deterministic stride; default 1.0 — sample "
+                         "down under sustained load to bound the "
+                         "steady-state overhead, "
+                         "docs/OBSERVABILITY.md 'Spans')")
     sv.add_argument("-q", "--quiet", action="store_true")
     _add_backend_flags(sv)
 
@@ -1595,6 +1605,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if not (args.deadline_ms > 0):
         print("error: --deadline-ms must be > 0", file=sys.stderr)
         return 2
+    if not (0.0 <= args.trace_sample_rate <= 1.0):
+        print("error: --trace-sample-rate must be in [0, 1], got "
+              f"{args.trace_sample_rate}", file=sys.stderr)
+        return 2
     # --hedge-ms: "off", "auto" (p99-based), or a fixed delay in ms
     hedge = args.hedge_ms
     if hedge not in ("off", "auto"):
@@ -1657,6 +1671,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             replicas=args.replicas, hedge=hedge,
                             degrade=args.degrade, siblings=siblings,
                             trace_out=args.trace_out,
+                            trace_sample_rate=args.trace_sample_rate,
                             metrics_registry=default_registry(),
                             verbose=not args.quiet).start()
     except ValueError as e:                 # width-mismatched sibling

@@ -54,6 +54,7 @@ from dpsvm_tpu.observability.record import (SOLVER_NAMES, RunTrace,
 from dpsvm_tpu.observability.report import (follow_trace, load_trace,
                                             render_report,
                                             resolve_trace_path,
+                                            span_attribution,
                                             summarize_trace,
                                             trace_facts)
 from dpsvm_tpu.observability.metrics import (MetricsRegistry,
@@ -67,8 +68,8 @@ __all__ = [
     "TRACE_SCHEMA_VERSION", "TraceWriter", "read_trace",
     "validate_trace", "RunTrace", "SOLVER_NAMES", "flush_open_traces",
     "load_trace", "render_report", "summarize_trace", "trace_facts",
-    "resolve_trace_path", "follow_trace", "compare_traces",
-    "compare_paths", "render_compare", "regressions",
+    "span_attribution", "resolve_trace_path", "follow_trace",
+    "compare_traces", "compare_paths", "render_compare", "regressions",
     "MetricsRegistry", "default_registry", "validate_exposition",
     "selfcheck", "main",
 ]
@@ -172,6 +173,181 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
             problems.append("v1 rendering invented v2 device facts")
     problems += _selfcheck_metrics()
     problems += _selfcheck_ledger(tmp_dir)
+    problems += _selfcheck_spans(tmp_dir)
+    problems += _selfcheck_roofline(tmp_dir)
+    return problems
+
+
+def _selfcheck_spans(tmp_dir: Optional[str] = None) -> List[str]:
+    """Span round-trip (schema v3, docs/OBSERVABILITY.md "Spans"):
+    serve real HTTP requests through the REAL serving stack — stub
+    engine, so no backend init — under --trace-out at sample rate 1.0,
+    then validate the v3 artifact and assert the attribution residual
+    stays under 10% of each request's wall (the acceptance bar: spans
+    must explain where the time went, not leave it unattributed)."""
+    import json
+    import os
+    import tempfile
+    import urllib.request
+
+    try:
+        import numpy as np
+
+        from dpsvm_tpu.serving.server import ServingServer
+    except Exception as e:              # pragma: no cover — env issue
+        return [f"span selfcheck setup failed: {e}"]
+
+    class _Engine:
+        num_attributes = 4
+        calibrated = False
+        manifest = {"task": "selfcheck-stub", "num_attributes": 4}
+
+        def infer(self, x, want):
+            n = int(np.shape(x)[0])
+            out = {}
+            if "labels" in want:
+                out["labels"] = np.ones(n, np.int32)
+            if "decision" in want:
+                out["decision"] = np.zeros(n, np.float32)
+            return out
+
+        def bucket_counts(self):
+            return {}
+
+    class _Registry:
+        def __init__(self):
+            self._e = _Engine()
+
+        def names(self):
+            return ["default"]
+
+        def engine(self, name):
+            return self._e
+
+        def build(self, name):
+            return _Engine()
+
+        def manifests(self):
+            return {"default": dict(self._e.manifest, generation=1)}
+
+    problems: List[str] = []
+    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
+        path = os.path.join(td, "serve.jsonl")
+        srv = ServingServer(_Registry(), port=0, max_batch=8,
+                            max_delay_ms=0.5, trace_out=path,
+                            trace_sample_rate=1.0).start()
+        try:
+            body = json.dumps(
+                {"instances": [[0.0] * 4, [1.0] * 4]}).encode()
+            for _ in range(6):
+                req = urllib.request.Request(
+                    srv.url + "/v1/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    r.read()
+        finally:
+            srv.drain(timeout=15.0)
+        try:
+            records = load_trace(path)      # validates v3 en route
+        except ValueError as e:
+            return [f"serving span trace failed validation: {e}"]
+        if (records[0].get("schema") or 0) < 3:
+            problems.append("serving trace is not schema v3")
+        att = span_attribution(records)
+        if att is None or att["requests"] < 6:
+            problems.append(f"span attribution lost requests: {att}")
+        elif att["covered_90pct_frac"] < 0.99:
+            problems.append(
+                "attribution residual >= 10% on "
+                f"{1 - att['covered_90pct_frac']:.0%} of requests "
+                f"(slowest: {att['slowest'][:1]})")
+        text = render_report(records)
+        for needle in ("request latency attribution",
+                       "slowest requests", "device_dispatch"):
+            if needle not in text:
+                problems.append(f"span report rendering lost "
+                                f"{needle!r}")
+    return problems
+
+
+def _selfcheck_roofline(tmp_dir: Optional[str] = None) -> List[str]:
+    """Roofline round-trip (docs/OBSERVABILITY.md "Roofline"): a
+    synthetic v3 bench trace on a known device (TPU v5e peaks) must
+    render an achieved-vs-peak fraction and a compute/memory-bound
+    verdict per phase; an unknown device must read as an honest n/a;
+    and a perf-ledger history of roofline_fraction readings must be
+    gateable by `dpsvm perf gate` (planted utilization drop fails)."""
+    import os
+    import tempfile
+
+    from dpsvm_tpu.observability import ledger, roofline
+
+    problems: List[str] = []
+    if roofline.peaks_for("TPU v4") is None:
+        problems.append("peak table lost TPU v4")
+    if roofline.peaks_for("cpu") is not None:
+        problems.append("peak table invented a CPU peak")
+    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
+        path = os.path.join(td, "bench_v5e.jsonl")
+        tr = RunTrace(path, config={"kernel": "rbf"}, n=60000, d=784,
+                      gamma=0.25, solver="bench-smo",
+                      env={"backend": "tpu",
+                           "device_kind": "TPU v5 lite",
+                           "device_count": 1})
+        # ~2.4e9 FLOP and ~3e7 B per iteration near the BENCH_r02
+        # operating point — AI ~80 FLOP/B, below the v5e ridge (~241),
+        # so the honest verdict is memory-bound.
+        tr.compile(program="bench-smo-chunk", seconds=1.0,
+                   flops=2.4e9, bytes=3.0e7)
+        tr.chunk(n_iter=100_000, b_lo=0.1, b_hi=-0.1,
+                 phases={"dispatch": 1.0, "poll": 4.5},
+                 phase_counts={"dispatch": 10, "poll": 10})
+        tr.summary(converged=True, n_iter=100_000, b=0.0, b_lo=0.001,
+                   b_hi=-0.001, n_sv=100, train_seconds=6.0,
+                   phases={"dispatch": 1.0, "poll": 4.5},
+                   phase_counts={"dispatch": 10, "poll": 10})
+        tr.close()
+        try:
+            records = load_trace(path)
+        except ValueError as e:
+            return [f"roofline sample failed validation: {e}"]
+        facts = trace_facts(records)
+        frac = facts.get("roofline_fraction")
+        if not (frac and 0 < frac < 1):
+            problems.append(f"roofline_fraction not computed: {frac}")
+        if facts.get("roofline_verdict") != "memory-bound":
+            problems.append("v5e bench point must read memory-bound, "
+                            f"got {facts.get('roofline_verdict')}")
+        text = render_report(records)
+        for needle in ("roofline: TPU v5e", "of peak",
+                       "[memory-bound]"):
+            if needle not in text:
+                problems.append(f"roofline rendering lost {needle!r}")
+        # unknown hardware: explicit n/a, never an invented number
+        records[0] = dict(records[0],
+                          env={"backend": "cpu", "device_kind": "cpu",
+                               "device_count": 1})
+        if trace_facts(records).get("roofline_fraction") is not None:
+            problems.append("unknown device got a roofline fraction")
+        if "roofline: n/a" not in render_report(records):
+            problems.append("unknown device lost the explicit "
+                            "roofline n/a line")
+        # ledger gate on the roofline_fraction column
+        lpath = os.path.join(td, "ledger.jsonl")
+        for v in (0.60, 0.61, 0.59, 0.60, 0.60, 0.40):
+            ledger.append("bench_headline",
+                          {"value": 16000.0, "unit": "iter/s",
+                           "roofline_fraction": v},
+                          kind="bench", path=lpath, strict=True)
+        records_l = ledger.read(lpath)
+        if ledger.gate(records_l, window=5, threshold_pct=10.0,
+                       metric="roofline_fraction") == []:
+            problems.append("planted roofline_fraction drop PASSED "
+                            "the perf gate")
+        if ledger.gate(records_l[:-1], window=5, threshold_pct=10.0,
+                       metric="roofline_fraction"):
+            problems.append("clean roofline_fraction history failed "
+                            "the perf gate")
     return problems
 
 
@@ -277,7 +453,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         print("telemetry selfcheck OK "
               f"(schema v{TRACE_SCHEMA_VERSION}, v1 accepted; metrics "
-              "exposition + ledger gate checked)")
+              "exposition + ledger gate + serving span round-trip + "
+              "roofline render checked)")
         return 0
     if args.validate:
         try:

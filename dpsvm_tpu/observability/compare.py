@@ -103,7 +103,8 @@ def compare_traces(records_a: List[dict], records_b: List[dict],
     for key in ("iters_per_sec", "train_seconds", "iters", "n_iter",
                 "gap", "n_sv", "cache_hit_rate", "n_compiles",
                 "compile_seconds", "hbm_peak", "est_flops",
-                "est_flops_per_sec"):
+                "est_bytes", "est_flops_per_sec", "arith_intensity",
+                "roofline_fraction"):
         rows.append({"metric": key, "a": fa.get(key), "b": fb.get(key),
                      "delta_pct": _pct(fa.get(key), fb.get(key))})
     phase_names = sorted(set(fa["phases"]) | set(fb["phases"]))
@@ -122,9 +123,11 @@ def compare_traces(records_a: List[dict], records_b: List[dict],
     gap_marks, marks_used = _gap_marks(fa, fb, marks)
     return {
         "a": {k: fa.get(k) for k in ("solver", "n", "d", "schema",
-                                     "converged")},
+                                     "converged", "device_kind",
+                                     "roofline_verdict")},
         "b": {k: fb.get(k) for k in ("solver", "n", "d", "schema",
-                                     "converged")},
+                                     "converged", "device_kind",
+                                     "roofline_verdict")},
         "metrics": rows,
         "gap_marks": gap_marks,
         "marks_requested": int(marks),
@@ -203,6 +206,13 @@ def render_compare(cmp: dict, label_a: str = "A",
                  else "      n/a")
             out.append(f"  gap@{m['n_iter']:<{w - 4},} "
                        f"{_cell(m['a']):>14} {_cell(m['b']):>14} {d}")
+    if a.get("roofline_verdict") or b.get("roofline_verdict"):
+        out.append("")
+        out.append("  roofline verdict (observability/roofline.py): "
+                   f"A {a.get('roofline_verdict') or 'n/a'} "
+                   f"({a.get('device_kind') or '?'}) vs "
+                   f"B {b.get('roofline_verdict') or 'n/a'} "
+                   f"({b.get('device_kind') or '?'})")
     if cmp["phases"]:
         out.append("")
         out.append("  host-loop phase split (seconds, share, calls):")

@@ -74,11 +74,13 @@ def _cache_size(fn) -> Optional[int]:
         return None
 
 
-def _cost_flops(fn, args, kwargs) -> Optional[float]:
-    """cost_analysis 'flops' of the program ``fn`` compiles for this
-    call signature, via a host-side re-lower on avals (no backend
-    compile). None when the backend/abstraction declines — the trace
-    records the fact as null rather than failing the run."""
+def _cost_estimates(fn, args, kwargs) -> tuple:
+    """cost_analysis ('flops', 'bytes accessed') of the program ``fn``
+    compiles for this call signature, via a host-side re-lower on
+    avals (no backend compile). Nones when the backend/abstraction
+    declines — the trace records the facts as null rather than failing
+    the run. The pair is the arithmetic intensity the roofline verdict
+    divides (observability/roofline.py)."""
     try:
         import jax
 
@@ -94,15 +96,19 @@ def _cost_flops(fn, args, kwargs) -> Optional[float]:
         ca = fn.lower(*specs, **kspecs).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        flops = (ca or {}).get("flops")
-        return float(flops) if flops is not None else None
+        ca = ca or {}
+        flops = ca.get("flops")
+        nbytes = ca.get("bytes accessed")
+        return (float(flops) if flops is not None else None,
+                float(nbytes) if nbytes is not None else None)
     except Exception:
-        return None
+        return None, None
 
 
 def observe(program: str, seconds: float, *,
             signature: Optional[str] = None,
-            flops: Optional[float] = None) -> None:
+            flops: Optional[float] = None,
+            bytes: Optional[float] = None) -> None:
     """Append one compile observation (public so harnesses that compile
     outside jit — e.g. explicit AOT paths — can report too)."""
     with _LOCK:
@@ -110,6 +116,7 @@ def observe(program: str, seconds: float, *,
                      "seconds": float(seconds),
                      "signature": signature,
                      "flops": flops,
+                     "bytes": bytes,
                      "wall": time.perf_counter()})
 
 
@@ -144,7 +151,7 @@ def instrument(fn: Callable, program: str, *,
                      if isinstance(fn, functools.partial)
                      and lowerable is not None and fn.func is lowerable
                      else {})
-    flops_seen: Dict[str, Optional[float]] = {}
+    cost_seen: Dict[str, tuple] = {}
 
     def wrapped(*args, **kwargs):
         before = _cache_size(target)
@@ -169,15 +176,16 @@ def instrument(fn: Callable, program: str, *,
                 sig_s = str(_signature(args, kwargs))
             except Exception:
                 pass
-            flops = None
-            if lowerable is not None and program not in flops_seen:
+            flops = nbytes = None
+            if lowerable is not None and program not in cost_seen:
                 # One estimate per program name: re-lowering is cheap
                 # (host tracing only) but not free, and a retrace of
                 # the same program has the same per-iteration cost.
-                flops = _cost_flops(lowerable, args,
-                                    {**static_kwargs, **kwargs})
-                flops_seen[program] = flops
-            observe(program, seconds, signature=sig_s, flops=flops)
+                flops, nbytes = _cost_estimates(
+                    lowerable, args, {**static_kwargs, **kwargs})
+                cost_seen[program] = (flops, nbytes)
+            observe(program, seconds, signature=sig_s, flops=flops,
+                    bytes=nbytes)
         return out
 
     wrapped.__name__ = f"observed[{program}]"

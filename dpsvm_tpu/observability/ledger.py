@@ -63,7 +63,8 @@ DIRECTION_BY_UNIT = {
 _LOWER_HINTS = ("seconds", "_ms", "_s", "latency", "hbm", "bytes",
                 "compile")
 _HIGHER_HINTS = ("per_sec", "per_s", "speedup", "rps", "throughput",
-                 "accuracy", "availability", "iters")
+                 "accuracy", "availability", "iters", "roofline",
+                 "fraction")
 
 
 def repo_root() -> str:

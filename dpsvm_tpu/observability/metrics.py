@@ -48,10 +48,16 @@ _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
 #: default latency buckets (milliseconds) for serving histograms —
-#: fixed at registration like every Prometheus histogram, spanning the
-#: measured p50 (~5 ms loopback) to deep-overload tails.
-DEFAULT_LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
-                              250.0, 500.0, 1000.0, 2500.0, 5000.0)
+#: fixed at registration like every Prometheus histogram, spanning
+#: loopback/TPU-local latencies to deep-overload tails. The sub-
+#: millisecond rungs exist because the old floor (1 ms) was coarser
+#: than the thing being measured: a loopback stub answers in ~0.1 ms
+#: and a TPU-local decision pass in ~0.5 ms, so every such request
+#: piled into one bucket and the histogram could not distinguish a
+#: 5x regression below 1 ms (pinned in tests/test_metrics.py).
+DEFAULT_LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                              25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+                              2500.0, 5000.0)
 
 
 def escape_label_value(v: str) -> str:

@@ -27,6 +27,7 @@ This module never touches a device: callers pass device facts in via
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 import weakref
 from typing import Dict, List, Optional
@@ -104,6 +105,20 @@ DIST_EVENTS = ("desync", "shard_lost", "reshard")
 # n_iter baseline stands).
 INGEST_EVENTS = ("quarantine", "ingest_resume")
 
+# Span names the serving layer records per sampled request (schema v3,
+# docs/OBSERVABILITY.md "Spans"). The `request` root covers admission
+# to response; its direct children are the sequential pipeline stages
+# (`admission` = parse+validate, `queue_wait` = batcher queue,
+# `batch_form` = coalescing window, `device_dispatch` = pool dispatch
+# through the engine, `respond` = result assembly + send). Below the
+# dispatch stage the pool records `replica_compute` per engine call
+# and zero-length markers for the resilience machinery (`hedge_fired`
+# / `hedge_won` / `redispatch`). Free strings to the schema; this
+# table is the documented vocabulary, like SERVING_EVENTS.
+SERVING_SPANS = ("request", "admission", "queue_wait", "batch_form",
+                 "device_dispatch", "respond", "replica_compute",
+                 "hedge_fired", "hedge_won", "redispatch")
+
 # Event types the cascade solver emits into its run trace
 # (solver/cascade.py, docs/APPROX.md "Cascade"): `screen` = stage-2
 # margin-band selection done (carries `n_kept`/`n_total` — the
@@ -118,14 +133,20 @@ CASCADE_EVENTS = ("screen", "polish", "readmit", "cascade_resume")
 
 
 def open_serving_trace(path: str, *, models: Optional[dict] = None,
-                       env: Optional[dict] = None) -> "RunTrace":
+                       env: Optional[dict] = None,
+                       sample_rate: Optional[float] = None) -> "RunTrace":
     """A RunTrace for a SERVING process: manifest solver="serving",
-    no chunk records — just the manifest, `SERVING_EVENTS` markers as
-    they happen, and a close_serving_trace() summary at drain. The
-    artifact validates under the ordinary v2 schema, so `dpsvm report`
-    and the trace tooling consume it unchanged."""
-    return RunTrace(path, solver="serving",
-                    config={"models": dict(models or {})}, env=env)
+    no chunk records — the manifest, `SERVING_EVENTS` markers as they
+    happen, per-request `span` trees for sampled requests
+    (``sample_rate``, recorded in the manifest config so a reader
+    knows what fraction of traffic the spans represent), and a
+    close_serving_trace() summary at drain. The artifact validates
+    under the ordinary v3 schema, so `dpsvm report` and the trace
+    tooling consume it unchanged."""
+    config = {"models": dict(models or {})}
+    if sample_rate is not None:
+        config["trace_sample_rate"] = float(sample_rate)
+    return RunTrace(path, solver="serving", config=config, env=env)
 
 
 def close_serving_trace(tr: "RunTrace", *, requests: int = 0,
@@ -186,7 +207,13 @@ class RunTrace:
         self._n_compiles = 0
         self._compile_seconds = 0.0
         self._est_flops: Optional[float] = None
+        self._est_bytes: Optional[float] = None
         self._hbm_peak: Optional[int] = None
+        # Serving traces are written from many threads (handler threads
+        # emitting request spans, pool workers emitting events): one
+        # lock serializes the (timestamp, write) pair so `t` stays
+        # non-decreasing in file order — the schema's ordering rule.
+        self._lock = threading.Lock()
         self._w.write({
             "kind": "manifest",
             "schema": TRACE_SCHEMA_VERSION,
@@ -259,31 +286,60 @@ class RunTrace:
     def event(self, event: str, *, n_iter: int = 0, **extra) -> None:
         """Solver lifecycle marker: checkpoint, program_swap (working-set
         growth), wall_budget, shrink, unshrink."""
-        rec = {"kind": "event", "event": str(event),
-               "n_iter": int(n_iter), "t": self._t()}
-        rec.update(extra)
-        self._w.write(rec)
+        with self._lock:
+            rec = {"kind": "event", "event": str(event),
+                   "n_iter": int(n_iter), "t": self._t()}
+            rec.update(extra)
+            self._w.write(rec)
+
+    def span(self, *, trace_id, span_id: int, parent: Optional[int],
+             name: str, t_start: float, t_end: float, **extra) -> None:
+        """One request-scoped span (schema v3; serving producers:
+        observability/spans.RequestSpans via ServingServer).
+        ``t_start``/``t_end`` are ABSOLUTE time.perf_counter readings —
+        the recorder rebases them onto its own t0 so every span shares
+        the trace's clock. All spans of one request are emitted
+        together at request completion, under the write lock, so
+        records of concurrent requests interleave whole, never torn."""
+        rel0 = round(float(t_start) - self._t0, 6)
+        rel1 = round(float(t_end) - self._t0, 6)
+        with self._lock:
+            rec = {"kind": "span", "trace_id": trace_id,
+                   "span_id": int(span_id),
+                   "parent": int(parent) if parent is not None else None,
+                   "name": str(name), "t_start": rel0, "t_end": rel1,
+                   "t": self._t()}
+            rec.update(extra)
+            self._w.write(rec)
 
     def compile(self, *, program: str, seconds: float,
                 signature: Optional[str] = None,
-                flops: Optional[float] = None, n_iter: int = 0,
+                flops: Optional[float] = None,
+                bytes: Optional[float] = None, n_iter: int = 0,
                 **extra) -> None:
         """One XLA compile (or retrace) of a chunk program
         (observability/compilewatch.py detects them; the driver drains
-        its log here). ``flops`` is the program's cost_analysis
-        estimate — on the chunk runners, the while-loop body counted
-        once, i.e. ~per-iteration FLOPs (docs/OBSERVABILITY.md)."""
-        rec = {"kind": "compile", "program": str(program),
-               "seconds": round(float(seconds), 6),
-               "signature": signature,
-               "flops": float(flops) if flops is not None else None,
-               "n_iter": int(n_iter), "t": self._t()}
-        rec.update(extra)
-        self._n_compiles += 1
-        self._compile_seconds += float(seconds)
-        if flops is not None:
-            self._est_flops = float(flops)
-        self._w.write(rec)
+        its log here). ``flops``/``bytes`` are the program's
+        cost_analysis estimates — on the chunk runners, the while-loop
+        body counted once, i.e. ~per-iteration FLOPs and bytes-accessed
+        (docs/OBSERVABILITY.md); together they are the arithmetic
+        intensity the roofline verdict divides
+        (observability/roofline.py)."""
+        with self._lock:
+            rec = {"kind": "compile", "program": str(program),
+                   "seconds": round(float(seconds), 6),
+                   "signature": signature,
+                   "flops": float(flops) if flops is not None else None,
+                   "bytes": float(bytes) if bytes is not None else None,
+                   "n_iter": int(n_iter), "t": self._t()}
+            rec.update(extra)
+            self._n_compiles += 1
+            self._compile_seconds += float(seconds)
+            if flops is not None:
+                self._est_flops = float(flops)
+            if bytes is not None:
+                self._est_bytes = float(bytes)
+            self._w.write(rec)
 
     def summary(self, *, converged: bool, n_iter: int, b: float,
                 b_lo: float, b_hi: float, n_sv: int,
@@ -319,10 +375,13 @@ class RunTrace:
             "compile_seconds": round(self._compile_seconds, 6),
             "hbm_peak": self._hbm_peak,
             "est_flops": self._est_flops,
+            "est_bytes": self._est_bytes,
             "t": self._t(),
         }
         rec.update(extra)
-        self._w.write(rec)
+        with self._lock:
+            rec["t"] = self._t()
+            self._w.write(rec)
 
     def close(self) -> None:
         self._closed = True

@@ -64,6 +64,7 @@ def trace_facts(records: List[dict]) -> dict:
         n_compiles = summary.get("n_compiles")
         compile_seconds = summary.get("compile_seconds")
         est_flops = summary.get("est_flops")
+        est_bytes = summary.get("est_bytes")
     else:
         iters = n_iter - it0
         seconds = float(src.get("t", 0.0) or 0.0)
@@ -77,11 +78,25 @@ def trace_facts(records: List[dict]) -> dict:
                            if compiles else None)
         est_flops = next((c.get("flops") for c in reversed(compiles)
                           if c.get("flops") is not None), None)
+        est_bytes = next((c.get("bytes") for c in reversed(compiles)
+                          if c.get("bytes") is not None), None)
     hits = int(src.get("cache_hits", 0) or 0)
     misses = int(src.get("cache_misses", 0) or 0)
     lookups = hits + misses
     est_flops_per_sec = (est_flops * iters / seconds
                          if est_flops and seconds and iters > 0 else None)
+    # Roofline digest (observability/roofline.py): achieved/peak
+    # fractions + the compute-vs-memory-bound verdict against the
+    # per-backend peak table; nulls on CPU/unknown hardware.
+    from dpsvm_tpu.observability import roofline as _roofline
+    env = manifest.get("env") or {}
+    phases_d = dict((summary or {}).get("phases")
+                    or (chunks[-1].get("phases") if chunks else {})
+                    or {})
+    roof = _roofline.roofline_facts(
+        est_flops=est_flops, est_bytes=est_bytes, iters=iters,
+        seconds=seconds, device_kind=env.get("device_kind"),
+        phases=phases_d)
     return {
         "solver": manifest.get("solver"),
         "n": manifest.get("n"),
@@ -99,7 +114,15 @@ def trace_facts(records: List[dict]) -> dict:
         "compile_seconds": compile_seconds,
         "hbm_peak": hbm_peak,
         "est_flops": est_flops,
+        "est_bytes": est_bytes,
         "est_flops_per_sec": est_flops_per_sec,
+        "device_kind": env.get("device_kind"),
+        "arith_intensity": roof["arith_intensity"],
+        "roofline_fraction": (round(roof["flops_fraction"], 6)
+                              if roof["flops_fraction"] is not None
+                              else None),
+        "roofline_verdict": roof["verdict"],
+        "roofline": roof,
         "quarantined_shards": len(quarantines),
         "phases": dict((summary or {}).get("phases")
                        or (chunks[-1].get("phases") if chunks else {})
@@ -108,6 +131,89 @@ def trace_facts(records: List[dict]) -> dict:
                              or (chunks[-1].get("phase_counts")
                                  if chunks else {}) or {}),
         "curve": [(c["n_iter"], c["gap"]) for c in chunks],
+    }
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (stdlib-only — the
+    report path must not import numpy)."""
+    if not sorted_vals:
+        return float("nan")
+    k = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[k]
+
+
+def span_attribution(records: List[dict],
+                     slowest: int = 5) -> Optional[dict]:
+    """Aggregate a serving trace's per-request span trees (schema v3)
+    into the latency-attribution digest behind ``dpsvm report``:
+
+    * per-stage stats over the root's direct children — count, mean,
+      p50/p95, max, and the share of total sampled wall time;
+    * the attribution residual ("unattributed"): root wall minus the
+      stage sum, reported as its own row — never silently folded into
+      a stage;
+    * the slowest-requests view: the top-``slowest`` roots by wall
+      time with their full per-stage breakdown, so one bad request's
+      time is explained, not just counted.
+
+    None when the trace has no span records (training traces, v1/v2)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    if not spans:
+        return None
+    by_trace: Dict[object, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    stages: Dict[str, List[float]] = {}
+    requests = []
+    covered_90 = 0
+    for tid, group in by_trace.items():
+        root = next((s for s in group if s["parent"] is None), None)
+        if root is None:
+            continue
+        dur = (root["t_end"] - root["t_start"]) * 1000.0
+        kids = [s for s in group if s["parent"] == root["span_id"]]
+        ksum = 0.0
+        breakdown: Dict[str, float] = {}
+        for s in kids:
+            ms = (s["t_end"] - s["t_start"]) * 1000.0
+            ksum += ms
+            stages.setdefault(s["name"], []).append(ms)
+            breakdown[s["name"]] = round(
+                breakdown.get(s["name"], 0.0) + ms, 3)
+        resid = max(dur - ksum, 0.0)
+        stages.setdefault("(unattributed)", []).append(resid)
+        coverage = (ksum / dur) if dur > 0 else 1.0
+        if coverage >= 0.9:
+            covered_90 += 1
+        requests.append({
+            "trace_id": tid, "total_ms": round(dur, 3),
+            "status": root.get("status"),
+            "coverage": round(coverage, 4),
+            "unattributed_ms": round(resid, 3),
+            "breakdown": breakdown,
+        })
+    if not requests:
+        return None
+    total_wall = sum(r["total_ms"] for r in requests) or 1.0
+    stage_stats = {}
+    for name, vals in stages.items():
+        vals = sorted(vals)
+        stage_stats[name] = {
+            "count": len(vals),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_percentile(vals, 50.0), 3),
+            "p95_ms": round(_percentile(vals, 95.0), 3),
+            "max_ms": round(vals[-1], 3),
+            "share": round(sum(vals) / total_wall, 4),
+        }
+    requests.sort(key=lambda r: -r["total_ms"])
+    return {
+        "requests": len(requests),
+        "covered_90pct": covered_90,
+        "covered_90pct_frac": round(covered_90 / len(requests), 4),
+        "stages": stage_stats,
+        "slowest": requests[:slowest],
     }
 
 
@@ -126,6 +232,7 @@ def summarize_trace(records: List[dict]) -> dict:
         "events": events,
         "compiles": compiles,
         "facts": trace_facts(records),
+        "spans": span_attribution(records),
         "curve": [{"n_iter": c["n_iter"], "gap": c["gap"],
                    "n_sv": c["n_sv"], "t": c["t"]} for c in chunks],
     }
@@ -273,6 +380,16 @@ def render_report(records: List[dict], width: int = 60) -> str:
         out.append(f"throughput: n/a (cost-model: "
                    f"{_fmt_flops(facts['est_flops'])}/iter; no "
                    "measured window to divide by)")
+    # Roofline block (schema v3, observability/roofline.py): achieved
+    # vs peak + the compute/memory-bound verdict per phase. Rendered
+    # when the trace carries a cost model or the hardware is in the
+    # peak table; a v3 CPU trace gets the explicit n/a line.
+    v3 = (m.get("schema") or 1) >= 3
+    if v3 and facts.get("device_kind") is not None and (
+            facts.get("est_flops") is not None
+            or (facts.get("roofline") or {}).get("peaks") is not None):
+        from dpsvm_tpu.observability import roofline as _roofline
+        out.extend(_roofline.render_roofline(facts["roofline"]))
     out.append("")
     out.append("convergence (gap vs iteration, log scale):")
     out.extend(_gap_curve(chunks, width=width))
@@ -324,6 +441,34 @@ def render_report(records: List[dict], width: int = 60) -> str:
     if events:
         out.append("events: " + ", ".join(
             f"{e['event']}@{e['n_iter']:,}" for e in events))
+    spans = span_attribution(records)
+    if spans is not None:
+        out.append("")
+        out.append(f"request latency attribution "
+                   f"({spans['requests']} sampled request(s), "
+                   f"{spans['covered_90pct_frac']:.0%} with >= 90% of "
+                   "wall attributed):")
+        w = max(len(n) for n in spans["stages"])
+        out.append(f"  {'stage':<{w}}  {'count':>6} {'mean ms':>9} "
+                   f"{'p50 ms':>9} {'p95 ms':>9} {'max ms':>9} "
+                   f"{'share':>6}")
+        order = sorted(spans["stages"].items(),
+                       key=lambda kv: -kv[1]["share"])
+        for name, st in order:
+            out.append(f"  {name:<{w}}  {st['count']:>6,} "
+                       f"{st['mean_ms']:>9,.3f} {st['p50_ms']:>9,.3f} "
+                       f"{st['p95_ms']:>9,.3f} {st['max_ms']:>9,.3f} "
+                       f"{st['share']:>6.1%}")
+        out.append("slowest requests (wall; per-stage ms):")
+        for r in spans["slowest"]:
+            parts = " | ".join(
+                f"{k} {v:,.3f}" for k, v in sorted(
+                    r["breakdown"].items(), key=lambda kv: -kv[1]))
+            status = (f" [{r['status']}]" if r.get("status") is not None
+                      else "")
+            out.append(f"  {r['trace_id']}: {r['total_ms']:,.3f} ms"
+                       f"{status}  {parts} | unattributed "
+                       f"{r['unattributed_ms']:,.3f}")
     out.append(f"chunk polls recorded: {len(chunks)}")
     return "\n".join(out)
 

@@ -1,0 +1,230 @@
+"""Hardware-roofline accounting: achieved vs peak, per phase.
+
+The ROADMAP's "as fast as the hardware allows" is a slogan until the
+denominator exists: this module turns the trace's cost-model facts
+(``est_flops``/``est_bytes`` per iteration, iteration count, wall
+seconds — all recorded by observability/compilewatch + record) plus a
+per-backend peak table into
+
+* an **achieved-fraction**: measured FLOP/s over the device's peak
+  MXU FLOP/s, and measured bytes/s over peak HBM bandwidth;
+* an **arithmetic-intensity verdict**: FLOPs per byte accessed vs the
+  device's ridge point (peak FLOP/s ÷ peak bandwidth) — above the
+  ridge the kernel is *compute-bound* (more FLOP/s needs better MXU
+  utilization), below it *memory-bound* (more FLOP/s needs fewer HBM
+  round-trips — exactly the case for the fused-Pallas work of ROADMAP
+  item 5, which keeps the gradient vector in VMEM);
+* a **per-phase split**: the host-loop phases that overlap device
+  execution (dispatch / poll / measure) carry the verdict; pure host
+  phases (checkpoint, ...) are labeled host-side — time the roofline
+  cannot explain must be named, not absorbed.
+
+"GPU-Accelerated Primal Learning" (arXiv:2008.03433) is the worked
+example of why this number directs tuning effort: their speedups came
+from knowing WHICH resource each phase saturated.
+
+**Peak-table honesty**: peaks are public spec-sheet numbers (dense
+bf16/f32 MXU FLOP/s and HBM bandwidth per chip), keyed by substring
+match on jax's ``device_kind``. An unrecognized device — and CPU,
+whose "peak" depends on the host — yields ``None``: every consumer
+(``dpsvm report``, ``dpsvm doctor``, the bench rows) renders an
+explicit *unknown/n/a* instead of inventing a denominator. The
+fractions are *per chip*: a sharded run's est_flops is the per-chip
+program, so the fraction reads as per-chip utilization.
+
+**Estimate honesty**: ``est_bytes`` is XLA's cost-model "bytes
+accessed" — LOGICAL traffic, an upper bound on physical HBM traffic
+(accesses served from VMEM/caches count too). The bandwidth fraction
+is therefore an upper bound and can exceed 100% on cache-friendly
+kernels; the AI verdict errs toward memory-bound, which is the safe
+direction for directing fusion work (ROADMAP item 5).
+
+Dependency-free (stdlib only): report/compare/doctor must render on a
+machine with no accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+#: Public per-chip peaks: (substring keys, canonical name,
+#: dense-matmul peak FLOP/s, HBM bytes/s). Matching is
+#: case-insensitive on jax's `device_kind` string ("TPU v5 lite",
+#: "TPU v4", ...). FLOP/s is the bf16 MXU peak — the precision the
+#: measured hot paths run at (docs/PERF.md "f32 vs bf16"); f32 peaks
+#: are half, noted in the table consumers print.
+PEAKS = (
+    (("v5 lite", "v5e"), "TPU v5e", 197e12, 819e9),
+    (("v5p", "v5 pod"), "TPU v5p", 459e12, 2765e9),
+    (("v6 lite", "v6e", "trillium"), "TPU v6e", 918e12, 1640e9),
+    (("v4",), "TPU v4", 275e12, 1228e9),
+    (("v3",), "TPU v3", 123e12, 900e9),
+    (("v2",), "TPU v2", 46e12, 700e9),
+)
+
+#: PhaseTimer phases that overlap device execution: the chunk program
+#: runs while the host is dispatching the next chunk or blocking on
+#: the stats poll (solver/driver.py "Poll economics"); bench.py's
+#: measure window is the same thing under another name. Everything
+#: else is host-side work the roofline cannot attribute to the chip.
+DEVICE_PHASES = ("dispatch", "poll", "measure", "compile+warmup")
+
+
+def peaks_for(device_kind: Optional[str]) -> Optional[dict]:
+    """The peak row for a device kind, or None for unrecognized
+    hardware (CPU included — an honest unknown, docs/OBSERVABILITY.md
+    "Roofline")."""
+    if not device_kind:
+        return None
+    low = str(device_kind).lower()
+    for keys, name, flops, bw in PEAKS:
+        if any(k in low for k in keys):
+            return {"device": name, "peak_flops": flops,
+                    "peak_hbm_Bps": bw,
+                    "ridge_flops_per_byte": flops / bw}
+    return None
+
+
+def roofline_facts(*, est_flops: Optional[float],
+                   est_bytes: Optional[float],
+                   iters: Optional[float], seconds: Optional[float],
+                   device_kind: Optional[str],
+                   phases: Optional[Dict[str, float]] = None) -> dict:
+    """The roofline digest rendered by ``dpsvm report``/``compare``
+    and folded into bench/burst rows.
+
+    Always returns the full key set (presence is the contract, like
+    the trace schema): unknown hardware or a missing cost model yields
+    nulls, never absent keys."""
+    peaks = peaks_for(device_kind)
+    out = {
+        "device_kind": device_kind,
+        "peaks": peaks,
+        "achieved_flops_per_sec": None,
+        "achieved_bytes_per_sec": None,
+        "flops_fraction": None,
+        "bandwidth_fraction": None,
+        "arith_intensity": None,
+        "verdict": None,
+        "phases": {},
+    }
+    measurable = (est_flops and seconds and iters
+                  and seconds > 0 and iters > 0)
+    if measurable:
+        out["achieved_flops_per_sec"] = est_flops * iters / seconds
+    if est_bytes and seconds and iters and seconds > 0 and iters > 0:
+        out["achieved_bytes_per_sec"] = est_bytes * iters / seconds
+    if est_flops and est_bytes:
+        out["arith_intensity"] = est_flops / est_bytes
+    if peaks is not None:
+        if out["achieved_flops_per_sec"] is not None:
+            out["flops_fraction"] = (out["achieved_flops_per_sec"]
+                                     / peaks["peak_flops"])
+        if out["achieved_bytes_per_sec"] is not None:
+            out["bandwidth_fraction"] = (out["achieved_bytes_per_sec"]
+                                         / peaks["peak_hbm_Bps"])
+        if out["arith_intensity"] is not None:
+            out["verdict"] = (
+                "compute-bound"
+                if out["arith_intensity"]
+                >= peaks["ridge_flops_per_byte"]
+                else "memory-bound")
+    # Per-phase split: device-overlapped phases inherit the kernel's
+    # verdict (the chunk program IS what runs during them); host
+    # phases are the roofline's blind spot and say so.
+    total = sum((phases or {}).values())
+    for name, sec in sorted((phases or {}).items(),
+                            key=lambda kv: -kv[1]):
+        device = name in DEVICE_PHASES
+        out["phases"][name] = {
+            "seconds": round(float(sec), 6),
+            "share": round(sec / total, 4) if total > 0 else None,
+            "kind": "device" if device else "host",
+            "verdict": (out["verdict"] if device else "host-side"),
+        }
+    return out
+
+
+def _fmt_flops(v: float) -> str:
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000 or unit == "P":
+            return f"{v:,.1f} {unit}FLOP/s"
+        v /= 1000
+    return f"{v:,.1f} PFLOP/s"
+
+
+def _fmt_bw(v: float) -> str:
+    return f"{v / 1e9:,.1f} GB/s"
+
+
+def render_roofline(rf: dict) -> List[str]:
+    """The human lines ``dpsvm report`` prints under "roofline:"."""
+    peaks = rf.get("peaks")
+    if peaks is None:
+        return [f"roofline: n/a (no peak table for device kind "
+                f"{rf.get('device_kind')!r} — fractions need a known "
+                "denominator; see dpsvm doctor)"]
+    out = [f"roofline: {peaks['device']}: peak "
+           f"{_fmt_flops(peaks['peak_flops'])} (bf16 MXU), "
+           f"{_fmt_bw(peaks['peak_hbm_Bps'])} HBM, ridge "
+           f"{peaks['ridge_flops_per_byte']:,.0f} FLOP/B"]
+    if rf.get("flops_fraction") is not None:
+        out.append(
+            f"roofline: achieved "
+            f"{_fmt_flops(rf['achieved_flops_per_sec'])} = "
+            f"{rf['flops_fraction']:.1%} of peak"
+            + (f"; {_fmt_bw(rf['achieved_bytes_per_sec'])} = "
+               f"{rf['bandwidth_fraction']:.1%} of HBM bandwidth"
+               if rf.get("bandwidth_fraction") is not None else ""))
+    else:
+        out.append("roofline: achieved fraction n/a (no cost-model "
+                   "FLOP estimate or no measured window)")
+    if rf.get("verdict") is not None:
+        out.append(
+            f"roofline: arithmetic intensity "
+            f"{rf['arith_intensity']:,.1f} FLOP/B -> {rf['verdict']} "
+            f"(ridge {peaks['ridge_flops_per_byte']:,.0f})")
+    for name, p in rf.get("phases", {}).items():
+        share = (f"{p['share']:.0%}" if p["share"] is not None
+                 else "n/a")
+        out.append(f"roofline:   {name:<14} {p['seconds']:8.3f} s "
+                   f"{share:>5}  [{p['verdict']}]")
+    return out
+
+
+def doctor_lines(device_kinds) -> List[str]:
+    """`dpsvm doctor`'s peak-table printout: the roofline denominators
+    for every visible device kind, with an honest `unknown` for
+    unrecognized hardware instead of a silent n/a later in report."""
+    out: List[str] = []
+    seen = []
+    for kind in device_kinds or [None]:
+        if kind in seen:
+            continue
+        seen.append(kind)
+        peaks = peaks_for(kind)
+        if peaks is None:
+            out.append(f"{kind!r}: unknown device kind — no peak "
+                       "table entry; `dpsvm report` will render "
+                       "roofline fractions as n/a")
+        else:
+            out.append(
+                f"{kind!r} -> {peaks['device']}: peak "
+                f"{_fmt_flops(peaks['peak_flops'])} bf16 MXU "
+                f"(f32 ~ half), {_fmt_bw(peaks['peak_hbm_Bps'])} HBM, "
+                f"ridge {peaks['ridge_flops_per_byte']:,.0f} FLOP/B")
+    return out
+
+
+def fraction(*, est_flops: Optional[float], iters: Optional[float],
+             seconds: Optional[float],
+             device_kind: Optional[str]) -> Optional[float]:
+    """The one-number ledger column (``roofline_fraction`` on
+    bench/burst rows): achieved/peak FLOP/s, or None when either side
+    is unknown — `dpsvm perf gate` skips null readings, so CPU rows
+    never gate on a made-up denominator."""
+    rf = roofline_facts(est_flops=est_flops, est_bytes=None,
+                        iters=iters, seconds=seconds,
+                        device_kind=device_kind)
+    f = rf["flops_fraction"]
+    return round(f, 6) if f is not None else None

@@ -34,15 +34,28 @@ read. Version history:
   (a mesh shard died mid-run) — docs/DISTRIBUTED.md "Elastic
   training". Chunk records of distributed runs may carry
   ``shard_ages`` (per-shard heartbeat ages, seconds).
+* v3 — adds the ``span`` record kind (request-scoped latency
+  attribution in serving traces, docs/OBSERVABILITY.md "Spans") and
+  the summary's ``est_bytes`` fact (cost-model bytes-accessed per
+  iteration — the denominator of the arithmetic-intensity verdict in
+  observability/roofline.py). Spans form per-request trees keyed by
+  ``trace_id``: one root span per request (``parent`` null) whose
+  children attribute the wall time to pipeline stages (queue wait,
+  batch formation, device dispatch, ...). Ordering is part of the
+  schema: a span ends at or after it starts, a child lies within its
+  parent's interval, the root's direct children never sum past the
+  root's own duration (the shortfall is the request's *unattributed*
+  residual, reported — never hidden — by ``dpsvm report``), and a
+  ``parent`` must name a span of the same ``trace_id``.
 """
 
 from __future__ import annotations
 
 import json
-from typing import IO, List, Optional
+from typing import IO, Dict, List, Optional
 
-TRACE_SCHEMA_VERSION = 2
-SUPPORTED_SCHEMAS = (1, 2)
+TRACE_SCHEMA_VERSION = 3
+SUPPORTED_SCHEMAS = (1, 2, 3)
 
 # Required keys per record kind. Values may be null where noted in
 # docs/OBSERVABILITY.md (e.g. env.device_kind on an uninitialized
@@ -58,11 +71,20 @@ SUMMARY_KEYS_V1 = ("converged", "n_iter", "iters", "iters_per_sec", "b",
                    "b_lo", "b_hi", "gap", "n_sv", "cache_hits",
                    "cache_misses", "cache_hit_rate", "train_seconds",
                    "phases", "t")
-SUMMARY_KEYS = SUMMARY_KEYS_V1 + ("phase_counts", "n_compiles",
-                                  "compile_seconds", "hbm_peak",
-                                  "est_flops")
+SUMMARY_KEYS_V2 = SUMMARY_KEYS_V1 + ("phase_counts", "n_compiles",
+                                     "compile_seconds", "hbm_peak",
+                                     "est_flops")
+SUMMARY_KEYS = SUMMARY_KEYS_V2 + ("est_bytes",)
+SPAN_KEYS = ("trace_id", "span_id", "parent", "name", "t_start",
+             "t_end", "t")
 KINDS_V1 = ("manifest", "chunk", "event", "summary")
-KINDS = KINDS_V1 + ("compile",)
+KINDS_V2 = KINDS_V1 + ("compile",)
+KINDS = KINDS_V2 + ("span",)
+
+#: slack (seconds) for the span containment/sum checks: producers clamp
+#: children to their root's interval at emission, so only float
+#: rounding of the recorded 6-decimal timestamps needs absorbing.
+SPAN_SLACK_S = 2e-6
 
 # Events that may legitimately FOLLOW the summary record: emergency
 # exit paths (the stall watchdog's flush_open_traces, a preemption
@@ -171,16 +193,22 @@ def validate_trace(records: List[dict]) -> List[str]:
     baseline; nothing resets the ``t`` baseline — a time rewind means
     interleaved writers. Cascade stage events are ordered (see
     EVENT_EXTRA_KEYS): ``polish`` only after ``screen``, ``readmit``
-    only after ``polish``, readmit rounds non-decreasing."""
+    only after ``polish``, readmit rounds non-decreasing. Span records
+    (v3) obey the per-request tree rules in _validate_spans."""
     errors: List[str] = []
     if not records:
         return ["empty trace (no records)"]
     head = records[0]
     schema = head.get("schema") if isinstance(head, dict) else None
     v1 = schema == 1
-    kinds = KINDS_V1 if v1 else KINDS
-    chunk_keys = CHUNK_KEYS_V1 if v1 else CHUNK_KEYS
-    summary_keys = SUMMARY_KEYS_V1 if v1 else SUMMARY_KEYS
+    if v1:
+        kinds, chunk_keys, summary_keys = (
+            KINDS_V1, CHUNK_KEYS_V1, SUMMARY_KEYS_V1)
+    elif schema == 2:
+        kinds, chunk_keys, summary_keys = (
+            KINDS_V2, CHUNK_KEYS, SUMMARY_KEYS_V2)
+    else:
+        kinds, chunk_keys, summary_keys = KINDS, CHUNK_KEYS, SUMMARY_KEYS
     for i, r in enumerate(records):
         if not isinstance(r, dict) or r.get("kind") not in kinds:
             errors.append(f"record {i}: unknown kind "
@@ -204,6 +232,7 @@ def validate_trace(records: List[dict]) -> List[str]:
     saw_screen = False
     saw_polish = False
     prev_readmit_round = None
+    spans: List[tuple] = []
     for i, r in enumerate(records):
         if not isinstance(r, dict):
             continue
@@ -269,6 +298,12 @@ def validate_trace(records: List[dict]) -> List[str]:
             elif r["seconds"] < 0:
                 errors.append(f"record {i}: compile seconds "
                               f"{r['seconds']} < 0")
+        elif kind == "span":
+            miss = _missing(r, SPAN_KEYS)
+            if miss:
+                errors.append(f"record {i}: span missing keys {miss}")
+            else:
+                spans.append((i, r))
         elif kind == "summary":
             miss = _missing(r, summary_keys)
             if miss:
@@ -278,4 +313,78 @@ def validate_trace(records: List[dict]) -> List[str]:
                               f"record {summary_at})")
             else:
                 summary_at = i
+    errors += _validate_spans(spans)
+    return errors
+
+
+def _validate_spans(spans: List[tuple]) -> List[str]:
+    """The per-request span-tree rules (schema v3, module docstring).
+
+    ``spans`` is [(record_index, span_record), ...] with the per-record
+    keys already checked. Grouping is by ``trace_id``, so the records
+    of concurrent requests may interleave freely in the file — the
+    tree rules apply within each request:
+
+    * every span ends at or after it starts;
+    * ``parent`` (when not null) names a ``span_id`` of the SAME
+      trace_id — an orphan points at a request that never recorded
+      its parent, i.e. a broken or interleaved producer;
+    * a child's [t_start, t_end] lies within its parent's (producers
+      clamp at emission; SPAN_SLACK_S absorbs timestamp rounding);
+    * per request there is exactly one root (``parent`` null), and the
+      root's DIRECT children — the pipeline stages — never sum past
+      the root's own duration. The shortfall is the request's
+      "unattributed" residual, a first-class fact `dpsvm report`
+      prints; an overshoot means overlapping stage spans, which the
+      serving producer never emits.
+    """
+    errors: List[str] = []
+    by_trace: Dict[object, List[tuple]] = {}
+    for i, r in spans:
+        t0, t1 = r["t_start"], r["t_end"]
+        if not (isinstance(t0, (int, float))
+                and isinstance(t1, (int, float))):
+            errors.append(f"record {i}: span t_start/t_end must be "
+                          f"numbers, got {t0!r}/{t1!r}")
+            continue
+        if t1 < t0:
+            errors.append(f"record {i}: span {r['name']!r} ends before "
+                          f"it starts (t_end {t1} < t_start {t0})")
+            continue
+        by_trace.setdefault(r["trace_id"], []).append((i, r))
+    for tid, group in by_trace.items():
+        ids = {r["span_id"]: (i, r) for i, r in group}
+        roots = [(i, r) for i, r in group if r["parent"] is None]
+        if len(roots) != 1:
+            errors.append(f"trace_id {tid!r}: {len(roots)} root span(s) "
+                          "(parent=null) — every request records "
+                          "exactly one")
+            continue
+        _ri, root = roots[0]
+        child_sum = 0.0
+        for i, r in group:
+            p = r["parent"]
+            if p is None:
+                continue
+            if p not in ids:
+                errors.append(f"record {i}: span {r['name']!r} has "
+                              f"orphan parent {p!r} (no such span_id "
+                              f"in trace_id {tid!r})")
+                continue
+            _pi, parent = ids[p]
+            if (r["t_start"] < parent["t_start"] - SPAN_SLACK_S
+                    or r["t_end"] > parent["t_end"] + SPAN_SLACK_S):
+                errors.append(
+                    f"record {i}: span {r['name']!r} "
+                    f"[{r['t_start']}, {r['t_end']}] escapes its "
+                    f"parent {parent['name']!r} "
+                    f"[{parent['t_start']}, {parent['t_end']}]")
+            if p == root["span_id"]:
+                child_sum += r["t_end"] - r["t_start"]
+        root_dur = root["t_end"] - root["t_start"]
+        if child_sum > root_dur + SPAN_SLACK_S * max(len(group), 1):
+            errors.append(
+                f"trace_id {tid!r}: direct children sum to "
+                f"{child_sum:.6f}s > the root's {root_dur:.6f}s wall — "
+                "stage spans overlap (attribution over 100%)")
     return errors

@@ -214,6 +214,16 @@ def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
         f"{topo.get('local_devices')} local, "
         f"process {topo.get('process_id')}/{topo.get('processes')}, "
         f"kinds {topo.get('device_kinds')})")
+    # Roofline denominators (observability/roofline.py): the peak
+    # FLOP/s + HBM-bandwidth table `dpsvm report` divides by. Printed
+    # HERE — with an honest `unknown` for unrecognized hardware —
+    # instead of failing silently later as an n/a in report.
+    from dpsvm_tpu.observability import roofline
+
+    kinds = topo.get("device_kinds") or [
+        getattr(devices[0], "device_kind", None)]
+    for line in roofline.doctor_lines(kinds):
+        out(f"roofline: {line}")
     p = int(shards) or len(devices)
     if p > len(devices):
         out(f"DOCTOR FAIL: asked for {p} shards but only "

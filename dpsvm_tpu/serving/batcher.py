@@ -65,13 +65,18 @@ class _Ticket:
     waiter that times out marks the ticket ``cancelled``, and the
     worker drops cancelled/expired tickets at batch-formation time
     instead of computing for nobody — the expired work is counted in
-    ``stats()["expired"]``, never silently burned."""
+    ``stats()["expired"]``, never silently burned.
+
+    ``spans`` (observability/spans.RequestSpans, None when the request
+    is unsampled) rides along so the worker can bracket this ticket's
+    queue-wait / batch-formation / dispatch stages — the request-scoped
+    latency attribution of docs/OBSERVABILITY.md "Spans"."""
 
     __slots__ = ("rows", "want", "event", "result", "error", "t_submit",
-                 "deadline", "cancelled")
+                 "deadline", "cancelled", "spans")
 
     def __init__(self, rows: np.ndarray, want: Tuple[str, ...],
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, spans=None):
         self.rows = rows
         self.want = want
         self.event = threading.Event()
@@ -80,6 +85,7 @@ class _Ticket:
         self.t_submit = time.perf_counter()
         self.deadline = deadline
         self.cancelled = False
+        self.spans = spans
 
     def wait(self, timeout: Optional[float] = None) -> dict:
         """Block for the result. The wait is bounded by BOTH the given
@@ -101,6 +107,13 @@ class _Ticket:
             if not self.event.is_set():
                 raise DeadlineExceededError(
                     "prediction did not complete in time")
+        # The dispatch stage is NOT ended here: the next stage the
+        # caller opens (`respond`) auto-closes it at that exact
+        # instant (observability/spans.RequestSpans.start), so the
+        # thread-wakeup latency between the worker's publish and the
+        # caller resuming is attributed to the dispatch with no gap —
+        # and a caller that never gets that far (blown deadline) has
+        # it cut at the root end by finish(), which IS the attribution.
         if self.error is not None:
             raise self.error
         return self.result
@@ -124,11 +137,15 @@ class MicroBatcher:
         self._infer = infer_fn
         # Deadline-aware engines (the replica pool) take the batch's
         # deadline as a keyword; plain engines keep the 2-arg shape.
+        # Same opt-in for span contexts: a `spans` keyword means the
+        # engine (the pool) records its own sub-spans per request.
         try:
-            self._pass_deadline = ("deadline" in
-                                   inspect.signature(infer_fn).parameters)
+            params = inspect.signature(infer_fn).parameters
+            self._pass_deadline = "deadline" in params
+            self._pass_spans = "spans" in params
         except (TypeError, ValueError):
             self._pass_deadline = False
+            self._pass_spans = False
         self.max_batch = int(max_batch)
         self.max_delay_s = max(float(max_delay_ms), 0.0) / 1000.0
         self.max_queue = int(max_queue)
@@ -150,19 +167,22 @@ class MicroBatcher:
     # -- client side --------------------------------------------------
 
     def submit(self, rows, want: Sequence[str] = ("labels",),
-               deadline: Optional[float] = None) -> _Ticket:
+               deadline: Optional[float] = None, spans=None) -> _Ticket:
         """Enqueue one request (rows: (k, d) float32). Returns a ticket
         to ``wait()`` on. Raises ``QueueFullError`` (fast, no blocking)
         at capacity, ``BatcherClosedError`` while draining.
         ``deadline`` (absolute perf_counter) bounds the whole journey:
-        an expired ticket is dropped at batch formation, not computed."""
+        an expired ticket is dropped at batch formation, not computed.
+        ``spans`` (RequestSpans or None) opens its ``queue_wait`` the
+        moment the ticket is accepted — rejects never count as queue
+        time."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 1:
             rows = rows[None, :]
         n = int(rows.shape[0])
         if n == 0:
             raise ValueError("empty request")
-        t = _Ticket(rows, tuple(want), deadline)
+        t = _Ticket(rows, tuple(want), deadline, spans=spans)
         with self._cond:
             if self._closing:
                 raise BatcherClosedError("server is draining")
@@ -171,6 +191,8 @@ class MicroBatcher:
                 raise QueueFullError(
                     f"queue full ({self._rows_queued} rows waiting, "
                     f"max {self.max_queue}) — retry with backoff")
+            if spans is not None:
+                spans.start("queue_wait")
             self._q.append(t)
             self._rows_queued += n
             self._n_requests += 1
@@ -225,6 +247,15 @@ class MicroBatcher:
 
     # -- worker -------------------------------------------------------
 
+    @staticmethod
+    def _note_batched(t: _Ticket) -> None:
+        """Span bookkeeping at batch admission: the ticket stops
+        waiting in the queue and starts riding an open batch
+        (batch_form's start auto-closes queue_wait at the same
+        timestamp — stage transitions are gap-free by construction)."""
+        if t.spans is not None:
+            t.spans.start("batch_form")
+
     def _prune_head(self) -> None:
         """Drop dead tickets from the queue head (holding the lock).
         Cancelled tickets (their waiter already gave up) and
@@ -264,6 +295,7 @@ class MicroBatcher:
                 return None
             first = self._q.popleft()
             self._rows_queued -= int(first.rows.shape[0])
+            self._note_batched(first)
             batch = [first]
             rows = int(first.rows.shape[0])
             deadline = time.perf_counter() + self.max_delay_s
@@ -275,6 +307,7 @@ class MicroBatcher:
                         break
                     t = self._q.popleft()
                     self._rows_queued -= nxt
+                    self._note_batched(t)
                     batch.append(t)
                     rows += nxt
                     continue
@@ -306,19 +339,31 @@ class MicroBatcher:
                 self._n_batches += 1
                 self._batch_rows[int(x.shape[0])] = \
                     self._batch_rows.get(int(x.shape[0]), 0) + 1
+            span_ctxs = []
+            for t in batch:
+                if t.spans is not None:
+                    # auto-closes batch_form at the same instant
+                    t.spans.start("device_dispatch",
+                                  batch_rows=int(x.shape[0]))
+                    span_ctxs.append(t.spans)
             try:
+                kw = {}
                 if self._pass_deadline:
                     # the batch stays interesting until its LAST
                     # member's deadline (earlier members 504 on their
                     # own wait; later ones still want the result)
                     ds = [t.deadline for t in batch]
-                    deadline = (None if any(d is None for d in ds)
-                                else max(ds))
-                    res = self._infer(x, want, deadline=deadline)
-                else:
-                    res = self._infer(x, want)
+                    kw["deadline"] = (None if any(d is None for d in ds)
+                                      else max(ds))
+                if self._pass_spans and span_ctxs:
+                    kw["spans"] = span_ctxs
+                res = (self._infer(x, want, **kw) if kw
+                       else self._infer(x, want))
             except BaseException as e:     # noqa: BLE001 — published to
                 for t in batch:            # every waiting ticket
+                    if t.spans is not None:
+                        t.spans.end("device_dispatch",
+                                    error=type(e).__name__)
                     t.error = e
                     t.event.set()
                 continue
@@ -327,5 +372,8 @@ class MicroBatcher:
                 hi = lo + int(t.rows.shape[0])
                 t.result = {k: v[lo:hi] for k, v in res.items()
                             if k in t.want}
+                # device_dispatch is ended by the waiter's NEXT stage
+                # bracket (auto-close) so wakeup latency stays
+                # attributed with no inter-stage gap
                 t.event.set()
                 lo = hi

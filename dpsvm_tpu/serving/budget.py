@@ -71,6 +71,20 @@ class Budget:
                 f"deadline budget ({self.total_s:.3g}s) exhausted"
                 + (f" at {where}" if where else ""))
 
+    def spent(self) -> float:
+        """Seconds consumed since admission."""
+        return time.perf_counter() - self.t0
+
+    def describe(self) -> dict:
+        """Deadline accounting for the request's root span
+        (docs/OBSERVABILITY.md "Spans"): how big the budget was and
+        how much was left when described — a 504's root span says not
+        just THAT the budget blew but how deep in it the request
+        died."""
+        return {"deadline_ms": round(self.total_s * 1000.0, 3),
+                "deadline_remaining_ms": round(
+                    self.remaining() * 1000.0, 3)}
+
     def __repr__(self) -> str:
         return (f"Budget(total={self.total_s:.3g}s, "
                 f"remaining={self.remaining():.3g}s)")

@@ -82,9 +82,17 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                 requests: int = 200, batch: int = 1,
                 concurrency: int = 8, mode: str = "closed",
                 rps: float = 100.0, want: Sequence[str] = ("labels",),
-                timeout: float = 30.0) -> dict:
+                timeout: float = 30.0, spans: bool = False) -> dict:
     """Fire ``requests`` requests of ``batch`` rows each; return the
-    result row (throughput + latency percentiles + error count)."""
+    result row (throughput + latency percentiles + error count).
+
+    ``spans=True`` asks the server for its per-request span breakdown
+    (the ``X-Trace-Spans`` header — forced server-side sampling, so it
+    works with or without a serving --trace-out) and aggregates the
+    stage percentiles into the row: ``queue_wait_p99_ms`` /
+    ``compute_p99_ms`` + the full ``span_p99_ms`` table, so a
+    saturate-knee row says WHICH stage hit the knee instead of just
+    that p99 did (docs/OBSERVABILITY.md "Spans")."""
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
     if requests < 1 or batch < 1 or concurrency < 1:
@@ -105,8 +113,12 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     idx_lock = threading.Lock()
     lat_ms: List[float] = []
     statuses: List[int] = []
+    stage_ms: dict = {}            # stage name -> [ms, ...] (spans=True)
     out_lock = threading.Lock()
     t_start = [0.0]
+    headers = {"Content-Type": "application/json"}
+    if spans:
+        headers["X-Trace-Spans"] = "1"
 
     def worker(wid: int) -> None:
         conn = _Conn(host, port, timeout=timeout)
@@ -127,13 +139,18 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                     t0 = due if due > t_start[0] else time.perf_counter()
                 else:
                     t0 = time.perf_counter()
+                breakdown = None
                 try:
                     conn.request("POST", "/v1/predict", body=bodies[i],
-                                 headers={"Content-Type":
-                                          "application/json"})
+                                 headers=headers)
                     resp = conn.getresponse()
-                    resp.read()
+                    data = resp.read()
                     status = resp.status
+                    if spans and status == 200:
+                        try:
+                            breakdown = json.loads(data).get("spans")
+                        except (json.JSONDecodeError, AttributeError):
+                            breakdown = None
                 except (http.client.HTTPException, OSError):
                     status = -1
                     conn.close()
@@ -142,6 +159,11 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                 with out_lock:
                     lat_ms.append(ms)
                     statuses.append(status)
+                    if isinstance(breakdown, dict):
+                        for k, v in breakdown.items():
+                            if isinstance(v, (int, float)):
+                                stage_ms.setdefault(k, []).append(
+                                    float(v))
         finally:
             conn.close()
 
@@ -166,6 +188,27 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     # "not now" — explicit backpressure, not a failure; everything else
     # non-200 (504s, 5xx, connection drops) counts against it.
     accepted = len(statuses) - counts.get("429", 0)
+    span_row: dict = {}
+    if spans and stage_ms:
+        # server-side stage percentiles — WHERE the latency lives
+        # ("compute" = the device_dispatch stage: pool dispatch through
+        # the engine pass; docs/OBSERVABILITY.md "Spans")
+        table = {}
+        for k, vals in sorted(stage_ms.items()):
+            if k in ("total_ms", "unattributed_ms"):
+                continue
+            p50s, p99s = np.percentile(np.asarray(vals, np.float64),
+                                       [50.0, 99.0])
+            table[k] = {"p50_ms": round(float(p50s), 3),
+                        "p99_ms": round(float(p99s), 3)}
+        span_row = {
+            "span_requests": len(stage_ms.get("total_ms", ())),
+            "span_p99_ms": table,
+            "queue_wait_p99_ms": table.get(
+                "queue_wait", {}).get("p99_ms"),
+            "compute_p99_ms": table.get(
+                "device_dispatch", {}).get("p99_ms"),
+        }
     return {
         "mode": mode,
         "requests": requests,
@@ -184,6 +227,7 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
         "availability_pct": (round(100.0 * ok / accepted, 3)
                              if accepted else None),
         **({"target_rps": rps} if mode == "open" else {}),
+        **span_row,
     }
 
 
@@ -227,22 +271,29 @@ def run_saturate(url: str, rows: np.ndarray, *,
     ``trace`` is the provenance pointer the row carries (the serving
     process's ``--trace-out`` artifact or an archived copy) — the same
     field burst-runner rows carry, so an SLO row is ledger- and
-    ``compare``-traceable like a training row."""
+    ``compare``-traceable like a training row. A set ``trace`` also
+    turns on the per-request span breakdown (``spans``), so each RPS
+    step says WHICH stage (queue wait vs device compute) hit the
+    knee."""
     steps = []
     best = None
     rps = float(start_rps)
+    spans = trace is not None
     for _ in range(int(max_steps)):
         r = run_loadgen(url, rows, model=model, requests=step_requests,
                         batch=batch, concurrency=concurrency,
                         mode="open", rps=rps, want=want,
-                        timeout=timeout)
+                        timeout=timeout, spans=spans)
         met = (r["errors"] == 0
                and np.isfinite(r["p99_ms"])
                and r["p99_ms"] <= p99_target_ms)
         steps.append({"rps": rps, "p99_ms": r["p99_ms"],
                       "throughput_rps": r["throughput_rps"],
                       "availability_pct": r["availability_pct"],
-                      "errors": r["errors"], "slo_met": met})
+                      "errors": r["errors"], "slo_met": met,
+                      **({"queue_wait_p99_ms": r.get("queue_wait_p99_ms"),
+                          "compute_p99_ms": r.get("compute_p99_ms")}
+                         if spans else {})})
         if not met:
             break
         best = (rps, r)
@@ -261,6 +312,10 @@ def run_saturate(url: str, rows: np.ndarray, *,
         row.update(value=r["throughput_rps"], slo_met=True,
                    sustained_rps=srps, p99_ms=r["p99_ms"],
                    availability_pct=r["availability_pct"])
+        if spans:
+            row.update(queue_wait_p99_ms=r.get("queue_wait_p99_ms"),
+                       compute_p99_ms=r.get("compute_p99_ms"),
+                       span_p99_ms=r.get("span_p99_ms"))
     return row
 
 
@@ -280,11 +335,18 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
     process — it fires mid-run, at the configured request count) and
     the row additionally carries the availability of accepted requests
     plus the delta of the server's robustness counters (ejections,
-    rebuilds, hedges, sheds) across the run, read from /metricsz."""
+    rebuilds, hedges, sheds) across the run, read from /metricsz.
+
+    A set ``trace`` turns on the per-request span breakdown: every
+    request carries ``X-Trace-Spans`` (the serving side records its
+    span tree into --trace-out AND returns the stage milliseconds),
+    and the row gains ``queue_wait_p99_ms`` / ``compute_p99_ms`` +
+    the full ``span_p99_ms`` table."""
     before = fetch_metrics(url, timeout=timeout) if chaos else None
     main = run_loadgen(url, rows, model=model, requests=requests,
                        batch=batch, concurrency=concurrency, mode=mode,
-                       rps=rps, want=want, timeout=timeout)
+                       rps=rps, want=want, timeout=timeout,
+                       spans=trace is not None)
     row = {
         "metric": "serving_examples_per_sec",
         "value": main["examples_per_sec"],

@@ -79,10 +79,17 @@ class _Dispatch:
 
     __slots__ = ("x", "want", "deadline", "event", "result", "error",
                  "lock", "done", "winner", "t0", "hedge_at",
-                 "hedge_fired", "primary_idx", "attempts", "computing")
+                 "hedge_fired", "primary_idx", "attempts", "computing",
+                 "spans")
 
     def __init__(self, x: np.ndarray, want: Tuple[str, ...],
-                 deadline: float, hedge_at: Optional[float]):
+                 deadline: float, hedge_at: Optional[float],
+                 spans: Sequence = ()):
+        # span contexts (observability/spans.RequestSpans) of the
+        # sampled requests riding this batch: the pool hangs its
+        # replica_compute / hedge / redispatch spans under each one's
+        # device_dispatch stage (docs/OBSERVABILITY.md "Spans")
+        self.spans = tuple(spans or ())
         self.x = x
         self.want = want
         self.deadline = float(deadline)
@@ -310,18 +317,21 @@ class ReplicaPool:
 
     def infer(self, x, want: Sequence[str] = ("labels",), *,
               timeout: Optional[float] = None,
-              deadline: Optional[float] = None) -> dict:
+              deadline: Optional[float] = None,
+              spans: Sequence = ()) -> dict:
         """Dispatch one batch; blocks until a replica answers or the
         deadline passes. Raises DeadlineExceededError (504) on a blown
         budget, PoolUnavailableError (503) when every circuit is open,
-        ValueError for client mistakes (width mismatch etc.)."""
+        ValueError for client mistakes (width mismatch etc.).
+        ``spans``: RequestSpans contexts of the batch's sampled
+        requests (the batcher threads them through)."""
         x = np.asarray(x, np.float32)
         if deadline is None:
             deadline = time.perf_counter() + (self.deadline_s
                                               if timeout is None
                                               else float(timeout))
         d = _Dispatch(x, tuple(want), deadline, self._hedge_at(
-            time.perf_counter()))
+            time.perf_counter()), spans=spans)
         r = self._choose()
         if r is None:
             raise PoolUnavailableError(
@@ -345,9 +355,20 @@ class ReplicaPool:
             raise d.error
         return d.result
 
+    @staticmethod
+    def _span_mark(d: _Dispatch, name: str, **extra) -> None:
+        """Stamp a marker span under every sampled request of the
+        batch. Defensive: attribution must never kill serving."""
+        for ctx in d.spans:
+            try:
+                ctx.mark(name, parent="device_dispatch", **extra)
+            except Exception:
+                pass
+
     def _redispatch(self, d: _Dispatch, exclude: Set[int]) -> None:
         if d.done:
             return
+        self._span_mark(d, "redispatch", excluded=sorted(exclude))
         d.attempts += 1
         if d.attempts >= len(self._replicas) + 1:
             d.complete(error=PoolUnavailableError(
@@ -402,6 +423,21 @@ class ReplicaPool:
         # won hedge would mask the wedge and the stuck worker's queue
         # would grow unserved forever).
         replica.busy_since = t0
+        # Per-request compute spans: each sampled request riding this
+        # batch gets a replica_compute child under its device_dispatch
+        # (ended in the finally — a wedged compute keeps its span open
+        # until the request's finish() cuts it at the root, which IS
+        # the attribution of a wedge).
+        comp_spans = []
+        for ctx in d.spans:
+            try:
+                comp_spans.append(
+                    (ctx, ctx.start("replica_compute",
+                                    parent="device_dispatch",
+                                    replica=replica.idx,
+                                    generation=replica.generation)))
+            except Exception:
+                pass
         try:
             plan = faultinject.current()
             if plan is not None and plan.note_serve_compute(
@@ -423,6 +459,11 @@ class ReplicaPool:
                 return
         finally:
             replica.busy_since = None
+            for ctx, sp in comp_spans:
+                try:
+                    ctx.end(sp)
+                except Exception:
+                    pass
         ms = (time.perf_counter() - t0) * 1000.0
         replica.monitor.note_latency(ms)
         with self._lock:
@@ -440,6 +481,7 @@ class ReplicaPool:
         if won and d.hedge_fired and replica.idx != d.primary_idx:
             with self._lock:
                 self._counters["hedges_won"].inc()
+            self._span_mark(d, "hedge_won", replica=replica.idx)
         if replica.state == HALF_OPEN:
             # a finite, timely compute is the probe's verdict whether
             # or not it won the publish race: close the circuit
@@ -567,6 +609,9 @@ class ReplicaPool:
                             self._counters["hedges_fired"].inc()
                         self._emit("hedge", primary=d.primary_idx,
                                    hedge=r2.idx)
+                        self._span_mark(d, "hedge_fired",
+                                        primary=d.primary_idx,
+                                        hedge=r2.idx)
                         r2.enqueue(d)
             self._stop.wait(self.reap_interval_s)
 

@@ -38,6 +38,15 @@ carries the robustness counters (504s, ejections, rebuilds, hedges,
 shed tiers, expired tickets) and the rolling score-distribution
 window the drift detector (``serving/lifecycle.py``) reads.
 
+Observability: with ``--trace-out`` + ``--trace-sample-rate`` each
+sampled request threads a span tree through the stack (admission ->
+queue wait -> batch formation -> device dispatch -> respond, with
+replica-compute and hedge markers below the dispatch) and the tree is
+emitted into the serving trace as schema-v3 ``span`` records at
+request completion — the per-request "where did the time go" that
+aggregate /metricsz percentiles cannot answer
+(docs/OBSERVABILITY.md "Spans"; observability/spans.py).
+
 Shutdown reuses the deferred-signal pattern of ``resilience/preempt``:
 ``serve_until_signal`` traps SIGTERM/SIGINT, and on delivery performs a
 graceful drain — stop admitting (503 + batchers closed), finish every
@@ -66,6 +75,7 @@ from dpsvm_tpu.observability.metrics import (DEFAULT_LATENCY_BUCKETS_MS,
                                              PROMETHEUS_CONTENT_TYPE,
                                              MetricsRegistry,
                                              wants_prometheus)
+from dpsvm_tpu.observability.spans import RequestSpans, should_sample
 from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
                                        MicroBatcher, QueueFullError)
 from dpsvm_tpu.serving.budget import (TIER_NONE, TIER_SHED_PROBA,
@@ -114,6 +124,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send(self, code: int, payload: dict,
               headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+        # Span back-stop: whatever path produced this response, the
+        # request's span tree (when one is open) is finished with THIS
+        # status — every 4xx/5xx branch gets attribution without each
+        # one hand-closing the tree. The success path finishes earlier
+        # (with budget/model extras); finish is once-only, so this is
+        # then a no-op.
+        rs = getattr(self, "_rs", None)
+        if rs is not None and not rs.finished:
+            self.server.owner.finish_request_spans(rs, status=code)
         body = json.dumps(payload, default=_jsonable).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -214,6 +233,16 @@ class _Handler(BaseHTTPRequestHandler):
             owner.count("errors")
             self._send(503, {"error": "draining"})
             return
+        # Request-scoped span tree (docs/OBSERVABILITY.md "Spans"):
+        # opened for sampled requests (--trace-sample-rate against an
+        # open serving trace) and for any request that asks via the
+        # X-Trace-Spans header (the loadgen breakdown path — forced,
+        # so a client probing "where did MY time go" never loses the
+        # sampling lottery). None = this request records nothing.
+        want_spans_back = (str(self.headers.get("X-Trace-Spans", ""))
+                           .lower() in ("1", "true", "yes"))
+        rs = owner.start_request_spans(force=want_spans_back)
+        self._rs = rs
         body = self._body()
         if body is None:
             owner.count("errors")
@@ -298,8 +327,11 @@ class _Handler(BaseHTTPRequestHandler):
             # output from the one decision pass anyway, and the server
             # feeds the values to the drift detector's score window.
             ride = tuple(dict.fromkeys(eff_want + ("decision",)))
+            # admission is auto-closed by queue_wait's start inside
+            # submit — one timestamp per stage transition, so no time
+            # can fall between an explicit end and the next start
             ticket = owner.batcher(eff_name).submit(
-                x, ride, deadline=budget.deadline)
+                x, ride, deadline=budget.deadline, spans=rs)
             res = ticket.wait(budget.remaining())
         except QueueFullError as e:
             owner.count("rejected")
@@ -327,14 +359,27 @@ class _Handler(BaseHTTPRequestHandler):
             owner.count("errors")
             self._send(400, {"error": str(e)})
             return
+        if rs is not None:
+            # respond opens IMMEDIATELY on wake (before the score-
+            # window feed) — auto-closing the dispatch stage, so the
+            # post-compute bookkeeping is attributed, not residual
+            rs.start("respond")
         owner.observe_scores(res.get("decision"))
+        out = {k: _jsonable(v) for k, v in res.items() if k in eff_want}
+        if degraded:
+            out["degraded"] = degraded
+        # Close the span tree BEFORE measuring ms so the root span and
+        # the /metricsz latency observation describe the same wall
+        # (the residual left to `respond` is the JSON encode + send).
+        breakdown = owner.finish_request_spans(
+            rs, status=200, budget=budget, model=eff_name,
+            rows=int(x.shape[0]))
+        if breakdown is not None and want_spans_back:
+            out["spans"] = breakdown
         ms = (time.perf_counter() - t0) * 1000.0
         owner.observe_latency(ms)
         owner.count("requests")
-        out = {k: _jsonable(v) for k, v in res.items() if k in eff_want}
         out.update(model=name, n=int(x.shape[0]), ms=round(ms, 3))
-        if degraded:
-            out["degraded"] = degraded
         self._send(200, out)
 
 
@@ -352,6 +397,7 @@ class ServingServer:
                  siblings: Optional[Dict[str, str]] = None,
                  score_window: int = 4096,
                  trace_out: Optional[str] = None,
+                 trace_sample_rate: float = 1.0,
                  metrics_registry: Optional[MetricsRegistry] = None,
                  verbose: bool = False):
         self.registry = registry
@@ -402,6 +448,16 @@ class ServingServer:
             "dpsvm_serving_request_latency_ms",
             "request wall latency (admission to response)",
             buckets=DEFAULT_LATENCY_BUCKETS_MS).labels()
+        # Per-stage latency from the sampled span trees: the scrapeable
+        # twin of the trace's span records (one histogram series per
+        # stage name — queue_wait / device_dispatch / ...). Registered
+        # lazily on the first sampled request: a histogram FAMILY with
+        # zero series renders a sample-less TYPE line the exposition
+        # grammar rejects.
+        self._h_span = None
+        self._c_spans = self.mreg.counter(
+            "dpsvm_serving_spans_sampled_total",
+            "requests that recorded a span tree").labels()
         self._g_queue = self.mreg.gauge(
             "dpsvm_serving_queue_depth",
             "micro-batcher queue depth in rows", labels=("model",))
@@ -419,6 +475,12 @@ class ServingServer:
         self._events: deque = deque(maxlen=512)
         self._trace = None
         self._trace_out = trace_out
+        if not (0.0 <= float(trace_sample_rate) <= 1.0):
+            raise ValueError(f"trace_sample_rate must be in [0, 1], "
+                             f"got {trace_sample_rate}")
+        self.trace_sample_rate = float(trace_sample_rate)
+        self._admitted = 0       # sampling stride counter
+        self._trace_seq = 0      # request trace_id allocator
         self._t0 = time.monotonic()
         self._httpd: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
@@ -531,6 +593,72 @@ class ServingServer:
                 return sib, want, f"sibling:{sib}"
         return name, want, degraded
 
+    # -- request-scoped spans -----------------------------------------
+
+    def start_request_spans(self, force: bool = False
+                            ) -> Optional[RequestSpans]:
+        """Open a span tree for an admitted request, or None.
+
+        Sampled requests (deterministic stride at
+        ``trace_sample_rate`` — observability/spans.should_sample) are
+        recorded only while a serving trace is open (the records need
+        somewhere to land); ``force`` (the X-Trace-Spans header)
+        records regardless, so the loadgen breakdown works against a
+        server with no --trace-out. The unsampled fast path is one
+        counter increment."""
+        with self._lock:
+            i = self._admitted
+            self._admitted += 1
+            take = force or (self._trace is not None
+                             and should_sample(i, self.trace_sample_rate))
+            if not take:
+                return None
+            self._trace_seq += 1
+            tid = f"req-{self._trace_seq}"
+        # admission opens WITH the root (same timestamp): parse +
+        # validate is stage 1 of every request
+        return RequestSpans(tid, first_stage="admission")
+
+    def finish_request_spans(self, rs: Optional[RequestSpans],
+                             status: Optional[int] = None,
+                             budget=None, **extra) -> Optional[dict]:
+        """Close a request's span tree: end the root (with the HTTP
+        status + deadline accounting), feed the per-stage histograms,
+        emit the records into the serving trace when one is open, and
+        return the stage breakdown (ms). Once-only (None / already
+        finished = no-op), and never raises into the serving path."""
+        if rs is None or rs.finished:
+            return None
+        ex = dict(extra)
+        if status is not None:
+            ex["status"] = int(status)
+        if budget is not None:
+            try:
+                ex.update(budget.describe())
+            except Exception:
+                pass
+        try:
+            rs.finish(**ex)
+            bd = rs.breakdown()
+            self._c_spans.inc()
+            if self._h_span is None:
+                self._h_span = self.mreg.histogram(
+                    "dpsvm_serving_span_ms",
+                    "per-stage request latency from sampled span "
+                    "trees",
+                    labels=("span",),
+                    buckets=DEFAULT_LATENCY_BUCKETS_MS)
+            for stage, ms in bd.items():
+                if stage != "total_ms":
+                    self._h_span.labels(span=stage).observe(ms)
+            with self._lock:
+                tr = self._trace
+            if tr is not None:
+                rs.emit_into(tr)
+            return bd
+        except Exception:
+            return None                # attribution never kills serving
+
     # -- events + serving trace ---------------------------------------
 
     def emit_event(self, event: str, **extra) -> None:
@@ -557,6 +685,7 @@ class ServingServer:
         out = dict(counters)
         out["uptime_s"] = round(self.uptime, 3)
         out["draining"] = self.draining
+        out["spans_sampled"] = int(self._c_spans.value)
         if lat.size:
             p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
             out["latency_ms"] = {"count": int(lat.size),
@@ -658,10 +787,14 @@ class ServingServer:
             if b is None:
                 # All device work routes through the replica pool; the
                 # pool resolves engines per dispatch, so a hot reload
-                # (pool refresh) swaps under a live batcher.
-                def infer_fn(x, want, deadline=None, _name=name):
+                # (pool refresh) swaps under a live batcher. `spans`
+                # rides through so the pool can hang replica_compute /
+                # hedge spans under each request's dispatch stage.
+                def infer_fn(x, want, deadline=None, spans=(),
+                             _name=name):
                     return self.pool(_name).infer(x, want,
-                                                  deadline=deadline)
+                                                  deadline=deadline,
+                                                  spans=spans)
                 b = MicroBatcher(infer_fn, max_batch=self.max_batch,
                                  max_delay_ms=self.max_delay_ms,
                                  max_queue=self.max_queue)
@@ -686,7 +819,8 @@ class ServingServer:
             self._trace = open_serving_trace(
                 self._trace_out,
                 models={n: {"replicas": self.replicas}
-                        for n in self.registry.names()})
+                        for n in self.registry.names()},
+                sample_rate=self.trace_sample_rate)
         for name in self.registry.names():
             self.pool(name)                 # replica builds paid at boot
         self._httpd = _Server((self.host, self.requested_port), _Handler)
@@ -724,7 +858,8 @@ class ServingServer:
                                 errors=counters["errors"],
                                 seconds=self.uptime,
                                 rejected=counters["rejected"],
-                                deadline_504=counters["deadline_504"])
+                                deadline_504=counters["deadline_504"],
+                                spans_sampled=int(self._c_spans.value))
 
     def serve_until_signal(self) -> int:
         """Run until SIGTERM/SIGINT, then drain. Returns the signal

@@ -295,7 +295,8 @@ def drain_compiles(trace, n_iter: int = 0, metrics=None) -> None:
             trace.compile(program=rec["program"],
                           seconds=rec["seconds"],
                           signature=rec.get("signature"),
-                          flops=rec.get("flops"), n_iter=n_iter)
+                          flops=rec.get("flops"),
+                          bytes=rec.get("bytes"), n_iter=n_iter)
         if metrics is not None:
             metrics.on_compile(rec)
 

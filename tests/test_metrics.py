@@ -107,6 +107,33 @@ def test_exposition_validator_rejects_tampered_text(tamper, why):
     assert validate_exposition(tamper(text)), why
 
 
+def test_default_buckets_resolve_sub_millisecond_latencies():
+    """ISSUE 12 satellite: the fixed default buckets were too coarse
+    below ~5 ms for loopback/TPU-local latencies — every such request
+    piled into the first rung and a 5x sub-ms regression was
+    invisible. The sub-ms rungs must separate 0.1/0.25/0.5/1.0-class
+    observations WITHOUT breaking the exposition grammar or the
+    /metricsz JSON shape (pinned elsewhere in this file)."""
+    from dpsvm_tpu.observability.metrics import DEFAULT_LATENCY_BUCKETS_MS
+
+    assert DEFAULT_LATENCY_BUCKETS_MS[0] < 1.0
+    subms = [b for b in DEFAULT_LATENCY_BUCKETS_MS if b < 1.0]
+    assert len(subms) >= 3, subms
+    # the old rungs survive (cumulative dashboards keep their edges)
+    for edge in (1.0, 5.0, 100.0, 5000.0):
+        assert edge in DEFAULT_LATENCY_BUCKETS_MS
+    reg = MetricsRegistry()
+    h = reg.histogram("dpsvm_t_subms_ms", "sub-ms latencies")
+    for v in (0.08, 0.2, 0.4, 0.9):       # one per sub-ms rung
+        h.observe(v)
+    buckets, _sum, count = h.labels().histogram_state()
+    assert count == 4
+    # each observation landed in its OWN rung — distinguishable
+    n_subms = len(subms)
+    assert buckets[:n_subms + 1][:4] == [1, 1, 1, 1], buckets
+    assert validate_exposition(reg.render_prometheus()) == []
+
+
 def test_registry_kind_and_label_mismatch_raise():
     reg = MetricsRegistry()
     reg.counter("dpsvm_t_thing_total", "x", labels=("model",))

@@ -1,0 +1,498 @@
+"""Request-scoped span tracing + roofline accounting (ISSUE 12).
+
+What must hold:
+
+* schema v3 — span records validate with their tree rules; every
+  negative case (end<start, orphan parent, child escaping its parent,
+  stages summing past the root wall, spans under a v2 manifest) FAILS
+  validate_trace; the committed v1/v2 fixtures keep validating.
+* spans    — the serving stack threads one RequestSpans per sampled
+  request through admission -> queue -> batch -> dispatch -> respond;
+  under --trace-sample-rate 1.0 a loadgen run yields a v3 trace where
+  >= 99% of sampled requests have >= 90% of their wall attributed
+  (the acceptance bar), rendered as a latency-attribution table +
+  slowest-requests view by `dpsvm report`; sampling is a
+  deterministic stride; the steady-state overhead is pinned.
+* roofline — known device kinds resolve peaks, unknown ones are an
+  honest n/a (report + doctor); the committed v5e bench fixture
+  renders achieved-vs-peak and a per-phase compute/memory verdict;
+  roofline_fraction is a perf-ledger column `dpsvm perf gate`
+  accepts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.observability.report import (load_trace, render_report,
+                                            span_attribution,
+                                            trace_facts)
+from dpsvm_tpu.observability.schema import validate_trace
+from dpsvm_tpu.observability.spans import RequestSpans, should_sample
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def _mk_model(n_sv=40, d=5, seed=0, b=0.2, gamma=0.5):
+    from dpsvm_tpu.models.svm import SVMModel
+    rng = np.random.default_rng(seed)
+    return SVMModel(
+        x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+        alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+        y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(np.int32),
+        b=b, gamma=gamma)
+
+
+# --------------------------------------------------------- spans: units
+
+def test_should_sample_is_a_deterministic_stride():
+    assert [should_sample(i, 1.0) for i in range(5)] == [True] * 5
+    assert [should_sample(i, 0.0) for i in range(5)] == [False] * 5
+    picks = [should_sample(i, 0.5) for i in range(10)]
+    assert sum(picks) == 5                  # exactly half, evenly spread
+    assert picks == [should_sample(i, 0.5) for i in range(10)]  # stable
+    assert sum(should_sample(i, 0.25) for i in range(100)) == 25
+
+
+def test_request_spans_tree_finish_and_breakdown():
+    rs = RequestSpans("req-t")
+    rs.start("admission")
+    rs.end("admission")
+    rs.start("queue_wait")
+    rs.end("queue_wait")
+    rs.start("device_dispatch")
+    sp = rs.start("replica_compute", parent="device_dispatch", replica=1)
+    rs.end(sp)
+    rs.mark("hedge_fired", parent="device_dispatch")
+    # device_dispatch left OPEN: finish must cut it at the root end
+    # (the 504-shaped case), never drop it
+    spans = rs.finish(status=504)
+    assert rs.finished
+    by_name = {s.name: s for s in spans}
+    root = by_name["request"]
+    assert root.extra["status"] == 504
+    dd = by_name["device_dispatch"]
+    assert dd.end == root.end and dd.extra.get("cut_at_root_end")
+    # children clamped into their parents; compute inside dispatch
+    rc = by_name["replica_compute"]
+    assert dd.start <= rc.start <= rc.end <= dd.end
+    bd = rs.breakdown()
+    assert set(bd) >= {"total_ms", "admission", "queue_wait",
+                       "device_dispatch", "unattributed_ms"}
+    stage_sum = sum(v for k, v in bd.items()
+                    if k not in ("total_ms", "unattributed_ms"))
+    assert stage_sum <= bd["total_ms"] + 1e-6
+    assert bd["unattributed_ms"] == pytest.approx(
+        bd["total_ms"] - stage_sum, abs=0.01)
+    # finishing twice is a no-op, not a second tree
+    assert len(rs.finish()) == len(spans)
+
+
+def _span_records(tmp_path, mutate=None):
+    """A minimal valid v3 serving trace with one request tree; `mutate`
+    edits the records before validation."""
+    from dpsvm_tpu.observability.record import (RunTrace,
+                                                close_serving_trace)
+    path = str(tmp_path / "t.jsonl")
+    tr = RunTrace(path, solver="serving", config={"kernel": "rbf"})
+    t0 = tr._t0
+    tr.span(trace_id="r1", span_id=0, parent=None, name="request",
+            t_start=t0 + 0.001, t_end=t0 + 0.011)
+    tr.span(trace_id="r1", span_id=1, parent=0, name="queue_wait",
+            t_start=t0 + 0.001, t_end=t0 + 0.006)
+    tr.span(trace_id="r1", span_id=2, parent=0, name="device_dispatch",
+            t_start=t0 + 0.006, t_end=t0 + 0.010)
+    tr.span(trace_id="r1", span_id=3, parent=2, name="replica_compute",
+            t_start=t0 + 0.007, t_end=t0 + 0.010)
+    close_serving_trace(tr, requests=1)
+    records = [json.loads(l) for l in open(path)]
+    if mutate:
+        mutate(records)
+    return records
+
+
+def test_span_ordering_negative_cases(tmp_path):
+    """The satellite's negative matrix: each broken tree must FAIL
+    validate_trace with a named problem."""
+    assert validate_trace(_span_records(tmp_path)) == []
+
+    def flip_end(recs):                     # end < start
+        s = next(r for r in recs if r.get("span_id") == 1)
+        s["t_start"], s["t_end"] = s["t_end"], s["t_start"]
+    errs = validate_trace(_span_records(tmp_path, flip_end))
+    assert any("ends before it starts" in e for e in errs)
+
+    def orphan(recs):                       # parent id never recorded
+        next(r for r in recs
+             if r.get("span_id") == 3)["parent"] = 77
+    errs = validate_trace(_span_records(tmp_path, orphan))
+    assert any("orphan parent" in e for e in errs)
+
+    def escape(recs):                       # child outlives its parent
+        next(r for r in recs
+             if r.get("span_id") == 3)["t_end"] = 0.0125
+    errs = validate_trace(_span_records(tmp_path, escape))
+    assert any("escapes its parent" in e for e in errs)
+
+    def oversum(recs):                      # stages overlap: sum > wall
+        s = next(r for r in recs if r.get("span_id") == 1)
+        s["t_start"], s["t_end"] = 0.001, 0.011
+    errs = validate_trace(_span_records(tmp_path, oversum))
+    assert any("overlap" in e for e in errs)
+
+    def two_roots(recs):
+        next(r for r in recs
+             if r.get("span_id") == 1)["parent"] = None
+    errs = validate_trace(_span_records(tmp_path, two_roots))
+    assert any("root span" in e for e in errs)
+
+    def downgrade(recs):                    # span kind is v3-only
+        recs[0]["schema"] = 2
+    errs = validate_trace(_span_records(tmp_path, downgrade))
+    assert any("unknown kind" in e for e in errs)
+
+
+def test_v1_and_v2_fixtures_still_validate():
+    """Back-compat pin: traces written by the v1 (PR 1) and v2
+    (PR 3..10) recorders keep validating and rendering after the v3
+    change — with no invented span/roofline facts."""
+    for name, schema in (("trace_v1.jsonl", 1), ("trace_v2.jsonl", 2),
+                         ("compare_base.jsonl", 2)):
+        records = load_trace(os.path.join(FIXTURES, name))
+        assert records[0]["schema"] == schema
+        text = render_report(records)
+        assert "request latency attribution" not in text
+        assert "roofline:" not in text
+        assert span_attribution(records) is None
+
+
+# --------------------------------------------- serving end-to-end (e2e)
+
+@pytest.fixture()
+def traced_server(tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    model = _mk_model(seed=61)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    trace = str(tmp_path / "serve_trace.jsonl")
+    srv = ServingServer(reg, port=0, max_batch=8, max_delay_ms=1.0,
+                        max_queue=256, trace_out=trace,
+                        trace_sample_rate=1.0).start()
+    yield srv, trace
+    if not srv.draining:
+        srv.drain(timeout=15.0)
+
+
+def test_loadgen_under_full_sampling_meets_attribution_bar(
+        traced_server, tmp_path, capsys):
+    """THE acceptance: a loadgen run against `--trace-out
+    --trace-sample-rate 1.0` yields a v3 trace where >= 99% of sampled
+    requests have spans covering >= 90% of their wall time; `dpsvm
+    report` renders the per-phase attribution table and the
+    slowest-requests view; the loadgen row says which stage the time
+    went to (queue_wait_p99_ms / compute_p99_ms)."""
+    from dpsvm_tpu.serving.loadgen import run_loadgen, synthetic_rows
+
+    srv, trace = traced_server
+    rows = synthetic_rows(5, n=64, seed=3)
+    row = run_loadgen(srv.url, rows, requests=60, batch=2,
+                      concurrency=4, spans=True)
+    assert row["errors"] == 0
+    # the satellite: the row names the stage, not just the total
+    assert row["queue_wait_p99_ms"] is not None
+    assert row["compute_p99_ms"] is not None
+    assert row["span_requests"] == 60
+    assert "device_dispatch" in row["span_p99_ms"]
+    srv.drain(timeout=15.0)
+
+    records = load_trace(trace)             # validates v3 en route
+    assert records[0]["schema"] == 3
+    att = span_attribution(records)
+    assert att["requests"] >= 60
+    assert att["covered_90pct_frac"] >= 0.99, att
+    for stage in ("admission", "queue_wait", "batch_form",
+                  "device_dispatch", "respond", "(unattributed)"):
+        assert stage in att["stages"], stage
+    assert att["slowest"][0]["total_ms"] >= att["slowest"][-1]["total_ms"]
+    # the CLI rendering carries the table + slowest view
+    from dpsvm_tpu.cli import main
+    assert main(["report", trace]) == 0
+    out = capsys.readouterr().out
+    assert "request latency attribution" in out
+    assert "slowest requests" in out
+    assert "queue_wait" in out and "device_dispatch" in out
+
+
+def test_sample_rate_strides_and_unsampled_requests_record_nothing(
+        tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    model = _mk_model(seed=62)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    trace = str(tmp_path / "half.jsonl")
+    srv = ServingServer(reg, port=0, max_batch=8, max_delay_ms=0.5,
+                        trace_out=trace, trace_sample_rate=0.5).start()
+    try:
+        q = np.zeros((1, 5), np.float32)
+        body = json.dumps({"instances": q.tolist()}).encode()
+        for _ in range(20):
+            req = urllib.request.Request(
+                srv.url + "/v1/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=15).read()
+    finally:
+        srv.drain(timeout=15.0)
+    records = load_trace(trace)
+    roots = [r for r in records if r.get("kind") == "span"
+             and r.get("parent") is None]
+    assert len(roots) == 10                 # exactly every other request
+    assert records[0]["config"]["trace_sample_rate"] == 0.5
+    # rate 0 + no force = zero span machinery
+    with pytest.raises(ValueError):
+        ServingServer(reg, trace_sample_rate=1.5)
+
+
+def test_span_overhead_bound(traced_server):
+    """The pinned overhead bound (docs/OBSERVABILITY.md "Spans"): the
+    span machinery itself — open, 5 stage brackets, finish, breakdown
+    — costs well under a millisecond per request (measured directly,
+    so the pin is robust to CI noise in a way end-to-end wall deltas
+    are not)."""
+    t0 = time.perf_counter()
+    n = 500
+    for i in range(n):
+        rs = RequestSpans(f"req-{i}")
+        rs.start("admission")
+        rs.end("admission")
+        rs.start("queue_wait")
+        rs.end("queue_wait")
+        rs.start("batch_form")
+        rs.end("batch_form")
+        rs.start("device_dispatch")
+        sp = rs.start("replica_compute", parent="device_dispatch")
+        rs.end(sp)
+        rs.end("device_dispatch")
+        rs.start("respond")
+        rs.finish(status=200)
+        rs.breakdown()
+    per_req_ms = (time.perf_counter() - t0) * 1000.0 / n
+    assert per_req_ms < 1.0, f"span machinery {per_req_ms:.3f} ms/req"
+
+
+def test_sampled_tracing_overhead_vs_untraced_run():
+    """The comparative half of the pin: the same request stream
+    through the same batcher, with EVERY request traced vs none,
+    stays within a small factor (generous for CI noise — the
+    machinery bound above is the tight invariant)."""
+    from dpsvm_tpu.serving.batcher import MicroBatcher
+
+    def infer(x, want):
+        return {"labels": np.ones(int(x.shape[0]), np.int32)}
+
+    rows = np.zeros((2, 4), np.float32)
+
+    def drive(traced: bool, n: int = 150) -> float:
+        b = MicroBatcher(infer, max_batch=8, max_delay_ms=0.0)
+        try:
+            t0 = time.perf_counter()
+            for i in range(n):
+                rs = (RequestSpans(f"r{i}", first_stage="admission")
+                      if traced else None)
+                b.submit(rows, ("labels",), spans=rs).wait(5.0)
+                if rs is not None:
+                    rs.start("respond")
+                    rs.finish(status=200)
+                    rs.breakdown()
+            return time.perf_counter() - t0
+        finally:
+            b.close(drain=True, timeout=5.0)
+
+    drive(False, n=20)                      # warm both paths
+    drive(True, n=20)
+    untraced = min(drive(False), drive(False))
+    traced = min(drive(True), drive(True))
+    assert traced < untraced * 3.0 + 0.25, (
+        f"traced {traced:.3f}s vs untraced {untraced:.3f}s")
+
+
+def test_deadline_blown_request_attributes_where_it_died(tmp_path):
+    """A 504's span tree must say WHERE the budget died (the stage
+    still open at the root end), with the deadline accounting on the
+    root — serving/budget.describe()."""
+    import threading
+
+    from dpsvm_tpu.serving.batcher import MicroBatcher
+
+    release = threading.Event()
+
+    def slow_infer(x, want):
+        release.wait(5.0)
+        return {"labels": np.ones(int(x.shape[0]), np.int32)}
+
+    b = MicroBatcher(slow_infer, max_batch=4, max_delay_ms=0.0)
+    try:
+        rs = RequestSpans("req-504")
+        rs.start("admission")
+        rs.end("admission")
+        deadline = time.perf_counter() + 0.05
+        t = b.submit(np.zeros((1, 4), np.float32), ("labels",),
+                     deadline=deadline, spans=rs)
+        with pytest.raises(TimeoutError):
+            t.wait(0.05)
+        rs.finish(status=504)
+        by_name = {s.name: s for s in rs.finish()}
+        # the dispatch stage was open at death: cut at root end
+        assert by_name["device_dispatch"].extra.get("cut_at_root_end")
+        bd = rs.breakdown()
+        assert bd["device_dispatch"] >= 30.0   # ~the whole 50 ms budget
+    finally:
+        release.set()
+        b.close(drain=False, timeout=5.0)
+
+
+# ------------------------------------------------------------- roofline
+
+def test_roofline_peak_table_and_fraction():
+    from dpsvm_tpu.observability import roofline
+
+    v5e = roofline.peaks_for("TPU v5 lite")
+    assert v5e["device"] == "TPU v5e"
+    assert v5e["peak_flops"] == pytest.approx(197e12)
+    assert roofline.peaks_for("TPU v4")["peak_hbm_Bps"] == \
+        pytest.approx(1228e9)
+    assert roofline.peaks_for("cpu") is None
+    assert roofline.peaks_for(None) is None
+    # fraction: 2.4e9 FLOP/iter * 1e5 iters / 6 s / 197e12
+    f = roofline.fraction(est_flops=2.4e9, iters=1e5, seconds=6.0,
+                          device_kind="TPU v5 lite")
+    assert f == pytest.approx(2.4e9 * 1e5 / 6.0 / 197e12, abs=1e-6)
+    assert roofline.fraction(est_flops=2.4e9, iters=1e5, seconds=6.0,
+                             device_kind="cpu") is None
+    assert roofline.fraction(est_flops=None, iters=1e5, seconds=6.0,
+                             device_kind="TPU v4") is None
+
+
+def test_roofline_report_on_committed_bench_fixture(capsys):
+    """Acceptance: `dpsvm report` on a bench trace prints the
+    achieved-vs-peak FLOP/s fraction and a compute/memory-bound
+    verdict per phase (committed v5e fixture, AI 80 FLOP/B < ridge
+    241 -> memory-bound)."""
+    from dpsvm_tpu.cli import main
+
+    fixture = os.path.join(FIXTURES, "bench_roofline_v5e.jsonl")
+    records = load_trace(fixture)
+    facts = trace_facts(records)
+    assert facts["roofline_fraction"] == pytest.approx(0.2034, abs=2e-3)
+    assert facts["roofline_verdict"] == "memory-bound"
+    assert facts["arith_intensity"] == pytest.approx(80.0)
+    assert facts["est_bytes"] == pytest.approx(3.0e7)
+    assert main(["report", fixture]) == 0
+    out = capsys.readouterr().out
+    assert "roofline: TPU v5e: peak 197.0 TFLOP/s" in out
+    assert "% of peak" in out
+    assert "-> memory-bound" in out
+    # per-phase verdict lines: device phases carry the verdict
+    assert "measure" in out and "[memory-bound]" in out
+    # the machine-readable digest carries the same facts
+    assert main(["report", fixture, "--json"]) == 0
+    digest = json.loads(capsys.readouterr().out)
+    assert digest["facts"]["roofline_verdict"] == "memory-bound"
+
+
+def test_compare_carries_roofline_rows(capsys):
+    from dpsvm_tpu.cli import main
+
+    fixture = os.path.join(FIXTURES, "bench_roofline_v5e.jsonl")
+    assert main(["compare", fixture, fixture, "--json"]) == 0
+    digest = json.loads(capsys.readouterr().out)
+    by = {r["metric"]: r for r in digest["metrics"]}
+    assert by["roofline_fraction"]["a"] == pytest.approx(0.2034,
+                                                         abs=2e-3)
+    assert by["est_bytes"]["a"] == pytest.approx(3.0e7)
+    assert digest["a"]["roofline_verdict"] == "memory-bound"
+    # human rendering names the verdicts
+    assert main(["compare", fixture, fixture]) == 0
+    assert "roofline verdict" in capsys.readouterr().out
+
+
+def test_cpu_trace_renders_honest_roofline_na(tmp_path, blobs_small):
+    """A real CPU training run (schema v3 now) must render the
+    explicit roofline n/a — an unknown device never gets an invented
+    denominator — while keeping every pre-existing report line."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = blobs_small
+    path = str(tmp_path / "run.jsonl")
+    train(x, y, SVMConfig(c=1.37, gamma=0.5, epsilon=1e-3,
+                          max_iter=20_000, chunk_iters=64,
+                          trace_out=path))
+    records = load_trace(path)
+    assert records[0]["schema"] == 3
+    facts = trace_facts(records)
+    assert facts["roofline_fraction"] is None
+    assert facts["est_bytes"] is not None   # cost model works on CPU
+    assert facts["arith_intensity"] is not None
+    text = render_report(records)
+    assert "roofline: n/a" in text
+    assert "None" not in text
+
+
+def test_perf_gate_accepts_roofline_fraction_column(tmp_path, capsys):
+    """Acceptance: a perf-ledger row carries roofline_fraction and
+    `dpsvm perf gate` accepts it — and catches a planted utilization
+    drop in the same column."""
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.observability import ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    for v in (0.58, 0.60, 0.59, 0.61, 0.60):
+        ledger.append("bench_headline",
+                      {"value": 16000.0, "unit": "iter/s",
+                       "roofline_fraction": v},
+                      kind="bench", path=path, strict=True)
+    assert main(["perf", "gate", "--ledger", path,
+                 "--metric", "roofline_fraction"]) == 0
+    capsys.readouterr()
+    ledger.append("bench_headline",
+                  {"value": 16100.0, "unit": "iter/s",
+                   "roofline_fraction": 0.31},
+                  kind="bench", path=path, strict=True)
+    assert main(["perf", "gate", "--ledger", path,
+                 "--metric", "roofline_fraction"]) == 1
+    assert "roofline_fraction" in capsys.readouterr().out
+
+
+def test_doctor_prints_roofline_denominators(capsys):
+    """Satellite: `dpsvm doctor` prints the detected backend's peak
+    table — an honest `unknown` on CPU instead of a silent n/a later
+    in report."""
+    from dpsvm_tpu.resilience.doctor import run_doctor
+
+    lines = []
+    rc = run_doctor(shards=1, timeout_s=60.0, out=lines.append)
+    assert rc == 0
+    roof = [ln for ln in lines if ln.startswith("roofline:")]
+    assert roof, lines
+    assert any("unknown device kind" in ln for ln in roof)
+    from dpsvm_tpu.observability import roofline
+    known = roofline.doctor_lines(["TPU v4", "TPU v4"])
+    assert len(known) == 1                  # de-duplicated
+    assert "275.0 TFLOP/s" in known[0] and "ridge" in known[0]
