@@ -40,6 +40,18 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def tuned_profile_tag():
+    """The active tuned-profile identity ("<device_kind>@<git_sha>")
+    or None — every bench row carries it so the ledger history stays
+    attributable to the knob set in effect (docs/PERF.md
+    "Autotuning"). Best-effort: provenance must never burn a row."""
+    try:
+        from dpsvm_tpu.tuning.profile import provenance_tag
+        return provenance_tag()
+    except Exception:                       # noqa: BLE001
+        return None
+
+
 def preflight_or_degrade(metric: str) -> None:
     """Deadline-bounded doctor preflight before the round
     (bench_common.doctor_preflight): an unresponsive TPU tunnel
@@ -151,11 +163,150 @@ def cascade_vs_exact() -> None:
         "gen": os.environ.get("BENCH_GEN", "planted"),
         "n_sv": int(m_casc.n_sv),
         "shrinking_polish": shrink,
+        "tuned_profile": tuned_profile_tag(),
     }
     print(json.dumps(row), flush=True)
     from dpsvm_tpu.observability import ledger
     ledger.append(row["metric"], row, kind="bench",
                   trace=trace_out, backend=dev.platform)
+
+
+def bf16_featurize() -> None:
+    """BENCH_CASE=bf16-featurize: the approx featurization GEMMs at
+    Precision.HIGHEST (exact f32, the reference-parity default) vs
+    Precision.DEFAULT (bf16 multiplies, f32 accumulation) on the same
+    feature map. One JSON row with the wall-clock speedup AND the
+    parity fact the bf16 path claims (max |phi_bf16 - phi_f32|) —
+    ~1.0x on CPU (both lower to f32 there; the row exists so the chip
+    history has a pinned bf16-featurize fact like the SMO headline).
+    Shape knobs: BENCH_N / BENCH_D / BENCH_APPROX_DIM / BENCH_REPEATS.
+    """
+    n = int(os.environ.get("BENCH_N", 60_000))
+    d = int(os.environ.get("BENCH_D", 128))
+    approx_dim = int(os.environ.get("BENCH_APPROX_DIM", 2048))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+    dev = require_devices()[0]
+    enable_compile_cache()
+    log(f"device: {dev} ({dev.platform})")
+
+    import numpy as np
+
+    from bench_common import standin
+    from dpsvm_tpu.approx.features import build_feature_map, featurize
+    from dpsvm_tpu.ops.kernels import KernelSpec
+
+    gamma = 0.25
+    x, _y = standin(n=n, d=d, gamma=gamma, seed=0)
+    fmap = build_feature_map("rff", x, approx_dim, 0,
+                             KernelSpec(kind="rbf", gamma=gamma))
+
+    def timed(precision: str):
+        featurize(fmap, x, precision=precision)     # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            phi = featurize(fmap, x, precision=precision)
+        return (time.perf_counter() - t0) / repeats, phi
+
+    s_hi, phi_hi = timed("highest")
+    s_bf, phi_bf = timed("default")
+    max_delta = float(np.max(np.abs(phi_hi - phi_bf)))
+    speedup = s_hi / s_bf if s_bf > 0 else 0.0
+    rows_per_s = n / s_bf if s_bf > 0 else 0.0
+    log(f"featurize {n}x{d}->D={fmap.dim}: highest {s_hi:.3f}s, "
+        f"default {s_bf:.3f}s ({speedup:.2f}x), max|delta| "
+        f"{max_delta:.2e}")
+    row = {
+        "metric": "bf16_featurize_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "highest_seconds": round(s_hi, 4),
+        "default_seconds": round(s_bf, 4),
+        "rows_per_sec_bf16": round(rows_per_s, 1),
+        "max_abs_delta": max_delta,
+        "n": n, "d": d, "approx_dim": int(fmap.dim),
+        "repeats": repeats,
+        "tuned_profile": tuned_profile_tag(),
+    }
+    print(json.dumps(row), flush=True)
+    from dpsvm_tpu.observability import ledger
+    ledger.append(row["metric"], row, kind="bench",
+                  backend=dev.platform)
+
+
+def bf16_serving() -> None:
+    """BENCH_CASE=bf16-serving: the serving decision ladder at
+    precision 'highest' vs 'default' over the same warmed
+    PredictionEngine workload. One JSON row with the rows/s speedup
+    AND the decision-parity fact (max |delta| vs the exact-f32
+    decisions). Shape knobs: BENCH_N (train rows) / BENCH_D /
+    BENCH_EVAL_ROWS / BENCH_REPEATS."""
+    n = int(os.environ.get("BENCH_N", 20_000))
+    d = int(os.environ.get("BENCH_D", 128))
+    eval_rows = int(os.environ.get("BENCH_EVAL_ROWS", 8192))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    max_batch = int(os.environ.get("BENCH_MAX_BATCH", 256))
+
+    from dpsvm_tpu.utils.backend_guard import (enable_compile_cache,
+                                               require_devices)
+    dev = require_devices()[0]
+    enable_compile_cache()
+    log(f"device: {dev} ({dev.platform})")
+
+    import numpy as np
+
+    from bench_common import standin
+    from dpsvm_tpu.api import fit
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.serving.engine import PredictionEngine
+
+    xa, ya = standin(n=n + eval_rows, d=d, gamma=0.25, seed=0)
+    x, y = xa[:n], ya[:n]
+    xt = xa[n:]
+    model, r = fit(x, y, SVMConfig(
+        c=10.0, gamma=0.25, epsilon=1e-3,
+        max_iter=int(os.environ.get("BENCH_MAX_ITER", 400_000)),
+        matmul_precision=os.environ.get("BENCH_PRECISION",
+                                        "default").lower()))
+    log(f"model: {model.n_sv} SVs ({r.train_seconds:.1f}s train)")
+
+    def timed(precision: str):
+        eng = PredictionEngine(model, max_batch=max_batch,
+                               precision=precision)
+        eng.decision_values(xt)                      # warm the path
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            dec = eng.decision_values(xt)
+        return (time.perf_counter() - t0) / repeats, dec
+
+    s_hi, dec_hi = timed("highest")
+    s_bf, dec_bf = timed("default")
+    max_delta = float(np.max(np.abs(dec_hi - dec_bf)))
+    agree = float(np.mean(np.sign(dec_hi) == np.sign(dec_bf)))
+    speedup = s_hi / s_bf if s_bf > 0 else 0.0
+    log(f"serving ladder {eval_rows} rows x {model.n_sv} SVs: highest "
+        f"{s_hi:.3f}s, default {s_bf:.3f}s ({speedup:.2f}x), "
+        f"max|delta| {max_delta:.2e}, sign agreement {agree:.6f}")
+    row = {
+        "metric": "bf16_serving_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "highest_seconds": round(s_hi, 4),
+        "default_seconds": round(s_bf, 4),
+        "rows_per_sec_bf16": round(eval_rows / s_bf, 1) if s_bf else 0,
+        "max_abs_delta": max_delta,
+        "sign_agreement": agree,
+        "n_sv": int(model.n_sv),
+        "n": n, "d": d, "eval_rows": eval_rows,
+        "max_batch": max_batch, "repeats": repeats,
+        "tuned_profile": tuned_profile_tag(),
+    }
+    print(json.dumps(row), flush=True)
+    from dpsvm_tpu.observability import ledger
+    ledger.append(row["metric"], row, kind="bench",
+                  backend=dev.platform)
 
 
 def approx_vs_exact() -> None:
@@ -223,6 +374,7 @@ def approx_vs_exact() -> None:
         "approx_converged": bool(r_approx.converged),
         "n": n, "d": d, "approx_dim": approx_dim,
         "c": c, "gamma": gamma,
+        "tuned_profile": tuned_profile_tag(),
     }
     print(json.dumps(row), flush=True)
     # Perf-ledger provenance (docs/OBSERVABILITY.md "Perf ledger"):
@@ -235,7 +387,9 @@ def approx_vs_exact() -> None:
 def main() -> None:
     case = os.environ.get("BENCH_CASE", "").replace("_", "-")
     metric = {"approx-vs-exact": "approx_vs_exact_speedup",
-              "cascade-vs-exact": "cascade_vs_exact_speedup"}.get(
+              "cascade-vs-exact": "cascade_vs_exact_speedup",
+              "bf16-featurize": "bf16_featurize_speedup",
+              "bf16-serving": "bf16_serving_speedup"}.get(
                   case, "smo_iters_per_sec_mnist_scale")
     preflight_or_degrade(metric)
     if case == "approx-vs-exact":
@@ -243,6 +397,12 @@ def main() -> None:
         return
     if case == "cascade-vs-exact":
         cascade_vs_exact()
+        return
+    if case == "bf16-featurize":
+        bf16_featurize()
+        return
+    if case == "bf16-serving":
+        bf16_serving()
         return
     n = int(os.environ.get("BENCH_N", 60_000))
     d = int(os.environ.get("BENCH_D", 784))
@@ -417,6 +577,7 @@ def main() -> None:
         "est_flops": est_flops,
         "est_bytes": est_bytes,
         "roofline_fraction": roof,
+        "tuned_profile": tuned_profile_tag(),
     }
     print(json.dumps(row), flush=True)
     # Perf-ledger provenance (docs/OBSERVABILITY.md "Perf ledger").

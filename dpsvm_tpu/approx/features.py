@@ -143,24 +143,36 @@ def _host_kernel(a: np.ndarray, b: np.ndarray,
         a2[:, None] - 2.0 * dots + b2[None, :], 0.0))
 
 
-@functools.partial(jax.jit, static_argnames=("kind", "degree"))
+@functools.partial(jax.jit, static_argnames=("kind", "degree",
+                                             "precision_name"))
 def _featurize_block_jit(block, omega_or_landmarks, proj, gamma, coef0,
-                         kind: str, degree: int):
+                         kind: str, degree: int,
+                         precision_name: str = "HIGHEST"):
     """One fixed-shape featurization block. rff: proj is unused (pass a
-    dummy); nystrom: omega_or_landmarks holds the landmark rows."""
+    dummy); nystrom: omega_or_landmarks holds the landmark rows.
+
+    ``precision_name`` selects the MXU mode of the featurization GEMMs
+    (the jax.lax.Precision name, like the solvers' matmul_precision):
+    "HIGHEST" = exact f32, the default and the reference-parity path;
+    "DEFAULT" = bf16 multiplies with f32 MXU accumulation — the
+    transcendental epilogue (cos/sin, the kernel epilogues) and the
+    feature values themselves stay float32 either way."""
     import jax.numpy as jnp
 
+    precision = getattr(jax.lax.Precision, precision_name)
     from dpsvm_tpu.ops.kernels import kernel_rows, row_norms_sq
 
     if kind == "rff":
-        z = block @ omega_or_landmarks                     # (m, D/2)
+        z = jnp.matmul(block, omega_or_landmarks,
+                       precision=precision)                # (m, D/2)
         scale = jnp.float32(math.sqrt(2.0 / (2 * z.shape[1])))
         return scale * jnp.concatenate([jnp.cos(z), jnp.sin(z)], axis=1)
     spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
     b2 = row_norms_sq(block)
     l2 = row_norms_sq(omega_or_landmarks)
-    k = kernel_rows(block, b2, omega_or_landmarks, l2, spec)   # (m, L)
-    return k @ proj
+    k = kernel_rows(block, b2, omega_or_landmarks, l2, spec,
+                    precision=precision)                   # (m, L)
+    return jnp.matmul(k, proj, precision=precision)
 
 
 def _block_args(fmap: FeatureMap):
@@ -172,12 +184,15 @@ def _block_args(fmap: FeatureMap):
             jnp.float32(fmap.gamma), jnp.float32(fmap.coef0))
 
 
-def featurize_fn(fmap: FeatureMap):
+def featurize_fn(fmap: FeatureMap, precision: str = "highest"):
     """A ``block -> phi_block`` callable over device arrays, suitable
     for ``observability/compilewatch.instrument`` wrapping (the serving
-    engine's approx decider builds on this)."""
+    engine's approx decider builds on this). ``precision`` is the
+    matmul_precision of the featurization GEMMs ("highest" = exact f32
+    reference parity, the default)."""
     args = _block_args(fmap)
     kind, degree = fmap.kind, int(fmap.degree)
+    pname = str(precision).upper()
     # rff's base kernel kind is irrelevant to the block program; the
     # static `kind` IS the map kind so both maps share one jit site.
     base = "rff" if kind == "rff" else fmap.kernel
@@ -185,13 +200,13 @@ def featurize_fn(fmap: FeatureMap):
     def run(block):
         return _featurize_block_jit(block, *args,
                                     kind=base if kind != "rff" else "rff",
-                                    degree=degree)
+                                    degree=degree, precision_name=pname)
 
     return run
 
 
 def featurize(fmap: FeatureMap, x: np.ndarray,
-              chunk: int = 8192) -> np.ndarray:
+              chunk: int = 8192, precision: str = "highest") -> np.ndarray:
     """phi(x) as host float32, streamed in fixed-shape chunks.
 
     Pads the tail chunk to the block shape (one compile total) and
@@ -203,7 +218,7 @@ def featurize(fmap: FeatureMap, x: np.ndarray,
 
     x = np.asarray(x, np.float32)
     n = x.shape[0]
-    run = featurize_fn(fmap)
+    run = featurize_fn(fmap, precision=precision)
     if n <= chunk:
         return np.asarray(run(jnp.asarray(x)))
     out = np.empty((n, fmap.dim), np.float32)
@@ -217,11 +232,12 @@ def featurize(fmap: FeatureMap, x: np.ndarray,
 
 
 def featurize_padded(fmap: FeatureMap, x: np.ndarray, n_pad: int,
-                     chunk: int = 8192) -> np.ndarray:
+                     chunk: int = 8192,
+                     precision: str = "highest") -> np.ndarray:
     """featurize + zero-pad rows to ``n_pad`` (the primal solver's
     aligned-minibatch layout; padding rows are masked out of the loss
     by the row-weight vector, not by their feature values)."""
-    phi = featurize(fmap, x, chunk=chunk)
+    phi = featurize(fmap, x, chunk=chunk, precision=precision)
     if n_pad == phi.shape[0]:
         return phi
     out = np.zeros((n_pad, phi.shape[1]), np.float32)

@@ -76,16 +76,23 @@ class ApproxSVMModel:
 
 
 @functools.partial(jax.jit, static_argnames=("kind", "degree",
-                                             "include_b"))
+                                             "include_b",
+                                             "precision_name"))
 def _approx_decision_jit(block, omega_or_landmarks, proj, gamma, coef0,
-                         w, b, kind: str, degree: int, include_b: bool):
+                         w, b, kind: str, degree: int, include_b: bool,
+                         precision_name: str = "HIGHEST"):
     """Featurize one fixed-shape block and dot with the weights — ONE
     program, shared by ``decision_function`` and the serving engine's
     approx decider, so matched shapes are bitwise-identical between
-    the two (the SV engine's parity property, kept here)."""
+    the two (the SV engine's parity property, kept here).
+    ``precision_name``: the serving --precision knob threaded into the
+    featurize GEMMs and the phi.w dot (HIGHEST = exact f32 parity,
+    the default — ``decision_function`` always evaluates there)."""
+    precision = getattr(jax.lax.Precision, precision_name)
     phi = _featurize_block_jit(block, omega_or_landmarks, proj, gamma,
-                               coef0, kind=kind, degree=degree)
-    dual = phi @ w
+                               coef0, kind=kind, degree=degree,
+                               precision_name=precision_name)
+    dual = jnp.matmul(phi, w, precision=precision)
     if include_b:
         dual = dual - b
     return dual

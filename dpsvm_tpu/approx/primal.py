@@ -470,7 +470,8 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
 
     feat_raw = compilewatch.instrument(_featurize_block_jit,
                                        "stream-featurize")
-    feat_args = _feat_call_args(fmap)
+    feat_args = _feat_call_args(fmap,
+                                precision=config.matmul_precision)
 
     def featurize_block(xk: np.ndarray):
         block = xk
@@ -608,15 +609,17 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
     return model, result
 
 
-def _feat_call_args(fmap: FeatureMap):
+def _feat_call_args(fmap: FeatureMap, precision: str = "highest"):
     """(positional, keyword) arguments binding ``_featurize_block_jit``
     for one map — the streaming path calls the SHARED jit directly
     (instead of a per-fit closure) so compilewatch's cache probe sees a
-    warm second run as zero compiles."""
+    warm second run as zero compiles. ``precision`` is the GEMM
+    matmul_precision ("highest" = exact f32 parity, the default)."""
     from dpsvm_tpu.approx.features import _block_args
     kind = "rff" if fmap.kind == "rff" else fmap.kernel
     return ((*_block_args(fmap),),
-            {"kind": kind, "degree": int(fmap.degree)})
+            {"kind": kind, "degree": int(fmap.degree),
+             "precision_name": str(precision).upper()})
 
 
 def _power_lambda_max(phi: np.ndarray, n: int) -> float:
@@ -702,7 +705,8 @@ def fit_approx(x: np.ndarray, y: np.ndarray,
     # reproducible function of the config.
     perm = np.random.default_rng(config.approx_seed).permutation(n)
     x, yv = x[perm], yv[perm]
-    phi = featurize_padded(fmap, x, n_pad)
+    phi = featurize_padded(fmap, x, n_pad,
+                           precision=config.matmul_precision)
     # Mean squared feature-row norm over REAL rows: the curvature bound
     # behind the tuning-free step size (module docstring).
     msq = float(np.mean(np.sum(phi[:n].astype(np.float64) ** 2, axis=1)))

@@ -102,8 +102,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poly/sigmoid coef0 (LIBSVM -r)")
     tr.add_argument("-e", "--epsilon", type=float, default=0.001)
     tr.add_argument("-n", "--max-iter", type=int, default=150_000)
-    tr.add_argument("-s", "--cache-size", type=int, default=0,
-                    help="kernel-row cache lines (0 = fused matmul, no cache)")
+    tr.add_argument("-s", "--cache-size", type=int, default=None,
+                    help="kernel-row cache lines (0 = fused matmul, no "
+                         "cache; default: the backend's tuned profile "
+                         "when one is active, else 0 — docs/PERF.md "
+                         "'Autotuning')")
+    tr.add_argument("--chunk-iters", type=int, default=None, metavar="I",
+                    help="host poll cadence: iterations per compiled "
+                         "chunk between convergence polls (default: "
+                         "the backend's tuned profile when one is "
+                         "active, else 512)")
+    tr.add_argument("--no-tuned", action="store_true",
+                    help="ignore the tuned per-backend profile "
+                         "(`dpsvm tune`): knobs left at their defaults "
+                         "stay at the built-in defaults "
+                         "(DPSVM_NO_TUNED=1 is the env equivalent; "
+                         "explicit flags always win either way)")
     tr.add_argument("--shards", type=int, default=1,
                     help="devices along the data axis (replaces mpirun -np)")
     tr.add_argument("--backend", default="xla", choices=["xla", "numpy"],
@@ -584,9 +598,25 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--port", type=int, default=8317,
                     help="listen port (0 = OS-assigned; the bound port "
                          "is printed on the ready line)")
-    sv.add_argument("--max-batch", type=int, default=256,
+    sv.add_argument("--max-batch", type=int, default=None,
                     help="top rung of the compile-warmed bucket ladder "
-                         "AND the micro-batcher's coalescing cap")
+                         "AND the micro-batcher's coalescing cap "
+                         "(default: the backend's tuned profile when "
+                         "one is active, else 256 — docs/PERF.md "
+                         "'Autotuning')")
+    sv.add_argument("--precision", default="highest",
+                    choices=["highest", "high", "default"],
+                    help="MXU precision of the decision ladder: "
+                         "'highest' = exact f32 (the default and the "
+                         "bitwise decision_function-parity path), "
+                         "'default' = bf16 multiplies with f32 "
+                         "accumulation (~the training headline's MXU "
+                         "speedup at a pinned decision tolerance — "
+                         "docs/SERVING.md)")
+    sv.add_argument("--no-tuned", action="store_true",
+                    help="ignore the tuned per-backend profile for "
+                         "serving knobs left at their defaults "
+                         "(DPSVM_NO_TUNED=1 is the env equivalent)")
     sv.add_argument("--max-delay-ms", type=float, default=2.0,
                     help="micro-batching deadline: a batch closes after "
                          "this long even if not full (idle-server "
@@ -708,6 +738,63 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--max-steps", type=int, default=8)
     lg.add_argument("--step-requests", type=int, default=100,
                     help="requests per saturation step")
+
+    tn = sub.add_parser(
+        "tune", help="measure this backend's throughput-critical "
+                     "knobs (successive-halving probes through the "
+                     "real driver/serving plumbing, deadline-bounded) "
+                     "and persist a per-backend tuned profile that "
+                     "train/serve consult for knobs left at their "
+                     "defaults (docs/PERF.md 'Autotuning')")
+    _add_backend_flags(tn)
+    tn.add_argument("-f", "--input", default=None,
+                    help="dataset whose rows drive the probes "
+                         "(synthetic planted data at --n x --d when "
+                         "omitted — probes measure throughput, not "
+                         "model quality)")
+    tn.add_argument("--n", type=int, default=8192,
+                    help="synthetic probe rows (ignored with -f)")
+    tn.add_argument("--d", type=int, default=64,
+                    help="synthetic probe features (ignored with -f)")
+    tn.add_argument("-c", "--cost", type=float, default=10.0,
+                    help="probe-problem cost (harder problems sustain "
+                         "longer measurement windows)")
+    tn.add_argument("-g", "--gamma", type=float, default=None)
+    tn.add_argument("--knobs",
+                    default="chunk_iters,cache_lines,serve_max_batch",
+                    help="comma list of knobs to probe (chunk_iters | "
+                         "cache_lines | serve_max_batch)")
+    tn.add_argument("--grid", action="append", default=[],
+                    metavar="KNOB=V1,V2,...",
+                    help="override one knob's candidate grid "
+                         "(repeatable); the built-in default value is "
+                         "always added so the comparison stays "
+                         "anchored")
+    tn.add_argument("--probe-iters", type=int, default=2000,
+                    metavar="I",
+                    help="iteration budget of the FIRST halving rung "
+                         "(each later rung doubles it)")
+    tn.add_argument("--rungs", type=int, default=3,
+                    help="successive-halving rungs (default 3)")
+    tn.add_argument("--deadline-s", type=float, default=300.0,
+                    help="wall deadline for the whole tune run: "
+                         "finished knobs keep their verdicts, "
+                         "unfinished knobs keep their defaults")
+    tn.add_argument("--min-win-pct", type=float, default=2.0,
+                    help="a candidate must beat the measured default "
+                         "by this percent at the final rung or the "
+                         "default is kept (default 2)")
+    tn.add_argument("--out", default=None, metavar="PATH",
+                    help="profile file (default: "
+                         "$DPSVM_TUNED_PROFILE, else benchmarks/"
+                         "results/tuned_profile.json)")
+    tn.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="probe/A-B trace directory (default: "
+                         "traces/tune next to the profile)")
+    tn.add_argument("--no-ledger", dest="ledger",
+                    action="store_false", default=True,
+                    help="skip the perf-ledger appends")
+    tn.add_argument("-q", "--quiet", action="store_true")
     return root
 
 
@@ -1119,11 +1206,24 @@ def cmd_train(args: argparse.Namespace) -> int:
                             allow_nonfinite=args.allow_nonfinite,
                             mem_budget_mb=args.mem_budget_mb,
                             on_bad_shard=args.on_bad_shard)
+    # Tunable-knob explicitness (docs/PERF.md "Autotuning"): these
+    # flags default to None so an operator setting them — even TO the
+    # built-in default — is distinguishable from leaving them alone,
+    # and explicit values always beat a tuned profile.
+    explicit_knobs = set()
+    if args.cache_size is not None:
+        explicit_knobs.add("cache_size")
+    if args.chunk_iters is not None:
+        explicit_knobs.add("chunk_iters")
     config = SVMConfig(
         c=args.cost, gamma=args.gamma, kernel=args.kernel,
         degree=args.degree, coef0=args.coef0, epsilon=args.epsilon,
         svr_epsilon=args.svr_epsilon,
-        max_iter=args.max_iter, cache_size=args.cache_size,
+        max_iter=args.max_iter,
+        cache_size=(args.cache_size if args.cache_size is not None
+                    else 0),
+        chunk_iters=(args.chunk_iters if args.chunk_iters is not None
+                     else 512),
         backend=args.backend,
         shards=args.shards, shard_x=not args.replicate_x,
         verbose=not args.quiet,
@@ -1158,6 +1258,19 @@ def cmd_train(args: argparse.Namespace) -> int:
         mem_budget_mb=args.mem_budget_mb,
         on_bad_shard=args.on_bad_shard,
     )
+    # Tuned-profile resolution: explicit value > tuned profile >
+    # built-in default (tuning/profile.py; opt out with --no-tuned /
+    # DPSVM_NO_TUNED=1; `dpsvm doctor` reports the active entry).
+    if not args.no_tuned:
+        from dpsvm_tpu.tuning import profile as tuned_profile
+        config, tuned_applied = tuned_profile.apply_tuned(
+            config, explicit=explicit_knobs)
+        if tuned_applied and not args.quiet:
+            print("tuned profile: "
+                  + ", ".join(f"{k}={v}" for k, v
+                              in sorted(tuned_applied.items()))
+                  + " (--no-tuned for built-in defaults)",
+                  file=sys.stderr)
     if stream_train:
         return _train_streaming(args, config)
     if args.multiclass:
@@ -1595,6 +1708,29 @@ def cmd_serve(args: argparse.Namespace) -> int:
     from dpsvm_tpu.serving import ModelRegistry
     from dpsvm_tpu.serving.server import ServingServer
 
+    # Tuned-profile resolution for the serving knobs left at their
+    # defaults (tuning/profile.py): explicit flags always win;
+    # --no-tuned / DPSVM_NO_TUNED=1 opt out.
+    tuned_entry = None
+    if not args.no_tuned:
+        from dpsvm_tpu.tuning import profile as tuned_profile
+        tuned_entry = tuned_profile.active_entry()
+    if args.max_batch is None:
+        from dpsvm_tpu.tuning.profile import tuned_value
+        mb = tuned_value(tuned_entry, "serve_max_batch")
+        args.max_batch = int(mb) if mb else 256
+        if mb and not args.quiet:
+            print(f"tuned profile: max_batch={args.max_batch} "
+                  "(--no-tuned for the built-in 256)",
+                  file=sys.stderr)
+    if args.hedge_ms == "off" and args.replicas >= 2:
+        from dpsvm_tpu.tuning.profile import tuned_value
+        hm = tuned_value(tuned_entry, "serve_hedge_ms")
+        if hm:
+            args.hedge_ms = str(float(hm))
+            if not args.quiet:
+                print(f"tuned profile: hedge_ms={args.hedge_ms} "
+                      "(--no-tuned to disable)", file=sys.stderr)
     if args.max_batch < 1 or args.max_queue < 1:
         print("error: --max-batch and --max-queue must be >= 1",
               file=sys.stderr)
@@ -1641,12 +1777,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
             return 2
         engine = registry.register(name, path,
                                    max_batch=args.max_batch,
-                                   include_b=not args.no_b)
+                                   include_b=not args.no_b,
+                                   precision=args.precision)
         if not args.quiet:
             m = engine.manifest
             print(f"loaded {name!r}: task={m['task']} "
                   f"n_sv={m['n_sv']} (dropped {m['n_sv_dropped']} "
                   f"zero-coef) d={m['num_attributes']} "
+                  f"precision={m['precision']} "
                   f"buckets={m['buckets']} "
                   f"warmup_compiles={m['warmup_compiles']} "
                   f"({m['warmup_compile_seconds']}s)", file=sys.stderr)
@@ -1760,6 +1898,50 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
         avail = row.get("availability_pct")
         return 0 if (avail is not None and avail >= 99.0) else 1
     return 0 if row["errors"] == 0 else 1
+
+
+def cmd_tune(args: argparse.Namespace) -> int:
+    """Measure + persist this backend's tuned profile (docs/PERF.md
+    "Autotuning"; tuning/tuner.py)."""
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.tuning import tuner
+
+    knobs = [k for k in args.knobs.split(",") if k]
+    unknown = [k for k in knobs if k not in tuner.DEFAULT_GRIDS]
+    if unknown:
+        print(f"error: unknown knob(s) {unknown}; pick from "
+              f"{sorted(tuner.DEFAULT_GRIDS)}", file=sys.stderr)
+        return 2
+    grids = {}
+    for spec in args.grid:
+        name, sep, vals = spec.partition("=")
+        try:
+            if not sep or name not in tuner.DEFAULT_GRIDS:
+                raise ValueError(name)
+            grids[name] = tuple(int(v) for v in vals.split(",") if v)
+        except ValueError:
+            print(f"error: --grid needs KNOB=V1,V2,... with a known "
+                  f"knob, got {spec!r}", file=sys.stderr)
+            return 2
+    if args.input:
+        from dpsvm_tpu.data.loader import load_dataset
+        x, y = load_dataset(args.input, None, None)
+    else:
+        from dpsvm_tpu.data.synthetic import make_planted
+        gamma = (args.gamma if args.gamma is not None
+                 else 1.0 / args.d)
+        x, y = make_planted(n=args.n, d=args.d, gamma=gamma, seed=0)
+    base = SVMConfig(c=args.cost, gamma=args.gamma, epsilon=1e-5,
+                     max_iter=10_000_000)
+    log = (lambda s: None) if args.quiet else (
+        lambda s: print(s, file=sys.stderr, flush=True))
+    _entry, rc = tuner.run_tune(
+        x, y, base_config=base, knobs=knobs, grids=grids,
+        probe_iters=args.probe_iters, rungs=args.rungs,
+        deadline_s=args.deadline_s, min_win_pct=args.min_win_pct,
+        profile_out=args.out, trace_dir=args.trace_dir,
+        ledger_on=args.ledger, log=log)
+    return rc
 
 
 def cmd_scale(args: argparse.Namespace) -> int:
@@ -2100,12 +2282,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             child, retries=args.retries, backoff_s=args.retry_backoff,
             checkpoint_path=args.checkpoint)
     try:
-        if args.command in ("train", "test", "serve"):
+        if args.command in ("train", "test", "serve", "tune"):
             rc = _init_backend(args)
             if rc:
                 return rc
         if args.command == "train":
             return cmd_train(args)
+        if args.command == "tune":
+            return cmd_tune(args)
         if args.command == "convert":
             return cmd_convert(args)
         if args.command == "scale":

@@ -111,20 +111,26 @@ class SVMModel:
 
 @functools.partial(jax.jit, static_argnames=("kind", "degree",
                                              "include_b",
-                                             "num_segments"))
+                                             "num_segments",
+                                             "precision_name"))
 def _pairwise_decisions_jit(x_test, sv_all, coef, seg_ids, b_vec, gamma,
                             coef0, kind: str, degree: int,
-                            include_b: bool, num_segments: int):
+                            include_b: bool, num_segments: int,
+                            precision_name: str = "HIGHEST"):
     """All P pairwise decisions in one pass (models/multiclass.py's
     batched path): one (m, d) @ (d, S) kernel matmul over the
     concatenated SV rows, then a sorted segment_sum per pair — O(m*S)
     like the per-model loop (no dense (S, P) reduction matrix), and a
     non-finite kernel value stays confined to its own pair's decision
-    exactly as in the loop."""
+    exactly as in the loop. ``precision_name`` is the serving engine's
+    MXU-mode knob (HIGHEST = exact f32 parity, the default — the
+    segment_sum reduction stays float32 in either mode)."""
+    precision = getattr(jax.lax.Precision, precision_name)
     spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
     t2 = row_norms_sq(x_test)
     sv2 = row_norms_sq(sv_all)
-    k = kernel_rows(x_test, t2, sv_all, sv2, spec)    # (m, S)
+    k = kernel_rows(x_test, t2, sv_all, sv2, spec,
+                    precision=precision)              # (m, S)
     dual = jax.ops.segment_sum((k * coef[None, :]).T, seg_ids,
                                num_segments=num_segments,
                                indices_are_sorted=True).T    # (m, P)
@@ -133,15 +139,23 @@ def _pairwise_decisions_jit(x_test, sv_all, coef, seg_ids, b_vec, gamma,
     return dual
 
 
-@functools.partial(jax.jit, static_argnames=("kind", "degree", "include_b"))
+@functools.partial(jax.jit, static_argnames=("kind", "degree",
+                                             "include_b",
+                                             "precision_name"))
 def _decision_jit(x_test, x_sv, coef, sv2, b, gamma, coef0,
-                  kind: str, degree: int, include_b: bool):
+                  kind: str, degree: int, include_b: bool,
+                  precision_name: str = "HIGHEST"):
     # kind/degree select the program (static); gamma/coef0 are traced so
     # a hyperparameter sweep reuses one compilation per kernel kind.
+    # precision_name (serving's --precision knob): HIGHEST = exact f32
+    # (the default, bitwise decision_function parity); DEFAULT = bf16
+    # multiplies with f32 MXU accumulation for the (m, n_sv) pass.
+    precision = getattr(jax.lax.Precision, precision_name)
     spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
     t2 = row_norms_sq(x_test)
-    k = kernel_rows(x_test, t2, x_sv, sv2, spec)      # (m, n_sv)
-    dual = k @ coef
+    k = kernel_rows(x_test, t2, x_sv, sv2, spec,
+                    precision=precision)              # (m, n_sv)
+    dual = jnp.matmul(k, coef, precision=precision)
     if include_b:
         dual = dual - b
     return dual

@@ -48,8 +48,10 @@ LEDGER_ENV = "DPSVM_PERF_LEDGER"
 LEDGER_SCHEMA = 1
 
 #: record kinds the documented producers write (free strings otherwise;
-#: this is the vocabulary, like record.SERVING_EVENTS)
-KINDS = ("bench", "burst", "loadgen", "compare")
+#: this is the vocabulary, like record.SERVING_EVENTS). "tune" rows
+#: come from `dpsvm tune` (tuning/tuner.py): per-knob probe readings
+#: plus the tuned_vs_default A/B verdict.
+KINDS = ("bench", "burst", "loadgen", "compare", "tune")
 
 #: unit -> gate direction ("higher" = bigger is better). The per-record
 #: ``direction`` field wins; the metric-name heuristics below back this

@@ -30,8 +30,10 @@ vocabulary. This module is that surface:
 
 Deliberately dependency-free (stdlib only — not even numpy): the
 registry is imported by the serving layer, the CLI and the driver, and
-must never force a backend init. This registry is the contract the
-ROADMAP-5 autotuner will read from; keep the instrument API stable.
+must never force a backend init. This registry is a contract the
+`dpsvm tune` autotuner READS (tuning/tuner.py: every train probe rides
+the driver's ``dpsvm_train_*`` feed and snapshots it into its probe
+ledger rows); keep the instrument API stable.
 """
 
 from __future__ import annotations

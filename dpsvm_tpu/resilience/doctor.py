@@ -31,6 +31,11 @@ with a one-line diagnosis.
    network filesystem surfaces as MB/s before the run starts, not as
    a mystery stall an hour in). Distinct exit codes: 7 = integrity,
    8 = disk space.
+
+The doctor also REPORTS (never gates on) the tuned-knob profile
+resolution would consult for this backend — knobs, provenance and the
+measured win, or exactly why no entry applies (docs/PERF.md
+"Autotuning").
 """
 
 from __future__ import annotations
@@ -224,6 +229,14 @@ def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
         getattr(devices[0], "device_kind", None)]
     for line in roofline.doctor_lines(kinds):
         out(f"roofline: {line}")
+    # Tuned-profile resolution (docs/PERF.md "Autotuning"): which
+    # per-backend knob profile train/serve would consult right now —
+    # or exactly why none applies (missing, opted out, wrong backend,
+    # provenance-invalid).
+    from dpsvm_tpu.tuning import profile as tuned_profile
+
+    for line in tuned_profile.doctor_lines(kinds[0] if kinds else None):
+        out(f"tuned: {line}")
     p = int(shards) or len(devices)
     if p > len(devices):
         out(f"DOCTOR FAIL: asked for {p} shards but only "

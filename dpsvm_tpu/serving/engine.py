@@ -37,6 +37,10 @@ bucket 64 is bit-identical to the same row through a direct
 ``decision_function`` call — the selfcheck asserts this too. (The
 engine reuses the exact jitted programs ``models/svm.py`` evaluates
 with, so there is one definition of the decision math in the repo.)
+That guarantee holds at the default ``precision="highest"``; the
+opt-in bf16 ladder (``serve --precision default`` — bf16 multiplies,
+f32 accumulation, docs/SERVING.md) trades it for a pinned float
+tolerance against the f32 reference decisions instead.
 
 Model coverage = everything ``models/io.py`` / ``models/multiclass.py``
 can persist: binary SVC (with optional Platt sidecar), SVR, one-class,
@@ -129,10 +133,21 @@ class PredictionEngine:
     def __init__(self, model: AnyModel, *, name: str = "default",
                  max_batch: int = 256, include_b: bool = True,
                  platt: Optional[Tuple[float, float]] = None,
-                 source: Optional[str] = None, warmup: bool = True):
+                 source: Optional[str] = None, warmup: bool = True,
+                 precision: str = "highest"):
+        if precision not in ("highest", "high", "default"):
+            raise ValueError("precision must be 'highest', 'high' or "
+                             f"'default', got {precision!r}")
         self.name = str(name)
         self.include_b = bool(include_b)
         self.source = source
+        # MXU mode of the decision ladder ("serve --precision"):
+        # "highest" = exact f32, the default and the bitwise-
+        # decision_function-parity path; "default" = bf16 multiplies
+        # with f32 accumulation (docs/SERVING.md). The precomputed-
+        # kernel decider is host NumPy and ignores the knob.
+        self.precision = str(precision)
+        self._pname = self.precision.upper()
         self.max_batch = int(max_batch)
         self.buckets = bucket_ladder(self.max_batch)
         self.multiclass = isinstance(model, MulticlassModel)
@@ -216,11 +231,12 @@ class PredictionEngine:
             args, kw = _decider_args(model)
             run = compilewatch.instrument(_approx_decision_jit,
                                           f"{tag}-approx-decision")
-            include_b = self.include_b
+            include_b, pname = self.include_b, self._pname
 
             def decide(block: np.ndarray) -> np.ndarray:
                 return np.asarray(run(jnp.asarray(block), *args,
-                                      include_b=include_b, **kw))
+                                      include_b=include_b,
+                                      precision_name=pname, **kw))
 
             return decide
 
@@ -255,11 +271,12 @@ class PredictionEngine:
         run = compilewatch.instrument(_decision_jit, f"{tag}-decision")
         kind, degree, include_b = model.kernel, int(model.degree), \
             self.include_b
+        pname = self._pname
 
         def decide(block: np.ndarray) -> np.ndarray:
             return np.asarray(run(jnp.asarray(block), x_sv, coef, sv2,
                                   b, gamma, coef0, kind, degree,
-                                  include_b))
+                                  include_b, pname))
 
         return decide
 
@@ -282,7 +299,8 @@ class PredictionEngine:
         spec = ms[0]
         self._mc_kw = dict(kind=spec.kernel, degree=int(spec.degree),
                            include_b=self.include_b,
-                           num_segments=len(ms))
+                           num_segments=len(ms),
+                           precision_name=self._pname)
         self._gamma = jnp.float32(spec.gamma)
         self._coef0 = jnp.float32(spec.coef0)
         self._mc_run = compilewatch.instrument(
@@ -357,6 +375,7 @@ class PredictionEngine:
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
             "include_b": self.include_b,
+            "precision": self.precision,
             "calibrated": self.calibrated,
             "warmup_compiles": len(self.warmup_compiles),
             "warmup_compile_seconds": round(

@@ -1,0 +1,208 @@
+"""Ledger-driven autotuning: measure the hand-set knobs, persist the
+winners per backend, resolve them at config time (docs/PERF.md
+"Autotuning").
+
+The pieces:
+
+* ``tuner``   — ``dpsvm tune``: deterministic, deadline-bounded
+                successive-halving probes over a bounded per-knob grid,
+                each probe a short run through the existing driver /
+                serving plumbing (traces, compilewatch and the metrics
+                registry come for free), compile-corrected rates, an
+                end-to-end ``tuned_vs_default`` A/B gated by
+                ``dpsvm compare`` and appended to the perf ledger.
+* ``profile`` — the persisted per-``device_kind`` profile (JSON with
+                git_sha / timestamp / probe-row provenance + the
+                measured win) and its resolution precedence:
+                explicit value > tuned profile > built-in default,
+                ``--no-tuned`` / ``DPSVM_NO_TUNED=1`` opt-out,
+                backend-mismatch invalidation. ``dpsvm doctor``
+                reports the active entry.
+
+CI gate: ``python -m dpsvm_tpu.tuning --selfcheck`` — sibling of the
+telemetry/resilience/serving/approx/data gates. Asserts (1) a real
+tiny-grid tune run persists a provenance-valid profile whose probe
+rows carry traces and land in the perf ledger; (2) config resolution
+picks a planted profile up, explicit values and the opt-outs win over
+it, and a wrong-backend entry is never applied; (3) the probe
+comparison structurally rejects a planted slower-than-default
+candidate — at the selection rule AND through a full successive-
+halving round.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["main", "selfcheck"]
+
+
+def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
+    """Returns a list of problems (empty = gate passes)."""
+    import json
+    import tempfile
+
+    problems: List[str] = []
+    base = tmp_dir or tempfile.mkdtemp(prefix="dpsvm_tune_selfcheck_")
+    old_ledger = os.environ.get("DPSVM_PERF_LEDGER")
+    old_noenv = os.environ.pop("DPSVM_NO_TUNED", None)
+    ledger_path = os.path.join(base, "ledger.jsonl")
+    os.environ["DPSVM_PERF_LEDGER"] = ledger_path
+    try:
+        import dataclasses
+
+        from dpsvm_tpu.config import SVMConfig
+        from dpsvm_tpu.data.synthetic import make_blobs
+        from dpsvm_tpu.tuning import profile as prof
+        from dpsvm_tpu.tuning import tuner
+
+        logged: List[str] = []
+        x, y = make_blobs(n=800, d=16, seed=0, separation=0.5)
+        base_cfg = SVMConfig(c=10.0, epsilon=1e-5, max_iter=100_000)
+        out = os.path.join(base, "tuned_profile.json")
+
+        # (1) real tiny-grid tune run -> provenance-valid profile.
+        entry, rc = tuner.run_tune(
+            x, y, base_config=base_cfg, knobs=("chunk_iters",),
+            grids={"chunk_iters": (128, 512)}, probe_iters=400,
+            rungs=2, deadline_s=180.0, min_win_pct=1.0,
+            profile_out=out, trace_dir=os.path.join(base, "traces"),
+            log=logged.append)
+        if rc != 0:
+            problems.append(f"tiny tune run exited {rc}")
+        if not os.path.exists(out):
+            problems.append("tune run wrote no profile file")
+        else:
+            dk = prof.current_device_kind()
+            saved = prof.load_profiles(out).get(dk)
+            if saved is None:
+                problems.append(
+                    f"profile has no entry for backend {dk!r}")
+            else:
+                bad = prof.validate_entry(saved)
+                if bad:
+                    problems.append(f"persisted entry invalid: {bad}")
+                if not saved.get("probes"):
+                    problems.append("entry carries no probe rows")
+                elif not any(p.get("trace") for p in saved["probes"]):
+                    problems.append("no probe row carries a trace "
+                                    "pointer")
+        if not os.path.exists(ledger_path):
+            problems.append("probes appended no perf-ledger rows")
+        else:
+            from dpsvm_tpu.observability import ledger as ledgerlib
+            rows = ledgerlib.read(ledger_path)
+            if not any(r.get("kind") == "tune" and
+                       r.get("case") == "tune_probe_chunk_iters"
+                       for r in rows):
+                problems.append("ledger has no tune_probe_chunk_iters "
+                                "row")
+
+        # (2) resolution picks a planted profile up; precedence and
+        # invalidation rules hold.
+        dk = prof.current_device_kind() or "cpu"
+        planted_path = os.path.join(base, "planted_profile.json")
+        prof.save_entry(prof.make_entry(dk, {"chunk_iters": 2048}),
+                        planted_path)
+        cfg, applied = prof.apply_tuned(SVMConfig(), path=planted_path)
+        if applied != {"chunk_iters": 2048} or cfg.chunk_iters != 2048:
+            problems.append(
+                f"resolution did not pick up the planted profile "
+                f"(applied={applied})")
+        cfg, applied = prof.apply_tuned(
+            SVMConfig(), explicit={"chunk_iters"}, path=planted_path)
+        if applied or cfg.chunk_iters != 512:
+            problems.append("explicit CLI knob did not win over the "
+                            "profile")
+        cfg, applied = prof.apply_tuned(SVMConfig(chunk_iters=64),
+                                        path=planted_path)
+        if applied or cfg.chunk_iters != 64:
+            problems.append("non-default config value did not win "
+                            "over the profile")
+        os.environ["DPSVM_NO_TUNED"] = "1"
+        try:
+            if prof.active_entry(path=planted_path) is not None:
+                problems.append("DPSVM_NO_TUNED=1 did not opt out")
+        finally:
+            os.environ.pop("DPSVM_NO_TUNED", None)
+        mism_path = os.path.join(base, "mismatch_profile.json")
+        prof.save_entry(prof.make_entry("TPU v99", {"chunk_iters": 9}),
+                        mism_path)
+        cfg, applied = prof.apply_tuned(SVMConfig(), path=mism_path)
+        if applied:
+            problems.append("wrong-backend entry was applied")
+        # provenance-or-nothing: strip git_sha and the entry must die
+        broken = prof.make_entry(dk, {"chunk_iters": 7})
+        broken["git_sha"] = ""
+        with open(os.path.join(base, "broken.json"), "w") as fh:
+            json.dump({"schema": prof.PROFILE_SCHEMA,
+                       "profiles": {dk: broken}}, fh)
+        if prof.active_entry(path=os.path.join(base,
+                                               "broken.json")):
+            problems.append("entry without git_sha provenance was "
+                            "accepted")
+        if prof.provenance_tag(path=planted_path) is None:
+            problems.append("provenance_tag returned None for an "
+                            "active entry")
+
+        # (3) planted slower-than-default candidate is rejected — at
+        # the rule and through a full halving round.
+        w, imp = tuner.select_winner(512, {512: 100.0, 2048: 80.0},
+                                     2.0)
+        if imp or w != 512:
+            problems.append("select_winner accepted a slower-than-"
+                            "default candidate")
+        planted_rates = {512: 100.0, 128: 60.0, 2048: 90.0}
+
+        def fake_measure(v, budget, rung):
+            from dpsvm_tpu.observability import ledger as ledgerlib
+            return ledgerlib.make_record(
+                "tune_probe_chunk_iters",
+                {"knob": "chunk_iters", "candidate": int(v),
+                 "rung": int(rung), "budget_iters": int(budget)},
+                kind="tune", value=planted_rates[v], unit="iter/s")
+
+        import time as _time
+        final, _ = tuner.successive_halving(
+            (128, 2048), 512, fake_measure, (100, 200),
+            _time.monotonic() + 60.0, lambda s: None)
+        w, imp = tuner.select_winner(512, final, 2.0)
+        if imp or w != 512:
+            problems.append(
+                "successive halving + comparison accepted a planted "
+                f"slower-than-default grid (winner {w})")
+    except Exception as e:                  # noqa: BLE001
+        import traceback
+        traceback.print_exc()
+        problems.append(f"selfcheck crashed: {type(e).__name__}: {e}")
+    finally:
+        if old_ledger is None:
+            os.environ.pop("DPSVM_PERF_LEDGER", None)
+        else:
+            os.environ["DPSVM_PERF_LEDGER"] = old_ledger
+        if old_noenv is not None:
+            os.environ["DPSVM_NO_TUNED"] = old_noenv
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.tuning")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="run the autotuning CI gate (see module "
+                        "docstring)")
+    args = p.parse_args(argv)
+    if not args.selfcheck:
+        p.print_help()
+        return 2
+    problems = selfcheck()
+    if problems:
+        print("tuning selfcheck FAILED:", file=sys.stderr)
+        for prob in problems:
+            print(f"  - {prob}", file=sys.stderr)
+        return 1
+    print("tuning selfcheck OK")
+    return 0

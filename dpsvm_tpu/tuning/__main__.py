@@ -1,0 +1,9 @@
+"""``python -m dpsvm_tpu.tuning`` — the autotuning selfcheck CI gate
+(sibling of ``python -m dpsvm_tpu.telemetry``, ``-m .resilience``,
+``-m .serving``, ``-m .approx`` and ``-m .data``)."""
+
+import sys
+
+from dpsvm_tpu.tuning import main
+
+sys.exit(main())

@@ -20,6 +20,11 @@ os.environ["JAX_PLATFORMS"] = os.environ.get("DPSVM_TEST_PLATFORM", "cpu")
 # the ledger itself monkeypatch.setenv a tmp path; the setting is
 # inherited by every subprocess the suite spawns (bench/burst/CLI).
 os.environ.setdefault("DPSVM_PERF_LEDGER", "")
+# Same convention for the tuned-knob profile (tuning/profile.py): the
+# suite must be knob-deterministic regardless of any profile a dev
+# machine carries, so profile resolution is disabled (empty env = off);
+# tuning tests monkeypatch a tmp path.
+os.environ.setdefault("DPSVM_TUNED_PROFILE", "")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,157 @@
+"""bf16 on the last f32-only hot paths (ROADMAP 4): matmul_precision
+threaded through approx featurization (in-memory + streaming) and the
+serving decision ladder, each behind a PINNED parity tolerance, with
+Precision.HIGHEST remaining the default and reference-parity path.
+
+The tolerances are sized for the bf16 MXU (relative error ~0.4% per
+product, f32 accumulation); on the CPU test backend both precisions
+lower to f32, so the pins also guarantee the plumbing cannot drift the
+HIGHEST path."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.api import fit
+from dpsvm_tpu.approx.features import (build_feature_map, featurize,
+                                       featurize_fn)
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.models.svm import decision_function
+from dpsvm_tpu.ops.kernels import KernelSpec
+from dpsvm_tpu.serving.engine import PredictionEngine
+
+#: pinned parity tolerances (absolute, on unit-scale features /
+#: few-unit-scale decisions): the bf16 paths must stay inside these on
+#: EVERY backend — the acceptance gate of docs/PERF.md "bf16 featurize
+#: & serving ladder".
+FEATURIZE_TOL = 2e-2
+DECISION_TOL = 5e-2
+
+
+def _fmap(d=16, dim=256, kind="rff"):
+    x, _ = make_blobs(n=300, d=d, seed=0)
+    return x, build_feature_map(kind, x, dim, 0,
+                                KernelSpec(kind="rbf", gamma=0.25))
+
+
+# -- featurize path --------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["rff", "nystrom"])
+def test_featurize_bf16_parity_pinned(kind):
+    x, fm = _fmap(kind=kind)
+    phi_hi = featurize(fm, x)
+    phi_bf = featurize(fm, x, precision="default")
+    assert np.max(np.abs(phi_hi - phi_bf)) <= FEATURIZE_TOL
+    # highest stays the default argument (the reference-parity path)
+    assert np.array_equal(phi_hi, featurize(fm, x,
+                                            precision="highest"))
+
+
+def test_featurize_fn_threads_precision():
+    import jax.numpy as jnp
+    x, fm = _fmap()
+    hi = featurize_fn(fm)(jnp.asarray(x[:64]))
+    bf = featurize_fn(fm, precision="default")(jnp.asarray(x[:64]))
+    assert np.max(np.abs(np.asarray(hi) - np.asarray(bf))) \
+        <= FEATURIZE_TOL
+
+
+def test_approx_fit_bf16_decision_parity_pinned():
+    # the in-memory primal path trains its featurization (and GEMMs)
+    # at config.matmul_precision; decisions of the two trained models
+    # must agree within the pinned tolerance (the convergence metric
+    # bounds both trajectories at the shared epsilon)
+    x, y = make_blobs(n=500, d=12, seed=1)
+    base = SVMConfig(solver="approx-rff", approx_dim=128,
+                     max_iter=60_000)
+    m_hi, _ = fit(x, y, base)
+    m_bf, _ = fit(x, y, dataclasses.replace(
+        base, matmul_precision="default"))
+    d_hi = decision_function(m_hi, x[:100])
+    d_bf = decision_function(m_bf, x[:100])
+    assert np.max(np.abs(d_hi - d_bf)) <= DECISION_TOL
+
+
+def test_stream_fit_bf16_runs_and_matches(tmp_path):
+    # fit_approx_stream featurizes shard blocks at
+    # config.matmul_precision (the _feat_call_args binding)
+    from dpsvm_tpu.approx.primal import fit_approx_stream
+    from dpsvm_tpu.data import stream as streamlib
+    x, y = make_blobs(n=400, d=10, seed=2)
+    src = str(tmp_path / "train.csv")
+    np.savetxt(src, np.column_stack([y, x]), delimiter=",",
+               fmt="%.6f")
+    sdir = str(tmp_path / "shards")
+    streamlib.convert_to_shards(src, sdir, rows_per_shard=128)
+    base = SVMConfig(solver="approx-rff", approx_dim=64,
+                     max_iter=30_000)
+    ds = streamlib.ShardedDataset.open(sdir)
+    m_hi, _ = fit_approx_stream(ds, base)
+    m_bf, _ = fit_approx_stream(
+        ds, dataclasses.replace(base, matmul_precision="default"))
+    d_hi = decision_function(m_hi, x[:80])
+    d_bf = decision_function(m_bf, x[:80])
+    assert np.max(np.abs(d_hi - d_bf)) <= DECISION_TOL
+
+
+# -- serving decision ladder -----------------------------------------
+
+def _sv_model():
+    x, y = make_blobs(n=400, d=10, seed=3)
+    model, _ = fit(x, y, SVMConfig(c=10.0, max_iter=40_000))
+    return model, x
+
+
+def test_serving_ladder_bf16_parity_pinned():
+    model, x = _sv_model()
+    ref = decision_function(model, x[:200])
+    eng_bf = PredictionEngine(model, max_batch=64,
+                              precision="default")
+    assert np.max(np.abs(eng_bf.decision_values(x[:200]) - ref)) \
+        <= DECISION_TOL
+    # HIGHEST remains the default AND the bitwise-parity path
+    eng_hi = PredictionEngine(model, max_batch=64)
+    assert eng_hi.precision == "highest"
+    assert np.array_equal(eng_hi.decision_values(x[:200]), ref)
+
+
+def test_serving_ladder_bf16_approx_model():
+    x, y = make_blobs(n=400, d=10, seed=4)
+    model, _ = fit(x, y, SVMConfig(solver="approx-rff",
+                                   approx_dim=128, max_iter=40_000))
+    ref = decision_function(model, x[:150])
+    eng = PredictionEngine(model, max_batch=64, precision="default")
+    assert np.max(np.abs(eng.decision_values(x[:150]) - ref)) \
+        <= DECISION_TOL
+
+
+def test_engine_precision_validated_and_in_manifest():
+    model, _ = _sv_model()
+    with pytest.raises(ValueError, match="precision"):
+        PredictionEngine(model, precision="bf16")
+    eng = PredictionEngine(model, max_batch=32, precision="default")
+    assert eng.manifest["precision"] == "default"
+    assert PredictionEngine(model, max_batch=32).manifest[
+        "precision"] == "highest"
+
+
+def test_engine_bf16_zero_steady_state_compiles():
+    # the precision knob must not break the ladder's compile economy
+    from dpsvm_tpu.observability import compilewatch
+    model, x = _sv_model()
+    eng = PredictionEngine(model, max_batch=64, precision="default")
+    compilewatch.drain()
+    for m in (1, 5, 17, 64, 150):
+        eng.decision_values(x[:m])
+    assert compilewatch.drain() == []
+
+
+def test_serve_cli_precision_flag_parses():
+    from dpsvm_tpu.cli import build_parser
+    args = build_parser().parse_args(
+        ["serve", "-m", "x.svm", "--precision", "default"])
+    assert args.precision == "default"
+    args = build_parser().parse_args(["serve", "-m", "x.svm"])
+    assert args.precision == "highest" and args.max_batch is None

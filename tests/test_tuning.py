@@ -1,0 +1,346 @@
+"""Ledger-driven autotuning (dpsvm_tpu/tuning/, docs/PERF.md
+"Autotuning"): profile resolution precedence, provenance/backend
+invalidation, the probe comparison's slower-than-default rejection,
+the tiny end-to-end tune run, and the CLI/doctor surfaces."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synthetic import make_blobs
+from dpsvm_tpu.tuning import profile as prof
+from dpsvm_tpu.tuning import tuner
+
+
+def _save(tmp_path, knobs, device_kind=None, name="profile.json",
+          mutate=None):
+    dk = device_kind or prof.current_device_kind()
+    entry = prof.make_entry(dk, knobs)
+    if mutate:
+        mutate(entry)
+    path = str(tmp_path / name)
+    if prof.validate_entry(entry):
+        # invalid-by-design entries bypass save_entry's refusal
+        with open(path, "w") as fh:
+            json.dump({"schema": prof.PROFILE_SCHEMA,
+                       "profiles": {entry["device_kind"]: entry}}, fh)
+        return path
+    return prof.save_entry(entry, path)
+
+
+# -- resolution precedence: explicit > tuned > built-in default ------
+
+def test_tuned_applied_at_default(tmp_path):
+    path = _save(tmp_path, {"chunk_iters": 2048, "cache_lines": 64})
+    cfg, applied = prof.apply_tuned(SVMConfig(), path=path)
+    assert applied == {"chunk_iters": 2048, "cache_size": 64}
+    assert cfg.chunk_iters == 2048 and cfg.cache_size == 64
+
+
+def test_explicit_flag_wins_even_at_default_value(tmp_path):
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    cfg, applied = prof.apply_tuned(SVMConfig(),
+                                    explicit={"chunk_iters"},
+                                    path=path)
+    assert applied == {} and cfg.chunk_iters == 512
+
+
+def test_nondefault_config_value_wins(tmp_path):
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    cfg, applied = prof.apply_tuned(SVMConfig(chunk_iters=64),
+                                    path=path)
+    assert applied == {} and cfg.chunk_iters == 64
+
+
+def test_conflicting_knob_skipped_others_still_apply(tmp_path):
+    # cache on a decomposition config fails validate(); the tuner's
+    # cache verdict must be skipped WITHOUT losing chunk_iters.
+    path = _save(tmp_path, {"chunk_iters": 2048, "cache_lines": 64})
+    cfg, applied = prof.apply_tuned(SVMConfig(working_set=8),
+                                    path=path)
+    assert applied == {"chunk_iters": 2048}
+    assert cfg.cache_size == 0 and cfg.chunk_iters == 2048
+
+
+def test_numpy_backend_never_resolved(tmp_path):
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    cfg, applied = prof.apply_tuned(SVMConfig(backend="numpy"),
+                                    path=path)
+    assert applied == {} and cfg.chunk_iters == 512
+
+
+# -- invalidation: opt-out, backend mismatch, provenance -------------
+
+def test_opt_out_env(tmp_path, monkeypatch):
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    monkeypatch.setenv(prof.NO_TUNED_ENV, "1")
+    assert prof.active_entry(path=path) is None
+    cfg, applied = prof.apply_tuned(SVMConfig(), path=path)
+    assert applied == {}
+
+
+def test_backend_mismatch_invalidates(tmp_path):
+    path = _save(tmp_path, {"chunk_iters": 2048},
+                 device_kind="TPU v99")
+    assert prof.active_entry(path=path) is None
+    cfg, applied = prof.apply_tuned(SVMConfig(), path=path)
+    assert applied == {}
+    # ...but asking FOR that backend finds it
+    assert prof.active_entry(device_kind="TPU v99",
+                             path=path) is not None
+
+
+def test_renamed_entry_is_a_provenance_lie(tmp_path):
+    # an entry copied under another backend's key must not apply there
+    dk = prof.current_device_kind()
+    entry = prof.make_entry("TPU v99", {"chunk_iters": 9})
+    path = str(tmp_path / "copied.json")
+    with open(path, "w") as fh:
+        json.dump({"schema": prof.PROFILE_SCHEMA,
+                   "profiles": {dk: entry}}, fh)
+    assert prof.active_entry(path=path) is None
+
+
+@pytest.mark.parametrize("mutate, problem", [
+    (lambda e: e.update(git_sha=""), "git_sha"),
+    (lambda e: e.update(schema=99), "schema"),
+    (lambda e: e.update(time=""), "timestamp"),
+    (lambda e: e["knobs"].update(chunk_iters="fast"), "non-numeric"),
+])
+def test_invalid_provenance_rejected(tmp_path, mutate, problem):
+    path = _save(tmp_path, {"chunk_iters": 2048}, mutate=mutate)
+    entry = prof.load_profiles(path)[prof.current_device_kind()]
+    assert any(problem in p for p in prof.validate_entry(entry))
+    assert prof.active_entry(path=path) is None
+
+
+def test_save_entry_refuses_invalid_and_merges(tmp_path):
+    path = str(tmp_path / "p.json")
+    bad = prof.make_entry("cpu", {"chunk_iters": 1024})
+    bad["git_sha"] = ""
+    with pytest.raises(ValueError, match="invalid profile"):
+        prof.save_entry(bad, path)
+    prof.save_entry(prof.make_entry("cpu", {"chunk_iters": 1024}),
+                    path)
+    prof.save_entry(prof.make_entry("TPU v5e",
+                                    {"chunk_iters": 4096}), path)
+    profiles = prof.load_profiles(path)
+    assert set(profiles) == {"cpu", "TPU v5e"}
+    assert profiles["cpu"]["knobs"]["chunk_iters"] == 1024
+
+
+def test_disabled_env_and_damaged_file_degrade(tmp_path, monkeypatch):
+    monkeypatch.setenv(prof.PROFILE_ENV, "")
+    assert prof.profile_path() is None
+    assert prof.active_entry() is None
+    path = str(tmp_path / "torn.json")
+    with open(path, "w") as fh:
+        fh.write('{"schema": 1, "profiles": {')
+    assert prof.load_profiles(path) == {}
+
+
+# -- probe comparison: planted slower-than-default must lose ---------
+
+def test_select_winner_rejects_slower_candidate():
+    winner, improved = tuner.select_winner(
+        512, {512: 100.0, 2048: 80.0, 128: 95.0}, 2.0)
+    assert winner == 512 and not improved
+
+
+def test_select_winner_needs_the_margin():
+    winner, improved = tuner.select_winner(512, {512: 100.0,
+                                                 1024: 101.0}, 2.0)
+    assert winner == 512 and not improved
+    winner, improved = tuner.select_winner(512, {512: 100.0,
+                                                 1024: 110.0}, 2.0)
+    assert winner == 1024 and improved
+
+
+def test_select_winner_requires_anchored_default():
+    with pytest.raises(ValueError, match="unanchored"):
+        tuner.select_winner(512, {1024: 110.0}, 2.0)
+
+
+def _fake_measure(rates):
+    def measure(v, budget, rung):
+        from dpsvm_tpu.observability import ledger
+        return ledger.make_record(
+            "tune_probe_fake",
+            {"knob": "fake", "candidate": int(v), "rung": int(rung),
+             "budget_iters": int(budget)},
+            kind="tune", value=rates[v], unit="iter/s")
+    return measure
+
+
+def test_halving_prunes_keeps_default_and_rejects_planted_grid():
+    rates = {64: 50.0, 128: 60.0, 512: 100.0, 1024: 70.0, 2048: 90.0}
+    calls = []
+
+    def measure(v, budget, rung):
+        calls.append((v, rung))
+        return _fake_measure(rates)(v, budget, rung)
+
+    final, probes = tuner.successive_halving(
+        (64, 128, 1024, 2048), 512, measure, (100, 200, 400),
+        time.monotonic() + 60.0, lambda s: None)
+    # default measured at every rung, the slowest cut early
+    assert 512 in final
+    assert (64, 2) not in calls
+    winner, improved = tuner.select_winner(512, final, 2.0)
+    assert winner == 512 and not improved
+    assert len(probes) == len(calls)
+
+
+def test_halving_deadline_expires():
+    with pytest.raises(tuner.DeadlineExpired):
+        tuner.successive_halving(
+            (128, 1024), 512, _fake_measure({128: 1.0, 512: 2.0,
+                                             1024: 3.0}),
+            (100, 200), time.monotonic() - 1.0, lambda s: None)
+
+
+# -- real probes + the tiny end-to-end tune run ----------------------
+
+def test_probe_train_row_shape(tmp_path):
+    x, y = make_blobs(n=400, d=8, seed=0)
+    cfg = SVMConfig(c=10.0, epsilon=1e-5, max_iter=100_000)
+    row = tuner.probe_train(x, y, cfg, "chunk_iters", 256, 400, 0,
+                            trace_dir=str(tmp_path))
+    assert row["kind"] == "tune"
+    assert row["case"] == "tune_probe_chunk_iters"
+    assert row["value"] > 0 and row["unit"] == "iter/s"
+    m = row["metrics"]
+    assert m["candidate"] == 256 and m["n_iter"] > 0
+    assert os.path.exists(row["trace"])
+    # the probe's compile seconds came from its own trace
+    assert m["compile_seconds"] >= 0.0
+
+
+def test_probe_serve_rate(tmp_path):
+    from dpsvm_tpu.api import fit
+    x, y = make_blobs(n=300, d=8, seed=0)
+    model, _ = fit(x, y, SVMConfig(c=1.0, max_iter=20_000))
+    rows = np.random.default_rng(0).standard_normal(
+        (max(tuner.SERVE_SIZES), 8)).astype(np.float32)
+    row = tuner.probe_serve(model, 128, 0, 1, rows)
+    assert row["case"] == "tune_probe_serve_max_batch"
+    assert row["value"] > 0 and row["unit"] == "rows/s"
+    assert row["metrics"]["buckets"][-1] == 128
+
+
+def test_run_tune_tiny_end_to_end(tmp_path, monkeypatch):
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("DPSVM_PERF_LEDGER", ledger_path)
+    x, y = make_blobs(n=600, d=8, seed=0, separation=0.5)
+    out = str(tmp_path / "tuned_profile.json")
+    entry, rc = tuner.run_tune(
+        x, y, base_config=SVMConfig(c=10.0, epsilon=1e-5,
+                                    max_iter=100_000),
+        knobs=("chunk_iters",), grids={"chunk_iters": (128, 512)},
+        probe_iters=300, rungs=2, deadline_s=120.0, min_win_pct=1.0,
+        profile_out=out, trace_dir=str(tmp_path / "traces"),
+        log=lambda s: None)
+    assert rc == 0
+    assert prof.validate_entry(entry) == []
+    saved = prof.load_profiles(out)[prof.current_device_kind()]
+    assert saved["knobs"] == entry["knobs"]
+    assert saved["probes"] and any(p.get("trace")
+                                   for p in saved["probes"])
+    # ledger rows landed (probe rows always; the A/B row when a knob
+    # improved)
+    from dpsvm_tpu.observability import ledger
+    rows = ledger.read(ledger_path)
+    assert any(r["case"] == "tune_probe_chunk_iters" for r in rows)
+    if entry["knobs"]:
+        assert any(r["case"] == "tuned_vs_default" for r in rows)
+        win = entry["win"]
+        assert win["trace_tuned"] and os.path.exists(
+            win["trace_tuned"])
+        assert "compare_ok" in win
+
+
+def test_run_tune_deadline_expired_exits_1(tmp_path):
+    x, y = make_blobs(n=300, d=8, seed=0)
+    entry, rc = tuner.run_tune(
+        x, y, knobs=("chunk_iters",),
+        grids={"chunk_iters": (128, 512)}, probe_iters=100, rungs=1,
+        deadline_s=0.0, profile_out=str(tmp_path / "p.json"),
+        log=lambda s: None)
+    assert rc == 1 and entry == {}
+    assert not os.path.exists(str(tmp_path / "p.json"))
+
+
+# -- surfaces: CLI train resolution, doctor, provenance tag ----------
+
+def _write_csv(tmp_path, n=120, d=6):
+    x, y = make_blobs(n=n, d=d, seed=0)
+    src = str(tmp_path / "train.csv")
+    np.savetxt(src, np.column_stack([y, x]), delimiter=",", fmt="%.6f")
+    return src
+
+
+def test_cli_train_consults_profile(tmp_path, monkeypatch, capsys):
+    from dpsvm_tpu.cli import main
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    monkeypatch.setenv(prof.PROFILE_ENV, path)
+    src = _write_csv(tmp_path)
+    model = str(tmp_path / "m.svm")
+    assert main(["train", "-f", src, "-m", model, "-n", "4000"]) == 0
+    assert "tuned profile: chunk_iters=2048" in capsys.readouterr().err
+
+    # explicit flag wins — even set to the tuned value's default
+    assert main(["train", "-f", src, "-m", model, "-n", "4000",
+                 "--chunk-iters", "512"]) == 0
+    assert "tuned profile:" not in capsys.readouterr().err
+
+    # --no-tuned opts out
+    assert main(["train", "-f", src, "-m", model, "-n", "4000",
+                 "--no-tuned"]) == 0
+    assert "tuned profile:" not in capsys.readouterr().err
+
+
+def test_doctor_lines_report_states(tmp_path, monkeypatch):
+    dk = prof.current_device_kind()
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    lines = prof.doctor_lines(dk, path=path)
+    assert any("active profile" in ln and "chunk_iters=2048" in ln
+               for ln in lines)
+    assert any("provenance: git" in ln for ln in lines)
+    missing = prof.doctor_lines(dk, path=str(tmp_path / "none.json"))
+    assert any("no tuned profile" in ln for ln in missing)
+    monkeypatch.setenv(prof.NO_TUNED_ENV, "1")
+    assert any("OPT-OUT" in ln
+               for ln in prof.doctor_lines(dk, path=path))
+    monkeypatch.delenv(prof.NO_TUNED_ENV)
+    mism = _save(tmp_path, {"chunk_iters": 9}, device_kind="TPU v99",
+                 name="mism.json")
+    assert any("no valid entry" in ln
+               for ln in prof.doctor_lines(dk, path=mism))
+
+
+def test_doctor_cli_reports_tuned(tmp_path, monkeypatch, capsys):
+    from dpsvm_tpu.cli import main
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    monkeypatch.setenv(prof.PROFILE_ENV, path)
+    assert main(["doctor", "--shards", "1", "--timeout", "60"]) == 0
+    out = capsys.readouterr().out
+    assert "tuned: active profile" in out
+
+
+def test_provenance_tag_for_bench_rows(tmp_path, monkeypatch):
+    path = _save(tmp_path, {"chunk_iters": 2048})
+    tag = prof.provenance_tag(path=path)
+    dk = prof.current_device_kind()
+    assert tag is not None and tag.startswith(f"{dk}@")
+    assert prof.provenance_tag(path=str(tmp_path / "nope.json")) is None
+
+
+def test_tune_selfcheck_gate():
+    # the CI gate itself (subprocess would re-pay jax startup; the
+    # in-process call is the same code path the gate runs)
+    from dpsvm_tpu.tuning import selfcheck
+    assert selfcheck() == []
