@@ -192,6 +192,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ summary — zero extra device polls; render "
                          "with `dpsvm report PATH`, schema in "
                          "docs/OBSERVABILITY.md)")
+    tr.add_argument("--watch-rules", default=None, metavar="FILE",
+                    help="alert-rules JSON for the continuous watch "
+                         "(gap stagnation, compile storm, heartbeat "
+                         "age, roofline drop vs the perf-ledger "
+                         "median; default rules when only "
+                         "--bundle-dir is given — "
+                         "docs/OBSERVABILITY.md 'Watch & alerts')")
+    tr.add_argument("--bundle-dir", default=None, metavar="DIR",
+                    help="arm the black-box flight recorder: a firing "
+                         "watch rule or tripped divergence guard "
+                         "dumps a self-contained incident bundle here "
+                         "(ring trace + metrics + doctor + tuned "
+                         "profile + ledger context; render with "
+                         "`dpsvm bundle DIR`) — zero extra device "
+                         "transfers")
     tr.add_argument("--debug-nans", action="store_true",
                     help="enable jax_debug_nans during training")
     tr.add_argument("--precision", default="highest",
@@ -583,6 +598,67 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--json", action="store_true",
                     help="machine-readable summary")
 
+    wt = sub.add_parser(
+        "watch", help="continuous SLO watch: tail a live /metricsz "
+                      "endpoint, a --metrics-out snapshot file or an "
+                      "in-flight run trace, evaluate the alert rules "
+                      "and exit with a distinct code per severity "
+                      "(0 = clean, 4 = warn fired, 5 = page fired, "
+                      "3 = source stale/unreachable) so cron/CI can "
+                      "gate on it (docs/OBSERVABILITY.md 'Watch & "
+                      "alerts')")
+    src = wt.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", default=None,
+                     help="base URL (or full /metricsz URL) of a live "
+                          "`dpsvm serve` / `train --metrics-port` "
+                          "process to poll")
+    src.add_argument("--metrics-file", default=None, metavar="FILE",
+                     help="a `train --metrics-out` snapshot file to "
+                          "tail (the seq header detects missed/"
+                          "duplicate snapshots)")
+    src.add_argument("--trace", default=None, metavar="PATH",
+                     help="a run-telemetry trace (or directory — "
+                          "newest *.jsonl) to tail; chunk records "
+                          "become training watch samples")
+    wt.add_argument("--rules", default=None, metavar="FILE",
+                    help="alert-rules JSON (default: the built-in "
+                         "serving rules for --url/--metrics-file, the "
+                         "training rules for --trace)")
+    wt.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="poll interval (default 2 s)")
+    wt.add_argument("--for", dest="duration", type=float, default=0.0,
+                    metavar="S",
+                    help="watch this long then exit (0 = until the "
+                         "source ends: trace summary/terminal event, "
+                         "or stale timeout)")
+    wt.add_argument("--once", action="store_true",
+                    help="evaluate one sample and exit (CI gate mode)")
+    wt.add_argument("--stale-timeout", type=float, default=60.0,
+                    metavar="S",
+                    help="exit 3 when the source stops updating for "
+                         "this long (default 60 s)")
+    wt.add_argument("--bundle-dir", default=None, metavar="DIR",
+                    help="dump an incident bundle here when a rule "
+                         "fires (the watch-side black box: recent "
+                         "samples + alert history)")
+    wt.add_argument("--json", action="store_true",
+                    help="machine-readable final state instead of the "
+                         "live rendering")
+    wt.add_argument("-q", "--quiet", action="store_true")
+
+    bd = sub.add_parser(
+        "bundle", help="render + validate an incident bundle dumped "
+                       "by the flight recorder (`--bundle-dir`): "
+                       "incident manifest, embedded-trace report, "
+                       "schema/exposition validation; exit 0 valid / "
+                       "1 invalid (docs/OBSERVABILITY.md 'Incident "
+                       "bundles')")
+    bd.add_argument("dir", help="a bundle directory (incident-*) or a "
+                                "parent --bundle-dir (newest bundle "
+                                "wins)")
+    bd.add_argument("--json", action="store_true",
+                    help="machine-readable manifest + verdict")
+
     sv = sub.add_parser(
         "serve", help="online prediction server: micro-batched "
                       "/v1/predict over any saved model (or several), "
@@ -671,6 +747,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "down under sustained load to bound the "
                          "steady-state overhead, "
                          "docs/OBSERVABILITY.md 'Spans')")
+    sv.add_argument("--watch-rules", default=None, metavar="FILE",
+                    help="alert-rules JSON for the serving watchtower "
+                         "(default: the built-in multi-window "
+                         "availability burn-rate + queue-saturation "
+                         "rules — docs/OBSERVABILITY.md 'Watch & "
+                         "alerts'); alert states ride /metricsz and "
+                         "the events ring")
+    sv.add_argument("--bundle-dir", default=None, metavar="DIR",
+                    help="dump a self-contained incident bundle here "
+                         "when a watch rule fires (flight-recorder "
+                         "trace + metrics + doctor facts; render with "
+                         "`dpsvm bundle DIR`)")
+    sv.add_argument("--no-watch", dest="watch", action="store_false",
+                    default=True,
+                    help="disable the continuous SLO watchtower")
     sv.add_argument("-q", "--quiet", action="store_true")
     _add_backend_flags(sv)
 
@@ -1237,6 +1328,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         metrics_port=args.metrics_port,
         metrics_out=args.metrics_out,
         trace_out=args.trace_out,
+        watch_rules=args.watch_rules,
+        bundle_dir=args.bundle_dir,
         debug_nans=args.debug_nans,
         matmul_precision=args.precision,
         polish=args.polish,
@@ -1811,8 +1904,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             trace_out=args.trace_out,
                             trace_sample_rate=args.trace_sample_rate,
                             metrics_registry=default_registry(),
+                            watch_rules=args.watch_rules,
+                            bundle_dir=args.bundle_dir,
+                            watch=args.watch,
                             verbose=not args.quiet).start()
     except ValueError as e:                 # width-mismatched sibling
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:                    # unreadable rules file
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.port_file:
@@ -2236,6 +2335,249 @@ def cmd_profile(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_watch(args: argparse.Namespace) -> int:
+    """`dpsvm watch`: continuous SLO evaluation against a live source
+    (docs/OBSERVABILITY.md "Watch & alerts"). Pure HTTP/file I/O — no
+    backend init — so it runs from any machine that can reach the
+    source. Exit codes: 0 clean, 4 a warn rule fired, 5 a page rule
+    fired (worst severity DURING the watch — a fired-and-cleared burn
+    still fails the gate), 3 source stale/unreachable, 2 usage."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    from dpsvm_tpu.observability import blackbox, slo
+
+    default_kind = "training" if args.trace else "serving"
+    try:
+        rules = slo.load_rules(args.rules, default=default_kind)
+    except (OSError, ValueError) as e:
+        print(f"error: bad rules: {e}", file=sys.stderr)
+        return 2
+    tower = slo.Watchtower(rules)
+    follower = slo.SnapshotFollower()
+    if args.trace and os.path.isdir(args.trace):
+        from dpsvm_tpu.observability.report import resolve_trace_path
+        try:
+            args.trace = resolve_trace_path(args.trace)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    flight = None
+    if args.bundle_dir:
+        flight = blackbox.FlightRecorder(blackbox.make_manifest(
+            solver=f"watch-{default_kind}",
+            config={"source": args.url or args.metrics_file
+                    or args.trace}))
+
+    def say(msg: str) -> None:
+        if not args.quiet and not args.json:
+            print(msg, flush=True)
+
+    def handle(transitions, t_label) -> None:
+        for tr in transitions:
+            mark = ("FIRING" if tr["state"] == "firing" else "ok")
+            say(f"[{t_label}] {mark:>6} {tr['severity']:<4} "
+                f"{tr['rule']} ({tr['window']}) {tr['reason']}")
+            if flight is not None:
+                flight.event("alert", rule=tr["rule"],
+                             window=tr["window"],
+                             severity=tr["severity"],
+                             state=tr["state"], reason=tr["reason"])
+                if tr["state"] == "firing":
+                    blackbox.dump_bundle(
+                        args.bundle_dir, recorder=flight,
+                        rule=tr["rule"], severity=tr["severity"],
+                        window=tr["window"], reason=tr["reason"],
+                        extra={"source": f"watch-{default_kind}"})
+
+    url = None
+    if args.url:
+        url = args.url.rstrip("/")
+        if not url.endswith("/metricsz"):
+            url += "/metricsz"
+
+    start = time.monotonic()
+    last_progress = start
+    trace_pos = 0
+    trace_done = None
+    stale = False
+    # The SOURCE's own watchtower outranks ours: a serving process
+    # reports its alert states in /metricsz, and a fresh `watch --url
+    # --once` has no sample history of its own — without this merge it
+    # would read a mid-incident server as clean.
+    server_worst: Optional[str] = None
+    server_firing: set = set()
+    while True:
+        now = time.monotonic()
+        got_sample = False
+        if url is not None:
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    raw = r.read()
+                try:
+                    obj = json.loads(raw)
+                except json.JSONDecodeError:
+                    obj = None
+                if isinstance(obj, dict) and ("alerts" in obj
+                                              or "requests" in obj):
+                    # the serving server's JSON blob: counters + its
+                    # own alert states
+                    sample = slo.sample_from_metricsz_json(obj)
+                    firing_now = set()
+                    for a in obj.get("alerts") or []:
+                        if a.get("state") != "firing":
+                            continue
+                        sev = a.get("severity", "warn")
+                        firing_now.add(a.get("rule"))
+                        server_worst = slo.worst_severity(
+                            server_worst, sev)
+                        if a.get("rule") not in server_firing:
+                            say(f"[live] FIRING {sev:<4} "
+                                f"{a.get('rule')} "
+                                f"({a.get('window')}) — reported by "
+                                "the source's own watchtower")
+                    for rule in server_firing - firing_now:
+                        say(f"[live]     ok      {rule} — cleared at "
+                            "the source")
+                    server_firing = firing_now
+                else:
+                    # registry-snapshot shape (the train sidecar):
+                    # re-fetch as the text exposition and flatten
+                    with urllib.request.urlopen(
+                            url + "?format=prometheus",
+                            timeout=10) as r:
+                        sample = slo.sample_from_prometheus(
+                            r.read().decode())
+                handle(tower.observe(sample, t=now), "live")
+                got_sample = True
+            except (urllib.error.URLError, OSError) as e:
+                say(f"source unreachable: {e}")
+        elif args.metrics_file is not None:
+            try:
+                with open(args.metrics_file) as fh:
+                    text = fh.read()
+            except OSError:
+                text = None             # not written yet: wait
+            if text:
+                fresh, problems = follower.note(
+                    slo.parse_snapshot_header(text))
+                for p in problems:
+                    say(f"WARNING: {p}")
+                if fresh:
+                    handle(tower.observe(
+                        slo.sample_from_prometheus(text), t=now),
+                        f"seq={follower.last_seq}")
+                    got_sample = True
+        else:
+            try:
+                with open(args.trace) as fh:
+                    fh.seek(trace_pos)
+                    new = fh.read()
+                    trace_pos = fh.tell()
+            except OSError:
+                new = ""
+            for line in new.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue            # torn final line of a live run
+                got_sample = True
+                kind = rec.get("kind")
+                if kind == "chunk":
+                    t_rec, sample = slo.sample_from_chunk(rec)
+                    handle(tower.observe(sample, t=t_rec),
+                           f"iter={rec.get('n_iter')}")
+                elif (kind == "summary"
+                      or (kind == "event"
+                          and rec.get("event") in ("stall",
+                                                   "preempt"))):
+                    trace_done = (rec.get("event")
+                                  if kind == "event" else "summary")
+        if got_sample:
+            last_progress = now
+        if trace_done is not None:
+            say(f"trace ended ({trace_done})")
+            break
+        if args.once and got_sample:
+            break
+        if args.duration and now - start >= args.duration:
+            break
+        if now - last_progress >= args.stale_timeout:
+            stale = True
+            break
+        time.sleep(max(args.interval, 0.05))
+
+    states = tower.states()
+    worst = slo.worst_severity(tower.worst_fired, server_worst)
+    code = slo.EXIT_STALE if stale else slo.severity_exit_code(worst)
+    if args.json:
+        _pipe_safe_print(json.dumps({
+            "states": states, "worst_fired": worst,
+            "source_reported": sorted(server_firing),
+            "stale": stale,
+            "snapshots": {"missed": follower.missed,
+                          "duplicates": follower.duplicates},
+            "exit_code": code}))
+    else:
+        say("")
+        for s in states:
+            mark = "FIRING" if s["state"] == "firing" else "ok"
+            say(f"{mark:>6} {s['severity']:<4} {s['rule']} "
+                f"({s['window']})"
+                + (f" — {s['reason']}" if s["reason"] else "")
+                + (f" [fired {s['fired_count']}x]"
+                   if s["fired_count"] else ""))
+        for rule in sorted(server_firing):
+            say(f"FIRING (source-reported) {rule}")
+        if stale:
+            print(f"error: source stale for {args.stale_timeout:g}s",
+                  file=sys.stderr)
+    return code
+
+
+def cmd_bundle(args: argparse.Namespace) -> int:
+    """`dpsvm bundle DIR`: render + validate one incident bundle
+    (observability/blackbox.py). Exit 0 = valid, 1 = invalid, 2 = no
+    bundle found."""
+    import json
+
+    from dpsvm_tpu.observability import blackbox
+
+    try:
+        path = blackbox.resolve_bundle_dir(args.dir)
+    except (FileNotFoundError, NotADirectoryError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    problems = blackbox.validate_bundle(path)
+    if args.json:
+        try:
+            incident = blackbox.load_incident(path)
+        except (OSError, json.JSONDecodeError):
+            incident = None
+        _pipe_safe_print(json.dumps({
+            "path": path, "valid": not problems,
+            "problems": problems, "incident": incident}))
+    else:
+        try:
+            _pipe_safe_print(blackbox.render_bundle(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: unrenderable bundle: {e}", file=sys.stderr)
+            return 1
+        if problems:
+            print("bundle INVALID:", file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+        else:
+            _pipe_safe_print("bundle OK (trace schema-valid, "
+                             "exposition grammar-valid)")
+    return 1 if problems else 0
+
+
 def _init_backend(args: argparse.Namespace) -> int:
     """Apply --platform/DPSVM_PLATFORM and fail fast on a dead backend.
 
@@ -2310,6 +2652,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_perf(args)
         if args.command == "profile":
             return cmd_profile(args)
+        if args.command == "watch":
+            return cmd_watch(args)
+        if args.command == "bundle":
+            return cmd_bundle(args)
         if args.command == "serve":
             return cmd_serve(args)
         if args.command == "loadgen":

@@ -355,6 +355,20 @@ class SVMConfig:
                                             # zero extra D2H) + summary;
                                             # render with `dpsvm report`
                                             # (docs/OBSERVABILITY.md)
+    watch_rules: Optional[str] = None       # alert-rules JSON for the
+                                            # driver's continuous watch
+                                            # (observability/slo.py);
+                                            # None with bundle_dir set =
+                                            # the default training rules
+                                            # (docs/OBSERVABILITY.md
+                                            # "Watch & alerts")
+    bundle_dir: Optional[str] = None        # incident bundles land here
+                                            # when a watch rule fires or
+                                            # a divergence guard trips —
+                                            # arms the black-box flight
+                                            # recorder (zero extra D2H:
+                                            # fed from the same packed-
+                                            # stats polls tracing rides)
     debug_nans: bool = False                # jax_debug_nans during training
 
     def fused_incompatibility(self) -> Optional[str]:
@@ -752,6 +766,12 @@ class SVMConfig:
                      "the shrinking loop manages its own dispatch; "
                      "the metrics exporters ride the shared host "
                      "driver"),
+                    ("watch_rules/bundle_dir",
+                     (bool(self.watch_rules) or bool(self.bundle_dir))
+                     and not cascade,
+                     "the shrinking loop manages its own dispatch; "
+                     "the continuous watch rides the shared host "
+                     "driver"),
                     ("on_divergence",
                      self.on_divergence != "raise" and not cascade,
                      "the shrinking loop manages its own dispatch; "
@@ -794,6 +814,8 @@ class SVMConfig:
                 ("metrics_port", self.metrics_port is not None),
                 ("metrics_out", self.metrics_out),
                 ("trace_out", self.trace_out),
+                ("watch_rules", self.watch_rules),
+                ("bundle_dir", self.bundle_dir),
                 ("wall_budget_s", self.wall_budget_s),
                 ("on_divergence", self.on_divergence != "raise"),
                 ("health_window", self.health_window)) if v]
@@ -873,6 +895,8 @@ def _auto_solver_plan(n: int, d: int, config: "SVMConfig") -> dict:
                             and not config.profile_dir
                             and config.metrics_port is None
                             and not config.metrics_out
+                            and not config.watch_rules
+                            and not config.bundle_dir
                             and config.on_divergence == "raise"
                             and not config.health_window
                             and not (config.use_pallas == "on"

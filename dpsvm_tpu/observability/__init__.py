@@ -175,6 +175,220 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
     problems += _selfcheck_ledger(tmp_dir)
     problems += _selfcheck_spans(tmp_dir)
     problems += _selfcheck_roofline(tmp_dir)
+    problems += _selfcheck_watch(tmp_dir)
+    return problems
+
+
+def _selfcheck_watch(tmp_dir: Optional[str] = None) -> List[str]:
+    """The continuous-watch gate (docs/OBSERVABILITY.md "Watch &
+    alerts"): rule round-trip -> a planted burn against an injectable
+    clock fires the multi-window rule and ONLY then -> flight-recorder
+    bundle dump -> the bundle re-validates (embedded trace schema
+    v3, exposition grammar) -> the alert clears after the burn stops
+    — plus the live half: a fault-injected slow replica turns real
+    HTTP requests into a 504 storm that must fire the serving
+    watchtower, dump a bundle and clear once the fault lifts."""
+    import json
+    import os
+    import tempfile
+    import urllib.request
+
+    from dpsvm_tpu.observability import blackbox, slo
+
+    problems: List[str] = []
+    # 1. rule round-trip: specs -> RuleSet -> specs, bit-identical
+    specs = slo.default_serving_rules() + slo.default_training_rules()
+    rs = slo.RuleSet.from_specs(specs)
+    if rs.to_specs() != specs:
+        problems.append("rule round-trip drifted "
+                        f"({rs.to_specs()} != {specs})")
+    # 2. planted burn on an injectable clock: healthy for 120 ticks,
+    # then a 50% 504 ratio — the page rule must fire, and a healthy
+    # steady state must never have fired
+    tower = slo.Watchtower(slo.load_rules(None, default="serving"))
+    fired_at = None
+    for i in range(400):
+        t = float(i)
+        bad = max(0, i - 120) * 5.0 if i <= 240 else 600.0
+        trs = tower.observe({"requests": i * 10.0,
+                             "deadline_504": bad}, t=t)
+        for tr in trs:
+            if tr["state"] == "firing" and fired_at is None:
+                if i <= 120:
+                    problems.append("burn rule fired on healthy "
+                                    f"steady state at t={t}")
+                fired_at = t
+    if fired_at is None:
+        problems.append("planted 50% 504 burn never fired the "
+                        "burn-rate rule")
+    elif not any(s["state"] == "ok" for s in tower.states()
+                 if s["rule"] == "availability-burn"):
+        problems.append("burn-rate alert did not clear after the "
+                        "burn stopped")
+    if tower.worst_fired != "page" or tower.exit_code() != slo.EXIT_PAGE:
+        problems.append(f"watch exit-code contract drifted: "
+                        f"{tower.worst_fired} -> {tower.exit_code()}")
+    # 3. bundle dump -> re-validate
+    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
+        fr = blackbox.FlightRecorder(blackbox.make_manifest(
+            solver="selfcheck-watch"))
+        fr.chunk(n_iter=512, b_lo=0.5, b_hi=-0.5)
+        fr.event("alert", rule="availability-burn",
+                 window="fast=60s/slow=600s", severity="page",
+                 state="firing", reason="selfcheck burn")
+        reg = MetricsRegistry()
+        reg.counter("dpsvm_selfcheck_total", "check").inc()
+        path = blackbox.dump_bundle(
+            td, recorder=fr, rule="availability-burn",
+            severity="page", window="fast=60s/slow=600s",
+            reason="selfcheck burn", registry=reg)
+        if not path:
+            problems.append("bundle dump failed")
+        else:
+            errs = blackbox.validate_bundle(path)
+            if errs:
+                problems.append(f"dumped bundle no longer validates: "
+                                f"{errs}")
+            if blackbox.resolve_bundle_dir(td) != path:
+                problems.append("resolve_bundle_dir lost the bundle")
+        # 4. the live drill: slow-replica fault -> 504 storm through
+        # REAL HTTP -> the server's own watchtower fires + dumps ->
+        # fault lifts -> recovery (alert clears)
+        problems += _watch_storm_drill(td)
+    return problems
+
+
+def _watch_storm_drill(td: str) -> List[str]:
+    """Fault-injected 504 storm against a stub-engine ServingServer
+    (no backend init): the in-process half of the drill that
+    tests/test_watch.py also pins as a subprocess."""
+    import json
+    import os
+    import time
+    import urllib.request
+
+    from dpsvm_tpu.observability import blackbox, slo
+
+    try:
+        import numpy as np
+
+        from dpsvm_tpu.resilience import faultinject
+        from dpsvm_tpu.serving.server import ServingServer
+    except Exception as e:              # pragma: no cover — env issue
+        return [f"watch drill setup failed: {e}"]
+
+    class _Engine:
+        num_attributes = 4
+        calibrated = False
+        manifest = {"task": "selfcheck-stub", "num_attributes": 4}
+
+        def infer(self, x, want):
+            n = int(np.shape(x)[0])
+            return {k: (np.ones(n, np.int32) if k == "labels"
+                        else np.zeros(n, np.float32))
+                    for k in want}
+
+        def bucket_counts(self):
+            return {}
+
+    class _Registry:
+        def __init__(self):
+            self._e = _Engine()
+
+        def names(self):
+            return ["default"]
+
+        def engine(self, name):
+            return self._e
+
+        def build(self, name):
+            return _Engine()
+
+        def manifests(self):
+            return {"default": dict(self._e.manifest, generation=1)}
+
+    problems: List[str] = []
+    bundle_dir = os.path.join(td, "storm-bundles")
+    # tight windows so the drill runs in ~2 s of wall clock; the
+    # determinism tests live on the injectable clock, this drills the
+    # REAL feed path end-to-end
+    rules = [{"name": "availability-burn", "kind": "burn_rate",
+              "severity": "page", "good": "requests",
+              "bad": "deadline_504", "objective": 0.999,
+              "fast_window_s": 0.4, "slow_window_s": 1.0,
+              "threshold": 2.0, "clear_after_s": 0.3}]
+    # ~30 slowed computes cover the storm phase, then the fault lifts
+    faultinject.install(faultinject.FaultPlan(
+        serve_slow_replica_ms=60, serve_slow_for=30))
+    srv = None
+    try:
+        srv = ServingServer(_Registry(), port=0, max_batch=4,
+                            max_delay_ms=0.2, watch_rules=rules,
+                            bundle_dir=bundle_dir).start()
+        body = json.dumps({"instances": [[0.0] * 4],
+                           "timeout_ms": 15}).encode()
+
+        def post():
+            req = urllib.request.Request(
+                srv.url + "/v1/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        deadline = time.monotonic() + 20.0
+        fired = False
+        while time.monotonic() < deadline and not fired:
+            post()
+            fired = any(s["state"] == "firing"
+                        for s in srv.watch.states())
+        if not fired:
+            problems.append("504 storm never fired the serving "
+                            "burn-rate rule")
+        # recovery: the fault has a finite budget (serve_slow_for), so
+        # continued traffic is healthy and the alert must clear
+        cleared = False
+        while time.monotonic() < deadline and not cleared:
+            post()
+            cleared = all(s["state"] == "ok"
+                          for s in srv.watch.states())
+            if not cleared:
+                time.sleep(0.05)
+        if not cleared:
+            problems.append("alert did not clear after the slow-"
+                            "replica fault lifted")
+        m = srv.metrics()
+        if not m.get("incidents_total"):
+            problems.append("dpsvm_incidents_total never incremented")
+        if not any(e.get("event") == "alert" for e in m.get("events",
+                                                            [])):
+            problems.append("events ring has no alert entry")
+        bundles = [b for b in (os.listdir(bundle_dir)
+                               if os.path.isdir(bundle_dir) else [])
+                   if b.startswith("incident-")]
+        if not bundles:
+            problems.append("storm fired but dumped no bundle")
+        else:
+            bpath = blackbox.resolve_bundle_dir(bundle_dir)
+            errs = blackbox.validate_bundle(bpath)
+            if errs:
+                problems.append(f"storm bundle invalid: {errs}")
+            inc = blackbox.load_incident(bpath)
+            if inc.get("rule") != "availability-burn":
+                problems.append("incident.json lost the rule name")
+    except Exception as e:
+        problems.append(f"watch storm drill crashed: {e!r}")
+    finally:
+        try:
+            if srv is not None:
+                srv.drain(timeout=10.0)
+        except Exception:
+            pass
+        faultinject.clear()
     return problems
 
 
@@ -454,7 +668,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("telemetry selfcheck OK "
               f"(schema v{TRACE_SCHEMA_VERSION}, v1 accepted; metrics "
               "exposition + ledger gate + serving span round-trip + "
-              "roofline render checked)")
+              "roofline render + watch gate (burn-rate fire/clear, "
+              "504-storm drill, incident-bundle round-trip) checked)")
         return 0
     if args.validate:
         try:

@@ -622,6 +622,18 @@ def validate_exposition(text: str) -> List[str]:
     return problems
 
 
+def incidents_counter(registry: Optional[MetricsRegistry] = None):
+    """The process-wide ``dpsvm_incidents_total`` counter (one per
+    registry; get-or-create): every alert-rule firing that produced an
+    incident — serving watchtower or training driver alike — counts
+    here, so one scrape answers "has this process paged"
+    (docs/OBSERVABILITY.md "Watch & alerts")."""
+    reg = registry if registry is not None else default_registry()
+    return reg.counter(
+        "dpsvm_incidents_total",
+        "alert-rule firings that opened an incident").labels()
+
+
 # ---------------------------------------------------------------------
 # the training half: packed-stats polls -> registry
 # ---------------------------------------------------------------------
@@ -720,6 +732,13 @@ class TrainingMetrics:
     def on_compile(self, rec: dict) -> None:
         self._c_compiles.inc()
         self._c_compile_s.inc(float(rec.get("seconds", 0.0)))
+
+    def compile_totals(self) -> Tuple[float, float]:
+        """(compiles, compile-seconds) — cumulative process counters,
+        read by the driver's watch hook to feed the compile-storm rule
+        (observability/slo.py) without a second accounting path."""
+        return (float(self._c_compiles.value),
+                float(self._c_compile_s.value))
 
     def on_done(self, *, converged: bool, n_iter: int) -> None:
         self._g_converged.set(1 if converged else 0)
@@ -855,15 +874,43 @@ class MetricsServer:
             pass
 
 
-def write_snapshot(registry: MetricsRegistry, path: str) -> None:
+#: per-path monotonic snapshot sequence numbers (process-local): the
+#: header line every ``write_snapshot`` emits so a tailing consumer
+#: (``dpsvm watch``, observability/slo.SnapshotFollower) can tell a
+#: missed snapshot from a duplicate re-read instead of silently
+#: mis-windowing its rates. Reset only with the process.
+_SNAPSHOT_SEQS: Dict[str, int] = {}
+_SNAPSHOT_LOCK = threading.Lock()
+
+
+def snapshot_header(seq: int, now: Optional[float] = None) -> str:
+    """The one header line (a plain comment to every Prometheus
+    parser; slo.parse_snapshot_header reads it back):
+    ``# dpsvm-snapshot seq=N unix=T time=ISO``."""
+    now = time.time() if now is None else float(now)
+    iso = time.strftime("%Y-%m-%dT%H:%M:%S%z", time.localtime(now))
+    return f"# dpsvm-snapshot seq={int(seq)} unix={now:.3f} time={iso}"
+
+
+def write_snapshot(registry: MetricsRegistry, path: str,
+                   seq: Optional[int] = None,
+                   now: Optional[float] = None) -> int:
     """Atomic text-exposition snapshot (tmp + rename): the scrape-less
     CI story — ``train --metrics-out FILE`` refreshes it every poll, so
     a harness reads a complete, parseable exposition at any moment.
-    Best-effort: a full disk must not kill the training run."""
+    The first line is the monotonic ``seq`` + wall-timestamp header
+    (``snapshot_header``); returns the seq written. Best-effort: a
+    full disk must not kill the training run."""
+    if seq is None:
+        with _SNAPSHOT_LOCK:
+            seq = _SNAPSHOT_SEQS.get(path, 0) + 1
+            _SNAPSHOT_SEQS[path] = seq
     try:
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as fh:
+            fh.write(snapshot_header(seq, now) + "\n")
             fh.write(registry.render_prometheus())
         os.replace(tmp, path)
     except OSError:
         pass
+    return int(seq)

@@ -58,6 +58,14 @@ def flush_open_traces(event: str, **extra) -> int:
             count += 1
         except Exception:
             pass
+    # Armed flight recorders land an incident bundle on the same
+    # emergency path (observability/blackbox.py): a watchdog stall
+    # leaves a postmortem artifact, not just a terminal trace event.
+    try:
+        from dpsvm_tpu.observability import blackbox
+        blackbox.dump_emergency(event)
+    except Exception:
+        pass
     return count
 
 # Carry-class -> human solver-path name (the driver keys the manifest on
@@ -118,6 +126,16 @@ INGEST_EVENTS = ("quarantine", "ingest_resume")
 SERVING_SPANS = ("request", "admission", "queue_wait", "batch_form",
                  "device_dispatch", "respond", "replica_compute",
                  "hedge_fired", "hedge_won", "redispatch")
+
+# Event types the continuous-watch layer emits (observability/slo.py +
+# blackbox.py, docs/OBSERVABILITY.md "Watch & alerts"): `alert` = a
+# rule crossed a state boundary (fired or cleared — `state` says
+# which; the schema requires rule/window/severity), `incident` = the
+# flight recorder dumped a bundle for a firing (adds `bundle`, the
+# directory `dpsvm bundle` renders). Emitted into serving traces by
+# the ServingServer's watchtower and into training traces by the
+# shared host driver's watch hook.
+WATCH_EVENTS = ("alert", "incident")
 
 # Event types the cascade solver emits into its run trace
 # (solver/cascade.py, docs/APPROX.md "Cascade"): `screen` = stage-2

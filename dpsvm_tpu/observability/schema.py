@@ -118,6 +118,13 @@ REWIND_EVENTS = ("rollback", "reshard")
 # `readmit` only a `polish`, and readmit round indices never decrease
 # — a trace violating any of these was written by a broken (or
 # interleaved) producer.
+#
+# Watch events (observability/slo.py, docs/OBSERVABILITY.md "Watch &
+# alerts"): `alert` marks a rule's state TRANSITION (fire or clear —
+# the `state` key distinguishes; rule/window/severity are required so
+# a consumer can always tell WHICH contract broke and over what
+# window), `incident` marks a flight-recorder bundle dump (carries the
+# same identity plus `bundle`, the dumped directory).
 EVENT_EXTRA_KEYS = {
     "desync": ("shards",),
     "reshard": ("from_shards", "to_shards"),
@@ -125,6 +132,8 @@ EVENT_EXTRA_KEYS = {
     "screen": ("n_kept", "n_total"),
     "polish": ("round", "n_kept"),
     "readmit": ("round", "n_readmitted"),
+    "alert": ("rule", "window", "severity"),
+    "incident": ("rule", "window", "severity", "bundle"),
 }
 
 

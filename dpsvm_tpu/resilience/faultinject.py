@@ -78,7 +78,15 @@ Serving-side knobs (``DPSVM_FAULT_SERVE_*``, consumed by
   clean — the transient device-buffer-corruption model);
 * ``DPSVM_FAULT_SERVE_FAIL_RELOAD=j`` — the j-th (1-based) engine
   reload/rebuild in this process fails (exercises
-  failed-reload-keeps-serving and the rebuild retry loop).
+  failed-reload-keeps-serving and the rebuild retry loop);
+* ``DPSVM_FAULT_SERVE_SLOW_REPLICA_MS=t`` — EVERY replica compute
+  sleeps ``t`` milliseconds first (the degraded-device / saturated-
+  interconnect model): with request deadlines under ``t`` this is the
+  deterministic 504 storm that must fire the serving burn-rate alert
+  and dump an incident bundle (docs/OBSERVABILITY.md "Watch &
+  alerts"). Combine with ``DPSVM_FAULT_SERVE_SLOW_FOR=m`` to LIFT the
+  fault after the first ``m`` slowed computes — the alert must then
+  clear, which is the recovery half of the drill.
 
 Cascade / bench-infra knobs (``solver/cascade.py``, ``bench_common.py``
 — docs/APPROX.md "Cascade"):
@@ -136,6 +144,11 @@ class FaultPlan:
     serve_nan_after: int = 0         # poison the replica serving
     #                                  compute #m until it is rebuilt
     serve_fail_reload: int = 0       # 1-based reload/rebuild counter
+    serve_slow_replica_ms: int = 0   # every compute sleeps this first
+    serve_slow_for: int = 0          # ...only the first m computes
+    #                                  (0 = for the process lifetime);
+    #                                  past m the fault LIFTS — the
+    #                                  504-storm recovery drill
     # distributed-mesh knobs (docstring above): shard NUMBERS 1-based
     dist_kill_shard: int = 0         # shard #k lost at a dist poll
     dist_kill_poll: int = 0          # ...the m-th dist poll (default 2)
@@ -176,11 +189,14 @@ class FaultPlan:
     _io_reads: int = 0
     _io_fail_fired: bool = False
     _cascade_fired: bool = False
+    _slow_computes: int = 0
+    _slow_lifted_logged: bool = False
 
     def any(self) -> bool:
         return bool(self.fail_checkpoint_write or self.nan_at_iter
                     or self.preempt_at_poll or self.serve_wedge_replica
                     or self.serve_nan_after or self.serve_fail_reload
+                    or self.serve_slow_replica_ms
                     or self.dist_kill_shard or self.dist_desync_at
                     or self.dist_slow_shard or self.io_read_fail_once
                     or self.io_corrupt_shard or self.io_truncate_shard
@@ -338,6 +354,24 @@ class FaultPlan:
                      f"#{self._serve_computes}")
             return False
 
+    def serve_slow_delay_s(self) -> float:
+        """Seconds THIS replica compute must sleep (0.0 = run clean).
+        With ``serve_slow_for`` set, only the first m computes are
+        slowed — the deterministic lift point of the 504-storm drill;
+        without it the slowness persists for the process."""
+        if not self.serve_slow_replica_ms:
+            return 0.0
+        with _SERVE_LOCK:
+            self._slow_computes += 1
+            if (self.serve_slow_for
+                    and self._slow_computes > self.serve_slow_for):
+                if not self._slow_lifted_logged:
+                    self._slow_lifted_logged = True
+                    _log(f"slow-replica fault lifted after "
+                         f"{self.serve_slow_for} computes")
+                return 0.0
+            return self.serve_slow_replica_ms / 1000.0
+
     def serve_poisoned(self, replica_idx: int, generation: int) -> bool:
         """True while (replica, generation) is the poisoned one — a
         rebuilt replica (new generation) runs clean, which is the
@@ -382,6 +416,8 @@ def plan_from_env() -> Optional[FaultPlan]:
         serve_wedge_after=_env_int("SERVE_WEDGE_AFTER"),
         serve_nan_after=_env_int("SERVE_NAN_AFTER"),
         serve_fail_reload=_env_int("SERVE_FAIL_RELOAD"),
+        serve_slow_replica_ms=_env_int("SERVE_SLOW_REPLICA_MS"),
+        serve_slow_for=_env_int("SERVE_SLOW_FOR"),
         dist_kill_shard=_env_int("DIST_KILL_SHARD"),
         dist_kill_poll=_env_int("DIST_KILL_POLL"),
         dist_desync_at=_env_int("DIST_DESYNC_AT"),

@@ -446,6 +446,13 @@ class ReplicaPool:
                 if d.done or replica.retired:
                     self._unprobe(replica)
                     return             # released after ejection
+            if plan is not None:
+                # slow-replica drill (DPSVM_FAULT_SERVE_SLOW_REPLICA_MS):
+                # the compute takes longer than the request deadline ->
+                # 504 storm -> the serving burn-rate rule must fire
+                slow_s = plan.serve_slow_delay_s()
+                if slow_s > 0:
+                    time.sleep(slow_s)
             try:
                 res = replica.engine.infer(d.x, d.want)
             except ValueError as e:

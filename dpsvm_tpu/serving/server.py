@@ -71,9 +71,11 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dpsvm_tpu.observability import blackbox, slo
 from dpsvm_tpu.observability.metrics import (DEFAULT_LATENCY_BUCKETS_MS,
                                              PROMETHEUS_CONTENT_TYPE,
                                              MetricsRegistry,
+                                             incidents_counter,
                                              wants_prometheus)
 from dpsvm_tpu.observability.spans import RequestSpans, should_sample
 from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
@@ -399,6 +401,8 @@ class ServingServer:
                  trace_out: Optional[str] = None,
                  trace_sample_rate: float = 1.0,
                  metrics_registry: Optional[MetricsRegistry] = None,
+                 watch_rules=None, bundle_dir: Optional[str] = None,
+                 watch: bool = True,
                  verbose: bool = False):
         self.registry = registry
         self.host = host
@@ -472,6 +476,33 @@ class ServingServer:
             "dpsvm_serving_expired_tickets",
             "tickets dropped at batch formation (deadline passed)")
         self.mreg.add_collector(self._collect_gauges)
+        # Continuous watch (observability/slo.py, docs/OBSERVABILITY.md
+        # "Watch & alerts"): every server evaluates the serving SLO
+        # rules against its OWN counters on every counted response —
+        # no scraper in the loop — and feeds a bounded flight recorder
+        # (observability/blackbox.py) from the event/span paths it
+        # already runs. A rule firing emits `alert` into the events
+        # ring + serving trace, bumps dpsvm_incidents_total, and (with
+        # ``bundle_dir``) dumps a self-contained incident bundle.
+        self.bundle_dir = bundle_dir
+        self.watch: Optional[slo.Watchtower] = None
+        if watch:
+            self.watch = slo.Watchtower(
+                slo.load_rules(watch_rules, default="serving"))
+        self._c_incidents = incidents_counter(self.mreg)
+        self._g_alert = None
+        if self.watch is not None:
+            self._g_alert = self.mreg.gauge(
+                "dpsvm_alert_firing",
+                "1 while the named alert rule is firing",
+                labels=("rule", "severity"))
+            for r in self.watch.ruleset:
+                self._g_alert.labels(rule=r.name,
+                                     severity=r.severity).set(0)
+        self._flight = blackbox.FlightRecorder(blackbox.make_manifest(
+            solver="serving",
+            config={"models": list(registry.names()),
+                    "replicas": int(replicas)}))
         self._events: deque = deque(maxlen=512)
         self._trace = None
         self._trace_out = trace_out
@@ -495,6 +526,64 @@ class ServingServer:
 
     def count(self, key: str) -> None:
         self._counters[key].inc()
+        # every counted terminal response is one watch sample: the
+        # rules see the burn as it happens, not at the next scrape
+        self._watch_note()
+
+    # -- continuous watch ---------------------------------------------
+
+    def watch_sample(self) -> Dict[str, float]:
+        """The canonical sample the serving rules evaluate
+        (observability/slo.py's documented vocabulary) — all host-side
+        counter reads."""
+        sample = {key: float(c.value)
+                  for key, c in self._counters.items()}
+        with self._lock:
+            batchers = dict(self._batchers)
+        depth = sum(b.queue_depth for b in batchers.values())
+        sample["queue_depth"] = float(depth)
+        sample["queue_fill"] = (depth / self.max_queue
+                                if self.max_queue else 0.0)
+        return sample
+
+    def _watch_note(self) -> None:
+        if self.watch is None:
+            return
+        try:
+            transitions = self.watch.observe(self.watch_sample())
+        except Exception:
+            return                  # watching must never kill serving
+        for tr in transitions:
+            self._on_alert(tr)
+
+    def _on_alert(self, tr: dict) -> None:
+        """One rule transition: events ring + serving trace + metrics,
+        and on a firing, the incident bundle."""
+        firing = tr["state"] == "firing"
+        if self._g_alert is not None:
+            self._g_alert.labels(rule=tr["rule"],
+                                 severity=tr["severity"]).set(
+                                     1 if firing else 0)
+        self.emit_event("alert", rule=tr["rule"], window=tr["window"],
+                        severity=tr["severity"], state=tr["state"],
+                        reason=tr["reason"])
+        if not firing:
+            return
+        self._c_incidents.inc()
+        self._flight.snapshot_metrics(self.mreg)
+        if self.bundle_dir:
+            path = blackbox.dump_bundle(
+                self.bundle_dir, recorder=self._flight,
+                rule=tr["rule"], severity=tr["severity"],
+                window=tr["window"], reason=tr["reason"],
+                registry=self.mreg,
+                extra={"source": "serving",
+                       "counters": {k: int(c.value) for k, c
+                                    in self._counters.items()}})
+            if path:
+                self.emit_event("incident", rule=tr["rule"],
+                                window=tr["window"],
+                                severity=tr["severity"], bundle=path)
 
     def observe_latency(self, ms: float) -> None:
         self._h_latency.observe(ms)      # the Prometheus histogram
@@ -655,6 +744,10 @@ class ServingServer:
                 tr = self._trace
             if tr is not None:
                 rs.emit_into(tr)
+            try:
+                rs.emit_into(self._flight)   # the black-box copy
+            except Exception:
+                pass
             return bd
         except Exception:
             return None                # attribution never kills serving
@@ -663,7 +756,9 @@ class ServingServer:
 
     def emit_event(self, event: str, **extra) -> None:
         """Robustness event sink: in-memory ring (for /metricsz and
-        tests) + the serving trace when one is open."""
+        tests) + the serving trace when one is open + the black-box
+        flight recorder (so a bundle dumped later carries the recent
+        eject/rebuild/shed/alert history)."""
         with self._lock:
             self._events.append({"event": event, "t": round(
                 self.uptime, 3), **extra})
@@ -673,6 +768,10 @@ class ServingServer:
                 tr.event(event, **extra)
             except Exception:
                 pass                   # tracing must not kill serving
+        try:
+            self._flight.event(event, **extra)
+        except Exception:
+            pass
 
     def metrics(self) -> dict:
         counters = {k: int(c.value) for k, c in self._counters.items()}
@@ -686,6 +785,11 @@ class ServingServer:
         out["uptime_s"] = round(self.uptime, 3)
         out["draining"] = self.draining
         out["spans_sampled"] = int(self._c_spans.value)
+        # continuous-watch state: the same rule states the Prometheus
+        # exposition carries as dpsvm_alert_firing series
+        out["alerts"] = (self.watch.states()
+                         if self.watch is not None else [])
+        out["incidents_total"] = int(self._c_incidents.value)
         if lat.size:
             p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
             out["latency_ms"] = {"count": int(lat.size),
@@ -821,6 +925,11 @@ class ServingServer:
                 models={n: {"replicas": self.replicas}
                         for n in self.registry.names()},
                 sample_rate=self.trace_sample_rate)
+        if self.bundle_dir:
+            # hard exits (watchdog stall, crash handlers) still land a
+            # bundle: record.flush_open_traces -> blackbox emergency
+            blackbox.arm_emergency(self._flight, self.bundle_dir,
+                                   self.mreg)
         for name in self.registry.names():
             self.pool(name)                 # replica builds paid at boot
         self._httpd = _Server((self.host, self.requested_port), _Handler)
@@ -836,6 +945,7 @@ class ServingServer:
         """Graceful shutdown: refuse new work, answer everything
         already accepted, then close the listener."""
         self.draining = True
+        blackbox.disarm_emergency(self._flight)
         with self._lock:
             batchers = list(self._batchers.values())
         for b in batchers:                  # finish every queued batch

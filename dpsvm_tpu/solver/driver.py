@@ -283,14 +283,18 @@ def begin_trace(config: SVMConfig, n: int, d: int, gamma: float,
     return trace
 
 
-def drain_compiles(trace, n_iter: int = 0, metrics=None) -> None:
+def drain_compiles(trace, n_iter: int = 0, metrics=None) -> list:
     """Flush pending compile observations (observability/compilewatch)
     into ``trace`` as ``compile`` records and, when given, the metric
     registry feeder (``metrics.TrainingMetrics``). Draining with both
     off discards them, so one run's compiles can never leak into the
     next run's trace. Called at poll boundaries by every trace producer
-    (this driver, the shrinking manager, the bench harnesses)."""
+    (this driver, the shrinking manager, the bench harnesses). Returns
+    the drained observations (the watch hook reads the newest
+    program's FLOPs estimate from them)."""
+    drained = []
     for rec in compilewatch.drain():
+        drained.append(rec)
         if trace is not None:
             trace.compile(program=rec["program"],
                           seconds=rec["seconds"],
@@ -299,6 +303,7 @@ def drain_compiles(trace, n_iter: int = 0, metrics=None) -> None:
                           bytes=rec.get("bytes"), n_iter=n_iter)
         if metrics is not None:
             metrics.on_compile(rec)
+    return drained
 
 
 def host_training_loop(
@@ -412,6 +417,59 @@ def host_training_loop(
     train_metrics = metricslib.TrainingMetrics(
         solver=SOLVER_NAMES.get(type(carry).__name__,
                                 type(carry).__name__), n=n, d=d)
+    # Continuous watch + black-box flight recorder
+    # (observability/slo.py + blackbox.py, docs/OBSERVABILITY.md
+    # "Watch & alerts"): armed by --watch-rules / --bundle-dir. The
+    # watchtower evaluates the training rules against the SAME
+    # host-side facts every poll already holds (packed stats, compile
+    # counters, heartbeat ages) and the flight recorder tees off the
+    # trace feed — a watched run performs ZERO additional
+    # device->host transfers, pinned in tests/test_watch.py.
+    watcher = None
+    flight = None
+    incidents = None
+    watch_peaks = None
+    watch_prev = None           # (n_iter, t) for the it/s fact
+    watch_flops = None          # newest chunk program's per-iter FLOPs
+    if config.bundle_dir or config.watch_rules:
+        from dpsvm_tpu.observability import blackbox, roofline, slo
+        env = trace_env()
+        watcher = slo.Watchtower(
+            slo.load_rules(config.watch_rules, default="training"))
+        incidents = metricslib.incidents_counter(train_metrics.registry)
+        watch_peaks = roofline.peaks_for(env.get("device_kind"))
+        flight = blackbox.FlightRecorder(blackbox.make_manifest(
+            solver=SOLVER_NAMES.get(type(carry).__name__,
+                                    type(carry).__name__),
+            n=n, d=d, gamma=gamma,
+            config={"kernel": config.kernel,
+                    "coef0": float(config.coef0),
+                    "degree": int(config.degree),
+                    "shards": int(shards)},
+            env=env))
+        trace = blackbox.TeeTrace(trace, flight)
+        if config.bundle_dir:
+            blackbox.arm_emergency(flight, config.bundle_dir,
+                                   train_metrics.registry)
+
+    def watch_incident(rule: str, severity: str, window: str,
+                       reason: str, n_iter: int) -> None:
+        """One firing -> incident counter + metrics snapshot + bundle
+        + `incident` trace event (the trace here is the TeeTrace, so
+        the flight ring carries the alert history the bundle dumps)."""
+        from dpsvm_tpu.observability import blackbox
+        incidents.inc()
+        flight.snapshot_metrics(train_metrics.registry)
+        if not config.bundle_dir:
+            return
+        path = blackbox.dump_bundle(
+            config.bundle_dir, recorder=flight, rule=rule,
+            severity=severity, window=window, reason=reason,
+            registry=train_metrics.registry,
+            extra={"source": "training", "n_iter": int(n_iter)})
+        if path and trace is not None:
+            trace.event("incident", n_iter=n_iter, rule=rule,
+                        window=window, severity=severity, bundle=path)
     exporting = (config.metrics_port is not None
                  or bool(config.metrics_out))
     sidecar = None
@@ -483,7 +541,11 @@ def host_training_loop(
                 # trace records before the chunk they delayed, and the
                 # allocator watermark is a dictionary read — still
                 # ZERO extra device->host transfers.
-                drain_compiles(trace, n_iter, metrics=train_metrics)
+                drained = drain_compiles(trace, n_iter,
+                                         metrics=train_metrics)
+                for rec in drained:
+                    if rec.get("flops") is not None:
+                        watch_flops = float(rec["flops"])
                 drain_queued_events(trace)
                 hbm = (memory_snapshot()
                        if trace is not None or exporting else None)
@@ -573,6 +635,48 @@ def host_training_loop(
                     metricslib.write_snapshot(train_metrics.registry,
                                               config.metrics_out)
 
+                if watcher is not None:
+                    # One watch sample per poll — every fact is
+                    # already host-side (the packed-stats read, the
+                    # compile counters, the heartbeat ages): zero
+                    # extra device transfers.
+                    w_now = time.perf_counter()
+                    gap = b_lo - b_hi
+                    sample = {"n_iter": float(n_iter),
+                              "n_sv": float(st.n_sv),
+                              "gap": (gap if math.isfinite(gap)
+                                      else float("inf"))}
+                    comp, comp_s = train_metrics.compile_totals()
+                    sample["compiles"] = comp
+                    sample["compile_seconds"] = comp_s
+                    if shard_ages is not None and len(shard_ages):
+                        sample["heartbeat_age"] = float(
+                            max(shard_ages))
+                    if (watch_peaks is not None
+                            and watch_flops is not None
+                            and watch_prev is not None
+                            and w_now > watch_prev[1]
+                            and n_iter > watch_prev[0]):
+                        ips = ((n_iter - watch_prev[0])
+                               / (w_now - watch_prev[1]))
+                        sample["roofline_fraction"] = (
+                            watch_flops * ips
+                            / watch_peaks["peak_flops"])
+                    watch_prev = (int(n_iter), w_now)
+                    for w_tr in watcher.observe(sample, t=w_now):
+                        if trace is not None:
+                            trace.event("alert", n_iter=n_iter,
+                                        rule=w_tr["rule"],
+                                        window=w_tr["window"],
+                                        severity=w_tr["severity"],
+                                        state=w_tr["state"],
+                                        reason=w_tr["reason"])
+                        if w_tr["state"] == "firing":
+                            watch_incident(w_tr["rule"],
+                                           w_tr["severity"],
+                                           w_tr["window"],
+                                           w_tr["reason"], n_iter)
+
                 # Divergence guards — BEFORE maybe_checkpoint, so a sick
                 # state is never saved over a good rotation slot. The
                 # cross-shard desync check rides the same policy: a
@@ -587,6 +691,15 @@ def host_training_loop(
                     if reason is not None:
                         ev_kind = "desync"
                 if reason is not None:
+                    if flight is not None:
+                        # The health guards are the oldest alert rules
+                        # of all: a tripped guard is an incident, so
+                        # the black box dumps BEFORE the policy acts
+                        # (a raise must still leave its artifact).
+                        watch_incident(
+                            f"health-{ev_kind}", "page",
+                            f"health_window={config.health_window}",
+                            reason, n_iter)
                     policy = monitor.policy
                     if policy == "rollback" and (
                             carry_from_ckpt is None
@@ -734,6 +847,9 @@ def host_training_loop(
     finally:
         # Leftover compile observations (error exits, untraced runs)
         # must not leak into the next run's trace.
+        if flight is not None:
+            from dpsvm_tpu.observability import blackbox
+            blackbox.disarm_emergency(flight)
         elastic.register_heartbeats(None)
         drain_compiles(trace if trace is not None and not trace.closed
                        else None, metrics=train_metrics)

@@ -1,0 +1,937 @@
+"""Continuous watchtower tests (docs/OBSERVABILITY.md "Watch &
+alerts" / "Incident bundles").
+
+What must hold, per component:
+
+* rules     — specs round-trip; bad specs fail at load; every firing
+              is a deterministic function of the (t, sample) series
+              (injectable clock — no wall reads in evaluation).
+* burn rate — the multi-window contract: a sustained burn fires
+              within the fast window, a short spike never fires, a
+              moderate burn trips via the slow window, and clearing
+              has hysteresis (no flapping around the threshold).
+* training  — stagnation / compile-storm / heartbeat / roofline-drop
+              rules fire on planted inputs and a healthy steady state
+              fires NOTHING.
+* snapshots — --metrics-out carries the monotonic seq + timestamp
+              header; a tailing consumer detects missed and duplicate
+              snapshots.
+* schema    — `alert`/`incident` events validate with required keys
+              (rule, window, severity) and fail without them.
+* bundles   — flight-recorder dump -> validate round-trip; tampered
+              bundles are rejected; `dpsvm bundle` renders + gates.
+* drills    — the fault-injected 504 storm fires the serving
+              burn-rate rule, dumps a schema-valid bundle and clears
+              after the fault lifts (in-process AND as a `dpsvm
+              serve` subprocess); planted gap stagnation produces a
+              bundle from the DRIVER path; a watched training run's
+              poll count equals an unwatched run's (zero extra D2H).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.observability import blackbox, slo
+from dpsvm_tpu.observability.schema import validate_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _burn_rule(**over):
+    spec = {"name": "avail", "kind": "burn_rate", "severity": "page",
+            "good": "requests", "bad": "deadline_504",
+            "objective": 0.999, "fast_window_s": 60.0,
+            "slow_window_s": 600.0, "threshold": 14.4,
+            "clear_after_s": 60.0}
+    spec.update(over)
+    return spec
+
+
+# ---------------------------------------------------------------------
+# rules: round-trip + validation
+# ---------------------------------------------------------------------
+
+def test_ruleset_roundtrip_and_file(tmp_path):
+    specs = slo.default_serving_rules() + slo.default_training_rules()
+    rs = slo.RuleSet.from_specs(specs)
+    assert rs.to_specs() == specs
+    # file round-trip, both layouts (bare list / {"rules": [...]})
+    p1 = tmp_path / "rules.json"
+    p1.write_text(json.dumps(specs))
+    assert slo.RuleSet.from_file(str(p1)).to_specs() == specs
+    p2 = tmp_path / "rules2.json"
+    p2.write_text(json.dumps({"comment": "x", "rules": specs}))
+    assert slo.RuleSet.from_file(str(p2)).to_specs() == specs
+
+
+@pytest.mark.parametrize("bad", [
+    {"kind": "burn_rate"},                          # no name
+    {"name": "x", "kind": "nope"},                  # unknown kind
+    {"name": "x", "kind": "burn_rate", "good": "a", "bad": "b",
+     "objective": 2.0, "fast_window_s": 1, "slow_window_s": 2,
+     "threshold": 1},                               # objective >= 1
+    {"name": "x", "kind": "burn_rate", "good": "a", "bad": "b",
+     "objective": 0.999, "fast_window_s": 60, "slow_window_s": 30,
+     "threshold": 1},                               # slow < fast
+    {"name": "x", "kind": "threshold", "metric": "m"},  # no bound
+    {"name": "x", "kind": "threshold", "metric": "m", "above": 1,
+     "below": 2},                                   # both bounds
+    {"name": "x", "kind": "stagnation", "metric": "m",
+     "window_s": 0},                                # window <= 0
+    {"name": "x", "kind": "drop_vs_baseline", "metric": "m",
+     "drop_pct": 10},                               # no baseline
+    {"name": "x", "kind": "burn_rate", "good": "a", "bad": "b",
+     "objective": 0.999, "fast_window_s": 1, "slow_window_s": 2,
+     "threshold": 1, "severity": "sev1"},           # bad severity
+])
+def test_bad_rule_specs_rejected(bad):
+    with pytest.raises(slo.RuleError):
+        slo.RuleSet.from_specs([bad])
+
+
+def test_duplicate_rule_names_rejected():
+    with pytest.raises(slo.RuleError, match="duplicate"):
+        slo.RuleSet.from_specs([_burn_rule(), _burn_rule()])
+
+
+# ---------------------------------------------------------------------
+# burn rate: the multi-window contract on an injectable clock
+# ---------------------------------------------------------------------
+
+def test_burn_rate_fast_trip_is_deterministic():
+    """A sustained 50% 504 ratio fires within ~the fast window of the
+    burn's onset, and two identical replays fire at the SAME t."""
+    def run():
+        tower = slo.Watchtower(slo.RuleSet.from_specs([_burn_rule()]))
+        fired = []
+        for i in range(400):
+            t = float(i)
+            bad = max(0, i - 100) * 10.0      # burn starts at t=100
+            for tr in tower.observe({"requests": i * 10.0,
+                                     "deadline_504": bad}, t=t):
+                if tr["state"] == "firing":
+                    fired.append(t)
+        return fired
+    a, b = run(), run()
+    assert a == b, "same series must fire at the same t"
+    assert len(a) == 1
+    # fired after the onset, within ~the fast window of it
+    assert 100.0 < a[0] <= 100.0 + 60.0 + 1.0, a
+
+
+def test_burn_rate_short_spike_never_fires():
+    """A burst shorter/smaller than the slow window's budget does not
+    page — the no-false-positive half of the multi-window design."""
+    tower = slo.Watchtower(slo.RuleSet.from_specs([_burn_rule()]))
+    for i in range(700):
+        t = float(i)
+        # one tick with a single 504 against ~100/s of traffic
+        bad = 1.0 if i >= 300 else 0.0
+        trs = tower.observe({"requests": i * 100.0,
+                             "deadline_504": bad}, t=t)
+        assert trs == [], f"spike fired at t={t}: {trs}"
+    assert tower.worst_fired is None
+
+
+def test_burn_rate_slow_trip_moderate_burn():
+    """A moderate burn (2% of traffic, ~20x the 0.1% budget) fires —
+    the slow window accumulates it even though no single fast window
+    looks catastrophic at onset."""
+    tower = slo.Watchtower(slo.RuleSet.from_specs([_burn_rule()]))
+    fired = []
+    for i in range(1000):
+        t = float(i)
+        for tr in tower.observe({"requests": i * 98.0,
+                                 "deadline_504": i * 2.0}, t=t):
+            if tr["state"] == "firing":
+                fired.append(t)
+    assert len(fired) == 1, "2% sustained burn must fire exactly once"
+
+
+def test_burn_rate_hysteresis_no_flap():
+    """After the burn stops, a lone healthy sample does NOT clear
+    (clear_after_s hysteresis), and the lifecycle is exactly
+    fire -> clear: no flapping while the fast window drains."""
+    tower = slo.Watchtower(slo.RuleSet.from_specs(
+        [_burn_rule(fast_window_s=10.0, slow_window_s=30.0,
+                    clear_after_s=15.0)]))
+    transitions = []
+    for i in range(300):
+        t = float(i)
+        # 30 s of 50% 504s starting at t=50, then healthy forever
+        bad = 10.0 * max(0, min(i, 80) - 50)
+        transitions += [(tr["state"], t) for tr in tower.observe(
+            {"requests": i * 10.0, "deadline_504": bad}, t=t)]
+    states = [s for s, _ in transitions]
+    assert states == ["firing", "ok"], transitions
+    fire_t = transitions[0][1]
+    clear_t = transitions[1][1]
+    assert 50.0 < fire_t < 70.0
+    # cannot clear before the burn end + fast window drain +
+    # clear_after hysteresis
+    assert clear_t >= 80.0 + 15.0, transitions
+    assert tower.worst_fired == "page"           # fired-and-cleared
+    assert tower.exit_code() == slo.EXIT_PAGE    # still fails the gate
+
+
+# ---------------------------------------------------------------------
+# training rules: stagnation, compile storm, heartbeat, roofline drop
+# ---------------------------------------------------------------------
+
+def test_stagnation_rule_fires_and_negative():
+    rs = slo.RuleSet.from_specs([
+        {"name": "stag", "kind": "stagnation", "severity": "warn",
+         "metric": "gap", "window_s": 30.0}])
+    tower = slo.Watchtower(rs)
+    # healthy: strictly-improving gap never fires
+    for i in range(100):
+        assert tower.observe({"gap": 1.0 / (i + 1)}, t=float(i)) == []
+    # planted: flat gap fires once the window elapses
+    tower2 = slo.Watchtower(slo.RuleSet.from_specs(rs.to_specs()))
+    fired = []
+    for i in range(100):
+        for tr in tower2.observe({"gap": 0.5}, t=float(i)):
+            fired.append((tr["state"], float(i)))
+    assert fired and fired[0] == ("firing", 30.0), fired
+
+
+def test_compile_storm_rate_rule():
+    rs = slo.RuleSet.from_specs([
+        {"name": "storm", "kind": "rate", "severity": "warn",
+         "metric": "compiles", "window_s": 20.0, "above": 0.5}])
+    # healthy: two warmup compiles then steady state — no firing
+    tower = slo.Watchtower(rs)
+    for i in range(100):
+        c = min(i, 2)
+        assert tower.observe({"compiles": float(c)}, t=float(i)) == []
+    # pathological: one compile per second, forever
+    tower2 = slo.Watchtower(slo.RuleSet.from_specs(rs.to_specs()))
+    fired = [tr for i in range(60)
+             for tr in tower2.observe({"compiles": float(i)},
+                                      t=float(i))]
+    assert fired and fired[0]["state"] == "firing"
+
+
+def test_heartbeat_threshold_rule_fire_and_clear():
+    rs = slo.RuleSet.from_specs([
+        {"name": "hb", "kind": "threshold", "severity": "page",
+         "metric": "heartbeat_age", "above": 30.0,
+         "clear_after_s": 5.0}])
+    tower = slo.Watchtower(rs)
+    trs = []
+    for i, age in enumerate([1, 5, 40, 45, 50, 1, 1, 1, 1, 1, 1, 1]):
+        trs += tower.observe({"heartbeat_age": float(age)},
+                             t=float(i * 2))
+    assert [t["state"] for t in trs] == ["firing", "ok"], trs
+
+
+def test_roofline_drop_vs_ledger_baseline():
+    records = [{"case": "bench_headline", "value": 100.0,
+                "metrics": {"roofline_fraction": v}}
+               for v in (0.60, 0.61, 0.59, 0.60, 0.60)]
+    rs = slo.RuleSet.from_specs(
+        [{"name": "roof", "kind": "drop_vs_baseline",
+          "severity": "warn", "metric": "roofline_fraction",
+          "baseline_case": "bench_headline",
+          "baseline_metric": "roofline_fraction", "drop_pct": 25.0}],
+        ledger_records=records)
+    assert rs.rules[0].baseline == pytest.approx(0.60)
+    tower = slo.Watchtower(rs)
+    # healthy: fractions at the median never fire
+    assert tower.observe({"roofline_fraction": 0.58}, t=1.0) == []
+    # planted: a 33% drop fires immediately
+    trs = tower.observe({"roofline_fraction": 0.40}, t=2.0)
+    assert [t["state"] for t in trs] == ["firing"]
+    # unresolvable baseline -> the rule is a no-op, never a guess
+    rs2 = slo.RuleSet.from_specs(
+        [{"name": "roof", "kind": "drop_vs_baseline",
+          "severity": "warn", "metric": "roofline_fraction",
+          "baseline_case": "no_such_case", "drop_pct": 25.0}],
+        ledger_records=records)
+    assert rs2.rules[0].baseline is None
+    assert slo.Watchtower(rs2).observe(
+        {"roofline_fraction": 0.01}, t=1.0) == []
+
+
+def test_healthy_steady_state_fires_nothing():
+    """THE negative acceptance: default serving AND training rules
+    against a long healthy run — zero transitions, exit 0."""
+    tower = slo.Watchtower(slo.load_rules(None, default="serving"))
+    for i in range(800):
+        assert tower.observe({"requests": i * 50.0,
+                              "deadline_504": 0.0,
+                              "queue_fill": 0.05}, t=float(i)) == []
+    ttower = slo.Watchtower(slo.load_rules(None, default="training"))
+    for i in range(200):
+        assert ttower.observe(
+            {"n_iter": i * 512.0, "gap": 1.0 / (i + 1),
+             "n_sv": 100.0, "compiles": 2.0,
+             "heartbeat_age": 0.5}, t=float(i)) == []
+    assert tower.exit_code() == slo.EXIT_OK
+    assert ttower.worst_fired is None
+
+
+# ---------------------------------------------------------------------
+# snapshot seq header (--metrics-out tailing contract)
+# ---------------------------------------------------------------------
+
+def test_metrics_out_snapshot_seq_header(tmp_path):
+    from dpsvm_tpu.observability.metrics import (MetricsRegistry,
+                                                 validate_exposition,
+                                                 write_snapshot)
+    reg = MetricsRegistry()
+    reg.counter("dpsvm_t_total", "t").inc()
+    path = str(tmp_path / "m.prom")
+    s1 = write_snapshot(reg, path)
+    text1 = open(path).read()
+    s2 = write_snapshot(reg, path)
+    text2 = open(path).read()
+    assert (s1, s2) == (1, 2), "seq must be monotonic per path"
+    h1 = slo.parse_snapshot_header(text1)
+    h2 = slo.parse_snapshot_header(text2)
+    assert h1["seq"] == 1 and h2["seq"] == 2
+    assert h2["unix"] >= h1["unix"] > 0
+    # the header is a comment to every Prometheus parser
+    assert validate_exposition(text2) == []
+    # a different path starts its own sequence
+    assert write_snapshot(reg, str(tmp_path / "other.prom")) == 1
+
+
+def test_snapshot_follower_detects_missed_and_duplicate():
+    f = slo.SnapshotFollower()
+    fresh, probs = f.note({"seq": 1, "unix": 1.0, "time": "t"})
+    assert fresh and probs == []
+    # duplicate re-read: NOT fresh (a tailing consumer must not
+    # re-evaluate its rules on the same snapshot)
+    fresh, probs = f.note({"seq": 1, "unix": 1.0, "time": "t"})
+    assert not fresh and f.duplicates == 1
+    # a gap is reported, never silent
+    fresh, probs = f.note({"seq": 4, "unix": 2.0, "time": "t"})
+    assert fresh and f.missed == 2 and "missed 2" in probs[0]
+    # a rewind means the writer restarted
+    fresh, probs = f.note({"seq": 2, "unix": 3.0, "time": "t"})
+    assert fresh and "backwards" in probs[0]
+    # headerless text -> no tracking, no error
+    assert slo.parse_snapshot_header("# HELP x y\n") is None
+    assert f.note(None) == (True, [])
+
+
+def test_train_metrics_out_carries_header(tmp_path):
+    """A real `train --metrics-out` snapshot starts with the seq
+    header (the satellite's end-to-end pin)."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.config import SVMConfig
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200, 5)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    out = str(tmp_path / "m.prom")
+    train(x, y, SVMConfig(c=1.0, epsilon=1e-3, max_iter=20_000,
+                          chunk_iters=64, metrics_out=out,
+                          verbose=False))
+    header = slo.parse_snapshot_header(open(out).read())
+    assert header is not None and header["seq"] >= 1, header
+
+
+# ---------------------------------------------------------------------
+# schema: alert/incident event vocabulary
+# ---------------------------------------------------------------------
+
+def _mini_trace(extra_records):
+    man = blackbox.make_manifest(solver="smo", n=10, d=2, gamma=0.5)
+    summary = blackbox.FlightRecorder(man).trace_records()[-1]
+    summary["t"] = 99.0
+    return [man] + extra_records + [summary]
+
+
+def test_validate_trace_watch_events():
+    good = _mini_trace([
+        {"kind": "event", "event": "alert", "n_iter": 5, "t": 1.0,
+         "rule": "availability-burn", "window": "fast=60s/slow=600s",
+         "severity": "page", "state": "firing"},
+        {"kind": "event", "event": "incident", "n_iter": 5, "t": 2.0,
+         "rule": "availability-burn", "window": "fast=60s/slow=600s",
+         "severity": "page", "bundle": "/tmp/x"}])
+    assert validate_trace(good) == []
+    # missing required keys -> rejected, naming the keys
+    for ev, missing in (("alert", "severity"), ("incident", "bundle")):
+        rec = {"kind": "event", "event": ev, "n_iter": 5, "t": 1.0,
+               "rule": "r", "window": "w", "severity": "page",
+               "bundle": "/tmp/x"}
+        rec.pop(missing)
+        errs = validate_trace(_mini_trace([rec]))
+        assert errs and missing in errs[0], errs
+
+
+# ---------------------------------------------------------------------
+# bundles: dump -> validate -> render, and tamper rejection
+# ---------------------------------------------------------------------
+
+def _dump_sample_bundle(td):
+    from dpsvm_tpu.observability.metrics import MetricsRegistry
+    fr = blackbox.FlightRecorder(blackbox.make_manifest(
+        solver="smo", n=100, d=4, gamma=0.5))
+    fr.compile(program="p", seconds=0.5, flops=1e6)
+    for i in range(3):
+        fr.chunk(n_iter=(i + 1) * 512, b_lo=0.5, b_hi=-0.5, n_sv=10)
+    fr.event("alert", rule="gap-stagnation", window="120s",
+             severity="warn", state="firing", reason="stuck")
+    reg = MetricsRegistry()
+    reg.counter("dpsvm_t_total", "t").inc(3)
+    return blackbox.dump_bundle(
+        str(td), recorder=fr, rule="gap-stagnation", severity="warn",
+        window="120s", reason="stuck", registry=reg)
+
+
+def test_bundle_dump_validate_render_roundtrip(tmp_path):
+    path = _dump_sample_bundle(tmp_path)
+    assert path and os.path.isdir(path)
+    assert blackbox.validate_bundle(path) == []
+    inc = blackbox.load_incident(path)
+    assert inc["rule"] == "gap-stagnation"
+    assert inc["window"] == "120s"
+    assert inc["severity"] == "warn"
+    # every required artifact exists and the trace stands alone
+    for fname in blackbox.BUNDLE_REQUIRED_FILES:
+        assert os.path.isfile(os.path.join(path, fname)), fname
+    from dpsvm_tpu.observability.schema import read_trace
+    records = read_trace(os.path.join(path, "trace.jsonl"))
+    assert validate_trace(records) == []
+    assert records[0]["schema"] == 3
+    text = blackbox.render_bundle(path)
+    assert "gap-stagnation" in text and "embedded trace" in text
+    # parent-dir resolution picks the bundle
+    assert blackbox.resolve_bundle_dir(str(tmp_path)) == path
+
+
+def test_bundle_tampering_rejected(tmp_path):
+    path = _dump_sample_bundle(tmp_path)
+    # 1. corrupt the embedded trace mid-file
+    tp = os.path.join(path, "trace.jsonl")
+    lines = open(tp).read().splitlines()
+    lines.insert(1, "not json")
+    open(tp, "w").write("\n".join(lines) + "\n")
+    assert any("trace.jsonl" in p for p in
+               blackbox.validate_bundle(path))
+    # 2. a missing required file
+    os.remove(os.path.join(path, "metrics.prom"))
+    assert any("metrics.prom" in p for p in
+               blackbox.validate_bundle(path))
+    # 3. no incident.json at all
+    os.remove(os.path.join(path, "incident.json"))
+    assert blackbox.validate_bundle(path)
+    with pytest.raises(FileNotFoundError):
+        blackbox.resolve_bundle_dir(path)
+
+
+def test_flight_recorder_ring_is_bounded_and_sane():
+    fr = blackbox.FlightRecorder(blackbox.make_manifest(
+        solver="smo", n=10, d=2, gamma=0.5), capacity=16)
+    for i in range(200):
+        fr.chunk(n_iter=i * 8, b_lo=0.5, b_hi=-0.5)
+    assert len(fr.records()) == 16
+    records = fr.trace_records()
+    assert validate_trace(records) == []
+    # the slice keeps only the newest records
+    chunk_iters = [r["n_iter"] for r in records
+                   if r["kind"] == "chunk"]
+    assert chunk_iters == sorted(chunk_iters)
+    assert chunk_iters[0] == (200 - 16) * 8
+
+
+def test_flight_recorder_sanitizes_truncated_slices():
+    """Orphaned spans / stage events whose opener fell off the ring
+    edge are dropped, never emitted invalid."""
+    fr = blackbox.FlightRecorder(blackbox.make_manifest(
+        solver="serving"))
+    t = time.perf_counter()
+    # span child whose root was truncated away
+    fr.span(trace_id="req-1", span_id=2, parent=1, name="queue_wait",
+            t_start=t, t_end=t + 0.001)
+    # a complete request
+    fr.span(trace_id="req-2", span_id=1, parent=None, name="request",
+            t_start=t, t_end=t + 0.01)
+    fr.span(trace_id="req-2", span_id=2, parent=1, name="queue_wait",
+            t_start=t, t_end=t + 0.001)
+    # cascade polish without its screen (truncated opener)
+    fr.event("polish", n_iter=1, round=1, n_kept=10)
+    records = fr.trace_records()
+    assert validate_trace(records) == []
+    assert not any(r.get("trace_id") == "req-1" for r in records)
+    assert sum(r.get("kind") == "span" for r in records) == 2
+    assert not any(r.get("event") == "polish" for r in records)
+
+
+# ---------------------------------------------------------------------
+# serving drill: 504 storm -> burn-rate fire -> bundle -> recovery
+# ---------------------------------------------------------------------
+
+class _StubEngine:
+    num_attributes = 4
+    calibrated = False
+    manifest = {"task": "stub", "num_attributes": 4}
+
+    def infer(self, x, want):
+        n = int(np.shape(x)[0])
+        out = {}
+        if "labels" in want:
+            out["labels"] = np.ones(n, np.int32)
+        if "decision" in want:
+            out["decision"] = np.zeros(n, np.float32)
+        return out
+
+    def bucket_counts(self):
+        return {}
+
+
+class _StubRegistry:
+    def __init__(self):
+        self._e = _StubEngine()
+
+    def names(self):
+        return ["default"]
+
+    def engine(self, name):
+        return self._e
+
+    def build(self, name):
+        return _StubEngine()
+
+    def manifests(self):
+        return {"default": dict(self._e.manifest, generation=1)}
+
+
+DRILL_RULES = [{"name": "availability-burn", "kind": "burn_rate",
+                "severity": "page", "good": "requests",
+                "bad": "deadline_504", "objective": 0.999,
+                "fast_window_s": 0.4, "slow_window_s": 1.0,
+                "threshold": 2.0, "clear_after_s": 0.3}]
+
+
+def test_serving_storm_fires_bundles_and_recovers(tmp_path):
+    """THE serving drill, in-process: slow-replica fault -> real HTTP
+    504 storm -> burn-rate fires within the fast window -> incident
+    bundle dumps (embedded trace is valid v3; incident.json names the
+    rule and window) -> the fault lifts -> the alert clears."""
+    import urllib.error
+    import urllib.request
+
+    from dpsvm_tpu.resilience import faultinject
+    from dpsvm_tpu.serving.server import ServingServer
+
+    bundle_dir = str(tmp_path / "bundles")
+    faultinject.install(faultinject.FaultPlan(
+        serve_slow_replica_ms=60, serve_slow_for=30))
+    srv = ServingServer(_StubRegistry(), port=0, max_batch=4,
+                        max_delay_ms=0.2, watch_rules=DRILL_RULES,
+                        bundle_dir=bundle_dir).start()
+    try:
+        body = json.dumps({"instances": [[0.0] * 4],
+                           "timeout_ms": 15}).encode()
+
+        def post():
+            req = urllib.request.Request(
+                srv.url + "/v1/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        deadline = time.monotonic() + 30.0
+        storm_codes = []
+        while time.monotonic() < deadline:
+            storm_codes.append(post())
+            if any(s["state"] == "firing"
+                   for s in srv.watch.states()):
+                break
+        else:
+            pytest.fail(f"burn-rate rule never fired "
+                        f"(codes: {storm_codes[-10:]})")
+        assert 504 in storm_codes, "the fault must produce 504s"
+        # /metricsz exposes the firing state + the incident counter
+        m = srv.metrics()
+        assert any(a["state"] == "firing" and a["severity"] == "page"
+                   for a in m["alerts"]), m["alerts"]
+        assert m["incidents_total"] >= 1
+        events = [e["event"] for e in m["events"]]
+        assert "alert" in events and "incident" in events, events
+        text = srv.metrics_text()
+        assert "dpsvm_alert_firing" in text
+        assert "dpsvm_incidents_total" in text
+        # `dpsvm watch --url --once` mid-incident: a fresh watcher has
+        # no sample history, so the SOURCE's own reported alert state
+        # must carry the verdict (exit 5 + the rule named)
+        r = _run_cli("watch", "--url", srv.url, "--once", "--json")
+        assert r.returncode == 5, (r.stdout, r.stderr)
+        out = json.loads(r.stdout)
+        assert out["worst_fired"] == "page"
+        assert "availability-burn" in out["source_reported"]
+        # the bundle: valid, rule+window named, trace stands alone
+        bpath = blackbox.resolve_bundle_dir(bundle_dir)
+        assert blackbox.validate_bundle(bpath) == []
+        inc = blackbox.load_incident(bpath)
+        assert inc["rule"] == "availability-burn"
+        assert inc["window"] == "fast=0.4s/slow=1s"
+        assert inc["source"] == "serving"
+        # recovery: serve_slow_for lifts the fault; healthy traffic
+        # must clear the alert (hysteresis included)
+        while time.monotonic() < deadline:
+            post()
+            if all(s["state"] == "ok" for s in srv.watch.states()):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("alert never cleared after the fault lifted")
+        clears = [e for e in srv.metrics()["events"]
+                  if e["event"] == "alert" and e.get("state") == "ok"]
+        assert clears, "the clear must land in the events ring"
+    finally:
+        srv.drain(timeout=15.0)
+        faultinject.clear()
+
+
+def test_serve_subprocess_storm_drill(tmp_path):
+    """The same drill through the real CLI: `dpsvm serve
+    --watch-rules --bundle-dir --trace-out` under
+    DPSVM_FAULT_SERVE_SLOW_REPLICA_MS -> 504 storm fires the rule,
+    the bundle validates, the serving trace carries alert+incident
+    events, the alert clears, and the drain exits 0."""
+    import urllib.error
+    import urllib.request
+
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import SVMModel
+
+    rng = np.random.default_rng(7)
+    model = SVMModel(
+        x_sv=rng.standard_normal((16, 4)).astype(np.float32),
+        alpha=rng.uniform(0.1, 1.0, 16).astype(np.float32),
+        y_sv=np.where(rng.random(16) < 0.5, -1, 1).astype(np.int32),
+        b=0.1, gamma=0.5)
+    mpath = str(tmp_path / "m.svm")
+    save_model(model, mpath)
+    rules_path = tmp_path / "rules.json"
+    rules_path.write_text(json.dumps(DRILL_RULES))
+    bundle_dir = str(tmp_path / "bundles")
+    trace = str(tmp_path / "serve_trace.jsonl")
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DPSVM_FAULT_SERVE_SLOW_REPLICA_MS"] = "60"
+    env["DPSVM_FAULT_SERVE_SLOW_FOR"] = "40"
+    port_file = tmp_path / "port.txt"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "serve", "-m", mpath,
+         "--port", "0", "--port-file", str(port_file),
+         "--max-batch", "8", "--watch-rules", str(rules_path),
+         "--bundle-dir", bundle_dir, "--trace-out", trace, "-q"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if port_file.exists() and port_file.read_text().strip():
+                break
+            if p.poll() is not None:
+                raise AssertionError(
+                    f"serve died: {p.communicate()[1]}")
+            time.sleep(0.2)
+        else:
+            raise AssertionError("serve never wrote its port file")
+        url = f"http://127.0.0.1:{int(port_file.read_text())}"
+        body = json.dumps({"instances": [[0.0] * 4],
+                           "timeout_ms": 15}).encode()
+
+        def post():
+            req = urllib.request.Request(
+                url + "/v1/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        def alerts():
+            with urllib.request.urlopen(url + "/metricsz",
+                                        timeout=10) as r:
+                return json.loads(r.read())
+
+        saw_504 = False
+        fired = False
+        end = time.monotonic() + 60.0
+        while time.monotonic() < end and not fired:
+            saw_504 |= (post() == 504)
+            m = alerts()
+            fired = any(a["state"] == "firing" for a in m["alerts"])
+        assert saw_504, "fault produced no 504s"
+        assert fired, "rule never fired in the serve subprocess"
+        cleared = False
+        while time.monotonic() < end and not cleared:
+            post()
+            cleared = all(a["state"] == "ok"
+                          for a in alerts()["alerts"])
+            if not cleared:
+                time.sleep(0.05)
+        assert cleared, "alert never cleared after the fault lifted"
+        assert alerts()["incidents_total"] >= 1
+    finally:
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=120)
+    assert p.returncode == 0, err[-2000:]
+    # the bundle validates, names rule + window, trace stands alone
+    bpath = blackbox.resolve_bundle_dir(bundle_dir)
+    assert blackbox.validate_bundle(bpath) == []
+    inc = blackbox.load_incident(bpath)
+    assert inc["rule"] == "availability-burn"
+    assert "fast=0.4s" in inc["window"]
+    # the serving trace is valid AND carries the watch events
+    from dpsvm_tpu.observability.report import load_trace
+    records = load_trace(trace)
+    assert validate_trace(records) == []
+    names = [r.get("event") for r in records
+             if r.get("kind") == "event"]
+    assert "alert" in names and "incident" in names, names
+    # `dpsvm bundle` gates it: exit 0 + the rule in the rendering
+    r = subprocess.run(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "bundle", bundle_dir],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "availability-burn" in r.stdout
+
+
+# ---------------------------------------------------------------------
+# training drill: planted stagnation -> driver-path bundle; zero-D2H
+# ---------------------------------------------------------------------
+
+def _stagnation_config(td, **over):
+    from dpsvm_tpu.config import SVMConfig
+    base = dict(c=1.0, epsilon=1e-12, max_iter=50_000, chunk_iters=64,
+                health_window=256, on_divergence="raise",
+                bundle_dir=str(td), verbose=False)
+    base.update(over)
+    return SVMConfig(**base)
+
+
+def _drill_data(n=80, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    return x, y
+
+
+def test_training_stagnation_dumps_bundle_from_driver(tmp_path):
+    """THE training drill: an epsilon no run can reach + a
+    health_window plants gap stagnation; the driver dumps an incident
+    bundle BEFORE the raise policy acts, and the bundle's embedded
+    trace validates."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.resilience.health import DivergenceError
+
+    x, y = _drill_data()
+    with pytest.raises(DivergenceError, match="stagnant"):
+        train(x, y, _stagnation_config(tmp_path))
+    bpath = blackbox.resolve_bundle_dir(str(tmp_path))
+    assert blackbox.validate_bundle(bpath) == []
+    inc = blackbox.load_incident(bpath)
+    assert inc["rule"] == "health-divergence"
+    assert inc["window"] == "health_window=256"
+    assert inc["source"] == "training"
+    assert "stagnant" in inc["reason"]
+    from dpsvm_tpu.observability.schema import read_trace
+    records = read_trace(os.path.join(bpath, "trace.jsonl"))
+    assert validate_trace(records) == []
+    assert any(r.get("kind") == "chunk" for r in records)
+    # the metrics snapshot rode along
+    assert "dpsvm_train_iterations" in open(
+        os.path.join(bpath, "metrics.prom")).read()
+
+
+def test_watch_rule_stagnation_fires_in_driver(tmp_path):
+    """The watch-rules path (not the HealthMonitor): a tiny stagnation
+    window fires mid-run, the trace records alert -> incident, and
+    the run itself is NOT killed (alerting observes, policy acts)."""
+    from dpsvm_tpu.api import train
+
+    x, y = _drill_data(seed=1)
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps([
+        {"name": "gap-stagnation", "kind": "stagnation",
+         "severity": "warn", "metric": "gap", "window_s": 1e-3}]))
+    trace = str(tmp_path / "t.jsonl")
+    bundles = tmp_path / "bundles"
+    bundles.mkdir()
+    cfg = _stagnation_config(
+        bundles, health_window=0, watch_rules=str(rules),
+        trace_out=trace, max_iter=6000)
+    r = train(x, y, cfg)
+    assert r.n_iter == 6000          # the run survived to its budget
+    from dpsvm_tpu.observability.report import load_trace
+    records = load_trace(trace)
+    assert validate_trace(records) == []
+    evs = [r for r in records if r.get("kind") == "event"]
+    alerts = [e for e in evs if e["event"] == "alert"]
+    incidents = [e for e in evs if e["event"] == "incident"]
+    assert alerts and alerts[0]["rule"] == "gap-stagnation"
+    assert incidents and os.path.isdir(incidents[0]["bundle"])
+    assert blackbox.validate_bundle(incidents[0]["bundle"]) == []
+
+
+def test_watched_run_adds_zero_device_polls(tmp_path, monkeypatch):
+    """THE zero-extra-D2H pin: a watched run (rules + bundle_dir
+    armed) performs exactly as many packed-stats polls as an
+    unwatched run, and lands on the same iterate."""
+    from dpsvm_tpu.api import train
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.solver import driver
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((400, 6)).astype(np.float32)
+    y = np.where(x[:, 0] + x[:, 1] > 0, 1, -1).astype(np.int32)
+    calls = {"n": 0}
+    real = driver.read_stats
+
+    def counting(stats):
+        calls["n"] += 1
+        return real(stats)
+
+    monkeypatch.setattr(driver, "read_stats", counting)
+    base = dict(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=30_000,
+                chunk_iters=64, verbose=False)
+    r1 = train(x, y, SVMConfig(**base))
+    plain = calls["n"]
+    calls["n"] = 0
+    r2 = train(x, y, SVMConfig(bundle_dir=str(tmp_path), **base))
+    watched = calls["n"]
+    assert r1.n_iter == r2.n_iter and r1.converged and r2.converged
+    assert watched == plain, \
+        f"the watch changed the poll count ({plain} -> {watched})"
+    # healthy run: no bundles dumped
+    assert not [b for b in os.listdir(tmp_path)
+                if b.startswith("incident-")]
+
+
+# ---------------------------------------------------------------------
+# CLI: watch exit codes + bundle gate
+# ---------------------------------------------------------------------
+
+def _run_cli(*argv, timeout=120):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    return subprocess.run([sys.executable, "-m", "dpsvm_tpu.cli",
+                           *argv], cwd=REPO, env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_watch_cli_exit_codes_per_severity(tmp_path):
+    """`dpsvm watch --once` against a snapshot file: a firing page
+    rule exits 5, a firing warn rule 4, a clean state 0 — the cron/CI
+    gate contract."""
+    from dpsvm_tpu.observability.metrics import (MetricsRegistry,
+                                                 write_snapshot)
+    reg = MetricsRegistry()
+    reg.gauge("dpsvm_serving_queue_depth", "q").set(100)
+    snap = str(tmp_path / "m.prom")
+    write_snapshot(reg, snap)
+
+    def rules(severity):
+        p = tmp_path / f"r_{severity}.json"
+        p.write_text(json.dumps([
+            {"name": "q", "kind": "threshold", "severity": severity,
+             "metric": "queue_depth", "above": 10.0}]))
+        return str(p)
+
+    r = _run_cli("watch", "--metrics-file", snap, "--rules",
+                 rules("page"), "--once", "--json")
+    assert r.returncode == 5, (r.stdout, r.stderr)
+    out = json.loads(r.stdout)
+    assert out["worst_fired"] == "page"
+    assert out["states"][0]["state"] == "firing"
+    r = _run_cli("watch", "--metrics-file", snap, "--rules",
+                 rules("warn"), "--once", "--json")
+    assert r.returncode == 4, (r.stdout, r.stderr)
+    ok = tmp_path / "r_ok.json"
+    ok.write_text(json.dumps([
+        {"name": "q", "kind": "threshold", "severity": "page",
+         "metric": "queue_depth", "above": 1000.0}]))
+    r = _run_cli("watch", "--metrics-file", snap, "--rules", str(ok),
+                 "--once", "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # a bad rules file is a usage error, not a crash
+    bad = tmp_path / "bad.json"
+    bad.write_text("[{\"kind\": \"nope\"}]")
+    r = _run_cli("watch", "--metrics-file", snap, "--rules", str(bad),
+                 "--once")
+    assert r.returncode == 2
+
+
+def test_watch_cli_stale_source_exits_3(tmp_path):
+    r = _run_cli("watch", "--metrics-file",
+                 str(tmp_path / "never_written.prom"),
+                 "--interval", "0.1", "--stale-timeout", "0.5")
+    assert r.returncode == 3, (r.stdout, r.stderr)
+
+
+def test_watch_cli_trace_source(tmp_path):
+    """`dpsvm watch --trace` replays chunk records through the
+    training rules deterministically (record t drives the clock) and
+    exits at the summary."""
+    fr = blackbox.FlightRecorder(blackbox.make_manifest(
+        solver="smo", n=100, d=4, gamma=0.5), capacity=128)
+    for i in range(40):
+        fr.chunk(n_iter=(i + 1) * 64, b_lo=0.25, b_hi=-0.25)
+    trace = tmp_path / "t.jsonl"
+    with open(trace, "w") as fh:
+        for rec in fr.trace_records():
+            fh.write(json.dumps(rec) + "\n")
+    # the flat gap above must trip a stagnation rule whose window is
+    # shorter than the ring's time span
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps([
+        {"name": "stag", "kind": "stagnation", "severity": "warn",
+         "metric": "gap", "window_s": 1e-9}]))
+    r = _run_cli("watch", "--trace", str(trace), "--rules",
+                 str(rules), "--interval", "0.05", "--json")
+    assert r.returncode == 4, (r.stdout, r.stderr)
+    out = json.loads(r.stdout)
+    assert out["states"][0]["fired_count"] >= 1
+
+
+def test_bundle_cli_valid_and_tampered(tmp_path):
+    path = _dump_sample_bundle(tmp_path)
+    r = _run_cli("bundle", str(tmp_path))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "gap-stagnation" in r.stdout and "bundle OK" in r.stdout
+    r = _run_cli("bundle", str(tmp_path), "--json")
+    out = json.loads(r.stdout)
+    assert out["valid"] and out["incident"]["rule"] == "gap-stagnation"
+    os.remove(os.path.join(path, "metrics.prom"))
+    r = _run_cli("bundle", str(path))
+    assert r.returncode == 1
+    r = _run_cli("bundle", str(tmp_path / "nowhere"))
+    assert r.returncode == 2
+
+
+def test_config_guards_watch_knobs():
+    """numpy backend and shrinking reject the watch knobs with the
+    reason (the no-silent-ignore convention)."""
+    from dpsvm_tpu.config import SVMConfig
+    with pytest.raises(ValueError, match="numpy backend"):
+        SVMConfig(backend="numpy", bundle_dir="/tmp/x").validate()
+    with pytest.raises(ValueError, match="watch_rules/bundle_dir"):
+        SVMConfig(shrinking=True, bundle_dir="/tmp/x").validate()
