@@ -151,6 +151,17 @@ TAGS = [
     # on device" check.
     sub("stream_fault_drill", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.data", "--selfcheck"]),
+    # Live continuous-learning drill (docs/SERVING.md "Continuous
+    # learning"): seed a shard log, serve from it, append a planted
+    # distribution shift mid-serve, and prove the drift -> warm-started
+    # refresh -> gate -> atomic hot-swap loop recovers held-out
+    # accuracy on the round's hardware with eject-free serving. The
+    # JSON row carries live_refresh_latency (drift-fire -> swapped
+    # generation wall seconds; also a perf-ledger "serve" row) and the
+    # serving trace (append_admitted/drift/refresh/retrain/promote
+    # events) archives under traces/ for `dpsvm report`.
+    sub("live_drift_drill", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.serving", "--live-drill"]),
     sub("inference", R3, 240,
         [sys.executable, "benchmarks/inference_bench.py"],
         BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),

@@ -167,7 +167,10 @@ def unpack_state(ck, dp: int) -> PrimalCarry:
         raise ValueError(
             f"checkpoint state shapes {ck.alpha.shape}/{f.shape} do not "
             f"match this problem's packed dim {dp} — was it written by "
-            "a different approx_dim?")
+            "a different approx_dim?"
+            + (" (shape dp + 4 is a LIVE streaming checkpoint; resume "
+               "it with fit_approx_stream(live=True))"
+               if f.shape == (dp + 4,) else ""))
     return PrimalCarry(
         w=np.asarray(ck.alpha, np.float32),
         v=f[:dp].copy(),
@@ -177,6 +180,60 @@ def unpack_state(ck, dp: int) -> PrimalCarry:
         n_iter=np.int32(ck.n_iter),
         nact=np.int32(0),
     )
+
+
+def pack_state_live(carry_host: PrimalCarry, generation: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Live-streaming checkpoint state: ``pack_state`` plus one lane
+    carrying the shard-log generation the trajectory had CONSUMED —
+    so a killed live run resumes with exactly the shard set it had
+    admitted (generations are small ints, exact in f32)."""
+    w, f = pack_state(carry_host)
+    return w, np.concatenate(
+        [f, np.asarray([np.float32(generation)], np.float32)])
+
+
+def unpack_state_live(ck, dp: int) -> Tuple[PrimalCarry, int]:
+    """(carry, consumed generation) from a live streaming checkpoint
+    (``pack_state_live``'s inverse)."""
+    f = np.asarray(ck.f, np.float32)
+    if ck.alpha.shape != (dp,) or f.shape != (dp + 4,):
+        raise ValueError(
+            f"live checkpoint state shapes {ck.alpha.shape}/{f.shape} "
+            f"do not match packed dim {dp} + the generation lane — "
+            "written by a frozen-stream run (resume with live=False) "
+            "or a different approx_dim?")
+    carry = PrimalCarry(
+        w=np.asarray(ck.alpha, np.float32),
+        v=f[:dp].copy(),
+        metric=np.float32(f[dp]),
+        best=np.float32(f[dp + 1]),
+        lrf=np.float32(f[dp + 2]),
+        n_iter=np.int32(ck.n_iter),
+        nact=np.int32(0),
+    )
+    return carry, int(f[dp + 3])
+
+
+def warm_start_vector(model: ApproxSVMModel) -> np.ndarray:
+    """The packed (dp,) primal weight vector of an approx model — the
+    ``init_w`` a warm-started (re)train starts from. The bias rides as
+    the last lane (the model stores ``b = -w[-1]``), so a fit seeded
+    with this vector begins at exactly the model's decision function."""
+    return np.concatenate([np.asarray(model.w, np.float32),
+                           np.asarray([-float(model.b)], np.float32)])
+
+
+def _apply_init_w(carry: PrimalCarry, init_w, dp: int) -> PrimalCarry:
+    iw = np.asarray(init_w, np.float32)
+    if iw.shape != (dp,):
+        raise ValueError(
+            f"init_w must be ({dp},) — the packed weight vector "
+            "including the bias lane (warm_start_vector(model)); got "
+            f"shape {iw.shape}")
+    if not np.isfinite(iw).all():
+        raise ValueError("init_w holds non-finite values")
+    return carry._replace(w=iw.copy())
 
 
 @functools.lru_cache(maxsize=32)
@@ -315,9 +372,8 @@ def _build_primal_runner(task: str, n_pad: int, dp: int, batch: int,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_stream_programs(task: str, dp: int, n_real: int, lam: float,
-                           big_l: float, epsilon: float, svr_eps: float,
-                           precision_name: str):
+def _build_stream_programs(task: str, dp: int, epsilon: float,
+                           svr_eps: float, precision_name: str):
     """Compiled programs for the OUT-OF-CORE full-batch path
     (``fit_approx_stream``): the host streams shards through ``acc``
     (partial data-gradient at the Nesterov lookahead point, one fixed
@@ -327,9 +383,17 @@ def _build_stream_programs(task: str, dp: int, n_real: int, lam: float,
     limit`` condition the in-memory while_loop checks, so a converged
     carry passes through untouched). ``stats_of`` packs the poll
     array for the zero-step edge (a speculative chunk dispatched after
-    max_iter). All three compile exactly once per geometry."""
+    max_iter). All three compile exactly once per geometry.
+
+    The problem-scale facts — row count ``n_real``, regularizer
+    ``lam`` and step size ``lr`` — ride as TRACED f32 scalars rather
+    than baked constants: a live shard log growing mid-run
+    (``fit_approx_stream(live=True)``, docs/DATA.md "Live shard
+    logs") changes only these operands, so ingest growth pins ZERO
+    retraces by construction (same values bitwise on frozen runs —
+    the scalars land in the identical f32 ops the constants did)."""
     precision = getattr(lax.Precision, precision_name)
-    lr, beta = 1.0 / big_l, _MOMENTUM
+    beta = _MOMENTUM
     reg_mask = np.ones((dp,), np.float32)
     reg_mask[-1] = 0.0          # the bias lane is not regularized
 
@@ -359,9 +423,9 @@ def _build_stream_programs(task: str, dp: int, n_real: int, lam: float,
         return (gacc * scale + gpart,
                 jnp.where(scale > 0, nacc, 0) + npart)
 
-    def upd(s: PrimalCarry, gacc, nacc, limit):
+    def upd(s: PrimalCarry, gacc, nacc, limit, n_real, lam, lr):
         u = s.w + beta * s.v
-        grad = gacc / jnp.float32(n_real) + lam * u * reg_mask
+        grad = gacc / n_real + lam * u * reg_mask
         metric = jnp.sqrt(jnp.sum(grad * grad))
         alive = (s.metric > 2.0 * epsilon) & (s.n_iter < limit)
         v_new = beta * s.v - (lr * s.lrf) * grad
@@ -395,7 +459,10 @@ def _build_stream_programs(task: str, dp: int, n_real: int, lam: float,
 
 def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
                       task: str = "svc",
-                      allow_nonfinite: bool = False
+                      allow_nonfinite: bool = False, *,
+                      live: Optional[bool] = None,
+                      init_w=None,
+                      watcher=None
                       ) -> Tuple[ApproxSVMModel, TrainResult]:
     """Featurize + primal-solve a ``data.stream.ShardedDataset`` that
     never fully materializes — the out-of-core training path
@@ -423,12 +490,33 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
     renormalize around lost rows), transient I/O errors retry with
     backoff, and ``config.mem_budget_mb`` refuses an over-budget
     per-shard working set up front.
+
+    ``live=True`` (or ``config.live``) trains the dataset as a LIVE
+    shard log (docs/DATA.md "Live shard logs"): a ``ShardLogWatcher``
+    polls the manifest at every sweep boundary and admits new durable
+    shards into the in-progress run — the admitted delta is traced
+    (``append_admitted`` per shard, one ``ingest_grow`` per growing
+    boundary), the divisor/regularizer/step-size math re-derives from
+    the grown view host-side, and because the update program takes
+    those scalars as traced operands growth causes ZERO retraces and
+    ZERO extra packed-stats polls (pinned in tests/test_live.py).
+    Checkpoints carry the CONSUMED generation, so a SIGKILL at any
+    boundary resumes bitwise: the resumed run re-admits exactly the
+    shards the dead run had admitted before the watcher sees anything
+    newer. Resume contract: open the dataset pinned at the same entry
+    generation the original run started from
+    (``ShardedDataset.open(dir, at_generation=g0)``).
+
+    ``init_w`` warm-starts the weights (``warm_start_vector(model)``)
+    — the continuous-learning loop's incremental refresh; a configured
+    ``resume_from`` checkpoint takes precedence.
     """
     from dpsvm_tpu.data import stream as streamlib
     from dpsvm_tpu.solver.driver import queue_trace_event
 
     config = config or SVMConfig()
     config.validate()
+    live = bool(config.live) if live is None else bool(live)
     if config.solver == "exact":
         raise ValueError(
             "streaming training is the approx primal path (the exact "
@@ -442,6 +530,10 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
             "fit_approx_stream is single-process: the sharded "
             "full-batch path (config.shards > 1) consumes in-memory "
             "arrays — materialize, or stream on one process")
+    # n is the ENTRY view's row count and stays the run's identity
+    # (trace manifest, checkpoint validation) even as a live log
+    # grows: growth is recorded by events + the generation lane, and
+    # a resume re-enters at the same pinned view.
     n, d = ds.n, ds.d
     gamma = float(config.resolve_gamma(d))
     spec = config.kernel_spec(d)
@@ -507,44 +599,121 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
     # first — deterministically, so an interrupted run and its resume
     # see the identical live set) while the curvature stat accumulates
     # over real rows. One extra I/O pass buys the same tuning-free
-    # step size the in-memory path measures.
-    msq_num = 0.0
-    seen = 0
-    for k in range(ds.n_shards):
+    # step size the in-memory path measures. Live admission reuses the
+    # same absorb step per appended shard, so the curvature stat's
+    # accumulation order (shard index order) is identical whether a
+    # shard arrived in the seed view or as an append — the bitwise
+    # resume contract's arithmetic half.
+    scale_state = {"msq_num": 0.0, "seen": 0}
+
+    def absorb_shard(k: int) -> int:
         got = ds.read_shard_checked(k, on_bad_shard=policy,
                                     allow_nonfinite=allow_nonfinite)
         if got is None:
-            continue
+            return 0
         xk, yk = got
         shard_lanes(k, yk)              # label sanity up front
         phi = np.asarray(featurize_block(xk))
-        msq_num += float(np.sum(phi[: len(yk)].astype(np.float64) ** 2))
-        seen += len(yk)
-    if seen == 0:
+        scale_state["msq_num"] += float(
+            np.sum(phi[: len(yk)].astype(np.float64) ** 2))
+        scale_state["seen"] += len(yk)
+        return len(yk)
+
+    for k in range(ds.n_shards):
+        absorb_shard(k)
+    if scale_state["seen"] == 0:
         raise streamlib.IngestAbortError(
             f"{ds.directory}: no readable shard survived the prologue")
-    msq = msq_num / seen + 1.0          # + the bias lane
-    lam = 1.0 / (float(config.c) * n)
     maxrw = (max(float(config.weight_pos), float(config.weight_neg))
              if task == "svc" else 1.0)
-    # Trace bound only: the spectral estimate needs power-iteration
-    # passes over all shards (an epoch of I/O each); the plateau decay
-    # recovers the difference in step count (docs/APPROX.md).
-    big_l = lam + 2.0 * maxrw * msq
+    live_state = {"n": ds.n, "gen": int(getattr(ds, "generation", 0))}
+
+    def scale_params() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(n_real, lam, lr) as f32 scalars for the update program —
+        re-derived host-side from the CURRENT admitted view, so live
+        growth changes operand values, never programs. The divisor is
+        the admitted manifest n (quarantined rows included — the
+        objective does not silently renormalize around lost rows) and
+        the step size keeps the trace curvature bound
+        (docs/APPROX.md): the spectral estimate would need
+        power-iteration I/O epochs."""
+        n_live = int(live_state["n"])
+        msq = scale_state["msq_num"] / scale_state["seen"] + 1.0
+        lam = 1.0 / (float(config.c) * n_live)
+        big_l = lam + 2.0 * maxrw * msq
+        return (np.float32(n_live), np.float32(lam),
+                np.float32(1.0 / big_l))
 
     acc_j, upd_j, stats_j = _build_stream_programs(
-        task, dp, n, lam, big_l, float(config.epsilon),
+        task, dp, float(config.epsilon),
         float(config.svr_epsilon), config.matmul_precision.upper())
     acc = compilewatch.instrument(acc_j, "stream-acc")
     upd = compilewatch.instrument(upd_j, "stream-upd")
 
-    carry = init_carry(dp)
-    ckpt = resume_state(config, n, dp, gamma)
-    if ckpt is not None:
-        carry = unpack_state(ckpt, dp)
-        queue_trace_event("ingest_resume", n_iter=int(ckpt.n_iter),
+    if live and watcher is None:
+        from dpsvm_tpu.data.live import ShardLogWatcher
+        watcher = ShardLogWatcher(
+            ds, on_bad_shard=policy,
+            allow_nonfinite=allow_nonfinite,
+            # absorb_shard below verifies (and may quarantine) every
+            # admitted shard — a second integrity read would be waste
+            verify_appends=False,
+            # admissions land in THIS run's trace at the next poll
+            on_event=lambda e, **kw: queue_trace_event(e, **kw))
+    if watcher is not None and watcher.ds is not ds:
+        raise ValueError("watcher must wrap the SAME ShardedDataset "
+                         "handle this run trains on")
+
+    def admit_new() -> None:
+        """Sweep-boundary admission (live mode): one manifest poll —
+        pure host I/O, zero device transfers. Newly durable shards are
+        absorbed (verified under the on_bad_shard policy, curvature
+        stat grown) and the boundary is traced as ONE ingest_grow
+        event carrying the new generation and row delta."""
+        admitted = watcher.poll()
+        if not admitted:
+            return
+        grown = 0
+        for k in admitted:
+            grown += absorb_shard(k)
+        live_state["n"] = ds.n
+        live_state["gen"] = int(ds.generation)
+        queue_trace_event("ingest_grow",
+                          generation=int(ds.generation),
+                          n_new_rows=int(grown),
                           shards=int(ds.n_shards),
                           quarantined=len(ds.quarantined))
+
+    carry = init_carry(dp)
+    if init_w is not None:
+        carry = _apply_init_w(carry, init_w, dp)
+    ckpt = resume_state(config, n, dp, gamma)
+    if ckpt is not None:
+        if live:
+            carry, gen_ck = unpack_state_live(ckpt, dp)
+            if gen_ck > ds.generation:
+                # Re-admit EXACTLY the shards the dead run had
+                # consumed (entries stamped <= the checkpoint's
+                # generation) before the watcher may see anything
+                # newer — the bitwise-resume contract's ingest half.
+                from dpsvm_tpu.data.live import read_manifest_checked
+                manifest = read_manifest_checked(ds.directory)
+                pinned = streamlib.pin_manifest_generation(manifest,
+                                                           gen_ck)
+                for k in ds.admit_manifest(pinned):
+                    absorb_shard(k)
+                live_state["n"] = ds.n
+                live_state["gen"] = int(ds.generation)
+            queue_trace_event("ingest_resume",
+                              n_iter=int(ckpt.n_iter),
+                              shards=int(ds.n_shards),
+                              generation=int(ds.generation),
+                              quarantined=len(ds.quarantined))
+        else:
+            carry = unpack_state(ckpt, dp)
+            queue_trace_event("ingest_resume", n_iter=int(ckpt.n_iter),
+                              shards=int(ds.n_shards),
+                              quarantined=len(ds.quarantined))
     carry = jax.device_put(carry)
     it0 = int(ckpt.n_iter) if ckpt is not None else 0
 
@@ -557,6 +726,9 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
         g, na = state["gacc"], state["nacc"]
         stats = None
         while state["it"] < limit:
+            if live:
+                admit_new()
+            nf, lamf, lr32 = scale_params()
             first = True
             for k in range(ds.n_shards):
                 got = ds.read_shard_checked(
@@ -573,7 +745,7 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
             if first:
                 raise streamlib.IngestAbortError(
                     f"{ds.directory}: every shard is quarantined")
-            c, stats = upd(c, g, na, np.int32(limit))
+            c, stats = upd(c, g, na, np.int32(limit), nf, lamf, lr32)
             state["it"] += 1
         if stats is None:
             # Zero-step dispatch (speculative chunk at max_iter):
@@ -585,15 +757,26 @@ def fit_approx_stream(ds, config: Optional[SVMConfig] = None,
 
     def carry_from_ckpt(ck):
         # Rollback restores BOTH halves of the trajectory state: the
-        # device carry and the host epoch cursor.
+        # device carry and the host epoch cursor. (Live mode: the
+        # admitted view never shrinks — a rollback to an older
+        # generation keeps the grown view, which is the superset the
+        # original trajectory was about to admit anyway.)
         state["it"] = int(ck.n_iter)
+        if live:
+            restored, _gen = unpack_state_live(ck, dp)
+            return jax.device_put(restored)
         return jax.device_put(unpack_state(ck, dp))
+
+    def carry_to_host(c):
+        host = jax.tree_util.tree_map(np.asarray, c)
+        if live:
+            return pack_state_live(host, live_state["gen"])
+        return pack_state(host)
 
     result = host_training_loop(
         config, gamma, n, dp, carry,
         step_chunk=step_chunk,
-        carry_to_host=lambda c: pack_state(
-            jax.tree_util.tree_map(np.asarray, c)),
+        carry_to_host=carry_to_host,
         it0=it0,
         carry_from_ckpt=carry_from_ckpt,
     )
@@ -653,14 +836,19 @@ def _check_svc_labels(y: np.ndarray) -> np.ndarray:
 
 def fit_approx(x: np.ndarray, y: np.ndarray,
                config: Optional[SVMConfig] = None,
-               task: str = "svc"
+               task: str = "svc", *,
+               init_w=None
                ) -> Tuple[ApproxSVMModel, TrainResult]:
     """Featurize + primal-solve; the approx path's ``api.fit``.
 
     Returns ``(ApproxSVMModel, TrainResult)``: the result's
     ``b_lo``/``b_hi`` carry the final (metric, 0) pair — its ``gap``
     IS the gradient-norm metric — and ``n_sv`` counts the last
-    minibatch's margin violators (there is no SV set).
+    minibatch's margin violators (there is no SV set). ``init_w``
+    warm-starts the weights from a packed (dp,) vector
+    (``warm_start_vector(model)``) — the continuous-learning loop's
+    cheap refresh and the cascade's warm-started full retrain; a
+    configured ``resume_from`` checkpoint takes precedence.
     """
     from dpsvm_tpu.utils import densify
 
@@ -756,6 +944,8 @@ def fit_approx(x: np.ndarray, y: np.ndarray,
         "approx-primal-chunk")
 
     carry = init_carry(dp)
+    if init_w is not None:
+        carry = _apply_init_w(carry, init_w, dp)
     # Checkpoint identity: (n, Dp) names the packed primal problem the
     # way (n, d) names a dual one. The feature map itself is not
     # persisted in the checkpoint — it is deterministic in the config

@@ -153,6 +153,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "drops the shard — traced as a `quarantine` "
                          "event naming shard + reason, bounded by the "
                          "bad-fraction abort (docs/DATA.md)")
+    tr.add_argument("--live", action="store_true",
+                    help="treat a shard-directory input as a LIVE "
+                         "append log (docs/DATA.md 'Live shard "
+                         "logs'): streaming approx training polls the "
+                         "manifest at sweep boundaries and admits "
+                         "newly durable shards mid-run (traced as "
+                         "append_admitted/ingest_grow; checkpoints "
+                         "carry the consumed generation)")
     tr.add_argument("--health-window", type=int, default=0, metavar="I",
                     help="iterations without best-gap improvement "
                          "before the stagnation guard trips (0 = off)")
@@ -1288,6 +1296,13 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "(streaming covers plain --solver approx-* "
                   "training); reads stay integrity-checked and "
                   "budget-guarded", file=sys.stderr)
+    if getattr(args, "live", False) and not stream_train:
+        # No-silent-ignore: live ingest IS the streaming train path.
+        print("error: --live applies to streaming shard-directory "
+              "training (--solver approx-* on a converted directory); "
+              "this input/mode trains a frozen materialized view",
+              file=sys.stderr)
+        return 2
     if stream_train:
         x = y = None
     else:
@@ -1350,6 +1365,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         screen_cap=args.screen_cap,
         mem_budget_mb=args.mem_budget_mb,
         on_bad_shard=args.on_bad_shard,
+        live=getattr(args, "live", False),
     )
     # Tuned-profile resolution: explicit value > tuned profile >
     # built-in default (tuning/profile.py; opt out with --no-tuned /

@@ -280,6 +280,19 @@ class SVMConfig:
                                         # naming shard + reason),
                                         # bounded by the bad-fraction
                                         # abort
+    live: bool = False                  # treat a shard-directory
+                                        # dataset as a LIVE append log
+                                        # (data/live.py, docs/DATA.md
+                                        # "Live shard logs"): streaming
+                                        # training polls the manifest
+                                        # at sweep boundaries and
+                                        # admits newly durable shards
+                                        # mid-run (traced as
+                                        # append_admitted/ingest_grow;
+                                        # checkpoints carry the
+                                        # consumed generation). Only
+                                        # the approx streaming path
+                                        # (train -f DIR --live)
     verbose: bool = False
     log_every: int = 0                  # 0 = no per-chunk logging
     wall_budget_s: float = 0.0          # stop dispatching chunks once this
@@ -478,6 +491,13 @@ class SVMConfig:
         if self.on_bad_shard not in ("raise", "quarantine"):
             raise ValueError("on_bad_shard must be 'raise' or "
                              f"'quarantine', got {self.on_bad_shard!r}")
+        if self.live and self.solver not in ("approx-rff",
+                                             "approx-nystrom"):
+            raise ValueError(
+                "live=True is the streaming approx path's knob "
+                "(fit_approx_stream admits appended shards at sweep "
+                f"boundaries); solver {self.solver!r} trains a frozen "
+                "view — docs/DATA.md 'Live shard logs'")
         if self.metrics_port is not None and not (
                 0 <= int(self.metrics_port) <= 65535):
             raise ValueError(

@@ -155,6 +155,86 @@ def selfcheck(tmp_dir=None):
             if "rows" not in str(e) or "shards" not in str(e):
                 problems.append(f"budget refusal lacks the shard math: "
                                 f"{e}")
+
+        # 6. live-append gate (docs/DATA.md "Live shard logs"): a torn
+        # publish is NEVER read (the watcher holds its view), a stale
+        # generation is refused, clean publishes are admitted, and a
+        # preemption at an admission boundary resumes BITWISE —
+        # re-admitting exactly the shards the dead run had consumed.
+        from dpsvm_tpu.data import live as livelib
+
+        ldir = os.path.join(base, "livelog")
+        streamlib.convert_to_shards(src, ldir, rows_per_shard=96)
+        ds_l = streamlib.ShardedDataset.open(ldir)
+        watcher = livelib.ShardLogWatcher(ds_l)
+        faultinject.install(
+            faultinject.FaultPlan(live_torn_publish=1))
+        try:
+            livelib.append_shard(ldir, x[:96], y[:96])
+            problems.append("torn publish did not crash the writer")
+        except livelib.WriterCrashError:
+            pass
+        finally:
+            faultinject.clear()
+        if watcher.poll() or ds_l.generation != 0:
+            problems.append("watcher advanced on a TORN publish")
+        if watcher.torn_observed != 1:
+            problems.append(f"torn publish not observed "
+                            f"({watcher.torn_observed})")
+        livelib.append_shard(ldir, x[:96], y[:96])   # repairs the log
+        watcher.poll()
+        if ds_l.generation != 1 or ds_l.n != 480:
+            problems.append(f"repaired publish not admitted (gen "
+                            f"{ds_l.generation}, n {ds_l.n})")
+        # Stale-generation refusal is relative to the READER's view: the
+        # watcher is now AT generation 1, so a replayed gen-1 publish
+        # with changed content must be refused, not admitted.
+        faultinject.install(
+            faultinject.FaultPlan(live_stale_generation=1))
+        try:
+            livelib.append_shard(ldir, x[96:160], y[96:160])
+        finally:
+            faultinject.clear()
+        watcher.poll()
+        if ds_l.generation != 1 or watcher.stale_observed < 1:
+            problems.append(
+                f"stale-generation publish not refused (gen "
+                f"{ds_l.generation}, stale {watcher.stale_observed})")
+        # The next clean publish advances the generation and carries
+        # BOTH the stale-published shard and the new one — the watcher
+        # admits them together, never having read the stale bytes.
+        livelib.append_shard(ldir, x[160:200], y[160:200])
+        watcher.poll()
+        if ds_l.generation != 2 or ds_l.n != 384 + 96 + 64 + 40:
+            problems.append(f"clean publishes not admitted (gen "
+                            f"{ds_l.generation}, n {ds_l.n})")
+        # kill -> bitwise resume across the admission boundary
+        from dpsvm_tpu.resilience.preempt import PreemptedError as _PE
+        live_cfg = dict(solver="approx-rff", approx_dim=32, c=10.0,
+                        epsilon=1e-9, max_iter=64, chunk_iters=32,
+                        verbose=False)
+        ds_a = streamlib.ShardedDataset.open(ldir, at_generation=0)
+        m_live, _ = fit_approx_stream(ds_a, SVMConfig(**live_cfg),
+                                      live=True)
+        lck = os.path.join(base, "live_ck.npz")
+        ds_b = streamlib.ShardedDataset.open(ldir, at_generation=0)
+        faultinject.install(faultinject.FaultPlan(preempt_at_poll=1))
+        try:
+            fit_approx_stream(ds_b, SVMConfig(checkpoint_path=lck,
+                                              checkpoint_every=32,
+                                              **live_cfg), live=True)
+            problems.append("live preemption did not raise")
+        except _PE:
+            pass
+        finally:
+            faultinject.clear()
+        ds_c = streamlib.ShardedDataset.open(ldir, at_generation=0)
+        m_lres, _ = fit_approx_stream(
+            ds_c, SVMConfig(resume_from=lck, **live_cfg), live=True)
+        if not np.array_equal(m_live.w, m_lres.w):
+            problems.append(
+                "live resume is not bitwise-identical (max delta "
+                f"{float(np.max(np.abs(m_live.w - m_lres.w)))})")
     except Exception as e:              # noqa: BLE001 - gate reports
         import traceback
         traceback.print_exc()
@@ -183,5 +263,7 @@ def main(argv=None):
             print(f"SELFCHECK FAIL: {p}", file=sys.stderr)
         return 1
     print("data selfcheck OK: convert + stream-train + quarantine "
-          "drill + bitwise resume + byte-identical manifest resume")
+          "drill + bitwise resume + byte-identical manifest resume + "
+          "live-append gate (torn publish never read, stale "
+          "generation refused, bitwise live resume)")
     return 0

@@ -1,0 +1,465 @@
+"""Live shard logs: crash-safe appends + the watcher that admits them.
+
+The PR 9 shard format (append-ordered CRC-manifested shards + a
+manifest) is already a log; this module adds the protocol that makes
+it safe to APPEND to while readers are training and serving from it —
+the workload a production deployment actually has ("Parallel SVMs in
+Practice", arXiv:1404.1066: data never stops arriving). The fault
+model comes first, as everywhere in this repo:
+
+* **Publish protocol** — a writer lands the shard file with the
+  existing atomic write (tmp + rename), then PUBLISHES it by swapping
+  in a new ``manifest.json`` whose ``generation`` is strictly
+  incremented and whose bytes carry a self-CRC (``manifest_crc`` over
+  the canonical serialization). The swap is atomic too, and the
+  previous good manifest is kept at ``manifest.json.prev`` so a
+  writer restarted over a torn manifest (non-atomic filesystem,
+  kill -9 mid-write — the ``DPSVM_FAULT_LIVE_TORN_PUBLISH`` model)
+  recovers WITHOUT reconstructing state: readers never consult
+  ``.prev`` (that would be a generation regression), only writers do.
+* **Reader rules** — a reader only ever advances on a manifest that
+  (a) parses, (b) passes its self-CRC, and (c) carries a generation
+  STRICTLY greater than the reader's current one, and (d) purely
+  EXTENDS the admitted shard list (the common prefix byte-identical).
+  Anything else — a torn publish, a replayed stale generation, a
+  rewritten prefix — leaves the reader's view untouched: a torn or
+  partial publish is NEVER visible downstream.
+* **ShardLogWatcher** — the polling reader: bounded transient-read
+  retry/backoff (the ``DPSVM_IO_RETRIES`` semantics shard reads
+  already use), quarantine of bad APPENDED shards under the existing
+  ``on_bad_shard`` policy, and an ``append_admitted`` event per
+  admitted shard naming shard + generation (live training wires the
+  sink to the driver's pending-event queue so admissions land in the
+  run trace, like ``quarantine``; a standalone watcher emits nowhere).
+
+Consumers: ``approx/primal.fit_approx_stream(live=True)`` admits new
+durable shards at sweep boundaries (docs/DATA.md "Live shard logs"),
+and the continuous-learning serving loop
+(``serving/lifecycle.ContinuousLearningLoop``) refreshes the served
+model from the growing log (docs/SERVING.md "Continuous learning").
+
+No jax at module level: append and watch must run on writer machines
+with no accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import zlib
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from dpsvm_tpu.data.stream import (MANIFEST_NAME, ShardedDataset,
+                                   StreamError, _write_json_atomic,
+                                   _write_shard_atomic, payload_crc,
+                                   shard_filename)
+from dpsvm_tpu.resilience import faultinject
+
+#: the writer's rolling backup of the last good manifest — consulted
+#: ONLY by writers recovering from a torn publish; readers advancing
+#: on it would regress the generation.
+PREV_MANIFEST_NAME = MANIFEST_NAME + ".prev"
+
+
+class TornPublishError(StreamError):
+    """manifest.json exists but cannot be trusted: unparseable JSON or
+    a failed self-CRC — a writer crashed mid-publish (or is mid-write
+    on a non-atomic filesystem). Transient to readers (hold the last
+    admitted view and retry); writers recover from the .prev backup."""
+
+
+class WriterCrashError(StreamError):
+    """Raised by the LIVE_* fault hooks at their configured crash
+    point — the deterministic stand-in for a writer process dying."""
+
+
+def _log(msg: str) -> None:
+    print(f"LIVELOG: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------
+# manifest self-CRC
+# ---------------------------------------------------------------------
+
+def manifest_crc(manifest: dict) -> int:
+    """CRC32 over the canonical serialization of the manifest WITHOUT
+    its ``manifest_crc`` key: a pure function of the content, so any
+    torn / bit-rotted publish fails verification."""
+    body = {k: v for k, v in manifest.items() if k != "manifest_crc"}
+    raw = json.dumps(body, sort_keys=True,
+                     separators=(",", ":")).encode()
+    return zlib.crc32(raw)
+
+
+def verify_manifest_crc(manifest: dict, where: str = "manifest") -> None:
+    got = manifest_crc(manifest)
+    want = int(manifest["manifest_crc"])
+    if got != want:
+        raise TornPublishError(
+            f"{where}: manifest self-CRC mismatch (recorded {want}, "
+            f"computed {got}) — a torn or bit-rotted publish; readers "
+            "must hold their last admitted view")
+
+
+def read_manifest_checked(directory: str) -> dict:
+    """Parse + verify ``directory``'s manifest under the reader rules:
+    raises ``TornPublishError`` on anything a mid-publish writer could
+    have left (unparseable bytes, failed self-CRC) and ``StreamError``
+    on a missing manifest. A manifest WITHOUT a self-CRC (a frozen
+    converted directory that has never been appended to) passes — the
+    append protocol is what introduces the CRC."""
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise StreamError(f"{directory}: no {MANIFEST_NAME} — not a "
+                          "shard dataset")
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise TornPublishError(
+            f"{mpath}: unparseable manifest ({e}) — a torn publish; "
+            "readers must hold their last admitted view") from e
+    if "manifest_crc" in manifest:
+        verify_manifest_crc(manifest, where=mpath)
+    return manifest
+
+
+# ---------------------------------------------------------------------
+# the writer side: crash-safe append
+# ---------------------------------------------------------------------
+
+def _read_writer_manifest(directory: str) -> dict:
+    """The manifest a WRITER resumes from: the live one when intact,
+    else the ``.prev`` backup (recovering a torn publish — the shard
+    of the torn generation is orphaned on disk and will be re-written
+    by the next append)."""
+    try:
+        return read_manifest_checked(directory)
+    except TornPublishError as e:
+        prev = os.path.join(directory, PREV_MANIFEST_NAME)
+        if os.path.exists(prev):
+            try:
+                with open(prev) as fh:
+                    manifest = json.load(fh)
+                if "manifest_crc" in manifest:
+                    verify_manifest_crc(manifest, where=prev)
+                _log(f"recovering from torn publish via {prev} "
+                     f"(generation {manifest.get('generation', 0)}); "
+                     "re-publishing will repair the live manifest")
+                return manifest
+            except (OSError, json.JSONDecodeError, TornPublishError):
+                pass
+        raise StreamError(
+            f"{directory}: manifest is torn and no intact "
+            f"{PREV_MANIFEST_NAME} backup exists — {e}") from e
+
+
+def append_shard(directory: str, x: np.ndarray, y: np.ndarray) -> dict:
+    """Append one shard to a live log, crash-safely.
+
+    Protocol (module docstring): atomic shard write -> atomic backup
+    of the current manifest to ``.prev`` -> atomic publish of the new
+    manifest with ``generation + 1``, the shard entry stamped with the
+    generation that published it, and a fresh self-CRC. ``x`` may hold
+    up to ``rows_per_shard`` rows (a partial final batch publishes as
+    a partial shard — the reader's offsets are cumulative). Returns
+    the published manifest. The ``DPSVM_FAULT_LIVE_*`` hooks fire at
+    their documented points (faultinject module docstring)."""
+    manifest = _read_writer_manifest(directory)
+    x = np.ascontiguousarray(np.asarray(x, np.float32))
+    ydt = (np.float32 if manifest.get("label_dtype") == "float32"
+           else np.int32)
+    y = np.ascontiguousarray(np.asarray(y, ydt))
+    if x.ndim != 2 or x.shape[1] != int(manifest["d"]):
+        raise ValueError(
+            f"appended shard must be (rows, {manifest['d']}), got "
+            f"{x.shape}")
+    if y.shape != (x.shape[0],):
+        raise ValueError(f"labels must be ({x.shape[0]},), got "
+                         f"{y.shape}")
+    rows = int(x.shape[0])
+    rps = int(manifest["rows_per_shard"])
+    if not (1 <= rows <= rps):
+        raise ValueError(
+            f"appended shard holds {rows} row(s); the log's geometry "
+            f"admits 1..{rps} (rows_per_shard={rps} — fixed shapes "
+            "are the zero-retrace contract)")
+    if not np.isfinite(x).all():
+        bad = np.argwhere(~np.isfinite(x))[0]
+        raise ValueError(f"appended shard has a non-finite value at "
+                         f"row {int(bad[0])}, column {int(bad[1])} — "
+                         "rejected before it can poison the log")
+
+    k = len(manifest["shards"])
+    gen = int(manifest.get("generation", 0)) + 1
+    fname = shard_filename(k)
+    _write_shard_atomic(os.path.join(directory, fname), x, y)
+
+    plan = faultinject.current()
+    if plan is not None and plan.live_append_begin():
+        # Writer died with the shard durable but un-published: the
+        # orphan file is invisible to readers (not in any manifest)
+        # and the next append overwrites it at the same index.
+        raise WriterCrashError(
+            f"writer crashed after shard {fname} was durable, before "
+            "its publish (injected)")
+
+    new = dict(manifest)
+    new["shards"] = list(manifest["shards"]) + [{
+        "file": fname, "rows": rows, "crc32": int(payload_crc(x, y)),
+        "generation": gen,
+    }]
+    new["n"] = int(manifest["n"]) + rows
+    new["generation"] = gen
+    stats = dict(manifest.get("stats") or {})
+    if stats.get("feature_min") is not None:
+        fmin = np.minimum(np.asarray(stats["feature_min"], np.float32),
+                          x.min(axis=0))
+        fmax = np.maximum(np.asarray(stats["feature_max"], np.float32),
+                          x.max(axis=0))
+        stats["feature_min"] = [float(np.float32(v)) for v in fmin]
+        stats["feature_max"] = [float(np.float32(v)) for v in fmax]
+        stats["label_min"] = min(float(stats["label_min"]),
+                                 float(y.min()))
+        stats["label_max"] = max(float(stats["label_max"]),
+                                 float(y.max()))
+        new["stats"] = stats
+    return publish_manifest(directory, new, previous=manifest)
+
+
+def publish_manifest(directory: str, manifest: dict, *,
+                     previous: Optional[dict] = None) -> dict:
+    """The atomic generation swap: back the current good manifest up
+    to ``.prev``, stamp the self-CRC, replace ``manifest.json``. The
+    fault hooks simulate the two writer failure modes here: a TORN
+    publish (half the bytes written in place, then crash — the
+    non-atomic-filesystem model) and a STALE publish (CRC-valid bytes
+    whose generation did not advance — a replayed/split-brain
+    writer)."""
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    if previous is not None:
+        _write_json_atomic(os.path.join(directory, PREV_MANIFEST_NAME),
+                           previous)
+    plan = faultinject.current()
+    mode = plan.live_publish_mode() if plan is not None else "clean"
+    if mode == "stale":
+        stale = dict(manifest)
+        stale["generation"] = int(previous.get("generation", 0)
+                                  if previous is not None else 0)
+        stale["manifest_crc"] = manifest_crc(stale)
+        _write_json_atomic(mpath, stale)
+        return stale
+    manifest = dict(manifest)
+    manifest["manifest_crc"] = manifest_crc(manifest)
+    if mode == "torn":
+        raw = (json.dumps(manifest, sort_keys=True, indent=1)
+               + "\n").encode()
+        with open(mpath, "wb") as fh:      # deliberately NON-atomic
+            fh.write(raw[: len(raw) // 2])
+        raise WriterCrashError(
+            "writer crashed mid-publish: manifest.json is torn "
+            "(injected); readers hold their view, the restarted "
+            "writer recovers from .prev")
+    _write_json_atomic(mpath, manifest)
+    return manifest
+
+
+# ---------------------------------------------------------------------
+# the reader side: the watcher
+# ---------------------------------------------------------------------
+
+class ShardLogWatcher:
+    """Polling reader over a live shard log.
+
+    Wraps a ``ShardedDataset`` handle (whose view it grows in place —
+    every consumer holding the handle sees the admitted shards) and
+    enforces the reader rules of the module docstring. ``poll()`` is
+    pure host I/O: one manifest read per call, shard payloads only
+    touched when ``verify_appends`` asks for an integrity read of the
+    newly admitted shards.
+
+    Counters: ``torn_observed`` / ``stale_observed`` count the
+    publishes this reader REFUSED (the drill's assertion surface);
+    ``admitted_shards``/``admitted_rows`` total what it accepted.
+    """
+
+    def __init__(self, ds: ShardedDataset, *,
+                 on_bad_shard: str = "raise",
+                 allow_nonfinite: bool = False,
+                 on_event: Optional[Callable[..., None]] = None,
+                 verify_appends: bool = True):
+        self.ds = ds
+        self.on_bad_shard = on_bad_shard
+        self.allow_nonfinite = allow_nonfinite
+        self.verify_appends = verify_appends
+        self._on_event = on_event
+        self.torn_observed = 0
+        self.stale_observed = 0
+        self.admitted_shards = 0
+        self.admitted_rows = 0
+
+    @property
+    def generation(self) -> int:
+        return self.ds.generation
+
+    def _emit(self, event: str, **extra) -> None:
+        # No default sink: a standalone watcher (doctor probes, tests,
+        # ad-hoc polling) must NOT feed the training driver's global
+        # pending-event queue — its events would leak into whatever
+        # trace the process opens next. Consumers that want the events
+        # pass a sink: live training wires queue_trace_event, the
+        # drill wires its serving trace.
+        if self._on_event is not None:
+            self._on_event(event, **extra)
+
+    def _read_manifest_retrying(self) -> Optional[dict]:
+        from dpsvm_tpu.data.stream import (DEFAULT_IO_BACKOFF_S,
+                                           DEFAULT_IO_RETRIES)
+        retries = int(os.environ.get("DPSVM_IO_RETRIES",
+                                     str(DEFAULT_IO_RETRIES)))
+        backoff = float(os.environ.get("DPSVM_IO_RETRY_BACKOFF_S",
+                                       str(DEFAULT_IO_BACKOFF_S)))
+        for attempt in range(retries + 1):
+            try:
+                return read_manifest_checked(self.ds.directory)
+            except TornPublishError:
+                # A torn manifest is a writer mid-crash (or mid-write):
+                # hold the admitted view. No retry loop here — the next
+                # poll is the retry, at the caller's cadence.
+                self.torn_observed += 1
+                _log(f"{self.ds.directory}: torn publish observed "
+                     f"(#{self.torn_observed}); holding generation "
+                     f"{self.ds.generation}")
+                return None
+            except (OSError, StreamError) as e:
+                if attempt >= retries or not isinstance(e, OSError):
+                    raise
+                wait = backoff * (2.0 ** attempt)
+                _log(f"transient manifest read failure ({e}); retry "
+                     f"{attempt + 1}/{retries} in {wait:g}s")
+                time.sleep(wait)
+        return None
+
+    def poll(self) -> List[int]:
+        """One watch cycle. Returns the newly admitted shard indices
+        (empty when the log did not durably advance). Emits one
+        ``append_admitted`` event per admitted shard (shard,
+        generation, rows — the schema-required keys)."""
+        manifest = self._read_manifest_retrying()
+        if manifest is None:
+            return []
+        gen = int(manifest.get("generation", 0))
+        if gen < self.ds.generation:
+            # A replayed (stale) generation: never regress. Note it
+            # and hold — a split-brain writer's publish must not
+            # un-admit data training already consumed.
+            self.stale_observed += 1
+            _log(f"{self.ds.directory}: manifest generation {gen} < "
+                 f"admitted {self.ds.generation}; refusing to regress "
+                 f"(#{self.stale_observed})")
+            return []
+        if gen == self.ds.generation:
+            if len(manifest["shards"]) != len(self.ds.shards):
+                # Same generation, different content — the stale-
+                # generation writer bug: CRC-valid bytes that changed
+                # the log without advancing the counter.
+                self.stale_observed += 1
+                _log(f"{self.ds.directory}: generation {gen} manifest "
+                     f"holds {len(manifest['shards'])} shard(s) vs "
+                     f"the admitted {len(self.ds.shards)} at the SAME "
+                     "generation; refusing a non-advancing publish "
+                     f"(#{self.stale_observed})")
+            return []
+        admitted = self.ds.admit_manifest(manifest)
+        for k in admitted:
+            meta = self.ds.shards[k]
+            if self.verify_appends:
+                got = self.ds.read_shard_checked(
+                    k, on_bad_shard=self.on_bad_shard,
+                    allow_nonfinite=self.allow_nonfinite)
+                if got is None:          # quarantined under the policy
+                    continue
+            self.admitted_shards += 1
+            self.admitted_rows += int(meta["rows"])
+            self._emit("append_admitted", shard=int(k),
+                       generation=int(meta.get("generation", gen)),
+                       rows=int(meta["rows"]))
+        return admitted
+
+    def wait_for_generation(self, generation: int, *,
+                            timeout_s: float = 30.0,
+                            interval_s: float = 0.02) -> bool:
+        """Poll until the admitted generation reaches ``generation``
+        (True) or the deadline passes (False) — the drill's writer/
+        reader rendezvous."""
+        deadline = time.monotonic() + timeout_s
+        while self.ds.generation < generation:
+            if time.monotonic() > deadline:
+                return False
+            self.poll()
+            if self.ds.generation < generation:
+                time.sleep(interval_s)
+        return True
+
+
+# ---------------------------------------------------------------------
+# subprocess writer (the concurrent writer/reader tests + the drill)
+# ---------------------------------------------------------------------
+
+def writer_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m dpsvm_tpu.data.live DIR --append N --rows R`` — a
+    real writer process appending synthetic blob shards to a live log
+    (the concurrent writer/reader interleaving tests SIGKILL it
+    mid-stream; the ``DPSVM_FAULT_LIVE_*`` env knobs apply). Prints
+    one ``APPENDED k generation g`` line per publish."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.data.live")
+    p.add_argument("directory")
+    p.add_argument("--append", type=int, default=4,
+                   help="how many shards to append")
+    p.add_argument("--rows", type=int, default=0,
+                   help="rows per appended shard (0 = the log's "
+                        "rows_per_shard)")
+    p.add_argument("--seed", type=int, default=100)
+    p.add_argument("--d", type=int, default=0,
+                   help="feature width (0 = the log's)")
+    p.add_argument("--interval-ms", type=float, default=0.0)
+    p.add_argument("--shift", type=float, default=0.0,
+                   help="mean shift applied to shards the "
+                        "LIVE_SHIFT_AT_SHARD hook selects (or all, "
+                        "when --shift-all)")
+    p.add_argument("--shift-all", action="store_true")
+    args = p.parse_args(argv)
+
+    manifest = _read_writer_manifest(args.directory)
+    d = args.d or int(manifest["d"])
+    rows = args.rows or int(manifest["rows_per_shard"])
+    rng = np.random.default_rng(args.seed)
+    plan = faultinject.current()
+    for i in range(args.append):
+        x = rng.standard_normal((rows, d)).astype(np.float32)
+        y = np.where(x[:, 0] + 0.25 * x[:, 1] > 0, 1, -1)
+        shifted = (args.shift_all
+                   or (plan is not None and plan.live_shift_now(i)))
+        if shifted and args.shift:
+            x = x + np.float32(args.shift)
+            # The shifted world keeps its labels consistent with the
+            # shifted inputs (concept stays, covariates move) — what a
+            # retrain can actually recover from.
+            y = np.where((x[:, 0] - args.shift)
+                         + 0.25 * (x[:, 1] - args.shift) > 0, 1, -1)
+        m = append_shard(args.directory, x, y)
+        print(f"APPENDED {len(m['shards']) - 1} generation "
+              f"{m['generation']}", flush=True)
+        if args.interval_ms:
+            time.sleep(args.interval_ms / 1000.0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(writer_main())

@@ -139,6 +139,27 @@ def is_shard_dir(path: str) -> bool:
             and os.path.exists(os.path.join(path, MANIFEST_NAME)))
 
 
+def pin_manifest_generation(manifest: dict, generation: int) -> dict:
+    """The manifest's view AS OF ``generation``: only shards whose
+    entry generation (0 for converted seed shards, which predate the
+    append protocol) is <= the target survive, and ``n``/``generation``
+    shrink to match. Appends are strictly ordered, so this is exactly
+    the shard set a reader at that generation had admitted — the
+    resume contract of live streaming training (data/live.py)."""
+    generation = int(generation)
+    current = int(manifest.get("generation", 0))
+    if generation >= current:
+        return manifest
+    kept = [s for s in manifest["shards"]
+            if int(s.get("generation", 0)) <= generation]
+    pinned = dict(manifest)
+    pinned["shards"] = kept
+    pinned["n"] = sum(int(s["rows"]) for s in kept)
+    pinned["generation"] = generation
+    pinned.pop("manifest_crc", None)     # the pinned view is derived,
+    return pinned                        # not published bytes
+
+
 def _write_json_atomic(path: str, obj: dict) -> None:
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w") as fh:
@@ -176,11 +197,28 @@ class ShardedDataset:
         self.float_labels = manifest.get("label_dtype") == "float32"
         self.quarantined: dict = {}          # shard idx -> reason
         self.max_bad_fraction = MAX_BAD_FRACTION
+        #: live-log generation this handle's view corresponds to
+        #: (docs/DATA.md "Live shard logs"); 0 on a frozen converted
+        #: directory whose manifest predates the append protocol.
+        self.generation = int(manifest.get("generation", 0))
+        self._rebuild_offsets()
+
+    def _rebuild_offsets(self) -> None:
+        # Cumulative row offsets: converted directories only ever have
+        # a short final shard, but a live log may hold partial shards
+        # mid-stream (each append publishes whatever rows it has), so
+        # the global index of shard k's first row is the running sum.
+        off = 0
+        self._offsets: List[int] = []
+        for s in self.shards:
+            self._offsets.append(off)
+            off += int(s["rows"])
 
     # -- opening -------------------------------------------------------
 
     @classmethod
-    def open(cls, directory: str) -> "ShardedDataset":
+    def open(cls, directory: str,
+             at_generation: Optional[int] = None) -> "ShardedDataset":
         mpath = os.path.join(directory, MANIFEST_NAME)
         if not os.path.exists(mpath):
             raise FileNotFoundError(
@@ -190,6 +228,17 @@ class ShardedDataset:
             with open(mpath) as fh:
                 manifest = json.load(fh)
         except (OSError, json.JSONDecodeError) as e:
+            if os.path.exists(mpath + ".prev"):
+                # The torn-publish signature of a LIVE log (a frozen
+                # conversion has no .prev backup): a writer crashed
+                # mid-publish. Readers hold their admitted view; the
+                # restarted writer repairs (data/live.py).
+                from dpsvm_tpu.data.live import TornPublishError
+                raise TornPublishError(
+                    f"{mpath}: unparseable manifest ({e}) beside a "
+                    ".prev backup — a torn live-log publish; the "
+                    "restarted writer repairs it on its next append"
+                ) from e
             raise StreamError(f"{mpath}: unreadable manifest ({e}); "
                               "re-run the conversion") from e
         for key in ("format", "version", "n", "d", "rows_per_shard",
@@ -209,7 +258,55 @@ class ShardedDataset:
                 f"{mpath}: shard rows sum to {rows} but manifest says "
                 f"n={manifest['n']} — truncated conversion? (a killed "
                 "convert leaves a cursor, not a manifest)")
+        if "manifest_crc" in manifest:
+            # Live-log manifests carry a self-CRC (data/live.py): a
+            # torn publish on a non-atomic filesystem must never be
+            # mistaken for a dataset.
+            from dpsvm_tpu.data.live import verify_manifest_crc
+            verify_manifest_crc(manifest, where=mpath)
+        if at_generation is not None:
+            # Pin the view to the shards durable at (or before) that
+            # generation — the resume path's exact re-admission
+            # (docs/DATA.md "Live shard logs"): a checkpoint names the
+            # generation it had consumed, and the resumed run must
+            # start from the identical shard set before the watcher
+            # re-admits anything newer.
+            manifest = pin_manifest_generation(manifest, at_generation)
         return cls(directory, manifest)
+
+    def admit_manifest(self, manifest: dict) -> List[int]:
+        """Grow this handle's view to ``manifest`` (a strictly newer
+        generation of the same log). The new manifest must EXTEND the
+        current one — the common shard prefix byte-identical in
+        file/rows/crc — because appends only ever add shards; a
+        rewritten prefix is a corrupted (or foreign) log, not an
+        append. Returns the newly admitted shard indices."""
+        gen = int(manifest.get("generation", 0))
+        if gen <= self.generation:
+            raise StreamError(
+                f"{self.directory}: admit_manifest generation {gen} "
+                f"does not advance the current {self.generation}")
+        new_shards = list(manifest["shards"])
+        if len(new_shards) < len(self.shards):
+            raise StreamError(
+                f"{self.directory}: generation {gen} manifest holds "
+                f"{len(new_shards)} shard(s), fewer than the admitted "
+                f"{len(self.shards)} — a log never shrinks")
+        for k, (old, new) in enumerate(zip(self.shards, new_shards)):
+            if (old["file"] != new["file"]
+                    or int(old["rows"]) != int(new["rows"])
+                    or int(old["crc32"]) != int(new["crc32"])):
+                raise StreamError(
+                    f"{self.directory}: generation {gen} manifest "
+                    f"REWROTE shard {k} ({old['file']}) — appends only "
+                    "extend the log; refusing the admitted view")
+        admitted = list(range(len(self.shards), len(new_shards)))
+        self.manifest = manifest
+        self.shards = new_shards
+        self.n = int(manifest["n"])
+        self.generation = gen
+        self._rebuild_offsets()
+        return admitted
 
     @property
     def n_shards(self) -> int:
@@ -223,8 +320,9 @@ class ShardedDataset:
 
     def row_offset(self, k: int) -> int:
         """Global index of shard k's first row (shards are contiguous
-        prefixes of the source order)."""
-        return k * self.rows_per_shard
+        in append order; a live log may hold partial shards mid-
+        stream, so this is the running sum, not k * rows_per_shard)."""
+        return self._offsets[k]
 
     # -- reading -------------------------------------------------------
 
@@ -392,10 +490,12 @@ class ShardedDataset:
         feature map must be rebuildable bit-identically forever."""
         indices = np.asarray(indices, np.int64)
         out = np.empty((len(indices), self.d), np.float32)
+        offsets = np.asarray(self._offsets, np.int64)
         by_shard: dict = {}
         for pos, gi in enumerate(indices):
-            by_shard.setdefault(int(gi) // self.rows_per_shard,
-                                []).append(pos)
+            k = int(np.searchsorted(offsets, int(gi),
+                                    side="right")) - 1
+            by_shard.setdefault(k, []).append(pos)
         for k in sorted(by_shard):
             x, _ = self.read_shard(k)
             base = self.row_offset(k)

@@ -51,7 +51,7 @@ LEDGER_SCHEMA = 1
 #: this is the vocabulary, like record.SERVING_EVENTS). "tune" rows
 #: come from `dpsvm tune` (tuning/tuner.py): per-knob probe readings
 #: plus the tuned_vs_default A/B verdict.
-KINDS = ("bench", "burst", "loadgen", "compare", "tune")
+KINDS = ("bench", "burst", "loadgen", "compare", "tune", "serve")
 
 #: unit -> gate direction ("higher" = bigger is better). The per-record
 #: ``direction`` field wins; the metric-name heuristics below back this

@@ -113,6 +113,21 @@ DIST_EVENTS = ("desync", "shard_lost", "reshard")
 # n_iter baseline stands).
 INGEST_EVENTS = ("quarantine", "ingest_resume")
 
+# Event types the LIVE shard-log layer emits (data/live.py +
+# approx/primal.fit_approx_stream(live=True) + the continuous-learning
+# serving loop — docs/DATA.md "Live shard logs", docs/SERVING.md
+# "Continuous learning"): `append_admitted` = one durable appended
+# shard entered a reader's admitted view (requires shard + generation;
+# carries rows), `ingest_grow` = a live training sweep boundary
+# admitted new rows (requires generation + n_new_rows — the divisor/
+# step-size math re-derived from the grown view), `refresh` = the
+# serving loop chose its refresh flavor (requires refresh_kind =
+# "incremental"|"full"; the key is NOT `kind` — that would collide
+# with the record kind at write time), `refresh_resume` = a killed
+# loop resumed at the gate with its durable candidate.
+LIVE_EVENTS = ("append_admitted", "ingest_grow", "refresh",
+               "refresh_resume")
+
 # Span names the serving layer records per sampled request (schema v3,
 # docs/OBSERVABILITY.md "Spans"). The `request` root covers admission
 # to response; its direct children are the sequential pipeline stages

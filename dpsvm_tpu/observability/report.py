@@ -51,6 +51,10 @@ def trace_facts(records: List[dict]) -> dict:
     compiles = [r for r in records if r.get("kind") == "compile"]
     quarantines = [r for r in records if r.get("kind") == "event"
                    and r.get("event") == "quarantine"]
+    admits = [r for r in records if r.get("kind") == "event"
+              and r.get("event") == "append_admitted"]
+    grows = [r for r in records if r.get("kind") == "event"
+             and r.get("event") == "ingest_grow"]
     summary = next((r for r in records if r.get("kind") == "summary"),
                    None)
     it0 = int(manifest.get("it0", 0) or 0)
@@ -124,6 +128,13 @@ def trace_facts(records: List[dict]) -> dict:
         "roofline_verdict": roof["verdict"],
         "roofline": roof,
         "quarantined_shards": len(quarantines),
+        "admitted_shards": len(admits),
+        "admitted_rows": sum(int(r.get("rows", 0) or 0)
+                             for r in admits),
+        "ingest_generation": (int(grows[-1].get("generation", 0) or 0)
+                              if grows
+                              else (int(admits[-1].get("generation", 0)
+                                        or 0) if admits else None)),
         "phases": dict((summary or {}).get("phases")
                        or (chunks[-1].get("phases") if chunks else {})
                        or {}),
@@ -431,6 +442,13 @@ def render_report(records: List[dict], width: int = 60) -> str:
                    f"{len(polishes)} polish round(s), "
                    f"{readmitted:,} re-admitted — see docs/APPROX.md "
                    "\"Cascade\"")
+    admits = [e for e in events if e.get("event") == "append_admitted"]
+    if admits:
+        rows = sum(int(e.get("rows", 0) or 0) for e in admits)
+        last_gen = admits[-1].get("generation")
+        out.append(f"admitted shards: {len(admits)} live append(s) "
+                   f"({rows:,} rows; log generation {last_gen}) — "
+                   "see docs/DATA.md \"Live shard logs\"")
     quarantines = [e for e in events if e.get("event") == "quarantine"]
     if quarantines:
         rows = sum(int(e.get("rows", 0) or 0) for e in quarantines)

@@ -125,6 +125,19 @@ REWIND_EVENTS = ("rollback", "reshard")
 # a consumer can always tell WHICH contract broke and over what
 # window), `incident` marks a flight-recorder bundle dump (carries the
 # same identity plus `bundle`, the dumped directory).
+#
+# Live shard-log events (data/live.py + the continuous-learning loop,
+# docs/DATA.md "Live shard logs" / docs/SERVING.md "Continuous
+# learning"): `append_admitted` marks one durable appended shard
+# entering a reader's view (shard + the generation that published it),
+# `ingest_grow` marks a sweep boundary at which live training admitted
+# new rows (the grown generation + row delta), and `refresh` marks the
+# serving loop choosing its refresh flavor — `refresh_kind` MUST be
+# "incremental" or "full" (validated below; a refresh of unknown kind
+# is a broken producer, not a vocabulary extension). The flavor key is
+# `refresh_kind`, not `kind`: every record's own `kind` field IS the
+# record kind, and an event extra named `kind` would overwrite it at
+# write time.
 EVENT_EXTRA_KEYS = {
     "desync": ("shards",),
     "reshard": ("from_shards", "to_shards"),
@@ -134,7 +147,13 @@ EVENT_EXTRA_KEYS = {
     "readmit": ("round", "n_readmitted"),
     "alert": ("rule", "window", "severity"),
     "incident": ("rule", "window", "severity", "bundle"),
+    "append_admitted": ("shard", "generation"),
+    "ingest_grow": ("generation", "n_new_rows"),
+    "refresh": ("refresh_kind",),
 }
+
+#: the closed value set of the `refresh` event's `refresh_kind`
+REFRESH_KINDS = ("incremental", "full")
 
 
 class TraceWriter:
@@ -279,6 +298,16 @@ def validate_trace(records: List[dict]) -> List[str]:
                 # The run restarted from a checkpoint at this iteration
                 # (rollback), possibly on a different mesh (reshard).
                 prev_iter = r["n_iter"]
+            elif r.get("event") == "refresh":
+                if r.get("refresh_kind") not in REFRESH_KINDS:
+                    errors.append(
+                        f"record {i}: refresh_kind "
+                        f"{r.get('refresh_kind')!r} not in "
+                        f"{REFRESH_KINDS}")
+            elif r.get("event") == "ingest_grow":
+                if int(r.get("n_new_rows", 0) or 0) < 0:
+                    errors.append(f"record {i}: ingest_grow "
+                                  f"n_new_rows {r['n_new_rows']} < 0")
             elif r.get("event") == "screen":
                 saw_screen = True
             elif r.get("event") == "polish":

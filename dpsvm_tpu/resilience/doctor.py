@@ -162,18 +162,75 @@ def _free_disk_probe(directory: str, need_bytes: int
 def _data_probe(path: str, out: Callable[[str], None]
                 ) -> Tuple[bool, int]:
     """Shard-dataset health: manifest + CRC spot-check + free disk +
-    one-shard timed read. Returns (ok, exit_code)."""
-    from dpsvm_tpu.data.stream import ShardedDataset, StreamError
+    one-shard timed read, plus the live-log probes (docs/DATA.md
+    "Live shard logs") — manifest generation, a torn in-progress
+    publish, and a conversion cursor ahead of the manifest each get a
+    distinct one-line verdict under the existing exit-code scheme
+    (7 = integrity, 8 = disk). Returns (ok, exit_code)."""
+    import glob
+    import json
 
+    from dpsvm_tpu.data.live import TornPublishError
+    from dpsvm_tpu.data.stream import (CURSOR_NAME, ShardedDataset,
+                                       StreamError)
+
+    # Live-log state probes run FIRST: a torn publish makes the
+    # manifest unopenable, and the verdict must say "writer crashed
+    # mid-publish", not "corrupt dataset". A .prev backup beside an
+    # unreadable manifest is the torn-publish signature — a frozen
+    # dataset with a rotted manifest has no backup and keeps the
+    # ordinary corrupt-manifest verdict.
+    from dpsvm_tpu.data.live import (PREV_MANIFEST_NAME,
+                                     read_manifest_checked)
+    cursor_path = os.path.join(path, CURSOR_NAME)
+    if os.path.isdir(path):
+        try:
+            read_manifest_checked(path)
+        except TornPublishError as e:
+            if os.path.exists(os.path.join(path, PREV_MANIFEST_NAME)):
+                out(f"data: {e}")
+                out("DOCTOR FAIL: in-progress (torn) publish — a "
+                    "writer crashed mid-publish (or is mid-write on a "
+                    "non-atomic filesystem); readers hold their last "
+                    "admitted view, the restarted writer repairs from "
+                    f"{PREV_MANIFEST_NAME}")
+                return False, 7
+        except StreamError:
+            pass                # open() below owns the verdict
     try:
         ds = ShardedDataset.open(path)
     except (FileNotFoundError, StreamError) as e:
         out(f"data: {e}")
         out(f"DOCTOR FAIL: {e}")
         return False, 7
+    if os.path.exists(cursor_path):
+        try:
+            with open(cursor_path) as fh:
+                rows_done = int(json.load(fh).get("rows_done", 0))
+        except (OSError, ValueError):
+            rows_done = -1
+        if rows_done > ds.n or rows_done < 0:
+            out(f"data: conversion cursor claims {rows_done} row(s) "
+                f"done but the manifest holds {ds.n}")
+            out("DOCTOR FAIL: cursor ahead of the manifest — a "
+                "conversion wrote past the published dataset (foreign "
+                "cursor, or a manifest rolled back under it); delete "
+                f"{CURSOR_NAME} only after confirming the shards")
+            return False, 7
+        out(f"data: stale conversion cursor present ({rows_done} "
+            f"rows done <= manifest n={ds.n}; harmless leftover)")
+    tmps = glob.glob(os.path.join(path, "manifest.json.tmp*"))
+    if tmps:
+        out(f"data: {len(tmps)} manifest tmp file(s) present — a "
+            "publish may be in flight (or a writer died pre-rename); "
+            "harmless to readers")
+    gen = int(ds.manifest.get("generation", 0))
     out(f"data: {path}: {ds.n} rows x {ds.d} features in "
         f"{ds.n_shards} shard(s) of {ds.rows_per_shard} "
-        f"({ds.manifest.get('label_dtype')} labels)")
+        f"({ds.manifest.get('label_dtype')} labels, "
+        f"log generation {gen}"
+        + (", live-append manifest" if "manifest_crc" in ds.manifest
+           else ", frozen conversion") + ")")
     ok, detail = _free_disk_probe(path, MIN_FREE_BYTES)
     out(f"data: disk: {detail}")
     if not ok:

@@ -88,6 +88,29 @@ Serving-side knobs (``DPSVM_FAULT_SERVE_*``, consumed by
   fault after the first ``m`` slowed computes — the alert must then
   clear, which is the recovery half of the drill.
 
+Live shard-log knobs (``DPSVM_FAULT_LIVE_*``, consumed by the append
+writer / the drift drill in ``data/live.py`` + ``serving/lifecycle.py``
+— docs/DATA.md "Live shard logs"):
+
+* ``DPSVM_FAULT_LIVE_TORN_PUBLISH=k`` — the k-th (1-based) manifest
+  publish in this process writes only the FIRST HALF of the manifest
+  bytes directly onto ``manifest.json`` (the non-atomic-filesystem /
+  kill-9-mid-write model) and raises ``WriterCrashError``: readers
+  must hold their last-admitted view (the torn file fails the
+  manifest CRC) and the restarted writer must repair on its next
+  publish;
+* ``DPSVM_FAULT_LIVE_STALE_GENERATION=k`` — the k-th publish lands a
+  CRC-VALID manifest whose ``generation`` did NOT increase (a replayed
+  or split-brain writer): readers must refuse to advance on it;
+* ``DPSVM_FAULT_LIVE_WRITER_CRASH_AFTER=k`` — the writer "crashes"
+  (raises ``WriterCrashError``) right after the k-th appended shard
+  file is durable but BEFORE its manifest publish: the orphan shard is
+  invisible to readers and the next append must overwrite it;
+* ``DPSVM_FAULT_LIVE_SHIFT_AT_SHARD=k`` — the drill's append source
+  plants the distribution shift from appended shard #k (1-based) on
+  (``live_shift_now``): the deterministic drift trigger of the
+  ``live_drift_drill``.
+
 Cascade / bench-infra knobs (``solver/cascade.py``, ``bench_common.py``
 — docs/APPROX.md "Cascade"):
 
@@ -161,6 +184,16 @@ class FaultPlan:
     #                                  (every read — persistent rot)
     io_truncate_shard: int = 0       # shard #k reads half its bytes
     io_slow_read_ms: int = 0         # every shard read sleeps this
+    # live shard-log knobs (data/live.py — docstring above): publish /
+    # append counters are 1-based like every other "the k-th" knob
+    live_torn_publish: int = 0       # the k-th publish tears mid-write
+    live_stale_generation: int = 0   # the k-th publish replays its old
+    #                                  generation (CRC-valid, stale)
+    live_writer_crash_after: int = 0  # crash after shard #k is durable,
+    #                                  before its manifest publish
+    live_shift_at_shard: int = 0     # drill: appended shard #k on is
+    #                                  drawn from the shifted
+    #                                  distribution
     # cascade / bench-infra knobs (solver/cascade.py, bench_common.py)
     cascade_stop_stage: int = 0      # kill the cascade right after the
     #                                  stage-#k boundary state is
@@ -188,6 +221,11 @@ class FaultPlan:
     _slow_probe: Optional[tuple] = None   # frozen probe row replayed
     _io_reads: int = 0
     _io_fail_fired: bool = False
+    _live_publishes: int = 0
+    _live_appends: int = 0
+    _torn_fired: bool = False
+    _stale_fired: bool = False
+    _writer_crash_fired: bool = False
     _cascade_fired: bool = False
     _slow_computes: int = 0
     _slow_lifted_logged: bool = False
@@ -201,7 +239,10 @@ class FaultPlan:
                     or self.dist_slow_shard or self.io_read_fail_once
                     or self.io_corrupt_shard or self.io_truncate_shard
                     or self.io_slow_read_ms or self.cascade_stop_stage
-                    or self.preflight_wedge_s)
+                    or self.preflight_wedge_s or self.live_torn_publish
+                    or self.live_stale_generation
+                    or self.live_writer_crash_after
+                    or self.live_shift_at_shard)
 
     def cascade_stop_now(self, stage: int) -> bool:
         """True exactly once, when the cascade has made the stage-#k
@@ -326,6 +367,48 @@ class FaultPlan:
         return bool(self.io_truncate_shard
                     and shard_idx + 1 == self.io_truncate_shard)
 
+    # -- live shard-log injection points (data/live.py). Single-
+    # threaded like the other data-pipeline hooks (one writer loop).
+
+    def live_append_begin(self) -> bool:
+        """Counted per durable appended shard, BEFORE its publish.
+        True exactly once, when the writer should crash with the shard
+        on disk but un-published (the orphan-shard model)."""
+        self._live_appends += 1
+        if (self.live_writer_crash_after and not self._writer_crash_fired
+                and self._live_appends >= self.live_writer_crash_after):
+            self._writer_crash_fired = True
+            _log(f"crashing writer after appended shard "
+                 f"#{self._live_appends} (pre-publish)")
+            return True
+        return False
+
+    def live_publish_mode(self) -> str:
+        """Counted per manifest publish. Returns "clean", "torn" (write
+        half the bytes non-atomically onto the real manifest path, then
+        crash) or "stale" (publish CRC-valid bytes whose generation did
+        not advance). Each fires once."""
+        self._live_publishes += 1
+        if (self.live_torn_publish and not self._torn_fired
+                and self._live_publishes >= self.live_torn_publish):
+            self._torn_fired = True
+            _log(f"tearing manifest publish #{self._live_publishes}")
+            return "torn"
+        if (self.live_stale_generation and not self._stale_fired
+                and self._live_publishes >= self.live_stale_generation):
+            self._stale_fired = True
+            _log(f"replaying stale generation at publish "
+                 f"#{self._live_publishes}")
+            return "stale"
+        return "clean"
+
+    def live_shift_now(self, append_idx: int) -> bool:
+        """True when appended shard #(idx+1) — and every later one —
+        should be drawn from the drill's shifted distribution
+        (persistent, like real drift: the world does not shift back)."""
+        return bool(self.live_shift_at_shard
+                    and append_idx + 1 >= self.live_shift_at_shard)
+
     # -- serving-side injection points (serving/pool.py). Unlike the
     # single-threaded training hooks, these are hit from concurrent
     # replica workers — counters advance under the module serve lock.
@@ -427,6 +510,10 @@ def plan_from_env() -> Optional[FaultPlan]:
         io_corrupt_shard=_env_int("IO_CORRUPT_SHARD"),
         io_truncate_shard=_env_int("IO_TRUNCATE_SHARD"),
         io_slow_read_ms=_env_int("IO_SLOW_READ_MS"),
+        live_torn_publish=_env_int("LIVE_TORN_PUBLISH"),
+        live_stale_generation=_env_int("LIVE_STALE_GENERATION"),
+        live_writer_crash_after=_env_int("LIVE_WRITER_CRASH_AFTER"),
+        live_shift_at_shard=_env_int("LIVE_SHIFT_AT_SHARD"),
         cascade_stop_stage=_env_int("CASCADE_STOP_STAGE"),
         preflight_wedge_s=_env_int("PREFLIGHT_WEDGE_S"))
     return p if p.any() else None

@@ -301,10 +301,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "synthetic model: asserts zero post-warmup "
                         "compiles and bitwise parity with "
                         "decision_function")
+    p.add_argument("--live-drill", action="store_true",
+                   help="run the end-to-end live drift-recovery drill "
+                        "(docs/SERVING.md 'Continuous learning'): "
+                        "seed a shard log, serve from it, append a "
+                        "planted distribution shift, and prove the "
+                        "drift->refresh->gate->hot-swap loop recovers "
+                        "accuracy; prints ONE JSON row "
+                        "(live_refresh_latency) and exits 0 iff it "
+                        "recovered eject-free")
     args = p.parse_args(argv)
-    if not args.selfcheck:
+    if not (args.selfcheck or args.live_drill):
         p.print_help()
         return 2
+    if args.live_drill:
+        import json
+        import tempfile
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from dpsvm_tpu.serving.lifecycle import live_drift_drill
+        with tempfile.TemporaryDirectory() as tmp:
+            trace_env = os.environ.get("BENCH_TRACE_OUT")
+            row = live_drift_drill(
+                tmp, trace_path=trace_env or os.path.join(
+                    tmp, "drill.jsonl"))
+        print(json.dumps(row))
+        return 0 if row.get("ok") else 1
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     problems = selfcheck()
     if problems:

@@ -222,11 +222,11 @@ class LifecycleLoop:
         self.promote(result, accuracy=gate.accuracy)
         return "promoted"
 
-    def _retrain(self) -> RetrainResult:
+    def _retrain(self, fn: Optional[Callable] = None) -> RetrainResult:
         from dpsvm_tpu.resilience.supervisor import run_with_retries
 
         result = run_with_retries(
-            self.retrain_fn, retries=self.retries,
+            fn or self.retrain_fn, retries=self.retries,
             backoff_s=self.backoff_s,
             checkpoint_path=self.checkpoint_path)
         if not isinstance(result, RetrainResult):
@@ -273,21 +273,19 @@ class LifecycleLoop:
         """Atomically replace the serving artifact and hot-reload: the
         candidate file moves onto the registry source path with
         ``os.replace`` (atomic; readers see old bytes or new bytes,
-        never a torn file), then the registry builds + warms the new
-        engine and swaps it in, then the pool refreshes. Any failure
-        here leaves the OLD artifact bytes gone only after the replace
-        — which is why the replace is last-resort-recoverable: the
-        reload failing keeps the old ENGINE serving from memory."""
-        source = self.registry.source(self.name)
-        os.replace(result.model_path, source)
-        self.registry.reload(self.name)
+        never a torn file — ``registry.promote_file``), then the
+        registry builds + warms the new engine and swaps it in, then
+        the pool refreshes. Any failure here leaves the OLD artifact
+        bytes gone only after the replace — which is why the replace
+        is last-resort-recoverable: the reload failing keeps the old
+        ENGINE serving from memory."""
+        gen = self.registry.promote_file(self.name, result.model_path)
         if result.trace_path:
             self.baseline_trace = result.trace_path
         if result.reference_scores is not None:
             self.detector.rearm(result.reference_scores)
         if self._on_promote is not None:
             self._on_promote(self.name)
-        gen = self.registry.manifests()[self.name]["generation"]
         self._emit("promote", model=self.name, ok=True,
                    generation=gen, accuracy=accuracy)
 
@@ -312,3 +310,445 @@ class LifecycleLoop:
                              name=f"dpsvm-lifecycle[{self.name}]")
         t.start()
         return t
+
+
+# ---------------------------------------------------------------------
+# continuous learning on a live shard log
+# ---------------------------------------------------------------------
+
+class ContinuousLearningLoop(LifecycleLoop):
+    """The drift loop closed over a LIVE shard log (docs/SERVING.md
+    "Continuous learning", docs/DATA.md "Live shard logs"): drift can
+    now trigger either a CHEAP incremental update — warm-start the
+    approx weights on the grown log (``fit_approx_stream(live=True,
+    init_w=warm_start_vector(served))``) — or a cadenced FULL retrain
+    (every ``full_every``-th refresh; typically the cascade
+    warm-started from the incremental weights). Both run under the
+    retry supervisor, both must clear the accuracy-floor +
+    ``dpsvm compare`` gate, and only a passing candidate reaches the
+    atomic hot-swap.
+
+    Robustness contract on top of ``LifecycleLoop``:
+
+    * every stage is individually kill-resumable: the refresh
+      functions own their training checkpoints (``checkpoint_path``),
+      and once a candidate artifact is durable the loop persists a
+      STAGE STATE file (``state_path``, atomic JSON) — a process
+      killed between retrain and swap resumes at the GATE with the
+      same candidate instead of paying the retrain again;
+    * a gate failure dumps a PR 13 incident bundle (``bundle_dir``)
+      whose embedded trace carries the loop's drift/refresh/retrain/
+      promote event history — the refresh that did NOT happen leaves
+      an artifact saying exactly why;
+    * a passing swap lands a ``live_refresh_latency`` perf-ledger row
+      (drift-fire -> swapped-generation wall seconds, kind="serve")
+      so refresh latency is a gateable historical fact.
+
+    ``incremental_fn`` / ``retrain_fn`` share the retrain signature
+    ``(resume_from, attempt) -> RetrainResult``.
+    """
+
+    def __init__(self, *, incremental_fn: Optional[Callable] = None,
+                 full_every: int = 0,
+                 bundle_dir: Optional[str] = None,
+                 state_path: Optional[str] = None,
+                 ledger_path: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        if incremental_fn is None and not kw.get("retrain_fn"):
+            raise ValueError("ContinuousLearningLoop needs "
+                             "incremental_fn and/or retrain_fn")
+        self.incremental_fn = incremental_fn
+        self.full_every = int(full_every)
+        self.bundle_dir = bundle_dir
+        self.state_path = state_path
+        self.ledger_path = ledger_path
+        self.refresh_count = 0
+        self.last_refresh: Optional[dict] = None
+        self._flight = None
+        if bundle_dir:
+            from dpsvm_tpu.observability import blackbox
+            self._flight = blackbox.FlightRecorder(
+                blackbox.make_manifest(
+                    solver="serving",
+                    config={"model": self.name,
+                            "loop": "continuous-learning"}))
+
+    def _emit(self, event: str, **extra) -> None:
+        if self._flight is not None:
+            try:
+                self._flight.event(event, n_iter=0, **extra)
+            except Exception:
+                pass
+        super()._emit(event, **extra)
+
+    # -- durable stage state ------------------------------------------
+
+    def _load_stage_state(self) -> Optional[dict]:
+        if not self.state_path or not os.path.exists(self.state_path):
+            return None
+        import json
+        try:
+            with open(self.state_path) as fh:
+                st = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if not os.path.exists(st.get("model_path", "")):
+            self._clear_stage_state()   # candidate gone: restart clean
+            return None
+        return st
+
+    def _save_stage_state(self, kind: str, result: RetrainResult,
+                          fired_unix: float) -> None:
+        if not self.state_path:
+            return
+        import json
+        st = {"stage": "gate", "kind": kind,
+              "model_path": result.model_path,
+              "trace_path": result.trace_path,
+              "reference_scores":
+                  (np.asarray(result.reference_scores,
+                              np.float64).tolist()
+                   if result.reference_scores is not None else None),
+              "fired_unix": float(fired_unix),
+              "refresh_count": int(self.refresh_count)}
+        tmp = f"{self.state_path}.tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(st, fh)
+        os.replace(tmp, self.state_path)
+
+    def _clear_stage_state(self) -> None:
+        if self.state_path:
+            try:
+                os.unlink(self.state_path)
+            except OSError:
+                pass
+
+    # -- the loop body ------------------------------------------------
+
+    def step(self) -> str:
+        resumed = self._load_stage_state()
+        if resumed is not None:
+            # Killed between a durable candidate and the swap: resume
+            # at the gate — the retrain is not paid twice.
+            self._emit("refresh_resume", model=self.name,
+                       refresh_kind=resumed["kind"],
+                       candidate=resumed["model_path"])
+            self.refresh_count = int(resumed.get(
+                "refresh_count", self.refresh_count))
+            result = RetrainResult(
+                model_path=resumed["model_path"],
+                trace_path=resumed.get("trace_path"),
+                reference_scores=(
+                    np.asarray(resumed["reference_scores"], np.float64)
+                    if resumed.get("reference_scores") is not None
+                    else None))
+            return self._gate_and_swap(resumed["kind"], result,
+                                       resumed.get("fired_unix"))
+        if (self.cooldown_s and
+                time.monotonic() - self._last_action_t < self.cooldown_s):
+            return "cooldown"
+        drift = self.detector.check(self.score_source())
+        if drift is None:
+            return "no-drift"
+        self._emit("drift", model=self.name, **drift)
+        self._last_action_t = time.monotonic()
+        fired_unix = time.time()
+        want_full = (self.incremental_fn is None
+                     or (self.full_every > 0
+                         and (self.refresh_count + 1) % self.full_every
+                         == 0))
+        kind = "full" if want_full else "incremental"
+        gen = self.registry.manifests()[self.name]["generation"]
+        self._emit("refresh", model=self.name, refresh_kind=kind,
+                   generation=gen)
+        fn = self.retrain_fn if kind == "full" else self.incremental_fn
+        try:
+            result = self._retrain(fn)
+        except Exception as e:         # noqa: BLE001 — reported, loop
+            self._emit("retrain", model=self.name, ok=False,
+                       refresh_kind=kind, error=str(e))
+            return "retrain-failed"
+        self._emit("retrain", model=self.name, ok=True,
+                   refresh_kind=kind, candidate=result.model_path)
+        self.refresh_count += 1
+        self._save_stage_state(kind, result, fired_unix)
+        return self._gate_and_swap(kind, result, fired_unix)
+
+    def _gate_and_swap(self, kind: str, result: RetrainResult,
+                       fired_unix: Optional[float]) -> str:
+        gate = self.gate(result)
+        if not gate.passed:
+            self._emit("promote", model=self.name, ok=False,
+                       refresh_kind=kind, accuracy=gate.accuracy,
+                       floor=gate.floor, problems=gate.problems)
+            self._dump_gate_bundle(kind, gate)
+            self._clear_stage_state()
+            return "gate-held"
+        self.promote(result, accuracy=gate.accuracy)
+        self._clear_stage_state()
+        latency = (max(time.time() - float(fired_unix), 0.0)
+                   if fired_unix else None)
+        gen = self.registry.manifests()[self.name]["generation"]
+        self.last_refresh = {"kind": kind, "seconds": latency,
+                             "generation": gen,
+                             "accuracy": gate.accuracy}
+        if latency is not None:
+            from dpsvm_tpu.observability import ledger
+            ledger.append(
+                "live_refresh_latency",
+                {"metric": "live_refresh_latency", "refresh_kind": kind,
+                 "model": self.name, "generation": gen,
+                 "accuracy": gate.accuracy},
+                kind="serve", value=float(latency), unit="s",
+                direction="lower", trace=result.trace_path,
+                path=self.ledger_path)
+        return "promoted"
+
+    def _dump_gate_bundle(self, kind: str, gate: GateResult) -> None:
+        """A held gate is an incident: the refresh the system decided
+        NOT to ship leaves a bundle naming why (docs/OBSERVABILITY.md
+        "Incident bundles")."""
+        if self._flight is None or not self.bundle_dir:
+            return
+        from dpsvm_tpu.observability import blackbox
+        blackbox.dump_bundle(
+            self.bundle_dir, recorder=self._flight,
+            rule="refresh-gate-held", severity="warn",
+            window=f"model={self.name}",
+            reason="; ".join(gate.problems) or "gate held",
+            extra={"source": "continuous-learning",
+                   "refresh_kind": kind,
+                   "accuracy": gate.accuracy, "floor": gate.floor})
+
+
+# ---------------------------------------------------------------------
+# the end-to-end drill
+# ---------------------------------------------------------------------
+
+def live_drift_drill(base_dir: str, *, seed: int = 0,
+                     rows_per_shard: int = 96, seed_shards: int = 3,
+                     append_shards: int = 4, shift: float = 3.0,
+                     shift_at_shard: int = 1,
+                     accuracy_floor: float = 0.85,
+                     full_every: int = 0,
+                     approx_dim: int = 64, c: float = 10.0,
+                     trace_path: Optional[str] = None,
+                     ledger_path: Optional[str] = None,
+                     bundle_dir: Optional[str] = None) -> dict:
+    """The live continuous-learning drill, end to end on one process
+    (CPU CI + the ``live_drift_drill`` burst tag): seed a shard log,
+    train + serve a model from it, APPEND shards whose distribution
+    shifts mid-serve, and prove — with no human in the loop — that
+    drift fires, the warm-started refresh retrains on the grown log,
+    the gate passes, the hot-swap is atomic, the served model's
+    held-out accuracy on the SHIFTED world recovers above the floor,
+    and serving stays eject-free throughout. Returns one JSON-able
+    row (metric ``live_refresh_latency`` = drift-fire -> swapped
+    generation wall seconds), appends it to the perf ledger, and —
+    when ``trace_path`` is set — records a schema-valid serving trace
+    covering every stage event (append_admitted -> drift -> refresh ->
+    retrain -> promote)."""
+    import json as _json
+
+    from dpsvm_tpu.approx.primal import (fit_approx_stream,
+                                         warm_start_vector)
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data import live as livelib
+    from dpsvm_tpu.data import stream as streamlib
+    from dpsvm_tpu.data.synthetic import save_csv
+    from dpsvm_tpu.models.io import load_model, save_model
+    from dpsvm_tpu.models.svm import decision_function
+    from dpsvm_tpu.observability.record import (close_serving_trace,
+                                                open_serving_trace)
+    from dpsvm_tpu.serving.pool import ReplicaPool
+    from dpsvm_tpu.serving.registry import ModelRegistry
+
+    t_drill = time.perf_counter()
+    rng = np.random.default_rng(seed)
+    d = 6
+
+    def make_rows(n, shifted):
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        if shifted:
+            x = x + np.float32(shift)
+            y = np.where((x[:, 0] - shift)
+                         + 0.25 * (x[:, 1] - shift) > 0, 1, -1)
+        else:
+            y = np.where(x[:, 0] + 0.25 * x[:, 1] > 0, 1, -1)
+        return x, np.asarray(y, np.int32)
+
+    # 1. seed log + holdouts (base AND shifted worlds)
+    x0, y0 = make_rows(seed_shards * rows_per_shard, False)
+    src = os.path.join(base_dir, "seed.csv")
+    save_csv(src, x0, y0)
+    log_dir = os.path.join(base_dir, "log")
+    streamlib.convert_to_shards(src, log_dir,
+                                rows_per_shard=rows_per_shard)
+    x_ho_base, y_ho_base = make_rows(256, False)
+    x_ho_shift, y_ho_shift = make_rows(256, True)
+
+    trace = (open_serving_trace(trace_path,
+                                models={"default": "live-drill"})
+             if trace_path else None)
+
+    def t_event(name, **extra):
+        if trace is not None:
+            trace.event(name, n_iter=0, **extra)
+
+    # 2. initial model trained from the log, registered, pooled
+    cfg = dict(solver="approx-rff", approx_dim=approx_dim, c=c,
+               epsilon=5e-3, max_iter=600, chunk_iters=64,
+               verbose=False)
+    ds0 = streamlib.ShardedDataset.open(log_dir)
+    model0, _res0 = fit_approx_stream(ds0, SVMConfig(**cfg))
+    model_path = os.path.join(base_dir, "serving.npz")
+    save_model(model0, model_path)
+    registry = ModelRegistry()
+    registry.register("default", model_path, max_batch=64)
+    pool = ReplicaPool(lambda idx: registry.build("default"),
+                       n_replicas=1, name="default",
+                       on_event=lambda e, **kw: t_event(e, **kw))
+
+    def served_scores(x):
+        return np.asarray(
+            pool.infer(x, ("decision",))["decision"], np.float64)
+
+    try:
+        base_scores = served_scores(x0[:256])
+        detector = DriftDetector(base_scores, threshold=0.25,
+                                 min_count=64)
+
+        # 3. the live training view + its watcher (events -> trace)
+        ds_live = streamlib.ShardedDataset.open(log_dir)
+        watcher = livelib.ShardLogWatcher(
+            ds_live,
+            on_event=lambda e, **kw: t_event(e, **kw))
+
+        # the serving-side score window: decisions of recently
+        # ARRIVED rows, scored through the pool — what /metricsz
+        # keeps in production
+        window: list = []
+
+        def score_arrivals():
+            for k in range(max(0, ds_live.n_shards - 2),
+                           ds_live.n_shards):
+                got = ds_live.read_shard_checked(k)
+                if got is not None:
+                    window[:] = served_scores(got[0]).tolist()
+
+        def refresh_fn(kind):
+            def run(resume_from, attempt):
+                served = load_model(registry.source("default"))
+                init = warm_start_vector(served)
+                ds_train = streamlib.ShardedDataset.open(log_dir)
+                tr_path = os.path.join(
+                    base_dir, f"refresh-{kind}.jsonl")
+                rcfg = SVMConfig(trace_out=tr_path,
+                                 resume_from=resume_from, **cfg)
+                if kind == "full":
+                    # The cadenced full retrain: the cascade's
+                    # warm-started exact polish is the chip-scale
+                    # move (solver/cascade.py approx_init_w); at
+                    # drill scale the same warm-started stream fit
+                    # retrains the full log exactly.
+                    model, _ = fit_approx_stream(ds_train, rcfg,
+                                                 init_w=init)
+                else:
+                    model, _ = fit_approx_stream(ds_train, rcfg,
+                                                 live=True,
+                                                 init_w=init)
+                cand = os.path.join(base_dir, "candidate.npz")
+                save_model(model, cand)
+                xs = ds_train.materialize()[0][-256:]
+                return RetrainResult(
+                    model_path=cand, trace_path=tr_path,
+                    reference_scores=np.asarray(
+                        decision_function(model, xs), np.float64))
+            return run
+
+        def evaluate(candidate_path):
+            cand = load_model(candidate_path)
+            pred = np.where(np.asarray(
+                decision_function(cand, x_ho_shift)) < 0, -1, 1)
+            return float(np.mean(pred == y_ho_shift))
+
+        loop = ContinuousLearningLoop(
+            registry=registry, name="default", detector=detector,
+            score_source=lambda: np.asarray(window, np.float64),
+            retrain_fn=refresh_fn("full"),
+            incremental_fn=refresh_fn("incremental"),
+            full_every=full_every,
+            eval_fn=evaluate, accuracy_floor=accuracy_floor,
+            state_path=os.path.join(base_dir, "refresh.state.json"),
+            bundle_dir=bundle_dir, ledger_path=ledger_path,
+            on_event=t_event,
+            on_promote=lambda _name: pool.refresh())
+
+        # 4. pre-shift serving: appends from the BASE world keep the
+        # loop quiet (no false drift fire)
+        plan = faultinject.current()
+        append_rng = np.random.default_rng(seed + 1)
+        outcomes = []
+        for i in range(append_shards):
+            shifted = (plan.live_shift_now(i) if plan is not None
+                       else i + 1 >= shift_at_shard)
+            xa = append_rng.standard_normal(
+                (rows_per_shard, d)).astype(np.float32)
+            if shifted:
+                xa = xa + np.float32(shift)
+                ya = np.where((xa[:, 0] - shift)
+                              + 0.25 * (xa[:, 1] - shift) > 0, 1, -1)
+            else:
+                ya = np.where(xa[:, 0] + 0.25 * xa[:, 1] > 0, 1, -1)
+            livelib.append_shard(log_dir, xa,
+                                 np.asarray(ya, np.int32))
+            watcher.poll()
+            score_arrivals()
+            outcomes.append(loop.step())
+
+        promoted = "promoted" in outcomes
+        accepted = [o for o in outcomes
+                    if o in ("promoted", "gate-held")]
+        pred = np.where(np.asarray(
+            served_scores(x_ho_shift)) < 0, -1, 1)
+        acc_shift = float(np.mean(pred == y_ho_shift))
+        pred_b = np.where(np.asarray(
+            served_scores(x_ho_base)) < 0, -1, 1)
+        acc_base_before = float(np.mean(np.where(np.asarray(
+            decision_function(model0, x_ho_shift)) < 0, -1, 1)
+            == y_ho_shift))
+        pool_metrics = pool.metrics()
+        row = {
+            "metric": "live_refresh_latency",
+            "value": (loop.last_refresh or {}).get("seconds"),
+            "unit": "s",
+            "promoted": promoted,
+            "outcomes": outcomes,
+            "refresh_kind": (loop.last_refresh or {}).get("kind"),
+            "generation": registry.manifests()["default"]["generation"],
+            "log_generation": ds_live.generation,
+            "admitted_shards": watcher.admitted_shards,
+            "accuracy_shifted_before": acc_base_before,
+            "accuracy_shifted_after": acc_shift,
+            "accuracy_base_after": float(np.mean(pred_b == y_ho_base)),
+            "accuracy_floor": accuracy_floor,
+            "ejections": int(pool_metrics.get("ejections", 0)),
+            "torn_observed": watcher.torn_observed,
+            "stale_observed": watcher.stale_observed,
+            "drill_seconds": round(time.perf_counter() - t_drill, 3),
+        }
+        row["ok"] = bool(promoted and acc_shift >= accuracy_floor
+                         and row["ejections"] == 0 and accepted)
+        if trace is not None:
+            close_serving_trace(trace, requests=len(outcomes),
+                                errors=0,
+                                seconds=row["drill_seconds"])
+        return row
+    finally:
+        if trace is not None and not trace.closed:
+            close_serving_trace(trace)
+        pool.close()
+
+
+# keep the drill's lazy imports honest: faultinject is used above
+from dpsvm_tpu.resilience import faultinject  # noqa: E402

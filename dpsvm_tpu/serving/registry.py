@@ -127,6 +127,26 @@ class ModelRegistry:
             entry.loaded_at = time.time()
         return fresh
 
+    def promote_file(self, name: str, candidate_path: str) -> int:
+        """Atomic hot-swap of ``name``'s artifact: move the candidate
+        file onto the registered source path (``os.replace`` — readers
+        see old bytes or new bytes, never a torn file), then the
+        explicit warmed reload. Returns the new generation. The only
+        blessed way a candidate becomes the serving artifact — the
+        lifecycle loops call this, never raw file ops
+        (docs/SERVING.md "Continuous learning")."""
+        import os
+
+        source = self.source(name)
+        if source is None:
+            raise ValueError(
+                f"model {name!r} was registered in-memory; there is "
+                "no source path to promote onto")
+        os.replace(candidate_path, source)
+        self.reload(name)
+        with self._lock:
+            return self._entries[name].generation
+
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._entries)

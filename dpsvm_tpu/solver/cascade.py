@@ -199,9 +199,9 @@ class _ArraySource:
         self.block = block
         self.notify_quarantine: Optional[Callable] = None
 
-    def fit_approx(self, cfg: SVMConfig):
+    def fit_approx(self, cfg: SVMConfig, init_w=None):
         from dpsvm_tpu.approx.primal import fit_approx
-        return fit_approx(self.x, self.y, cfg)
+        return fit_approx(self.x, self.y, cfg, init_w=init_w)
 
     def blocks(self, model) -> Iterator[Tuple[int, np.ndarray,
                                               np.ndarray, np.ndarray]]:
@@ -264,10 +264,11 @@ class _ShardSource:
             allow_nonfinite=self.allow_nonfinite,
             on_quarantine=self.notify_quarantine)
 
-    def fit_approx(self, cfg: SVMConfig):
+    def fit_approx(self, cfg: SVMConfig, init_w=None):
         from dpsvm_tpu.approx.primal import fit_approx_stream
         return fit_approx_stream(self.ds, cfg, task="svc",
-                                 allow_nonfinite=self.allow_nonfinite)
+                                 allow_nonfinite=self.allow_nonfinite,
+                                 init_w=init_w)
 
     def blocks(self, model):
         from dpsvm_tpu.models.svm import decision_function
@@ -288,12 +289,12 @@ class _ShardSource:
         With ``window_idx``, shards holding no window rows are not
         even read (the tiered intermediate verify skips their I/O)."""
         from dpsvm_tpu.models.svm import decision_function
-        rps = self.ds.rows_per_shard
         for k in range(self.ds.n_shards):
             base = self.ds.row_offset(k)
+            rows_k = self.ds.shard_rows(k)
             if window_idx is not None:
                 wlo = np.searchsorted(window_idx, base)
-                whi = np.searchsorted(window_idx, base + rps)
+                whi = np.searchsorted(window_idx, base + rows_k)
                 if wlo == whi:
                     continue
             got = self._read(k)
@@ -306,7 +307,7 @@ class _ShardSource:
             else:
                 mask = np.ones(len(yk), bool)
             lo = np.searchsorted(kept_idx, base)
-            hi = np.searchsorted(kept_idx, base + rps)
+            hi = np.searchsorted(kept_idx, base + rows_k)
             mask[kept_idx[lo:hi] - base] = False
             if not mask.any():
                 continue
@@ -377,6 +378,11 @@ class _StageState:
                 f"({type(e).__name__}: {e}) — delete it to restart"
             ) from e
         for k, want in self.fingerprint.items():
+            if k not in got:
+                raise CascadeStateError(
+                    f"{self.path}: stage state predates the "
+                    f"{k!r} fingerprint field — stale state from an "
+                    "older run; delete it to restart")
             have = got[k]
             have = (str(have) if isinstance(want, str)
                     else type(want)(have))
@@ -449,8 +455,14 @@ class _StageState:
                 pass
 
 
-def _fingerprint(config: SVMConfig, n: int, d: int,
-                 gamma: float) -> dict:
+def _fingerprint(config: SVMConfig, n: int, d: int, gamma: float,
+                 approx_init_w=None) -> dict:
+    # The warm-start vector is part of the trajectory's identity: a
+    # stage file written under a different (or no) init must read as
+    # stale, never silently resume a different cascade.
+    import zlib
+    init_crc = (0 if approx_init_w is None else zlib.crc32(
+        np.ascontiguousarray(approx_init_w, np.float32).tobytes()))
     return dict(n=np.int64(n), d=np.int64(d),
                 c=np.float64(config.c), gamma=np.float64(gamma),
                 epsilon=np.float64(config.epsilon),
@@ -460,7 +472,8 @@ def _fingerprint(config: SVMConfig, n: int, d: int,
                 approx_dim=np.int64(config.approx_dim),
                 approx_seed=np.int64(config.approx_seed),
                 weight_pos=np.float64(config.weight_pos),
-                weight_neg=np.float64(config.weight_neg))
+                weight_neg=np.float64(config.weight_neg),
+                init_crc=np.int64(init_crc))
 
 
 # ---------------------------------------------------------------------
@@ -569,7 +582,8 @@ def _begin_trace(config: SVMConfig, n: int, d: int, gamma: float):
 
 
 def fit_cascade(x: np.ndarray, y: np.ndarray,
-                config: Optional[SVMConfig] = None
+                config: Optional[SVMConfig] = None, *,
+                approx_init_w=None
                 ) -> Tuple[SVMModel, CascadeResult]:
     """In-memory cascade (module docstring). Returns an ordinary
     ``SVMModel`` plus a ``CascadeResult`` whose ``alpha`` is the
@@ -583,7 +597,8 @@ def fit_cascade(x: np.ndarray, y: np.ndarray,
     if config.solver != "cascade":
         raise ValueError("fit_cascade needs solver='cascade'")
     x, y = _check_xy(x, y)
-    model, result = _run_cascade(_ArraySource(x, y), config)
+    model, result = _run_cascade(_ArraySource(x, y), config,
+                                 approx_init_w=approx_init_w)
     full = np.zeros((x.shape[0],), np.float32)
     full[result._kept_idx] = result.alpha
     result.alpha = full
@@ -591,7 +606,8 @@ def fit_cascade(x: np.ndarray, y: np.ndarray,
 
 
 def fit_cascade_stream(ds, config: Optional[SVMConfig] = None,
-                       allow_nonfinite: bool = False
+                       allow_nonfinite: bool = False, *,
+                       approx_init_w=None
                        ) -> Tuple[SVMModel, CascadeResult]:
     """Out-of-core cascade over a ``data.stream.ShardedDataset``: the
     approx stage trains via ``fit_approx_stream``, screening and KKT
@@ -607,10 +623,11 @@ def fit_cascade_stream(ds, config: Optional[SVMConfig] = None,
                          "(config.shards must be 1), like "
                          "fit_approx_stream")
     return _run_cascade(_ShardSource(ds, config, allow_nonfinite),
-                        config)
+                        config, approx_init_w=approx_init_w)
 
 
-def _run_cascade(source, config: SVMConfig
+def _run_cascade(source, config: SVMConfig, *,
+                 approx_init_w=None
                  ) -> Tuple[SVMModel, CascadeResult]:
     n, d = source.n, source.d
     gamma = float(config.resolve_gamma(d))
@@ -621,7 +638,8 @@ def _run_cascade(source, config: SVMConfig
               "verify": 0.0}
     plan = faultinject.current()
     state = (_StageState(config.checkpoint_path,
-                         _fingerprint(config, n, d, gamma))
+                         _fingerprint(config, n, d, gamma,
+                                      approx_init_w))
              if config.checkpoint_path else None)
     st = state.load() if state is not None else None
     trace = _begin_trace(config, n, d, gamma)
@@ -639,7 +657,8 @@ def _run_cascade(source, config: SVMConfig
         model_a = None
         if st is None:
             t0 = time.perf_counter()
-            model_a, res_a = source.fit_approx(_approx_config(config))
+            model_a, res_a = source.fit_approx(_approx_config(config),
+                                               init_w=approx_init_w)
             approx_iters = int(res_a.n_iter)
             phases["approx"] = time.perf_counter() - t0
             _log(f"approx warm-start: {approx_iters} iter(s) in "
