@@ -162,6 +162,16 @@ TAGS = [
     # events) archives under traces/ for `dpsvm report`.
     sub("live_drift_drill", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.serving", "--live-drill"]),
+    # Noisy-neighbour isolation drill (docs/OBSERVABILITY.md
+    # "Per-tenant attribution"): serve a multi-model registry, drive a
+    # skewed 8-tenant mix (t0 sends 80%) and prove the per-tenant
+    # observability chain identifies the hog — the fair-share rule
+    # fires naming t0, the incident bundle carries the tenant, and the
+    # JSON row's headline (tenant_isolation, also a perf-ledger row)
+    # is the COLD tenants' p99: what everyone else's latency costs
+    # while one tenant hogs the queue.
+    sub("tenant_isolation", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.serving", "--tenant-drill"]),
     sub("inference", R3, 240,
         [sys.executable, "benchmarks/inference_bench.py"],
         BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),

@@ -507,6 +507,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "collective probe (a hung interconnect "
                          "surfaces here in seconds, not an hour into "
                          "a run)")
+    dr.add_argument("--serving-url", default=None, metavar="URL",
+                    help="also probe a live `dpsvm serve` process: "
+                         "reports the tenant label budget, live "
+                         "per-tenant series count, evictions and "
+                         "overflow, warning near saturation "
+                         "(docs/OBSERVABILITY.md 'Per-tenant "
+                         "attribution'); reporting-only, never "
+                         "changes the exit code")
 
     rp = sub.add_parser(
         "report", help="render a run-telemetry trace (train "
@@ -770,6 +778,15 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--no-watch", dest="watch", action="store_false",
                     default=True,
                     help="disable the continuous SLO watchtower")
+    sv.add_argument("--tenant-budget", type=int, default=None,
+                    metavar="K",
+                    help="per-tenant metric label budget: at most K "
+                         "tenants get their own /metricsz series and "
+                         "cost ledger rows; the long tail folds into "
+                         "the mandatory 'other' bucket (LRU-of-"
+                         "activity eviction; default 32 — "
+                         "docs/OBSERVABILITY.md 'Per-tenant "
+                         "attribution')")
     sv.add_argument("-q", "--quiet", action="store_true")
     _add_backend_flags(sv)
 
@@ -837,6 +854,43 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--max-steps", type=int, default=8)
     lg.add_argument("--step-requests", type=int, default=100,
                     help="requests per saturation step")
+    lg.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="stamp requests with N synthetic tenant "
+                         "labels t0..t{N-1} (body 'tenant' field); "
+                         "the row gains per-tenant request counts and "
+                         "p50/p99, and a tenant_isolation perf-ledger "
+                         "row when combined with --hot-tenant-skew")
+    lg.add_argument("--hot-tenant-skew", type=float, default=0.0,
+                    metavar="S",
+                    help="fraction (0..1) of requests sent by the "
+                         "single hot tenant t0; the rest round-robin "
+                         "the cold tenants — the noisy-neighbour "
+                         "drill shape (docs/OBSERVABILITY.md "
+                         "'Per-tenant attribution')")
+
+    tns = sub.add_parser(
+        "tenants", help="per-tenant cost attribution table: who "
+                        "spends the fleet's device compute, rows and "
+                        "queue time — from a serving trace's span "
+                        "records or a live /metricsz endpoint "
+                        "(docs/OBSERVABILITY.md 'Per-tenant "
+                        "attribution')")
+    tsrc = tns.add_mutually_exclusive_group(required=True)
+    tsrc.add_argument("--url", default=None,
+                      help="base URL (or full /metricsz URL) of a "
+                           "live `dpsvm serve` process: renders its "
+                           "tenant cost ledger")
+    tsrc.add_argument("trace", nargs="?", default=None,
+                      help="serving trace JSONL (serve --trace-out), "
+                           "or a directory — newest *.jsonl; costs "
+                           "are attributed from sampled span trees")
+    tns.add_argument("--top", type=int, default=None, metavar="K",
+                     help="show only the K most expensive tenants by "
+                          "attributed wall time (default: all)")
+    tns.add_argument("--json", action="store_true",
+                     help="machine-readable rows instead of the table")
+    tns.add_argument("--timeout", type=float, default=10.0,
+                     help="--url fetch timeout seconds")
 
     tn = sub.add_parser(
         "tune", help="measure this backend's throughput-critical "
@@ -1923,6 +1977,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             watch_rules=args.watch_rules,
                             bundle_dir=args.bundle_dir,
                             watch=args.watch,
+                            **({"tenant_budget": args.tenant_budget}
+                               if args.tenant_budget is not None else {}),
                             verbose=not args.quiet).start()
     except ValueError as e:                 # width-mismatched sibling
         print(f"error: {e}", file=sys.stderr)
@@ -2004,15 +2060,125 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
                       rps=args.rps, want=want, timeout=args.timeout,
                       chaos=args.chaos,
                       compare_sequential=args.compare_sequential,
-                      trace=trace)
+                      trace=trace, tenants=args.tenants,
+                      hot_tenant_skew=args.hot_tenant_skew)
     print(json.dumps(row), flush=True)
     _ledger_append(row)
+    if row.get("hot_tenant") and row.get("others_p99_ms") is not None:
+        # The noisy-neighbour shape additionally feeds the
+        # tenant_isolation ledger case: the headline is the COLD
+        # tenants' p99 — how clean everyone else's latency stays while
+        # one tenant hogs the queue (docs/OBSERVABILITY.md
+        # "Per-tenant attribution").
+        _ledger_append({
+            "metric": "tenant_isolation",
+            "value": row["others_p99_ms"], "unit": "ms",
+            "trace": row.get("trace"),
+            "tenants": row.get("tenants"),
+            "hot_tenant_skew": row.get("hot_tenant_skew"),
+            "hot_tenant": row.get("hot_tenant"),
+            "hot_p99_ms": row.get("hot_p99_ms"),
+            "others_p99_ms": row.get("others_p99_ms"),
+            "requests": row.get("requests"),
+            "errors": row.get("errors")})
     if args.chaos:
         # a chaos drill EXPECTS some failures; the verdict is the
         # availability of accepted requests (the acceptance bar)
         avail = row.get("availability_pct")
         return 0 if (avail is not None and avail >= 99.0) else 1
     return 0 if row["errors"] == 0 else 1
+
+
+def cmd_tenants(args: argparse.Namespace) -> int:
+    """`dpsvm tenants`: the by-tenant cost table
+    (docs/OBSERVABILITY.md "Per-tenant attribution"). Two sources, one
+    row shape: a serving trace's sampled span trees (full percentiles)
+    or a live /metricsz cost ledger (running totals; no percentiles).
+    Pure HTTP/file I/O — no backend init. Exit 0 = rendered, 1 = the
+    source has no tenant attribution, 2 = unreachable/invalid."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dpsvm_tpu.observability.report import render_tenant_table
+
+    if args.url:
+        url = args.url.rstrip("/")
+        if not url.endswith("/metricsz"):
+            url += "/metricsz"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as r:
+                obj = json.loads(r.read())
+        except (urllib.error.URLError, OSError,
+                json.JSONDecodeError) as e:
+            print(f"error: cannot read {url}: {e}", file=sys.stderr)
+            return 2
+        tn = obj.get("tenants") if isinstance(obj, dict) else None
+        if not isinstance(tn, dict):
+            print("error: no 'tenants' block in /metricsz — is this a "
+                  "`dpsvm serve` endpoint?", file=sys.stderr)
+            return 1
+        per = tn.get("per_tenant") or {}
+        total_wall = sum(float(d.get("wall_ms", 0.0))
+                         for d in per.values())
+        rows = []
+        for ten, d in per.items():
+            wall = float(d.get("wall_ms", 0.0))
+            rows.append({
+                "tenant": ten,
+                "requests": int(d.get("requests", 0)),
+                "rows": int(d.get("rows", 0)),
+                "wall_ms": round(wall, 3),
+                "share": (wall / total_wall) if total_wall else 0.0,
+                "queue_wait_ms": float(d.get("queue_wait_ms", 0.0)),
+                "compute_ms": float(d.get("compute_ms", 0.0)),
+                "p50_ms": None, "p99_ms": None,
+                "errors": int(d.get("errors", 0)),
+                "deadline_504": int(d.get("deadline_504", 0)),
+                "models": []})
+        rows.sort(key=lambda r: (-r["wall_ms"], r["tenant"]))
+        if args.top is not None:
+            rows = rows[:max(int(args.top), 1)]
+        digest = {"source": url,
+                  "budget": tn.get("budget"), "live": tn.get("live"),
+                  "evictions": tn.get("evictions"),
+                  "overflow": tn.get("overflow"), "rows": rows}
+        if args.json:
+            _pipe_safe_print(json.dumps(digest))
+            return 0
+        head = (f"tenants (live): budget {tn.get('budget')}, "
+                f"{tn.get('live')} live series, "
+                f"{tn.get('evictions')} evictions, "
+                f"{tn.get('overflow')} folded into 'other'")
+        _pipe_safe_print("\n".join(
+            [head, ""] + render_tenant_table(rows)))
+        return 0
+
+    from dpsvm_tpu.observability.report import (load_trace,
+                                                resolve_trace_path,
+                                                tenant_attribution)
+    try:
+        records = load_trace(resolve_trace_path(args.trace))
+    except FileNotFoundError as e:
+        print(f"error: no such trace: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    att = tenant_attribution(records, top=args.top)
+    if att is None:
+        print("error: no tenant-attributed span roots in this trace "
+              "(pre-v4 schema, or --trace-sample-rate 0)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        _pipe_safe_print(json.dumps(att))
+        return 0
+    head = (f"tenants (trace): {att['tenants']} attributed, "
+            f"{att['total_wall_ms']:,.1f} ms total wall")
+    _pipe_safe_print("\n".join(
+        [head, ""] + render_tenant_table(att["rows"])))
+    return 0
 
 
 def cmd_tune(args: argparse.Namespace) -> int:
@@ -2450,10 +2616,12 @@ def cmd_watch(args: argparse.Namespace) -> int:
                         server_worst = slo.worst_severity(
                             server_worst, sev)
                         if a.get("rule") not in server_firing:
+                            ten = (f" [tenant {a['tenant']}]"
+                                   if a.get("tenant") else "")
                             say(f"[live] FIRING {sev:<4} "
                                 f"{a.get('rule')} "
-                                f"({a.get('window')}) — reported by "
-                                "the source's own watchtower")
+                                f"({a.get('window')}){ten} — reported "
+                                "by the source's own watchtower")
                     for rule in server_firing - firing_now:
                         say(f"[live]     ok      {rule} — cleared at "
                             "the source")
@@ -2545,6 +2713,8 @@ def cmd_watch(args: argparse.Namespace) -> int:
             mark = "FIRING" if s["state"] == "firing" else "ok"
             say(f"{mark:>6} {s['severity']:<4} {s['rule']} "
                 f"({s['window']})"
+                + (f" [tenant {s['tenant']}]" if s.get("tenant")
+                   else "")
                 + (f" — {s['reason']}" if s["reason"] else "")
                 + (f" [fired {s['fired_count']}x]"
                    if s["fired_count"] else ""))
@@ -2659,7 +2829,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_doctor(shards=args.shards,
                               checkpoint_path=args.checkpoint,
                               data_path=args.data,
-                              timeout_s=args.timeout)
+                              timeout_s=args.timeout,
+                              serving_url=args.serving_url)
         if args.command == "report":
             return cmd_report(args)
         if args.command == "compare":
@@ -2676,6 +2847,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_serve(args)
         if args.command == "loadgen":
             return cmd_loadgen(args)
+        if args.command == "tenants":
+            return cmd_tenants(args)
         return cmd_test(args)
     except PreemptedError as e:
         # Resumable by design: the supervisor (or the next manual run)

@@ -176,6 +176,7 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
     problems += _selfcheck_spans(tmp_dir)
     problems += _selfcheck_roofline(tmp_dir)
     problems += _selfcheck_watch(tmp_dir)
+    problems += _selfcheck_tenants(tmp_dir)
     return problems
 
 
@@ -389,6 +390,171 @@ def _watch_storm_drill(td: str) -> List[str]:
         except Exception:
             pass
         faultinject.clear()
+    return problems
+
+
+def _selfcheck_tenants(tmp_dir: Optional[str] = None) -> List[str]:
+    """The per-tenant attribution gate (docs/OBSERVABILITY.md
+    "Per-tenant attribution"): multi-tenant stub traffic with a
+    planted hog -> per-tenant series land on both /metricsz faces
+    (validator-clean exposition) -> the tenant-fair-share rule fires
+    NAMING the hog -> the incident bundle carries the tenant -> the
+    trace's span roots attribute every sampled request to its
+    tenant."""
+    import json
+    import os
+    import tempfile
+    import time
+    import urllib.error
+    import urllib.request
+
+    from dpsvm_tpu.observability import blackbox
+    from dpsvm_tpu.observability.metrics import validate_exposition
+
+    try:
+        import numpy as np
+
+        from dpsvm_tpu.serving.loadgen import tenant_of
+        from dpsvm_tpu.serving.server import ServingServer
+    except Exception as e:              # pragma: no cover — env issue
+        return [f"tenant drill setup failed: {e}"]
+
+    class _Engine:
+        num_attributes = 4
+        calibrated = False
+        manifest = {"task": "selfcheck-stub", "num_attributes": 4}
+
+        def infer(self, x, want):
+            n = int(np.shape(x)[0])
+            return {k: (np.ones(n, np.int32) if k == "labels"
+                        else np.zeros(n, np.float32))
+                    for k in want}
+
+        def bucket_counts(self):
+            return {}
+
+    class _Registry:
+        def __init__(self):
+            self._e = _Engine()
+
+        def names(self):
+            return ["default", "aux"]
+
+        def engine(self, name):
+            return self._e
+
+        def build(self, name):
+            return _Engine()
+
+        def manifests(self):
+            return {n: dict(self._e.manifest, generation=1)
+                    for n in self.names()}
+
+    problems: List[str] = []
+    with tempfile.TemporaryDirectory(dir=tmp_dir) as td:
+        bundle_dir = os.path.join(td, "tenant-bundles")
+        trace_path = os.path.join(td, "tenant-trace.jsonl")
+        rules = [{"name": "tenant-fair-share", "kind": "fair_share",
+                  "severity": "warn", "per_tenant": True,
+                  "window_s": 0.8, "share_above": 0.5,
+                  "min_tenants": 2, "for_s": 0.0,
+                  "clear_after_s": 10.0}]
+        srv = None
+        try:
+            srv = ServingServer(_Registry(), port=0, max_batch=4,
+                                max_delay_ms=0.2, watch_rules=rules,
+                                bundle_dir=bundle_dir,
+                                trace_out=trace_path,
+                                trace_sample_rate=1.0,
+                                tenant_budget=8).start()
+
+            def post(i):
+                body = {"instances": [[0.0] * 4],
+                        "model": ("aux" if i % 7 == 3 else "default"),
+                        "tenant": tenant_of(i, 8, 0.8)}
+                req = urllib.request.Request(
+                    srv.url + "/v1/predict",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+
+            deadline = time.monotonic() + 20.0
+            fired = {}
+            i = 0
+            while time.monotonic() < deadline and not fired:
+                post(i)
+                i += 1
+                fired = next(
+                    (s for s in srv.watch.states()
+                     if s["state"] == "firing"
+                     and s["rule"].startswith("tenant-fair-share[")),
+                    {})
+            if not fired:
+                problems.append("planted hot tenant never fired the "
+                                "fair-share rule")
+            elif fired.get("tenant") != "t0":
+                problems.append("fair-share fired for "
+                                f"{fired.get('tenant')!r}, not the "
+                                "planted hog t0")
+            # both /metricsz faces carry the per-tenant series
+            with urllib.request.urlopen(
+                    srv.url + "/metricsz?format=prometheus",
+                    timeout=10) as r:
+                expo = r.read().decode()
+            errs = validate_exposition(expo)
+            if errs:
+                problems.append("per-tenant exposition invalid: "
+                                f"{errs}")
+            if 'dpsvm_tenant_requests_total{tenant="t0"}' not in expo:
+                problems.append("tenant series missing from the "
+                                "prometheus exposition")
+            with urllib.request.urlopen(srv.url + "/metricsz",
+                                        timeout=10) as r:
+                mz = json.loads(r.read())
+            per = (mz.get("tenants") or {}).get("per_tenant") or {}
+            if not per or max(
+                    per, key=lambda t: per[t]["requests"]) != "t0":
+                problems.append("JSON cost ledger did not rank the "
+                                f"hog first: {sorted(per)}")
+            for name in ("default", "aux"):
+                if name not in (mz.get("per_model") or {}):
+                    problems.append(f"per_model block lost {name!r}")
+        except Exception as e:
+            problems.append(f"tenant drill crashed: {e!r}")
+        finally:
+            try:
+                if srv is not None:
+                    srv.drain(timeout=10.0)
+            except Exception:
+                pass
+        # the incident bundle names the culprit and validates clean
+        bundles = [b for b in (os.listdir(bundle_dir)
+                               if os.path.isdir(bundle_dir) else [])
+                   if b.startswith("incident-")]
+        if not bundles:
+            problems.append("fair-share fired but dumped no bundle")
+        else:
+            bpath = blackbox.resolve_bundle_dir(bundle_dir)
+            errs = blackbox.validate_bundle(bpath)
+            if errs:
+                problems.append(f"tenant bundle invalid: {errs}")
+            inc = blackbox.load_incident(bpath)
+            if inc.get("tenant") != "t0":
+                problems.append("incident.json does not name the "
+                                f"tenant: {inc.get('tenant')!r}")
+        # every sampled span root attributes its request to a tenant
+        try:
+            records = load_trace(trace_path)
+        except (OSError, ValueError) as e:
+            problems.append(f"tenant trace unreadable: {e}")
+            records = []
+        roots = [r for r in records
+                 if r.get("kind") == "span" and r.get("name") == "request"]
+        if not roots:
+            problems.append("tenant trace recorded no request roots")
+        if any("tenant" not in r for r in roots):
+            problems.append("a sampled span root lost its tenant")
     return problems
 
 
@@ -669,7 +835,10 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"(schema v{TRACE_SCHEMA_VERSION}, v1 accepted; metrics "
               "exposition + ledger gate + serving span round-trip + "
               "roofline render + watch gate (burn-rate fire/clear, "
-              "504-storm drill, incident-bundle round-trip) checked)")
+              "504-storm drill, incident-bundle round-trip) + tenant "
+              "gate (per-tenant series on both /metricsz faces, "
+              "fair-share names the hog, bundle carries the tenant, "
+              "span roots attributed) checked)")
         return 0
     if args.validate:
         try:

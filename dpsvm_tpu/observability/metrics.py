@@ -193,10 +193,7 @@ class _Metric:
         else:
             self.buckets = ()
 
-    def labels(self, *values, **kv) -> _Child:
-        """The series handle for one label-value combination (created
-        on first use). Positional values follow the registration
-        order; keyword values may come in any order."""
+    def _key(self, values, kv) -> Tuple[str, ...]:
         if values and kv:
             raise ValueError("pass label values positionally OR by "
                              "keyword, not both")
@@ -212,13 +209,30 @@ class _Metric:
             raise ValueError(
                 f"metric {self.name} takes {len(self.label_names)} "
                 f"label value(s) {self.label_names}, got {len(values)}")
-        key = tuple(str(v) for v in values)
+        return tuple(str(v) for v in values)
+
+    def labels(self, *values, **kv) -> _Child:
+        """The series handle for one label-value combination (created
+        on first use). Positional values follow the registration
+        order; keyword values may come in any order."""
+        key = self._key(values, kv)
         with self._lock:
             s = self._series.get(key)
             if s is None:
                 s = _Series(key, n_buckets=len(self.buckets))
                 self._series[key] = s
             return _Child(self, s)
+
+    def remove(self, *values, **kv) -> bool:
+        """Drop one labeled series (True when it existed). The escape
+        hatch bounded-cardinality surfaces need: a TenantLabelBudget
+        eviction removes the evicted tenant's series so the exposition
+        can never grow past the label budget. Stale _Child handles to
+        a removed series keep working but update an orphan — callers
+        must re-resolve through ``labels()`` after an eviction."""
+        key = self._key(values, kv)
+        with self._lock:
+            return self._series.pop(key, None) is not None
 
     # unlabeled convenience: counter.inc() etc. act on the () series
     def _default(self) -> _Child:
@@ -632,6 +646,139 @@ def incidents_counter(registry: Optional[MetricsRegistry] = None):
     return reg.counter(
         "dpsvm_incidents_total",
         "alert-rule firings that opened an incident").labels()
+
+
+# ---------------------------------------------------------------------
+# bounded-cardinality tenant labels (docs/OBSERVABILITY.md
+# "Per-tenant attribution")
+# ---------------------------------------------------------------------
+
+#: the mandatory overflow bucket every out-of-budget tenant lands in —
+#: a fixed label value, so total series stay <= budget + 1 per family.
+TENANT_OTHER = "other"
+
+#: default top-K active tenants that get their own label value
+#: (``dpsvm serve --tenant-budget`` overrides).
+DEFAULT_TENANT_BUDGET = 32
+
+#: longest tenant name accepted at admission; longer ones are clamped
+#: (a label value is an identity, not a payload channel).
+MAX_TENANT_LEN = 64
+
+
+def sanitize_tenant(name) -> Optional[str]:
+    """Admission-side tenant-name hygiene: strip, replace control
+    characters (newline included) with ``_``, clamp to MAX_TENANT_LEN.
+    Returns None for an unusable name (empty / whitespace / not a
+    string-able scalar) so the caller falls back to its default.
+
+    Printable hostile characters (``"`` and ``\\``) are deliberately
+    KEPT: the exposition escapes them (``escape_label_value``) and the
+    grammar validator accepts the escaped form — pinned by the
+    tamper-case in tests — so a tenant named ``acme"prod`` stays
+    identifiable instead of being silently renamed."""
+    if name is None or isinstance(name, (dict, list, tuple)):
+        return None
+    s = str(name)
+    s = "".join(ch if ch.isprintable() else "_" for ch in s)
+    s = s.strip()[:MAX_TENANT_LEN].strip()
+    return s or None
+
+
+class TenantLabelBudget:
+    """Bounded-cardinality tenant -> label-value resolver.
+
+    Prometheus dies by label cardinality: a fleet with an unbounded
+    tenant label is one curious client away from a series explosion.
+    This resolver admits at most ``budget`` resident tenants; everyone
+    else resolves to the ``other`` overflow bucket, so per-family
+    series are <= budget + 1 forever (pinned by the 10k-churn test).
+
+    Residency is LRU-of-activity with a deterministic twist: activity
+    is a monotone integer tick (no wall clock — replays and tests see
+    identical evictions), and a non-resident needs a SECOND touch
+    while the budget is full to evict the least-recently-active
+    resident. One-shot names — the churny tail — never displace a
+    working set, they aggregate into ``other``; a genuinely active
+    newcomer gets in on its second request. ``on_evict(tenant)`` fires
+    (outside any hot path, same thread) so the owner can drop the
+    evicted tenant's series (``_Metric.remove``).
+
+    Thread-safe; stdlib only."""
+
+    OTHER = TENANT_OTHER
+
+    def __init__(self, budget: int = DEFAULT_TENANT_BUDGET,
+                 on_evict: Optional[Callable[[str], None]] = None):
+        if int(budget) < 1:
+            raise ValueError(f"tenant budget must be >= 1, got {budget}")
+        self.budget = int(budget)
+        self._on_evict = on_evict
+        self._lock = threading.Lock()
+        self._tick = 0
+        self._resident: Dict[str, int] = {}     # tenant -> last tick
+        self._waiting: Dict[str, int] = {}      # non-resident touches
+        self._evictions = 0
+        self._overflow = 0
+
+    def resolve(self, tenant: str) -> str:
+        """The label value to use for ``tenant`` right now: the name
+        itself while resident (or admitted by this touch), else
+        ``other``. Every call counts as activity."""
+        tenant = str(tenant)
+        if tenant == TENANT_OTHER:
+            return TENANT_OTHER
+        evicted = None
+        with self._lock:
+            self._tick += 1
+            if tenant in self._resident:
+                self._resident[tenant] = self._tick
+                return tenant
+            if len(self._resident) < self.budget:
+                self._resident[tenant] = self._tick
+                self._waiting.pop(tenant, None)
+                return tenant
+            touches = self._waiting.get(tenant, 0) + 1
+            if touches >= 2:
+                lru = min(self._resident, key=self._resident.get)
+                del self._resident[lru]
+                self._evictions += 1
+                evicted = lru
+                self._resident[tenant] = self._tick
+                self._waiting.pop(tenant, None)
+            else:
+                self._waiting[tenant] = touches
+                # the waiting map is itself bounded: one-shot churn
+                # must not hoard host memory either
+                while len(self._waiting) > self.budget:
+                    drop = next(iter(self._waiting))
+                    del self._waiting[drop]
+                self._overflow += 1
+        if evicted is not None and self._on_evict is not None:
+            try:
+                self._on_evict(evicted)
+            except Exception:
+                pass
+        if evicted is not None:
+            return tenant
+        return TENANT_OTHER
+
+    def is_resident(self, tenant: str) -> bool:
+        with self._lock:
+            return tenant in self._resident
+
+    def residents(self) -> List[str]:
+        """Resident tenants, most recently active first."""
+        with self._lock:
+            return sorted(self._resident,
+                          key=self._resident.get, reverse=True)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"budget": self.budget,
+                    "live": len(self._resident),
+                    "evictions": self._evictions,
+                    "overflow": self._overflow}
 
 
 # ---------------------------------------------------------------------

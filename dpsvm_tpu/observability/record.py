@@ -128,7 +128,7 @@ INGEST_EVENTS = ("quarantine", "ingest_resume")
 LIVE_EVENTS = ("append_admitted", "ingest_grow", "refresh",
                "refresh_resume")
 
-# Span names the serving layer records per sampled request (schema v3,
+# Span names the serving layer records per sampled request (schema v3+,
 # docs/OBSERVABILITY.md "Spans"). The `request` root covers admission
 # to response; its direct children are the sequential pipeline stages
 # (`admission` = parse+validate, `queue_wait` = batcher queue,
@@ -174,7 +174,7 @@ def open_serving_trace(path: str, *, models: Optional[dict] = None,
     (``sample_rate``, recorded in the manifest config so a reader
     knows what fraction of traffic the spans represent), and a
     close_serving_trace() summary at drain. The artifact validates
-    under the ordinary v3 schema, so `dpsvm report` and the trace
+    under the ordinary current schema, so `dpsvm report` and the trace
     tooling consume it unchanged."""
     config = {"models": dict(models or {})}
     if sample_rate is not None:
@@ -327,7 +327,7 @@ class RunTrace:
 
     def span(self, *, trace_id, span_id: int, parent: Optional[int],
              name: str, t_start: float, t_end: float, **extra) -> None:
-        """One request-scoped span (schema v3; serving producers:
+        """One request-scoped span (schema v3+; serving producers:
         observability/spans.RequestSpans via ServingServer).
         ``t_start``/``t_end`` are ABSOLUTE time.perf_counter readings —
         the recorder rebases them onto its own t0 so every span shares

@@ -228,6 +228,84 @@ def span_attribution(records: List[dict],
     }
 
 
+def tenant_attribution(records: List[dict],
+                       top: Optional[int] = None) -> Optional[dict]:
+    """Aggregate a serving trace's tenant-stamped span trees (schema
+    v4 — root spans carry ``tenant``/``model`` extras) into the
+    by-tenant cost table behind ``dpsvm report`` and ``dpsvm
+    tenants``: per tenant, sampled requests, rows, wall / queue-wait /
+    device-compute milliseconds, the tenant's share of total sampled
+    wall, latency percentiles, and error/504 counts. ``top`` keeps the
+    N most expensive tenants (by wall). None when no root span names a
+    tenant (training traces, pre-v4 serving traces)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    if not spans:
+        return None
+    by_trace: Dict[object, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    acc: Dict[str, dict] = {}
+    for tid, group in by_trace.items():
+        root = next((s for s in group if s["parent"] is None), None)
+        if root is None or root.get("tenant") is None:
+            continue
+        tenant = str(root["tenant"])
+        dur = (root["t_end"] - root["t_start"]) * 1000.0
+        a = acc.setdefault(tenant, {
+            "requests": 0, "rows": 0, "wall_ms": 0.0,
+            "queue_wait_ms": 0.0, "compute_ms": 0.0,
+            "errors": 0, "deadline_504": 0,
+            "wall": [], "models": set()})
+        a["requests"] += 1
+        a["rows"] += int(root.get("rows", 0) or 0)
+        a["wall_ms"] += dur
+        a["wall"].append(dur)
+        if root.get("model") is not None:
+            a["models"].add(str(root["model"]))
+        status = root.get("status")
+        if status == 504:
+            a["deadline_504"] += 1
+        elif status is not None and status != 200:
+            a["errors"] += 1
+        for s in group:
+            if s["parent"] != root["span_id"]:
+                continue
+            ms = (s["t_end"] - s["t_start"]) * 1000.0
+            if s["name"] == "queue_wait":
+                a["queue_wait_ms"] += ms
+            elif s["name"] == "device_dispatch":
+                a["compute_ms"] += ms
+    if not acc:
+        return None
+    total_wall = sum(a["wall_ms"] for a in acc.values()) or 1.0
+    rows = []
+    for tenant, a in acc.items():
+        wall = sorted(a["wall"])
+        rows.append({
+            "tenant": tenant,
+            "requests": a["requests"],
+            "rows": a["rows"],
+            "wall_ms": round(a["wall_ms"], 3),
+            "share": round(a["wall_ms"] / total_wall, 4),
+            "queue_wait_ms": round(a["queue_wait_ms"], 3),
+            "compute_ms": round(a["compute_ms"], 3),
+            "p50_ms": round(_percentile(wall, 50.0), 3),
+            "p99_ms": round(_percentile(wall, 99.0), 3),
+            "errors": a["errors"],
+            "deadline_504": a["deadline_504"],
+            "models": sorted(a["models"]),
+        })
+    rows.sort(key=lambda r: (-r["wall_ms"], r["tenant"]))
+    n_total = len(rows)
+    if top is not None and top > 0:
+        rows = rows[:top]
+    return {
+        "tenants": n_total,
+        "total_wall_ms": round(total_wall, 3),
+        "rows": rows,
+    }
+
+
 def summarize_trace(records: List[dict]) -> dict:
     """The machine-readable digest ``dpsvm report --json`` prints."""
     manifest = records[0] if records else {}
@@ -244,6 +322,7 @@ def summarize_trace(records: List[dict]) -> dict:
         "compiles": compiles,
         "facts": trace_facts(records),
         "spans": span_attribution(records),
+        "tenants": tenant_attribution(records),
         "curve": [{"n_iter": c["n_iter"], "gap": c["gap"],
                    "n_sv": c["n_sv"], "t": c["t"]} for c in chunks],
     }
@@ -487,8 +566,39 @@ def render_report(records: List[dict], width: int = 60) -> str:
             out.append(f"  {r['trace_id']}: {r['total_ms']:,.3f} ms"
                        f"{status}  {parts} | unattributed "
                        f"{r['unattributed_ms']:,.3f}")
+    tenants = tenant_attribution(records)
+    if tenants is not None:
+        out.append("")
+        out.append(f"per-tenant cost attribution "
+                   f"({tenants['tenants']} tenant(s), "
+                   f"{tenants['total_wall_ms']:,.1f} ms sampled wall "
+                   "— docs/OBSERVABILITY.md \"Per-tenant "
+                   "attribution\"):")
+        out.extend(render_tenant_table(tenants["rows"]))
     out.append(f"chunk polls recorded: {len(chunks)}")
     return "\n".join(out)
+
+
+def render_tenant_table(rows: List[dict]) -> List[str]:
+    """The by-tenant cost table (one row shape — tenant_attribution
+    for traces, ``dpsvm tenants --url`` normalizes /metricsz into the
+    same dicts), indented for embedding in the report."""
+    if not rows:
+        return ["  (no tenant-attributed requests)"]
+    w = max(max(len(r["tenant"]) for r in rows), len("tenant"))
+    out = [f"  {'tenant':<{w}}  {'reqs':>6} {'rows':>7} "
+           f"{'wall ms':>10} {'share':>6} {'queue ms':>9} "
+           f"{'compute ms':>10} {'p99 ms':>8} {'err':>4} {'504':>4}"]
+    for r in rows:
+        p99 = r.get("p99_ms")
+        out.append(
+            f"  {r['tenant']:<{w}}  {r['requests']:>6,} "
+            f"{r['rows']:>7,} {r['wall_ms']:>10,.1f} "
+            f"{r['share']:>6.1%} {r['queue_wait_ms']:>9,.1f} "
+            f"{r['compute_ms']:>10,.1f} "
+            + (f"{p99:>8,.2f}" if p99 is not None else f"{'-':>8}")
+            + f" {r['errors']:>4,} {r['deadline_504']:>4,}")
+    return out
 
 
 def _is_terminal(records: List[dict]) -> Optional[str]:

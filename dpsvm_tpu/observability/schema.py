@@ -47,6 +47,14 @@ read. Version history:
   root's own duration (the shortfall is the request's *unattributed*
   residual, reported — never hidden — by ``dpsvm report``), and a
   ``parent`` must name a span of the same ``trace_id``.
+* v4 — tenant attribution (docs/OBSERVABILITY.md "Per-tenant
+  attribution"): serving span roots and ``replica_compute`` children
+  may carry ``tenant`` and ``model`` extras identifying who the
+  request's time was spent for (``X-Tenant`` header / body ``tenant``
+  field, default = model name). Purely additive — no new record
+  kinds, no new required keys — so every v3 consumer reads a v4
+  trace unchanged and v1/v2/v3 traces keep validating
+  (tests/fixtures/trace_v{1,2,3}.jsonl).
 """
 
 from __future__ import annotations
@@ -54,8 +62,8 @@ from __future__ import annotations
 import json
 from typing import IO, Dict, List, Optional
 
-TRACE_SCHEMA_VERSION = 3
-SUPPORTED_SCHEMAS = (1, 2, 3)
+TRACE_SCHEMA_VERSION = 4
+SUPPORTED_SCHEMAS = (1, 2, 3, 4)
 
 # Required keys per record kind. Values may be null where noted in
 # docs/OBSERVABILITY.md (e.g. env.device_kind on an uninitialized

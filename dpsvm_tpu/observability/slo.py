@@ -49,6 +49,28 @@ Rule kinds (specs are plain dicts — JSON on disk, Python inline):
   drop_pct/100)`` for ``for_s``; the baseline is a literal number or
   resolved ONCE at ruleset load from the perf-ledger median
   (``baseline_case`` — the roofline_fraction drop rule).
+* ``fair_share`` — noisy-tenant detection: one tenant's share of the
+  fleet's trailing-window queue-wait above ``share_above`` while at
+  least ``min_tenants`` tenants are active. Reads the cumulative
+  ``tenant:<name>:queue_wait_ms`` / ``tenant:<name>:compute_ms``
+  lanes the serving layer exports (docs/OBSERVABILITY.md "Per-tenant
+  attribution") — queue wait is the cost a tenant imposes on its
+  NEIGHBOURS, so a dominant queue-wait share is the isolation alarm
+  even when the tenant's own latency still looks fine.
+
+**Per-tenant templates.** A rule spec carrying ``"per_tenant": true``
+is a TEMPLATE, not a rule: the ``Watchtower`` discovers active
+tenants from the ``tenant:<name>:<metric>`` keys in each sample and
+expands the template into one concrete rule per tenant (named
+``template[tenant]``, ``{tenant}`` substituted into metric/counter
+names), capped at ``tenant_cap`` expansions so alert cardinality is
+bounded exactly like the metric label budget (metrics.py
+``TenantLabelBudget``). The ``other`` overflow tenant is never
+expanded — its lanes aggregate many tenants, so a firing there could
+not name a culprit. Templates round-trip verbatim through
+``RuleSet.to_specs()``; expanded rules live only inside the tower,
+and their transitions/states carry a ``tenant`` key so incident
+bundles can name the tenant (serving/server.py ``_on_alert``).
 
 Severities and exit codes (the ``dpsvm watch`` contract): ``warn`` ->
 exit 4, ``page`` -> exit 5; no alert -> 0; a stale/unreachable source
@@ -76,7 +98,22 @@ EXIT_WARN = 4
 EXIT_PAGE = 5
 
 RULE_KINDS = ("burn_rate", "threshold", "rate", "stagnation",
-              "drop_vs_baseline")
+              "drop_vs_baseline", "fair_share")
+
+#: The overflow pseudo-tenant (mirrors metrics.TENANT_OTHER — pinned
+#: equal in tests/test_watch.py so the two stay one vocabulary without
+#: this stdlib-only module importing the metrics layer).
+TENANT_OTHER = "other"
+
+#: Default cap on per-template tenant fan-out: alert cardinality gets
+#: the same bound the metric series get (metrics.DEFAULT_TENANT_BUDGET).
+TENANT_FAN_OUT_CAP = 32
+
+#: ``tenant:<name>:<metric>`` — the flattened per-tenant sample lanes
+#: (sample_from_metricsz_json / serving watch_sample). The tenant part
+#: is greedy so tenant names containing ``:`` still parse (the metric
+#: suffix never contains one).
+_TENANT_KEY_RE = re.compile(r"^tenant:(?P<tenant>.+):(?P<metric>[^:]+)$")
 
 
 class RuleError(ValueError):
@@ -140,7 +177,31 @@ class Rule:
         # per-kind parameters (validated eagerly so a bad rules file
         # fails at load, not at the 3 a.m. firing)
         k = self.kind
-        if k == "burn_rate":
+        self.per_tenant = bool(spec.get("per_tenant"))
+        self.tenant = spec.get("tenant")
+        if self.tenant is not None:
+            self.tenant = str(self.tenant)
+        if k == "fair_share":
+            if not self.per_tenant and not self.tenant:
+                raise RuleError(
+                    f"rule {self.name!r}: fair_share needs 'tenant' "
+                    "(or 'per_tenant': true to template over active "
+                    "tenants)")
+            self.window_s = _num(spec, "window_s", 60.0,
+                                 positive=True)
+            share = _num(spec, "share_above", 0.5)
+            if not (0.0 < share < 1.0):
+                raise RuleError(f"rule {self.name!r}: share_above "
+                                f"must be in (0, 1), got {share}")
+            self.share_above = share
+            mt = _num(spec, "min_tenants", 2.0)
+            if mt < 1:
+                raise RuleError(f"rule {self.name!r}: min_tenants "
+                                f"must be >= 1, got {mt}")
+            self.min_tenants = int(mt)
+            self.min_queue_wait_ms = _num(spec, "min_queue_wait_ms",
+                                          1.0) or 0.0
+        elif k == "burn_rate":
             self.good = str(spec.get("good") or "")
             self.bad = str(spec.get("bad") or "")
             if not self.good or not self.bad:
@@ -214,7 +275,7 @@ class Rule:
     def _keep_window_s(self) -> float:
         if self.kind == "burn_rate":
             return self.slow_window_s
-        if self.kind in ("rate", "stagnation"):
+        if self.kind in ("rate", "stagnation", "fair_share"):
             return self.window_s
         # threshold / drop_vs_baseline hold no history beyond the
         # debounce; keep the larger debounce span
@@ -279,6 +340,53 @@ class Rule:
                           f"(slow {self.slow_window_s:g}s) of the "
                           f"{self.budget:.4g} error budget "
                           f"(threshold {self.threshold:g}x)")
+        if self.kind == "fair_share":
+            own_qw = sample.get(f"tenant:{self.tenant}:queue_wait_ms")
+            own_c = sample.get(f"tenant:{self.tenant}:compute_ms")
+            if own_qw is None or own_c is None:
+                return None, ""
+            tot_qw = tot_c = 0.0
+            active = set()
+            for key, val in sample.items():
+                m = _TENANT_KEY_RE.match(key)
+                if m is None or not isinstance(val, (int, float)):
+                    continue
+                active.add(m.group("tenant"))
+                if m.group("metric") == "queue_wait_ms":
+                    tot_qw += float(val)
+                elif m.group("metric") == "compute_ms":
+                    tot_c += float(val)
+            self._samples.append(
+                (t, (float(own_qw), tot_qw, float(own_c), tot_c,
+                     float(len(active)))))
+            self._prune(t)
+            # like ``rate``: a FULL window before any verdict, so the
+            # first busy seconds of a process can't misread as a hog
+            if t - self._samples[0][0] < self.window_s:
+                return None, ""
+            d_own_qw = self._window_delta(t, self.window_s, 0)
+            d_tot_qw = self._window_delta(t, self.window_s, 1)
+            d_own_c = self._window_delta(t, self.window_s, 2)
+            d_tot_c = self._window_delta(t, self.window_s, 3)
+            if d_own_qw is None or d_tot_qw is None:
+                return None, ""
+            n_active = int(self._samples[-1][1][4])
+            if (n_active < self.min_tenants
+                    or d_tot_qw < self.min_queue_wait_ms):
+                # too few tenants / too little queueing for a share to
+                # mean anything: explicitly healthy, not no-verdict,
+                # so a firing clears when traffic drains
+                return False, ""
+            qw_share = d_own_qw / d_tot_qw
+            comp_share = ((d_own_c or 0.0) / d_tot_c
+                          if (d_own_c is not None and d_tot_c)
+                          else 0.0)
+            return (qw_share >= self.share_above,
+                    f"tenant {self.tenant!r} queue_wait share "
+                    f"{qw_share:.0%} (compute share {comp_share:.0%}) "
+                    f"over {self.window_s:g}s across {n_active} "
+                    f"active tenants (threshold "
+                    f"{self.share_above:.0%})")
         v = sample.get(self.metric)
         if v is None:
             return None, ""
@@ -373,25 +481,31 @@ class Rule:
         if self.kind == "burn_rate":
             return (f"fast={self.fast_window_s:g}s/"
                     f"slow={self.slow_window_s:g}s")
-        if self.kind in ("rate", "stagnation"):
+        if self.kind in ("rate", "stagnation", "fair_share"):
             return f"{self.window_s:g}s"
         if self.for_s:
             return f"for={self.for_s:g}s"
         return "instant"
 
     def _transition(self, state: str, t: float) -> dict:
-        return {"rule": self.name, "kind": self.kind,
-                "severity": self.severity, "state": state,
-                "window": self.window_desc(), "reason": self.reason,
-                "t": round(float(t), 6)}
+        out = {"rule": self.name, "kind": self.kind,
+               "severity": self.severity, "state": state,
+               "window": self.window_desc(), "reason": self.reason,
+               "t": round(float(t), 6)}
+        if self.tenant:
+            out["tenant"] = self.tenant
+        return out
 
     def state(self) -> dict:
-        return {"rule": self.name, "kind": self.kind,
-                "severity": self.severity,
-                "state": "firing" if self.firing else "ok",
-                "window": self.window_desc(),
-                "since": self.since, "reason": self.reason,
-                "fired_count": self.fired_count}
+        out = {"rule": self.name, "kind": self.kind,
+               "severity": self.severity,
+               "state": "firing" if self.firing else "ok",
+               "window": self.window_desc(),
+               "since": self.since, "reason": self.reason,
+               "fired_count": self.fired_count}
+        if self.tenant:
+            out["tenant"] = self.tenant
+        return out
 
     def to_dict(self) -> dict:
         return dict(self.spec)
@@ -483,16 +597,49 @@ def resolve_ledger_baseline(case: str, metric: str = "value", *,
         return None
 
 
+def active_tenants(sample: Dict[str, float]) -> List[str]:
+    """Tenant names present in a sample's ``tenant:<name>:<metric>``
+    lanes, sorted (deterministic expansion order), ``other`` excluded
+    — the overflow aggregate can never name a culprit."""
+    seen = set()
+    for key in sample:
+        m = _TENANT_KEY_RE.match(key)
+        if m is not None and m.group("tenant") != TENANT_OTHER:
+            seen.add(m.group("tenant"))
+    return sorted(seen)
+
+
+def expand_tenant_rule(spec: dict, tenant: str) -> dict:
+    """One concrete rule spec from a ``per_tenant`` template:
+    ``{tenant}`` substituted into the metric/counter names, the rule
+    renamed ``template[tenant]`` and pinned to the tenant."""
+    out = {k: v for k, v in spec.items() if k != "per_tenant"}
+    out["name"] = f"{spec.get('name')}[{tenant}]"
+    out["tenant"] = tenant
+    for key in ("metric", "good", "bad"):
+        v = out.get(key)
+        if isinstance(v, str) and "{tenant}" in v:
+            out[key] = v.replace("{tenant}", tenant)
+    return out
+
+
 class Watchtower:
     """A RuleSet plus the evaluation loop state: feed samples, get
     transitions; thread-safe (serving feeds from handler threads).
 
     ``clock`` is injected for determinism and only consulted when a
     caller omits ``t`` — tests and the trace-replay path always pass
-    explicit timestamps, so firings replay bit-identically."""
+    explicit timestamps, so firings replay bit-identically.
+
+    ``per_tenant`` template rules are expanded lazily against the
+    tenants each sample shows as active, at most ``tenant_cap``
+    concrete rules per template (first-seen wins once the cap is
+    reached; an expanded rule persists for the watch's lifetime so a
+    briefly-idle tenant keeps its alert history)."""
 
     def __init__(self, rules, *,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 tenant_cap: int = TENANT_FAN_OUT_CAP):
         if isinstance(rules, RuleSet):
             self.ruleset = rules
         else:
@@ -501,6 +648,40 @@ class Watchtower:
         self._lock = threading.Lock()
         self._worst_fired: Optional[str] = None
         self.transitions_total = 0
+        self.tenant_cap = max(1, int(tenant_cap))
+        # template name -> {tenant -> concrete Rule}
+        self._tenant_rules: Dict[str, Dict[str, Rule]] = {
+            r.name: {} for r in self.ruleset if r.per_tenant}
+
+    def _expand(self, sample: Dict[str, float]) -> None:
+        """Lock held. Materialize concrete rules for newly-active
+        tenants, within the per-template cap."""
+        tenants = None
+        for template in self.ruleset:
+            if not template.per_tenant:
+                continue
+            if tenants is None:
+                tenants = active_tenants(sample)
+                if not tenants:
+                    return
+            expanded = self._tenant_rules[template.name]
+            for ten in tenants:
+                if ten in expanded:
+                    continue
+                if len(expanded) >= self.tenant_cap:
+                    break
+                expanded[ten] = Rule(
+                    expand_tenant_rule(template.spec, ten))
+
+    def _live_rules(self) -> List[Rule]:
+        """Lock held. Evaluation order: concrete base rules, then the
+        expansions of each template (templates themselves never see a
+        sample — their metric names still hold the placeholder)."""
+        out = [r for r in self.ruleset if not r.per_tenant]
+        for template in self.ruleset:
+            if template.per_tenant:
+                out.extend(self._tenant_rules[template.name].values())
+        return out
 
     def observe(self, sample: Dict[str, float],
                 t: Optional[float] = None) -> List[dict]:
@@ -514,7 +695,8 @@ class Watchtower:
                 t = self._clock()
         out: List[dict] = []
         with self._lock:
-            for rule in self.ruleset:
+            self._expand(sample)
+            for rule in self._live_rules():
                 tr = rule.observe(float(t), sample)
                 if tr is not None:
                     out.append(tr)
@@ -526,7 +708,7 @@ class Watchtower:
 
     def states(self) -> List[dict]:
         with self._lock:
-            return [r.state() for r in self.ruleset]
+            return [r.state() for r in self._live_rules()]
 
     def firing(self) -> List[dict]:
         return [s for s in self.states() if s["state"] == "firing"]
@@ -556,9 +738,13 @@ class Watchtower:
 def default_serving_rules() -> List[dict]:
     """The serving SLO rules every ServingServer watches out of the
     box: a paging multi-window burn-rate alert on availability (504
-    deadline misses burning the 99.9% objective's budget) and a
-    warning on sustained queue saturation (the shed ladder's territory
-    — serving/budget.py)."""
+    deadline misses burning the 99.9% objective's budget), a warning
+    on sustained queue saturation (the shed ladder's territory —
+    serving/budget.py), and two per-tenant templates — an
+    availability burn scoped to one tenant's traffic and the
+    ``fair_share`` noisy-neighbour warn — expanded over whatever
+    tenants the live sample shows (docs/OBSERVABILITY.md "Per-tenant
+    attribution")."""
     return [
         {"name": "availability-burn", "kind": "burn_rate",
          "severity": "page",
@@ -570,6 +756,17 @@ def default_serving_rules() -> List[dict]:
          "severity": "warn",
          "metric": "queue_fill", "above": 0.8,
          "for_s": 5.0, "clear_after_s": 10.0},
+        {"name": "tenant-availability-burn", "kind": "burn_rate",
+         "severity": "warn", "per_tenant": True,
+         "good": "tenant:{tenant}:requests",
+         "bad": "tenant:{tenant}:deadline_504",
+         "objective": 0.999,
+         "fast_window_s": 60.0, "slow_window_s": 600.0,
+         "threshold": 14.4, "clear_after_s": 60.0},
+        {"name": "tenant-fair-share", "kind": "fair_share",
+         "severity": "warn", "per_tenant": True,
+         "window_s": 60.0, "share_above": 0.5, "min_tenants": 2,
+         "for_s": 5.0, "clear_after_s": 30.0},
     ]
 
 
@@ -697,6 +894,16 @@ def sample_from_metricsz_json(obj: dict) -> Dict[str, float]:
                 st.get("queue_depth_rows"), (int, float)):
             depth += float(st["queue_depth_rows"])
     out["queue_depth"] = depth
+    # per-tenant lanes (serving metrics() "tenants.per_tenant") —
+    # the vocabulary the per_tenant rule templates reference
+    per_tenant = (obj.get("tenants") or {}).get("per_tenant") or {}
+    if isinstance(per_tenant, dict):
+        for ten, st in per_tenant.items():
+            if not isinstance(st, dict):
+                continue
+            for key, v in st.items():
+                if isinstance(v, (int, float)):
+                    out[f"tenant:{ten}:{key}"] = float(v)
     return out
 
 

@@ -20,7 +20,7 @@ itself (docs/OBSERVABILITY.md "Spans"):
   child into the root's interval and force-ending still-open stages
   at the root end, so a request that died waiting (504) shows WHERE
   it was waiting instead of losing the span — and the server emits
-  the spans as schema-v3 ``span`` records into the serving trace
+  the spans as ``span`` records (schema v3+) into the serving trace
   (observability/record.RunTrace.span).
 
 Everything here is stdlib (perf_counter + a lock): recording a span is
@@ -76,7 +76,7 @@ class RequestSpans:
     Span back to ``end`` instead of the name."""
 
     __slots__ = ("trace_id", "_lock", "_spans", "_by_name", "_next_id",
-                 "finished")
+                 "finished", "tenant", "model")
 
     def __init__(self, trace_id, first_stage: Optional[str] = None):
         self.trace_id = trace_id
@@ -85,6 +85,14 @@ class RequestSpans:
         self._spans: List[Span] = []
         self._by_name: Dict[str, Span] = {}
         self.finished = False
+        # Tenant/model identity (schema v4, docs/OBSERVABILITY.md
+        # "Per-tenant attribution"): set by the HTTP layer right after
+        # it parses the request body, read by every downstream stage
+        # (the pool stamps them on replica_compute spans) and merged
+        # into the root's extras at finish — the tree IS the carrier,
+        # so no pipeline signature needs a tenant parameter.
+        self.tenant: Optional[str] = None
+        self.model: Optional[str] = None
         root = self._open(ROOT, parent_id=None, extra={})
         if first_stage:
             # first stage opens at the root's exact timestamp: a
@@ -173,6 +181,13 @@ class RequestSpans:
             root.end = now
             if extra:
                 root.extra.update(extra)
+            # tenant/model land on the ROOT span (schema v4) on every
+            # exit path — 200s and the handler's error back-stop alike
+            # — so attribution never depends on how the request died.
+            if self.tenant is not None:
+                root.extra.setdefault("tenant", self.tenant)
+            if self.model is not None:
+                root.extra.setdefault("model", self.model)
             child_sum = 0.0
             clamped = {root.span_id: root}
             for sp in self._spans[1:]:
@@ -214,7 +229,7 @@ class RequestSpans:
             return out
 
     def emit_into(self, trace) -> int:
-        """Write every span as a schema-v3 record into ``trace`` (an
+        """Write every span as a schema span record into ``trace`` (an
         observability/record.RunTrace). Returns records written. The
         caller finishes first; an unfinished tree emits nothing (a
         half-built tree would violate the schema it is supposed to

@@ -254,9 +254,49 @@ def _data_probe(path: str, out: Callable[[str], None]
     return True, 0
 
 
+def _serving_tenant_probe(url: str, out: Callable[[str], None]) -> None:
+    """Reporting-only probe of a live serve process's tenant label
+    budget (docs/OBSERVABILITY.md "Per-tenant attribution"): live
+    series vs budget, evictions, overflow folded into 'other' — with a
+    WARNING near saturation (>= 80% of budget), since a saturated
+    budget means NEW tenants stop getting their own cost rows. Never
+    changes the doctor verdict: a down server is not a broken mesh."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    full = url.rstrip("/")
+    if not full.endswith("/metricsz"):
+        full += "/metricsz"
+    try:
+        with urllib.request.urlopen(full, timeout=10) as r:
+            obj = json.loads(r.read())
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+        out(f"serving: UNREACHABLE ({e}) — reporting only, not a "
+            "doctor failure")
+        return
+    tn = obj.get("tenants") if isinstance(obj, dict) else None
+    if not isinstance(tn, dict):
+        out("serving: no tenant block in /metricsz (pre-attribution "
+            "server, or not a `dpsvm serve` endpoint)")
+        return
+    budget = int(tn.get("budget") or 0)
+    live = int(tn.get("live") or 0)
+    out(f"serving: tenant labels: {live}/{budget} budget slots live, "
+        f"{int(tn.get('evictions') or 0)} evictions, "
+        f"{int(tn.get('overflow') or 0)} requests folded into "
+        "'other'")
+    if budget and live >= 0.8 * budget:
+        out(f"serving: WARNING tenant label budget near saturation "
+            f"({live}/{budget} live) — new tenants will fold into "
+            "'other'; raise `serve --tenant-budget` if per-tenant "
+            "attribution matters for the tail")
+
+
 def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
                data_path: Optional[str] = None,
                timeout_s: float = 60.0,
+               serving_url: Optional[str] = None,
                out: Callable[[str], None] = print) -> int:
     """The full preflight; returns the process exit code (0 = sane).
     Prints its findings through ``out`` and always ends with one
@@ -322,6 +362,8 @@ def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
         data_ok, code = _data_probe(data_path, out)
         if not data_ok:
             return code
+    if serving_url:
+        _serving_tenant_probe(serving_url, out)
     out(f"DOCTOR OK: {p}-shard mesh sane"
         + (", checkpoint path healthy" if checkpoint_path else "")
         + (", shard data healthy" if data_path else ""))

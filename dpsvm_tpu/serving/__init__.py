@@ -73,7 +73,7 @@ __all__ = [
     "ReplicaPool", "PoolUnavailableError", "DriftDetector",
     "LifecycleLoop", "RetrainResult", "ServingServer", "bucket_ladder",
     "compact_model", "loadgen_row", "run_loadgen", "run_saturate",
-    "selfcheck", "main",
+    "selfcheck", "tenant_isolation_drill", "main",
 ]
 
 _LAZY = {
@@ -291,6 +291,134 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
     return problems
 
 
+def tenant_isolation_drill(tmp_dir: Optional[str] = None,
+                           trace_path: Optional[str] = None) -> dict:
+    """The end-to-end noisy-neighbour drill (docs/OBSERVABILITY.md
+    "Per-tenant attribution"): serve a multi-model registry, drive a
+    skewed 8-tenant mix (t0 sends 80%), and prove the per-tenant
+    observability chain identifies the hog — the ``tenant-fair-share``
+    rule fires naming t0, the incident bundle's incident.json carries
+    the tenant, and the cold tenants' p99 stays measurable on its own
+    lane. Returns ONE JSON-able row (``metric: tenant_isolation``,
+    headline = the cold tenants' p99 ms); ``ok`` is the verdict the
+    burst runner and selfcheck gate on."""
+    import json
+    import os
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import SVMModel
+    from dpsvm_tpu.serving.loadgen import run_loadgen
+    from dpsvm_tpu.serving.registry import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    ctx = (tempfile.TemporaryDirectory() if tmp_dir is None else None)
+    base = tmp_dir if tmp_dir is not None else ctx.name
+    ext_trace = trace_path is not None
+    row: dict = {"metric": "tenant_isolation", "unit": "ms",
+                 "tenants": 8, "hot_tenant_skew": 0.8, "ok": False}
+    try:
+        rng = np.random.default_rng(11)
+        n_sv, d = 32, 5
+        model = SVMModel(
+            x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+            alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+            y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(
+                np.int32),
+            b=0.1, gamma=0.4)
+        path = os.path.join(base, "drill.svm")
+        save_model(model, path)
+        if trace_path is None:
+            # the v4 trace is part of the drill's evidence (span roots
+            # carry the tenant) — always write one somewhere
+            trace_path = os.path.join(base, "tenant_drill.jsonl")
+        registry = ModelRegistry()
+        registry.register("default", path, max_batch=32)
+        registry.register("aux", path, max_batch=16)
+
+        # tight per-tenant rules so the drill converges in seconds:
+        # same shapes as default_serving_rules(), drill-speed windows
+        rules = [
+            {"name": "tenant-fair-share", "kind": "fair_share",
+             "severity": "warn", "per_tenant": True, "window_s": 1.0,
+             "share_above": 0.5, "min_tenants": 2, "for_s": 0.0,
+             "clear_after_s": 10.0},
+            {"name": "tenant-availability-burn", "kind": "burn_rate",
+             "severity": "warn", "per_tenant": True,
+             "good": "tenant:{tenant}:requests",
+             "bad": "tenant:{tenant}:deadline_504",
+             "objective": 0.999, "fast_window_s": 5.0,
+             "slow_window_s": 30.0, "threshold": 14.4,
+             "clear_after_s": 10.0},
+        ]
+        bundle_dir = os.path.join(base, "bundles")
+        srv = ServingServer(
+            registry, "127.0.0.1", 0, max_batch=32, max_delay_ms=0.5,
+            trace_out=trace_path, trace_sample_rate=1.0,
+            watch_rules=rules, bundle_dir=bundle_dir,
+            tenant_budget=16).start()
+        url = f"http://127.0.0.1:{srv.port}"
+        rows = rng.standard_normal((64, d)).astype(np.float32)
+        fired = False
+        last = None
+        errors = 0
+        n_requests = 0
+        try:
+            give_up = _time.perf_counter() + 30.0
+            while _time.perf_counter() < give_up:
+                last = run_loadgen(
+                    url, rows, model="default", requests=96, batch=1,
+                    concurrency=8, mode="closed", want=("labels",),
+                    timeout=10.0, spans=True, tenants=8,
+                    hot_tenant_skew=0.8)
+                errors += int(last.get("errors", 0))
+                n_requests += int(last.get("requests", 0))
+                fired = any(
+                    s["state"] == "firing"
+                    and s["rule"] == "tenant-fair-share[t0]"
+                    and s.get("tenant") == "t0"
+                    for s in srv.watch.states())
+                if fired:
+                    break
+            m = srv.metrics()
+        finally:
+            srv.drain(timeout=10.0)
+        row["fair_share_fired"] = fired
+        row["requests"] = n_requests
+        row["errors"] = errors
+        per = (m.get("tenants") or {}).get("per_tenant") or {}
+        hottest = max(per, key=lambda t: per[t]["requests"],
+                      default=None)
+        row["hot_tenant"] = hottest
+        if last is not None:
+            row["hot_p99_ms"] = last.get("hot_p99_ms")
+            row["others_p99_ms"] = last.get("others_p99_ms")
+            row["value"] = last.get("others_p99_ms")
+        # the incident bundle must NAME the culprit tenant
+        incident_tenant = None
+        for ent in sorted(os.listdir(bundle_dir)
+                          if os.path.isdir(bundle_dir) else []):
+            inc = os.path.join(bundle_dir, ent, "incident.json")
+            if os.path.exists(inc):
+                with open(inc) as fh:
+                    doc = json.load(fh)
+                if doc.get("rule") == "tenant-fair-share[t0]":
+                    incident_tenant = doc.get("tenant")
+        row["incident_tenant"] = incident_tenant
+        if ext_trace:
+            row["trace"] = trace_path
+        row["ok"] = bool(fired and hottest == "t0"
+                         and incident_tenant == "t0" and errors == 0
+                         and row.get("value") is not None)
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+    return row
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import os
@@ -310,10 +438,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "accuracy; prints ONE JSON row "
                         "(live_refresh_latency) and exits 0 iff it "
                         "recovered eject-free")
+    p.add_argument("--tenant-drill", action="store_true",
+                   help="run the end-to-end noisy-neighbour drill "
+                        "(docs/OBSERVABILITY.md 'Per-tenant "
+                        "attribution'): serve a multi-model registry, "
+                        "drive an 8-tenant mix with t0 sending 80%%, "
+                        "and prove the fair-share rule + incident "
+                        "bundle name the hog while the cold tenants' "
+                        "p99 stays on its own lane; prints ONE JSON "
+                        "row (tenant_isolation) and exits 0 iff the "
+                        "culprit was identified")
     args = p.parse_args(argv)
-    if not (args.selfcheck or args.live_drill):
+    if not (args.selfcheck or args.live_drill or args.tenant_drill):
         p.print_help()
         return 2
+    if args.tenant_drill:
+        import json
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        trace_env = os.environ.get("BENCH_TRACE_OUT")
+        row = tenant_isolation_drill(trace_path=trace_env or None)
+        print(json.dumps(row))
+        return 0 if row.get("ok") else 1
     if args.live_drill:
         import json
         import tempfile

@@ -48,15 +48,21 @@ class Budget:
     absolute so it can be handed across threads (ticket -> batcher
     worker -> pool dispatch) without re-anchoring."""
 
-    __slots__ = ("t0", "deadline", "total_s")
+    __slots__ = ("t0", "deadline", "total_s", "tenant")
 
     def __init__(self, total_s: float,
-                 t0: Optional[float] = None):
+                 t0: Optional[float] = None,
+                 tenant: Optional[str] = None):
         if not (total_s > 0):
             raise ValueError(f"budget must be > 0 s, got {total_s}")
         self.t0 = time.perf_counter() if t0 is None else float(t0)
         self.total_s = float(total_s)
         self.deadline = self.t0 + self.total_s
+        # Who this deadline is spent for (docs/OBSERVABILITY.md
+        # "Per-tenant attribution"): carried with the deadline across
+        # threads so the 504 accounting downstream of the ticket wait
+        # can bill the right tenant without re-deriving identity.
+        self.tenant = tenant
 
     def remaining(self) -> float:
         """Seconds left (>= 0)."""
@@ -81,9 +87,12 @@ class Budget:
         how much was left when described — a 504's root span says not
         just THAT the budget blew but how deep in it the request
         died."""
-        return {"deadline_ms": round(self.total_s * 1000.0, 3),
-                "deadline_remaining_ms": round(
-                    self.remaining() * 1000.0, 3)}
+        out = {"deadline_ms": round(self.total_s * 1000.0, 3),
+               "deadline_remaining_ms": round(
+                   self.remaining() * 1000.0, 3)}
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        return out
 
     def __repr__(self) -> str:
         return (f"Budget(total={self.total_s:.3g}s, "

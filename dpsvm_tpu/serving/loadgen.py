@@ -78,11 +78,36 @@ class _Conn(http.client.HTTPConnection):
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
 
+def tenant_of(i: int, tenants: int, skew: float) -> Optional[str]:
+    """Deterministic tenant assignment for request index ``i`` (the
+    multi-tenant traffic mix ``dpsvm loadgen --tenants`` sends).
+
+    With ``skew`` S in (0, 1], tenant ``t0`` is the planted hot tenant
+    and receives fraction S of the requests via the same cumulative-
+    quota stride the span sampler uses (observability/spans
+    .should_sample — evenly interleaved, no RNG, replayable); the
+    remainder round-robins over ``t1..t{N-1}``. skew=0 round-robins
+    over all N. ``tenants=0`` disables the mix (None: no ``tenant``
+    field — the server falls back to per-model attribution)."""
+    if tenants < 1:
+        return None
+    if tenants == 1:
+        return "t0"
+    s = min(max(float(skew), 0.0), 1.0)
+    if s > 0.0 and int((i + 1) * s) > int(i * s):
+        return "t0"
+    cold = tenants - 1 if s > 0.0 else tenants
+    first = 1 if s > 0.0 else 0
+    return f"t{first + i % cold}"
+
+
 def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                 requests: int = 200, batch: int = 1,
                 concurrency: int = 8, mode: str = "closed",
                 rps: float = 100.0, want: Sequence[str] = ("labels",),
-                timeout: float = 30.0, spans: bool = False) -> dict:
+                timeout: float = 30.0, spans: bool = False,
+                tenants: int = 0,
+                hot_tenant_skew: float = 0.0) -> dict:
     """Fire ``requests`` requests of ``batch`` rows each; return the
     result row (throughput + latency percentiles + error count).
 
@@ -92,28 +117,44 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     stage percentiles into the row: ``queue_wait_p99_ms`` /
     ``compute_p99_ms`` + the full ``span_p99_ms`` table, so a
     saturate-knee row says WHICH stage hit the knee instead of just
-    that p99 did (docs/OBSERVABILITY.md "Spans")."""
+    that p99 did (docs/OBSERVABILITY.md "Spans").
+
+    ``tenants=N`` spreads the requests over N tenant labels (body
+    ``tenant`` field; ``tenant_of`` above), ``hot_tenant_skew=S``
+    concentrates fraction S on the planted hot tenant ``t0`` — the
+    tenant-isolation drill. The row then carries per-tenant request/
+    latency sub-rows plus ``hot_p99_ms`` / ``others_p99_ms``, so "one
+    noisy tenant did not ruin its neighbours' p99" is a printed fact
+    (docs/OBSERVABILITY.md "Per-tenant attribution")."""
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
     if requests < 1 or batch < 1 or concurrency < 1:
         raise ValueError("requests, batch and concurrency must be >= 1")
+    if tenants < 0:
+        raise ValueError(f"tenants must be >= 0, got {tenants}")
     rows = np.asarray(rows, np.float32)
     host, port = _host_port(url)
     # Pre-serialize every request body: the generator must measure the
     # server, not its own json.dumps.
     n_rows = rows.shape[0]
     bodies: List[bytes] = []
+    tenant_by_idx: List[Optional[str]] = []
     for i in range(requests):
         take = [(i * batch + j) % n_rows for j in range(batch)]
-        bodies.append(json.dumps({
-            "model": model, "return": list(want),
-            "instances": rows[take].tolist()}).encode())
+        body = {"model": model, "return": list(want),
+                "instances": rows[take].tolist()}
+        ten = tenant_of(i, tenants, hot_tenant_skew)
+        tenant_by_idx.append(ten)
+        if ten is not None:
+            body["tenant"] = ten
+        bodies.append(json.dumps(body).encode())
 
     next_idx = [0]
     idx_lock = threading.Lock()
     lat_ms: List[float] = []
     statuses: List[int] = []
     stage_ms: dict = {}            # stage name -> [ms, ...] (spans=True)
+    by_tenant: dict = {}           # tenant -> {"ms": [...], "errors": n}
     out_lock = threading.Lock()
     t_start = [0.0]
     headers = {"Content-Type": "application/json"}
@@ -159,6 +200,13 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                 with out_lock:
                     lat_ms.append(ms)
                     statuses.append(status)
+                    ten = tenant_by_idx[i]
+                    if ten is not None:
+                        acc = by_tenant.setdefault(
+                            ten, {"ms": [], "errors": 0})
+                        acc["ms"].append(ms)
+                        if status != 200:
+                            acc["errors"] += 1
                     if isinstance(breakdown, dict):
                         for k, v in breakdown.items():
                             if isinstance(v, (int, float)):
@@ -209,6 +257,34 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
             "compute_p99_ms": table.get(
                 "device_dispatch", {}).get("p99_ms"),
         }
+    tenant_row: dict = {}
+    if tenants >= 1:
+        per_tenant = {}
+        others: List[float] = []
+        for ten, acc in sorted(by_tenant.items()):
+            tl = np.asarray(acc["ms"], np.float64)
+            tp50, tp99 = (np.percentile(tl, [50.0, 99.0])
+                          if tl.size else (float("nan"),) * 2)
+            per_tenant[ten] = {
+                "requests": int(tl.size),
+                "errors": int(acc["errors"]),
+                "p50_ms": round(float(tp50), 3),
+                "p99_ms": round(float(tp99), 3)}
+            if ten != "t0":
+                others.extend(acc["ms"])
+        tenant_row = {
+            "tenants": int(tenants),
+            "hot_tenant_skew": round(float(hot_tenant_skew), 4),
+            "tenant_rows": per_tenant,
+        }
+        if hot_tenant_skew > 0.0 and tenants > 1:
+            hot = per_tenant.get("t0") or {}
+            op99 = (np.percentile(np.asarray(others, np.float64),
+                                  99.0)
+                    if others else float("nan"))
+            tenant_row["hot_tenant"] = "t0"
+            tenant_row["hot_p99_ms"] = hot.get("p99_ms")
+            tenant_row["others_p99_ms"] = round(float(op99), 3)
     return {
         "mode": mode,
         "requests": requests,
@@ -228,6 +304,7 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                              if accepted else None),
         **({"target_rps": rps} if mode == "open" else {}),
         **span_row,
+        **tenant_row,
     }
 
 
@@ -325,7 +402,8 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                 rps: float = 100.0, want: Sequence[str] = ("labels",),
                 timeout: float = 30.0, chaos: bool = False,
                 compare_sequential: bool = True,
-                trace: Optional[str] = None) -> dict:
+                trace: Optional[str] = None, tenants: int = 0,
+                hot_tenant_skew: float = 0.0) -> dict:
     """The one-line result row ``dpsvm loadgen`` prints: the main
     measurement, plus (by default) the batch-1 single-worker sequential
     baseline and the coalescing speedup over it.
@@ -346,7 +424,8 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
     main = run_loadgen(url, rows, model=model, requests=requests,
                        batch=batch, concurrency=concurrency, mode=mode,
                        rps=rps, want=want, timeout=timeout,
-                       spans=trace is not None)
+                       spans=trace is not None, tenants=tenants,
+                       hot_tenant_skew=hot_tenant_skew)
     row = {
         "metric": "serving_examples_per_sec",
         "value": main["examples_per_sec"],

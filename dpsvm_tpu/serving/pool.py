@@ -431,11 +431,20 @@ class ReplicaPool:
         comp_spans = []
         for ctx in d.spans:
             try:
+                # (model, tenant) on the compute span (schema v4): the
+                # identity rides the request's span context, so
+                # per-tenant device-compute cost is pure host-side
+                # span math — zero extra device transfers.
+                ident = {"model": self.name}
+                tenant = getattr(ctx, "tenant", None)
+                if tenant is not None:
+                    ident["tenant"] = tenant
                 comp_spans.append(
                     (ctx, ctx.start("replica_compute",
                                     parent="device_dispatch",
                                     replica=replica.idx,
-                                    generation=replica.generation)))
+                                    generation=replica.generation,
+                                    **ident)))
             except Exception:
                 pass
         try:

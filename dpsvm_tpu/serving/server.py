@@ -10,7 +10,11 @@ Endpoints (docs/SERVING.md):
   (load balancers stop routing before the listener closes).
 * ``GET /metricsz``     — request/error/reject counters, per-model
   batch-row and bucket histograms, queue depths, p50/p95/p99 request
-  latency over a sliding window.
+  latency over a sliding window, plus the per-tenant cost ledger
+  (``tenants``) and per-model request/latency view (``per_model``).
+  Requests carry a tenant label (``X-Tenant`` header / ``tenant``
+  body field, defaulting to the model name) and every response bills
+  it — docs/OBSERVABILITY.md "Per-tenant attribution".
 * ``GET /v1/models``    — registry manifests (shape, SV counts,
   compaction, warmup-compile receipt, generation).
 * ``POST /v1/reload``   — ``{"model": name}``: explicit hot reload via
@@ -42,7 +46,7 @@ Observability: with ``--trace-out`` + ``--trace-sample-rate`` each
 sampled request threads a span tree through the stack (admission ->
 queue wait -> batch formation -> device dispatch -> respond, with
 replica-compute and hedge markers below the dispatch) and the tree is
-emitted into the serving trace as schema-v3 ``span`` records at
+emitted into the serving trace as schema ``span`` records at
 request completion — the per-request "where did the time go" that
 aggregate /metricsz percentiles cannot answer
 (docs/OBSERVABILITY.md "Spans"; observability/spans.py).
@@ -73,9 +77,13 @@ import numpy as np
 
 from dpsvm_tpu.observability import blackbox, slo
 from dpsvm_tpu.observability.metrics import (DEFAULT_LATENCY_BUCKETS_MS,
+                                             DEFAULT_TENANT_BUDGET,
                                              PROMETHEUS_CONTENT_TYPE,
+                                             TENANT_OTHER,
                                              MetricsRegistry,
+                                             TenantLabelBudget,
                                              incidents_counter,
+                                             sanitize_tenant,
                                              wants_prometheus)
 from dpsvm_tpu.observability.spans import RequestSpans, should_sample
 from dpsvm_tpu.serving.batcher import (KNOWN_OUTPUTS, BatcherClosedError,
@@ -89,6 +97,20 @@ from dpsvm_tpu.serving.registry import ModelRegistry
 
 #: request bodies above this are rejected (413) before parsing.
 MAX_BODY_BYTES = 64 * 1024 * 1024
+
+#: response-class counters that also bill the request's tenant
+#: (docs/OBSERVABILITY.md "Per-tenant attribution"); the shed counters
+#: stay fleet-wide — a shed decision belongs to queue pressure, not to
+#: the request that happened to trip it.
+_TENANT_COUNT_KEYS = ("requests", "errors", "rejected", "deadline_504")
+
+
+def _new_tenant_acc() -> Dict[str, float]:
+    """One tenant's host-side cost ledger row (exact values for the
+    JSON /metricsz; the Prometheus families mirror these)."""
+    return {"requests": 0.0, "errors": 0.0, "rejected": 0.0,
+            "deadline_504": 0.0, "rows": 0.0, "wall_ms": 0.0,
+            "queue_wait_ms": 0.0, "compute_ms": 0.0}
 
 
 def _jsonable(v):
@@ -250,26 +272,38 @@ class _Handler(BaseHTTPRequestHandler):
             owner.count("errors")
             return
         name = body.get("model", "default")
+        # Tenant identity, fixed at admission (docs/OBSERVABILITY.md
+        # "Per-tenant attribution"): X-Tenant header beats the body's
+        # `tenant` field beats the model name. Hostile values are
+        # sanitized and the label budget may resolve a long-tail
+        # tenant to the `other` aggregate; the span tree carries the
+        # resolved label downstream, so no pipeline signature changes
+        # and no extra device transfers.
+        tenant = owner.admit_tenant(self.headers.get("X-Tenant"),
+                                    body.get("tenant"), name)
+        if rs is not None:
+            rs.tenant = tenant
+            rs.model = name
         want = tuple(body.get("return") or ("labels", "decision"))
         inst = body.get("instances")
         try:
             engine = owner.registry.engine(name)
         except KeyError as e:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(404, {"error": str(e)})
             return
         if inst is None:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": "missing 'instances'"})
             return
         try:
             x = np.asarray(inst, dtype=np.float32)
         except (ValueError, TypeError) as e:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": f"instances not numeric: {e}"})
             return
         if not np.all(np.isfinite(x)):
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": "instances contain non-finite "
                                       "values"})
             return
@@ -281,13 +315,13 @@ class _Handler(BaseHTTPRequestHandler):
             x = x[None, :]
         d = engine.num_attributes
         if x.ndim != 2 or x.shape[0] == 0 or x.shape[1] != d:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": f"instances must be a non-empty "
                                       f"(m, {d}) matrix, got shape "
                                       f"{list(x.shape)}"})
             return
         if x.shape[0] > self.server.owner.max_queue:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(413, {"error": f"{x.shape[0]} rows in one "
                                       f"request exceeds the queue bound "
                                       f"({owner.max_queue}); split the "
@@ -296,7 +330,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         bad = [w for w in want if w not in KNOWN_OUTPUTS]
         if bad:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": f"unknown outputs {bad}; pick "
                                       f"from {list(KNOWN_OUTPUTS)}"})
             return
@@ -305,9 +339,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             budget = owner.budget_for(
                 body.get("timeout_ms",
-                         self.headers.get("X-Deadline-Ms")))
+                         self.headers.get("X-Deadline-Ms")),
+                tenant=tenant)
         except ValueError as e:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": str(e)})
             return
         # Degradation ladder: shed the optional expensive output, then
@@ -336,29 +371,29 @@ class _Handler(BaseHTTPRequestHandler):
                 x, ride, deadline=budget.deadline, spans=rs)
             res = ticket.wait(budget.remaining())
         except QueueFullError as e:
-            owner.count("rejected")
+            owner.count("rejected", tenant=tenant)
             self._send(429, {"error": str(e)},
                        headers=(("Retry-After", "1"),))
             return
         except BatcherClosedError:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(503, {"error": "draining"})
             return
         except (DeadlineExceededError, TimeoutError) as e:
             # the satellite bugfix: a timeout is the SERVER's miss —
             # 504 + Retry-After, never the 400 family
-            owner.count("deadline_504")
+            owner.count("deadline_504", tenant=tenant)
             self._send(504, {"error": str(e)},
                        headers=(("Retry-After", "1"),))
             return
         except PoolUnavailableError as e:
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(503, {"error": str(e)},
                        headers=(("Retry-After", "1"),))
             return
         except ValueError as e:
             # bad width / unknown output / uncalibrated proba
-            owner.count("errors")
+            owner.count("errors", tenant=tenant)
             self._send(400, {"error": str(e)})
             return
         if rs is not None:
@@ -380,7 +415,11 @@ class _Handler(BaseHTTPRequestHandler):
             out["spans"] = breakdown
         ms = (time.perf_counter() - t0) * 1000.0
         owner.observe_latency(ms)
-        owner.count("requests")
+        # tenant/model accounting BEFORE the counted response, so the
+        # watch sample the count triggers sees this request's lanes
+        owner.account_request(tenant, name, rows=int(x.shape[0]),
+                              ms=ms, breakdown=breakdown)
+        owner.count("requests", tenant=tenant)
         out.update(model=name, n=int(x.shape[0]), ms=round(ms, 3))
         self._send(200, out)
 
@@ -403,6 +442,7 @@ class ServingServer:
                  metrics_registry: Optional[MetricsRegistry] = None,
                  watch_rules=None, bundle_dir: Optional[str] = None,
                  watch: bool = True,
+                 tenant_budget: int = DEFAULT_TENANT_BUDGET,
                  verbose: bool = False):
         self.registry = registry
         self.host = host
@@ -462,6 +502,35 @@ class ServingServer:
         self._c_spans = self.mreg.counter(
             "dpsvm_serving_spans_sampled_total",
             "requests that recorded a span tree").labels()
+        # Per-tenant cost attribution (docs/OBSERVABILITY.md
+        # "Per-tenant attribution"): an exact host-side ledger for the
+        # JSON /metricsz plus bounded-cardinality Prometheus families
+        # — at most ``tenant_budget`` live tenant label values, the
+        # long tail aggregated under ``other`` and LRU-evicted series
+        # removed from the exposition. Everything here is arithmetic
+        # on numbers the request path already produced: zero extra
+        # device->host transfers.
+        self.tenant_budget = TenantLabelBudget(
+            int(tenant_budget), on_evict=self._evict_tenant)
+        self._tenants: Dict[str, Dict[str, float]] = {}
+        self._per_model: Dict[str, dict] = {}
+        self._c_tenant = {
+            key: self.mreg.counter(f"dpsvm_tenant_{key}_total", help_,
+                                   labels=("tenant",))
+            for key, help_ in (
+                ("requests", "requests answered 200, per tenant"),
+                ("errors", "error responses, per tenant"),
+                ("rejected", "queue-full 429s, per tenant"),
+                ("deadline_504", "deadline budget blown (504), per "
+                                 "tenant"),
+                ("rows", "rows predicted, per tenant"),
+                ("queue_wait_ms", "queue-wait milliseconds from "
+                                  "sampled span trees, per tenant"),
+                ("compute_ms", "device-dispatch milliseconds from "
+                               "sampled span trees, per tenant"))}
+        # lazy, like _h_span: a histogram family with zero series
+        # renders a sample-less TYPE line the grammar rejects
+        self._h_tenant = None
         self._g_queue = self.mreg.gauge(
             "dpsvm_serving_queue_depth",
             "micro-batcher queue depth in rows", labels=("model",))
@@ -524,11 +593,100 @@ class ServingServer:
     def uptime(self) -> float:
         return time.monotonic() - self._t0
 
-    def count(self, key: str) -> None:
+    def count(self, key: str, tenant: Optional[str] = None) -> None:
         self._counters[key].inc()
+        if tenant is not None and key in _TENANT_COUNT_KEYS:
+            with self._lock:
+                acc = self._tenants.setdefault(tenant,
+                                               _new_tenant_acc())
+                acc[key] += 1.0
+            # re-resolve labels() every increment: an LRU eviction may
+            # have removed this tenant's series, and a stale child
+            # handle would update an orphan (metrics._Metric.remove)
+            self._c_tenant[key].labels(tenant=tenant).inc()
         # every counted terminal response is one watch sample: the
         # rules see the burn as it happens, not at the next scrape
         self._watch_note()
+
+    # -- per-tenant attribution ---------------------------------------
+
+    def admit_tenant(self, header_val, body_val,
+                     model_name: str) -> str:
+        """Resolve one request's tenant label at admission:
+        ``X-Tenant`` header, else the body's ``tenant`` field, else
+        the model name (single-tenant deployments get per-model
+        attribution for free). Hostile values are sanitized
+        (metrics.sanitize_tenant) and the label budget may resolve a
+        long-tail tenant to ``other``."""
+        raw = sanitize_tenant(header_val)
+        if raw is None:
+            raw = sanitize_tenant(body_val)
+        if raw is None:
+            raw = sanitize_tenant(model_name) or "default"
+        return self.tenant_budget.resolve(raw)
+
+    def account_request(self, tenant: str, model: str, *, rows: int,
+                        ms: float, breakdown: Optional[dict] = None
+                        ) -> None:
+        """Bill one answered request: rows + wall to the tenant and
+        the model; queue-wait and device-compute ms when the request
+        recorded a span tree (the sampled-spans caveat the docs pin —
+        stage lanes cover the sampled fraction, wall covers all)."""
+        qw = comp = 0.0
+        if breakdown:
+            qw = float(breakdown.get("queue_wait") or 0.0)
+            comp = float(breakdown.get("device_dispatch") or 0.0)
+        with self._lock:
+            acc = self._tenants.setdefault(tenant, _new_tenant_acc())
+            acc["rows"] += rows
+            acc["wall_ms"] += ms
+            acc["queue_wait_ms"] += qw
+            acc["compute_ms"] += comp
+            pm = self._per_model.setdefault(
+                model, {"requests": 0, "lat": deque(maxlen=2048)})
+            pm["requests"] += 1
+            pm["lat"].append(ms)
+        self._c_tenant["rows"].labels(tenant=tenant).inc(rows)
+        if qw:
+            self._c_tenant["queue_wait_ms"].labels(
+                tenant=tenant).inc(qw)
+        if comp:
+            self._c_tenant["compute_ms"].labels(
+                tenant=tenant).inc(comp)
+        if self._h_tenant is None:
+            self._h_tenant = self.mreg.histogram(
+                "dpsvm_tenant_request_latency_ms",
+                "request wall latency per tenant",
+                labels=("tenant",),
+                buckets=DEFAULT_LATENCY_BUCKETS_MS)
+        self._h_tenant.labels(tenant=tenant).observe(ms)
+
+    def _evict_tenant(self, tenant: str) -> None:
+        """TenantLabelBudget eviction callback: the evicted tenant's
+        ledger row folds into ``other`` (totals survive — the tail is
+        aggregated, never dropped) and its Prometheus series leave the
+        exposition so live cardinality stays within budget. The
+        per-tenant histogram series is removed without folding
+        (bucketed observations cannot be re-attributed)."""
+        with self._lock:
+            acc = self._tenants.pop(tenant, None)
+            if acc is not None:
+                other = self._tenants.setdefault(TENANT_OTHER,
+                                                 _new_tenant_acc())
+                for k, v in acc.items():
+                    other[k] = other.get(k, 0.0) + v
+        for fam in self._c_tenant.values():
+            fam.remove(tenant=tenant)
+        if self._h_tenant is not None:
+            self._h_tenant.remove(tenant=tenant)
+        if acc is not None:
+            for key in ("requests", "errors", "rejected",
+                        "deadline_504", "rows", "queue_wait_ms",
+                        "compute_ms"):
+                v = acc.get(key, 0.0)
+                if v:
+                    self._c_tenant[key].labels(
+                        tenant=TENANT_OTHER).inc(v)
 
     # -- continuous watch ---------------------------------------------
 
@@ -540,10 +698,17 @@ class ServingServer:
                   for key, c in self._counters.items()}
         with self._lock:
             batchers = dict(self._batchers)
+            tenants = {t: dict(a) for t, a in self._tenants.items()}
         depth = sum(b.queue_depth for b in batchers.values())
         sample["queue_depth"] = float(depth)
         sample["queue_fill"] = (depth / self.max_queue
                                 if self.max_queue else 0.0)
+        # per-tenant lanes — the vocabulary slo.py's per_tenant rule
+        # templates expand over (tenant:<name>:<metric>)
+        for ten, acc in tenants.items():
+            for k in ("requests", "deadline_504", "queue_wait_ms",
+                      "compute_ms"):
+                sample[f"tenant:{ten}:{k}"] = float(acc.get(k, 0.0))
         return sample
 
     def _watch_note(self) -> None:
@@ -560,30 +725,35 @@ class ServingServer:
         """One rule transition: events ring + serving trace + metrics,
         and on a firing, the incident bundle."""
         firing = tr["state"] == "firing"
+        # a per-tenant rule's transition names its tenant — ride it on
+        # the event/incident records so a bundle can name the culprit
+        ten = {"tenant": tr["tenant"]} if tr.get("tenant") else {}
         if self._g_alert is not None:
             self._g_alert.labels(rule=tr["rule"],
                                  severity=tr["severity"]).set(
                                      1 if firing else 0)
         self.emit_event("alert", rule=tr["rule"], window=tr["window"],
                         severity=tr["severity"], state=tr["state"],
-                        reason=tr["reason"])
+                        reason=tr["reason"], **ten)
         if not firing:
             return
         self._c_incidents.inc()
         self._flight.snapshot_metrics(self.mreg)
         if self.bundle_dir:
+            extra = {"source": "serving",
+                     "counters": {k: int(c.value) for k, c
+                                  in self._counters.items()}}
+            extra.update(ten)
             path = blackbox.dump_bundle(
                 self.bundle_dir, recorder=self._flight,
                 rule=tr["rule"], severity=tr["severity"],
                 window=tr["window"], reason=tr["reason"],
-                registry=self.mreg,
-                extra={"source": "serving",
-                       "counters": {k: int(c.value) for k, c
-                                    in self._counters.items()}})
+                registry=self.mreg, extra=extra)
             if path:
                 self.emit_event("incident", rule=tr["rule"],
                                 window=tr["window"],
-                                severity=tr["severity"], bundle=path)
+                                severity=tr["severity"], bundle=path,
+                                **ten)
 
     def observe_latency(self, ms: float) -> None:
         self._h_latency.observe(ms)      # the Prometheus histogram
@@ -629,12 +799,14 @@ class ServingServer:
 
     # -- resilience policy --------------------------------------------
 
-    def budget_for(self, raw) -> Budget:
+    def budget_for(self, raw, tenant: Optional[str] = None) -> Budget:
         """The request's deadline budget: ``timeout_ms`` (body) /
         ``X-Deadline-Ms`` (header), capped by the server-wide
-        ``predict_timeout``. Invalid values are a 400 (ValueError)."""
+        ``predict_timeout``. Invalid values are a 400 (ValueError).
+        ``tenant`` rides the budget across threads so deadline
+        accounting downstream bills the right tenant."""
         if raw is None:
-            return Budget(self.predict_timeout)
+            return Budget(self.predict_timeout, tenant=tenant)
         try:
             ms = float(raw)
         except (TypeError, ValueError):
@@ -642,7 +814,8 @@ class ServingServer:
         if not (math.isfinite(ms) and ms > 0):
             raise ValueError(f"timeout_ms must be finite and > 0, "
                              f"got {raw!r}")
-        return Budget(min(ms / 1000.0, self.predict_timeout))
+        return Budget(min(ms / 1000.0, self.predict_timeout),
+                      tenant=tenant)
 
     def set_sibling(self, name: str, sibling: str) -> None:
         """Register ``sibling`` as the tier-2 degradation target for
@@ -781,6 +954,12 @@ class ServingServer:
             batchers = dict(self._batchers)
             pools = dict(self._pools)
             events = list(self._events)
+            tenants_acc = {t: dict(a)
+                           for t, a in self._tenants.items()}
+            per_model_acc = {
+                m: {"requests": d["requests"],
+                    "lat": np.asarray(d["lat"], np.float64)}
+                for m, d in self._per_model.items()}
         out = dict(counters)
         out["uptime_s"] = round(self.uptime, 3)
         out["draining"] = self.draining
@@ -839,6 +1018,48 @@ class ServingServer:
             models[name] = st
         out.update(totals)
         out["models"] = models
+        # per-model request/latency view: registry models that have
+        # not served yet still appear, zeroed — a dashboard can tile
+        # the fleet without learning the model list elsewhere
+        per_model = {}
+        for name in self.registry.names():
+            d = per_model_acc.get(name)
+            lat_m = (d["lat"] if d is not None
+                     else np.asarray([], np.float64))
+            if lat_m.size:
+                p50, p95, p99 = np.percentile(lat_m,
+                                              [50.0, 95.0, 99.0])
+                lat_out = {"count": int(lat_m.size),
+                           "p50": round(float(p50), 3),
+                           "p95": round(float(p95), 3),
+                           "p99": round(float(p99), 3)}
+            else:
+                lat_out = {"count": 0, "p50": None, "p95": None,
+                           "p99": None}
+            st = models.get(name) or {}
+            per_model[name] = {
+                "requests": int(d["requests"]) if d is not None else 0,
+                "latency_ms": lat_out,
+                "queue_depth_rows": int(st.get("queue_depth_rows",
+                                               0))}
+        out["per_model"] = per_model
+        # per-tenant cost ledger + label-budget health — the JSON twin
+        # of the dpsvm_tenant_* Prometheus families (and the source
+        # slo.sample_from_metricsz_json flattens into tenant: lanes)
+        tb = self.tenant_budget.stats()
+        out["tenants"] = {
+            "budget": tb["budget"], "live": tb["live"],
+            "evictions": tb["evictions"], "overflow": tb["overflow"],
+            "per_tenant": {
+                ten: {"requests": int(a["requests"]),
+                      "errors": int(a["errors"]),
+                      "rejected": int(a["rejected"]),
+                      "deadline_504": int(a["deadline_504"]),
+                      "rows": int(a["rows"]),
+                      "wall_ms": round(a["wall_ms"], 3),
+                      "queue_wait_ms": round(a["queue_wait_ms"], 3),
+                      "compute_ms": round(a["compute_ms"], 3)}
+                for ten, a in sorted(tenants_acc.items())}}
         out["events"] = events[-64:]
         return out
 

@@ -218,7 +218,7 @@ def test_loadgen_under_full_sampling_meets_attribution_bar(
     srv.drain(timeout=15.0)
 
     records = load_trace(trace)             # validates v3 en route
-    assert records[0]["schema"] == 3
+    assert records[0]["schema"] == 4
     att = span_attribution(records)
     assert att["requests"] >= 60
     assert att["covered_90pct_frac"] >= 0.99, att
@@ -445,7 +445,7 @@ def test_cpu_trace_renders_honest_roofline_na(tmp_path, blobs_small):
                           max_iter=20_000, chunk_iters=64,
                           trace_out=path))
     records = load_trace(path)
-    assert records[0]["schema"] == 3
+    assert records[0]["schema"] == 4
     facts = trace_facts(records)
     assert facts["roofline_fraction"] is None
     assert facts["est_bytes"] is not None   # cost model works on CPU

@@ -405,7 +405,7 @@ def test_bundle_dump_validate_render_roundtrip(tmp_path):
     from dpsvm_tpu.observability.schema import read_trace
     records = read_trace(os.path.join(path, "trace.jsonl"))
     assert validate_trace(records) == []
-    assert records[0]["schema"] == 3
+    assert records[0]["schema"] == 4
     text = blackbox.render_bundle(path)
     assert "gap-stagnation" in text and "embedded trace" in text
     # parent-dir resolution picks the bundle
