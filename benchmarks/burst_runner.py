@@ -143,6 +143,22 @@ TAGS = [
     # so the run doubles as a "probes cost nothing on chip" check.
     sub("dist_fault_drill", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.resilience", "--selfcheck"]),
+    # Host-loss reformation drill (docs/DISTRIBUTED.md "Multi-host",
+    # resilience/hostgroup.py): three REAL single-device host
+    # processes train dist-smo over a cross-process mesh, one is
+    # SIGKILLed mid-run, and the group supervisor reforms the
+    # survivors from the newest intact checkpoint. The JSON row's
+    # headline is host_loss_recovery_s (loss detection -> every
+    # reformed host beating again; also a perf-ledger "robust" row,
+    # direction lower). NOTE for chip rounds (cf. BENCH_r03-r05
+    # tunnel behavior): the drill's hosts are localhost CPU processes
+    # by construction — on a tunneled single-TPU round this tag
+    # still measures the CPU recovery loop, not TPU reformation; a
+    # multi-host TPU slice is the only place the gloo/ICI distinction
+    # changes the number.
+    sub("host_loss_drill", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.resilience",
+         "--host-drill"]),
     # Streaming-ingest fault drill: the data selfcheck's convert ->
     # stream-train -> quarantine (injected corrupt shard + transient
     # read failure) -> bitwise-resume -> byte-identical-manifest loop

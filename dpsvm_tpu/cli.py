@@ -120,6 +120,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "explicit flags always win either way)")
     tr.add_argument("--shards", type=int, default=1,
                     help="devices along the data axis (replaces mpirun -np)")
+    tr.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="multi-host training (docs/DISTRIBUTED.md "
+                         "'Multi-host'): join a cross-process group "
+                         "via this jax.distributed coordinator — one "
+                         "`dpsvm train` per host, same flags plus "
+                         "--num-hosts/--host-id; the data mesh then "
+                         "spans every host's devices. Omitted = "
+                         "single-host, bit-identical to before the "
+                         "flag existed (on Cloud TPU pods the group "
+                         "is metadata-discovered; this flag is for "
+                         "explicit/localhost groups)")
+    tr.add_argument("--num-hosts", type=int, default=None, metavar="N",
+                    help="process count of the multi-host group "
+                         "(requires --coordinator)")
+    tr.add_argument("--host-id", type=int, default=None, metavar="K",
+                    help="this process's rank, 0..N-1 (requires "
+                         "--coordinator)")
     tr.add_argument("--backend", default="xla", choices=["xla", "numpy"],
                     help="'numpy' runs the golden-reference CPU solver "
                          "(the reference's seq binary equivalent)")
@@ -515,6 +532,28 @@ def build_parser() -> argparse.ArgumentParser:
                          "(docs/OBSERVABILITY.md 'Per-tenant "
                          "attribution'); reporting-only, never "
                          "changes the exit code")
+    dr.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="multi-host preflight: deadline-bounded TCP "
+                         "reachability check of the jax.distributed "
+                         "coordinator (a pure socket probe — the "
+                         "doctor NEVER initializes a distributed "
+                         "backend); exit 9 = host group degraded")
+    dr.add_argument("--hosts-dir", default=None, metavar="DIR",
+                    help="host-group heartbeat directory "
+                         "(DPSVM_HOST_HEARTBEAT_DIR of a supervised "
+                         "run): reports each host's last-beat age, "
+                         "iteration and admitted live generation; "
+                         "exit 9 when a host is missing or stale "
+                         "(docs/DISTRIBUTED.md 'Multi-host')")
+    dr.add_argument("--num-hosts", type=int, default=0, metavar="N",
+                    help="expected host-group size for --hosts-dir "
+                         "(0 = whatever heartbeats exist; nonzero "
+                         "makes a MISSING host a degradation, not "
+                         "just a stale one)")
+    dr.add_argument("--heartbeat-max-age", type=float, default=60.0,
+                    metavar="S",
+                    help="heartbeat age beyond which a host counts as "
+                         "stale for --hosts-dir (default 60)")
 
     rp = sub.add_parser(
         "report", help="render a run-telemetry trace (train "
@@ -2809,6 +2848,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         return supervisor.supervise(
             child, retries=args.retries, backoff_s=args.retry_backoff,
             checkpoint_path=args.checkpoint)
+    if args.command == "train":
+        coord = getattr(args, "coordinator", None)
+        if not coord and (getattr(args, "num_hosts", None) is not None
+                          or getattr(args, "host_id", None) is not None):
+            print("error: --num-hosts/--host-id require --coordinator "
+                  "(docs/DISTRIBUTED.md 'Multi-host')", file=sys.stderr)
+            return 2
+        if coord:
+            nh, hid = args.num_hosts, args.host_id
+            if (nh is None) != (hid is None):
+                print("error: --num-hosts and --host-id must be given "
+                      "together", file=sys.stderr)
+                return 2
+            if nh is not None and not 0 <= hid < nh:
+                print(f"error: --host-id {hid} out of range for "
+                      f"--num-hosts {nh}", file=sys.stderr)
+                return 2
+            # MUST run before _init_backend: the backend probe warms
+            # XLA, after which jax.distributed.initialize refuses to
+            # run in this process (parallel/multihost.py).
+            from dpsvm_tpu.parallel import multihost
+            multihost.initialize(coordinator=coord, num_processes=nh,
+                                 process_id=hid)
     try:
         if args.command in ("train", "test", "serve", "tune"):
             rc = _init_backend(args)
@@ -2830,7 +2892,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                               checkpoint_path=args.checkpoint,
                               data_path=args.data,
                               timeout_s=args.timeout,
-                              serving_url=args.serving_url)
+                              serving_url=args.serving_url,
+                              coordinator=args.coordinator,
+                              hosts_dir=args.hosts_dir,
+                              num_hosts=args.num_hosts,
+                              heartbeat_max_age_s=args.heartbeat_max_age)
         if args.command == "report":
             return cmd_report(args)
         if args.command == "compare":

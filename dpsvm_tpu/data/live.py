@@ -374,6 +374,22 @@ class ShardLogWatcher:
                      "generation; refusing a non-advancing publish "
                      f"(#{self.stale_observed})")
             return []
+        # Cross-host admission barrier (resilience/hostgroup.py,
+        # docs/DISTRIBUTED.md "Multi-host"): publish the durably
+        # OBSERVED generation, commit only at the minimum the whole
+        # group has published. Identity outside a host group. A peer
+        # that has not yet observed `gen` — straggler, still
+        # compiling, dead — pins the commit to the group floor, so no
+        # host ever trains on rows another host has not admitted (the
+        # per-host divisor/step-size math would silently desync).
+        from dpsvm_tpu.resilience import hostgroup
+        commit = hostgroup.admission_barrier(gen, self.ds.generation)
+        if commit <= self.ds.generation:
+            return []
+        if commit < gen:
+            from dpsvm_tpu.data.stream import pin_manifest_generation
+            manifest = pin_manifest_generation(manifest, commit)
+            gen = commit
         admitted = self.ds.admit_manifest(manifest)
         for k in admitted:
             meta = self.ds.shards[k]

@@ -50,8 +50,11 @@ LEDGER_SCHEMA = 1
 #: record kinds the documented producers write (free strings otherwise;
 #: this is the vocabulary, like record.SERVING_EVENTS). "tune" rows
 #: come from `dpsvm tune` (tuning/tuner.py): per-knob probe readings
-#: plus the tuned_vs_default A/B verdict.
-KINDS = ("bench", "burst", "loadgen", "compare", "tune", "serve")
+#: plus the tuned_vs_default A/B verdict. "robust" rows come from the
+#: resilience drills (resilience/hostgroup.host_loss_drill):
+#: recovery latencies, gated direction "lower" like any latency.
+KINDS = ("bench", "burst", "loadgen", "compare", "tune", "serve",
+         "robust")
 
 #: unit -> gate direction ("higher" = bigger is better). The per-record
 #: ``direction`` field wins; the metric-name heuristics below back this

@@ -103,6 +103,18 @@ SERVING_EVENTS = ("eject", "rebuild", "shed", "hedge", "drift",
 # observability/schema.REWIND_EVENTS).
 DIST_EVENTS = ("desync", "shard_lost", "reshard")
 
+# Event types the MULTI-HOST layer emits (resilience/hostgroup.py,
+# docs/DISTRIBUTED.md "Multi-host"): `host_lost` = a real host process
+# died or went heartbeat-silent past the deadline (requires `host_id`),
+# `reform` = the group supervisor relaunched the survivors as a
+# smaller process group resuming from the newest intact checkpoint
+# (requires `from_hosts`/`to_hosts`; rewinds the n_iter baseline like
+# `reshard` — observability/schema.REWIND_EVENTS). Both are written by
+# the RESUMED attempt's driver from the supervisor's env markers, so
+# the recovery story survives the fact that each attempt is a separate
+# process writing a fresh trace file.
+HOST_EVENTS = ("host_lost", "reform")
+
 # Event types the streaming data layer emits into a training trace
 # (data/stream.py, docs/DATA.md): `quarantine` = a data shard failed
 # its CRC / finiteness check under on_bad_shard="quarantine" and was

@@ -103,10 +103,12 @@ SPAN_SLACK_S = 2e-6
 TERMINAL_EVENTS = ("stall", "preempt")
 
 # Events that rewind the chunk-record n_iter baseline to their own
-# n_iter: `rollback` (checkpoint restored after divergence/corruption)
-# and `reshard` (resume re-sliced onto a different mesh — the
-# checkpoint's iteration restarts the count on the new mesh).
-REWIND_EVENTS = ("rollback", "reshard")
+# n_iter: `rollback` (checkpoint restored after divergence/corruption),
+# `reshard` (resume re-sliced onto a different mesh — the checkpoint's
+# iteration restarts the count on the new mesh), and `reform` (a host
+# group shrank after a host loss and the resumed attempt restarts from
+# the checkpoint's iteration — resilience/hostgroup.py).
+REWIND_EVENTS = ("rollback", "reshard", "reform")
 
 # Required extra keys per elastic/ingest/cascade event type (beyond
 # EVENT_KEYS): a `desync` without its mesh size, a `reshard` without
@@ -158,6 +160,12 @@ EVENT_EXTRA_KEYS = {
     "append_admitted": ("shard", "generation"),
     "ingest_grow": ("generation", "n_new_rows"),
     "refresh": ("refresh_kind",),
+    # Multi-host recovery (resilience/hostgroup.py): a `host_lost`
+    # without the dead host's id, or a `reform` without both group
+    # sizes, cannot drive a playbook — rejected like their elastic
+    # shard-level counterparts above.
+    "host_lost": ("host_id",),
+    "reform": ("from_hosts", "to_hosts"),
 }
 
 #: the closed value set of the `refresh` event's `refresh_kind`

@@ -1,4 +1,4 @@
-"""Multi-host (multi-process) initialization.
+"""Multi-host (multi-process) runtime: lifecycle, identity, launcher.
 
 The reference scales across machines with `mpirun --hostfile hf` and MPI
 process management (``svmTrainMain.cpp:144-159``, ``Makefile:74``). The
@@ -11,21 +11,33 @@ MPI anywhere.
 
 Typical launch (one command per host, or via your cluster scheduler):
 
-    python -c "import dpsvm_tpu.parallel.multihost as mh; \
-               mh.initialize(coordinator='host0:8476', num_processes=4, \
-                             process_id=$RANK)" ...
+    dpsvm train --coordinator host0:8476 --num-hosts 4 --host-id $RANK \
+                --shards 16 ...
 
 On Cloud TPU VMs all three arguments are discovered from the metadata
-server, so ``initialize()`` with no arguments suffices.
+server, so ``initialize()`` with no arguments suffices there.
+
+CI story (docs/DISTRIBUTED.md "Multi-host"): the whole lifecycle is
+testable on CPU — N single-device "host" subprocesses on localhost, a
+free coordinator port, and XLA's gloo CPU collectives (the default CPU
+client cannot run cross-process computations at all; ``initialize``
+flips the collectives implementation BEFORE the distributed client
+comes up, which is the only moment it can be flipped). The host-group
+supervisor that spawns/monitors/reforms such groups lives in
+``resilience/hostgroup.py``.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import os
+import socket
+from typing import Dict, Optional
 
-import jax
+import numpy as np
 
 _initialized = False
+_host_count = 1
+_host_id = 0
 
 
 def initialize(coordinator: Optional[str] = None,
@@ -36,11 +48,24 @@ def initialize(coordinator: Optional[str] = None,
     Deliberately checks only the local flag, NOT ``is_initialized()``:
     that helper may consult ``jax.process_count()``, and any such call
     initializes the XLA backend — after which
-    ``jax.distributed.initialize`` refuses to run at all.
+    ``jax.distributed.initialize`` refuses to run at all. For the same
+    reason the CLI calls this BEFORE its backend probe
+    (cli.main -> _init_backend).
     """
-    global _initialized
+    global _initialized, _host_count, _host_id
     if _initialized:
         return
+    import jax
+
+    # The stock CPU client has no cross-process collectives ("Multiprocess
+    # computations aren't implemented on the CPU backend"); gloo does.
+    # Must be set before the distributed client exists — harmless for
+    # TPU/GPU backends (the knob only selects the CPU client's
+    # implementation) and absent in very old jaxlibs (guarded).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
     kwargs = {}
     if coordinator is not None:
         kwargs["coordinator_address"] = coordinator
@@ -55,6 +80,14 @@ def initialize(coordinator: Optional[str] = None,
         if "already" not in str(e).lower():
             raise
     _initialized = True
+    if num_processes is not None:
+        _host_count = int(num_processes)
+        _host_id = int(process_id or 0)
+    else:
+        # Auto-detected (TPU metadata server): the backend is up now,
+        # so the process facts are a dictionary read.
+        _host_count = jax.process_count()
+        _host_id = jax.process_index()
 
 
 def is_initialized() -> bool:
@@ -72,12 +105,44 @@ def is_initialized() -> bool:
             return False
     except (ImportError, AttributeError):    # private API moved: assume
         pass                                 # warm and fall through
+    import jax
     return jax.process_count() > 1
+
+
+def host_count() -> int:
+    """Hosts in the group. 1 on an uninitialized single process —
+    read from the recorded lifecycle, NEVER from a jax call, so it is
+    safe at any time (including before the backend is warm)."""
+    return _host_count if _initialized else 1
+
+
+def host_id() -> int:
+    """This process's rank in the group (0 on an uninitialized single
+    process). Same cold-backend safety contract as ``host_count``."""
+    return _host_id if _initialized else 0
+
+
+def host_allgather(value) -> np.ndarray:
+    """Stack ``value`` across hosts -> ``(host_count, ...)`` ndarray.
+
+    On an uninitialized single process this is a pure-NumPy identity
+    wrap — shape ``(1, ...)`` — touching no jax state at all (pinned by
+    tests/test_multihost.py: today's only mode must stay bit-identical).
+    Under a multi-host runtime it is a real cross-process allgather
+    (every host must call it — it is a collective)."""
+    if not _initialized:
+        return np.asarray(value)[None]
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(value), tiled=False))
 
 
 def process_info() -> str:
     """Rank banner, the reference's Get_rank/Get_processor_name analog
     (``svmTrainMain.cpp:154-167``)."""
+    import jax
     return (f"process {jax.process_index()}/{jax.process_count()}, "
             f"{jax.local_device_count()} local / "
             f"{jax.device_count()} global devices")
@@ -89,6 +154,7 @@ def topology() -> dict:
     to call any time after the backend is up; initializes the backend
     if it is not (callers wanting a bounded wait go through
     ``utils.backend_guard.probe_devices`` first)."""
+    import jax
     try:
         devs = jax.devices()
         return {
@@ -102,3 +168,51 @@ def topology() -> dict:
         }
     except Exception as e:               # dead backend: report, not raise
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+# ---------------------------------------------------------------------
+# Localhost CPU host-group launch plumbing (CI / the host-loss drill).
+
+def find_free_port() -> int:
+    """A free localhost TCP port for the coordinator (bind-to-0 probe;
+    the tiny race between close and the coordinator's own bind is
+    acceptable for drills — a clash fails loudly and a retry picks a
+    fresh port)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def coordinator_reachable(coordinator: str,
+                          timeout_s: float = 5.0) -> Optional[str]:
+    """None when a TCP connect to ``host:port`` succeeds within the
+    deadline; else the one-line reason. Used by ``dpsvm doctor`` — a
+    pure socket probe that never touches jax (reporting must not warm
+    a backend the process may still want to distributed-initialize)."""
+    host, sep, port = coordinator.rpartition(":")
+    if not sep or not port.isdigit():
+        return f"malformed coordinator address {coordinator!r} (want host:port)"
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=timeout_s):
+            return None
+    except OSError as e:
+        return (f"coordinator {coordinator} unreachable within "
+                f"{timeout_s:g}s ({e})")
+
+
+def local_host_env(host_id: int, base: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    """Environment for one localhost CPU "host" subprocess: CPU
+    platform pinned, and the virtual-device multiplier stripped from
+    XLA_FLAGS so each host owns exactly ONE device (the whole point of
+    the drill is a real cross-process mesh, not one process pretending
+    to be eight)."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=1")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["DPSVM_HOST_ID"] = str(int(host_id))
+    return env

@@ -49,7 +49,8 @@ __all__ = [
 ]
 
 
-def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
+def selfcheck(tmp_dir: Optional[str] = None,
+              host_drill: bool = False) -> List[str]:
     """Injector + supervisor round-trip on a tiny CPU problem; returns
     problems (empty = OK). Flow: (1) an uninterrupted reference run,
     (2) the same run preempted mid-flight by an injected fault and
@@ -62,6 +63,15 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
     surviving mesh from the newest intact shard-aware checkpoint,
     final model bitwise-identical to an uninterrupted mesh run with
     the ``reshard``/``retry`` events on a schema-valid trace.
+
+    With ``host_drill=True`` (the ``--selfcheck`` CLI gate and the
+    burst runner's ``host_loss_drill`` tag; opt-in because it spawns
+    real training subprocesses) it additionally runs the kill-one-HOST
+    drill: N localhost single-device host processes training dist-smo
+    over a cross-process mesh, one SIGKILLed mid-run, survivors
+    reformed by the group supervisor (resilience/hostgroup.py) to the
+    same model within 1e-4 with a schema-valid ``host_lost`` ->
+    ``reform`` trace.
 
     Tier-1 (tests/test_resilience.py) and ``python -m
     dpsvm_tpu.resilience --selfcheck`` both run this, so a regression in
@@ -215,4 +225,20 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
             if schema_errs:
                 problems.append("kill-shard drill: resumed attempt "
                                 f"trace fails validation: {schema_errs}")
+
+        # --- kill-one-HOST drill: cross-process reformation ----------
+        # (opt-in: real subprocesses, each paying its own jax startup)
+        if host_drill:
+            from dpsvm_tpu.resilience import hostgroup
+            td3 = os.path.join(td, "hostdrill")
+            try:
+                facts = hostgroup.host_loss_drill(td3)
+            except Exception as e:
+                problems.append(f"host-loss drill failed: "
+                                f"{type(e).__name__}: {e}")
+            else:
+                if facts.get("host_loss_recovery_s", 0) <= 0:
+                    problems.append(
+                        "host-loss drill measured no recovery latency "
+                        f"(facts: {facts})")
     return problems

@@ -2,14 +2,20 @@
 
 Runs on CPU without any accelerator (forces JAX_PLATFORMS=cpu when the
 ambient env doesn't pin a platform) — the CI twin of
-``python -m dpsvm_tpu.telemetry --selfcheck``.
+``python -m dpsvm_tpu.telemetry --selfcheck``. ``--selfcheck`` includes
+the kill-one-HOST drill (real subprocesses; resilience/hostgroup.py);
+``--host-drill`` runs ONLY that drill and prints its facts as a final
+JSON line — the burst runner's ``host_loss_drill`` tag harvests the
+``host_loss_recovery_s`` metric from it (benchmarks/burst_runner.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import tempfile
 from typing import List, Optional
 
 
@@ -20,12 +26,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "problem; asserts the resumed trajectory is "
                         "bitwise-identical to an uninterrupted run "
                         "(incl. the kill-one-shard degraded-mesh "
-                        "drill on a virtual-device mesh)")
+                        "drill on a virtual-device mesh AND the "
+                        "kill-one-host reformation drill on real "
+                        "localhost host processes)")
+    p.add_argument("--host-drill", action="store_true",
+                   help="run only the kill-one-host drill: 3 "
+                        "single-device localhost hosts training over "
+                        "a cross-process mesh, one SIGKILLed mid-run, "
+                        "survivors reformed from the newest intact "
+                        "checkpoint; prints the drill facts "
+                        "(host_loss_recovery_s, model deltas) as a "
+                        "final JSON line")
     args = p.parse_args(argv)
-    if not args.selfcheck:
+    if not (args.selfcheck or args.host_drill):
         p.print_help()
         return 2
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.host_drill:
+        # Pure supervisor process: the hosts are subprocesses with
+        # their own (single-device) jax; this process touches none.
+        from dpsvm_tpu.resilience import hostgroup
+
+        with tempfile.TemporaryDirectory() as td:
+            facts = hostgroup.host_loss_drill(td)
+        print("host-loss drill OK: "
+              f"recovered in {facts['host_loss_recovery_s']:.2f}s, "
+              f"{facts['hosts']} -> {facts['surviving_hosts']} hosts, "
+              f"coef delta {facts['coef_delta']:g}"
+              + (" (bitwise)" if facts.get("bitwise") else ""),
+              file=sys.stderr)
+        print(json.dumps(facts))
+        return 0
     if os.environ["JAX_PLATFORMS"] == "cpu":
         # The kill-shard drill needs a mesh: force virtual CPU devices
         # unless the caller already pinned a device count (same pattern
@@ -37,15 +68,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             ).strip()
     from dpsvm_tpu.resilience import selfcheck
 
-    problems = selfcheck()
+    problems = selfcheck(host_drill=True)
     if problems:
         print("resilience selfcheck FAILED:", file=sys.stderr)
         for pr in problems:
             print(f"  {pr}", file=sys.stderr)
         return 1
     print("resilience selfcheck OK (preempt + retry + rotation "
-          "fallback + kill-shard degraded-mesh drill, "
-          "bitwise-identical resume)")
+          "fallback + kill-shard degraded-mesh drill + kill-host "
+          "reformation drill, bitwise-identical resume)")
     return 0
 
 

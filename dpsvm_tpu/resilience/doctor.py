@@ -31,6 +31,13 @@ with a one-line diagnosis.
    network filesystem surfaces as MB/s before the run starts, not as
    a mystery stall an hour in). Distinct exit codes: 7 = integrity,
    8 = disk space.
+5. **Host group** (``--coordinator`` / ``--hosts-dir``,
+   docs/DISTRIBUTED.md "Multi-host") — deadline-bounded TCP
+   reachability of the ``jax.distributed`` coordinator (a pure socket
+   probe: the doctor NEVER initializes a distributed backend — the
+   probing process may still want to) and per-host heartbeat
+   freshness/iteration/generation from the group supervisor's shared
+   directory (resilience/hostgroup.py). Exit 9 = host group degraded.
 
 The doctor also REPORTS (never gates on) the tuned-knob profile
 resolution would consult for this backend — knobs, provenance and the
@@ -293,10 +300,86 @@ def _serving_tenant_probe(url: str, out: Callable[[str], None]) -> None:
             "attribution matters for the tail")
 
 
+def _hostgroup_probe(coordinator: Optional[str],
+                     hosts_dir: Optional[str],
+                     num_hosts: int, max_age_s: float,
+                     timeout_s: float,
+                     out: Callable[[str], None]) -> Tuple[bool, str]:
+    """Multi-host preflight (docs/DISTRIBUTED.md "Multi-host").
+    Reporting-only and collective-free by design: the coordinator
+    check is a pure TCP connect with a deadline (it must be usable
+    from a process that will LATER distributed-initialize — touching
+    jax here would forfeit that), and group liveness is read from the
+    heartbeat files the supervisor itself watches. The cross-host
+    psum agreement check runs ONLY when this process is already
+    inside an initialized group — the doctor never forms one.
+    Returns (ok, reason-if-degraded)."""
+    from dpsvm_tpu.parallel import multihost
+    from dpsvm_tpu.resilience import hostgroup
+
+    degraded: List[str] = []
+    if coordinator:
+        why = multihost.coordinator_reachable(
+            coordinator, timeout_s=min(timeout_s, 10.0))
+        if why is None:
+            out(f"hostgroup: coordinator {coordinator} reachable")
+        else:
+            out(f"hostgroup: {why}")
+            degraded.append(why)
+    if hosts_dir:
+        beats = hostgroup.read_heartbeats(hosts_dir)
+        ages = hostgroup.heartbeat_ages(hosts_dir)
+        expected = (set(range(int(num_hosts))) if num_hosts
+                    else set(beats))
+        if not beats:
+            msg = f"no heartbeats in {hosts_dir}"
+            out(f"hostgroup: {msg}")
+            degraded.append(msg)
+        for hid in sorted(expected | set(beats)):
+            rec = beats.get(hid)
+            if rec is None:
+                msg = f"host {hid} has NO heartbeat (expected one)"
+                out(f"hostgroup: {msg}")
+                degraded.append(msg)
+                continue
+            age = ages.get(hid, float("inf"))
+            stale = age > max_age_s
+            out(f"hostgroup: host {hid}: beat {age:.1f}s ago, "
+                f"iter {rec.get('n_iter')}, "
+                f"generation {rec.get('generation')}, "
+                f"pid {rec.get('pid')}"
+                + (f" — STALE (> {max_age_s:g}s)" if stale else ""))
+            if stale:
+                degraded.append(f"host {hid} heartbeat {age:.1f}s old "
+                                f"(> {max_age_s:g}s)")
+    if multihost.is_initialized():
+        import numpy as np
+        got = multihost.host_allgather(multihost.host_id())
+        want = list(range(multihost.host_count()))
+        if sorted(int(v) for v in np.asarray(got).ravel()) == want:
+            out(f"hostgroup: cross-host allgather agrees "
+                f"({multihost.host_count()} host(s))")
+        else:
+            msg = (f"cross-host allgather disagrees: {got!r} vs "
+                   f"hosts {want}")
+            out(f"hostgroup: {msg}")
+            degraded.append(msg)
+    else:
+        out("hostgroup: not inside an initialized host group — "
+            "cross-host collective check skipped (reporting-only: "
+            "the doctor never initializes one)")
+    return (not degraded,
+            degraded[0] if degraded else "")
+
+
 def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
                data_path: Optional[str] = None,
                timeout_s: float = 60.0,
                serving_url: Optional[str] = None,
+               coordinator: Optional[str] = None,
+               hosts_dir: Optional[str] = None,
+               num_hosts: int = 0,
+               heartbeat_max_age_s: float = 60.0,
                out: Callable[[str], None] = print) -> int:
     """The full preflight; returns the process exit code (0 = sane).
     Prints its findings through ``out`` and always ends with one
@@ -362,9 +445,17 @@ def run_doctor(shards: int = 0, checkpoint_path: Optional[str] = None,
         data_ok, code = _data_probe(data_path, out)
         if not data_ok:
             return code
+    if coordinator or hosts_dir:
+        hg_ok, why = _hostgroup_probe(coordinator, hosts_dir,
+                                      num_hosts, heartbeat_max_age_s,
+                                      timeout_s, out)
+        if not hg_ok:
+            out(f"DOCTOR FAIL: host group degraded — {why}")
+            return 9
     if serving_url:
         _serving_tenant_probe(serving_url, out)
     out(f"DOCTOR OK: {p}-shard mesh sane"
         + (", checkpoint path healthy" if checkpoint_path else "")
-        + (", shard data healthy" if data_path else ""))
+        + (", shard data healthy" if data_path else "")
+        + (", host group healthy" if coordinator or hosts_dir else ""))
     return 0

@@ -42,6 +42,24 @@ driver on multi-shard runs — docs/DISTRIBUTED.md "Elastic training"):
   its heartbeat age grows in the chunk records and the stall
   watchdog's dist verdict fingers it.
 
+Multi-host knobs (``DPSVM_FAULT_HOST_*``, consumed by the shared driver
+and the live-ingest barrier — docs/DISTRIBUTED.md "Multi-host"; the
+host-group drill plants them in ONE host subprocess's environment, so
+the blast radius is per-host, exactly like the real failures):
+
+* ``DPSVM_FAULT_HOST_KILL=m`` — THIS process SIGKILLs itself at its
+  m-th (1-based) host poll: a real, uncatchable host death mid-run
+  (no snapshot, no cleanup — the heartbeat file simply stops). The
+  host-group supervisor (resilience/hostgroup.py) detects the dead
+  member, reforms the group on the survivors and resumes from the
+  newest intact checkpoint — the kill-one-host drill;
+* ``DPSVM_FAULT_HOST_HANG_MS=t`` — THIS process sleeps ``t``
+  milliseconds at every live-ingest admission poll (the straggler-host
+  model): its published generation lags, the cross-host min-generation
+  barrier holds every host at the straggler's boundary (no desync),
+  and the hang surfaces as heartbeat age in doctor/watch — never as a
+  silent wedge.
+
 Data-pipeline knobs (``DPSVM_FAULT_IO_*``, consumed by the shard
 reader in ``data/stream.py`` — docs/DATA.md "Failure playbook"):
 
@@ -178,6 +196,10 @@ class FaultPlan:
     dist_desync_at: int = 0          # poison a probe at n_iter >= j
     dist_desync_shard: int = 0       # which shard lies (default last)
     dist_slow_shard: int = 0         # shard #k's probe stops advancing
+    # multi-host knobs (docstring above): planted PER-HOST by the
+    # host-group drill, so "this process" is one member of the group
+    host_kill: int = 0               # SIGKILL self at the m-th host poll
+    host_hang_ms: int = 0            # sleep at every live admission poll
     # data-pipeline knobs (docstring above): shard NUMBERS 1-based
     io_read_fail_once: int = 0       # the k-th shard read fails once
     io_corrupt_shard: int = 0        # shard #k payload bit-flipped
@@ -217,6 +239,7 @@ class FaultPlan:
     _poisoned: Optional[Tuple[int, int]] = None  # (replica, generation)
     _dist_polls: int = 0
     _kill_fired: bool = False
+    _host_polls: int = 0
     _desync_fired: bool = False
     _slow_probe: Optional[tuple] = None   # frozen probe row replayed
     _io_reads: int = 0
@@ -236,7 +259,8 @@ class FaultPlan:
                     or self.serve_nan_after or self.serve_fail_reload
                     or self.serve_slow_replica_ms
                     or self.dist_kill_shard or self.dist_desync_at
-                    or self.dist_slow_shard or self.io_read_fail_once
+                    or self.dist_slow_shard or self.host_kill
+                    or self.host_hang_ms or self.io_read_fail_once
                     or self.io_corrupt_shard or self.io_truncate_shard
                     or self.io_slow_read_ms or self.cascade_stop_stage
                     or self.preflight_wedge_s or self.live_torn_publish
@@ -333,6 +357,28 @@ class FaultPlan:
                  f"#{self._dist_polls}")
             return self.dist_kill_shard
         return 0
+
+    def host_kill_now(self) -> bool:
+        """Counted per host poll; True exactly once, at the configured
+        poll — the driver then SIGKILLs its own process. Unlike
+        ``dist_kill_now`` (which raises a catchable ShardLostError in a
+        single supervising process) this is a REAL uncatchable death of
+        one member of a multi-process host group: no snapshot, no trace
+        close, heartbeat file frozen mid-run."""
+        if not self.host_kill:
+            return False
+        self._host_polls += 1
+        if self._host_polls >= self.host_kill:
+            _log(f"SIGKILLing this host at host poll "
+                 f"#{self._host_polls}")
+            return True
+        return False
+
+    def host_hang_delay_s(self) -> float:
+        """Seconds the live-ingest admission poll must sleep (0.0 =
+        run clean) — the straggler-host model for the cross-host
+        min-generation barrier."""
+        return self.host_hang_ms / 1000.0
 
     # -- data-pipeline injection points (data/stream.py). Like the
     # training hooks these are single-threaded (one reader loop).
@@ -506,6 +552,8 @@ def plan_from_env() -> Optional[FaultPlan]:
         dist_desync_at=_env_int("DIST_DESYNC_AT"),
         dist_desync_shard=_env_int("DIST_DESYNC_SHARD"),
         dist_slow_shard=_env_int("DIST_SLOW_SHARD"),
+        host_kill=_env_int("HOST_KILL"),
+        host_hang_ms=_env_int("HOST_HANG_MS"),
         io_read_fail_once=_env_int("IO_READ_FAIL_ONCE"),
         io_corrupt_shard=_env_int("IO_CORRUPT_SHARD"),
         io_truncate_shard=_env_int("IO_TRUNCATE_SHARD"),

@@ -1,0 +1,572 @@
+"""Host-group supervision: heartbeats, reformation, the admission barrier.
+
+``elastic.py`` owns the IN-process distributed fault model (virtual
+device meshes, ``run_elastic``). This module owns the CROSS-process one:
+a group of real host processes — one ``dpsvm train --coordinator ...``
+per host (parallel/multihost.py) — supervised from outside, because a
+host that dies by SIGKILL cannot run any in-process recovery, and its
+survivors wedge inside the next gloo/ICI collective waiting for a peer
+that will never answer (docs/DISTRIBUTED.md "Multi-host").
+
+Three cooperating pieces:
+
+* **Heartbeat files** — every host appends its liveness fact
+  (``host-<id>.json``: n_iter, admitted live generation, pid) to a
+  shared directory at each poll boundary, written atomically so a
+  reader never sees a torn record. The supervisor and ``dpsvm doctor``
+  read ONLY these files — detection never requires a collective on a
+  group that may already be wedged.
+* **run_host_group** — the reformation supervisor: spawns N localhost
+  "hosts" on a fresh coordinator port, watches child exits and
+  heartbeat ages, and on a loss kills the wedged survivors, shrinks the
+  group to N-1, and relaunches on a NEW port resuming from the newest
+  intact checkpoint (the re-shard-on-load path). The resumed attempt's
+  trace records ``host_lost`` -> ``reform`` via the env markers below.
+* **admission_barrier** — multi-host live ingest (docs/DATA.md "Live
+  shard logs"): each host publishes the newest durable manifest
+  generation it has OBSERVED, but commits only at the minimum
+  generation the whole group has published. A straggler (or a dead
+  host) therefore holds everyone at the last common generation — the
+  per-host divisor/step-size math (approx/primal.scale_params) can
+  never desync across the group.
+
+Env contract (set by the supervisor for its children; absent on a
+plain single-host run, where every hook here is a no-op):
+
+* ``DPSVM_HOST_HEARTBEAT_DIR`` — the shared heartbeat directory;
+* ``DPSVM_HOST_ID`` / ``DPSVM_HOST_COUNT`` — this host's rank and the
+  expected group size (the barrier's membership roll);
+* ``DPSVM_HOST_LOST`` / ``DPSVM_REFORM_FROM`` / ``DPSVM_REFORM_TO`` —
+  set on a post-loss attempt only; drained into the run trace by
+  ``solver/driver.begin_trace`` as the ``host_lost`` and ``reform``
+  events.
+
+Fault hooks (resilience/faultinject.py): ``DPSVM_FAULT_HOST_KILL=m``
+self-SIGKILLs one host at its m-th poll — the drill's real host death;
+``DPSVM_FAULT_HOST_HANG_MS=t`` delays every admission poll — the
+planted straggler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+ENV_HEARTBEAT_DIR = "DPSVM_HOST_HEARTBEAT_DIR"
+ENV_HOST_ID = "DPSVM_HOST_ID"
+ENV_HOST_COUNT = "DPSVM_HOST_COUNT"
+ENV_HOST_LOST = "DPSVM_HOST_LOST"
+ENV_REFORM_FROM = "DPSVM_REFORM_FROM"
+ENV_REFORM_TO = "DPSVM_REFORM_TO"
+
+#: Env markers that must never leak from one attempt (or an enclosing
+#: test) into a freshly spawned host — the supervisor owns them.
+_MARKER_VARS = (ENV_HOST_LOST, ENV_REFORM_FROM, ENV_REFORM_TO,
+                "DPSVM_RETRY_ATTEMPT")
+_FAULT_VARS = ("DPSVM_FAULT_HOST_KILL", "DPSVM_FAULT_HOST_HANG_MS")
+
+
+def _log(msg: str) -> None:
+    print(f"hostgroup: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------
+# Heartbeat files.
+
+def heartbeat_path(hb_dir: str, host_id: int) -> str:
+    return os.path.join(hb_dir, f"host-{int(host_id)}.json")
+
+
+def write_heartbeat(hb_dir: str, host_id: int, n_iter: int,
+                    generation: int = 0) -> None:
+    """Atomically publish this host's liveness fact. tmp + rename so a
+    concurrent reader (supervisor, doctor, a peer's barrier poll) never
+    parses a torn record; the file mtime is the liveness clock, so ages
+    work even when writer and reader disagree about wall time."""
+    os.makedirs(hb_dir, exist_ok=True)
+    path = heartbeat_path(hb_dir, host_id)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"host_id": int(host_id), "n_iter": int(n_iter),
+                   "generation": int(generation), "t": time.time(),
+                   "pid": os.getpid()}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def read_heartbeats(hb_dir: str) -> Dict[int, dict]:
+    """All parseable heartbeat records, keyed by host id. Torn or alien
+    files are skipped, never raised — reporting must survive exactly
+    the failures it reports on."""
+    out: Dict[int, dict] = {}
+    try:
+        names = os.listdir(hb_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("host-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(hb_dir, name)) as fh:
+                rec = json.load(fh)
+            out[int(rec["host_id"])] = rec
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def heartbeat_ages(hb_dir: str,
+                   now: Optional[float] = None) -> Dict[int, float]:
+    """Seconds since each host's last heartbeat write (file mtime — see
+    ``write_heartbeat``). A host with no file yet has no entry."""
+    now = time.time() if now is None else now
+    ages: Dict[int, float] = {}
+    for hid in read_heartbeats(hb_dir):
+        try:
+            ages[hid] = max(0.0, now - os.path.getmtime(
+                heartbeat_path(hb_dir, hid)))
+        except OSError:
+            continue
+    return ages
+
+
+# ---------------------------------------------------------------------
+# In-host hooks (driver poll loop, live-ingest admission).
+
+#: This host's last published facts — n_iter from the driver poll,
+#: generation from the admission barrier — merged so either writer
+#: emits the full record.
+_STATE = {"n_iter": 0, "generation": 0}
+
+
+def _group() -> Optional[tuple]:
+    """(heartbeat_dir, host_id, host_count) when this process runs
+    inside a supervised host group, else None. Read from env on every
+    call — the polls are chunk-cadence, the reads are nanoseconds, and
+    tests monkeypatch the env."""
+    hb_dir = os.environ.get(ENV_HEARTBEAT_DIR, "").strip()
+    if not hb_dir:
+        return None
+    try:
+        hid = int(os.environ.get(ENV_HOST_ID, "0") or 0)
+        count = int(os.environ.get(ENV_HOST_COUNT, "1") or 1)
+    except ValueError:
+        return None
+    return hb_dir, hid, count
+
+
+def note_poll_heartbeat(n_iter: int) -> None:
+    """Driver poll-boundary hook: publish liveness. No-op outside a
+    host group; never raises (a full disk must not kill training —
+    the supervisor sees the growing age instead)."""
+    grp = _group()
+    if grp is None:
+        return
+    hb_dir, hid, _ = grp
+    _STATE["n_iter"] = int(n_iter)
+    try:
+        write_heartbeat(hb_dir, hid, _STATE["n_iter"],
+                        _STATE["generation"])
+    except OSError as e:
+        _log(f"heartbeat write failed ({e}); continuing")
+
+
+def admission_barrier(observed_gen: int, committed_gen: int) -> int:
+    """Generation this host may COMMIT, given it has durably OBSERVED
+    ``observed_gen`` and already consumed ``committed_gen``.
+
+    Outside a host group: identity (``observed_gen``) — the single-host
+    live path is untouched. Inside one: publish ``observed_gen`` in the
+    heartbeat, read the whole group's published generations, and return
+    the group minimum (floored at ``committed_gen`` so the answer never
+    moves backwards). A member with no heartbeat yet — still compiling,
+    hung, or dead — holds the group at ``committed_gen``: nobody trains
+    on rows a peer has not admitted, which is the invariant the shared
+    divisor/step-size math needs (docs/DISTRIBUTED.md "Multi-host").
+
+    The planted straggler (``DPSVM_FAULT_HOST_HANG_MS``) sleeps BEFORE
+    publishing, so its lag is visible to the group as a stale
+    generation and a growing heartbeat age — a doctor/watch fact, not a
+    wedge."""
+    grp = _group()
+    if grp is None:
+        return int(observed_gen)
+    hb_dir, hid, count = grp
+    hang_ms = os.environ.get("DPSVM_FAULT_HOST_HANG_MS", "").strip()
+    if hang_ms.isdigit() and int(hang_ms):
+        time.sleep(int(hang_ms) / 1000.0)
+    _STATE["generation"] = max(_STATE["generation"], int(observed_gen))
+    try:
+        write_heartbeat(hb_dir, hid, _STATE["n_iter"],
+                        _STATE["generation"])
+    except OSError as e:
+        _log(f"heartbeat write failed ({e}); holding admission")
+        return int(committed_gen)
+    beats = read_heartbeats(hb_dir)
+    gens: List[int] = []
+    for k in range(count):
+        rec = beats.get(k)
+        if rec is None:
+            return int(committed_gen)
+        gens.append(int(rec.get("generation", 0)))
+    return max(int(committed_gen), min(gens))
+
+
+# ---------------------------------------------------------------------
+# The reformation supervisor.
+
+class HostGroupError(RuntimeError):
+    """The group died in a way reformation cannot fix: a non-transient
+    child exit, or the retry/min-host budget ran out."""
+
+
+@dataclass
+class HostGroupResult:
+    """What a supervised run did: how many attempts, the final group
+    size, which hosts were lost (in order), and the measured
+    detection -> reformed-and-beating latency of the LAST loss."""
+    attempts: int
+    hosts: int
+    losses: List[int] = field(default_factory=list)
+    recovery_s: float = 0.0
+
+
+def _clean_child_env(base: Dict[str, str]) -> Dict[str, str]:
+    env = dict(base)
+    for k in _MARKER_VARS + _FAULT_VARS:
+        env.pop(k, None)
+    return env
+
+
+def _kill_group(procs: Dict[int, subprocess.Popen],
+                grace_s: float) -> None:
+    """SIGTERM the still-running children, give them ``grace_s`` to
+    die, then SIGKILL the rest. Survivors of a host loss are wedged
+    inside a collective — SIGTERM alone often cannot reach them."""
+    for p in procs.values():
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.time() + grace_s
+    for p in procs.values():
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+
+
+def run_host_group(
+    make_argv: Callable[[int, int, str, int], Sequence[str]],
+    *,
+    num_hosts: int,
+    heartbeat_dir: str,
+    checkpoint_path: Optional[str] = None,
+    retries: int = 1,
+    deadline_s: float = 60.0,
+    min_hosts: int = 1,
+    poll_s: float = 0.2,
+    grace_s: float = 5.0,
+    env_base: Optional[Dict[str, str]] = None,
+    first_attempt_env: Optional[Dict[int, Dict[str, str]]] = None,
+) -> HostGroupResult:
+    """Spawn and supervise a localhost host group; reform on loss.
+
+    ``make_argv(host_id, hosts, coordinator, attempt)`` builds one
+    host's command line (typically ``dpsvm train --coordinator ...``).
+    Each attempt gets a FRESH coordinator port — the old coordinator
+    died with host 0's process group — and, when ``checkpoint_path``
+    has an intact slot, ``--resume`` injected exactly like the retry
+    supervisor (resilience/supervisor.py). ``first_attempt_env`` plants
+    per-host fault env (the drill's ``DPSVM_FAULT_HOST_KILL``) on
+    attempt 0 ONLY, so a reformed group cannot re-inherit its own
+    death.
+
+    Loss detection is two-channel and collective-free: a child exiting
+    with a transient code/signal (supervisor.TRANSIENT_*), or a
+    heartbeat older than ``deadline_s`` (a hang — the SIGKILLed-peer
+    wedge looks like this on the survivors). The wedged survivors are
+    killed (SIGTERM, ``grace_s``, SIGKILL), the group shrinks by the
+    one lost host, and the next attempt's env carries the
+    ``host_lost``/``reform`` trace markers. ``recovery_s`` measures
+    detection -> every reformed host's first heartbeat."""
+    from dpsvm_tpu.parallel import multihost
+    from dpsvm_tpu.resilience import supervisor
+
+    if num_hosts < 1:
+        raise ValueError("num_hosts must be >= 1")
+    hosts = int(num_hosts)
+    attempt = 0
+    losses: List[int] = []
+    recovery_s = 0.0
+    detection_t: Optional[float] = None
+    base_env = _clean_child_env(
+        dict(os.environ) if env_base is None else dict(env_base))
+
+    while True:
+        port = multihost.find_free_port()
+        coordinator = f"127.0.0.1:{port}"
+        os.makedirs(heartbeat_dir, exist_ok=True)
+        for name in os.listdir(heartbeat_dir):
+            if name.startswith("host-"):
+                try:
+                    os.unlink(os.path.join(heartbeat_dir, name))
+                except OSError:
+                    pass
+        best, skipped = supervisor.newest_intact(checkpoint_path)
+        if skipped and best:
+            _log(f"skipping unreadable checkpoint slot(s) {skipped} "
+                 f"-> resuming {best}")
+        procs: Dict[int, subprocess.Popen] = {}
+        spawn_t = time.time()
+        for hid in range(hosts):
+            env = multihost.local_host_env(hid, base=base_env)
+            env[ENV_HEARTBEAT_DIR] = heartbeat_dir
+            env[ENV_HOST_COUNT] = str(hosts)
+            if attempt:
+                env["DPSVM_RETRY_ATTEMPT"] = str(attempt)
+                env[ENV_HOST_LOST] = str(losses[-1])
+                env[ENV_REFORM_FROM] = str(hosts + 1)
+                env[ENV_REFORM_TO] = str(hosts)
+            elif first_attempt_env and hid in first_attempt_env:
+                env.update(first_attempt_env[hid])
+            argv = list(make_argv(hid, hosts, coordinator, attempt))
+            if best:
+                argv = supervisor.with_resume(argv, best)
+            procs[hid] = subprocess.Popen(argv, env=env)
+        if attempt:
+            _log(f"attempt {attempt}: reformed to {hosts} host(s) on "
+                 f"{coordinator}"
+                 + (f", resuming {best}" if best else ""))
+
+        lost: Optional[int] = None
+        lost_reason = ""
+        beating: set = set()
+        while True:
+            time.sleep(poll_s)
+            now = time.time()
+            rcs = {hid: p.poll() for hid, p in procs.items()}
+            exited_bad = {hid: rc for hid, rc in rcs.items()
+                          if rc is not None and rc != 0}
+            if exited_bad:
+                # A SIGKILLed host's gloo peers die within milliseconds
+                # of it (connection reset inside the wedged collective)
+                # with ORDINARY error exits, so one poll sample can
+                # show several corpses: the TRANSIENT death (signal /
+                # preempt code) is the root cause, the rest are
+                # collateral. Only an all-non-transient group is a
+                # real command failure.
+                transient = {h: rc for h, rc in exited_bad.items()
+                             if supervisor.is_transient(rc)}
+                if not transient:
+                    lost, rc = sorted(exited_bad.items())[0]
+                    _kill_group(procs, grace_s)
+                    raise HostGroupError(
+                        f"host {lost} exited {rc} (non-transient) on "
+                        f"attempt {attempt}")
+                lost, rc = sorted(transient.items())[0]
+                lost_reason = f"exit {rc}"
+                break
+            # recovery_s: the reformed group is "back" when every host
+            # has published a heartbeat under the new attempt.
+            ages = heartbeat_ages(heartbeat_dir, now=now)
+            beating |= set(ages)
+            if (detection_t is not None
+                    and len(beating) >= len(procs)):
+                recovery_s = now - detection_t
+                detection_t = None
+                _log(f"recovered: all {hosts} host(s) beating "
+                     f"{recovery_s:.2f}s after loss detection")
+            if all(rc == 0 for rc in rcs.values()):
+                return HostGroupResult(attempts=attempt + 1,
+                                       hosts=hosts, losses=losses,
+                                       recovery_s=recovery_s)
+            # Hang channel: a host whose last heartbeat (or spawn, if
+            # it never beat) is older than the deadline.
+            for hid, p in procs.items():
+                if rcs[hid] is not None:
+                    continue
+                age = ages.get(hid, now - spawn_t)
+                if age > deadline_s:
+                    lost = hid
+                    lost_reason = f"heartbeat {age:.1f}s old"
+                    break
+            if lost is not None:
+                break
+
+        detection_t = time.time()
+        _log(f"host {lost} lost ({lost_reason}); killing the wedged "
+             f"survivors")
+        _kill_group(procs, grace_s)
+        if hosts - 1 < min_hosts:
+            raise HostGroupError(
+                f"host {lost} lost but the group cannot shrink below "
+                f"min_hosts={min_hosts}")
+        if attempt >= retries:
+            raise HostGroupError(
+                f"host {lost} lost but the retry budget ({retries}) "
+                f"is exhausted")
+        losses.append(int(lost))
+        hosts -= 1
+        attempt += 1
+
+
+# ---------------------------------------------------------------------
+# The kill-one-host drill.
+
+def host_loss_drill(tmp_dir: str, *, num_hosts: int = 3,
+                    kill_host: int = 1, kill_poll: int = 3,
+                    deadline_s: float = 120.0) -> dict:
+    """End-to-end host-loss recovery on localhost CPU: train dist-smo
+    across ``num_hosts`` REAL single-device host processes, SIGKILL one
+    mid-run (``DPSVM_FAULT_HOST_KILL``), and require the survivors to
+    reform and land on the uninterrupted group's model.
+
+    Returns the drill facts (for the perf ledger / burst runner):
+    ``host_loss_recovery_s``, the model deltas, attempts, events.
+    Raises on any failed expectation — callers (resilience selfcheck,
+    ``--host-drill``, tests) get a hard gate, not a report to parse.
+
+    Tolerance contract: the survivors' mesh differs from the reference
+    mesh, so agreement is pinned at 1e-4 (the same eps-KKT argument as
+    the kill-shard drill); bitwise agreement, when the tilings happen
+    to coincide, is reported in the result as ``bitwise``.
+    """
+    import numpy as np
+
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.models.io import load_model
+    from dpsvm_tpu.telemetry import load_trace
+    from dpsvm_tpu.observability.schema import validate_trace
+    from dpsvm_tpu.parallel import multihost
+
+    tmp = os.path.abspath(tmp_dir)
+    os.makedirs(tmp, exist_ok=True)
+    x, y = make_blobs(n=64, d=4, seed=11)
+    data = os.path.join(tmp, "drill.csv")
+    with open(data, "w") as fh:
+        for row, label in zip(x, y):
+            fh.write(f"{int(label)}," +
+                     ",".join(f"{v:.9g}" for v in row) + "\n")
+
+    def train_argv(model: str, shards: int, trace: str,
+                   extra: Sequence[str] = ()) -> List[str]:
+        return [sys.executable, "-m", "dpsvm_tpu.cli", "train",
+                "-f", data, "-m", model, "--shards", str(shards),
+                "-c", "1.0", "-g", "0.5", "-e", "1e-12", "-n", "300",
+                "--chunk-iters", "25", "--no-tuned", "--quiet",
+                "--trace-out", trace, *extra]
+
+    # Uninterrupted reference: the same group size, virtual devices in
+    # ONE process (proven bitwise-identical to the real multi-process
+    # run by tests/test_multihost.py).
+    ref_model = os.path.join(tmp, "model_ref.txt")
+    ref_env = multihost.local_host_env(0)
+    flags = [f for f in ref_env["XLA_FLAGS"].split()
+             if "xla_force_host_platform_device_count" not in f]
+    ref_env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={num_hosts}"])
+    ref_env.pop(ENV_HEARTBEAT_DIR, None)
+    subprocess.run(train_argv(ref_model, num_hosts,
+                              os.path.join(tmp, "trace_ref.jsonl")),
+                   env=_clean_child_env(ref_env), check=True,
+                   timeout=deadline_s)
+
+    ck = os.path.join(tmp, "group.npz")
+    hb_dir = os.path.join(tmp, "heartbeats")
+
+    def make_argv(hid: int, hosts: int, coordinator: str,
+                  attempt: int) -> List[str]:
+        return train_argv(
+            os.path.join(tmp, f"model_h{hid}_a{attempt}.txt"), hosts,
+            os.path.join(tmp, f"trace_h{hid}_a{attempt}.jsonl"),
+            extra=["--coordinator", coordinator,
+                   "--num-hosts", str(hosts), "--host-id", str(hid),
+                   "--checkpoint", ck, "--checkpoint-every", "50",
+                   "--checkpoint-keep", "2"])
+
+    t0 = time.time()
+    res = run_host_group(
+        make_argv, num_hosts=num_hosts, heartbeat_dir=hb_dir,
+        checkpoint_path=ck, retries=1, deadline_s=30.0,
+        first_attempt_env={int(kill_host): {
+            "DPSVM_FAULT_HOST_KILL": str(int(kill_poll))}})
+    wall_s = time.time() - t0
+
+    if res.losses != [int(kill_host)]:
+        raise AssertionError(
+            f"drill expected host {kill_host} lost, got {res.losses}")
+    if res.hosts != num_hosts - 1:
+        raise AssertionError(
+            f"drill expected a reformed {num_hosts - 1}-host group, "
+            f"got {res.hosts}")
+
+    ref = load_model(ref_model)
+    got = load_model(os.path.join(tmp, "model_h0_a1.txt"))
+    if ref.alpha.shape != got.alpha.shape:
+        raise AssertionError(
+            f"drill: recovered SV set differs in size "
+            f"({got.alpha.shape} vs {ref.alpha.shape})")
+    coef_delta = float(np.max(np.abs(
+        np.asarray(ref.alpha) * np.asarray(ref.y_sv)
+        - np.asarray(got.alpha) * np.asarray(got.y_sv))))
+    b_delta = float(abs(float(ref.b) - float(got.b)))
+    if coef_delta > 1e-4 or b_delta > 1e-4:
+        raise AssertionError(
+            f"drill: recovered model disagrees with the uninterrupted "
+            f"{num_hosts}-host run (coef delta {coef_delta:g}, b delta "
+            f"{b_delta:g}, tolerance 1e-4)")
+    bitwise = bool(coef_delta == 0.0 and b_delta == 0.0
+                   and np.array_equal(np.asarray(ref.x_sv),
+                                      np.asarray(got.x_sv)))
+
+    # The reformed attempt's trace must carry the recovery story and
+    # stay schema-valid: host_lost -> reform -> (reshard) -> resume.
+    trace = load_trace(os.path.join(tmp, "trace_h0_a1.jsonl"))
+    events = [r["event"] for r in trace if r.get("kind") == "event"]
+    for want in ("host_lost", "reform"):
+        if want not in events:
+            raise AssertionError(
+                f"drill: reformed trace missing {want} "
+                f"(events: {events})")
+    if events.index("host_lost") > events.index("reform"):
+        raise AssertionError(
+            f"drill: host_lost must precede reform (events: {events})")
+    errs = validate_trace(trace)
+    if errs:
+        raise AssertionError(
+            f"drill: reformed trace fails schema validation: {errs}")
+
+    facts = {
+        "metric": "host_loss_recovery_s",
+        "host_loss_recovery_s": round(res.recovery_s, 3),
+        "drill_wall_s": round(wall_s, 3),
+        "hosts": num_hosts,
+        "surviving_hosts": res.hosts,
+        "losses": res.losses,
+        "attempts": res.attempts,
+        "coef_delta": coef_delta,
+        "b_delta": b_delta,
+        "bitwise": bitwise,
+    }
+    # Perf-ledger row (observability/ledger.py; DPSVM_PERF_LEDGER=""
+    # disables): recovery latency is a gated robustness metric —
+    # regressions in detection or reformation show up in `dpsvm perf`
+    # exactly like a throughput drop.
+    from dpsvm_tpu.observability import ledger
+    ledger.append("host_loss_drill", facts, kind="robust",
+                  value=facts["host_loss_recovery_s"], unit="s",
+                  direction="lower")
+    return facts

@@ -53,7 +53,7 @@ from dpsvm_tpu.observability import compilewatch
 from dpsvm_tpu.observability import metrics as metricslib
 from dpsvm_tpu.observability import profiler as profilerlib
 from dpsvm_tpu.observability.device import memory_snapshot
-from dpsvm_tpu.resilience import elastic, faultinject, preempt
+from dpsvm_tpu.resilience import elastic, faultinject, hostgroup, preempt
 from dpsvm_tpu.resilience.health import (DesyncError, DivergenceError,
                                          HealthMonitor)
 from dpsvm_tpu.utils import watchdog
@@ -209,7 +209,16 @@ def read_stats(stats) -> ChunkStats:
     recorded arrays stay readable. The deterministic NaN fault
     (resilience/faultinject.py) poisons the result HERE — the one point
     every consumer (driver loop, benchmarks) reads device state."""
-    s = np.asarray(stats)       # blocks until the chunk's stats land
+    if getattr(stats, "is_fully_addressable", True):
+        s = np.asarray(stats)   # blocks until the chunk's stats land
+    else:
+        # Cross-process mesh (multi-host): the per-shard probe tail is
+        # sharded over processes, so the packed array is not fully
+        # addressable here — assemble it with the same multihost-safe
+        # gather the final (alpha, f) read-back uses. Every host polls
+        # at every chunk, so the collective is symmetric.
+        from dpsvm_tpu.parallel.mesh import to_host
+        s = to_host(stats)
     watchdog.pet()
     b = s[1:3].view(np.float32)
     extra = [int(v) for v in s[3:STATS_WIDTH]]
@@ -278,6 +287,19 @@ def begin_trace(config: SVMConfig, n: int, d: int, gamma: float,
     attempt = os.environ.get("DPSVM_RETRY_ATTEMPT", "").strip()
     if attempt.isdigit():
         trace.event("retry", n_iter=it0, attempt=int(attempt))
+    # A post-host-loss attempt announces the reformation the same way
+    # (resilience/hostgroup.py sets the markers): the dead host first,
+    # then the group change — so one trace tells the recovery story
+    # even though each attempt is a separate process writing a fresh
+    # file.
+    lost = os.environ.get("DPSVM_HOST_LOST", "").strip()
+    if lost.isdigit():
+        trace.event("host_lost", n_iter=it0, host_id=int(lost))
+    rf = os.environ.get("DPSVM_REFORM_FROM", "").strip()
+    rt = os.environ.get("DPSVM_REFORM_TO", "").strip()
+    if rf.isdigit() and rt.isdigit():
+        trace.event("reform", n_iter=it0, from_hosts=int(rf),
+                    to_hosts=int(rt))
     for event, extra in pending:
         trace.event(event, **extra)
     return trace
@@ -488,6 +510,10 @@ def host_training_loop(
 
     def snapshot(n_iter: int, b_lo: float, b_hi: float) -> SolverCheckpoint:
         # Closure over the loop's CURRENT carry (the cell, not a copy).
+        # sys.modules, not an import: a process that never loaded
+        # parallel.multihost is single-host by construction, and
+        # importing it here would cycle through dpsvm_tpu.parallel.
+        mh = sys.modules.get("dpsvm_tpu.parallel.multihost")
         alpha, f = carry_to_host(carry)
         return SolverCheckpoint(
             alpha=alpha, f=f, n_iter=n_iter, b_lo=b_lo, b_hi=b_hi,
@@ -497,8 +523,10 @@ def host_training_loop(
             weight_neg=float(config.weight_neg),
             kernel=config.kernel, coef0=float(config.coef0),
             degree=int(config.degree),
-            shards=int(shards))     # shard-aware manifest + per-shard
+            shards=int(shards),     # shard-aware manifest + per-shard
                                     # CRCs (utils/checkpoint.py)
+            host_count=mh.host_count() if mh is not None else 1,
+            host_id=mh.host_id() if mh is not None else 0)
 
     try:
         with _debug_nans(config.debug_nans), preempt.trap():
@@ -533,6 +561,15 @@ def host_training_loop(
                                         shard=lost - 1, shards=shards)
                         raise elastic.ShardLostError(lost - 1, shards,
                                                      n_iter)
+                if faults is not None and faults.host_kill_now():
+                    # Host-loss drill: a REAL host death — no cleanup,
+                    # no snapshot, no atexit. The group supervisor
+                    # (resilience/hostgroup.py) must notice the exit /
+                    # heartbeat silence from OUTSIDE and reform.
+                    os.kill(os.getpid(), signal.SIGKILL)
+                # Liveness for that supervisor and `dpsvm doctor`:
+                # no-op outside a host group.
+                hostgroup.note_poll_heartbeat(n_iter)
                 shard_ages = (heartbeats.note_poll(st.shard_probes)
                               if heartbeats is not None else None)
                 # Device/compiler facts for this poll, all host-side

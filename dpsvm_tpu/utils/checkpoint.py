@@ -54,10 +54,14 @@ from dpsvm_tpu.config import SVMConfig
 # "precomputed" is -t 4 (the row data IS the (n, n) kernel matrix).
 _KERNEL_T = ("linear", "poly", "rbf", "sigmoid", "precomputed")
 
-#: On-disk format version stored in the ``mesh`` array. 2 = the elastic
+#: On-disk format version stored in the ``mesh`` array. 3 = the
+#: multi-host manifest (adds the saving group's host_count/host_id to
+#: the mesh array — informational: a host-count difference alone is
+#: NEVER a mismatch, resume re-shards exactly like a device-count
+#: change); 2 = the elastic
 #: shard-aware manifest (mesh shape + per-shard CRCs); files without the
 #: array are version 1 (pre-elastic) and load as single-shard records.
-CKPT_FORMAT_VERSION = 2
+CKPT_FORMAT_VERSION = 3
 
 
 def shard_slices(n: int, shards: int) -> "List[tuple]":
@@ -120,6 +124,13 @@ class SolverCheckpoint:
     # Pre-elastic files read as shards=1, shard_crcs=None.
     shards: int = 1
     shard_crcs: "Optional[np.ndarray]" = None
+    # Multi-host manifest (CKPT_FORMAT_VERSION 3): the host group the
+    # state was saved under. Informational — the state is the GLOBAL
+    # (alpha, f) either way, so a different current group re-shards on
+    # load exactly like a device-count change; never a mismatch.
+    # Pre-v3 files read as host_count=1, host_id=0.
+    host_count: int = 1
+    host_id: int = 0
 
     def mesh_desc(self) -> str:
         """Human mesh summary for error messages and logs."""
@@ -236,7 +247,18 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint,
                     keep: int = 1) -> None:
     """Atomic write (tmp + rename) with an embedded payload CRC32;
     ``keep > 1`` rotates the previous file(s) to ``.1``/``.2``/… slots
-    first, so the newest write can never destroy the only intact state."""
+    first, so the newest write can never destroy the only intact state.
+
+    Multi-host: every host builds the snapshot (the read-back is a
+    collective all hosts must enter symmetrically) but only host 0
+    touches the shared path — N hosts racing the same tmp+rename would
+    interleave rotations. sys.modules, not an import: a process that
+    never loaded parallel.multihost cannot be a non-zero host, and
+    importing it here would cycle through dpsvm_tpu.parallel."""
+    import sys
+    mh = sys.modules.get("dpsvm_tpu.parallel.multihost")
+    if mh is not None and mh.host_id() != 0:
+        return
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     alpha, f, scalars = _payload(
@@ -251,7 +273,10 @@ def save_checkpoint(path: str, ckpt: SolverCheckpoint,
     # Elastic manifest: the save-time mesh + per-shard CRCs over the
     # shard_slices partition (docs/DISTRIBUTED.md "Elastic training").
     shards = max(int(getattr(ckpt, "shards", 1) or 1), 1)
-    mesh = np.asarray([CKPT_FORMAT_VERSION, shards], np.int64)
+    mesh = np.asarray(
+        [CKPT_FORMAT_VERSION, shards,
+         max(int(getattr(ckpt, "host_count", 1) or 1), 1),
+         max(int(getattr(ckpt, "host_id", 0) or 0), 0)], np.int64)
     shard_crc = _shard_crcs(alpha, f, shards)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     try:
@@ -379,6 +404,11 @@ def load_checkpoint(path: str) -> SolverCheckpoint:
             f"unreadable checkpoint {path}: "
             f"{type(e).__name__}: {e}{where}") from e
     shards = int(mesh[1]) if mesh is not None and len(mesh) > 1 else 1
+    # v3 host-group fields; v2 (and pre-elastic) files read as the
+    # single-host defaults — back-compat pinned by tests/fixtures/
+    # ckpt_pre_elastic.npz and ckpt_v2.npz.
+    host_count = int(mesh[2]) if mesh is not None and len(mesh) > 2 else 1
+    host_id = int(mesh[3]) if mesh is not None and len(mesh) > 3 else 0
     if stored_crc is not None:
         actual = _crc32(*_payload(alpha, f, s))
         if actual != stored_crc:
@@ -412,6 +442,8 @@ def load_checkpoint(path: str) -> SolverCheckpoint:
         degree=int(s[12]) if len(s) > 12 else 3,
         shards=shards,
         shard_crcs=shard_crc,
+        host_count=host_count,
+        host_id=host_id,
     )
 
 

@@ -138,3 +138,150 @@ def test_two_process_initialize_and_psum(tmp_path):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"RANK{rank}_TRAIN_OK" in out, out
         assert f"RANK{rank}_OK" in out, out
+
+
+# --------------------------------------------------------------------
+# Uninitialized single process: every multihost hook must be a no-op
+# (today's only production mode — pinned so the multi-host machinery
+# can never perturb it).
+# --------------------------------------------------------------------
+
+def test_uninitialized_single_process_identity():
+    from dpsvm_tpu.parallel import multihost
+
+    assert multihost.host_count() == 1
+    assert multihost.host_id() == 0
+
+
+def test_uninitialized_allgather_is_pure_numpy():
+    import numpy as np
+
+    from dpsvm_tpu.parallel import multihost
+
+    got = multihost.host_allgather(np.asarray([1.5, 2.5], np.float32))
+    assert isinstance(got, np.ndarray)
+    assert got.shape == (1, 2)
+    np.testing.assert_array_equal(got[0], [1.5, 2.5])
+    # scalars wrap the same way
+    assert multihost.host_allgather(7).shape == (1,)
+
+
+def test_coordinator_reachable_probe():
+    from dpsvm_tpu.parallel import multihost
+
+    # malformed address: named as such, no socket touched
+    why = multihost.coordinator_reachable("not-an-address")
+    assert why is not None and "malformed" in why
+    # nothing listening: unreachable with the deadline in the reason
+    port = multihost.find_free_port()
+    why = multihost.coordinator_reachable(f"127.0.0.1:{port}",
+                                          timeout_s=2.0)
+    assert why is not None and "unreachable" in why
+    # a live listener: reachable -> None
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    try:
+        ok_port = s.getsockname()[1]
+        assert multihost.coordinator_reachable(
+            f"127.0.0.1:{ok_port}", timeout_s=5.0) is None
+    finally:
+        s.close()
+
+
+def test_local_host_env_pins_one_device():
+    from dpsvm_tpu.parallel import multihost
+
+    base = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8 "
+                         "--xla_something_else",
+            "PATH": "/bin"}
+    env = multihost.local_host_env(2, base=base)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["DPSVM_HOST_ID"] == "2"
+    assert "--xla_force_host_platform_device_count=1" in env["XLA_FLAGS"]
+    assert "device_count=8" not in env["XLA_FLAGS"]
+    assert "--xla_something_else" in env["XLA_FLAGS"]
+    assert env["PATH"] == "/bin"
+
+
+# --------------------------------------------------------------------
+# CLI flag validation + the single-host bit-identity pin
+# --------------------------------------------------------------------
+
+def test_cli_host_flags_require_coordinator(capsys):
+    from dpsvm_tpu import cli
+
+    rc = cli.main(["train", "-f", "x.csv", "-m", "m.svm",
+                   "--num-hosts", "2"])
+    assert rc == 2
+    assert "require --coordinator" in capsys.readouterr().err
+
+
+def test_cli_host_flags_must_come_together(capsys):
+    from dpsvm_tpu import cli
+
+    rc = cli.main(["train", "-f", "x.csv", "-m", "m.svm",
+                   "--coordinator", "127.0.0.1:1", "--num-hosts", "2"])
+    assert rc == 2
+    assert "together" in capsys.readouterr().err
+
+
+def test_cli_host_id_range_checked(capsys):
+    from dpsvm_tpu import cli
+
+    rc = cli.main(["train", "-f", "x.csv", "-m", "m.svm",
+                   "--coordinator", "127.0.0.1:1",
+                   "--num-hosts", "2", "--host-id", "5"])
+    assert rc == 2
+    assert "out of range" in capsys.readouterr().err
+
+
+def test_single_host_train_never_initializes_and_is_deterministic(
+        tmp_path, monkeypatch):
+    """The PR's bit-identity pin: `dpsvm train` WITHOUT --coordinator
+    must never touch jax.distributed (monkeypatched to explode) and
+    must stay byte-deterministic with no host events in its trace —
+    the single-host path is provably untouched by the multi-host
+    machinery."""
+    import numpy as np
+
+    from dpsvm_tpu import cli
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.parallel import multihost
+    from dpsvm_tpu.telemetry import load_trace
+
+    def boom(*a, **kw):
+        raise AssertionError("initialize must not be called without "
+                             "--coordinator")
+
+    monkeypatch.setattr(multihost, "initialize", boom)
+    x, y = make_blobs(n=48, d=4, seed=3)
+    data = tmp_path / "d.csv"
+    with open(data, "w") as fh:
+        for row, label in zip(x, y):
+            fh.write(f"{int(label)}," +
+                     ",".join(f"{v:.9g}" for v in row) + "\n")
+
+    def run(k):
+        model = tmp_path / f"m{k}.svm"
+        trace = tmp_path / f"t{k}.jsonl"
+        rc = cli.main(["train", "-f", str(data), "-m", str(model),
+                       "-c", "1.0", "-g", "0.5", "-e", "1e-12",
+                       "-n", "100", "--chunk-iters", "25",
+                       "--no-tuned", "--quiet",
+                       "--trace-out", str(trace)])
+        assert rc == 0
+        return model.read_bytes(), load_trace(str(trace))
+
+    m0, t0 = run(0)
+    m1, t1 = run(1)
+    assert m0 == m1                       # byte-identical model files
+    events = [r["event"] for r in t0 if r.get("kind") == "event"]
+    assert "host_lost" not in events and "reform" not in events
+    # the two traces tell the same numeric story (timestamps differ)
+    c0 = [(r["n_iter"], r["b_lo"], r["b_hi"]) for r in t0
+          if r.get("kind") == "chunk"]
+    c1 = [(r["n_iter"], r["b_lo"], r["b_hi"]) for r in t1
+          if r.get("kind") == "chunk"]
+    assert c0 == c1 and len(c0) > 0
+    assert np.isfinite([v for row in c0 for v in row[1:]]).all()
