@@ -159,6 +159,18 @@ TAGS = [
     sub("host_loss_drill", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.resilience",
          "--host-drill"]),
+    # Straggler drill (docs/OBSERVABILITY.md "Fleet",
+    # resilience/hostgroup.py straggler_drill): three localhost host
+    # processes, a planted per-poll hang on host 1, and the whole
+    # fleet observability plane must NAME it — merged trace lanes,
+    # the iteration-skew rule, the federated metrics table and the
+    # fleet incident bundle. Headline is straggler_behind_s (mean
+    # seconds host 1 held the group per matched chunk; also a
+    # perf-ledger "robust" row tagged host_count=3, direction lower).
+    # Same localhost-CPU caveat as host_loss_drill on chip rounds.
+    sub("straggler_drill", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.resilience",
+         "--straggler-drill"]),
     # Streaming-ingest fault drill: the data selfcheck's convert ->
     # stream-train -> quarantine (injected corrupt shard + transient
     # read failure) -> bitwise-resume -> byte-identical-manifest loop

@@ -931,6 +931,43 @@ def build_parser() -> argparse.ArgumentParser:
     tns.add_argument("--timeout", type=float, default=10.0,
                      help="--url fetch timeout seconds")
 
+    fl = sub.add_parser(
+        "fleet", help="multi-host metrics federation: fold N hosts' "
+                      "`train --metrics-out` snapshot files and/or "
+                      "live /metricsz URLs into ONE fleet table + "
+                      "Prometheus exposition (counters summed, ages "
+                      "maxed, group iteration min'ed, per-host lanes "
+                      "under a bounded `host` label) "
+                      "(docs/OBSERVABILITY.md 'Fleet')")
+    fl.add_argument("sources", nargs="+", metavar="SRC",
+                    help="per-host sources: metrics snapshot files "
+                         "(metrics_h0.prom ...) and/or base URLs of "
+                         "live `train --metrics-port` / `dpsvm "
+                         "serve` processes; host ids parse from the "
+                         "names (h0/host-1/...), else positional")
+    fl.add_argument("--hosts-dir", default=None, metavar="DIR",
+                    help="hostgroup heartbeat directory (--coordinator "
+                         "runs write host-K.json there): joins "
+                         "generation/seq liveness into the table")
+    fl.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the federated Prometheus "
+                         "exposition here (the fleet-level "
+                         "--metrics-out; '-' = stdout)")
+    fl.add_argument("--watch", action="store_true",
+                    help="evaluate the fleet alert rules (default: "
+                         "the built-in fleet set — heartbeat-stale "
+                         "page, reform-storm page, iteration-skew "
+                         "warn) against one federated sample and use "
+                         "the watch exit codes (4 warn / 5 page)")
+    fl.add_argument("--rules", default=None, metavar="FILE",
+                    help="alert-rules JSON for --watch (default: the "
+                         "built-in fleet rules)")
+    fl.add_argument("--json", action="store_true",
+                    help="machine-readable fleet snapshot instead of "
+                         "the table")
+    fl.add_argument("--timeout", type=float, default=5.0,
+                    help="per-URL fetch timeout seconds (default 5)")
+
     tn = sub.add_parser(
         "tune", help="measure this backend's throughput-critical "
                      "knobs (successive-halving probes through the "
@@ -2220,6 +2257,64 @@ def cmd_tenants(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fleet(args: argparse.Namespace) -> int:
+    """`dpsvm fleet`: N hosts' metrics sources -> one fleet snapshot
+    (docs/OBSERVABILITY.md "Fleet"). Pure HTTP/file I/O — no backend
+    init, so it runs from any box that can reach the hosts. Exit
+    codes: 0 = rendered clean, 2 = unusable source list, 3 = a host
+    was unreachable/unreadable, and with --watch the `dpsvm watch`
+    codes on top (4 = warn fired, 5 = page fired)."""
+    import json
+
+    from dpsvm_tpu.observability import fleet, slo
+
+    try:
+        state = fleet.collect(args.sources, timeout=args.timeout)
+        heartbeats = (fleet.read_heartbeats(args.hosts_dir)
+                      if args.hosts_dir else None)
+        snap = fleet.federate(state, heartbeats=heartbeats)
+    except fleet.FleetError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        expo = fleet.render_exposition(snap)
+        if args.out == "-":
+            _pipe_safe_print(expo)
+        else:
+            with open(args.out, "w") as fh:
+                fh.write(expo)
+    tower = None
+    if args.watch:
+        try:
+            tower = slo.Watchtower(slo.load_rules(args.rules,
+                                                  default="fleet"))
+        except (OSError, ValueError, slo.RuleError) as e:
+            print(f"error: bad rules: {e}", file=sys.stderr)
+            return 2
+        tower.observe(fleet.fleet_watch_sample(snap))
+    down = sorted(h for h, d in snap["hosts"].items()
+                  if not d.get("up"))
+    if args.json:
+        digest = dict(snap, down=down)
+        if tower is not None:
+            digest["alerts"] = tower.states()
+        _pipe_safe_print(json.dumps(digest))
+    else:
+        text = fleet.render_fleet_table(snap)
+        if down:
+            text += ("\n  UNREACHABLE host(s): "
+                     + ", ".join(str(h) for h in down))
+        if tower is not None:
+            firing = tower.firing()
+            text += ("\n  alerts: " + ("; ".join(
+                f"{s['rule']} {s['severity'].upper()} ({s['reason']})"
+                for s in firing) if firing else "none firing"))
+        _pipe_safe_print(text)
+    if tower is not None and tower.exit_code():
+        return tower.exit_code()
+    return 3 if down else 0
+
+
 def cmd_tune(args: argparse.Namespace) -> int:
     """Measure + persist this backend's tuned profile (docs/PERF.md
     "Autotuning"; tuning/tuner.py)."""
@@ -2373,7 +2468,7 @@ def cmd_report(args: argparse.Namespace) -> int:
     growing)."""
     import json
 
-    from dpsvm_tpu.telemetry import (follow_trace, load_trace,
+    from dpsvm_tpu.telemetry import (follow_trace, load_trace_auto,
                                      render_report, resolve_trace_path,
                                      summarize_trace)
 
@@ -2381,15 +2476,23 @@ def cmd_report(args: argparse.Namespace) -> int:
     if args.follow:
         # The trace may not exist yet (watching a run about to start):
         # resolve directories when possible, else follow the raw path.
+        # A multi-host trace family cannot be followed live — name one
+        # host's file (or report the directory after the run).
         try:
             path = resolve_trace_path(args.trace)
         except FileNotFoundError:
             path = args.trace
+        except ValueError as e:
+            print(f"error: --follow needs one trace: {e}",
+                  file=sys.stderr)
+            return 2
         return follow_trace(path, interval=max(args.interval, 0.01),
                             stall_timeout=args.stall_timeout,
                             width=width)
     try:
-        records = load_trace(resolve_trace_path(args.trace))
+        # a directory holding a multi-host trace_h* family is MERGED
+        # onto one fleet timeline (per-host lanes in the rendering)
+        records = load_trace_auto(args.trace)
     except FileNotFoundError as e:
         print(f"error: no such trace: {e}", file=sys.stderr)
         return 2
@@ -2915,6 +3018,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_loadgen(args)
         if args.command == "tenants":
             return cmd_tenants(args)
+        if args.command == "fleet":
+            return cmd_fleet(args)
         return cmd_test(args)
     except PreemptedError as e:
         # Resumable by design: the supervisor (or the next manual run)

@@ -462,11 +462,21 @@ def _git_sha() -> Optional[str]:
 
 def dump_bundle(out_dir: str, *, recorder: FlightRecorder,
                 rule: str, severity: str, window: str, reason: str,
-                registry=None, extra: Optional[dict] = None) -> str:
+                registry=None, extra: Optional[dict] = None,
+                host_artifacts: Optional[Dict[int, dict]] = None
+                ) -> str:
     """Write one self-contained incident bundle; returns its
     directory. Never raises — a failed dump logs to stderr and
     returns "" (the incident response must not take the producer
-    down with it)."""
+    down with it).
+
+    ``host_artifacts`` makes this a FLEET bundle (docs/OBSERVABILITY.md
+    "Fleet"): host id -> ``{"heartbeat": dict, "trace_tail": [lines],
+    "doctor": str}`` (observability/fleet.host_artifacts collects it),
+    landing as ``host-<k>-heartbeat.json`` / ``host-<k>-trace-tail
+    .jsonl`` / ``host-<k>-doctor.txt`` entries in the file inventory —
+    so one bundle carries every group member's last words, not just
+    the dumping process's own ring."""
     global _DUMP_SEQ
     try:
         with _DUMP_LOCK:
@@ -525,6 +535,31 @@ def dump_bundle(out_dir: str, *, recorder: FlightRecorder,
                 for rec in tail:
                     fh.write(json.dumps(rec) + "\n")
             files["perf_ledger"] = "perf_ledger.jsonl"
+
+        # 4b. per-host artifacts (fleet bundles): written before the
+        # manifest so a listed file always exists
+        for hid in sorted(host_artifacts or {}):
+            art = host_artifacts[hid]
+            if not isinstance(art, dict):
+                continue
+            if art.get("heartbeat") is not None:
+                fname = f"host-{hid}-heartbeat.json"
+                with open(os.path.join(path, fname), "w") as fh:
+                    json.dump(art["heartbeat"], fh, indent=1)
+                files[f"host_{hid}_heartbeat"] = fname
+            tail_lines = art.get("trace_tail")
+            if tail_lines:
+                fname = f"host-{hid}-trace-tail.jsonl"
+                with open(os.path.join(path, fname), "w") as fh:
+                    for line in tail_lines:
+                        fh.write(line if line.endswith("\n")
+                                 else line + "\n")
+                files[f"host_{hid}_trace_tail"] = fname
+            if art.get("doctor"):
+                fname = f"host-{hid}-doctor.txt"
+                with open(os.path.join(path, fname), "w") as fh:
+                    fh.write(str(art["doctor"]))
+                files[f"host_{hid}_doctor"] = fname
 
         # 5. the manifest, written LAST: an incident.json implies a
         # complete bundle

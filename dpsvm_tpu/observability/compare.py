@@ -27,6 +27,7 @@ import math
 from typing import List, Optional, Tuple
 
 from dpsvm_tpu.observability.report import (load_trace,
+                                            load_trace_auto,
                                             resolve_trace_path,
                                             trace_facts)
 
@@ -233,8 +234,20 @@ def render_compare(cmp: dict, label_a: str = "A",
 def compare_paths(path_a: str, path_b: str, marks: int = 4
                   ) -> Tuple[dict, str, str]:
     """Resolve (file or directory), load+validate, compare. Returns
-    (comparison, resolved_a, resolved_b)."""
-    ra = resolve_trace_path(path_a)
-    rb = resolve_trace_path(path_b)
-    return (compare_traces(load_trace(ra), load_trace(rb), marks=marks),
-            ra, rb)
+    (comparison, resolved_a, resolved_b). A directory holding a
+    multi-host ``trace_h*`` family resolves to itself and compares the
+    MERGED fleet timeline (report.load_trace_auto) — never one
+    arbitrary host's view of a group run."""
+    import os
+
+    def _load(path: str) -> Tuple[List[dict], str]:
+        if os.path.isdir(path):
+            from dpsvm_tpu.observability import merge as _merge
+            if len(_merge.discover_family(path)) > 1:
+                return load_trace_auto(path), path
+        resolved = resolve_trace_path(path)
+        return load_trace(resolved), resolved
+
+    recs_a, ra = _load(path_a)
+    recs_b, rb = _load(path_b)
+    return compare_traces(recs_a, recs_b, marks=marks), ra, rb

@@ -155,12 +155,25 @@ def direction_for(record: dict) -> str:
     return "higher"
 
 
+def host_count_hint() -> int:
+    """The provenance host count: ``DPSVM_HOST_COUNT`` (set by the
+    hostgroup supervisor for its children) when parseable, else 1 —
+    the single-process default every pre-fleet row implicitly had."""
+    raw = os.environ.get("DPSVM_HOST_COUNT", "").strip()
+    try:
+        n = int(raw)
+        return n if n >= 1 else 1
+    except ValueError:
+        return 1
+
+
 def make_record(case: str, metrics: Optional[dict] = None, *,
                 kind: str = "bench", value: Optional[float] = None,
                 unit: Optional[str] = None,
                 direction: Optional[str] = None,
                 trace: Optional[str] = None,
-                backend: Optional[str] = None) -> dict:
+                backend: Optional[str] = None,
+                host_count: Optional[int] = None) -> dict:
     metrics = dict(metrics or {})
     if value is None:
         v = metrics.get("value")
@@ -172,6 +185,10 @@ def make_record(case: str, metrics: Optional[dict] = None, *,
         "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         "git_sha": git_sha(),
         "backend": backend if backend is not None else backend_hint(),
+        # multi-host provenance: a 3-host row must never gate against
+        # single-host history (docs/OBSERVABILITY.md "Fleet")
+        "host_count": (int(host_count) if host_count is not None
+                       else host_count_hint()),
         "value": value,
         "unit": unit if unit is not None else metrics.get("unit"),
         "direction": direction,
@@ -184,6 +201,7 @@ def append(case: str, metrics: Optional[dict] = None, *,
            kind: str = "bench", value: Optional[float] = None,
            unit: Optional[str] = None, direction: Optional[str] = None,
            trace: Optional[str] = None, backend: Optional[str] = None,
+           host_count: Optional[int] = None,
            path: Optional[str] = None,
            strict: bool = False) -> Optional[str]:
     """Append one record; returns the ledger path written (None when
@@ -193,7 +211,8 @@ def append(case: str, metrics: Optional[dict] = None, *,
     if resolved is None:
         return None
     rec = make_record(case, metrics, kind=kind, value=value, unit=unit,
-                      direction=direction, trace=trace, backend=backend)
+                      direction=direction, trace=trace, backend=backend,
+                      host_count=host_count)
     try:
         parent = os.path.dirname(os.path.abspath(resolved))
         os.makedirs(parent, exist_ok=True)
@@ -272,13 +291,29 @@ def gate(records: Sequence[dict], *, window: int = 5,
     comes from the newest record (``direction``/``unit``/name
     heuristics). Cases with fewer than 2 readings have no history to
     gate and are skipped.
+
+    Provenance filter: only rows whose ``host_count`` matches the
+    newest record's (absent = 1, the pre-fleet default) count as
+    history — a 3-host reading regressing against single-host medians
+    (or propping them up) would be a category error, not a trend
+    (docs/OBSERVABILITY.md "Fleet").
     """
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     targets = [case] if case else cases(records)
     verdicts = []
+
+    def _hc(rec: dict) -> int:
+        v = rec.get("host_count")
+        return int(v) if isinstance(v, int) and not isinstance(
+            v, bool) and v >= 1 else 1
+
     for c in targets:
         hist = series(records, c, metric=metric)
+        if len(hist) < 2:
+            continue
+        want_hc = _hc(hist[-1]["record"])
+        hist = [h for h in hist if _hc(h["record"]) == want_hc]
         if len(hist) < 2:
             continue
         newest = hist[-1]

@@ -274,6 +274,13 @@ class RunTrace:
             "config": config_d,
             "it0": int(it0),
             "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            # High-resolution wall-clock anchor for cross-host merge
+            # (observability/merge.py): `t` values are perf_counter
+            # offsets from THIS instant, so hosts sharing a wall clock
+            # (one machine, or an NTP-synced pod) align exactly via
+            # unix_k - unix_ref — the only anchor a constant straggler
+            # lag cannot contaminate.
+            "unix": time.time(),
         })
         _OPEN_TRACES.add(self)
 

@@ -30,15 +30,49 @@ def load_trace(path: str) -> List[dict]:
 def resolve_trace_path(path: str) -> str:
     """A trace argument may be a directory (the burst runner archives
     under ``<results>/traces/``): resolve to its newest ``*.jsonl``.
-    Plain files pass through untouched."""
+    Plain files pass through untouched.
+
+    A directory holding a MULTI-host ``trace_h{K}_a{N}`` family
+    (resilience/hostgroup.py) is refused with the host list: "newest
+    file" would silently answer for one arbitrary host of a group run.
+    Callers that can merge use ``load_trace_auto`` instead."""
     if not os.path.isdir(path):
         return path
+    from dpsvm_tpu.observability import merge as _merge
+    family = _merge.discover_family(path)
+    if len(family) > 1:
+        raise ValueError(
+            f"{path}: holds a {len(family)}-host trace family "
+            f"(hosts {', '.join(str(h) for h in sorted(family))}) — "
+            "a single newest file would be one arbitrary host's view. "
+            "Use `dpsvm report` on the directory (merges the family) "
+            "or name one host's file explicitly.")
     candidates = [os.path.join(path, f) for f in os.listdir(path)
                   if f.endswith(".jsonl")]
     if not candidates:
         raise FileNotFoundError(
             f"no *.jsonl trace in directory {path}")
     return max(candidates, key=os.path.getmtime)
+
+
+def load_trace_auto(path: str) -> List[dict]:
+    """``load_trace`` that understands group runs: a directory holding
+    a multi-host ``trace_h*`` family is MERGED onto one fleet timeline
+    (observability/merge.py) and validated; anything else resolves to
+    a single file exactly like before. The entry point behind ``dpsvm
+    report``/``compare``, so a 3-host run dir renders per-host lanes
+    instead of silently picking one host's trace."""
+    if os.path.isdir(path):
+        from dpsvm_tpu.observability import merge as _merge
+        family = _merge.discover_family(path)
+        if len(family) > 1:
+            records = _merge.merge_paths(family)
+            errors = validate_trace(records)
+            if errors:
+                raise ValueError(f"merged trace family {path} is "
+                                 "invalid: " + "; ".join(errors))
+            return records
+    return load_trace(resolve_trace_path(path))
 
 
 def trace_facts(records: List[dict]) -> dict:
@@ -306,6 +340,99 @@ def tenant_attribution(records: List[dict],
     }
 
 
+def host_lanes(records: List[dict]) -> Optional[dict]:
+    """Per-host lane digest of a merged fleet trace (schema v5,
+    observability/merge.py): iteration progress, phase split and
+    straggler attribution per host, plus the group-level recovery
+    events. None when no record carries a ``host`` tag (single-host
+    traces — every pre-v5 consumer sees no change).
+
+    Straggler attribution: chunk records with the same ``n_iter`` are
+    the same group-wide instant (the collectives inside a chunk are a
+    barrier), so each host's mean ``t`` excess over the leader at the
+    matched iterations IS the time that host held the group — the
+    per-host answer to "whose dispatch stalls the collective"."""
+    tagged = [r for r in records if isinstance(r.get("host"), int)]
+    if not tagged:
+        return None
+    hosts = sorted({r["host"] for r in tagged})
+    # matched-iteration anchors: first chunk t per (n_iter, host)
+    anchors: Dict[int, Dict[int, float]] = {}
+    lanes: Dict[int, dict] = {
+        h: {"host": h, "chunks": 0, "n_iter": 0, "last_t": None,
+            "behind_s": None, "iter_lag": 0, "converged": None,
+            "train_seconds": None, "phases": {}, "events": []}
+        for h in hosts}
+    for r in tagged:
+        h = r["host"]
+        kind = r.get("kind")
+        if kind == "chunk":
+            lane = lanes[h]
+            lane["chunks"] += 1
+            lane["n_iter"] = max(lane["n_iter"],
+                                 int(r.get("n_iter", 0) or 0))
+            lane["last_t"] = r.get("t")
+            lane["phases"] = dict(r.get("phases") or lane["phases"])
+            by_host = anchors.setdefault(int(r.get("n_iter", 0) or 0),
+                                         {})
+            t = r.get("t")
+            if isinstance(t, (int, float)) and h not in by_host:
+                by_host[h] = float(t)
+        elif kind == "event":
+            ev = r.get("event")
+            if ev == "host_summary":
+                lanes[h]["converged"] = r.get("converged")
+                lanes[h]["train_seconds"] = r.get("train_seconds")
+                lanes[h]["n_iter"] = max(lanes[h]["n_iter"],
+                                         int(r.get("n_iter", 0) or 0))
+            else:
+                lanes[h]["events"].append(str(ev))
+    # mean time behind the leader over the matched iterations
+    behind: Dict[int, List[float]] = {h: [] for h in hosts}
+    for _n, by_host in anchors.items():
+        if len(by_host) < 2:
+            continue
+        lead = min(by_host.values())
+        for h, t in by_host.items():
+            behind[h].append(t - lead)
+    for h in hosts:
+        if behind[h]:
+            lanes[h]["behind_s"] = round(
+                sum(behind[h]) / len(behind[h]), 6)
+    max_iter = max(lane["n_iter"] for lane in lanes.values())
+    for lane in lanes.values():
+        lane["iter_lag"] = max_iter - lane["n_iter"]
+    # the straggler: the host that held the group, when one stands out
+    straggler = None
+    scored = [(lane["behind_s"] or 0.0, lane["iter_lag"], h)
+              for h, lane in lanes.items()]
+    worst = max(scored)
+    if worst[0] > 0.005 or worst[1] > 0:
+        straggler = worst[2]
+    # group-level recovery events, deduplicated across the hosts that
+    # each recorded their own copy
+    group_events: List[dict] = []
+    seen = set()
+    for r in records:
+        if r.get("kind") != "event" or r.get("event") not in (
+                "host_lost", "reform"):
+            continue
+        key = (r["event"], r.get("host_id"), r.get("from_hosts"),
+               r.get("to_hosts"), r.get("n_iter"))
+        if key in seen:
+            continue
+        seen.add(key)
+        group_events.append({k: r.get(k) for k in (
+            "event", "n_iter", "t", "host_id", "from_hosts",
+            "to_hosts")})
+    return {
+        "hosts": [lanes[h] for h in hosts],
+        "straggler": straggler,
+        "max_iter": max_iter,
+        "group_events": group_events,
+    }
+
+
 def summarize_trace(records: List[dict]) -> dict:
     """The machine-readable digest ``dpsvm report --json`` prints."""
     manifest = records[0] if records else {}
@@ -323,6 +450,7 @@ def summarize_trace(records: List[dict]) -> dict:
         "facts": trace_facts(records),
         "spans": span_attribution(records),
         "tenants": tenant_attribution(records),
+        "fleet": host_lanes(records),
         "curve": [{"n_iter": c["n_iter"], "gap": c["gap"],
                    "n_sv": c["n_sv"], "t": c["t"]} for c in chunks],
     }
@@ -433,6 +561,49 @@ def render_report(records: List[dict], width: int = 60) -> str:
     else:
         out.append("result: (no summary record — run still in flight "
                    "or killed)")
+    fleet = host_lanes(records)
+    if fleet is not None:
+        out.append("")
+        out.append(f"fleet: {len(fleet['hosts'])} host lane(s) merged "
+                   "— docs/OBSERVABILITY.md \"Fleet\"")
+        out.append(f"  {'host':>4}  {'chunks':>6} {'iter':>9} "
+                   f"{'lag':>6} {'behind':>9}  {'done':>5}  phases")
+        for lane in fleet["hosts"]:
+            behind = (f"{lane['behind_s']:+.3f}s"
+                      if lane["behind_s"] is not None else "n/a")
+            done = ("yes" if lane["converged"]
+                    else "NO" if lane["converged"] is not None
+                    else "?")
+            ph = " ".join(
+                f"{k}={v:.2f}s" for k, v in sorted(
+                    (lane["phases"] or {}).items(),
+                    key=lambda kv: -kv[1])[:3])
+            mark = (" <- straggler"
+                    if lane["host"] == fleet["straggler"] else "")
+            out.append(f"  {lane['host']:>4}  {lane['chunks']:>6,} "
+                       f"{lane['n_iter']:>9,} {lane['iter_lag']:>6,} "
+                       f"{behind:>9}  {done:>5}  {ph}{mark}")
+        if fleet["straggler"] is not None:
+            lane = next(x for x in fleet["hosts"]
+                        if x["host"] == fleet["straggler"])
+            why = []
+            if lane["behind_s"]:
+                why.append(f"avg {lane['behind_s']:.3f}s behind the "
+                           "leader at matched iterations")
+            if lane["iter_lag"]:
+                why.append(f"{lane['iter_lag']:,} iterations behind "
+                           "the fastest host")
+            out.append(f"  straggler: host {fleet['straggler']} "
+                       f"({'; '.join(why) or 'slowest lane'})")
+        for ge in fleet["group_events"]:
+            if ge["event"] == "host_lost":
+                out.append(f"  group: host_lost(host "
+                           f"{ge.get('host_id')})@"
+                           f"{ge.get('n_iter', 0):,}")
+            else:
+                out.append(f"  group: reform {ge.get('from_hosts')}->"
+                           f"{ge.get('to_hosts')} hosts@"
+                           f"{ge.get('n_iter', 0):,}")
     # Device/compiler layer (schema v2; silent on v1 traces, which
     # carry none of these facts). A v2 trace whose backend reports no
     # allocator stats / cost model (CPU) renders an explicit `n/a` —
@@ -535,9 +706,13 @@ def render_report(records: List[dict], width: int = 60) -> str:
         out.append(f"quarantined shards: {len(quarantines)} "
                    f"({rows:,} rows dropped; shard {shards_q}) — "
                    "see docs/DATA.md")
-    if events:
+    shown_events = [e for e in events
+                    if fleet is None
+                    or e.get("event") not in ("host_summary",
+                                              "host_lost", "reform")]
+    if shown_events:
         out.append("events: " + ", ".join(
-            f"{e['event']}@{e['n_iter']:,}" for e in events))
+            f"{e['event']}@{e['n_iter']:,}" for e in shown_events))
     spans = span_attribution(records)
     if spans is not None:
         out.append("")

@@ -55,6 +55,20 @@ read. Version history:
   kinds, no new required keys — so every v3 consumer reads a v4
   trace unchanged and v1/v2/v3 traces keep validating
   (tests/fixtures/trace_v{1,2,3}.jsonl).
+* v5 — the merged-fleet trace (observability/merge.py,
+  docs/OBSERVABILITY.md "Fleet"): N per-host traces of one group run
+  combined into ONE clock-aligned stream where every record carries a
+  ``host`` tag. Single-host producers keep writing v4
+  (``TRACE_SCHEMA_VERSION``); only the merger stamps
+  ``FLEET_SCHEMA_VERSION``. What changes at >= 5: chunk ``n_iter``
+  monotonicity is checked PER HOST LANE (interleaved hosts progress
+  independently; a rewind event tagged with ``host`` resets only that
+  lane, an untagged ``reform`` resets the whole group), and each
+  host's own final summary is demoted by the merger to a
+  ``host_summary`` event so the one-summary rule still holds for the
+  synthesized fleet summary. ``t`` stays globally non-decreasing —
+  clock alignment is the merger's job, and a merged trace that
+  rewinds time is a broken merge.
 """
 
 from __future__ import annotations
@@ -63,7 +77,10 @@ import json
 from typing import IO, Dict, List, Optional
 
 TRACE_SCHEMA_VERSION = 4
-SUPPORTED_SCHEMAS = (1, 2, 3, 4)
+#: schema stamped by observability/merge.py on a merged multi-host
+#: trace — the only producer of v5; single-host writers stay at v4
+FLEET_SCHEMA_VERSION = 5
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5)
 
 # Required keys per record kind. Values may be null where noted in
 # docs/OBSERVABILITY.md (e.g. env.device_kind on an uninitialized
@@ -228,8 +245,8 @@ def validate_trace(records: List[dict]) -> List[str]:
     Contract (acceptance bar of docs/OBSERVABILITY.md): exactly one
     leading manifest at a supported schema version (the version selects
     the per-kind key sets — v1 traces keep validating); >= 0 chunk
-    records with monotone non-decreasing n_iter and non-negative
-    counters; ``t`` non-decreasing across every record that carries it;
+    records with monotone non-decreasing n_iter (per ``host`` lane in
+    a v5 merged trace) and non-negative counters; ``t`` non-decreasing across every record that carries it;
     at most one summary, followed only by terminal events (stall /
     preempt — the emergency flush paths). A ``rollback`` event
     legitimately rewinds the run to its checkpoint's iteration
@@ -270,7 +287,12 @@ def validate_trace(records: List[dict]) -> List[str]:
            for r in records) > 1:
         errors.append("multiple manifest records")
 
-    prev_iter = None
+    # chunk n_iter monotonicity baselines. Pre-v5 traces have exactly
+    # one lane (key None); a v5 merged trace interleaves N hosts that
+    # progress independently, so each ``host`` tag is its own lane.
+    fleet = isinstance(schema, int) and not isinstance(schema, bool) \
+        and schema >= 5
+    prev_iter_by_lane: Dict[object, int] = {}
     prev_t = None
     summary_at = None
     saw_screen = False
@@ -297,10 +319,14 @@ def validate_trace(records: List[dict]) -> List[str]:
             if miss:
                 errors.append(f"record {i}: chunk missing keys {miss}")
                 continue
-            if prev_iter is not None and r["n_iter"] < prev_iter:
+            lane = r.get("host") if fleet else None
+            base = prev_iter_by_lane.get(lane)
+            if base is not None and r["n_iter"] < base:
+                where = (f" in host {lane} lane"
+                         if fleet and lane is not None else "")
                 errors.append(f"record {i}: n_iter {r['n_iter']} < "
-                              f"previous {prev_iter} (not monotone)")
-            prev_iter = r["n_iter"]
+                              f"previous {base} (not monotone{where})")
+            prev_iter_by_lane[lane] = r["n_iter"]
             for k in ("n_sv", "cache_hits", "cache_misses", "rounds"):
                 if r[k] < 0:
                     errors.append(f"record {i}: {k} = {r[k]} < 0")
@@ -313,7 +339,15 @@ def validate_trace(records: List[dict]) -> List[str]:
             elif r.get("event") in REWIND_EVENTS:
                 # The run restarted from a checkpoint at this iteration
                 # (rollback), possibly on a different mesh (reshard).
-                prev_iter = r["n_iter"]
+                # In a merged fleet trace a host-tagged rewind resets
+                # only that host's lane; an untagged one (a group-wide
+                # reform) resets every lane seen so far.
+                if fleet and "host" not in r:
+                    prev_iter_by_lane = {
+                        k: r["n_iter"] for k in prev_iter_by_lane}
+                else:
+                    prev_iter_by_lane[
+                        r.get("host") if fleet else None] = r["n_iter"]
             elif r.get("event") == "refresh":
                 if r.get("refresh_kind") not in REFRESH_KINDS:
                     errors.append(

@@ -57,6 +57,17 @@ Rule kinds (specs are plain dicts — JSON on disk, Python inline):
   attribution") — queue wait is the cost a tenant imposes on its
   NEIGHBOURS, so a dominant queue-wait share is the isolation alarm
   even when the tenant's own latency still looks fine.
+* ``skew`` — straggler detection for a multi-host group: the lag
+  between the fastest and the slowest host's ``host:<k>:<metric>``
+  lane (normally ``n_iter``), MEANED over a full ``window_s`` of
+  samples, above ``lag_above``. The mean — not the instantaneous gap
+  — because a healthy group shows a transient gap at every collective
+  boundary (the fast host publishes first), while a straggler holds
+  the gap open across the whole window. The firing names the laggard
+  (the host with the lowest mean progress): the reason carries the
+  literal ``skew[host-K]`` and the transition/state a ``host`` key,
+  so the fleet incident bundle can attribute the stall
+  (docs/OBSERVABILITY.md "Fleet").
 
 **Per-tenant templates.** A rule spec carrying ``"per_tenant": true``
 is a TEMPLATE, not a rule: the ``Watchtower`` discovers active
@@ -71,6 +82,14 @@ not name a culprit. Templates round-trip verbatim through
 ``RuleSet.to_specs()``; expanded rules live only inside the tower,
 and their transitions/states carry a ``tenant`` key so incident
 bundles can name the tenant (serving/server.py ``_on_alert``).
+
+**Per-host templates.** The same pattern over the fleet sample's
+``host:<k>:<metric>`` lanes (observability/fleet.py): a spec carrying
+``"per_host": true`` expands into one concrete rule per active host
+(named ``template[host-K]``, ``{host}`` substituted), capped at
+``host_cap`` — so a 3-host group's heartbeat-stale page watches three
+lanes from one template, and a 300-host fleet cannot explode alert
+cardinality.
 
 Severities and exit codes (the ``dpsvm watch`` contract): ``warn`` ->
 exit 4, ``page`` -> exit 5; no alert -> 0; a stale/unreachable source
@@ -98,7 +117,7 @@ EXIT_WARN = 4
 EXIT_PAGE = 5
 
 RULE_KINDS = ("burn_rate", "threshold", "rate", "stagnation",
-              "drop_vs_baseline", "fair_share")
+              "drop_vs_baseline", "fair_share", "skew")
 
 #: The overflow pseudo-tenant (mirrors metrics.TENANT_OTHER — pinned
 #: equal in tests/test_watch.py so the two stay one vocabulary without
@@ -114,6 +133,16 @@ TENANT_FAN_OUT_CAP = 32
 #: is greedy so tenant names containing ``:`` still parse (the metric
 #: suffix never contains one).
 _TENANT_KEY_RE = re.compile(r"^tenant:(?P<tenant>.+):(?P<metric>[^:]+)$")
+
+#: Default cap on per-template host fan-out (the fleet twin of
+#: TENANT_FAN_OUT_CAP).
+HOST_FAN_OUT_CAP = 32
+
+#: ``host:<k>:<metric>`` — the flattened per-host sample lanes the
+#: fleet federation layer builds (observability/fleet.py
+#: fleet_watch_sample). Host ids are integers, so the pattern is
+#: strict where the tenant one is greedy.
+_HOST_KEY_RE = re.compile(r"^host:(?P<host>\d+):(?P<metric>.+)$")
 
 
 class RuleError(ValueError):
@@ -181,7 +210,29 @@ class Rule:
         self.tenant = spec.get("tenant")
         if self.tenant is not None:
             self.tenant = str(self.tenant)
-        if k == "fair_share":
+        self.per_host = bool(spec.get("per_host"))
+        self.host = spec.get("host")
+        if self.host is not None:
+            try:
+                self.host = int(self.host)
+            except (TypeError, ValueError):
+                raise RuleError(f"rule {self.name!r}: host must be an "
+                                f"integer, got {spec.get('host')!r}")
+        #: skew only: the laggard host the last evaluation attributed
+        self._laggard: Optional[int] = None
+        if k == "skew":
+            if self.per_host:
+                raise RuleError(
+                    f"rule {self.name!r}: skew is inherently "
+                    "cross-host — it reads every host:<k> lane "
+                    "itself; 'per_host' templating would watch one "
+                    "host against nobody")
+            self.metric = str(spec.get("metric") or "n_iter")
+            self.window_s = _num(spec, "window_s", required=True,
+                                 positive=True)
+            self.lag_above = _num(spec, "lag_above", required=True,
+                                  positive=True)
+        elif k == "fair_share":
             if not self.per_tenant and not self.tenant:
                 raise RuleError(
                     f"rule {self.name!r}: fair_share needs 'tenant' "
@@ -275,7 +326,7 @@ class Rule:
     def _keep_window_s(self) -> float:
         if self.kind == "burn_rate":
             return self.slow_window_s
-        if self.kind in ("rate", "stagnation", "fair_share"):
+        if self.kind in ("rate", "stagnation", "fair_share", "skew"):
             return self.window_s
         # threshold / drop_vs_baseline hold no history beyond the
         # debounce; keep the larger debounce span
@@ -387,6 +438,48 @@ class Rule:
                     f"over {self.window_s:g}s across {n_active} "
                     f"active tenants (threshold "
                     f"{self.share_above:.0%})")
+        if self.kind == "skew":
+            vals: Dict[int, float] = {}
+            suffix = f":{self.metric}"
+            for key, val in sample.items():
+                m = _HOST_KEY_RE.match(key)
+                if (m is not None and key.endswith(suffix)
+                        and m.group("metric") == self.metric
+                        and isinstance(val, (int, float))
+                        and math.isfinite(float(val))):
+                    vals[int(m.group("host"))] = float(val)
+            if len(vals) < 2:
+                # a lone host has nobody to lag behind; explicitly
+                # healthy (not no-verdict) so a firing clears when the
+                # rest of the group drains away
+                return (False, "") if self._samples else (None, "")
+            self._samples.append((t, vals))
+            self._prune(t)
+            # a FULL window before any verdict (the rate/fair_share
+            # contract): every collective boundary opens a transient
+            # gap while the fast host's publish races the slow one's,
+            # so only a gap that SURVIVES the whole window is a
+            # straggler
+            if t - self._samples[0][0] < self.window_s:
+                return None, ""
+            inside = [(ts, hv) for ts, hv in self._samples
+                      if ts >= t - self.window_s and len(hv) >= 2]
+            if len(inside) < 2:
+                return None, ""
+            lag = sum(max(hv.values()) - min(hv.values())
+                      for _, hv in inside) / len(inside)
+            # the laggard: lowest mean progress over the window
+            sums: Dict[int, List[float]] = {}
+            for _, hv in inside:
+                for h, v in hv.items():
+                    sums.setdefault(h, []).append(v)
+            means = {h: sum(vs) / len(vs) for h, vs in sums.items()}
+            self._laggard = min(means, key=lambda h: (means[h], h))
+            return (lag > self.lag_above,
+                    f"skew[host-{self._laggard}]: {self.metric} lag "
+                    f"{lag:.3g} between fastest and slowest of "
+                    f"{len(means)} hosts over {self.window_s:g}s "
+                    f"(threshold {self.lag_above:g})")
         v = sample.get(self.metric)
         if v is None:
             return None, ""
@@ -481,11 +574,16 @@ class Rule:
         if self.kind == "burn_rate":
             return (f"fast={self.fast_window_s:g}s/"
                     f"slow={self.slow_window_s:g}s")
-        if self.kind in ("rate", "stagnation", "fair_share"):
+        if self.kind in ("rate", "stagnation", "fair_share", "skew"):
             return f"{self.window_s:g}s"
         if self.for_s:
             return f"for={self.for_s:g}s"
         return "instant"
+
+    def _attributed_host(self) -> Optional[int]:
+        """The host a firing names: the spec pin (a per_host
+        expansion), else the skew laggard."""
+        return self.host if self.host is not None else self._laggard
 
     def _transition(self, state: str, t: float) -> dict:
         out = {"rule": self.name, "kind": self.kind,
@@ -494,6 +592,9 @@ class Rule:
                "t": round(float(t), 6)}
         if self.tenant:
             out["tenant"] = self.tenant
+        host = self._attributed_host()
+        if host is not None:
+            out["host"] = host
         return out
 
     def state(self) -> dict:
@@ -505,6 +606,9 @@ class Rule:
                "fired_count": self.fired_count}
         if self.tenant:
             out["tenant"] = self.tenant
+        host = self._attributed_host()
+        if host is not None:
+            out["host"] = host
         return out
 
     def to_dict(self) -> dict:
@@ -623,6 +727,31 @@ def expand_tenant_rule(spec: dict, tenant: str) -> dict:
     return out
 
 
+def active_hosts(sample: Dict[str, float]) -> List[int]:
+    """Host ids present in a sample's ``host:<k>:<metric>`` lanes,
+    sorted — the ``per_host`` expansion source."""
+    seen = set()
+    for key in sample:
+        m = _HOST_KEY_RE.match(key)
+        if m is not None:
+            seen.add(int(m.group("host")))
+    return sorted(seen)
+
+
+def expand_host_rule(spec: dict, host: int) -> dict:
+    """One concrete rule spec from a ``per_host`` template:
+    ``{host}`` substituted into the metric/counter names, the rule
+    renamed ``template[host-K]`` and pinned to the host."""
+    out = {k: v for k, v in spec.items() if k != "per_host"}
+    out["name"] = f"{spec.get('name')}[host-{host}]"
+    out["host"] = int(host)
+    for key in ("metric", "good", "bad"):
+        v = out.get(key)
+        if isinstance(v, str) and "{host}" in v:
+            out[key] = v.replace("{host}", str(host))
+    return out
+
+
 class Watchtower:
     """A RuleSet plus the evaluation loop state: feed samples, get
     transitions; thread-safe (serving feeds from handler threads).
@@ -639,7 +768,8 @@ class Watchtower:
 
     def __init__(self, rules, *,
                  clock: Optional[Callable[[], float]] = None,
-                 tenant_cap: int = TENANT_FAN_OUT_CAP):
+                 tenant_cap: int = TENANT_FAN_OUT_CAP,
+                 host_cap: int = HOST_FAN_OUT_CAP):
         if isinstance(rules, RuleSet):
             self.ruleset = rules
         else:
@@ -649,38 +779,53 @@ class Watchtower:
         self._worst_fired: Optional[str] = None
         self.transitions_total = 0
         self.tenant_cap = max(1, int(tenant_cap))
+        self.host_cap = max(1, int(host_cap))
         # template name -> {tenant -> concrete Rule}
         self._tenant_rules: Dict[str, Dict[str, Rule]] = {
             r.name: {} for r in self.ruleset if r.per_tenant}
+        # template name -> {host -> concrete Rule}
+        self._host_rules: Dict[str, Dict[int, Rule]] = {
+            r.name: {} for r in self.ruleset if r.per_host}
 
     def _expand(self, sample: Dict[str, float]) -> None:
         """Lock held. Materialize concrete rules for newly-active
-        tenants, within the per-template cap."""
-        tenants = None
+        tenants/hosts, within the per-template caps."""
+        tenants = hosts = None
         for template in self.ruleset:
-            if not template.per_tenant:
-                continue
-            if tenants is None:
-                tenants = active_tenants(sample)
-                if not tenants:
-                    return
-            expanded = self._tenant_rules[template.name]
-            for ten in tenants:
-                if ten in expanded:
-                    continue
-                if len(expanded) >= self.tenant_cap:
-                    break
-                expanded[ten] = Rule(
-                    expand_tenant_rule(template.spec, ten))
+            if template.per_tenant:
+                if tenants is None:
+                    tenants = active_tenants(sample)
+                expanded = self._tenant_rules[template.name]
+                for ten in tenants:
+                    if ten in expanded:
+                        continue
+                    if len(expanded) >= self.tenant_cap:
+                        break
+                    expanded[ten] = Rule(
+                        expand_tenant_rule(template.spec, ten))
+            elif template.per_host:
+                if hosts is None:
+                    hosts = active_hosts(sample)
+                hexp = self._host_rules[template.name]
+                for h in hosts:
+                    if h in hexp:
+                        continue
+                    if len(hexp) >= self.host_cap:
+                        break
+                    hexp[h] = Rule(
+                        expand_host_rule(template.spec, h))
 
     def _live_rules(self) -> List[Rule]:
         """Lock held. Evaluation order: concrete base rules, then the
         expansions of each template (templates themselves never see a
         sample — their metric names still hold the placeholder)."""
-        out = [r for r in self.ruleset if not r.per_tenant]
+        out = [r for r in self.ruleset
+               if not r.per_tenant and not r.per_host]
         for template in self.ruleset:
             if template.per_tenant:
                 out.extend(self._tenant_rules[template.name].values())
+            elif template.per_host:
+                out.extend(self._host_rules[template.name].values())
         return out
 
     def observe(self, sample: Dict[str, float],
@@ -796,12 +941,43 @@ def default_training_rules(
     ]
 
 
+def default_fleet_rules() -> List[dict]:
+    """The multi-host group rules ``dpsvm fleet --watch`` and the
+    straggler drill arm by default (docs/OBSERVABILITY.md "Fleet"):
+
+    * a paging per-host heartbeat-stale threshold — expanded over the
+      ``host:<k>:heartbeat_age_seconds`` lanes the federation layer
+      builds, so a silent host pages by NAME;
+    * a paging reform-storm rate — the group ``generation`` counter
+      (every reformation increments it: resilience/hostgroup.py)
+      climbing faster than ~3 reformations / 10 min means the group is
+      thrashing, not recovering;
+    * the warning ``skew`` rule on per-host iteration progress — one
+      chunk of sustained lag (the drill plants 25-iteration chunks) is
+      a straggler, the transient collective-boundary gap is not.
+    """
+    return [
+        {"name": "host-heartbeat-stale", "kind": "threshold",
+         "severity": "page", "per_host": True,
+         "metric": "host:{host}:heartbeat_age_seconds",
+         "above": 120.0, "for_s": 0.0, "clear_after_s": 0.0},
+        {"name": "reform-storm", "kind": "rate", "severity": "page",
+         "metric": "generation", "window_s": 600.0, "above": 0.005,
+         "clear_after_s": 120.0},
+        {"name": "iteration-skew", "kind": "skew", "severity": "warn",
+         "metric": "n_iter", "window_s": 30.0, "lag_above": 20.0,
+         "clear_after_s": 10.0},
+    ]
+
+
 def load_rules(source, *, default: str = "serving") -> RuleSet:
-    """Resolve a rules argument: None -> the named default set, a path
-    -> ``RuleSet.from_file``, a list of specs / a RuleSet -> as-is."""
+    """Resolve a rules argument: None -> the named default set
+    (``serving``/``training``/``fleet``), a path ->
+    ``RuleSet.from_file``, a list of specs / a RuleSet -> as-is."""
     if source is None:
-        specs = (default_serving_rules() if default == "serving"
-                 else default_training_rules())
+        specs = {"serving": default_serving_rules,
+                 "training": default_training_rules,
+                 "fleet": default_fleet_rules}[default]()
         return RuleSet.from_specs(specs)
     if isinstance(source, RuleSet):
         return source

@@ -7,6 +7,10 @@ the kill-one-HOST drill (real subprocesses; resilience/hostgroup.py);
 ``--host-drill`` runs ONLY that drill and prints its facts as a final
 JSON line — the burst runner's ``host_loss_drill`` tag harvests the
 ``host_loss_recovery_s`` metric from it (benchmarks/burst_runner.py).
+``--straggler-drill`` runs the fleet-observability acceptance drill
+(planted per-poll hang on one host; merged trace + skew rule + metrics
+federation + incident bundle must all name it) the same way — the
+burst runner's ``straggler_drill`` tag harvests ``straggler_behind_s``.
 """
 
 from __future__ import annotations
@@ -37,11 +41,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "checkpoint; prints the drill facts "
                         "(host_loss_recovery_s, model deltas) as a "
                         "final JSON line")
+    p.add_argument("--straggler-drill", action="store_true",
+                   help="run the fleet-observability straggler drill: "
+                        "3 localhost hosts with a planted per-poll "
+                        "hang on host 1; asserts the merged trace, "
+                        "skew rule, metrics federation, and fleet "
+                        "incident bundle all name the straggler; "
+                        "prints the drill facts as a final JSON line")
     args = p.parse_args(argv)
-    if not (args.selfcheck or args.host_drill):
+    if not (args.selfcheck or args.host_drill or args.straggler_drill):
         p.print_help()
         return 2
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.straggler_drill:
+        # Pure supervisor process, same as --host-drill: hosts are
+        # subprocesses; this process never initialises jax.
+        from dpsvm_tpu.resilience import hostgroup
+
+        with tempfile.TemporaryDirectory() as td:
+            facts = hostgroup.straggler_drill(td)
+        print("straggler drill OK: "
+              f"host {facts['straggler']} behind "
+              f"{facts['straggler_behind_s']:.2f}s over "
+              f"{facts['hosts']} hosts, skew fired "
+              f"{facts['skew_fired']}x, bundle validated",
+              file=sys.stderr)
+        print(json.dumps(facts))
+        return 0
     if args.host_drill:
         # Pure supervisor process: the hosts are subprocesses with
         # their own (single-device) jax; this process touches none.

@@ -344,14 +344,42 @@ def _hostgroup_probe(coordinator: Optional[str],
                 continue
             age = ages.get(hid, float("inf"))
             stale = age > max_age_s
+            # seq vs wall-clock disagreement (docs/OBSERVABILITY.md
+            # "Fleet"): the heartbeat's own wall stamp `t` older than
+            # the file mtime says by more than the staleness budget
+            # means the writer's clock stepped BACKWARD mid-run — the
+            # record is fresh (seq advanced, mtime young) but its
+            # timestamp lies. A stalled host is the opposite shape:
+            # old mtime AND old t, seq frozen.
+            seq = rec.get("seq")
+            clock_note = ""
+            t_rec = rec.get("t")
+            if isinstance(t_rec, (int, float)):
+                try:
+                    mtime = os.path.getmtime(
+                        hostgroup.heartbeat_path(hosts_dir, hid))
+                    drift = mtime - float(t_rec)
+                    if not stale and drift > max_age_s:
+                        clock_note = (f" — wall clock stepped back "
+                                      f"{drift:.0f}s (seq {seq} is "
+                                      "fresh; trust seq, not t)")
+                except OSError:
+                    pass
             out(f"hostgroup: host {hid}: beat {age:.1f}s ago, "
                 f"iter {rec.get('n_iter')}, "
+                f"seq {seq if seq is not None else '-'}, "
                 f"generation {rec.get('generation')}, "
                 f"pid {rec.get('pid')}"
-                + (f" — STALE (> {max_age_s:g}s)" if stale else ""))
+                + (f" — STALE (> {max_age_s:g}s, seq frozen at "
+                   f"{seq})" if stale else "")
+                + clock_note)
             if stale:
                 degraded.append(f"host {hid} heartbeat {age:.1f}s old "
                                 f"(> {max_age_s:g}s)")
+            elif clock_note:
+                degraded.append(f"host {hid} wall clock stepped "
+                                "backward (heartbeat t older than "
+                                "file mtime)")
     if multihost.is_initialized():
         import numpy as np
         got = multihost.host_allgather(multihost.host_id())

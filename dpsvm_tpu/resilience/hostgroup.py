@@ -43,8 +43,13 @@ plain single-host run, where every hook here is a no-op):
 
 Fault hooks (resilience/faultinject.py): ``DPSVM_FAULT_HOST_KILL=m``
 self-SIGKILLs one host at its m-th poll — the drill's real host death;
-``DPSVM_FAULT_HOST_HANG_MS=t`` delays every admission poll — the
-planted straggler.
+``DPSVM_FAULT_HOST_HANG_MS=t`` delays every poll-boundary heartbeat
+publish AND every admission poll — the planted straggler. The sleep
+sits BEFORE the publish (and before the driver's chunk record, which
+follows this hook in the poll loop), so the lag is visible exactly
+where a real straggler's would be: a stale heartbeat, a trailing
+``host:<k>:n_iter`` lane in the fleet sample, and late chunk records
+in the merged trace (observability/merge.py).
 """
 
 from __future__ import annotations
@@ -84,18 +89,25 @@ def heartbeat_path(hb_dir: str, host_id: int) -> str:
 
 
 def write_heartbeat(hb_dir: str, host_id: int, n_iter: int,
-                    generation: int = 0) -> None:
+                    generation: int = 0, seq: int = 0) -> None:
     """Atomically publish this host's liveness fact. tmp + rename so a
     concurrent reader (supervisor, doctor, a peer's barrier poll) never
     parses a torn record; the file mtime is the liveness clock, so ages
-    work even when writer and reader disagree about wall time."""
+    work even when writer and reader disagree about wall time.
+
+    ``seq`` is the writer's monotonic publish counter: a reader seeing
+    the SAME seq twice knows the host stalled, while a record whose
+    wall-clock ``t`` stepped backwards but whose seq advanced is a
+    clock adjustment, not a stall — the distinction ``dpsvm doctor
+    --hosts-dir`` and the fleet federation layer report
+    (docs/OBSERVABILITY.md "Fleet")."""
     os.makedirs(hb_dir, exist_ok=True)
     path = heartbeat_path(hb_dir, host_id)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump({"host_id": int(host_id), "n_iter": int(n_iter),
-                   "generation": int(generation), "t": time.time(),
-                   "pid": os.getpid()}, fh)
+                   "generation": int(generation), "seq": int(seq),
+                   "t": time.time(), "pid": os.getpid()}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
@@ -141,9 +153,19 @@ def heartbeat_ages(hb_dir: str,
 # In-host hooks (driver poll loop, live-ingest admission).
 
 #: This host's last published facts — n_iter from the driver poll,
-#: generation from the admission barrier — merged so either writer
-#: emits the full record.
-_STATE = {"n_iter": 0, "generation": 0}
+#: generation from the admission barrier, seq counting every publish
+#: — merged so either writer emits the full record.
+_STATE = {"n_iter": 0, "generation": 0, "seq": 0}
+
+
+def _fault_hang() -> None:
+    """The planted-straggler sleep (``DPSVM_FAULT_HOST_HANG_MS``),
+    applied before a heartbeat publish so the lag lands where a real
+    straggler's would: stale heartbeat, trailing fleet lane, late
+    chunk records."""
+    hang_ms = os.environ.get("DPSVM_FAULT_HOST_HANG_MS", "").strip()
+    if hang_ms.isdigit() and int(hang_ms):
+        time.sleep(int(hang_ms) / 1000.0)
 
 
 def _group() -> Optional[tuple]:
@@ -170,10 +192,12 @@ def note_poll_heartbeat(n_iter: int) -> None:
     if grp is None:
         return
     hb_dir, hid, _ = grp
+    _fault_hang()
     _STATE["n_iter"] = int(n_iter)
+    _STATE["seq"] = _STATE.get("seq", 0) + 1
     try:
         write_heartbeat(hb_dir, hid, _STATE["n_iter"],
-                        _STATE["generation"])
+                        _STATE["generation"], _STATE["seq"])
     except OSError as e:
         _log(f"heartbeat write failed ({e}); continuing")
 
@@ -199,13 +223,12 @@ def admission_barrier(observed_gen: int, committed_gen: int) -> int:
     if grp is None:
         return int(observed_gen)
     hb_dir, hid, count = grp
-    hang_ms = os.environ.get("DPSVM_FAULT_HOST_HANG_MS", "").strip()
-    if hang_ms.isdigit() and int(hang_ms):
-        time.sleep(int(hang_ms) / 1000.0)
+    _fault_hang()
     _STATE["generation"] = max(_STATE["generation"], int(observed_gen))
+    _STATE["seq"] = _STATE.get("seq", 0) + 1
     try:
         write_heartbeat(hb_dir, hid, _STATE["n_iter"],
-                        _STATE["generation"])
+                        _STATE["generation"], _STATE["seq"])
     except OSError as e:
         _log(f"heartbeat write failed ({e}); holding admission")
         return int(committed_gen)
@@ -568,5 +591,212 @@ def host_loss_drill(tmp_dir: str, *, num_hosts: int = 3,
     from dpsvm_tpu.observability import ledger
     ledger.append("host_loss_drill", facts, kind="robust",
                   value=facts["host_loss_recovery_s"], unit="s",
-                  direction="lower")
+                  direction="lower", host_count=num_hosts)
+    return facts
+
+
+# ---------------------------------------------------------------------
+# The planted-straggler drill (the fleet observability acceptance).
+
+def straggler_drill(tmp_dir: str, *, num_hosts: int = 3,
+                    slow_host: int = 1, hang_ms: int = 400,
+                    deadline_s: float = 240.0) -> dict:
+    """End-to-end straggler attribution on localhost CPU: train
+    dist-smo across ``num_hosts`` real host processes with
+    ``DPSVM_FAULT_HOST_HANG_MS`` planted on ``slow_host``, let the run
+    COMPLETE (a straggler is a slow member, not a dead one — the
+    supervisor must not reform), then require the whole fleet
+    observability plane to name the culprit:
+
+    1. the per-host trace family merges (observability/merge.py) into
+       a schema-valid fleet trace whose lane digest attributes the
+       straggler to ``slow_host`` and leaves the other lanes clean;
+    2. a ``skew`` rule replayed over the merged trace fires
+       ``skew[host-K]`` naming ``slow_host`` and CLEARS once progress
+       drains to a common front;
+    3. the hosts' ``--metrics-out`` sidecars federate (``dpsvm
+       fleet``) into an exposition that passes validate_exposition;
+    4. a fleet incident bundle carries every host's heartbeat, trace
+       tail and doctor line, passes validate_bundle, and its incident
+       names the host.
+
+    Raises AssertionError on any failed expectation; returns the drill
+    facts (ledger row ``straggler_drill``, kind="robust")."""
+    from dpsvm_tpu.data.synthetic import make_blobs
+    from dpsvm_tpu.observability import blackbox, fleet, merge
+    from dpsvm_tpu.observability.report import (host_lanes,
+                                                render_report)
+    from dpsvm_tpu.observability.schema import validate_trace
+    from dpsvm_tpu.observability.slo import Watchtower
+
+    tmp = os.path.abspath(tmp_dir)
+    os.makedirs(tmp, exist_ok=True)
+    x, y = make_blobs(n=64, d=4, seed=11)
+    data = os.path.join(tmp, "drill.csv")
+    with open(data, "w") as fh:
+        for row, label in zip(x, y):
+            fh.write(f"{int(label)}," +
+                     ",".join(f"{v:.9g}" for v in row) + "\n")
+    hb_dir = os.path.join(tmp, "heartbeats")
+    metrics_paths = {hid: os.path.join(tmp, f"metrics_h{hid}.prom")
+                     for hid in range(num_hosts)}
+
+    def make_argv(hid: int, hosts: int, coordinator: str,
+                  attempt: int) -> List[str]:
+        return [sys.executable, "-m", "dpsvm_tpu.cli", "train",
+                "-f", data,
+                "-m", os.path.join(tmp, f"model_h{hid}_a{attempt}.txt"),
+                "--shards", str(hosts),
+                "-c", "1.0", "-g", "0.5", "-e", "1e-12", "-n", "300",
+                "--chunk-iters", "25", "--no-tuned", "--quiet",
+                "--trace-out",
+                os.path.join(tmp, f"trace_h{hid}_a{attempt}.jsonl"),
+                "--metrics-out", metrics_paths[hid],
+                "--coordinator", coordinator,
+                "--num-hosts", str(hosts), "--host-id", str(hid)]
+
+    t0 = time.time()
+    res = run_host_group(
+        make_argv, num_hosts=num_hosts, heartbeat_dir=hb_dir,
+        retries=0, deadline_s=max(30.0, 100.0 * hang_ms / 1000.0),
+        first_attempt_env={int(slow_host): {
+            "DPSVM_FAULT_HOST_HANG_MS": str(int(hang_ms))}})
+    wall_s = time.time() - t0
+    if res.losses or res.hosts != num_hosts:
+        raise AssertionError(
+            f"straggler drill must complete without a reformation, "
+            f"got losses={res.losses} hosts={res.hosts}")
+
+    # 1. merge + lane attribution
+    merged = merge.merge_dir(tmp)
+    errs = validate_trace(merged)
+    if errs:
+        raise AssertionError(
+            f"drill: merged trace fails schema validation: {errs}")
+    merged_path = merge.write_merged(
+        merged, os.path.join(tmp, "trace_fleet.jsonl"))
+    lanes = host_lanes(merged)
+    if lanes is None or lanes.get("straggler") != int(slow_host):
+        raise AssertionError(
+            f"drill: merged lanes did not attribute the straggler to "
+            f"host {slow_host}: {lanes and lanes.get('straggler')}")
+    by_host = {h["host"]: h for h in lanes["hosts"]}
+    slow_behind = float(by_host[int(slow_host)]["behind_s"] or 0.0)
+    for h, lane in by_host.items():
+        if h == int(slow_host):
+            continue
+        if float(lane["behind_s"] or 0.0) >= max(0.5 * slow_behind,
+                                                 hang_ms / 2000.0):
+            raise AssertionError(
+                f"drill: host {h}'s lane is not clean "
+                f"(behind {lane['behind_s']}s vs straggler "
+                f"{slow_behind}s)")
+    report_text = render_report(merged)
+    if f"straggler: host {slow_host}" not in report_text:
+        raise AssertionError(
+            f"drill: report does not name host {slow_host}:\n"
+            f"{report_text}")
+
+    # 2. skew replay over the merged trace: per-host n_iter lanes fed
+    # in fleet-time order, then a synthetic drain (every host at the
+    # common final front) to pin the CLEAR transition.
+    chunks = [r for r in merged
+              if r.get("kind") == "chunk"
+              and isinstance(r.get("host"), int)]
+    span = max(r["t"] for r in chunks) - min(r["t"] for r in chunks)
+    window_s = max(0.5, 0.25 * span)
+    tower = Watchtower([
+        {"name": "iteration-skew", "kind": "skew", "severity": "warn",
+         "metric": "n_iter", "window_s": window_s,
+         "lag_above": 10.0, "clear_after_s": window_s / 2}])
+    latest: Dict[int, float] = {}
+    transitions: List[dict] = []
+    for rec in chunks:
+        latest[rec["host"]] = float(rec["n_iter"])
+        transitions += tower.observe(
+            {f"host:{k}:n_iter": v for k, v in latest.items()},
+            t=float(rec["t"]))
+    t_end = max(r["t"] for r in chunks)
+    front = max(latest.values())
+    drain = {f"host:{k}:n_iter": front for k in latest}
+    step = 0.1
+    t_drain = t_end
+    while t_drain < t_end + 2.0 * window_s + 1.0:
+        t_drain += step
+        transitions += tower.observe(drain, t=t_drain)
+    fired = [tr for tr in transitions if tr["state"] == "firing"
+             and tr["rule"] == "iteration-skew"]
+    cleared = [tr for tr in transitions if tr["state"] == "ok"
+               and tr["rule"] == "iteration-skew"]
+    if not fired or fired[0].get("host") != int(slow_host) \
+            or f"skew[host-{slow_host}]" not in fired[0]["reason"]:
+        raise AssertionError(
+            f"drill: skew[host-{slow_host}] did not fire "
+            f"(transitions: {transitions})")
+    if not cleared:
+        raise AssertionError(
+            "drill: skew did not clear on drain "
+            f"(transitions: {transitions})")
+
+    # 3. metrics federation from the per-host sidecars
+    from dpsvm_tpu.observability.metrics import validate_exposition
+    state = fleet.collect({h: p for h, p in metrics_paths.items()
+                           if os.path.exists(p)})
+    if len(state) != num_hosts:
+        raise AssertionError(
+            f"drill: expected {num_hosts} metrics sidecars, got "
+            f"{sorted(state)}")
+    snap = fleet.federate(state,
+                          heartbeats=fleet.read_heartbeats(hb_dir))
+    expo = fleet.render_exposition(snap)
+    expo_errs = validate_exposition(expo)
+    if expo_errs:
+        raise AssertionError(
+            f"drill: federated exposition invalid: {expo_errs}")
+
+    # 4. the fleet incident bundle
+    arts = fleet.host_artifacts(tmp, hb_dir)
+    if sorted(arts) != list(range(num_hosts)):
+        raise AssertionError(
+            f"drill: expected artifacts for hosts "
+            f"{list(range(num_hosts))}, got {sorted(arts)}")
+    recorder = blackbox.FlightRecorder(
+        blackbox.make_manifest(solver="dist-smo"))
+    recorder.event("skew", n_iter=int(front),
+                   host=int(slow_host))
+    bundle_dir = os.path.join(tmp, "bundles")
+    bundle = blackbox.dump_bundle(
+        bundle_dir, recorder=recorder, rule="iteration-skew",
+        severity="warn", window=f"{window_s:g}s",
+        reason=fired[0]["reason"],
+        extra={"extra": {"host": int(slow_host),
+                         "merged_trace":
+                         os.path.basename(merged_path)}},
+        host_artifacts=arts)
+    problems = blackbox.validate_bundle(bundle)
+    if problems:
+        raise AssertionError(
+            f"drill: fleet bundle invalid: {problems}")
+    with open(os.path.join(bundle, "incident.json")) as fh:
+        incident = json.load(fh)
+    if incident.get("extra", {}).get("host") != int(slow_host) \
+            or f"skew[host-{slow_host}]" not in str(
+                incident.get("reason")):
+        raise AssertionError(
+            f"drill: bundle incident does not name host {slow_host}")
+
+    facts = {
+        "metric": "straggler_behind_s",
+        "straggler_behind_s": round(slow_behind, 3),
+        "drill_wall_s": round(wall_s, 3),
+        "hosts": num_hosts,
+        "straggler": int(slow_host),
+        "hang_ms": int(hang_ms),
+        "skew_fired": len(fired),
+        "bundle": bundle,
+    }
+    from dpsvm_tpu.observability import ledger
+    ledger.append("straggler_drill", facts, kind="robust",
+                  value=facts["straggler_behind_s"], unit="s",
+                  direction="lower", host_count=num_hosts)
     return facts

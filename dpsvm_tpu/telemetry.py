@@ -14,17 +14,20 @@ from dpsvm_tpu.observability import (SOLVER_NAMES,                # noqa: F401
                                      TRACE_SCHEMA_VERSION, RunTrace,
                                      compare_paths, compare_traces,
                                      flush_open_traces, follow_trace,
-                                     load_trace, main, regressions,
-                                     render_compare, render_report,
+                                     host_lanes, load_trace,
+                                     load_trace_auto, main,
+                                     regressions, render_compare,
+                                     render_report,
                                      resolve_trace_path, selfcheck,
                                      summarize_trace, trace_facts,
                                      validate_trace)
 
 __all__ = [
     "TRACE_SCHEMA_VERSION", "RunTrace", "SOLVER_NAMES",
-    "flush_open_traces", "load_trace", "render_report",
-    "summarize_trace", "trace_facts", "resolve_trace_path",
-    "follow_trace", "compare_traces", "compare_paths",
+    "flush_open_traces", "load_trace", "load_trace_auto",
+    "render_report", "summarize_trace", "trace_facts",
+    "resolve_trace_path", "follow_trace", "host_lanes",
+    "compare_traces", "compare_paths",
     "render_compare", "regressions", "selfcheck", "main",
     "validate_trace",
 ]

@@ -1,0 +1,621 @@
+"""Fleet observability plane tests (docs/OBSERVABILITY.md "Fleet").
+
+What must hold, per layer:
+
+* merge      — a ``trace_h*`` family merges onto ONE validator-clean
+               schema-v5 timeline; wall-clock (`unix`) anchors align
+               exactly and never absorb a straggler's lateness; the
+               content-anchor fallback absorbs a planted clock offset;
+               mismatched run fingerprints REFUSE to merge.
+* report     — a family directory auto-merges under `dpsvm report`
+               (per-host lanes, straggler named); the single-trace
+               resolver refuses the family naming the hosts.
+* skew rule  — fires only after a full window, names the laggard
+               host, clears when the lanes level; per-host templates
+               expand within the cap; skew+per_host is a spec error.
+* federation — counters sum, ages max, group iteration mins; the
+               `host` label is budget-bounded with overflow folded
+               into `other`; the exposition stays validator-clean;
+               a dead source is an `up 0` row, not a crash.
+* heartbeats — seq is monotonic per publish; the doctor tells a
+               stalled host (seq frozen) from a wall-clock step-back
+               (seq fresh, t old).
+* ledger     — rows carry host_count and the gate never compares
+               across different host counts.
+* bundles    — per-host artifacts ride the fleet incident bundle and
+               the bundle re-validates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dpsvm_tpu.observability import blackbox, fleet, ledger, merge, slo
+from dpsvm_tpu.observability.metrics import (MetricsRegistry,
+                                             validate_exposition,
+                                             write_snapshot)
+from dpsvm_tpu.observability.record import RunTrace
+from dpsvm_tpu.observability.report import (host_lanes, load_trace,
+                                            load_trace_auto,
+                                            render_report,
+                                            resolve_trace_path)
+from dpsvm_tpu.observability.schema import validate_trace
+from dpsvm_tpu.resilience import hostgroup
+
+
+# ---------------------------------------------------------------------
+# synthetic trace families
+# ---------------------------------------------------------------------
+
+def _template(tmp_path, *, gamma=0.5, chunks=4):
+    """One schema-current run through the REAL writer, reloaded as
+    dicts — the raw material every family below is cut from."""
+    path = os.path.join(str(tmp_path), "template.jsonl")
+    tr = RunTrace(path, config={"kernel": "rbf", "shards": 3,
+                                "shard_x": True, "coef0": 0.0,
+                                "degree": 3},
+                  n=3000, d=16, gamma=gamma, solver="dist-smo", it0=0,
+                  env={"backend": "cpu", "device_kind": "host",
+                       "device_count": 1})
+    for i in range(chunks):
+        tr.chunk(n_iter=(i + 1) * 128, b_lo=0.4 - 0.1 * i,
+                 b_hi=-(0.4 - 0.1 * i), n_sv=40 + i,
+                 cache_hits=i, cache_misses=i, rounds=i,
+                 phases={"dispatch": 0.01, "poll": 0.02})
+    tr.summary(converged=True, n_iter=chunks * 128, b=0.0, b_lo=1e-3,
+               b_hi=-1e-3, n_sv=44, train_seconds=1.0,
+               cache_hits=4, cache_misses=4,
+               phases={"dispatch": 0.04, "poll": 0.08},
+               phase_counts={"dispatch": chunks, "poll": chunks})
+    tr.close()
+    records = load_trace(path)
+    os.unlink(path)
+    return records
+
+
+def _write_family(dirname, per_host_records):
+    os.makedirs(dirname, exist_ok=True)
+    paths = {}
+    for host, records in per_host_records.items():
+        p = os.path.join(dirname, f"trace_h{host}.jsonl")
+        with open(p, "w") as fh:
+            for r in records:
+                fh.write(json.dumps(r) + "\n")
+        paths[host] = p
+    return paths
+
+
+def _host_copy(template, *, unix=None, t_of=None):
+    """A per-host copy of the template with rewritten time axis.
+    ``t_of(chunk_index)`` maps the k-th timed record (1-based) to its
+    local t; ``unix`` sets (or, when None, REMOVES) the manifest's
+    wall-clock anchor."""
+    records = [dict(r) for r in template]
+    if unix is None:
+        records[0].pop("unix", None)
+    else:
+        records[0]["unix"] = float(unix)
+    k = 0
+    for r in records[1:]:
+        if isinstance(r.get("t"), (int, float)):
+            k += 1
+            r["t"] = round(float(t_of(k)), 6)
+    return records
+
+
+def _straggler_family(tmp_path, name="fam", lag=0.4, slow=1):
+    """Three hosts, same wall-clock start, host ``slow`` cumulatively
+    late at every chunk — the planted straggler."""
+    template = _template(tmp_path)
+    fam = os.path.join(str(tmp_path), name)
+    per_host = {}
+    for h in (0, 1, 2):
+        per_lag = lag if h == slow else 0.0
+        per_host[h] = _host_copy(
+            template, unix=1.7e9,
+            t_of=lambda k, extra=per_lag, h=h: k + extra * k + 1e-3 * h)
+    return fam, _write_family(fam, per_host)
+
+
+# ---------------------------------------------------------------------
+# cross-host merge
+# ---------------------------------------------------------------------
+
+def test_merge_family_validates_and_tags_hosts(tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    merged = merge.merge_dir(fam)
+    assert validate_trace(merged) == []
+    assert merged[0]["schema"] == merge.FLEET_SCHEMA_VERSION
+    assert merged[0]["merged"] is True
+    assert sorted(merged[0]["hosts"]) == ["0", "1", "2"]
+    body = merged[1:]
+    assert all(isinstance(r.get("host"), int) for r in body
+               if r.get("kind") == "chunk")
+    ts = [r["t"] for r in body if isinstance(r.get("t"), (int, float))]
+    assert ts == sorted(ts)
+
+
+def test_unix_anchors_align_a_late_start_exactly(tmp_path):
+    """Host 1 started 3 s later by wall clock: the merged timeline
+    places its records 3 s after host 0's, to the microsecond."""
+    template = _template(tmp_path)
+    fam = os.path.join(str(tmp_path), "late")
+    _write_family(fam, {
+        0: _host_copy(template, unix=1.7e9, t_of=lambda k: k),
+        1: _host_copy(template, unix=1.7e9 + 3.0, t_of=lambda k: k),
+    })
+    merged = merge.merge_dir(fam)
+    assert validate_trace(merged) == []
+    assert merged[0]["hosts"]["1"]["offset_s"] == pytest.approx(3.0)
+    by = {(r["host"], r["n_iter"]): r["t"] for r in merged[1:]
+          if r.get("kind") == "chunk"}
+    for n in (128, 256, 384, 512):
+        assert by[(1, n)] - by[(0, n)] == pytest.approx(3.0, abs=1e-6)
+
+
+def test_unix_anchors_do_not_absorb_a_straggler(tmp_path):
+    """The exact trap content anchors fall into: a host uniformly late
+    at every chunk looks like clock skew to a median-of-anchors
+    alignment. Wall-clock anchors keep the lateness visible."""
+    fam, _ = _straggler_family(tmp_path, lag=0.4, slow=1)
+    merged = merge.merge_dir(fam)
+    lanes = host_lanes(merged)
+    assert lanes["straggler"] == 1
+    by = {h["host"]: h for h in lanes["hosts"]}
+    assert by[1]["behind_s"] == pytest.approx(1.0, rel=0.05)
+    for h in (0, 2):
+        assert abs(by[h]["behind_s"] or 0.0) < 0.05
+
+
+def test_chunk_anchors_absorb_a_planted_clock_offset(tmp_path):
+    """No `unix` anchors (pre-fleet producers): a constant +5 s clock
+    offset on host 1 must be aligned away — matched-iteration chunk
+    records land at (approximately) the same merged t."""
+    template = _template(tmp_path)
+    fam = os.path.join(str(tmp_path), "skewed")
+    _write_family(fam, {
+        0: _host_copy(template, unix=None, t_of=lambda k: k),
+        1: _host_copy(template, unix=None, t_of=lambda k: k + 5.0),
+    })
+    merged = merge.merge_dir(fam)
+    assert validate_trace(merged) == []
+    ts = [r["t"] for r in merged[1:]
+          if isinstance(r.get("t"), (int, float))]
+    assert ts == sorted(ts)
+    by = {(r["host"], r["n_iter"]): r["t"] for r in merged[1:]
+          if r.get("kind") == "chunk"}
+    for n in (128, 256, 384, 512):
+        assert by[(1, n)] == pytest.approx(by[(0, n)], abs=0.01)
+
+
+def test_mismatched_fingerprints_refuse_to_merge(tmp_path):
+    ta = _template(tmp_path, gamma=0.5)
+    tb = _template(tmp_path, gamma=0.25)
+    fam = os.path.join(str(tmp_path), "bad")
+    _write_family(fam, {
+        0: _host_copy(ta, unix=1.7e9, t_of=lambda k: k),
+        1: _host_copy(tb, unix=1.7e9, t_of=lambda k: k),
+    })
+    with pytest.raises(merge.MergeError, match="gamma"):
+        merge.merge_dir(fam)
+
+
+def test_merge_demotes_summaries_and_synthesizes_fleet_summary(
+        tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    merged = merge.merge_dir(fam)
+    summaries = [r for r in merged if r.get("kind") == "summary"]
+    assert len(summaries) == 1          # ONE fleet summary
+    assert summaries[0].get("fleet_hosts") == [0, 1, 2]
+    host_sums = [r for r in merged if r.get("kind") == "event"
+                 and r.get("event") == "host_summary"]
+    assert sorted(r["host"] for r in host_sums) == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------
+# report integration
+# ---------------------------------------------------------------------
+
+def test_resolver_refuses_family_naming_hosts(tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    with pytest.raises(ValueError, match="hosts 0, 1, 2"):
+        resolve_trace_path(fam)
+
+
+def test_load_trace_auto_merges_family(tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    records = load_trace_auto(fam)
+    assert records[0].get("merged") is True
+    assert host_lanes(records)["straggler"] == 1
+
+
+def test_report_renders_lanes_and_names_straggler(tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    text = render_report(merge.merge_dir(fam))
+    assert "straggler: host 1" in text
+    assert "<- straggler" in text
+    assert "fleet: 3 host lane(s) merged" in text
+
+
+def test_single_trace_dir_still_resolves(tmp_path):
+    template = _template(tmp_path)
+    d = os.path.join(str(tmp_path), "single")
+    _write_family(d, {0: template})
+    # one host is not a family: newest-file resolution as before
+    assert resolve_trace_path(d).endswith("trace_h0.jsonl")
+    assert host_lanes(load_trace_auto(d)) is None
+
+
+# ---------------------------------------------------------------------
+# the skew rule + per-host templates
+# ---------------------------------------------------------------------
+
+def _skew_spec(**kw):
+    spec = {"name": "iteration-skew", "kind": "skew",
+            "severity": "warn", "metric": "n_iter", "window_s": 10.0,
+            "lag_above": 20.0, "clear_after_s": 5.0}
+    spec.update(kw)
+    return spec
+
+
+def _lane_sample(fronts):
+    return {f"host:{h}:n_iter": float(v) for h, v in fronts.items()}
+
+
+def test_skew_fires_naming_the_laggard_and_clears():
+    tower = slo.Watchtower([_skew_spec()])
+    transitions = []
+    for i in range(100):
+        lagging = 20 <= i <= 45
+        fronts = {0: 100.0 + i, 1: 100.0 + i - (64.0 if lagging
+                                                else 0.0),
+                  2: 100.0 + i}
+        transitions += tower.observe(_lane_sample(fronts), t=float(i))
+    fired = [t for t in transitions if t["state"] == "firing"]
+    assert fired and fired[0]["host"] == 1
+    assert "skew[host-1]" in fired[0]["reason"]
+    assert any(t["state"] == "ok" for t in transitions)
+
+
+def test_skew_needs_a_full_window_before_judging():
+    """A huge lag in the first samples must NOT fire: one slow
+    collective boundary is not a straggler until it sustains."""
+    tower = slo.Watchtower([_skew_spec(window_s=10.0)])
+    for i in range(10):                 # t spans only 9 s < window
+        got = tower.observe(_lane_sample({0: 1000.0, 1: 0.0}),
+                            t=float(i))
+        assert got == []
+
+
+def test_skew_single_host_never_fires():
+    tower = slo.Watchtower([_skew_spec()])
+    for i in range(50):
+        assert tower.observe(_lane_sample({0: float(i)}),
+                             t=float(i)) == []
+
+
+def test_skew_per_host_is_a_spec_error():
+    with pytest.raises(slo.RuleError):
+        slo.Rule(_skew_spec(per_host=True))
+
+
+def test_skew_requires_window_and_lag():
+    bad = _skew_spec()
+    del bad["lag_above"]
+    with pytest.raises(slo.RuleError):
+        slo.Rule(bad)
+
+
+def test_per_host_template_expands_within_cap():
+    spec = {"name": "host-heartbeat-stale", "kind": "threshold",
+            "severity": "page", "per_host": True,
+            "metric": "host:{host}:heartbeat_age_seconds",
+            "above": 120.0, "for_s": 0.0, "clear_after_s": 0.0}
+    tower = slo.Watchtower([spec], host_cap=2)
+    sample = {f"host:{h}:heartbeat_age_seconds": 1.0
+              for h in range(4)}
+    tower.observe(sample, t=0.0)
+    names = {s["rule"] for s in tower.states()}
+    assert len(names) == 2              # capped
+    assert names <= {f"host-heartbeat-stale[host-{h}]"
+                     for h in range(4)}
+
+
+def test_per_host_heartbeat_stale_pages_the_silent_host():
+    tower = slo.Watchtower(slo.load_rules(None, default="fleet"))
+    fired = []
+    for i in range(5):
+        sample = _lane_sample({0: 100.0, 1: 100.0})
+        sample["host:0:heartbeat_age_seconds"] = 1.0
+        sample["host:1:heartbeat_age_seconds"] = 500.0
+        fired += [t for t in tower.observe(sample, t=float(i))
+                  if t["state"] == "firing"]
+    assert any(t["rule"] == "host-heartbeat-stale[host-1]"
+               and t["severity"] == "page" for t in fired)
+
+
+def test_default_fleet_rules_round_trip():
+    specs = slo.default_fleet_rules()
+    assert {s["kind"] for s in specs} == {"threshold", "rate", "skew"}
+    rs = slo.RuleSet.from_specs(specs)
+    assert rs.to_specs() == specs
+    assert slo.load_rules(None, default="fleet").to_specs() == specs
+
+
+# ---------------------------------------------------------------------
+# metrics federation
+# ---------------------------------------------------------------------
+
+def _sidecar(tmp_path, host, *, iters, compiles, gap=0.01, seq=3):
+    reg = MetricsRegistry()
+    reg.gauge("dpsvm_train_iterations", "it").set(float(iters))
+    reg.gauge("dpsvm_train_gap", "gap").set(float(gap))
+    reg.counter("dpsvm_train_compiles_total", "c").inc(int(compiles))
+    path = os.path.join(str(tmp_path), f"metrics_h{host}.prom")
+    write_snapshot(reg, path, seq=seq)
+    return path
+
+
+def test_federation_aggregation_rules(tmp_path):
+    srcs = [_sidecar(tmp_path, 0, iters=500, compiles=3),
+            _sidecar(tmp_path, 1, iters=380, compiles=2)]
+    snap = fleet.federate(fleet.collect(srcs))
+    agg = snap["aggregate"]
+    assert agg["dpsvm_train_iterations"] == 380.0      # group min
+    assert agg["dpsvm_train_compiles_total"] == 5.0    # summed
+    assert snap["lag"] == 120.0
+    assert snap["slowest"] == 1
+    expo = fleet.render_exposition(snap)
+    assert validate_exposition(expo) == []
+    assert 'dpsvm_host_iterations{host="0"} 500' in expo
+    assert 'dpsvm_host_iterations{host="1"} 380' in expo
+
+
+def test_federation_host_label_budget_overflow(tmp_path):
+    srcs = [_sidecar(tmp_path, h, iters=100 + h, compiles=1)
+            for h in range(4)]
+    snap = fleet.federate(
+        fleet.collect(srcs),
+        budget=fleet.TenantLabelBudget(2))
+    expo = fleet.render_exposition(snap)
+    assert validate_exposition(expo) == []
+    assert 'host="other"' in expo
+    # overflow counters AGGREGATE: 2 hosts folded -> compiles sum 2
+    line = next(ln for ln in expo.splitlines()
+                if ln.startswith("dpsvm_host_compiles_total")
+                and 'host="other"' in ln)
+    assert line.split()[-1] == "2"
+
+
+def test_collect_marks_dead_source_down(tmp_path):
+    ok = _sidecar(tmp_path, 0, iters=100, compiles=1)
+    missing = os.path.join(str(tmp_path), "metrics_h1.prom")
+    state = fleet.collect([ok, missing])
+    assert state[0]["up"] == 1 and state[1]["up"] == 0
+    snap = fleet.federate(state)
+    assert snap["aggregate"]["dpsvm_fleet_hosts_up"] == 1.0
+    assert "UNREACHABLE" not in fleet.render_fleet_table(snap)  # table renders
+    assert validate_exposition(fleet.render_exposition(snap)) == []
+
+
+def test_resolve_sources_parses_host_ids():
+    srcs = ["run/metrics_h2.prom", "http://node-0:9100",
+            "other/host-5.prom"]
+    resolved = fleet.resolve_sources(srcs)
+    assert resolved == {2: "run/metrics_h2.prom",
+                        0: "http://node-0:9100",
+                        5: "other/host-5.prom"}
+    with pytest.raises(fleet.FleetError):
+        fleet.resolve_sources(["a/metrics_h1.prom",
+                               "b/metrics_h1.prom"])
+
+
+def test_fleet_watch_sample_has_host_lanes(tmp_path):
+    srcs = [_sidecar(tmp_path, 0, iters=500, compiles=3),
+            _sidecar(tmp_path, 1, iters=380, compiles=2)]
+    sample = fleet.fleet_watch_sample(fleet.federate(fleet.collect(
+        srcs)))
+    assert sample["host:0:n_iter"] == 500.0
+    assert sample["host:1:n_iter"] == 380.0
+    assert sample["iteration_lag"] == 120.0
+    assert sample["hosts"] == 2.0
+
+
+def test_federation_joins_heartbeats(tmp_path):
+    hb = os.path.join(str(tmp_path), "hb")
+    hostgroup.write_heartbeat(hb, 0, 500, generation=2, seq=9)
+    hostgroup.write_heartbeat(hb, 1, 380, generation=2, seq=7)
+    srcs = [_sidecar(tmp_path, 0, iters=500, compiles=3),
+            _sidecar(tmp_path, 1, iters=380, compiles=2)]
+    snap = fleet.federate(fleet.collect(srcs),
+                          heartbeats=fleet.read_heartbeats(hb))
+    assert snap["hosts"][0]["hb_seq"] == 9
+    assert snap["hosts"][1]["hb_seq"] == 7
+    assert snap["aggregate"]["dpsvm_fleet_generation"] == 2.0
+
+
+# ---------------------------------------------------------------------
+# heartbeat seq + doctor
+# ---------------------------------------------------------------------
+
+def test_heartbeat_seq_is_monotonic(tmp_path, monkeypatch):
+    hb = os.path.join(str(tmp_path), "hb")
+    monkeypatch.setenv(hostgroup.ENV_HEARTBEAT_DIR, hb)
+    monkeypatch.setenv(hostgroup.ENV_HOST_ID, "0")
+    monkeypatch.setenv(hostgroup.ENV_HOST_COUNT, "1")
+    hostgroup.note_poll_heartbeat(100)
+    first = hostgroup.read_heartbeats(hb)[0]["seq"]
+    hostgroup.note_poll_heartbeat(200)
+    second = hostgroup.read_heartbeats(hb)[0]["seq"]
+    assert second == first + 1
+
+
+def test_doctor_reports_seq_and_clock_step_back(tmp_path):
+    from dpsvm_tpu.resilience.doctor import _hostgroup_probe
+
+    hb = os.path.join(str(tmp_path), "hb")
+    os.makedirs(hb)
+    import time as _time
+    now = _time.time()
+    # host 0: healthy; host 1: fresh file + seq but t 500 s in the
+    # past — a wall-clock step-back, NOT a stall
+    for hid, t in ((0, now), (1, now - 500.0)):
+        with open(os.path.join(hb, f"host-{hid}.json"), "w") as fh:
+            json.dump({"host_id": hid, "n_iter": 128, "generation": 0,
+                       "seq": 5, "t": t, "pid": 1}, fh)
+    lines = []
+    ok, why = _hostgroup_probe(None, hb, 2, 60.0, 5.0, lines.append)
+    text = "\n".join(lines)
+    assert "seq 5" in text
+    assert "wall clock stepped back" in text
+    assert "STALE" not in text
+    assert not ok and "stepped backward" in why
+
+
+# ---------------------------------------------------------------------
+# perf-ledger host_count
+# ---------------------------------------------------------------------
+
+def test_ledger_rows_record_host_count(tmp_path, monkeypatch):
+    path = os.path.join(str(tmp_path), "ledger.jsonl")
+    ledger.append("case", {"value": 1.0}, kind="robust", value=1.0,
+                  host_count=3, path=path, strict=True)
+    monkeypatch.setenv("DPSVM_HOST_COUNT", "4")
+    ledger.append("case", {"value": 1.0}, kind="robust", value=1.0,
+                  path=path, strict=True)
+    rows = ledger.read(path)
+    assert [r["host_count"] for r in rows] == [3, 4]
+
+
+def test_ledger_gate_isolates_host_counts(tmp_path):
+    """A 3-host drill is a different physics than a 1-host run: the
+    gate must never call a 3-host reading a regression of a 1-host
+    baseline (or vice versa)."""
+    path = os.path.join(str(tmp_path), "ledger.jsonl")
+    # slow single-host history...
+    for v in (10.0, 10.1, 9.9, 10.0, 10.0):
+        ledger.append("drill", {"value": v, "unit": "s"},
+                      kind="robust", value=v, direction="lower",
+                      host_count=1, path=path, strict=True)
+    # ...then a 3-host reading 5x faster: vs the 1-host median this
+    # "improves", vs nothing it is the FIRST of its kind — no verdict
+    ledger.append("drill", {"value": 2.0, "unit": "s"},
+                  kind="robust", value=2.0, direction="lower",
+                  host_count=3, path=path, strict=True)
+    assert ledger.gate(ledger.read(path), window=5,
+                       threshold_pct=10.0) == []
+    # a genuine regression WITHIN host_count=3 still fails
+    for v in (2.0, 2.1, 1.9, 2.0, 8.0):
+        ledger.append("drill", {"value": v, "unit": "s"},
+                      kind="robust", value=v, direction="lower",
+                      host_count=3, path=path, strict=True)
+    verdicts = ledger.gate(ledger.read(path), window=5,
+                           threshold_pct=10.0)
+    assert verdicts and "drill" in verdicts[0]
+
+
+# ---------------------------------------------------------------------
+# fleet incident bundles
+# ---------------------------------------------------------------------
+
+def test_bundle_carries_host_artifacts(tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    hb = os.path.join(str(tmp_path), "hb")
+    for h in (0, 1, 2):
+        hostgroup.write_heartbeat(hb, h, 512, generation=0, seq=4)
+    arts = fleet.host_artifacts(fam, hb)
+    assert sorted(arts) == [0, 1, 2]
+    fr = blackbox.FlightRecorder(blackbox.make_manifest(
+        solver="dist-smo"))
+    fr.event("skew", n_iter=512, host=1)
+    bundle = blackbox.dump_bundle(
+        os.path.join(str(tmp_path), "bundles"), recorder=fr,
+        rule="iteration-skew", severity="warn", window="30s",
+        reason="skew[host-1]: planted",
+        extra={"extra": {"host": 1}}, host_artifacts=arts)
+    assert blackbox.validate_bundle(bundle) == []
+    inc = blackbox.load_incident(bundle)
+    assert inc["extra"]["host"] == 1
+    for h in (0, 1, 2):
+        assert os.path.exists(os.path.join(
+            bundle, f"host-{h}-heartbeat.json"))
+        assert os.path.exists(os.path.join(
+            bundle, f"host-{h}-trace-tail.jsonl"))
+        assert f"host_{h}_heartbeat" in inc["files"]
+
+
+# ---------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------
+
+def _run_cli(args, cwd=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "dpsvm_tpu.cli"] + args,
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=120)
+
+
+@pytest.mark.slow
+def test_cli_fleet_renders_sidecars_and_urls(tmp_path):
+    """`dpsvm fleet` from BOTH source kinds at once: one live
+    /metricsz URL and one sidecar file fold into one table."""
+    from dpsvm_tpu.observability.metrics import MetricsServer
+
+    reg = MetricsRegistry()
+    reg.gauge("dpsvm_train_iterations", "it").set(500.0)
+    reg.counter("dpsvm_train_compiles_total", "c").inc(3)
+    srv = MetricsServer(reg)
+    try:
+        sidecar = _sidecar(tmp_path, 1, iters=380, compiles=2)
+        res = _run_cli(["fleet",
+                        f"http://127.0.0.1:{srv.port}", sidecar,
+                        "--watch", "--json"])
+    finally:
+        srv.close()
+    assert res.returncode == 0, res.stderr
+    snap = json.loads(res.stdout)
+    assert snap["lag"] == 120.0 and snap["slowest"] == 1
+    assert {s["rule"] for s in snap["alerts"]} >= {"iteration-skew",
+                                                   "reform-storm"}
+
+
+@pytest.mark.slow
+def test_cli_fleet_exit_3_on_dead_host(tmp_path):
+    ok = _sidecar(tmp_path, 0, iters=100, compiles=1)
+    missing = os.path.join(str(tmp_path), "metrics_h1.prom")
+    res = _run_cli(["fleet", ok, missing])
+    assert res.returncode == 3, res.stdout + res.stderr
+    assert "UNREACHABLE" in res.stdout
+
+
+@pytest.mark.slow
+def test_cli_report_merges_family_and_compare_refuses_nothing(
+        tmp_path):
+    fam, _ = _straggler_family(tmp_path)
+    res = _run_cli(["report", fam])
+    assert res.returncode == 0, res.stderr
+    assert "straggler: host 1" in res.stdout
+    res = _run_cli(["compare", fam, fam])
+    assert res.returncode == 0, res.stderr
+
+
+# ---------------------------------------------------------------------
+# the acceptance drill (subprocess twin lives in the burst runner)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_straggler_drill_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("DPSVM_PERF_LEDGER",
+                       os.path.join(str(tmp_path), "ledger.jsonl"))
+    facts = hostgroup.straggler_drill(str(tmp_path))
+    assert facts["straggler"] == 1
+    assert facts["skew_fired"] >= 1
+    assert facts["straggler_behind_s"] > 0.1
+    rows = ledger.read(os.environ["DPSVM_PERF_LEDGER"])
+    assert rows[-1]["case"] == "straggler_drill"
+    assert rows[-1]["host_count"] == 3
