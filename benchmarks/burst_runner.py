@@ -200,6 +200,18 @@ TAGS = [
     # while one tenant hogs the queue.
     sub("tenant_isolation", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.serving", "--tenant-drill"]),
+    # Model-fleet cache drill (docs/SERVING.md "Model fleet",
+    # dpsvm_tpu/fleet/): 1000 lazily registered models served from a
+    # 32-slot HBM cache — a skewed hot set plus a full one-shot scan.
+    # Proves on the round's hardware that the hot residents survive
+    # the scan (second-touch admission; scan traffic pays transient
+    # serves, ZERO evictions), conservation holds, and the headline
+    # fleet_cold_start_p99_ms (also a perf-ledger "fleet" row,
+    # direction lower) prices what a fault costs when the budget is
+    # 3% of the fleet. Trace (model_fault/model_evict events) archives
+    # under traces/ for `dpsvm report`.
+    sub("fleet_cache_drill", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.fleet", "--drill"]),
     sub("inference", R3, 240,
         [sys.executable, "benchmarks/inference_bench.py"],
         BENCH_NSV=8000, BENCH_M=10000, BENCH_D=784, BENCH_PASSES=5),

@@ -528,9 +528,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also probe a live `dpsvm serve` process: "
                          "reports the tenant label budget, live "
                          "per-tenant series count, evictions and "
-                         "overflow, warning near saturation "
-                         "(docs/OBSERVABILITY.md 'Per-tenant "
-                         "attribution'); reporting-only, never "
+                         "overflow, plus the model-cache residency/"
+                         "fault/eviction state when the fleet cache "
+                         "is armed — warning near saturation of "
+                         "either budget (docs/OBSERVABILITY.md "
+                         "'Per-tenant attribution', docs/SERVING.md "
+                         "'Model fleet'); reporting-only, never "
                          "changes the exit code")
     dr.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                     help="multi-host preflight: deadline-bounded TCP "
@@ -826,6 +829,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "activity eviction; default 32 — "
                          "docs/OBSERVABILITY.md 'Per-tenant "
                          "attribution')")
+    sv.add_argument("--model-cache-budget", type=int, default=None,
+                    metavar="K",
+                    help="arm the HBM model cache: at most K models "
+                         "resident on device at once; the rest are "
+                         "registered lazily (manifest-only) and "
+                         "hydrate on first request (counted "
+                         "model_fault, second-touch admission + "
+                         "LRU-of-activity eviction). Same-spec "
+                         "residents share ONE batched decision "
+                         "program (docs/SERVING.md 'Model fleet')")
     sv.add_argument("-q", "--quiet", action="store_true")
     _add_backend_flags(sv)
 
@@ -906,6 +919,89 @@ def build_parser() -> argparse.ArgumentParser:
                          "the cold tenants — the noisy-neighbour "
                          "drill shape (docs/OBSERVABILITY.md "
                          "'Per-tenant attribution')")
+    lg.add_argument("--models", type=int, default=0, metavar="N",
+                    help="spread requests over the first N models "
+                         "from the server's /v1/models list (sorted; "
+                         "--model is forced to the front as the hot "
+                         "model) — the model-fleet drill. The row "
+                         "gains per-model p50/p99 sub-rows and "
+                         "cold_start_p99_ms (p99 over each model's "
+                         "FIRST-request latency — the number the HBM "
+                         "model cache bounds; docs/SERVING.md "
+                         "'Model fleet')")
+    lg.add_argument("--model-skew", type=float, default=0.0,
+                    metavar="S",
+                    help="fraction (0..1) of requests sent to the "
+                         "single hot model (--model); the rest "
+                         "round-robin the remaining N-1 — same "
+                         "deterministic stride as --hot-tenant-skew. "
+                         "0 round-robins all N (the cache-thrash "
+                         "worst case when N exceeds the cache budget)")
+
+    gd = sub.add_parser(
+        "grid", help="mesh-parallel C×gamma grid trainer: the whole "
+                     "grid runs as batched programs spread over the "
+                     "local devices (one compile per device, not one "
+                     "per cell), per-cell held-out accuracy, optional "
+                     "cascade polish of the winner; prints ONE JSON "
+                     "row and can promote the winner into a serving "
+                     "artifact atomically (docs/SERVING.md 'Model "
+                     "fleet')")
+    _add_data_flags(gd, model_required=False)
+    _add_backend_flags(gd)
+    gd.add_argument("--cs", default="0.25,1,4,16", metavar="C1,C2",
+                    help="comma list of C values — the grid rows "
+                         "(default 0.25,1,4,16)")
+    gd.add_argument("--gammas", default=None, metavar="G1,G2",
+                    help="comma list of gamma values — the grid "
+                         "columns (default: one column at the 1/d "
+                         "default)")
+    gd.add_argument("-k", "--kernel", default="rbf",
+                    choices=["rbf", "linear", "poly", "sigmoid"])
+    gd.add_argument("-d", "--degree", type=int, default=3)
+    gd.add_argument("--coef0", type=float, default=0.0)
+    gd.add_argument("--max-iter", type=int, default=None,
+                    help="per-cell iteration cap (default: the "
+                         "config default)")
+    gd.add_argument("--holdout-frac", type=float, default=0.2,
+                    help="fraction of rows held out for per-cell "
+                         "scoring (seeded shuffle split; the winner "
+                         "is the best held-out accuracy, row-major "
+                         "first-wins tie-break)")
+    gd.add_argument("--seed", type=int, default=0,
+                    help="holdout-split shuffle seed (replayable)")
+    gd.add_argument("--polish", action="store_true",
+                    help="re-fit the winning cell with the cascade "
+                         "solver on ALL rows (train+holdout) before "
+                         "saving/promoting — the production-artifact "
+                         "finish")
+    gd.add_argument("--compare-sequential", action="store_true",
+                    help="also fit every cell sequentially (one "
+                         "program each, the no-batching baseline) and "
+                         "report + ledger the grid_vs_sequential "
+                         "speedup (docs/PERF.md)")
+    gd.add_argument("--out", default=None, metavar="PATH",
+                    help="save the winning model here (atomic "
+                         "tmp+rename in the target directory)")
+    gd.add_argument("--promote", default=None, metavar="PATH",
+                    help="promote the winner onto this serving "
+                         "artifact path via the registry's atomic "
+                         "promote_file (os.replace + validating "
+                         "reload) — a `dpsvm serve` hot-reload of the "
+                         "same path picks it up (docs/SERVING.md "
+                         "'Continuous learning')")
+    gd.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write the grid training trace here "
+                         "(solver='grid': one grid_cell event per "
+                         "cell, grid_winner, summary) — the "
+                         "provenance pointer the ledger rows carry")
+    gd.add_argument("--no-ledger", dest="ledger", action="store_false",
+                    default=True,
+                    help="skip the perf-ledger append")
+    gd.add_argument("--json", action="store_true",
+                    help="print the full result row as JSON instead "
+                         "of the per-cell table")
+    gd.add_argument("-q", "--quiet", action="store_true")
 
     tns = sub.add_parser(
         "tenants", help="per-tenant cost attribution table: who "
@@ -2001,6 +2097,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
                   f"{spec!r}", file=sys.stderr)
             return 2
         siblings[name] = sib
+    cache_budget = args.model_cache_budget
+    if cache_budget is not None and cache_budget < 1:
+        print("error: --model-cache-budget must be >= 1",
+              file=sys.stderr)
+        return 2
+    if cache_budget is not None and args.no_b:
+        # the cache's shared same-spec program serves include_b=True
+        # decisions; mixing the two would silently change semantics
+        print("error: --no-b is not supported with "
+              "--model-cache-budget", file=sys.stderr)
+        return 2
     registry = ModelRegistry()
     for i, spec in enumerate(args.model):
         name, sep, path = spec.partition("=")
@@ -2014,6 +2121,19 @@ def cmd_serve(args: argparse.Namespace) -> int:
         if not os.path.exists(path):
             print(f"error: no such model: {path}", file=sys.stderr)
             return 2
+        if cache_budget is not None:
+            # fleet mode: manifest-only registration — the model cache
+            # hydrates on first request, within its HBM budget
+            # (docs/SERVING.md "Model fleet"); boot cost is O(fleet
+            # size) filename bookkeeping, not O(fleet size) compiles
+            registry.register(name, path, lazy=True,
+                              max_batch=args.max_batch,
+                              include_b=True,
+                              precision=args.precision)
+            if not args.quiet:
+                print(f"registered {name!r} (lazy): {path}",
+                      file=sys.stderr)
+            continue
         engine = registry.register(name, path,
                                    max_batch=args.max_batch,
                                    include_b=not args.no_b,
@@ -2055,6 +2175,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             watch=args.watch,
                             **({"tenant_budget": args.tenant_budget}
                                if args.tenant_budget is not None else {}),
+                            model_cache_budget=cache_budget,
                             verbose=not args.quiet).start()
     except ValueError as e:                 # width-mismatched sibling
         print(f"error: {e}", file=sys.stderr)
@@ -2084,27 +2205,60 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
 
     import numpy as np
 
-    from dpsvm_tpu.serving.loadgen import (fetch_manifest, loadgen_row,
+    from dpsvm_tpu.serving.loadgen import (fetch_models, loadgen_row,
                                            run_saturate, synthetic_rows)
 
     want = tuple(w for w in args.want.split(",") if w)
+    if args.models < 0:
+        print("error: --models must be >= 0", file=sys.stderr)
+        return 2
+    if not (0.0 <= args.model_skew <= 1.0):
+        print(f"error: --model-skew must be in [0, 1], got "
+              f"{args.model_skew}", file=sys.stderr)
+        return 2
     try:
-        manifest = fetch_manifest(args.url, args.model,
-                                  timeout=args.timeout)
+        all_models = fetch_models(args.url, timeout=args.timeout)
     except (OSError, RuntimeError) as e:
         print(f"error: cannot reach {args.url}: {e}", file=sys.stderr)
         return 2
+    if args.model not in all_models:
+        print(f"error: server has no model {args.model!r} "
+              f"(models: {sorted(all_models)[:20]})", file=sys.stderr)
+        return 2
+    manifest = all_models[args.model]
+    # A lazy (fleet-cache) registration reports no feature width until
+    # it hydrates; borrow the width from any resident sibling (the
+    # fleet drill is a same-spec fleet), else require -f.
+    width = manifest.get("num_attributes")
+    if width is None:
+        width = next((m["num_attributes"] for m in all_models.values()
+                      if m.get("num_attributes") is not None), None)
     if args.input:
         from dpsvm_tpu.data.loader import load_dataset
         rows, _ = load_dataset(args.input, None, None)
         rows = np.asarray(rows, np.float32)
-        if rows.shape[1] != manifest["num_attributes"]:
+        if width is not None and rows.shape[1] != width:
             print(f"error: dataset has {rows.shape[1]} attributes, "
-                  f"model {args.model!r} expects "
-                  f"{manifest['num_attributes']}", file=sys.stderr)
+                  f"model {args.model!r} expects {width}",
+                  file=sys.stderr)
             return 2
+    elif width is not None:
+        rows = synthetic_rows(width)
     else:
-        rows = synthetic_rows(manifest["num_attributes"])
+        print(f"error: model {args.model!r} is not resident and no "
+              "sibling reports a feature width — pass -f DATASET so "
+              "the loadgen knows the request shape", file=sys.stderr)
+        return 2
+    fleet_names: list = []
+    if args.models > 0:
+        # hot model first (the skew target), then the rest sorted —
+        # a deterministic, replayable fleet selection
+        rest = [n for n in sorted(all_models) if n != args.model]
+        fleet_names = [args.model] + rest[:args.models - 1]
+        if len(fleet_names) < args.models:
+            print(f"error: --models {args.models} but the server has "
+                  f"only {len(all_models)} models", file=sys.stderr)
+            return 2
     trace = args.trace or os.environ.get("BENCH_TRACE_OUT") or None
 
     def _ledger_append(row):
@@ -2137,9 +2291,25 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
                       chaos=args.chaos,
                       compare_sequential=args.compare_sequential,
                       trace=trace, tenants=args.tenants,
-                      hot_tenant_skew=args.hot_tenant_skew)
+                      hot_tenant_skew=args.hot_tenant_skew,
+                      models=fleet_names, model_skew=args.model_skew)
     print(json.dumps(row), flush=True)
     _ledger_append(row)
+    if row.get("cold_start_p99_ms") is not None:
+        # The fleet shape additionally feeds the model_fleet ledger
+        # case: the headline is cold-start p99 — how fast a paged-out
+        # model comes back when its first request lands
+        # (docs/SERVING.md "Model fleet").
+        _ledger_append({
+            "metric": "model_fleet",
+            "value": row["cold_start_p99_ms"], "unit": "ms",
+            "trace": row.get("trace"),
+            "models": row.get("models"),
+            "model_skew": row.get("model_skew"),
+            "hot_model": row.get("hot_model"),
+            "p99_ms": row.get("p99_ms"),
+            "requests": row.get("requests"),
+            "errors": row.get("errors")})
     if row.get("hot_tenant") and row.get("others_p99_ms") is not None:
         # The noisy-neighbour shape additionally feeds the
         # tenant_isolation ledger case: the headline is the COLD
@@ -2163,6 +2333,179 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
         avail = row.get("availability_pct")
         return 0 if (avail is not None and avail >= 99.0) else 1
     return 0 if row["errors"] == 0 else 1
+
+
+def cmd_grid(args: argparse.Namespace) -> int:
+    """`dpsvm grid` (docs/SERVING.md "Model fleet"): train the whole
+    C×gamma grid as mesh-parallel batched programs, score every cell
+    on a seeded holdout, optionally cascade-polish the winner and
+    promote it atomically. One compile per device partition instead of
+    one per cell — that is where the grid_vs_sequential speedup lives."""
+    import json
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.loader import load_dataset
+
+    try:
+        cs = tuple(float(v) for v in args.cs.split(",") if v.strip())
+        gammas = (tuple(float(v) for v in args.gammas.split(",")
+                        if v.strip())
+                  if args.gammas else None)
+    except ValueError:
+        print(f"error: --cs/--gammas must be comma lists of numbers "
+              f"(got --cs {args.cs!r} --gammas {args.gammas!r})",
+              file=sys.stderr)
+        return 2
+    if not cs or any(c <= 0 for c in cs):
+        print(f"error: --cs needs at least one positive C, got "
+              f"{args.cs!r}", file=sys.stderr)
+        return 2
+    if gammas is not None and any(g <= 0 for g in gammas):
+        print(f"error: --gammas must be positive, got {args.gammas!r}",
+              file=sys.stderr)
+        return 2
+    if not (0.0 < args.holdout_frac < 1.0):
+        print(f"error: --holdout-frac must be in (0, 1), got "
+              f"{args.holdout_frac}", file=sys.stderr)
+        return 2
+    x, y = load_dataset(args.input, args.num_ex, args.num_att,
+                        allow_nonfinite=args.allow_nonfinite,
+                        mem_budget_mb=args.mem_budget_mb)
+    config = SVMConfig(kernel=args.kernel, degree=args.degree,
+                       coef0=args.coef0, verbose=not args.quiet,
+                       **({"max_iter": args.max_iter}
+                          if args.max_iter is not None else {}))
+
+    from dpsvm_tpu.fleet import sequential_grid_seconds, train_grid
+
+    tr = None
+    if args.trace_out:
+        from dpsvm_tpu.observability.record import RunTrace
+        tr = RunTrace(args.trace_out, config=config, n=x.shape[0],
+                      d=x.shape[1], gamma=(gammas[0] if gammas
+                                           else 1.0 / x.shape[1]),
+                      solver="grid")
+    try:
+        grid = train_grid(x, y, cs=cs, gammas=gammas, config=config,
+                          holdout_frac=args.holdout_frac,
+                          seed=args.seed, polish=args.polish,
+                          trace=tr)
+    finally:
+        if tr is not None:
+            tr.close()
+    best = grid.best
+    row = {
+        "metric": "grid_train_seconds",
+        "value": round(grid.train_seconds, 4),
+        "unit": "s",
+        "cs": list(cs),
+        "gammas": [c.gamma for c in grid.cells[:len(grid.cells)
+                                               // len(cs)]],
+        "cells": [{"c": c.c, "gamma": round(c.gamma, 8),
+                   "holdout_acc": round(c.holdout_acc, 6),
+                   "n_sv": int(c.result.n_sv),
+                   "converged": bool(c.result.converged)}
+                  for c in grid.cells],
+        "winner": {"c": best.c, "gamma": round(best.gamma, 8),
+                   "holdout_acc": round(best.holdout_acc, 6),
+                   "n_sv": int(best.result.n_sv)},
+        "n_train": grid.n_train, "n_holdout": grid.n_holdout,
+        "devices": grid.devices, "polished": grid.polished,
+        "trace": args.trace_out,
+    }
+
+    def _ledger_append(case, value, unit, extra):
+        if not args.ledger:
+            return
+        from dpsvm_tpu.observability import ledger
+        ledger.append(case, extra, kind="fleet", value=value,
+                      unit=unit, trace=args.trace_out)
+
+    if args.compare_sequential:
+        seq_s, seq_models = sequential_grid_seconds(
+            x, y, cs=cs, gammas=gammas, config=config,
+            holdout_frac=args.holdout_frac, seed=args.seed)
+        speedup = (round(seq_s / grid.train_seconds, 3)
+                   if grid.train_seconds > 0 else None)
+        row["sequential_seconds"] = round(seq_s, 4)
+        row["grid_vs_sequential_x"] = speedup
+        # matched-accuracy guard: the speedup row only counts if the
+        # batched cells converged to the same per-cell quality
+        import numpy as np
+
+        from dpsvm_tpu.fleet import holdout_split
+        from dpsvm_tpu.models.svm import evaluate
+        _, ho_idx = holdout_split(x.shape[0], args.holdout_frac,
+                                  args.seed)
+        x_ho = np.asarray(x)[ho_idx]
+        y_ho = np.asarray(y)[ho_idx]
+        seq_accs = [float(evaluate(m, x_ho, y_ho))
+                    for _, _, m in seq_models]
+        acc_gap = max(abs(sa - c.holdout_acc)
+                      for sa, c in zip(seq_accs, grid.cells))
+        row["seq_acc_gap_max"] = round(acc_gap, 6)
+        _ledger_append("grid_vs_sequential", speedup, "x", {
+            "grid_seconds": row["value"],
+            "sequential_seconds": row["sequential_seconds"],
+            "cells": len(grid.cells), "devices": grid.devices,
+            "seq_acc_gap_max": row["seq_acc_gap_max"],
+            "n": int(x.shape[0]), "d": int(x.shape[1])})
+    _ledger_append("grid_train", row["value"], "s", {
+        "cells": len(grid.cells), "devices": grid.devices,
+        "winner": row["winner"], "polished": grid.polished,
+        "n": int(x.shape[0]), "d": int(x.shape[1])})
+
+    if args.out:
+        import tempfile
+
+        from dpsvm_tpu.models.io import save_model
+        out = os.path.abspath(args.out)
+        fd, tmp = tempfile.mkstemp(
+            prefix=f".{os.path.basename(out)}.", suffix=".grid-cand",
+            dir=os.path.dirname(out) or ".")
+        os.close(fd)
+        try:
+            save_model(best.model, tmp)
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        row["out"] = out
+    if args.promote:
+        from dpsvm_tpu.fleet import promote_winner
+        from dpsvm_tpu.serving import ModelRegistry
+        target = os.path.abspath(args.promote)
+        reg = ModelRegistry()
+        reg.register("winner", target, lazy=True, warmup=False,
+                     max_batch=32)
+        try:
+            gen = promote_winner(grid, reg, "winner")
+        except (OSError, ValueError) as e:
+            print(f"error: promote failed: {e}", file=sys.stderr)
+            return 1
+        row["promoted"] = target
+        row["generation"] = gen
+    if args.json or args.quiet:
+        print(json.dumps(row), flush=True)
+    else:
+        print(f"grid {len(cs)}x{len(grid.cells) // len(cs)} on "
+              f"{grid.devices} device(s): {grid.train_seconds:.2f}s "
+              f"({grid.n_train} train / {grid.n_holdout} holdout rows)")
+        for c in grid.cells:
+            mark = " <-- winner" if c is best else ""
+            print(f"  C={c.c:<8g} gamma={c.gamma:<12.6g} "
+                  f"holdout_acc={c.holdout_acc:.4f} "
+                  f"n_sv={c.result.n_sv}{mark}")
+        if "grid_vs_sequential_x" in row:
+            print(f"  sequential baseline: {row['sequential_seconds']}s "
+                  f"-> {row['grid_vs_sequential_x']}x speedup "
+                  f"(max per-cell acc gap {row['seq_acc_gap_max']})")
+        if row.get("out"):
+            print(f"  saved winner -> {row['out']}")
+        if row.get("promoted"):
+            print(f"  promoted -> {row['promoted']} "
+                  f"(generation {row['generation']})")
+    return 0
 
 
 def cmd_tenants(args: argparse.Namespace) -> int:
@@ -2975,10 +3318,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             multihost.initialize(coordinator=coord, num_processes=nh,
                                  process_id=hid)
     try:
-        if args.command in ("train", "test", "serve", "tune"):
+        if args.command in ("train", "test", "serve", "tune", "grid"):
             rc = _init_backend(args)
             if rc:
                 return rc
+        if args.command == "grid":
+            return cmd_grid(args)
         if args.command == "train":
             return cmd_train(args)
         if args.command == "tune":

@@ -1,0 +1,426 @@
+"""Model-fleet subsystem: thousands of tenant models, one process.
+
+The ROADMAP's north star is millions of users — which means millions
+of TENANTS, each with a small model, not one giant model. Until this
+package the registry held a handful of always-resident engines and
+every model was trained by hand. The fleet layer closes that gap
+(docs/SERVING.md "Model fleet"):
+
+* ``modelcache`` — ``ModelCache``: an HBM-budgeted model-granularity
+                   generalization of the reference trainer's
+                   ``cache.cu`` kernel-row LRU. Second-touch admission
+                   + LRU-of-activity (the ``TenantLabelBudget``
+                   discipline applied to model names) so one-shot
+                   churn never evicts the working set; every hydration
+                   is a ``model_fault`` with its measured cold start,
+                   every page-out a ``model_evict``.
+* ``packer``     — ``GroupPacker``/``PackedGroup``: resident models of
+                   identical spec (kernel/γ/coef0/degree/width) share
+                   ONE concatenated segment-sum decision program (the
+                   engine's OvO collapse generalized — the same
+                   ``SegmentPack``), so N same-spec tenants cost one
+                   warmed bucket ladder and one dispatch per request,
+                   zero steady-state retraces.
+* ``grid``       — ``train_grid``: the production line. A whole C×γ
+                   grid solved as mesh-partitioned batched sweep
+                   programs (``solver/batched_ovo.train_c_sweep``),
+                   held-out per-cell scores, cascade polish for the
+                   winner, one trace, and atomic promotion through
+                   ``ModelRegistry.promote_file``.
+
+CLI: ``dpsvm grid`` (training), ``dpsvm serve --model-cache-budget``
+(serving), ``dpsvm loadgen --models/--model-skew`` (drills).
+
+CI gate: ``python -m dpsvm_tpu.fleet --selfcheck`` — registers 64
+tiny models lazily under a cache budget of 8, churns them, and
+asserts the properties the fleet design rests on: counter
+conservation (touches == hits + faults + transients), a deterministic
+resident set one-shot scans cannot evict, zero stray retraces across
+steady-state packed-group traffic, packed decisions matching a fresh
+dedicated engine load, and a schema-valid fault/evict trace. Wired
+into tier-1 by ``tests/test_modelfleet.py``; the heavier 1000-model
+``--drill`` is the ``fleet_cache_drill`` burst tag and lands the
+``fleet_cold_start_p99_ms`` perf-ledger row.
+
+Importing this package initializes no backend: the cache and packer
+pull jax lazily, on first hydration/dispatch.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from dpsvm_tpu.fleet.modelcache import ModelCache
+from dpsvm_tpu.fleet.packer import (GroupPacker, GroupSpec, PackedGroup,
+                                    packable)
+
+__all__ = [
+    "ModelCache", "GroupPacker", "GroupSpec", "PackedGroup", "packable",
+    "train_grid", "GridResult", "GridCell", "holdout_split",
+    "sequential_grid_seconds", "promote_winner", "selfcheck",
+    "fleet_cache_drill", "main",
+]
+
+_LAZY = {
+    "train_grid": ("dpsvm_tpu.fleet.grid", "train_grid"),
+    "GridResult": ("dpsvm_tpu.fleet.grid", "GridResult"),
+    "GridCell": ("dpsvm_tpu.fleet.grid", "GridCell"),
+    "holdout_split": ("dpsvm_tpu.fleet.grid", "holdout_split"),
+    "sequential_grid_seconds": ("dpsvm_tpu.fleet.grid",
+                                "sequential_grid_seconds"),
+    "promote_winner": ("dpsvm_tpu.fleet.grid", "promote_winner"),
+}
+
+
+def __getattr__(name: str):
+    """PEP 562 lazy re-exports (the serving package's idiom): the grid
+    trainer pulls the solver stack only when something asks for it."""
+    try:
+        mod, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(mod), attr)
+
+
+def _tiny_fleet(base: str, n_models: int, *, specs=((0.5, 4),),
+                seed: int = 7) -> List[str]:
+    """Save ``n_models`` tiny same-width binary SV models under
+    ``base``; model i uses spec i % len(specs) ((gamma, d) pairs share
+    d). Returns the saved paths in name order."""
+    import os
+
+    import numpy as np
+
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import SVMModel
+
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(n_models):
+        gamma, d = specs[i % len(specs)]
+        n_sv = int(rng.integers(4, 12))
+        model = SVMModel(
+            x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+            alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+            y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(np.int32),
+            b=float(rng.normal()), gamma=gamma)
+        path = os.path.join(base, f"m{i:04d}.svm")
+        save_model(model, path)
+        paths.append(path)
+    return paths
+
+
+def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
+    """Run the fleet cache end to end on 64 tiny models under a budget
+    of 8; return a list of problems (empty = healthy). See module
+    docstring for what is asserted and why."""
+    import os
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    problems: List[str] = []
+    ctx = (tempfile.TemporaryDirectory() if tmp_dir is None else None)
+    base = tmp_dir if tmp_dir is not None else ctx.name
+    try:
+        from dpsvm_tpu.models.io import load_model
+        from dpsvm_tpu.models.svm import decision_function
+        from dpsvm_tpu.observability import compilewatch
+        from dpsvm_tpu.observability.record import (close_serving_trace,
+                                                    open_serving_trace)
+        from dpsvm_tpu.observability.schema import (read_trace,
+                                                    validate_trace)
+        from dpsvm_tpu.serving.registry import ModelRegistry
+
+        n_models, budget, d = 64, 8, 4
+        paths = _tiny_fleet(base, n_models,
+                            specs=((0.5, d), (0.25, d)))
+        registry = ModelRegistry()
+        t0 = _time.perf_counter()
+        for i, path in enumerate(paths):
+            registry.register(f"m{i:04d}", path, lazy=True)
+        boot_s = _time.perf_counter() - t0
+        if boot_s > 2.0:
+            problems.append(f"lazy registration of {n_models} models "
+                            f"took {boot_s:.2f}s — it is loading "
+                            "models eagerly")
+        if any(m["resident"] for m in registry.manifests().values()):
+            problems.append("lazy registration reported resident "
+                            "models before any request")
+
+        trace_path = os.path.join(base, "fleet_selfcheck.jsonl")
+        tr = open_serving_trace(trace_path, models={})
+        cache = ModelCache(registry, budget=budget, max_batch=16,
+                           on_event=tr.event)
+        rng = np.random.default_rng(3)
+        q = rng.standard_normal((5, d)).astype(np.float32)
+
+        # 1) filling an under-budget cache hydrates on first touch
+        # (fault), answers the repeat from residency (hit)
+        hot = [f"m{i:04d}" for i in range(budget)]
+        for name in hot:
+            cache.infer(name, q)            # under budget: fault
+            cache.infer(name, q)            # resident: hit
+        st = cache.stats()
+        if st["faults"] != budget or st["resident"] != budget:
+            problems.append(f"expected {budget} faults/{budget} "
+                            f"residents after double-touching the hot "
+                            f"set, got {st['faults']}/{st['resident']}")
+        if st["evictions"] != 0:
+            problems.append(f"{st['evictions']} evictions while under "
+                            "budget")
+
+        # 2) zero stray retraces across steady-state resident traffic
+        compilewatch.drain()
+        outs = {}
+        for _ in range(3):
+            for name in hot:
+                outs[name] = cache.infer(
+                    name, q, want=("labels", "decision"))
+        stray = compilewatch.drain()
+        if stray:
+            progs = sorted({c["program"] for c in stray})
+            problems.append(
+                f"{len(stray)} compile event(s) across steady-state "
+                f"resident traffic (programs: {progs}) — the packed "
+                "groups are leaking retraces")
+
+        # 3) packed decisions match a fresh direct evaluation
+        for name in hot:
+            i = int(name[1:])
+            direct = decision_function(load_model(paths[i]), q)
+            got = outs[name]["decision"]
+            if not np.allclose(got, direct, atol=1e-5):
+                problems.append(
+                    f"packed decision for {name} differs from a fresh "
+                    f"load (max abs err "
+                    f"{np.max(np.abs(got - direct)):.3g})")
+                break
+            want_labels = np.where(direct < 0, -1, 1).astype(np.int32)
+            if not np.array_equal(outs[name]["labels"], want_labels):
+                problems.append(f"packed labels differ for {name}")
+                break
+
+        # 4) a one-shot scan over the cold tail is all transients:
+        # the resident working set must not churn
+        before = set(cache.resident_names())
+        for i in range(budget, n_models):
+            cache.infer(f"m{i:04d}", q)
+        st = cache.stats()
+        if set(cache.resident_names()) != before:
+            problems.append("a one-shot cold scan changed the "
+                            "resident set")
+        if st["evictions"] != 0:
+            problems.append(f"a one-shot cold scan caused "
+                            f"{st['evictions']} evictions")
+        if st["transients"] != n_models - budget:
+            problems.append(
+                f"expected {n_models - budget} transient serves "
+                f"(one per cold-scan touch of a full cache), got "
+                f"{st['transients']}")
+
+        # 5) a genuinely hot newcomer evicts exactly the LRU resident.
+        # Pick the LAST-scanned model: the second-touch waiting ledger
+        # is bounded by the budget, so only recently-seen one-timers
+        # are still admission candidates (by design — a returning
+        # model from a long-past scan starts over).
+        lru = cache.resident_names()[-1]
+        newcomer = f"m{n_models - 1:04d}"
+        cache.infer(newcomer, q)            # 2nd-ever touch: admitted
+        st = cache.stats()
+        if st["evictions"] != 1 or lru in cache.resident_names():
+            problems.append(
+                f"admission over budget should evict the LRU ({lru}); "
+                f"evictions={st['evictions']}, residents="
+                f"{cache.resident_names()}")
+        if newcomer not in cache.resident_names():
+            problems.append(f"admitted newcomer {newcomer} is not "
+                            "resident")
+
+        # 6) conservation: every touch is exactly one of hit / fault /
+        # transient
+        st = cache.stats()
+        if st["touches"] != st["hits"] + st["faults"] + st["transients"]:
+            problems.append(
+                f"counter conservation violated: touches "
+                f"{st['touches']} != hits {st['hits']} + faults "
+                f"{st['faults']} + transients {st['transients']}")
+        if st["faults"] != len(cache.cold_start_ms):
+            problems.append("every fault must record a cold start "
+                            f"({st['faults']} faults, "
+                            f"{len(cache.cold_start_ms)} samples)")
+
+        # 7) the fault/evict story is a schema-valid trace
+        close_serving_trace(tr, requests=st["touches"], errors=0,
+                            seconds=_time.perf_counter() - t0,
+                            model_faults=st["faults"],
+                            model_evictions=st["evictions"])
+        tprobs = validate_trace(read_trace(trace_path))
+        if tprobs:
+            problems.append(f"fleet trace failed schema validation: "
+                            f"{tprobs[:3]}")
+        events = [r["event"] for r in read_trace(trace_path)
+                  if r.get("kind") == "event"]
+        if events.count("model_fault") != st["faults"]:
+            problems.append(
+                f"trace carries {events.count('model_fault')} "
+                f"model_fault events for {st['faults']} faults")
+        if events.count("model_evict") != st["evictions"]:
+            problems.append(
+                f"trace carries {events.count('model_evict')} "
+                f"model_evict events for {st['evictions']} evictions")
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+    return problems
+
+
+def fleet_cache_drill(tmp_dir: Optional[str] = None,
+                      trace_path: Optional[str] = None,
+                      n_models: int = 1000, budget: int = 32) -> dict:
+    """The 1000-model residency drill (the ``fleet_cache_drill`` burst
+    tag): register ``n_models`` lazily, replay a deterministic skewed
+    stream (a hot set that fits the budget + a long one-shot tail),
+    and prove the fixed budget holds — residents never exceed it, the
+    hot set stays resident through the tail scan, counters conserve,
+    and every hydration's cold start is measured. Returns ONE
+    JSON-able row (``metric: fleet_cold_start_p99_ms``, trace-pointed)
+    with the ``ok`` verdict the burst runner gates on; the CLI appends
+    it to the perf ledger (kind="fleet")."""
+    import os
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from dpsvm_tpu.observability.record import (close_serving_trace,
+                                                open_serving_trace)
+    from dpsvm_tpu.observability.schema import read_trace, validate_trace
+    from dpsvm_tpu.serving.registry import ModelRegistry
+
+    ctx = (tempfile.TemporaryDirectory() if tmp_dir is None else None)
+    base = tmp_dir if tmp_dir is not None else ctx.name
+    row: dict = {"metric": "fleet_cold_start_p99_ms", "unit": "ms",
+                 "models": int(n_models), "budget": int(budget),
+                 "ok": False}
+    try:
+        d = 4
+        # a handful of distinct artifacts shared by many names: the
+        # cache is keyed on NAMES (a registration is a tenant), so
+        # this exercises 1000-model churn without 1000 file writes
+        arts = _tiny_fleet(base, 8, specs=((0.5, d), (0.25, d)),
+                           seed=13)
+        registry = ModelRegistry()
+        t0 = _time.perf_counter()
+        for i in range(n_models):
+            registry.register(f"t{i:05d}", arts[i % len(arts)],
+                              lazy=True)
+        row["register_seconds"] = round(_time.perf_counter() - t0, 3)
+
+        if trace_path is None:
+            trace_path = os.path.join(base, "fleet_drill.jsonl")
+        tr = open_serving_trace(trace_path, models={})
+        cache = ModelCache(registry, budget=budget, max_batch=16,
+                           on_event=tr.event)
+        rng = np.random.default_rng(5)
+        q = rng.standard_normal((4, d)).astype(np.float32)
+
+        # hot set: 3/4 of the budget, touched repeatedly -> resident
+        hot = [f"t{i:05d}" for i in range(0, n_models,
+                                          n_models // (budget * 3 // 4))]
+        hot = hot[:budget * 3 // 4]
+        peak_resident = 0
+        for _ in range(3):
+            for name in hot:
+                cache.infer(name, q)
+                peak_resident = max(peak_resident,
+                                    cache.stats()["resident"])
+        # one-shot tail: every model once, in name order
+        for i in range(n_models):
+            cache.infer(f"t{i:05d}", q)
+            if i % 250 == 0:
+                peak_resident = max(peak_resident,
+                                    cache.stats()["resident"])
+        st = cache.stats()
+        peak_resident = max(peak_resident, st["resident"])
+        seconds = _time.perf_counter() - t0
+        close_serving_trace(tr, requests=st["touches"], errors=0,
+                            seconds=seconds,
+                            model_faults=st["faults"],
+                            model_evictions=st["evictions"])
+        tprobs = validate_trace(read_trace(trace_path))
+
+        row.update({
+            "value": round(st["cold_start_p99_ms"], 3),
+            "touches": st["touches"], "hits": st["hits"],
+            "faults": st["faults"], "transients": st["transients"],
+            "evictions": st["evictions"],
+            "resident": st["resident"],
+            "peak_resident": peak_resident,
+            "resident_bytes_est": st["resident_bytes_est"],
+            "packer": st["packer"],
+            "hot_models": len(hot),
+            "seconds": round(seconds, 3),
+            "trace": trace_path,
+            "trace_valid": not tprobs,
+        })
+        hot_resident = all(cache.is_resident(n) for n in hot)
+        conserved = (st["touches"] ==
+                     st["hits"] + st["faults"] + st["transients"])
+        row["hot_set_survived_scan"] = hot_resident
+        row["ok"] = bool(conserved and hot_resident and not tprobs
+                         and peak_resident <= budget
+                         and st["faults"] >= len(hot)
+                         and row["value"] > 0.0)
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+    return row
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(prog="python -m dpsvm_tpu.fleet")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="64 lazy models under a cache budget of 8: "
+                        "asserts counter conservation, a scan-proof "
+                        "resident set, zero steady-state retraces "
+                        "through the packed groups, parity with fresh "
+                        "loads, and a schema-valid fault/evict trace")
+    p.add_argument("--drill", action="store_true",
+                   help="the 1000-model fleet_cache_drill: lazy-boot a "
+                        "1000-name registry, replay a skewed stream "
+                        "under a budget of 32, and print ONE JSON row "
+                        "(fleet_cold_start_p99_ms, trace-pointed); "
+                        "exits 0 iff the budget held and counters "
+                        "conserved")
+    args = p.parse_args(argv)
+    if not (args.selfcheck or args.drill):
+        p.print_help()
+        return 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.drill:
+        import json
+
+        trace_env = os.environ.get("BENCH_TRACE_OUT")
+        row = fleet_cache_drill(trace_path=trace_env or None)
+        print(json.dumps(row))
+        return 0 if row.get("ok") else 1
+    problems = selfcheck()
+    if problems:
+        print("fleet selfcheck FAILED:", file=sys.stderr)
+        for pr in problems:
+            print(f"  {pr}", file=sys.stderr)
+        return 1
+    print("fleet selfcheck OK (64 lazy models under a budget of 8: "
+          "counters conserved, one-shot churn never touched the "
+          "working set, zero stray retraces through the packed "
+          "groups, packed decisions match fresh loads, fault/evict "
+          "trace schema-valid)")
+    return 0

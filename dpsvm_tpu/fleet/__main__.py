@@ -1,0 +1,3 @@
+from dpsvm_tpu.fleet import main
+
+raise SystemExit(main())

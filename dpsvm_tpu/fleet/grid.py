@@ -1,0 +1,272 @@
+"""Mesh-parallel C×γ grid trainer: the fleet's model production line.
+
+Hyperparameter search dominates fleet training cost ("A Recipe for
+Fast Large-scale SVM Training", arxiv 2207.01016): every tenant model
+is really a C×γ GRID of candidate models, of which one is promoted.
+The repo already holds the hard part — ``solver/batched_ovo.
+train_c_sweep`` solves a whole C×γ product grid as ONE compiled
+batched program (C only moves the box bound, γ only the kernel
+epilogue after the shared dot products). This module wraps it into
+the production line:
+
+* **mesh parallelism** — the C axis is partitioned contiguously
+  across local devices, one batched sweep program per device running
+  concurrently (each partition is still a full C-chunk × γ batched
+  solve, so the per-device program keeps the shared-kernel-pass
+  economics). On one device the partition is the whole grid — same
+  numbers, one program;
+* **held-out selection** — a deterministic seeded split scores every
+  cell on rows the solver never saw; the winner is the row-major-first
+  argmax (ties break toward smaller C then smaller γ, the LIBSVM
+  grid.py convention of preferring the simpler model);
+* **cascade polish** — the winning cell can be refit on ALL rows
+  (train + holdout) through the cascade schedule
+  (``config.solver="cascade"``), warm-starting from the sweep's
+  screening economics — the sweep picks, the polish ships;
+* **one trace** — a ``RunTrace(solver="grid")`` carries a
+  ``grid_cell`` event per cell (C, γ, held-out accuracy, n_sv) and a
+  ``grid_winner`` marker, so ``dpsvm report`` renders a grid run like
+  any other solve;
+* **atomic promotion** — ``promote_winner`` hands the winner to
+  ``ModelRegistry.promote_file``, the repo's only blessed
+  artifact-swap path (os.replace + fully-warmed reload).
+
+``grid_vs_sequential`` times the same grid as per-cell sequential
+``api.fit`` calls and emits the speedup — the perf-ledger's
+``grid_vs_sequential`` row (docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class GridCell:
+    """One (C, γ) grid point: its compacted model, solver result, and
+    held-out score."""
+    c: float
+    gamma: float
+    model: object
+    result: object
+    holdout_acc: float
+
+
+@dataclasses.dataclass
+class GridResult:
+    cells: List[GridCell]               # row-major (C, gamma) order
+    winner: int                         # index into cells
+    n_train: int
+    n_holdout: int
+    train_seconds: float                # wall for the whole grid
+    devices: int
+    polished: bool = False
+
+    @property
+    def best(self) -> GridCell:
+        return self.cells[self.winner]
+
+
+def holdout_split(n: int, holdout_frac: float, seed: int):
+    """Deterministic shuffled split: (train_idx, holdout_idx). Seeded
+    permutation, not a stride — stride splits alias sorted datasets
+    (every k-th row one class) and the grid's scores must mean the
+    same thing on every run of the same seed."""
+    if not 0.0 < holdout_frac < 1.0:
+        raise ValueError(f"holdout_frac must be in (0, 1), "
+                         f"got {holdout_frac}")
+    n_hold = max(1, int(round(n * holdout_frac)))
+    if n_hold >= n:
+        raise ValueError(f"holdout_frac {holdout_frac} leaves no "
+                         f"training rows (n={n})")
+    perm = np.random.default_rng(seed).permutation(n)
+    return np.sort(perm[n_hold:]), np.sort(perm[:n_hold])
+
+
+def _partition(items: Sequence, k: int) -> List[List]:
+    """Contiguous near-even split of ``items`` into <= k non-empty
+    chunks (order preserved — partitioning the C axis keeps row-major
+    reassembly trivial)."""
+    k = max(1, min(int(k), len(items)))
+    base, extra = divmod(len(items), k)
+    out, lo = [], 0
+    for i in range(k):
+        hi = lo + base + (1 if i < extra else 0)
+        out.append(list(items[lo:hi]))
+        lo = hi
+    return out
+
+
+def train_grid(x, y, *, cs: Sequence[float],
+               gammas: Optional[Sequence[float]] = None,
+               config=None, holdout_frac: float = 0.2, seed: int = 0,
+               polish: bool = False, trace=None,
+               max_devices: Optional[int] = None) -> GridResult:
+    """Solve the full C×γ grid, score every cell held-out, pick the
+    winner. ``trace`` is an open ``RunTrace`` (the caller owns its
+    lifecycle — the CLI opens one per run; library callers may pass
+    None)."""
+    import jax
+
+    from dpsvm_tpu import api
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.svm import evaluate
+
+    config = config or SVMConfig()
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y)
+    cs = [float(c) for c in cs]
+    gammas_l = [float(g) for g in gammas] if gammas is not None else None
+    if not cs:
+        raise ValueError("grid needs at least one C value")
+
+    tr_idx, ho_idx = holdout_split(len(y), holdout_frac, seed)
+    x_tr, y_tr = x[tr_idx], y[tr_idx]
+    x_ho, y_ho = x[ho_idx], y[ho_idx]
+
+    devices = jax.local_devices()
+    if max_devices is not None:
+        devices = devices[:max(1, int(max_devices))]
+    c_parts = _partition(cs, len(devices))
+
+    t0 = time.perf_counter()
+    part_out: List[Optional[list]] = [None] * len(c_parts)
+    errors: List[BaseException] = []
+
+    def _solve(i: int, part_cs: List[float], dev) -> None:
+        # one batched sweep program per device; jax dispatches the
+        # whole partition onto `dev` (computation-follows-data via
+        # default_device, so partitions genuinely run side by side on
+        # a multi-device host)
+        try:
+            with jax.default_device(dev):
+                part_out[i] = api.sweep_c(x_tr, y_tr, part_cs,
+                                          config, gammas=gammas_l)
+        except BaseException as e:          # re-raised on the caller
+            errors.append(e)
+
+    if len(c_parts) == 1:
+        _solve(0, c_parts[0], devices[0])
+    else:
+        threads = [threading.Thread(target=_solve,
+                                    args=(i, p, devices[i % len(devices)]),
+                                    name=f"grid-part-{i}")
+                   for i, p in enumerate(c_parts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0]
+    fitted = [pair for part in part_out for pair in (part or [])]
+    grid_seconds = time.perf_counter() - t0
+
+    gs = gammas_l if gammas_l is not None else [None]
+    cells: List[GridCell] = []
+    for i, (model, result) in enumerate(fitted):
+        c_val = cs[i // len(gs)]
+        g_val = float(result.gamma)
+        acc = float(evaluate(model, x_ho, y_ho))
+        cells.append(GridCell(c=c_val, gamma=g_val, model=model,
+                              result=result, holdout_acc=acc))
+        if trace is not None:
+            trace.event("grid_cell", n_iter=int(result.n_iter),
+                        c=c_val, gamma=g_val,
+                        holdout_acc=round(acc, 6),
+                        n_sv=int(result.n_sv),
+                        converged=bool(result.converged))
+    winner = int(np.argmax([c.holdout_acc for c in cells]))
+
+    polished = False
+    if polish:
+        # refit the winning cell on ALL rows through the cascade
+        # schedule — the shipped artifact sees the holdout too
+        best = cells[winner]
+        pol_cfg = dataclasses.replace(config, c=best.c,
+                                      gamma=best.gamma,
+                                      solver="cascade")
+        model, result = api.fit(x, y, pol_cfg)
+        cells[winner] = GridCell(c=best.c, gamma=best.gamma,
+                                 model=model, result=result,
+                                 holdout_acc=best.holdout_acc)
+        polished = True
+
+    out = GridResult(cells=cells, winner=winner, n_train=len(tr_idx),
+                     n_holdout=len(ho_idx),
+                     train_seconds=time.perf_counter() - t0,
+                     devices=len(c_parts), polished=polished)
+    if trace is not None:
+        best = out.best
+        trace.event("grid_winner", n_iter=int(best.result.n_iter),
+                    c=best.c, gamma=best.gamma,
+                    holdout_acc=round(best.holdout_acc, 6),
+                    polished=polished)
+        trace.summary(converged=all(c.result.converged for c in cells),
+                      n_iter=max(int(c.result.n_iter) for c in cells),
+                      b=float(best.result.b),
+                      b_lo=float(best.result.b_lo),
+                      b_hi=float(best.result.b_hi),
+                      n_sv=int(best.result.n_sv),
+                      train_seconds=out.train_seconds,
+                      grid_cells=len(cells),
+                      grid_devices=out.devices,
+                      grid_seconds=round(grid_seconds, 6))
+    return out
+
+
+def sequential_grid_seconds(x, y, *, cs: Sequence[float],
+                            gammas: Optional[Sequence[float]] = None,
+                            config=None, holdout_frac: float = 0.2,
+                            seed: int = 0) -> Tuple[float, List]:
+    """The baseline the batched grid is measured against: the same
+    cells, one ``api.fit`` each, same train/holdout split. Returns
+    (wall_seconds, [(c, gamma, model)] in the grid's row-major
+    order)."""
+    from dpsvm_tpu import api
+    from dpsvm_tpu.config import SVMConfig
+
+    config = config or SVMConfig()
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y)
+    tr_idx, _ = holdout_split(len(y), holdout_frac, seed)
+    x_tr, y_tr = x[tr_idx], y[tr_idx]
+    gs = [float(g) for g in gammas] if gammas is not None else [config.gamma]
+    t0 = time.perf_counter()
+    fitted = []
+    for c in cs:
+        for g in gs:
+            cfg = dataclasses.replace(config, c=float(c), gamma=g)
+            model, _ = api.fit(x_tr, y_tr, cfg)
+            fitted.append((float(c), g, model))
+    return time.perf_counter() - t0, fitted
+
+
+def promote_winner(grid: GridResult, registry, name: str) -> int:
+    """Ship the winning cell through the registry's atomic promote
+    path: serialize the model to a candidate file next to the
+    registered source, then ``promote_file`` (os.replace + warmed
+    reload — the ONLY blessed artifact swap, docs/SERVING.md
+    "Continuous learning"). Returns the new generation."""
+    from dpsvm_tpu.models.io import save_model
+
+    source = registry.source(name)
+    if source is None:
+        raise ValueError(f"model {name!r} was registered in-memory; "
+                         "there is no source path to promote onto")
+    d = os.path.dirname(os.path.abspath(source)) or "."
+    fd, cand = tempfile.mkstemp(prefix=f".{os.path.basename(source)}.",
+                                suffix=".grid-cand", dir=d)
+    os.close(fd)
+    try:
+        save_model(grid.best.model, cand)
+        return registry.promote_file(name, cand)
+    finally:
+        if os.path.exists(cand):        # promote_file moved it on success
+            os.unlink(cand)

@@ -1,0 +1,345 @@
+"""HBM-budgeted model cache: thousands of tenants, a fixed buffer pool.
+
+The reference CUDA trainer's ``cache.cu`` keeps hot kernel ROWS in a
+fixed slab and pages cold rows out under LRU. A model fleet has the
+same economics one level up: device memory holds a fixed number of
+models' SV/feature buffers, and the tenant popularity distribution is
+heavy-tailed — so the cache unit here is the MODEL, and the admission
+discipline is borrowed wholesale from the per-tenant label budget
+(``observability/metrics.TenantLabelBudget``):
+
+* **second-touch admission** — while the budget has free slots a
+  first touch hydrates immediately (an empty cache should warm fast);
+  once it is FULL, the first request for a cold model is served from a
+  throwaway engine (a *transient*: correct, but cold) and only a
+  second touch hydrates it — evicting the LRU resident. A one-shot
+  scan over 10k models therefore costs 10k transients and ZERO
+  evictions — the resident working set never churns (pinned in
+  tests/test_modelfleet.py);
+* **LRU-of-activity eviction** — admission beyond the budget evicts
+  the least-recently-touched resident; the budget ledger's monotone
+  tick (no wall clock) keeps the resident set deterministic for the
+  selfcheck;
+* **fault/evict accounting** — every hydration is a ``model_fault``
+  (with its measured ``cold_start_ms``), every page-out a
+  ``model_evict``; both flow through ``on_event`` into the serving
+  trace and the ``dpsvm_fleet_model_*_total`` counters the watchtower's
+  ``model-cache-thrash`` rule watches (observability/slo.py).
+
+Resident packable models (binary SV models) live in same-spec
+``PackedGroup``s (fleet/packer.py): their device footprint is their
+segment of the shared concatenated-SV program, so N resident tenants
+of one spec cost one warmed ladder and one dispatch per request.
+Unpackable residents (multiclass dirs, approx/precomputed models,
+in-memory registrations) hold a dedicated warmed ``PredictionEngine``.
+
+Conservation law (pinned in tests): every ``infer`` is exactly one of
+hit / fault / transient, so ``touches == hits + faults + transients``
+and ``evictions <= faults`` always.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dpsvm_tpu.fleet.packer import GroupPacker, packable
+from dpsvm_tpu.observability.metrics import TenantLabelBudget
+
+
+class _Resident:
+    """One hydrated model: either a packed-group member (raw model +
+    optional Platt sidecar) or a dedicated engine for unpackable
+    kinds."""
+    __slots__ = ("model", "platt", "engine", "cold_start_ms")
+
+    def __init__(self, model=None, platt=None, engine=None,
+                 cold_start_ms=0.0):
+        self.model = model
+        self.platt = platt
+        self.engine = engine
+        self.cold_start_ms = float(cold_start_ms)
+
+
+class ModelCache:
+    """Budgeted residency manager over a ``ModelRegistry``.
+
+    The registry holds the manifest of EVERY model (registered lazy —
+    serving/registry.py); the cache decides which of them hold device
+    buffers right now. ``infer(name, x, want)`` is the single entry
+    point: it resolves residency, hydrates or serves transiently as
+    the admission policy dictates, and answers from the packed group
+    (one shared dispatch) or the resident/transient engine.
+    """
+
+    def __init__(self, registry, *, budget: int, max_batch: int = 64,
+                 precision: str = "highest", warmup: bool = True,
+                 on_event: Optional[Callable[..., None]] = None):
+        if budget < 1:
+            raise ValueError(f"model cache budget must be >= 1, "
+                             f"got {budget}")
+        self.registry = registry
+        self.budget = int(budget)
+        self.max_batch = int(max_batch)
+        self.precision = str(precision)
+        self.warmup = bool(warmup)
+        self.on_event = on_event
+        self._lock = threading.RLock()
+        # The admission policy IS the tenant label budget, applied to
+        # model names: same second-touch + LRU-of-activity ledger,
+        # same deterministic tick. on_evict fires inside resolve() —
+        # the RLock makes the page-out re-entrant from _admit.
+        self._ledger = TenantLabelBudget(self.budget,
+                                         on_evict=self._page_out)
+        self._packer = GroupPacker(max_batch=self.max_batch,
+                                   precision=self.precision,
+                                   warmup=self.warmup)
+        self._resident: Dict[str, _Resident] = {}
+        self.touches = 0
+        self.hits = 0
+        self.faults = 0
+        self.transients = 0
+        self.evictions = 0
+        self.cold_start_ms: List[float] = []
+
+    # -- events -------------------------------------------------------
+
+    def _emit(self, event: str, **extra) -> None:
+        if self.on_event is not None:
+            self.on_event(event, **extra)
+
+    # -- residency ----------------------------------------------------
+
+    def resident_names(self) -> List[str]:
+        """Resident models, most-recently-touched first (the ledger's
+        activity order)."""
+        with self._lock:
+            return [n for n in self._ledger.residents()
+                    if n in self._resident]
+
+    def is_resident(self, name: str) -> bool:
+        with self._lock:
+            return name in self._resident
+
+    def _page_out(self, name: str) -> None:
+        """Ledger eviction hook: free ``name``'s device buffers (its
+        packed-group segment or its engine) but keep the registry
+        entry — the model re-hydrates from its source on the next
+        second touch."""
+        with self._lock:
+            res = self._resident.pop(name, None)
+            if res is None:
+                return
+            self._packer.remove(name)
+            self.evictions += 1
+        self._emit("model_evict", model=name)
+
+    def evict(self, name: str) -> bool:
+        """Operator page-out (doctor/drills). Returns whether the
+        model was resident."""
+        with self._lock:
+            was = name in self._resident
+            self._page_out(name)
+            return was
+
+    def _hydrate(self, name: str) -> _Resident:
+        """Load ``name`` from its registered source and give it device
+        residency: packable binary SV models join their spec's
+        PackedGroup (warmed ladder shared across the group), anything
+        else gets a dedicated warmed engine. The measured wall time is
+        the model's cold start."""
+        t0 = time.perf_counter()
+        source = self.registry.source(name)
+        res = _Resident()
+        if source is None or os.path.isdir(source):
+            # in-memory registration or multiclass dir: the registry's
+            # replica-build path already does the right load + warmup
+            from dpsvm_tpu.serving.engine import PredictionEngine
+
+            if source is None:
+                res.engine = self.registry.build(name)
+            else:
+                res.engine = PredictionEngine.load(
+                    source, name=name, max_batch=self.max_batch,
+                    precision=self.precision, warmup=self.warmup)
+        else:
+            from dpsvm_tpu.models.io import load_model
+            from dpsvm_tpu.serving.engine import (PredictionEngine,
+                                                  _load_binary_platt)
+
+            model = load_model(source)
+            if packable(model):
+                res.model = model
+                res.platt = _load_binary_platt(source)
+                self._packer.add(name, model)
+                # warm the (possibly repacked) group now so the fault
+                # pays the whole cold start, not the next request
+                g = self._packer.group_for(name)
+                if g is not None and self.warmup:
+                    g.decisions_all(np.zeros(
+                        (1, g.spec.num_attributes), np.float32))
+            else:
+                res.engine = PredictionEngine(
+                    model, name=name, max_batch=self.max_batch,
+                    precision=self.precision, warmup=self.warmup)
+        res.cold_start_ms = (time.perf_counter() - t0) * 1e3
+        self._resident[name] = res
+        self.faults += 1
+        self.cold_start_ms.append(res.cold_start_ms)
+        return res
+
+    def _transient_engine(self, name: str):
+        """Serve a non-admitted touch from a throwaway engine: no
+        warmup, no residency, dropped after the reply. Correctness is
+        identical (same load path, same jitted programs); the cost is
+        the cold dispatch — which is the POINT: one-shot churn pays
+        its own price instead of evicting the working set."""
+        from dpsvm_tpu.serving.engine import PredictionEngine
+
+        source = self.registry.source(name)
+        if source is None:
+            return self.registry.build(name)
+        return PredictionEngine.load(source, name=name,
+                                     max_batch=self.max_batch,
+                                     precision=self.precision,
+                                     warmup=False)
+
+    # -- serving ------------------------------------------------------
+
+    def infer(self, name: str, x, want: Sequence[str] = ("labels",)) -> dict:
+        """Serve one request for ``name``: hit (resident), fault
+        (second touch — hydrate, then serve warm), or transient (first
+        touch — throwaway engine). Raises KeyError for an unregistered
+        name, ValueError for bad inputs (same contract as
+        ``PredictionEngine.infer``)."""
+        self.registry.source(name)          # KeyError for unknown names
+        with self._lock:
+            self.touches += 1
+            resolved = self._ledger.resolve(name)
+            res = self._resident.get(name)
+            if res is not None:
+                self.hits += 1
+                return self._serve_resident(name, res, x, want)
+            if resolved == name:
+                # admitted (second touch): hydration fault — serve
+                # under the lock so a concurrent evict can't unseat
+                # the model between hydration and its first answer
+                res = self._hydrate(name)
+                out = self._serve_resident(name, res, x, want)
+                cold_ms = res.cold_start_ms
+            else:
+                out = None
+        if out is not None:
+            self._emit("model_fault", model=name,
+                       cold_start_ms=round(cold_ms, 3))
+            return out
+        # not admitted: transient serve outside the lock (slow path
+        # must not block resident traffic)
+        engine = self._transient_engine(name)
+        with self._lock:
+            self.transients += 1
+        return engine.infer(x, want=want)
+
+    def _serve_resident(self, name: str, res: _Resident, x, want) -> dict:
+        if res.engine is not None:
+            return res.engine.infer(x, want=want)
+        from dpsvm_tpu.serving.batcher import KNOWN_OUTPUTS
+
+        unknown = [w for w in want if w not in KNOWN_OUTPUTS]
+        if unknown:
+            raise ValueError(f"unknown outputs {unknown}; "
+                             f"pick from {list(KNOWN_OUTPUTS)}")
+        if "proba" in want and res.platt is None:
+            raise ValueError(
+                f"model {name!r} has no probability calibration — "
+                "binary models need the .platt.json sidecar next to "
+                "the model file")
+        group = self._packer.group_for(name)
+        if group is None:                    # pragma: no cover - guard
+            raise RuntimeError(f"resident model {name!r} lost its "
+                               "packed group")
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[1] != group.spec.num_attributes:
+            # engines raise the same ValueError shape; the server maps
+            # it to HTTP 400 on the cold path too
+            raise ValueError(
+                f"model {name!r} expects (n, "
+                f"{group.spec.num_attributes}) features, got "
+                f"{tuple(x.shape)}")
+        dec = group.decisions_for(name, x)
+        out: dict = {}
+        if "decision" in want:
+            out["decision"] = dec
+        if "labels" in want:
+            if getattr(res.model, "task", "svc") == "svr":
+                out["labels"] = dec
+            else:
+                out["labels"] = np.where(dec < 0, -1, 1).astype(np.int32)
+        if "proba" in want:
+            from dpsvm_tpu.models.calibration import sigmoid_proba
+            pa, pb = res.platt
+            # packed groups serve include_b=True decisions, the form
+            # the Platt sigmoid is defined on
+            out["proba"] = sigmoid_proba(dec, pa, pb)
+        return out
+
+    def decisions_group(self, name: str, x) -> np.ndarray:
+        """(m, N) decision matrix of the WHOLE spec group ``name``
+        belongs to — the fleet sweep shape (score every same-spec
+        resident on one batch in one dispatch per ladder pass)."""
+        with self._lock:
+            group = self._packer.group_for(name)
+            if group is None:
+                raise KeyError(f"model {name!r} is not resident in a "
+                               "packed group")
+        return group.decisions_all(x)
+
+    # -- accounting ---------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        """Estimated device bytes held by resident models: packed
+        groups hold float32 SV rows + coefficients + segment ids +
+        intercepts; engine residents are estimated from their SV
+        count. The budget is enforced in MODELS (the ledger), this is
+        the observability companion for the docs' budget math."""
+        with self._lock:
+            total = 0
+            for g in self._packer.groups():
+                s = g.stats()
+                total += s["n_sv"] * (g.spec.num_attributes + 2) * 4
+                total += s["members"] * 4
+            for res in self._resident.values():
+                if res.engine is not None:
+                    d = int(res.engine.num_attributes)
+                    total += int(res.engine.n_sv) * (d + 2) * 4
+            return total
+
+    def stats(self) -> dict:
+        """Counters + ledger + packer state for /metricsz and the
+        doctor probe. Conservation: touches == hits + faults +
+        transients."""
+        with self._lock:
+            ledger = self._ledger.stats()
+            return {
+                "budget": self.budget,
+                "resident": len(self._resident),
+                "touches": self.touches,
+                "hits": self.hits,
+                "faults": self.faults,
+                "transients": self.transients,
+                "evictions": self.evictions,
+                "ledger_overflow": ledger["overflow"],
+                "resident_bytes_est": self.resident_bytes(),
+                "cold_start_p99_ms": _p99(self.cold_start_ms),
+                "packer": self._packer.stats(),
+            }
+
+
+def _p99(vals: List[float]) -> float:
+    if not vals:
+        return 0.0
+    return float(np.percentile(np.asarray(vals, np.float64), 99.0))

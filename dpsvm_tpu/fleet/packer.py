@@ -1,0 +1,281 @@
+"""Same-spec batched serving: N resident models, one decision program.
+
+A fleet of tenant models is not N unrelated programs. Tenants
+overwhelmingly train with the same recipe — same kernel family, same
+gamma, same feature width — so their models differ only in WHICH
+support vectors they hold, not in the program that evaluates them.
+``serving/engine.SegmentPack`` already proves the collapsed shape for
+one multiclass model's OvO pairs: concatenate every member's SVs,
+evaluate one ``(m, d) @ (d, S_total)`` kernel pass, and segment-sum
+per member. This module generalizes that pack to arbitrary same-spec
+model GROUPS, so the fleet's cold path costs one warmed ladder per
+spec instead of one per model:
+
+* **one compile budget per spec** — the group's bucket ladder is
+  warmed once; a request for ANY member runs the shared program at
+  zero steady-state retraces (the engine's guarantee, inherited —
+  same ``compilewatch`` instrumentation, same selfcheck discipline);
+* **one dispatch per request** — a member request pads into a ladder
+  bucket and reads its own column of the ``(m, N)`` decision matrix.
+  The extra columns are the price of sharing, and they are cheap: the
+  kernel pass is dominated by the shared X stream, exactly the
+  argument ``solver/batched_ovo.py`` makes for batched training;
+* **membership changes repack** — admitting or evicting a member
+  changes ``num_segments`` (a static arg), so the next dispatch
+  retraces once. Repacks are counted (``repacks`` in ``stats()``) and
+  the fleet selfcheck pins that a churn-free steady state stays at
+  zero.
+
+Parity: a member's column is evaluated by the exact jitted program
+(``models/svm._pairwise_decisions_jit``) the multiclass engine serves
+with, at the same ``precision="highest"`` default — bitwise equal to
+a dedicated ``PredictionEngine`` for that model (pinned in
+tests/test_modelfleet.py).
+
+No jax at module import; the pack builds lazily on first dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GroupSpec(NamedTuple):
+    """The identity a model must share to join a packed group: the
+    static/traced knobs of the segment-sum program plus the feature
+    width. Two models with equal GroupSpec compile to the same XLA
+    program and can concatenate."""
+    kernel: str
+    gamma: float
+    coef0: float
+    degree: int
+    num_attributes: int
+
+    @classmethod
+    def of(cls, model) -> "GroupSpec":
+        return cls(kernel=str(model.kernel), gamma=float(model.gamma),
+                   coef0=float(model.coef0), degree=int(model.degree),
+                   num_attributes=int(model.num_attributes))
+
+
+def packable(model) -> bool:
+    """Whether ``model`` can join a same-spec group: a binary SV model
+    with feature rows to concatenate. Approx models have no SV set,
+    precomputed kernels no feature rows, multiclass containers pack
+    their own pairs already (``engine._build_mc_batched``)."""
+    if getattr(model, "is_approx", False):
+        return False
+    if getattr(model, "models", None) is not None:   # multiclass dir
+        return False
+    return getattr(model, "kernel", None) not in (None, "precomputed")
+
+
+class PackedGroup:
+    """One spec's members behind one SegmentPack + bucket ladder.
+
+    Members are (name, model) in admission order; ``decisions_for``
+    streams a request through the ladder exactly like
+    ``PredictionEngine._decisions`` (full top-rung passes + one padded
+    remainder bucket) and slices the member's column. The pack is
+    rebuilt lazily after a membership change (``dirty``), and the new
+    pack's ladder is re-warmed inside the rebuild so steady-state
+    traffic never observes the retrace mid-request."""
+
+    def __init__(self, spec: GroupSpec, *, max_batch: int = 64,
+                 include_b: bool = True, precision: str = "highest",
+                 warmup: bool = True):
+        from dpsvm_tpu.serving.engine import bucket_ladder
+
+        self.spec = spec
+        self.max_batch = int(max_batch)
+        self.buckets = bucket_ladder(self.max_batch)
+        self.include_b = bool(include_b)
+        self.precision = str(precision)
+        self.warmup = bool(warmup)
+        self._lock = threading.Lock()
+        self._names: List[str] = []
+        self._models: List = []
+        self._col: Dict[str, int] = {}
+        self._pack = None                  # SegmentPack, built lazily
+        self.repacks = 0
+        self.dispatches = 0
+
+    # -- membership ---------------------------------------------------
+
+    def add(self, name: str, model) -> None:
+        with self._lock:
+            if name in self._col:
+                raise ValueError(f"model {name!r} already packed")
+            if GroupSpec.of(model) != self.spec:
+                raise ValueError(f"model {name!r} spec "
+                                 f"{GroupSpec.of(model)} != group "
+                                 f"spec {self.spec}")
+            self._names.append(name)
+            self._models.append(model)
+            self._col[name] = len(self._names) - 1
+            self._pack = None              # membership change: repack
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            i = self._col.pop(name, None)
+            if i is None:
+                raise KeyError(f"model {name!r} not in group")
+            del self._names[i]
+            del self._models[i]
+            self._col = {n: j for j, n in enumerate(self._names)}
+            self._pack = None
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._col
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._names)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._names)
+
+    # -- evaluation ---------------------------------------------------
+
+    def _ensure_pack(self):
+        """Build (or rebuild) the SegmentPack under the lock; warm the
+        ladder so the retrace is paid HERE, at the membership change,
+        never spread across later member requests."""
+        from dpsvm_tpu.serving.engine import SegmentPack
+
+        if self._pack is not None:
+            return self._pack
+        if not self._models:
+            raise RuntimeError("packed group is empty")
+        self._pack = SegmentPack(
+            list(self._models),
+            tag=f"fleet[{self.spec.kernel}/g{self.spec.gamma:g}"
+                f"/d{self.spec.num_attributes}]",
+            include_b=self.include_b,
+            precision_name=self.precision.upper())
+        self.repacks += 1
+        if self.warmup:
+            d = self.spec.num_attributes
+            for bucket in self.buckets:
+                self._pack.decide(np.zeros((bucket, d), np.float32))
+        return self._pack
+
+    def _bucket_for(self, m: int) -> int:
+        for b in self.buckets:
+            if b >= m:
+                return b
+        return self.max_batch
+
+    def decisions_all(self, x: np.ndarray) -> np.ndarray:
+        """(m, N) decision matrix for every member at once — the
+        fleet's offline sweep shape (score N tenants' models on one
+        batch in one dispatch per ladder pass)."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[1] != self.spec.num_attributes:
+            raise ValueError(
+                f"instances must be (m, {self.spec.num_attributes}), "
+                f"got shape {x.shape}")
+        m = x.shape[0]
+        out = None
+        lo = 0
+        with self._lock:
+            pack = self._ensure_pack()
+            while lo < m:
+                take = min(self.max_batch, m - lo)
+                bucket = self._bucket_for(take)
+                block = np.zeros((bucket, x.shape[1]), np.float32)
+                block[:take] = x[lo:lo + take]
+                vals = pack.decide(block)
+                self.dispatches += 1
+                if out is None:
+                    out = np.empty((m, vals.shape[1]), vals.dtype)
+                out[lo:lo + take] = vals[:take]
+                lo += take
+        return out
+
+    def decisions_for(self, name: str, x: np.ndarray) -> np.ndarray:
+        """(m,) decision values for one member — a per-model request
+        through the shared program."""
+        with self._lock:
+            i = self._col.get(name)
+        if i is None:
+            raise KeyError(f"model {name!r} not in group")
+        return self.decisions_all(x)[:, i]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"members": len(self._names),
+                    "n_sv": int(sum(int(m.n_sv) for m in self._models)),
+                    "repacks": self.repacks,
+                    "dispatches": self.dispatches,
+                    "packed": self._pack is not None}
+
+
+class GroupPacker:
+    """The fleet's spec -> PackedGroup router: every packable resident
+    model lands in exactly one group keyed by its GroupSpec. The model
+    cache (fleet/modelcache.py) owns membership (admit -> ``add``,
+    evict -> ``remove``); this class only keeps the grouping honest
+    and answers 'which shared program serves this name'."""
+
+    def __init__(self, *, max_batch: int = 64, include_b: bool = True,
+                 precision: str = "highest", warmup: bool = True):
+        self.max_batch = int(max_batch)
+        self.include_b = bool(include_b)
+        self.precision = str(precision)
+        self.warmup = bool(warmup)
+        self._lock = threading.Lock()
+        self._groups: Dict[GroupSpec, PackedGroup] = {}
+        self._group_of: Dict[str, GroupSpec] = {}
+
+    def add(self, name: str, model) -> Optional[PackedGroup]:
+        """Pack ``name`` into its spec group (created on first member).
+        Returns the group, or None for an unpackable model (the caller
+        keeps a dedicated engine instead)."""
+        if not packable(model):
+            return None
+        spec = GroupSpec.of(model)
+        with self._lock:
+            g = self._groups.get(spec)
+            if g is None:
+                g = PackedGroup(spec, max_batch=self.max_batch,
+                                include_b=self.include_b,
+                                precision=self.precision,
+                                warmup=self.warmup)
+                self._groups[spec] = g
+            self._group_of[name] = spec
+        g.add(name, model)
+        return g
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            spec = self._group_of.pop(name, None)
+            if spec is None:
+                return False
+            g = self._groups[spec]
+        g.remove(name)
+        with self._lock:
+            if len(g) == 0 and self._groups.get(spec) is g:
+                del self._groups[spec]
+        return True
+
+    def group_for(self, name: str) -> Optional[PackedGroup]:
+        with self._lock:
+            spec = self._group_of.get(name)
+            return self._groups.get(spec) if spec is not None else None
+
+    def groups(self) -> List[PackedGroup]:
+        with self._lock:
+            return list(self._groups.values())
+
+    def stats(self) -> dict:
+        gs = self.groups()
+        return {"groups": len(gs),
+                "packed_models": int(sum(len(g) for g in gs)),
+                "repacks": int(sum(g.repacks for g in gs)),
+                "dispatches": int(sum(g.dispatches for g in gs))}

@@ -53,8 +53,11 @@ LEDGER_SCHEMA = 1
 #: plus the tuned_vs_default A/B verdict. "robust" rows come from the
 #: resilience drills (resilience/hostgroup.host_loss_drill):
 #: recovery latencies, gated direction "lower" like any latency.
+#: "fleet" rows come from the model-fleet subsystem (dpsvm_tpu/fleet):
+#: the fleet_cache_drill's cold-start p99 and `dpsvm grid`'s
+#: grid_vs_sequential speedup, both trace-pointed (docs/PERF.md).
 KINDS = ("bench", "burst", "loadgen", "compare", "tune", "serve",
-         "robust")
+         "robust", "fleet")
 
 #: unit -> gate direction ("higher" = bigger is better). The per-record
 #: ``direction`` field wins; the metric-name heuristics below back this

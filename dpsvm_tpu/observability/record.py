@@ -154,6 +154,26 @@ SERVING_SPANS = ("request", "admission", "queue_wait", "batch_form",
                  "device_dispatch", "respond", "replica_compute",
                  "hedge_fired", "hedge_won", "redispatch")
 
+# Event types the model-fleet layer emits into a serving trace
+# (dpsvm_tpu/fleet/modelcache.py, docs/SERVING.md "Model fleet"):
+# `model_fault` = a cold model was hydrated into the budgeted cache
+# (requires `model` + `cold_start_ms` — the measured cold start is the
+# whole point of the event; the fleet drill's p99 over these IS the
+# `fleet_cold_start_p99_ms` ledger row), `model_evict` = the admission
+# ledger paged a resident model's buffers out (requires `model`). The
+# watchtower's `model-cache-thrash` rule rates the fault counter these
+# events mirror (observability/slo.py).
+FLEET_EVENTS = ("model_fault", "model_evict")
+
+# Event types the C×γ grid trainer emits (dpsvm_tpu/fleet/grid.py,
+# docs/PERF.md): `grid_cell` = one grid point solved + scored held-out
+# (requires `c`/`gamma`/`holdout_acc`; carries n_sv + convergence),
+# `grid_winner` = the selected cell (requires `c`/`gamma`; carries
+# whether the cascade polish refit it). A grid trace is a training
+# trace (solver="grid") whose summary reports the WINNING cell's
+# duals plus grid_cells/grid_devices extras.
+GRID_EVENTS = ("grid_cell", "grid_winner")
+
 # Event types the continuous-watch layer emits (observability/slo.py +
 # blackbox.py, docs/OBSERVABILITY.md "Watch & alerts"): `alert` = a
 # rule crossed a state boundary (fired or cleared — `state` says

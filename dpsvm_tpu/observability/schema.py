@@ -183,6 +183,18 @@ EVENT_EXTRA_KEYS = {
     # shard-level counterparts above.
     "host_lost": ("host_id",),
     "reform": ("from_hosts", "to_hosts"),
+    # Model-fleet cache events (dpsvm_tpu/fleet/modelcache.py): a
+    # `model_fault` without the model name and its measured cold start
+    # can drive neither the thrash rule's attribution nor the
+    # fleet_cold_start_p99_ms ledger row; a `model_evict` without the
+    # name cannot explain the next fault.
+    "model_fault": ("model", "cold_start_ms"),
+    "model_evict": ("model",),
+    # Grid-trainer events (dpsvm_tpu/fleet/grid.py): a `grid_cell`
+    # without its coordinates and held-out score is useless to the
+    # selection audit; `grid_winner` must at least name the cell.
+    "grid_cell": ("c", "gamma", "holdout_acc"),
+    "grid_winner": ("c", "gamma"),
 }
 
 #: the closed value set of the `refresh` event's `refresh_kind`

@@ -912,6 +912,15 @@ def default_serving_rules() -> List[dict]:
          "severity": "warn", "per_tenant": True,
          "window_s": 60.0, "share_above": 0.5, "min_tenants": 2,
          "for_s": 5.0, "clear_after_s": 30.0},
+        # A thrashing model cache pages BEFORE p99 does: sustained
+        # hydration faults mean the working set outgrew the HBM budget
+        # (every fault is a cold start on someone's request), so rate
+        # the fault counter like the training side rates compiles
+        # (compile-storm). > 1 fault/s sustained over a minute is
+        # churn, not warmup (docs/SERVING.md "Model fleet").
+        {"name": "model-cache-thrash", "kind": "rate",
+         "severity": "warn", "metric": "model_faults",
+         "window_s": 60.0, "above": 1.0, "clear_after_s": 60.0},
     ]
 
 
@@ -1007,6 +1016,8 @@ _PROM_CANON = {
     "dpsvm_serving_errors_total": "errors",
     "dpsvm_serving_rejected_total": "rejected",
     "dpsvm_serving_queue_depth": "queue_depth",
+    "dpsvm_fleet_model_faults_total": "model_faults",
+    "dpsvm_fleet_model_evictions_total": "model_evictions",
     "dpsvm_serving_replicas_healthy": "healthy_replicas",
     "dpsvm_incidents_total": "incidents",
     "dpsvm_train_iterations": "n_iter",
@@ -1070,6 +1081,18 @@ def sample_from_metricsz_json(obj: dict) -> Dict[str, float]:
                 st.get("queue_depth_rows"), (int, float)):
             depth += float(st["queue_depth_rows"])
     out["queue_depth"] = depth
+    # model-fleet cache lanes (serving metrics() "model_cache") — the
+    # model-cache-thrash rule's fault counter plus the eviction/
+    # residency companions
+    mc = obj.get("model_cache") or {}
+    if isinstance(mc, dict):
+        for key, canon in (("faults", "model_faults"),
+                           ("evictions", "model_evictions"),
+                           ("resident", "model_cache_resident"),
+                           ("budget", "model_cache_budget")):
+            v = mc.get(key)
+            if isinstance(v, (int, float)):
+                out[canon] = float(v)
     # per-tenant lanes (serving metrics() "tenants.per_tenant") —
     # the vocabulary the per_tenant rule templates reference
     per_tenant = (obj.get("tenants") or {}).get("per_tenant") or {}

@@ -298,6 +298,29 @@ def _serving_tenant_probe(url: str, out: Callable[[str], None]) -> None:
             f"({live}/{budget} live) — new tenants will fold into "
             "'other'; raise `serve --tenant-budget` if per-tenant "
             "attribution matters for the tail")
+    # Model-cache saturation (docs/SERVING.md "Model fleet") — same
+    # reporting-only contract: a thrashing cache is a capacity-planning
+    # fact, not a broken mesh.
+    mc = obj.get("model_cache") if isinstance(obj, dict) else None
+    if isinstance(mc, dict):
+        mbudget = int(mc.get("budget") or 0)
+        resident = int(mc.get("resident") or 0)
+        faults = int(mc.get("faults") or 0)
+        evictions = int(mc.get("evictions") or 0)
+        transients = int(mc.get("transients") or 0)
+        out(f"serving: model cache: {resident}/{mbudget} residents, "
+            f"{faults} faults, {evictions} evictions, "
+            f"{transients} transient serves, cold-start p99 "
+            f"{float(mc.get('cold_start_p99_ms') or 0.0):.1f} ms, "
+            f"~{int(mc.get('resident_bytes_est') or 0) // (1 << 20)} "
+            "MiB resident")
+        if mbudget and resident >= 0.8 * mbudget:
+            out(f"serving: WARNING model cache near saturation "
+                f"({resident}/{mbudget} resident) — cold models serve "
+                "transiently until a second touch evicts the LRU; "
+                "raise `serve --model-cache-budget` if the working "
+                "set outgrew the budget (watch the model-cache-thrash "
+                "rule)")
 
 
 def _hostgroup_probe(coordinator: Optional[str],

@@ -121,6 +121,69 @@ def _load_binary_platt(path: str) -> Optional[Tuple[float, float]]:
     return None
 
 
+class SegmentPack:
+    """N same-spec binary SV models concatenated into the operands of
+    ONE ``models/svm._pairwise_decisions_jit`` segment-sum program:
+    a ``(m, d) @ (d, S_total)`` kernel pass over every model's SVs at
+    once, then a sorted segment_sum per model -> an ``(m, N)`` decision
+    matrix per dispatch.
+
+    This is the one definition of the concatenated-SV decision program
+    in the repo: the engine's OvO collapse (``_build_mc_batched``) and
+    the fleet's same-spec model groups (``dpsvm_tpu/fleet/packer.py``)
+    both build THIS, so the two paths cannot drift. All models must
+    share (kernel, gamma, coef0, degree, d) — the caller groups by
+    spec; this class only asserts it.
+    """
+
+    def __init__(self, models: Sequence[SVMModel], *, tag: str,
+                 include_b: bool = True,
+                 precision_name: str = "HIGHEST"):
+        import jax.numpy as jnp
+
+        from dpsvm_tpu.models.svm import _pairwise_decisions_jit
+
+        if not models:
+            raise ValueError("SegmentPack needs at least one model")
+        specs = {(m.kernel, float(m.gamma), float(m.coef0),
+                  int(m.degree), int(m.num_attributes))
+                 for m in models}
+        if len(specs) != 1:
+            raise ValueError(f"SegmentPack needs one shared kernel "
+                             f"spec, got {len(specs)}: {sorted(specs)}")
+        if models[0].kernel == "precomputed":
+            raise ValueError("precomputed-kernel models have no SV "
+                             "feature rows to concatenate")
+        self.n_models = len(models)
+        self.num_attributes = int(models[0].num_attributes)
+        self.n_sv = int(sum(m.n_sv for m in models))
+        self.sv_all = jnp.asarray(np.concatenate(
+            [np.asarray(m.x_sv, np.float32) for m in models]))
+        self.coef = jnp.asarray(np.concatenate(
+            [np.asarray(m.alpha, np.float32)
+             * np.asarray(m.y_sv, np.float32) for m in models]))
+        self.seg_ids = jnp.asarray(np.repeat(
+            np.arange(len(models), dtype=np.int32),
+            [int(m.n_sv) for m in models]))
+        self.b_vec = jnp.asarray(np.asarray([m.b for m in models],
+                                            np.float32))
+        spec = models[0]
+        self.kw = dict(kind=spec.kernel, degree=int(spec.degree),
+                       include_b=bool(include_b),
+                       num_segments=len(models),
+                       precision_name=precision_name)
+        self.gamma = jnp.float32(spec.gamma)
+        self.coef0 = jnp.float32(spec.coef0)
+        self._run = compilewatch.instrument(_pairwise_decisions_jit, tag)
+
+    def decide(self, block: np.ndarray) -> np.ndarray:
+        """(bucket, d) padded block -> (bucket, N) decision matrix."""
+        import jax.numpy as jnp
+        return np.asarray(self._run(
+            jnp.asarray(block), self.sv_all, self.coef, self.seg_ids,
+            self.b_vec, self.gamma, self.coef0, **self.kw))
+
+
 class PredictionEngine:
     """One loaded model, packed for serving (see module docstring).
 
@@ -281,39 +344,14 @@ class PredictionEngine:
         return decide
 
     def _build_mc_batched(self) -> None:
-        import jax.numpy as jnp
-
-        from dpsvm_tpu.models.svm import _pairwise_decisions_jit
-
-        ms = self.model.models
-        self._sv_all = jnp.asarray(np.concatenate(
-            [np.asarray(m.x_sv, np.float32) for m in ms]))
-        self._coef = jnp.asarray(np.concatenate(
-            [np.asarray(m.alpha, np.float32)
-             * np.asarray(m.y_sv, np.float32) for m in ms]))
-        self._seg_ids = jnp.asarray(np.repeat(
-            np.arange(len(ms), dtype=np.int32),
-            [int(m.n_sv) for m in ms]))
-        self._b_vec = jnp.asarray(np.asarray([m.b for m in ms],
-                                             np.float32))
-        spec = ms[0]
-        self._mc_kw = dict(kind=spec.kernel, degree=int(spec.degree),
-                           include_b=self.include_b,
-                           num_segments=len(ms),
-                           precision_name=self._pname)
-        self._gamma = jnp.float32(spec.gamma)
-        self._coef0 = jnp.float32(spec.coef0)
-        self._mc_run = compilewatch.instrument(
-            _pairwise_decisions_jit, f"serve[{self.name}]-pairwise")
-
-        def decide(block: np.ndarray) -> np.ndarray:
-            import jax.numpy as jnp
-            return np.asarray(self._mc_run(
-                jnp.asarray(block), self._sv_all, self._coef,
-                self._seg_ids, self._b_vec, self._gamma, self._coef0,
-                **self._mc_kw))
-
-        self._decide_block = decide
+        # The OvO collapse: all P same-spec pairs as ONE SegmentPack
+        # program — the construction the fleet packer generalizes to
+        # arbitrary same-spec model groups (fleet/packer.py).
+        self._pack = SegmentPack(self.model.models,
+                                 tag=f"serve[{self.name}]-pairwise",
+                                 include_b=self.include_b,
+                                 precision_name=self._pname)
+        self._decide_block = self._pack.decide
 
     def _decide_mc_per_pair(self, block: np.ndarray) -> np.ndarray:
         return np.stack([d(block) for d in self._pair_deciders], axis=1)

@@ -42,10 +42,10 @@ def synthetic_rows(d: int, n: int = 512, seed: int = 0) -> np.ndarray:
     return rng.standard_normal((n, d)).astype(np.float32)
 
 
-def fetch_manifest(url: str, model: str = "default",
-                   timeout: float = 10.0) -> dict:
-    """GET /v1/models and return the named model's manifest (the
-    loadgen needs the feature width to synthesize rows)."""
+def fetch_models(url: str, timeout: float = 10.0) -> dict:
+    """GET /v1/models and return the full name -> manifest map (lazy
+    fleet entries report ``resident: false`` and light registration
+    facts only — the fleet drill picks its target names from here)."""
     host, port = _host_port(url)
     conn = _Conn(host, port, timeout=timeout)
     try:
@@ -56,7 +56,14 @@ def fetch_manifest(url: str, model: str = "default",
         conn.close()
     if resp.status != 200:
         raise RuntimeError(f"GET /v1/models -> {resp.status}: {body}")
-    models = body.get("models", {})
+    return body.get("models", {})
+
+
+def fetch_manifest(url: str, model: str = "default",
+                   timeout: float = 10.0) -> dict:
+    """GET /v1/models and return the named model's manifest (the
+    loadgen needs the feature width to synthesize rows)."""
+    models = fetch_models(url, timeout=timeout)
     if model not in models:
         raise RuntimeError(f"server has no model {model!r} "
                            f"(models: {sorted(models)})")
@@ -101,13 +108,36 @@ def tenant_of(i: int, tenants: int, skew: float) -> Optional[str]:
     return f"t{first + i % cold}"
 
 
+def model_of(i: int, n_models: int, skew: float) -> int:
+    """Deterministic model-list index for request index ``i`` (the
+    model-fleet traffic mix ``dpsvm loadgen --models`` sends).
+
+    Same cumulative-quota stride as ``tenant_of``: with ``skew`` S in
+    (0, 1] the FIRST model in the list is the planted hot model and
+    receives fraction S of the requests, evenly interleaved; the rest
+    round-robins over the remainder. skew=0 round-robins over all N.
+    Round-robin over a fleet larger than the server's model-cache
+    budget is the cache-thrash worst case; the skewed mix is the
+    realistic one the cache exists for."""
+    if n_models <= 1:
+        return 0
+    s = min(max(float(skew), 0.0), 1.0)
+    if s > 0.0 and int((i + 1) * s) > int(i * s):
+        return 0
+    cold = n_models - 1 if s > 0.0 else n_models
+    first = 1 if s > 0.0 else 0
+    return first + i % cold
+
+
 def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                 requests: int = 200, batch: int = 1,
                 concurrency: int = 8, mode: str = "closed",
                 rps: float = 100.0, want: Sequence[str] = ("labels",),
                 timeout: float = 30.0, spans: bool = False,
                 tenants: int = 0,
-                hot_tenant_skew: float = 0.0) -> dict:
+                hot_tenant_skew: float = 0.0,
+                models: Sequence[str] = (),
+                model_skew: float = 0.0) -> dict:
     """Fire ``requests`` requests of ``batch`` rows each; return the
     result row (throughput + latency percentiles + error count).
 
@@ -125,7 +155,16 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     tenant-isolation drill. The row then carries per-tenant request/
     latency sub-rows plus ``hot_p99_ms`` / ``others_p99_ms``, so "one
     noisy tenant did not ruin its neighbours' p99" is a printed fact
-    (docs/OBSERVABILITY.md "Per-tenant attribution")."""
+    (docs/OBSERVABILITY.md "Per-tenant attribution").
+
+    ``models=[names]`` spreads the requests over a model fleet instead
+    of one model (``model_of`` above; ``model_skew`` plants the first
+    name as the hot model). The row then carries per-model request/
+    latency sub-rows plus ``cold_start_p99_ms`` — p99 over each
+    model's FIRST-request latency, the number the HBM model cache
+    exists to bound (a fault that hydrates from disk shows up here;
+    a resident hit does not). All models must share the primary
+    model's feature width (the fleet drill is a same-spec fleet)."""
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
     if requests < 1 or batch < 1 or concurrency < 1:
@@ -137,11 +176,16 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     # Pre-serialize every request body: the generator must measure the
     # server, not its own json.dumps.
     n_rows = rows.shape[0]
+    models = list(models)
     bodies: List[bytes] = []
     tenant_by_idx: List[Optional[str]] = []
+    model_by_idx: List[str] = []
     for i in range(requests):
         take = [(i * batch + j) % n_rows for j in range(batch)]
-        body = {"model": model, "return": list(want),
+        mdl = (models[model_of(i, len(models), model_skew)]
+               if models else model)
+        model_by_idx.append(mdl)
+        body = {"model": mdl, "return": list(want),
                 "instances": rows[take].tolist()}
         ten = tenant_of(i, tenants, hot_tenant_skew)
         tenant_by_idx.append(ten)
@@ -155,6 +199,7 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     statuses: List[int] = []
     stage_ms: dict = {}            # stage name -> [ms, ...] (spans=True)
     by_tenant: dict = {}           # tenant -> {"ms": [...], "errors": n}
+    by_model: dict = {}            # model -> {"lat": [(i, ms)], "errors": n}
     out_lock = threading.Lock()
     t_start = [0.0]
     headers = {"Content-Type": "application/json"}
@@ -207,6 +252,12 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                         acc["ms"].append(ms)
                         if status != 200:
                             acc["errors"] += 1
+                    if models:
+                        macc = by_model.setdefault(
+                            model_by_idx[i], {"lat": [], "errors": 0})
+                        macc["lat"].append((i, ms))
+                        if status != 200:
+                            macc["errors"] += 1
                     if isinstance(breakdown, dict):
                         for k, v in breakdown.items():
                             if isinstance(v, (int, float)):
@@ -285,6 +336,37 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
             tenant_row["hot_tenant"] = "t0"
             tenant_row["hot_p99_ms"] = hot.get("p99_ms")
             tenant_row["others_p99_ms"] = round(float(op99), 3)
+    model_row: dict = {}
+    if models:
+        per_model = {}
+        firsts: List[float] = []
+        for name, macc in sorted(by_model.items()):
+            pairs = sorted(macc["lat"])        # by request index
+            ml = np.asarray([ms for _, ms in pairs], np.float64)
+            mp50, mp99 = (np.percentile(ml, [50.0, 99.0])
+                          if ml.size else (float("nan"),) * 2)
+            # latency of the model's FIRST request (lowest request
+            # index — deterministic even though workers race): the
+            # cold-start sample, a cache fault if the model was not
+            # resident when the run began
+            first_ms = pairs[0][1] if pairs else float("nan")
+            firsts.append(first_ms)
+            per_model[name] = {
+                "requests": int(ml.size),
+                "errors": int(macc["errors"]),
+                "p50_ms": round(float(mp50), 3),
+                "p99_ms": round(float(mp99), 3),
+                "first_ms": round(float(first_ms), 3)}
+        cold_p99 = (np.percentile(np.asarray(firsts, np.float64), 99.0)
+                    if firsts else float("nan"))
+        model_row = {
+            "models": len(models),
+            "model_skew": round(float(model_skew), 4),
+            "model_rows": per_model,
+            "cold_start_p99_ms": round(float(cold_p99), 3),
+        }
+        if model_skew > 0.0 and len(models) > 1:
+            model_row["hot_model"] = models[0]
     return {
         "mode": mode,
         "requests": requests,
@@ -305,6 +387,7 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
         **({"target_rps": rps} if mode == "open" else {}),
         **span_row,
         **tenant_row,
+        **model_row,
     }
 
 
@@ -403,7 +486,9 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                 timeout: float = 30.0, chaos: bool = False,
                 compare_sequential: bool = True,
                 trace: Optional[str] = None, tenants: int = 0,
-                hot_tenant_skew: float = 0.0) -> dict:
+                hot_tenant_skew: float = 0.0,
+                models: Sequence[str] = (),
+                model_skew: float = 0.0) -> dict:
     """The one-line result row ``dpsvm loadgen`` prints: the main
     measurement, plus (by default) the batch-1 single-worker sequential
     baseline and the coalescing speedup over it.
@@ -425,7 +510,8 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                        batch=batch, concurrency=concurrency, mode=mode,
                        rps=rps, want=want, timeout=timeout,
                        spans=trace is not None, tenants=tenants,
-                       hot_tenant_skew=hot_tenant_skew)
+                       hot_tenant_skew=hot_tenant_skew,
+                       models=models, model_skew=model_skew)
     row = {
         "metric": "serving_examples_per_sec",
         "value": main["examples_per_sec"],

@@ -34,26 +34,43 @@ class _Entry:
         self.model = model            # kept for in-memory rebuilds
         self.kwargs = kwargs
         self.generation = 1
-        self.loaded_at = time.time()
+        self.loaded_at = time.time() if engine is not None else None
 
 
 class ModelRegistry:
     def __init__(self):
         self._lock = threading.Lock()
+        # serializes lazy hydrations so a request storm on one cold
+        # model builds its engine exactly once (the double-checked
+        # pattern serving/server.pool uses for replica builds)
+        self._hydrate_lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
 
     def register(self, name: str, source: Optional[str] = None, *,
-                 model=None, **engine_kwargs):
+                 model=None, lazy: bool = False, **engine_kwargs):
         """Load + warm a model under ``name``. ``source`` is a model
         file or multiclass directory; alternatively pass an in-memory
         ``model`` (then reload is unavailable, but replica rebuilds
-        still are — the model object is retained). Returns the engine."""
-        from dpsvm_tpu.serving.engine import PredictionEngine
+        still are — the model object is retained).
 
+        ``lazy=True`` registers the manifest only: no engine is built,
+        no device buffers are packed, no ladder is warmed — the first
+        ``engine()`` call hydrates on demand. A 1000-model fleet
+        registry boots in seconds instead of paying 1000 warmups up
+        front (docs/SERVING.md "Model fleet"); ``/v1/models`` reports
+        ``resident: false`` until the first request lands. Returns the
+        engine (eager) or None (lazy)."""
         if (source is None) == (model is None):
             raise ValueError("register needs exactly one of source= "
                              "(a path) or model= (an in-memory model)")
         engine_kwargs.setdefault("name", name)
+        if lazy:
+            with self._lock:
+                self._entries[name] = _Entry(None, source, model,
+                                             engine_kwargs)
+            return None
+        from dpsvm_tpu.serving.engine import PredictionEngine
+
         if source is not None:
             engine = PredictionEngine.load(source, **engine_kwargs)
         else:
@@ -93,12 +110,61 @@ class ModelRegistry:
             return entry.source
 
     def engine(self, name: str):
+        """The model's warmed engine — hydrating a lazy entry on first
+        touch (build COMPLETELY outside the registry lock, swap in
+        under it: concurrent readers of other models never wait on a
+        cold model's warmup)."""
         with self._lock:
             entry = self._entries.get(name)
         if entry is None:
             raise KeyError(f"no model named {name!r} "
                            f"(registered: {self.names()})")
-        return entry.engine
+        if entry.engine is not None:
+            return entry.engine
+        with self._hydrate_lock:
+            with self._lock:
+                entry = self._entries.get(name)
+                if entry is None:
+                    raise KeyError(f"model {name!r} was removed "
+                                   "mid-hydration")
+                if entry.engine is not None:
+                    return entry.engine
+            fresh = self.build(name)
+            with self._lock:
+                entry = self._entries.get(name)
+                if entry is None:
+                    raise KeyError(f"model {name!r} was removed "
+                                   "mid-hydration")
+                entry.engine = fresh
+                entry.loaded_at = time.time()
+            return fresh
+
+    def resident(self, name: str) -> bool:
+        """Whether ``name`` currently holds a hydrated engine (False
+        for a lazy entry nobody has requested yet, and for one the
+        fleet model cache paged out — ``evict``)."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"no model named {name!r} "
+                               f"(registered: {list(self._entries)})")
+            return entry.engine is not None
+
+    def evict(self, name: str) -> bool:
+        """Drop ``name``'s hydrated engine (device buffers free with
+        it) while keeping the registration — the fleet model cache's
+        page-out hook (dpsvm_tpu/fleet/modelcache.py). The next
+        ``engine()`` call re-hydrates from the retained source/model.
+        Returns whether an engine was actually resident."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"no model named {name!r} "
+                               f"(registered: {list(self._entries)})")
+            was = entry.engine is not None
+            entry.engine = None
+            entry.loaded_at = None
+            return was
 
     def reload(self, name: str):
         """Re-load ``name`` from its source path and swap atomically.
@@ -152,12 +218,22 @@ class ModelRegistry:
             return sorted(self._entries)
 
     def manifests(self) -> Dict[str, dict]:
+        """Per-model manifests for ``/v1/models``. Every entry carries
+        ``resident``: a hydrated model reports its full engine manifest,
+        a cold (lazy, or fleet-cache-evicted) one reports the light
+        registration facts only — reading 1000 cold manifests costs no
+        model loads (docs/SERVING.md "Model fleet")."""
         with self._lock:
             entries = dict(self._entries)
         out = {}
         for name, e in entries.items():
-            m = dict(e.engine.manifest)
+            if e.engine is not None:
+                m = dict(e.engine.manifest)
+                m["resident"] = True
+                m["loaded_at_unix"] = round(e.loaded_at, 3)
+            else:
+                m = {"name": name, "source": e.source,
+                     "resident": False, "loaded_at_unix": None}
             m["generation"] = e.generation
-            m["loaded_at_unix"] = round(e.loaded_at, 3)
             out[name] = m
         return out

@@ -219,7 +219,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(200, owner.metrics())
         elif self.path == "/v1/models":
-            self._send(200, {"models": owner.registry.manifests()})
+            self._send(200, {"models": owner.model_manifests()})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -286,8 +286,16 @@ class _Handler(BaseHTTPRequestHandler):
             rs.model = name
         want = tuple(body.get("return") or ("labels", "decision"))
         inst = body.get("instances")
+        # Fleet routing (docs/SERVING.md "Model fleet"): a non-resident
+        # registration behind an armed model cache serves through the
+        # cache's synchronous cold path — no pool, no batcher; the
+        # cache decides transient vs hydrate and does its own width/
+        # calibration validation (ValueError -> 400 below).
+        engine = None
         try:
-            engine = owner.registry.engine(name)
+            cold = owner.serves_cold(name)
+            if not cold:
+                engine = owner.registry.engine(name)
         except KeyError as e:
             owner.count("errors", tenant=tenant)
             self._send(404, {"error": str(e)})
@@ -313,8 +321,10 @@ class _Handler(BaseHTTPRequestHandler):
         # a failed batch).
         if x.ndim == 1:
             x = x[None, :]
-        d = engine.num_attributes
-        if x.ndim != 2 or x.shape[0] == 0 or x.shape[1] != d:
+        if x.ndim != 2 or x.shape[0] == 0 or (
+                engine is not None
+                and x.shape[1] != engine.num_attributes):
+            d = engine.num_attributes if engine is not None else "d"
             owner.count("errors", tenant=tenant)
             self._send(400, {"error": f"instances must be a non-empty "
                                       f"(m, {d}) matrix, got shape "
@@ -344,6 +354,27 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             owner.count("errors", tenant=tenant)
             self._send(400, {"error": str(e)})
+            return
+        if cold:
+            # Synchronous cold dispatch through the model cache: no
+            # degrade ladder (there is no queue to protect), no
+            # batcher. The measured wall below IS the cold-start
+            # latency the fleet drill reports the p99 of.
+            try:
+                ride = tuple(dict.fromkeys(want + ("decision",)))
+                res = owner.model_cache.infer(name, x, want=ride)
+            except KeyError as e:
+                owner.count("errors", tenant=tenant)
+                self._send(404, {"error": str(e)})
+                return
+            except ValueError as e:
+                owner.count("errors", tenant=tenant)
+                self._send(400, {"error": str(e)})
+                return
+            eff_name, eff_want, degraded = name, want, None
+            self._respond_predict(owner, t0, rs, budget, tenant, name,
+                                  eff_name, eff_want, degraded, x, res,
+                                  want_spans_back)
             return
         # Degradation ladder: shed the optional expensive output, then
         # shed the whole request to the registered sibling, BEFORE the
@@ -396,6 +427,16 @@ class _Handler(BaseHTTPRequestHandler):
             owner.count("errors", tenant=tenant)
             self._send(400, {"error": str(e)})
             return
+        self._respond_predict(owner, t0, rs, budget, tenant, name,
+                              eff_name, eff_want, degraded, x, res,
+                              want_spans_back)
+
+    def _respond_predict(self, owner: "ServingServer", t0, rs, budget,
+                         tenant, name, eff_name, eff_want, degraded,
+                         x, res, want_spans_back) -> None:
+        """The shared 200 tail of both predict paths (batched and
+        fleet-cold): score-window feed, span close, latency + tenant
+        accounting, counted response."""
         if rs is not None:
             # respond opens IMMEDIATELY on wake (before the score-
             # window feed) — auto-closing the dispatch stage, so the
@@ -443,6 +484,7 @@ class ServingServer:
                  watch_rules=None, bundle_dir: Optional[str] = None,
                  watch: bool = True,
                  tenant_budget: int = DEFAULT_TENANT_BUDGET,
+                 model_cache_budget: Optional[int] = None,
                  verbose: bool = False):
         self.registry = registry
         self.host = host
@@ -537,6 +579,28 @@ class ServingServer:
         self._g_healthy = self.mreg.gauge(
             "dpsvm_serving_replicas_healthy",
             "replicas with a closed circuit", labels=("model",))
+        # Model-fleet cache (dpsvm_tpu/fleet, docs/SERVING.md "Model
+        # fleet"): when armed, NON-resident registrations (lazy ones,
+        # and anything the cache pages out) are served by the budgeted
+        # ModelCache instead of a dedicated pool/batcher — the cold
+        # path is synchronous by design, its latency IS the cold-start
+        # story. Resident eager engines keep the classic batched path
+        # untouched. The fault/eviction counters exist unconditionally
+        # so the model-cache-thrash rule always has its lane (zero on
+        # a cache-less server).
+        self._c_model_faults = self.mreg.counter(
+            "dpsvm_fleet_model_faults_total",
+            "cold-model hydrations into the fleet cache").labels()
+        self._c_model_evictions = self.mreg.counter(
+            "dpsvm_fleet_model_evictions_total",
+            "resident models paged out of the fleet cache").labels()
+        self.model_cache = None
+        if model_cache_budget is not None:
+            from dpsvm_tpu.fleet.modelcache import ModelCache
+            self.model_cache = ModelCache(
+                registry, budget=int(model_cache_budget),
+                max_batch=self.max_batch,
+                on_event=self._fleet_event)
         self._g_uptime = self.mreg.gauge("dpsvm_serving_uptime_seconds",
                                          "seconds since server start")
         self._g_draining = self.mreg.gauge("dpsvm_serving_draining",
@@ -607,6 +671,49 @@ class ServingServer:
         # every counted terminal response is one watch sample: the
         # rules see the burn as it happens, not at the next scrape
         self._watch_note()
+
+    # -- model-fleet cache --------------------------------------------
+
+    def _fleet_event(self, event: str, **extra) -> None:
+        """The model cache's event sink: count the fault/evict, ride
+        the event into the ring + serving trace, and note a watch
+        sample so the model-cache-thrash rule sees the fault rate as
+        it happens, not at the next counted response."""
+        if event == "model_fault":
+            self._c_model_faults.inc()
+        elif event == "model_evict":
+            self._c_model_evictions.inc()
+        self.emit_event(event, **extra)
+        self._watch_note()
+
+    def serves_cold(self, name: str) -> bool:
+        """Whether ``name`` routes through the model cache's cold path
+        right now: the cache is armed and the registry holds no
+        hydrated engine for the name. Raises KeyError for an unknown
+        name (the 404)."""
+        if self.model_cache is None:
+            # unknown names surface as the engine lookup's KeyError
+            return False
+        return not self._registry_resident(name)
+
+    def _registry_resident(self, name: str) -> bool:
+        """Residency per the registry; duck-typed test registries
+        without a residency surface are all-eager by construction."""
+        fn = getattr(self.registry, "resident", None)
+        return True if fn is None else bool(fn(name))
+
+    def model_manifests(self) -> Dict[str, dict]:
+        """``/v1/models``: the registry's manifests with the fleet
+        cache's residency overlaid — a cache-managed model is
+        ``resident`` iff its buffers are packed right now, regardless
+        of the (never-hydrated) registry entry."""
+        out = self.registry.manifests()
+        if self.model_cache is not None:
+            for name, man in out.items():
+                if not man.get("resident"):
+                    man["resident"] = bool(
+                        self.model_cache.is_resident(name))
+        return out
 
     # -- per-tenant attribution ---------------------------------------
 
@@ -703,6 +810,11 @@ class ServingServer:
         sample["queue_depth"] = float(depth)
         sample["queue_fill"] = (depth / self.max_queue
                                 if self.max_queue else 0.0)
+        # fleet-cache lanes — always present (0.0 without a cache) so
+        # the model-cache-thrash rate rule has a continuous series
+        sample["model_faults"] = float(self._c_model_faults.value)
+        sample["model_evictions"] = float(
+            self._c_model_evictions.value)
         # per-tenant lanes — the vocabulary slo.py's per_tenant rule
         # templates expand over (tenant:<name>:<metric>)
         for ten, acc in tenants.items():
@@ -1060,6 +1172,11 @@ class ServingServer:
                       "queue_wait_ms": round(a["queue_wait_ms"], 3),
                       "compute_ms": round(a["compute_ms"], 3)}
                 for ten, a in sorted(tenants_acc.items())}}
+        # fleet model-cache block (docs/SERVING.md "Model fleet") —
+        # the JSON twin of dpsvm_fleet_model_*_total, and the source
+        # slo.sample_from_metricsz_json + the doctor probe read
+        if self.model_cache is not None:
+            out["model_cache"] = self.model_cache.stats()
         out["events"] = events[-64:]
         return out
 
@@ -1152,7 +1269,13 @@ class ServingServer:
             blackbox.arm_emergency(self._flight, self.bundle_dir,
                                    self.mreg)
         for name in self.registry.names():
-            self.pool(name)                 # replica builds paid at boot
+            # replica builds paid at boot — but only for HYDRATED
+            # entries: pre-creating a pool for a lazy registration
+            # would defeat the whole point of the seconds-not-minutes
+            # fleet boot (a lazy model's pool builds on first request,
+            # or never, if the model cache serves it cold)
+            if self._registry_resident(name):
+                self.pool(name)
         self._httpd = _Server((self.host, self.requested_port), _Handler)
         self._httpd.owner = self
         self._thread = threading.Thread(target=self._httpd.serve_forever,

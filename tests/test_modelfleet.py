@@ -1,0 +1,501 @@
+"""Model-fleet subsystem tests (docs/SERVING.md "Model fleet").
+
+What must hold, per component:
+
+* model cache — conservation (touches == hits + faults + transients,
+              evictions <= faults) and full determinism under churn:
+              the same touch sequence lands the same resident set,
+              the same counters and the same eviction order on every
+              run (the admission ledger ticks monotonically — no wall
+              clock);
+* admission — second-touch once full: a one-shot scan over many cold
+              models is served transiently and never evicts the hot
+              working set;
+* hydration — a model paged out and re-admitted answers BITWISE the
+              decisions it answered before eviction (the packed
+              segment-sum column is invariant under group membership
+              churn), and matches a fresh engine load at the pinned
+              decision tolerance with exactly equal labels;
+* retraces  — steady-state serving through packed groups compiles
+              NOTHING (the zero-retrace pin, via compilewatch);
+* grid      — every batched grid cell matches its sequential
+              ``api.fit`` twin at the batched-sweep alpha tolerance
+              (atol 5e-3, the test_batched_ovo convention); the
+              winner promotes through the registry's atomic path
+              (generation bump, no leftover candidate files);
+* lazy reg  — registering thousands of models is manifest-only
+              bookkeeping (no loads, sub-second) and ``/v1/models``
+              reports ``resident: false`` until first hydration;
+* serving   — the end-to-end cold path: lazy registry + armed cache
+              behind a real HTTP server, residency overlay, 404/400
+              contracts, /metricsz conservation, and the loadgen's
+              per-model + cold_start_p99_ms row;
+* watchtower— the model-cache-thrash rate rule fires on sustained
+              fault churn and stays silent on a warmup burst.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.fleet import ModelCache, _tiny_fleet
+from dpsvm_tpu.serving import ModelRegistry
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _lazy_registry(base, n_models, *, specs=((0.5, 4),), seed=7,
+                   max_batch=16):
+    paths = _tiny_fleet(str(base), n_models, specs=specs, seed=seed)
+    reg = ModelRegistry()
+    for i, p in enumerate(paths):
+        reg.register(f"m{i:04d}", p, lazy=True, max_batch=max_batch,
+                     include_b=True)
+    return reg
+
+
+# ---------------------------------------------------------------------
+# cache: conservation + determinism under churn
+# ---------------------------------------------------------------------
+
+
+def _churn_sequence(n_touches):
+    """Deterministic churn with REAL evictions: a hot working set
+    touched constantly, plus a small rotating cold pool whose members
+    return fast enough to accrue a second touch inside the bounded
+    waiting window — so admissions genuinely evict and the working
+    set turns over."""
+    hot = [f"m{i:04d}" for i in range(8)]
+    seq = []
+    for t in range(n_touches):
+        if t % 13 == 12:
+            seq.append(f"m{8 + (t // 13) % 6:04d}")
+        else:
+            seq.append(hot[t % 8])
+    return seq
+
+
+def _run_churn(reg, seq, budget):
+    events = []
+    cache = ModelCache(reg, budget=budget, max_batch=16, warmup=False,
+                       on_event=lambda ev, **kw: events.append(
+                           (ev, kw.get("model"))))
+    q = np.zeros((1, 4), np.float32)
+    for name in seq:
+        out = cache.infer(name, q, want=("labels",))
+        assert out["labels"].shape == (1,)
+    return cache, events
+
+
+def test_cache_conservation_and_determinism_churn(tmp_path):
+    reg = _lazy_registry(tmp_path, 16)
+    seq = _churn_sequence(2000)
+
+    cache_a, events_a = _run_churn(reg, seq, budget=8)
+    cache_b, events_b = _run_churn(reg, seq, budget=8)
+
+    sa, sb = cache_a.stats(), cache_b.stats()
+    # conservation: every touch is exactly one of hit/fault/transient
+    for s in (sa, sb):
+        assert s["touches"] == len(seq)
+        assert s["touches"] == s["hits"] + s["faults"] + s["transients"]
+        assert s["evictions"] <= s["faults"]
+        assert s["resident"] <= 8
+    assert sa["evictions"] > 0          # the churn genuinely evicts
+    # determinism: same sequence -> same residents, counters, events
+    assert cache_a.resident_names() == cache_b.resident_names()
+    assert {k: sa[k] for k in ("hits", "faults", "transients",
+                               "evictions", "ledger_overflow")} == \
+           {k: sb[k] for k in ("hits", "faults", "transients",
+                               "evictions", "ledger_overflow")}
+    assert events_a == events_b
+    # the trace-event stream mirrors the counters exactly
+    assert sum(1 for ev, _ in events_a if ev == "model_fault") == \
+        sa["faults"]
+    assert sum(1 for ev, _ in events_a if ev == "model_evict") == \
+        sa["evictions"]
+
+
+def test_one_shot_scan_never_evicts_working_set(tmp_path):
+    n_names = 40
+    reg = _lazy_registry(tmp_path, n_names)
+    cache = ModelCache(reg, budget=8, max_batch=16, warmup=False)
+    q = np.zeros((1, 4), np.float32)
+    hot = [f"m{i:04d}" for i in range(8)]
+    for name in hot:            # admit (first touch, under budget)
+        cache.infer(name, q)
+    for name in hot:            # all hits now
+        cache.infer(name, q)
+    resident_before = sorted(cache.resident_names())
+    assert resident_before == hot
+    for i in range(8, n_names):  # the scan: one touch each
+        cache.infer(f"m{i:04d}", q)
+    s = cache.stats()
+    assert sorted(cache.resident_names()) == resident_before
+    assert s["evictions"] == 0
+    assert s["transients"] == n_names - 8
+
+
+# ---------------------------------------------------------------------
+# hydration parity
+# ---------------------------------------------------------------------
+
+
+def test_cold_start_rehydration_bitwise_parity(tmp_path):
+    from dpsvm_tpu.models.io import load_model
+    from dpsvm_tpu.models.svm import decision_function
+    from dpsvm_tpu.serving.engine import PredictionEngine
+
+    reg = _lazy_registry(tmp_path, 4)
+    cache = ModelCache(reg, budget=2, max_batch=16, warmup=False)
+    rng = np.random.default_rng(3)
+    q = rng.standard_normal((5, 4)).astype(np.float32)
+
+    first = cache.infer("m0000", q, want=("labels", "decision"))
+    cache.infer("m0001", q)                       # fills the budget
+    # second-touch admission of m0002 evicts the LRU resident (m0000)
+    cache.infer("m0002", q)                       # transient
+    cache.infer("m0002", q)                       # admit + evict
+    assert not cache.is_resident("m0000")
+    # re-admit m0000 the same way
+    cache.infer("m0000", q)                       # transient
+    again = cache.infer("m0000", q, want=("labels", "decision"))
+    assert cache.is_resident("m0000")
+    # the packed column is bitwise-stable across page-out/rehydration
+    # and across the group's changed membership
+    np.testing.assert_array_equal(first["decision"], again["decision"])
+    np.testing.assert_array_equal(first["labels"], again["labels"])
+    # and matches a fresh engine load / decision_function at the
+    # pinned decision tolerance with exactly equal labels
+    src = reg.source("m0000")
+    eng = PredictionEngine.load(src, max_batch=16, warmup=False)
+    fresh = eng.infer(q, want=("labels", "decision"))
+    np.testing.assert_allclose(again["decision"], fresh["decision"],
+                               atol=1e-5)
+    np.testing.assert_array_equal(again["labels"], fresh["labels"])
+    np.testing.assert_allclose(
+        again["decision"], decision_function(load_model(src), q),
+        atol=1e-5)
+
+
+def test_cache_width_and_want_contracts(tmp_path):
+    reg = _lazy_registry(tmp_path, 2)
+    cache = ModelCache(reg, budget=2, max_batch=16, warmup=False)
+    q = np.zeros((1, 4), np.float32)
+    cache.infer("m0000", q)
+    with pytest.raises(KeyError):
+        cache.infer("nope", q)
+    with pytest.raises(ValueError):
+        cache.infer("m0000", np.zeros((1, 9), np.float32))
+    with pytest.raises(ValueError):
+        cache.infer("m0000", q, want=("labels", "wat"))
+    with pytest.raises(ValueError):      # no Platt sidecar on disk
+        cache.infer("m0000", q, want=("proba",))
+
+
+# ---------------------------------------------------------------------
+# zero steady-state retraces
+# ---------------------------------------------------------------------
+
+
+def test_packed_serving_zero_steady_state_retraces(tmp_path):
+    from dpsvm_tpu.observability import compilewatch
+
+    reg = _lazy_registry(tmp_path, 6, specs=((0.5, 4), (0.25, 4)))
+    cache = ModelCache(reg, budget=6, max_batch=16)
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((3, 4)).astype(np.float32)
+    for i in range(6):                   # hydrate everything (warmup)
+        cache.infer(f"m{i:04d}", q)
+    compilewatch.drain()
+    for _ in range(3):                   # steady state
+        for i in range(6):
+            cache.infer(f"m{i:04d}", q, want=("labels", "decision"))
+    stray = compilewatch.drain()
+    assert stray == [], f"steady-state serving retraced: {stray}"
+
+
+# ---------------------------------------------------------------------
+# grid trainer
+# ---------------------------------------------------------------------
+
+
+def _blobs(n=160, d=6, seed=0):
+    # the clean-margin family the batched-sweep parity pins use
+    # (tests/test_batched_ovo.py): separable on the first feature, so
+    # batched and sequential solves converge to the same optimum
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    return x, y
+
+
+def test_grid_cells_match_sequential_fits(tmp_path):
+    import dataclasses
+
+    from dpsvm_tpu import api
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.fleet import holdout_split, train_grid
+
+    x, y = _blobs()
+    cs, gs = [0.5, 5.0], [0.05, 0.5]
+    # the batched-sweep parity convention (tests/test_batched_ovo.py):
+    # both sides run to the SAME tight gap, then alphas agree to 5e-3
+    cfg = SVMConfig(verbose=False, epsilon=1e-3, max_iter=20_000,
+                    chunk_iters=64)
+    grid = train_grid(x, y, cs=cs, gammas=gs, config=cfg,
+                      holdout_frac=0.25, seed=1)
+    assert len(grid.cells) == 4
+    np.testing.assert_allclose(
+        [(c.c, c.gamma) for c in grid.cells],
+        [(0.5, 0.05), (0.5, 0.5), (5.0, 0.05), (5.0, 0.5)], rtol=1e-6)
+    tr_idx, _ = holdout_split(len(y), 0.25, 1)
+    for cell in grid.cells:
+        _, ref = api.fit(x[tr_idx], y[tr_idx],
+                         dataclasses.replace(cfg, c=cell.c,
+                                             gamma=cell.gamma))
+        assert cell.result.converged and ref.converged
+        assert cell.result.n_sv == ref.n_sv
+        np.testing.assert_allclose(np.asarray(cell.result.alpha),
+                                   np.asarray(ref.alpha), atol=5e-3)
+    best = grid.best
+    assert best.holdout_acc == max(c.holdout_acc for c in grid.cells)
+
+
+def test_grid_trace_and_polish(tmp_path):
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.fleet import train_grid
+    from dpsvm_tpu.observability.record import RunTrace
+    from dpsvm_tpu.observability.schema import read_trace, validate_trace
+
+    x, y = _blobs(seed=2)
+    path = str(tmp_path / "grid.jsonl")
+    cfg = SVMConfig(verbose=False)
+    tr = RunTrace(path, config=cfg, n=len(y), d=x.shape[1],
+                  gamma=0.25, solver="grid")
+    try:
+        grid = train_grid(x, y, cs=[1.0, 8.0], gammas=[0.25],
+                          config=cfg, holdout_frac=0.25, seed=0,
+                          polish=True, trace=tr)
+    finally:
+        tr.close()
+    assert grid.polished
+    recs = read_trace(path)
+    assert validate_trace(recs) == []
+    events = [r.get("event") for r in recs if r.get("event")]
+    assert events.count("grid_cell") == 2
+    assert events.count("grid_winner") == 1
+    summary = [r for r in recs if r.get("kind") == "summary"][-1]
+    assert summary["grid_cells"] == 2
+
+
+def test_promote_winner_atomic(tmp_path):
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.fleet import promote_winner, train_grid
+    from dpsvm_tpu.models.io import save_model
+
+    # d=4 to match the _tiny_fleet spec of the artifact being replaced
+    x, y = _blobs(d=4, seed=4)
+    grid = train_grid(x, y, cs=[2.0], gammas=[0.25],
+                      config=SVMConfig(verbose=False),
+                      holdout_frac=0.25, seed=0)
+    # a registered serving artifact to promote onto
+    target = str(tmp_path / "served.svm")
+    save_model(_tiny_model(seed=9), target)
+    reg = ModelRegistry()
+    reg.register("prod", target, max_batch=8)
+    gen0 = reg.manifests()["prod"]["generation"]
+    before = reg.engine("prod").infer(x[:3], want=("decision",))
+
+    gen1 = promote_winner(grid, reg, "prod")
+    assert gen1 == gen0 + 1
+    after = reg.engine("prod").infer(x[:3], want=("decision",))
+    assert not np.allclose(before["decision"], after["decision"])
+    # atomic: no leftover candidate files next to the artifact
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if f.endswith(".grid-cand")]
+    assert leftovers == []
+    # in-memory registrations have no source path to promote onto
+    reg.register("mem", model=grid.best.model, max_batch=8)
+    with pytest.raises(ValueError):
+        promote_winner(grid, reg, "mem")
+
+
+def _tiny_model(seed=0):
+    paths = None
+    from dpsvm_tpu.fleet import _tiny_fleet  # noqa: F401 (shape helper)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        from dpsvm_tpu.models.io import load_model
+        paths = _tiny_fleet(d, 1, seed=seed)
+        return load_model(paths[0])
+
+
+# ---------------------------------------------------------------------
+# lazy registration
+# ---------------------------------------------------------------------
+
+
+def test_lazy_registration_is_manifest_only(tmp_path):
+    paths = _tiny_fleet(str(tmp_path), 2)
+    reg = ModelRegistry()
+    t0 = time.perf_counter()
+    for i in range(5000):
+        reg.register(f"t{i:05d}", paths[i % 2], lazy=True, max_batch=16)
+    boot_s = time.perf_counter() - t0
+    assert boot_s < 2.0, f"lazy registration cost {boot_s:.2f}s for 5k"
+    man = reg.manifests()
+    assert len(man) == 5000
+    assert all(m["resident"] is False for m in man.values())
+    assert reg.resident("t00000") is False
+    eng = reg.engine("t00000")           # first request hydrates
+    assert eng is not None
+    assert reg.resident("t00000") is True
+    assert man["t00000"]["source"] == paths[0]
+    assert reg.evict("t00000") is True
+    assert reg.resident("t00000") is False
+
+
+# ---------------------------------------------------------------------
+# watchtower: model-cache-thrash
+# ---------------------------------------------------------------------
+
+
+def test_model_cache_thrash_rule_fires_and_stays_quiet():
+    from dpsvm_tpu.observability import slo
+
+    specs = [r for r in slo.default_serving_rules()
+             if r["name"] == "model-cache-thrash"]
+    assert len(specs) == 1
+    # warmup burst: 20 faults in the first seconds, then residency —
+    # the rate over the window decays below threshold, no firing
+    tower = slo.Watchtower(slo.RuleSet.from_specs(specs))
+    quiet = [tr for i in range(180)
+             for tr in tower.observe(
+                 {"model_faults": float(min(i, 20))}, t=float(i))]
+    assert quiet == [], quiet
+    # sustained churn: 3 faults/second forever -> fires
+    tower2 = slo.Watchtower(slo.RuleSet.from_specs(specs))
+    fired = [tr for i in range(180)
+             for tr in tower2.observe(
+                 {"model_faults": float(3 * i)}, t=float(i))]
+    assert fired and fired[0]["state"] == "firing"
+    assert fired[0]["rule"] == "model-cache-thrash"
+
+
+def test_metricsz_flatten_maps_fleet_counters():
+    from dpsvm_tpu.observability import slo
+
+    sample = slo.sample_from_metricsz_json({
+        "requests": 10,
+        "model_cache": {"budget": 8, "resident": 3, "faults": 5,
+                        "evictions": 2}})
+    assert sample["model_faults"] == 5.0
+    assert sample["model_evictions"] == 2.0
+    assert sample["model_cache_resident"] == 3.0
+    assert sample["model_cache_budget"] == 8.0
+
+
+# ---------------------------------------------------------------------
+# fleet selfcheck (the CI gate)
+# ---------------------------------------------------------------------
+
+
+def test_fleet_selfcheck_clean(tmp_path):
+    from dpsvm_tpu import fleet
+
+    assert fleet.selfcheck(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------
+# end-to-end: server + loadgen
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fleet_server(tmp_path):
+    from dpsvm_tpu.serving.server import ServingServer
+
+    reg = _lazy_registry(tmp_path, 6)
+    srv = ServingServer(reg, port=0, max_batch=16,
+                        model_cache_budget=3, verbose=False).start()
+    yield srv, reg
+    srv.drain(timeout=10.0)
+
+
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_server_cold_path_end_to_end(fleet_server):
+    srv, reg = fleet_server
+    q = np.zeros((2, 4), np.float32).tolist()
+    # every model lazy at boot
+    with urllib.request.urlopen(srv.url + "/v1/models") as r:
+        man = json.loads(r.read())["models"]
+    assert all(m["resident"] is False for m in man.values())
+    # cold requests answer correctly (fault or transient)
+    for name in ("m0000", "m0001", "m0000", "m0001"):
+        code, body = _post(srv.url + "/v1/predict",
+                           {"model": name, "instances": q,
+                            "return": ["labels", "decision"]})
+        assert code == 200, body
+        assert len(body["labels"]) == 2
+    # contracts on the cold path
+    code, _ = _post(srv.url + "/v1/predict",
+                    {"model": "nope", "instances": q})
+    assert code == 404
+    code, _ = _post(srv.url + "/v1/predict",
+                    {"model": "m0002",
+                     "instances": np.zeros((1, 9), np.float32).tolist()})
+    assert code == 400
+    # /metricsz carries a conserved model_cache block
+    with urllib.request.urlopen(srv.url + "/metricsz") as r:
+        mz = json.loads(r.read())
+    mc = mz["model_cache"]
+    assert mc["budget"] == 3
+    assert mc["touches"] == mc["hits"] + mc["faults"] + mc["transients"]
+    assert mc["resident"] <= 3
+    # residency overlay after traffic
+    with urllib.request.urlopen(srv.url + "/v1/models") as r:
+        man2 = json.loads(r.read())["models"]
+    assert any(m["resident"] for m in man2.values())
+    assert not man2["m0005"]["resident"]
+
+
+def test_loadgen_fleet_row(fleet_server):
+    from dpsvm_tpu.serving.loadgen import (fetch_models, model_of,
+                                           run_loadgen)
+
+    srv, _reg = fleet_server
+    names = sorted(fetch_models(srv.url))
+    assert len(names) == 6
+    rows = np.zeros((8, 4), np.float32)
+    row = run_loadgen(srv.url, rows, model="m0000", requests=40,
+                      batch=2, concurrency=4, models=names,
+                      model_skew=0.5)
+    assert row["errors"] == 0
+    assert row["models"] == 6
+    assert set(row["model_rows"]) == set(names)
+    assert row["cold_start_p99_ms"] > 0
+    # the skewed stride is deterministic and hot-model-first
+    hot_share = sum(1 for i in range(40)
+                    if model_of(i, 6, 0.5) == 0)
+    assert row["model_rows"]["m0000"]["requests"] == hot_share == 20
+    for sub in row["model_rows"].values():
+        assert sub["first_ms"] >= 0
+        assert sub["requests"] >= 1
