@@ -200,6 +200,19 @@ TAGS = [
     # while one tenant hogs the queue.
     sub("tenant_isolation", R4, 420,
         [sys.executable, "-m", "dpsvm_tpu.serving", "--tenant-drill"]),
+    # Front-door transport drill (docs/SERVING.md "Front door"): the
+    # same model saturated behind the threaded and the async front
+    # ends, the async one holding 10x the open keep-alive connections
+    # through the weighted-fair admission queue. The JSON row's
+    # headline is serving_slo_max_rps for the async transport (also a
+    # perf-ledger row via the runner), with the threaded baseline, the
+    # connection ratio, and the span-stage knee — which the event-loop
+    # + shallow-batcher design must keep OUT of queue_wait. On a chip
+    # round the serving engine computes on device, so the row doubles
+    # as an "admission layer costs nothing at the device" check.
+    sub("async_front_door", R4, 420,
+        [sys.executable, "-m", "dpsvm_tpu.serving",
+         "--front-door-drill"]),
     # Model-fleet cache drill (docs/SERVING.md "Model fleet",
     # dpsvm_tpu/fleet/): 1000 lazily registered models served from a
     # 32-slot HBM cache — a skewed hot set plus a full one-shot scan.

@@ -530,11 +530,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "per-tenant series count, evictions and "
                          "overflow, plus the model-cache residency/"
                          "fault/eviction state when the fleet cache "
-                         "is armed — warning near saturation of "
-                         "either budget (docs/OBSERVABILITY.md "
+                         "is armed, plus the front-end kind with its "
+                         "open-connection count and per-tenant fair-"
+                         "queue lane depths (async front door) — "
+                         "warning near saturation of any budget or "
+                         "the connection cap (docs/OBSERVABILITY.md "
                          "'Per-tenant attribution', docs/SERVING.md "
-                         "'Model fleet'); reporting-only, never "
-                         "changes the exit code")
+                         "'Model fleet', 'Front door'); reporting-"
+                         "only, never changes the exit code")
     dr.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                     help="multi-host preflight: deadline-bounded TCP "
                          "reachability check of the jax.distributed "
@@ -839,6 +842,37 @@ def build_parser() -> argparse.ArgumentParser:
                          "LRU-of-activity eviction). Same-spec "
                          "residents share ONE batched decision "
                          "program (docs/SERVING.md 'Model fleet')")
+    sv.add_argument("--front-end", choices=["threaded", "async"],
+                    default="threaded",
+                    help="HTTP transport: 'threaded' (stdlib thread-"
+                         "per-connection, the default) or 'async' (one "
+                         "asyncio event loop holds every connection — "
+                         "10k+ keep-alive clients without 10k threads, "
+                         "bitwise-identical responses, same drain "
+                         "contract; adds the weighted-fair per-tenant "
+                         "admission queue — docs/SERVING.md 'Front "
+                         "door')")
+    sv.add_argument("--tenant-weight", action="append", default=[],
+                    metavar="NAME=W",
+                    help="DRR weight for a tenant's fair-queue lane on "
+                         "the async front end (repeatable; default 1; "
+                         "an 8-weight lane gets 8x the service of a "
+                         "1-weight lane under contention; the 'other' "
+                         "long-tail bucket shares one lane)")
+    sv.add_argument("--max-connections", type=int, default=10000,
+                    help="async front end only: open-connection cap — "
+                         "beyond it new connections get an immediate "
+                         "503 + close (doctor WARNs at 80%%)")
+    sv.add_argument("--hbm-budget-mb", type=float, default=None,
+                    metavar="MB",
+                    help="per-device budget for a model's packed "
+                         "buffers: a model whose estimated resident "
+                         "bytes exceed it is served through the mesh-"
+                         "sharded decision path instead (SV axis for "
+                         "dual models, feature-block axis for approx "
+                         "models, psum-reduced over the local devices; "
+                         "bitwise == the unsharded blocked reference "
+                         "— docs/SERVING.md 'Front door')")
     sv.add_argument("-q", "--quiet", action="store_true")
     _add_backend_flags(sv)
 
@@ -937,6 +971,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "deterministic stride as --hot-tenant-skew. "
                          "0 round-robins all N (the cache-thrash "
                          "worst case when N exceeds the cache budget)")
+    lg.add_argument("--connections", type=int, default=0, metavar="N",
+                    help="pre-open and HOLD N keep-alive connections "
+                         "for the whole run; the first --concurrency "
+                         "carry the traffic, the rest sit idle-open — "
+                         "the front-door drill shape (thousands of "
+                         "mostly-idle sockets; docs/SERVING.md 'Front "
+                         "door'). The row gains open_connections")
 
     gd = sub.add_parser(
         "grid", help="mesh-parallel C×gamma grid trainer: the whole "
@@ -2102,6 +2143,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
         print("error: --model-cache-budget must be >= 1",
               file=sys.stderr)
         return 2
+    if args.hbm_budget_mb is not None and not (args.hbm_budget_mb > 0):
+        print(f"error: --hbm-budget-mb must be > 0, got "
+              f"{args.hbm_budget_mb}", file=sys.stderr)
+        return 2
+    if args.max_connections < 1:
+        print("error: --max-connections must be >= 1", file=sys.stderr)
+        return 2
+    tenant_weights = {}
+    if args.tenant_weight:
+        if args.front_end != "async":
+            print("error: --tenant-weight needs --front-end async "
+                  "(the threaded transport has no fair queue)",
+                  file=sys.stderr)
+            return 2
+        from dpsvm_tpu.serving.fairqueue import parse_tenant_weights
+        try:
+            tenant_weights = parse_tenant_weights(args.tenant_weight)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if cache_budget is not None and args.no_b:
         # the cache's shared same-spec program serves include_b=True
         # decisions; mixing the two would silently change semantics
@@ -2129,7 +2190,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
             registry.register(name, path, lazy=True,
                               max_batch=args.max_batch,
                               include_b=True,
-                              precision=args.precision)
+                              precision=args.precision,
+                              **({"hbm_budget_mb": args.hbm_budget_mb}
+                                 if args.hbm_budget_mb else {}))
             if not args.quiet:
                 print(f"registered {name!r} (lazy): {path}",
                       file=sys.stderr)
@@ -2137,7 +2200,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         engine = registry.register(name, path,
                                    max_batch=args.max_batch,
                                    include_b=not args.no_b,
-                                   precision=args.precision)
+                                   precision=args.precision,
+                                   **({"hbm_budget_mb":
+                                       args.hbm_budget_mb}
+                                      if args.hbm_budget_mb else {}))
         if not args.quiet:
             m = engine.manifest
             print(f"loaded {name!r}: task={m['task']} "
@@ -2146,7 +2212,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
                   f"precision={m['precision']} "
                   f"buckets={m['buckets']} "
                   f"warmup_compiles={m['warmup_compiles']} "
-                  f"({m['warmup_compile_seconds']}s)", file=sys.stderr)
+                  f"({m['warmup_compile_seconds']}s)"
+                  + (" [mesh-sharded decisions]" if m.get("sharded")
+                     else ""), file=sys.stderr)
     unknown = [s for pair in siblings.items() for s in pair
                if s not in registry.names()]
     if unknown:
@@ -2176,7 +2244,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             **({"tenant_budget": args.tenant_budget}
                                if args.tenant_budget is not None else {}),
                             model_cache_budget=cache_budget,
-                            verbose=not args.quiet).start()
+                            verbose=not args.quiet)
+        if args.front_end == "async":
+            from dpsvm_tpu.serving.frontdoor import AsyncFrontDoor
+            front = AsyncFrontDoor(
+                srv, max_connections=args.max_connections,
+                tenant_weights=tenant_weights).start()
+        else:
+            front = srv.start()
     except ValueError as e:                 # width-mismatched sibling
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -2185,11 +2260,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         return 2
     if args.port_file:
         with open(args.port_file, "w") as f:
-            f.write(str(srv.port))
-    print(f"serving on http://{args.host}:{srv.port} "
-          f"(models: {', '.join(registry.names())}) — SIGTERM/Ctrl-C "
+            f.write(str(front.port))
+    print(f"serving on http://{args.host}:{front.port} "
+          f"({args.front_end} front end; models: "
+          f"{', '.join(registry.names())}) — SIGTERM/Ctrl-C "
           "drains", file=sys.stderr, flush=True)
-    signum = srv.serve_until_signal()
+    signum = front.serve_until_signal()
     if not args.quiet:
         m = srv.metrics()
         print(f"drained (signal {signum}): {m['requests']} requests, "
@@ -2271,6 +2347,10 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
         ledger.append(row.get("metric", "loadgen"), row,
                       kind="loadgen", trace=row.get("trace"))
 
+    if args.connections < 0:
+        print(f"error: --connections must be >= 0, got "
+              f"{args.connections}", file=sys.stderr)
+        return 2
     if args.saturate:
         row = run_saturate(args.url, rows, model=args.model,
                            p99_target_ms=args.p99_target_ms,
@@ -2280,7 +2360,8 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
                            step_requests=args.step_requests,
                            batch=args.batch,
                            concurrency=args.concurrency, want=want,
-                           timeout=args.timeout, trace=trace)
+                           timeout=args.timeout, trace=trace,
+                           connections=args.connections)
         print(json.dumps(row), flush=True)
         _ledger_append(row)
         return 0 if row["slo_met"] else 1
@@ -2292,7 +2373,8 @@ def cmd_loadgen(args: argparse.Namespace) -> int:
                       compare_sequential=args.compare_sequential,
                       trace=trace, tenants=args.tenants,
                       hot_tenant_skew=args.hot_tenant_skew,
-                      models=fleet_names, model_skew=args.model_skew)
+                      models=fleet_names, model_skew=args.model_skew,
+                      connections=args.connections)
     print(json.dumps(row), flush=True)
     _ledger_append(row)
     if row.get("cold_start_p99_ms") is not None:

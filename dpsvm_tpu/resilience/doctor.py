@@ -321,6 +321,40 @@ def _serving_tenant_probe(url: str, out: Callable[[str], None]) -> None:
                 "raise `serve --model-cache-budget` if the working "
                 "set outgrew the budget (watch the model-cache-thrash "
                 "rule)")
+    # Front-door transport (docs/SERVING.md "Front door") — same
+    # reporting-only contract: connection-cap pressure and queue-lane
+    # depth are capacity facts, not a broken mesh.
+    fd = obj.get("front_door") if isinstance(obj, dict) else None
+    if isinstance(fd, dict):
+        kind = fd.get("kind", "threaded")
+        if kind != "async":
+            out("serving: front end: threaded (thread-per-connection; "
+                "`serve --front-end async` holds 10k+ connections on "
+                "one event loop)")
+        else:
+            open_c = int(fd.get("open_connections") or 0)
+            max_c = int(fd.get("max_connections") or 0)
+            out(f"serving: front end: async ({open_c}/{max_c} "
+                "connections open, "
+                f"{int(fd.get('connections_rejected') or 0)} rejected "
+                f"at the cap, {int(fd.get('inflight_rows') or 0)} "
+                "rows in flight)")
+            fq = fd.get("fair_queue") or {}
+            lanes = fq.get("lanes") or {}
+            if lanes:
+                depth = ", ".join(
+                    f"{t}: {int(v.get('rows') or 0)} rows (w="
+                    f"{v.get('weight')})"
+                    for t, v in sorted(lanes.items()))
+                out(f"serving: fair-queue lanes: {depth}; "
+                    f"{int(fq.get('rows_queued') or 0)} rows queued "
+                    f"of {int(fq.get('lane_capacity_rows') or 0)} "
+                    "per-lane capacity")
+            if max_c and open_c >= 0.8 * max_c:
+                out(f"serving: WARNING open connections near the cap "
+                    f"({open_c}/{max_c}) — new connections will get "
+                    "an immediate 503; raise `serve "
+                    "--max-connections` if this is organic load")
 
 
 def _hostgroup_probe(coordinator: Optional[str],

@@ -32,6 +32,18 @@ until this package the repo could only do one-shot batch eval
                  ``GET /healthz`` / ``/metricsz`` / ``/v1/models``,
                  ``POST /v1/reload``; SIGTERM graceful drain via the
                  ``resilience/preempt`` deferred-signal trap.
+* ``frontdoor``— ``AsyncFrontDoor``: asyncio event-loop transport over
+                 the same request core (``dpsvm serve --front-end
+                 async``) — 10k connections without 10k threads,
+                 bitwise-identical responses, same drain contract.
+* ``fairqueue``— ``FairQueue``: deficit-round-robin weighted-fair
+                 admission between the loop and the batcher; one lane
+                 per resolved tenant label (``--tenant-weight``).
+* ``sharded``  — ``ShardedDecider``: mesh-sharded decision path (SV
+                 axis / feature-block axis over ``parallel/mesh``) the
+                 engine selects when a packed model exceeds
+                 ``--hbm-budget-mb`` per device; psum-reduced, bitwise
+                 == its unsharded in-order blocked reference.
 * ``loadgen``  — open/closed-loop generator printing one bench-harness
                  JSON row (throughput + p50/p95/p99 + the sequential
                  batch-1 baseline and coalescing speedup); ``--chaos``
@@ -74,6 +86,8 @@ __all__ = [
     "LifecycleLoop", "RetrainResult", "ServingServer", "bucket_ladder",
     "compact_model", "loadgen_row", "run_loadgen", "run_saturate",
     "selfcheck", "tenant_isolation_drill", "main",
+    "AsyncFrontDoor", "FairQueue", "LaneFullError", "ShardedDecider",
+    "front_door_drill",
 ]
 
 _LAZY = {
@@ -90,6 +104,10 @@ _LAZY = {
     "run_loadgen": ("dpsvm_tpu.serving.loadgen", "run_loadgen"),
     "loadgen_row": ("dpsvm_tpu.serving.loadgen", "loadgen_row"),
     "run_saturate": ("dpsvm_tpu.serving.loadgen", "run_saturate"),
+    "AsyncFrontDoor": ("dpsvm_tpu.serving.frontdoor", "AsyncFrontDoor"),
+    "FairQueue": ("dpsvm_tpu.serving.fairqueue", "FairQueue"),
+    "LaneFullError": ("dpsvm_tpu.serving.fairqueue", "LaneFullError"),
+    "ShardedDecider": ("dpsvm_tpu.serving.sharded", "ShardedDecider"),
 }
 
 
@@ -285,6 +303,98 @@ def selfcheck(tmp_dir: Optional[str] = None) -> List[str]:
             faultinject.release_serve_wedge()
             faultinject.clear()
             pool.close()
+
+        # 6) front door: the async transport answers bitwise-
+        # identically to the threaded one over the same artifact; DRR
+        # weights yield the promised service ratio; an over-budget
+        # model serves mesh-sharded at bitwise parity with its
+        # unsharded in-order reference (docs/SERVING.md "Front door")
+        import json as _json
+        import urllib.request
+
+        from dpsvm_tpu.serving.fairqueue import drr_schedule
+        from dpsvm_tpu.serving.frontdoor import AsyncFrontDoor
+        from dpsvm_tpu.serving.server import ServingServer
+
+        def _post(url, payload):
+            req = urllib.request.Request(
+                url + "/v1/predict",
+                data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15.0) as r:
+                return _json.loads(r.read())
+
+        reg_thr, reg_fd = ModelRegistry(), ModelRegistry()
+        reg_thr.register("default", path, max_batch=16)
+        reg_fd.register("default", path, max_batch=16)
+        thr = ServingServer(reg_thr, port=0, max_batch=16,
+                            max_delay_ms=0.5).start()
+        fd = AsyncFrontDoor(
+            ServingServer(reg_fd, port=0, max_batch=16,
+                          max_delay_ms=0.5),
+            tenant_weights={"gold": 8.0}).start()
+        try:
+            q6 = rng.standard_normal((9, d)).astype(np.float32)
+            want6 = {"instances": q6.tolist(),
+                     "return": ["labels", "decision"]}
+            out_thr = _post(thr.url, want6)
+            out_fd = _post(fd.url, want6)
+            if (out_thr["decision"] != out_fd["decision"]
+                    or out_thr["labels"] != out_fd["labels"]):
+                problems.append(
+                    "async front door answered differently from the "
+                    "threaded transport over the same artifact")
+        finally:
+            fd.drain(timeout=10.0)
+            thr.drain(timeout=10.0)
+
+        # DRR ratio on the pure staged queue: 8:1 weights, everything
+        # pushed up front -> one full round serves EXACTLY 64 gold + 8
+        # bronze rows (one quantum grant per lane per turn). Exact, not
+        # approximate: a tolerance here once hid a re-earning bug that
+        # served the front lane to exhaustion (72/72 gold).
+        pushes = ([("gold", 1)] * 80 + [("bronze", 1)] * 80)
+        order = drr_schedule(pushes, {"gold": 8.0, "bronze": 1.0},
+                             quantum=8)
+        gold_first = sum(1 for t, _ in order[:72] if t == "gold")
+        if gold_first != 64:
+            problems.append(
+                f"DRR served {gold_first}/72 gold rows for an 8:1 "
+                "weight ratio (expected exactly 64: one full round)")
+
+        # sharded decision path: force a budget far below the packed
+        # model, assert the engine flips to the mesh decider and that
+        # it is bitwise == its unsharded in-order blocked reference
+        import jax as _jax
+        if len(_jax.devices()) >= 2:
+            eng_sh = PredictionEngine.load(path, max_batch=16,
+                                           hbm_budget_mb=1e-4)
+            if not eng_sh.sharded:
+                problems.append(
+                    "engine did not select the sharded decision path "
+                    "under a forced 0.0001 MB HBM budget")
+            else:
+                sd = eng_sh._sharded_deciders[0]
+                q_sh = rng.standard_normal((16, d)).astype(np.float32)
+                got = np.asarray(sd.decide(q_sh), np.float32)
+                ref = np.asarray(sd.reference(q_sh), np.float32)
+                if not np.array_equal(got.view(np.int32),
+                                      ref.view(np.int32)):
+                    problems.append(
+                        "sharded decision differs bitwise from its "
+                        "unsharded in-order reference (max abs err "
+                        f"{np.max(np.abs(got - ref)):.3g})")
+                compilewatch.drain()
+                for s in (1, 7, 16):
+                    eng_sh.infer(rng.standard_normal(
+                        (s, d)).astype(np.float32), want=("decision",))
+                stray6 = compilewatch.drain()
+                if stray6:
+                    problems.append(
+                        f"{len(stray6)} compile event(s) across post-"
+                        "warmup sharded traffic — the sharded path is "
+                        "leaking retraces")
     finally:
         if ctx is not None:
             ctx.cleanup()
@@ -419,6 +529,126 @@ def tenant_isolation_drill(tmp_dir: Optional[str] = None,
     return row
 
 
+def front_door_drill(tmp_dir: Optional[str] = None,
+                     trace_path: Optional[str] = None,
+                     threaded_connections: int = 20,
+                     connection_factor: int = 10) -> dict:
+    """The threaded-vs-async transport drill (docs/SERVING.md "Front
+    door"): saturate the SAME model behind both front ends, the async
+    one holding ``connection_factor``x the open keep-alive connections,
+    and report ONE ``serving_slo_max_rps`` row — async's max sustained
+    RPS under the p99 SLO, the threaded baseline, the connection
+    ratio, and WHICH span stage sat at the knee (the fair-queue +
+    shallow-batcher design keeps it out of ``queue_wait``). ``ok`` is
+    the verdict the burst runner gates on."""
+    import os
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.models.svm import SVMModel
+    from dpsvm_tpu.serving.frontdoor import AsyncFrontDoor
+    from dpsvm_tpu.serving.loadgen import run_saturate
+    from dpsvm_tpu.serving.registry import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    ctx = (tempfile.TemporaryDirectory() if tmp_dir is None else None)
+    base = tmp_dir if tmp_dir is not None else ctx.name
+    ext_trace = trace_path is not None
+    c_thr = int(threaded_connections)
+    c_asy = c_thr * int(connection_factor)
+    row: dict = {"metric": "serving_slo_max_rps", "unit": "req/s",
+                 "front_end": "async", "ok": False}
+    try:
+        rng = np.random.default_rng(17)
+        n_sv, d = 32, 5
+        model = SVMModel(
+            x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+            alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+            y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(
+                np.int32),
+            b=0.1, gamma=0.4)
+        path = os.path.join(base, "frontdoor.svm")
+        save_model(model, path)
+        if trace_path is None:
+            trace_path = os.path.join(base, "front_door.jsonl")
+        rows = rng.standard_normal((64, d)).astype(np.float32)
+        sat = dict(p99_target_ms=250.0, start_rps=40.0, rps_factor=2.0,
+                   max_steps=4, step_requests=80, concurrency=8,
+                   timeout=15.0, trace=trace_path)
+
+        reg_thr = ModelRegistry()
+        reg_thr.register("default", path, max_batch=32)
+        thr_srv = ServingServer(reg_thr, "127.0.0.1", 0, max_batch=32,
+                                max_delay_ms=0.5).start()
+        try:
+            thr = run_saturate(thr_srv.url, rows, connections=c_thr,
+                               **sat)
+        finally:
+            thr_srv.drain(timeout=10.0)
+
+        reg_asy = ModelRegistry()
+        reg_asy.register("default", path, max_batch=32)
+        fd = AsyncFrontDoor(
+            ServingServer(reg_asy, "127.0.0.1", 0, max_batch=32,
+                          max_delay_ms=0.5, trace_out=trace_path,
+                          trace_sample_rate=1.0),
+            max_connections=max(4 * c_asy, 64)).start()
+        try:
+            # the front-door stats mid-run come from the same endpoint
+            # any scraper would use — sampled before the held sockets
+            # release
+            asy = run_saturate(fd.url, rows, connections=c_asy, **sat)
+            with urllib.request.urlopen(fd.url + "/metricsz",
+                                        timeout=10.0) as r:
+                import json as _json
+                front = _json.loads(r.read()).get("front_door", {})
+        finally:
+            fd.drain(timeout=10.0)
+
+        thr_open = int(thr.get("open_connections") or 0)
+        asy_open = int(asy.get("open_connections") or 0)
+        knee = None
+        table = asy.get("span_p99_ms") or {}
+        if table:
+            knee = max(table, key=lambda k: table[k]["p99_ms"])
+        row.update(
+            value=asy.get("value"),
+            slo_met=bool(asy.get("slo_met")),
+            p99_target_ms=sat["p99_target_ms"],
+            connections_threaded=thr_open,
+            connections_async=asy_open,
+            connection_ratio=(round(asy_open / thr_open, 2)
+                              if thr_open else None),
+            throughput_threaded_rps=thr.get("value"),
+            throughput_async_rps=asy.get("value"),
+            async_vs_threaded=(
+                round(asy["value"] / thr["value"], 3)
+                if thr.get("value") else None),
+            knee_stage=knee,
+            queue_wait_p99_ms=asy.get("queue_wait_p99_ms"),
+            compute_p99_ms=asy.get("compute_p99_ms"),
+            connections_rejected=int(
+                front.get("connections_rejected", 0)),
+            steps_threaded=thr.get("steps"),
+            steps_async=asy.get("steps"),
+        )
+        if ext_trace:
+            row["trace"] = trace_path
+        row["ok"] = bool(
+            thr.get("slo_met") and asy.get("slo_met")
+            and asy_open >= 10 * max(thr_open, 1)
+            and thr.get("value") and asy.get("value")
+            and asy["value"] >= 0.8 * thr["value"]
+            and knee != "queue_wait")
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+    return row
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import os
@@ -448,10 +678,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "p99 stays on its own lane; prints ONE JSON "
                         "row (tenant_isolation) and exits 0 iff the "
                         "culprit was identified")
+    p.add_argument("--front-door-drill", action="store_true",
+                   help="run the threaded-vs-async transport drill "
+                        "(docs/SERVING.md 'Front door'): saturate the "
+                        "same model behind both front ends, the async "
+                        "one holding 10x the open keep-alive "
+                        "connections; prints ONE JSON row "
+                        "(serving_slo_max_rps) and exits 0 iff async "
+                        "sustained the SLO at the connection ratio "
+                        "with the latency knee out of queue_wait")
     args = p.parse_args(argv)
-    if not (args.selfcheck or args.live_drill or args.tenant_drill):
+    if not (args.selfcheck or args.live_drill or args.tenant_drill
+            or args.front_door_drill):
         p.print_help()
         return 2
+    if args.front_door_drill:
+        import json
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        trace_env = os.environ.get("BENCH_TRACE_OUT")
+        row = front_door_drill(trace_path=trace_env or None)
+        print(json.dumps(row))
+        return 0 if row.get("ok") else 1
     if args.tenant_drill:
         import json
 
@@ -474,6 +722,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(row))
         return 0 if row.get("ok") else 1
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the sharded-decision gate needs >= 2 devices; standalone runs
+    # (outside the test suite's conftest) force the virtual-CPU mesh
+    # unless the caller pinned their own XLA flags
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
     problems = selfcheck()
     if problems:
         print("serving selfcheck FAILED:", file=sys.stderr)
@@ -484,5 +737,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           "mixed-size traffic; engine bitwise == decision_function; "
           "batcher + hot reload consistent; pool ejects a wedged "
           "replica, 504s its dispatch, rebuilds and recovers with "
-          "zero stray retraces)")
+          "zero stray retraces; async front door bitwise == threaded; "
+          "DRR fair queue serves 8:1 weights at 8:1; over-budget "
+          "model serves mesh-sharded bitwise == its unsharded "
+          "reference)")
     return 0

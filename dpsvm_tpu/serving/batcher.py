@@ -70,13 +70,22 @@ class _Ticket:
     ``spans`` (observability/spans.RequestSpans, None when the request
     is unsampled) rides along so the worker can bracket this ticket's
     queue-wait / batch-formation / dispatch stages — the request-scoped
-    latency attribution of docs/OBSERVABILITY.md "Spans"."""
+    latency attribution of docs/OBSERVABILITY.md "Spans".
+
+    ``on_done`` (callable taking the ticket, or None) fires from the
+    worker thread right after the ticket's result or error is
+    published (``event.set()``). It exists for callers that must NOT
+    block a thread in ``wait()`` — the asyncio front door passes a
+    ``loop.call_soon_threadsafe`` trampoline here and resolves a
+    future on the loop instead. The callback must be fast and never
+    raise (exceptions are swallowed so they can't kill the worker)."""
 
     __slots__ = ("rows", "want", "event", "result", "error", "t_submit",
-                 "deadline", "cancelled", "spans")
+                 "deadline", "cancelled", "spans", "on_done")
 
     def __init__(self, rows: np.ndarray, want: Tuple[str, ...],
-                 deadline: Optional[float] = None, spans=None):
+                 deadline: Optional[float] = None, spans=None,
+                 on_done=None):
         self.rows = rows
         self.want = want
         self.event = threading.Event()
@@ -86,6 +95,7 @@ class _Ticket:
         self.deadline = deadline
         self.cancelled = False
         self.spans = spans
+        self.on_done = on_done
 
     def wait(self, timeout: Optional[float] = None) -> dict:
         """Block for the result. The wait is bounded by BOTH the given
@@ -167,7 +177,8 @@ class MicroBatcher:
     # -- client side --------------------------------------------------
 
     def submit(self, rows, want: Sequence[str] = ("labels",),
-               deadline: Optional[float] = None, spans=None) -> _Ticket:
+               deadline: Optional[float] = None, spans=None,
+               on_done=None) -> _Ticket:
         """Enqueue one request (rows: (k, d) float32). Returns a ticket
         to ``wait()`` on. Raises ``QueueFullError`` (fast, no blocking)
         at capacity, ``BatcherClosedError`` while draining.
@@ -175,14 +186,17 @@ class MicroBatcher:
         an expired ticket is dropped at batch formation, not computed.
         ``spans`` (RequestSpans or None) opens its ``queue_wait`` the
         moment the ticket is accepted — rejects never count as queue
-        time."""
+        time. ``on_done`` (see ``_Ticket``) is attached ATOMICALLY at
+        submit so there is no window where the worker publishes before
+        the callback exists."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 1:
             rows = rows[None, :]
         n = int(rows.shape[0])
         if n == 0:
             raise ValueError("empty request")
-        t = _Ticket(rows, tuple(want), deadline, spans=spans)
+        t = _Ticket(rows, tuple(want), deadline, spans=spans,
+                    on_done=on_done)
         with self._cond:
             if self._closing:
                 raise BatcherClosedError("server is draining")
@@ -248,6 +262,19 @@ class MicroBatcher:
     # -- worker -------------------------------------------------------
 
     @staticmethod
+    def _notify(t: _Ticket) -> None:
+        """Fire the ticket's ``on_done`` (if any) after its terminal
+        publish. Runs on the worker thread; callback errors are
+        swallowed — a broken callback must not take the batcher (and
+        every other tenant's requests) down with it."""
+        cb = t.on_done
+        if cb is not None:
+            try:
+                cb(t)
+            except Exception:
+                pass
+
+    @staticmethod
     def _note_batched(t: _Ticket) -> None:
         """Span bookkeeping at batch admission: the ticket stops
         waiting in the queue and starts riding an open batch
@@ -277,6 +304,7 @@ class MicroBatcher:
                 t.error = DeadlineExceededError(
                     "deadline passed while queued")
                 t.event.set()
+                self._notify(t)
 
     def _take_batch(self) -> Optional[List[_Ticket]]:
         """Block for the first request, then coalesce until max_batch
@@ -329,6 +357,7 @@ class MicroBatcher:
                     for t in leftovers:
                         t.error = BatcherClosedError("server shut down")
                         t.event.set()
+                        self._notify(t)
                 return
             if not batch:                  # all queued tickets expired
                 continue
@@ -366,6 +395,7 @@ class MicroBatcher:
                                     error=type(e).__name__)
                     t.error = e
                     t.event.set()
+                    self._notify(t)
                 continue
             lo = 0
             for t in batch:
@@ -376,4 +406,5 @@ class MicroBatcher:
                 # bracket (auto-close) so wakeup latency stays
                 # attributed with no inter-stage gap
                 t.event.set()
+                self._notify(t)
                 lo = hi

@@ -197,10 +197,14 @@ class PredictionEngine:
                  max_batch: int = 256, include_b: bool = True,
                  platt: Optional[Tuple[float, float]] = None,
                  source: Optional[str] = None, warmup: bool = True,
-                 precision: str = "highest"):
+                 precision: str = "highest",
+                 hbm_budget_mb: Optional[float] = None):
         if precision not in ("highest", "high", "default"):
             raise ValueError("precision must be 'highest', 'high' or "
                              f"'default', got {precision!r}")
+        if hbm_budget_mb is not None and not (float(hbm_budget_mb) > 0):
+            raise ValueError(f"hbm_budget_mb must be > 0, got "
+                             f"{hbm_budget_mb}")
         self.name = str(name)
         self.include_b = bool(include_b)
         self.source = source
@@ -216,6 +220,13 @@ class PredictionEngine:
         self.multiclass = isinstance(model, MulticlassModel)
         self.warmup_compiles: List[dict] = []
         self.n_sv_dropped = 0
+        # per-device HBM budget ("serve --hbm-budget-mb"): a binary SV
+        # or approx model whose packed buffers exceed it is served
+        # through the mesh-sharded path (serving/sharded.py) when >= 2
+        # devices are visible. None = never shard (the default).
+        self.hbm_budget_mb = (float(hbm_budget_mb)
+                              if hbm_budget_mb is not None else None)
+        self._sharded_deciders: List = []
         self._lock = threading.Lock()
         self._bucket_counts: Dict[int, int] = {b: 0 for b in self.buckets}
         if self.multiclass:
@@ -277,9 +288,37 @@ class PredictionEngine:
             return
         self._decide_block = self._make_binary_decider(self.model, None)
 
+    def _maybe_sharded(self, model, tag: str):
+        """The --hbm-budget-mb decision: a ShardedDecider when the
+        packed buffers exceed the per-device budget and the mesh can
+        host them (>= 2 devices), else None (single-device ladder).
+        Precomputed models (host gather, nothing device-resident) and
+        the same-spec multiclass SegmentPack collapse never shard —
+        only binary SV/approx deciders (including multiclass mixed-spec
+        per-pair ones) reach here."""
+        if self.hbm_budget_mb is None:
+            return None
+        from dpsvm_tpu.serving import sharded as _sharded
+        if not _sharded.eligible(model):
+            return None
+        if (_sharded.model_bytes_est(model)
+                <= self.hbm_budget_mb * (1 << 20)):
+            return None
+        import jax
+        if len(jax.devices()) < 2:
+            return None
+        sd = _sharded.ShardedDecider(model, include_b=self.include_b,
+                                     precision_name=self._pname,
+                                     tag=f"{tag}-sharded-decision")
+        self._sharded_deciders.append(sd)
+        return sd
+
     def _make_binary_decider(self, model: SVMModel, pair: Optional[int]):
         tag = f"serve[{self.name}]" + (f"-pair{pair}" if pair is not None
                                        else "")
+        sharded = self._maybe_sharded(model, tag)
+        if sharded is not None:
+            return sharded.decide
         if getattr(model, "is_approx", False):
             # EXPLICIT model-kind dispatch: an approx model has no SV
             # buffers — falling through to the SV path would crash on
@@ -389,6 +428,12 @@ class PredictionEngine:
         return self.platt is not None
 
     @property
+    def sharded(self) -> bool:
+        """True when any of this engine's deciders runs mesh-sharded
+        (the --hbm-budget-mb selection fired)."""
+        return bool(self._sharded_deciders)
+
+    @property
     def model_kind(self) -> str:
         """Which decision machinery serves this model: "sv" (device SV
         buffers), "approx-rff"/"approx-nystrom" (featurize + dot, no SV
@@ -419,6 +464,16 @@ class PredictionEngine:
             "warmup_compile_seconds": round(
                 sum(c["seconds"] for c in self.warmup_compiles), 3),
         }
+        if self.hbm_budget_mb is not None:
+            out["hbm_budget_mb"] = self.hbm_budget_mb
+        out["sharded"] = self.sharded
+        if self._sharded_deciders:
+            # binary models have exactly one; mixed-spec multiclass may
+            # shard several pairs — report the first (they share mesh
+            # geometry) plus the count
+            out["sharding"] = dict(self._sharded_deciders[0].facts(),
+                                   n_sharded_deciders=len(
+                                       self._sharded_deciders))
         if self.multiclass:
             out["classes"] = [int(c) for c in self.model.classes]
             out["n_pairs"] = len(self.model.models)

@@ -137,7 +137,8 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
                 tenants: int = 0,
                 hot_tenant_skew: float = 0.0,
                 models: Sequence[str] = (),
-                model_skew: float = 0.0) -> dict:
+                model_skew: float = 0.0,
+                connections: int = 0) -> dict:
     """Fire ``requests`` requests of ``batch`` rows each; return the
     result row (throughput + latency percentiles + error count).
 
@@ -164,13 +165,24 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     model's FIRST-request latency, the number the HBM model cache
     exists to bound (a fault that hydrates from disk shows up here;
     a resident hit does not). All models must share the primary
-    model's feature width (the fleet drill is a same-spec fleet)."""
+    model's feature width (the fleet drill is a same-spec fleet).
+
+    ``connections=N`` pre-opens N keep-alive sockets before the clock
+    starts and HOLDS them all for the whole run: the first
+    ``concurrency`` of them carry the traffic, the rest sit idle-open.
+    That is the front-door drill's shape — thousands of mostly-idle
+    connections with a modest request rate — which costs an event-loop
+    server one registered socket each and a thread-per-connection
+    server one stack each. The row gains ``open_connections`` (how
+    many actually opened)."""
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
     if requests < 1 or batch < 1 or concurrency < 1:
         raise ValueError("requests, batch and concurrency must be >= 1")
     if tenants < 0:
         raise ValueError(f"tenants must be >= 0, got {tenants}")
+    if connections < 0:
+        raise ValueError(f"connections must be >= 0, got {connections}")
     rows = np.asarray(rows, np.float32)
     host, port = _host_port(url)
     # Pre-serialize every request body: the generator must measure the
@@ -193,6 +205,21 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
             body["tenant"] = ten
         bodies.append(json.dumps(body).encode())
 
+    held: List[_Conn] = []
+    if connections:
+        # open the whole fleet up front, outside the measured wall
+        # clock; stop quietly at the server's cap (the row reports the
+        # achieved count, and a cap-refused connect is the server
+        # behaving, not a loadgen failure)
+        for _ in range(int(connections)):
+            c = _Conn(host, port, timeout=timeout)
+            try:
+                c.connect()
+            except OSError:
+                c.close()
+                break
+            held.append(c)
+
     next_idx = [0]
     idx_lock = threading.Lock()
     lat_ms: List[float] = []
@@ -207,7 +234,8 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
         headers["X-Trace-Spans"] = "1"
 
     def worker(wid: int) -> None:
-        conn = _Conn(host, port, timeout=timeout)
+        conn = (held[wid] if wid < len(held)
+                else _Conn(host, port, timeout=timeout))
         try:
             while True:
                 with idx_lock:
@@ -274,6 +302,11 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start[0]
+    for c in held:          # idle holders release only after the run
+        try:
+            c.close()
+        except Exception:
+            pass
 
     lat = np.asarray(lat_ms, np.float64)
     ok = sum(1 for s in statuses if s == 200)
@@ -385,6 +418,7 @@ def run_loadgen(url: str, rows: np.ndarray, *, model: str = "default",
         "availability_pct": (round(100.0 * ok / accepted, 3)
                              if accepted else None),
         **({"target_rps": rps} if mode == "open" else {}),
+        **({"open_connections": len(held)} if connections else {}),
         **span_row,
         **tenant_row,
         **model_row,
@@ -421,7 +455,8 @@ def run_saturate(url: str, rows: np.ndarray, *,
                  batch: int = 1, concurrency: int = 16,
                  want: Sequence[str] = ("labels",),
                  timeout: float = 30.0,
-                 trace: Optional[str] = None) -> dict:
+                 trace: Optional[str] = None,
+                 connections: int = 0) -> dict:
     """Drive-to-saturation: step open-loop RPS by ``rps_factor`` until
     p99 exceeds the target (or errors appear), and report ONE SLO row —
     the max sustained throughput at p99 < target, with availability.
@@ -439,11 +474,15 @@ def run_saturate(url: str, rows: np.ndarray, *,
     best = None
     rps = float(start_rps)
     spans = trace is not None
+    achieved_conns = None
     for _ in range(int(max_steps)):
         r = run_loadgen(url, rows, model=model, requests=step_requests,
                         batch=batch, concurrency=concurrency,
                         mode="open", rps=rps, want=want,
-                        timeout=timeout, spans=spans)
+                        timeout=timeout, spans=spans,
+                        connections=connections)
+        if connections:
+            achieved_conns = r.get("open_connections")
         met = (r["errors"] == 0
                and np.isfinite(r["p99_ms"])
                and r["p99_ms"] <= p99_target_ms)
@@ -464,6 +503,7 @@ def run_saturate(url: str, rows: np.ndarray, *,
         "p99_target_ms": float(p99_target_ms),
         "steps": steps,
         "trace": trace,
+        **({"open_connections": achieved_conns} if connections else {}),
     }
     if best is None:
         row.update(value=0.0, slo_met=False, availability_pct=None)
@@ -488,7 +528,8 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                 trace: Optional[str] = None, tenants: int = 0,
                 hot_tenant_skew: float = 0.0,
                 models: Sequence[str] = (),
-                model_skew: float = 0.0) -> dict:
+                model_skew: float = 0.0,
+                connections: int = 0) -> dict:
     """The one-line result row ``dpsvm loadgen`` prints: the main
     measurement, plus (by default) the batch-1 single-worker sequential
     baseline and the coalescing speedup over it.
@@ -511,7 +552,8 @@ def loadgen_row(url: str, rows: np.ndarray, *, model: str = "default",
                        rps=rps, want=want, timeout=timeout,
                        spans=trace is not None, tenants=tenants,
                        hot_tenant_skew=hot_tenant_skew,
-                       models=models, model_skew=model_skew)
+                       models=models, model_skew=model_skew,
+                       connections=connections)
     row = {
         "metric": "serving_examples_per_sec",
         "value": main["examples_per_sec"],

@@ -648,6 +648,12 @@ class ServingServer:
         self._t0 = time.monotonic()
         self._httpd: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
+        # The async front door (serving/frontdoor.AsyncFrontDoor)
+        # attaches itself here when it wraps this core with
+        # ``start(listen=False)``; /metricsz and the doctor probe read
+        # its stats through this handle. None = classic threaded
+        # listener.
+        self.front_door = None
         for name, sib in (siblings or {}).items():
             self.set_sibling(name, sib)
 
@@ -1177,6 +1183,14 @@ class ServingServer:
         # slo.sample_from_metricsz_json + the doctor probe read
         if self.model_cache is not None:
             out["model_cache"] = self.model_cache.stats()
+        # front-door block (docs/SERVING.md "Front door"): which
+        # transport answers connections, how many are open, and the
+        # per-tenant fair-queue lane depths — the source the doctor
+        # probe reads. The threaded listener has no connection cap or
+        # admission queue, so its block is just the kind marker.
+        fd = self.front_door
+        out["front_door"] = (fd.stats() if fd is not None
+                             else {"kind": "threaded"})
         out["events"] = events[-64:]
         return out
 
@@ -1255,7 +1269,13 @@ class ServingServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def start(self) -> "ServingServer":
+    def start(self, listen: bool = True) -> "ServingServer":
+        """Open the trace, arm the emergency bundle, pre-build pools —
+        and (by default) start the threaded HTTP listener.
+        ``listen=False`` does everything EXCEPT the listener: the async
+        front door (serving/frontdoor.py) wraps a core started this
+        way and brings its own event-loop transport, so the two front
+        ends share one request core instead of forking it."""
         if self._trace_out:
             from dpsvm_tpu.observability.record import open_serving_trace
             self._trace = open_serving_trace(
@@ -1276,6 +1296,8 @@ class ServingServer:
             # or never, if the model cache serves it cold)
             if self._registry_resident(name):
                 self.pool(name)
+        if not listen:
+            return self
         self._httpd = _Server((self.host, self.requested_port), _Handler)
         self._httpd.owner = self
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -1,0 +1,432 @@
+"""Mesh-sharded decision pass: one model's buffers spread over chips.
+
+The engine's single-device ladder (``serving/engine.py``) assumes the
+packed model fits one device's HBM. A model that doesn't — a large SV
+set, or a wide approx feature map — would either OOM at packing or
+evict everything else from the PR 19 model cache. This module serves
+such a model by sharding the REDUCTION axis of its decision program
+over the ``parallel/mesh`` data axis, exactly the way the distributed
+trainers shard training rows:
+
+* **SV (dual) models** — the support-vector axis is sharded: each
+  device holds ``S/n`` SV rows (+ their coef and squared norms),
+  computes its partial ``(m, S/n) kernel-matmul``, and a ``lax.psum``
+  over the ``"shard"`` axis folds the partials into the full (m,)
+  decision. Query rows are replicated (they are small; the SV buffers
+  are what didn't fit).
+* **Approx models** — the FEATURE axis is sharded. RFF: each device
+  holds a column block of omega and the matching (cos-half, sin-half)
+  weight slices, so its partial is the same ``scale * [cos z | sin z]
+  @ w_blk`` program the single-device decider runs, just narrower.
+  The cos/sin scale is the GLOBAL ``sqrt(2/dim)`` — a naive per-block
+  featurize would rescale by the block width and serve garbage.
+  Nystrom: landmarks are replicated (they are ``dim``-sized, small by
+  construction), the whitening projection's columns and ``w`` are
+  sharded.
+
+Padding makes the shards even: the sharded axis is padded up to a
+multiple of the mesh size with zero coefficients / zero weights, whose
+contribution to the f32 partial is EXACTLY ``0.0`` (finite kernel or
+feature value times a zero coefficient), so padding never perturbs the
+decision bits.
+
+**What "parity" means here.** f32 addition does not reassociate: a
+single ``(m, S) @ (S,)`` matmul and a fold of per-block partials
+differ in final bits (observed ~7e-8 on CPU), so NO sharded execution
+can be bitwise-equal to the classic single-pass ladder. What IS exact
+— and what the tests and the serving selfcheck pin — is that the mesh
+execution (partials + ``psum``) is bitwise-identical to the SAME
+blocked program run unsharded on one device with an in-order fold:
+``ShardedDecider.reference``. Against the classic ladder the sharded
+decisions agree to f32 roundoff (the documented, pinned tolerance).
+Determinism still holds: the block layout is fixed at build time, so
+every request sees one reduction order, and matched shapes are
+bitwise-reproducible call over call with zero steady-state retraces
+(the program set is one jitted mesh program per ladder bucket, warmed
+like every other decider and watched by ``compilewatch``).
+
+Selection lives in the engine: ``--hbm-budget-mb`` (serve) sets a
+per-device budget, ``model_bytes_est`` reuses the fleet model-cache
+byte math (``fleet/modelcache.resident_bytes``), and a binary SV or
+approx model whose packed buffers exceed the budget is served through
+this path when ≥2 devices are visible. Precomputed-kernel models
+(host NumPy gather, nothing device-resident) and the multiclass
+SegmentPack collapse stay on their existing paths.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dpsvm_tpu.observability import compilewatch
+
+__all__ = ["ShardedDecider", "model_bytes_est", "eligible"]
+
+
+# -- byte estimates (the --hbm-budget-mb decision) ---------------------
+
+def model_bytes_est(model) -> int:
+    """Estimated device-resident bytes of the PACKED decision buffers.
+
+    Same arithmetic as ``fleet/modelcache.resident_bytes`` for SV
+    models — ``n_sv * (d + 2) * 4`` (SV rows + coef + squared norms,
+    f32) — extended to the approx kinds (omega / landmarks+proj + w)
+    and summed over pairs for multiclass directories. Query blocks and
+    outputs are ladder-bounded and excluded, as in the cache math."""
+    if getattr(model, "is_approx", False):
+        fmap = model.fmap
+        dim = int(fmap.dim)
+        if fmap.kind == "rff":
+            # omega (d, dim/2) + w (dim,)
+            return (int(fmap.d) * (dim // 2) + dim) * 4
+        n_land = int(fmap.landmarks.shape[0])
+        # landmarks (L, d) + their norms (L,) + proj (L, dim) + w (dim,)
+        return (n_land * int(fmap.d) + n_land + n_land * dim + dim) * 4
+    if getattr(model, "models", None) is not None:       # multiclass
+        return int(sum(model_bytes_est(m) for m in model.models))
+    if model.kernel == "precomputed":
+        # host-side gather: coef + SV indices, nothing device-resident
+        return int(model.n_sv) * (4 + 8)
+    d = int(model.x_sv.shape[1])
+    return int(model.n_sv) * (d + 2) * 4
+
+
+def eligible(model) -> bool:
+    """Can this model's decision program be mesh-sharded? Binary SV
+    models with real (non-precomputed) kernels shard the SV axis;
+    approx models shard the feature axis. Precomputed models have no
+    device buffers to shard; multiclass directories are handled
+    per-pair by the engine."""
+    if getattr(model, "models", None) is not None:
+        return False
+    if getattr(model, "is_approx", False):
+        return True
+    return model.kernel != "precomputed"
+
+
+# -- the one definition of each partial program ------------------------
+# The mesh-local function and the unsharded reference fold call the
+# SAME math at the same block shapes, which is what makes the
+# psum-vs-in-order-fold parity gate meaningful.
+
+def _sv_partial_math(x, sv_blk, coef_blk, sv2_blk, gamma, coef0,
+                     kind: str, degree: int, precision):
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import (KernelSpec, kernel_rows,
+                                       row_norms_sq)
+    spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
+    t2 = row_norms_sq(x)
+    k = kernel_rows(x, t2, sv_blk, sv2_blk, spec, precision=precision)
+    return jnp.matmul(k, coef_blk, precision=precision)
+
+
+def _rff_partial_math(x, omega_blk, w_blk, scale, precision):
+    import jax.numpy as jnp
+    z = jnp.matmul(x, omega_blk, precision=precision)
+    phi = scale * jnp.concatenate([jnp.cos(z), jnp.sin(z)], axis=1)
+    return jnp.matmul(phi, w_blk, precision=precision)
+
+
+def _nystrom_partial_math(x, landmarks, l2, proj_blk, w_blk, gamma,
+                          coef0, kind: str, degree: int, precision):
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import (KernelSpec, kernel_rows,
+                                       row_norms_sq)
+    spec = KernelSpec(kind=kind, gamma=gamma, coef0=coef0, degree=degree)
+    x2 = row_norms_sq(x)
+    k = kernel_rows(x, x2, landmarks, l2, spec, precision=precision)
+    phi = jnp.matmul(k, proj_blk, precision=precision)
+    return jnp.matmul(phi, w_blk, precision=precision)
+
+
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    """Zero-pad axis 0 up to a multiple of n."""
+    rem = (-a.shape[0]) % n
+    if rem == 0:
+        return a
+    pad = [(0, rem)] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+def _pad_cols(a: np.ndarray, n: int) -> np.ndarray:
+    """Zero-pad axis 1 up to a multiple of n."""
+    rem = (-a.shape[1]) % n
+    if rem == 0:
+        return a
+    return np.pad(a, ((0, 0), (0, rem)))
+
+
+class ShardedDecider:
+    """``block -> decisions`` over a device mesh (module docstring).
+
+    Drop-in for the engine's per-block deciders: takes the ladder's
+    zero-padded ``(bucket, d)`` float32 block, returns the ``(bucket,)``
+    decision values with the intercept applied per ``include_b``.
+    ``reference(block)`` runs the SAME blocked program unsharded on the
+    default device with an in-order partial fold — the bitwise parity
+    target. Build once per model; the jitted mesh program is warmed per
+    ladder bucket by the engine like any other decider.
+    """
+
+    def __init__(self, model, *, include_b: bool = True,
+                 precision_name: str = "HIGHEST",
+                 shards: Optional[int] = None, devices=None,
+                 tag: str = "sharded"):
+        import jax
+
+        n_dev = len(devices if devices is not None else jax.devices())
+        self.n_shards = int(shards) if shards else n_dev
+        if self.n_shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.include_b = bool(include_b)
+        self._pname = str(precision_name)
+        self._precision = getattr(jax.lax.Precision, self._pname)
+        self._b = np.float32(getattr(model, "b", 0.0))
+        self.is_approx = bool(getattr(model, "is_approx", False))
+        self.axis = "feature" if self.is_approx else "sv"
+        self.resident_bytes_est = model_bytes_est(model)
+        if self.is_approx:
+            self._build_approx(model, devices)
+        else:
+            self._build_sv(model, devices)
+        self._run = compilewatch.instrument(self._fn, tag)
+
+    # -- builders ------------------------------------------------------
+
+    def _mesh(self, devices):
+        from dpsvm_tpu.parallel.mesh import make_data_mesh
+        return make_data_mesh(self.n_shards, devices)
+
+    def _build_sv(self, model, devices) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from dpsvm_tpu.ops.kernels import row_norms_sq
+        from dpsvm_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat
+
+        x_sv = _pad_rows(np.asarray(model.x_sv, np.float32),
+                         self.n_shards)
+        coef = _pad_rows(np.asarray(model.alpha, np.float32)
+                         * np.asarray(model.y_sv, np.float32),
+                         self.n_shards)
+        self.orig_len = int(model.n_sv)
+        self.padded_len = int(x_sv.shape[0])
+        # squared norms of the PADDED rows (padding rows are zero, so
+        # their norm is exactly 0.0) — per-row math, so each shard's
+        # slice equals what it would compute locally
+        sv2 = np.asarray(row_norms_sq(jnp.asarray(x_sv)))
+        mesh = self._mesh(devices)
+        row = NamedSharding(mesh, P(SHARD_AXIS))
+        self._operands = (
+            jax.device_put(x_sv, row),
+            jax.device_put(coef, row),
+            jax.device_put(sv2, row),
+        )
+        # host copies for reference() — test-path only, never shipped
+        self._host_operands = (x_sv, coef, sv2)
+        kind, degree = model.kernel, int(model.degree)
+        gamma = float(model.gamma)
+        coef0 = float(model.coef0)
+        include_b, b = self.include_b, self._b
+        precision = self._precision
+
+        def local(x, sv_blk, coef_blk, sv2_blk):
+            partial = _sv_partial_math(x, sv_blk, coef_blk, sv2_blk,
+                                       gamma, coef0, kind, degree,
+                                       precision)
+            dual = lax.psum(partial, SHARD_AXIS)
+            return dual - b if include_b else dual
+
+        self._fn = jax.jit(shard_map_compat(
+            local, mesh=mesh,
+            in_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=P()))
+
+        def ref_partial(x, k):
+            lo = k * (self.padded_len // self.n_shards)
+            hi = lo + self.padded_len // self.n_shards
+            return _sv_ref_jit(x, jnp.asarray(x_sv[lo:hi]),
+                               jnp.asarray(coef[lo:hi]),
+                               jnp.asarray(sv2[lo:hi]),
+                               jnp.float32(gamma), jnp.float32(coef0),
+                               kind=kind, degree=degree,
+                               precision_name=self._pname)
+
+        self._ref_partial = ref_partial
+
+    def _build_approx(self, model, devices) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from dpsvm_tpu.ops.kernels import row_norms_sq
+        from dpsvm_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat
+
+        fmap = model.fmap
+        mesh = self._mesh(devices)
+        include_b, b = self.include_b, self._b
+        precision = self._precision
+        n = self.n_shards
+        w = np.asarray(model.w, np.float32)
+        self.orig_len = int(fmap.dim)
+
+        if fmap.kind == "rff":
+            # shard the dim/2 omega columns; each shard's weight slice
+            # is [w_cos block | w_sin block] so its local program IS
+            # the single-device featurize-and-dot, just narrower. The
+            # scale is the GLOBAL sqrt(2/dim) — fixed at the unpadded
+            # feature count (see module docstring).
+            d2 = int(fmap.omega.shape[1])
+            omega = _pad_cols(np.asarray(fmap.omega, np.float32), n)
+            d2p = int(omega.shape[1])
+            self.padded_len = 2 * d2p
+            w_cos = _pad_rows(w[:d2], n)
+            w_sin = _pad_rows(w[d2:], n)
+            c = d2p // n
+            w_perm = np.concatenate(
+                [np.concatenate([w_cos[k * c:(k + 1) * c],
+                                 w_sin[k * c:(k + 1) * c]])
+                 for k in range(n)])
+            scale = np.float32(math.sqrt(2.0 / (2 * d2)))
+            col = NamedSharding(mesh, P(None, SHARD_AXIS))
+            row = NamedSharding(mesh, P(SHARD_AXIS))
+            self._operands = (jax.device_put(omega, col),
+                              jax.device_put(w_perm, row))
+            self._host_operands = (omega, w_perm)
+
+            def local(x, omega_blk, w_blk):
+                partial = _rff_partial_math(x, omega_blk, w_blk, scale,
+                                            precision)
+                dual = lax.psum(partial, SHARD_AXIS)
+                return dual - b if include_b else dual
+
+            self._fn = jax.jit(shard_map_compat(
+                local, mesh=mesh,
+                in_specs=(P(), P(None, SHARD_AXIS), P(SHARD_AXIS)),
+                out_specs=P()))
+
+            def ref_partial(x, k):
+                return _rff_ref_jit(
+                    x, jnp.asarray(omega[:, k * c:(k + 1) * c]),
+                    jnp.asarray(w_perm[k * 2 * c:(k + 1) * 2 * c]),
+                    scale, precision_name=self._pname)
+
+            self._ref_partial = ref_partial
+            return
+
+        # nystrom: landmarks replicated, projection columns + w sharded
+        landmarks = np.asarray(fmap.landmarks, np.float32)
+        proj = _pad_cols(np.asarray(fmap.proj, np.float32), n)
+        w_pad = _pad_rows(w, n)
+        self.padded_len = int(proj.shape[1])
+        c = self.padded_len // n
+        l2 = np.asarray(row_norms_sq(jnp.asarray(landmarks)))
+        rep = NamedSharding(mesh, P())
+        col = NamedSharding(mesh, P(None, SHARD_AXIS))
+        row = NamedSharding(mesh, P(SHARD_AXIS))
+        self._operands = (jax.device_put(landmarks, rep),
+                          jax.device_put(l2, rep),
+                          jax.device_put(proj, col),
+                          jax.device_put(w_pad, row))
+        self._host_operands = (landmarks, l2, proj, w_pad)
+        kind, degree = fmap.kernel, int(fmap.degree)
+        gamma, coef0 = float(fmap.gamma), float(fmap.coef0)
+
+        def local(x, lm, lm2, proj_blk, w_blk):
+            partial = _nystrom_partial_math(x, lm, lm2, proj_blk,
+                                            w_blk, gamma, coef0, kind,
+                                            degree, precision)
+            dual = lax.psum(partial, SHARD_AXIS)
+            return dual - b if include_b else dual
+
+        self._fn = jax.jit(shard_map_compat(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P(None, SHARD_AXIS),
+                      P(SHARD_AXIS)),
+            out_specs=P()))
+
+        def ref_partial(x, k):
+            return _nystrom_ref_jit(
+                x, jnp.asarray(landmarks), jnp.asarray(l2),
+                jnp.asarray(proj[:, k * c:(k + 1) * c]),
+                jnp.asarray(w_pad[k * c:(k + 1) * c]),
+                jnp.float32(gamma), jnp.float32(coef0),
+                kind=kind, degree=degree, precision_name=self._pname)
+
+        self._ref_partial = ref_partial
+
+    # -- evaluation ----------------------------------------------------
+
+    def __call__(self, block: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        return np.asarray(self._run(jnp.asarray(block),
+                                    *self._operands))
+
+    decide = __call__
+
+    def reference(self, block: np.ndarray) -> np.ndarray:
+        """The SAME blocked decision, unsharded: every shard's partial
+        computed in shard-index order on the default device and folded
+        with in-order f32 adds — bitwise what ``psum`` produces on the
+        mesh (the parity gate of the tests and the serving selfcheck).
+        """
+        import jax.numpy as jnp
+        x = jnp.asarray(np.asarray(block, np.float32))
+        acc: Optional[np.ndarray] = None
+        for k in range(self.n_shards):
+            p = np.asarray(self._ref_partial(x, k))
+            acc = p if acc is None else acc + p
+        if self.include_b:
+            acc = acc - self._b
+        return acc
+
+    def facts(self) -> dict:
+        """Manifest block (serving/engine.py manifest, /v1/models)."""
+        return {
+            "sharded": True,
+            "shard_axis": self.axis,
+            "shards": self.n_shards,
+            "padded_len": self.padded_len,
+            "orig_len": self.orig_len,
+            "resident_bytes_est": int(self.resident_bytes_est),
+            "per_device_bytes_est":
+                int(self.resident_bytes_est // self.n_shards),
+        }
+
+
+# -- reference-fold jits (test path; one per partial program) ----------
+
+@functools.partial(jax.jit,
+                   static_argnames=("kind", "degree", "precision_name"))
+def _sv_ref_jit(x, sv_blk, coef_blk, sv2_blk, gamma, coef0, kind: str,
+                degree: int, precision_name: str):
+    return _sv_partial_math(x, sv_blk, coef_blk, sv2_blk, gamma, coef0,
+                            kind, degree,
+                            getattr(jax.lax.Precision, precision_name))
+
+
+@functools.partial(jax.jit, static_argnames=("precision_name",))
+def _rff_ref_jit(x, omega_blk, w_blk, scale, precision_name: str):
+    return _rff_partial_math(x, omega_blk, w_blk, scale,
+                             getattr(jax.lax.Precision, precision_name))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kind", "degree", "precision_name"))
+def _nystrom_ref_jit(x, landmarks, l2, proj_blk, w_blk, gamma, coef0,
+                     kind: str, degree: int, precision_name: str):
+    return _nystrom_partial_math(
+        x, landmarks, l2, proj_blk, w_blk, gamma, coef0, kind, degree,
+        getattr(jax.lax.Precision, precision_name))

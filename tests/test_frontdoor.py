@@ -1,0 +1,605 @@
+"""Async front door + weighted-fair admission tests
+(docs/SERVING.md "Front door").
+
+What must hold, per component:
+
+* fairqueue  — DRR service ratio between backlogged lanes follows the
+               configured weights (8:1 pinned deterministically via
+               drr_schedule), a weight-1 lane is never starved (its
+               first service lands within one round's row bound), FIFO
+               within a lane, per-lane capacity rejects ONLY the hot
+               tenant, stats/depths shapes.
+* frontdoor  — the async transport answers BITWISE what the threaded
+               server answers for the same model file, maps every
+               error identically (400/404/413/429), grows the span
+               chain with the ``fair_queue`` stage, enforces the
+               connection cap with an immediate 503, reloads, and
+               drains on SIGTERM in a real process (rc 0, everything
+               accepted answered).
+* loadgen    — ``--connections N`` holds N open sockets through the
+               run and reports the achieved count in the row.
+* doctor     — ``--serving-url`` reports the front-end kind, open
+               connections, fair-queue lanes, and WARNs near the cap.
+* soak       — (slow) thousands of idle connections held on the one
+               event loop without thousands of threads, requests still
+               round-tripping — the reason this subsystem exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk_model(n_sv=40, d=5, seed=0, b=0.2, gamma=0.5, task="svc"):
+    from dpsvm_tpu.models.svm import SVMModel
+    rng = np.random.default_rng(seed)
+    return SVMModel(
+        x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+        alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+        y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(np.int32),
+        b=b, gamma=gamma, task=task)
+
+
+def _rows(n, d, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, d)).astype(np.float32)
+
+
+def _post(url, payload, timeout=15.0, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=hdrs,
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _get(url, timeout=15.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+# ---------------------------------------------------------------------
+# fair queue: DRR properties (deterministic, no server)
+# ---------------------------------------------------------------------
+
+def test_parse_tenant_weights():
+    from dpsvm_tpu.serving.fairqueue import parse_tenant_weights
+    assert parse_tenant_weights(["gold=8", "bronze=1.5"]) == {
+        "gold": 8.0, "bronze": 1.5}
+    assert parse_tenant_weights([]) == {}
+    assert parse_tenant_weights(None) == {}
+    with pytest.raises(ValueError, match="NAME=WEIGHT"):
+        parse_tenant_weights(["gold"])
+    with pytest.raises(ValueError, match="NAME=WEIGHT"):
+        parse_tenant_weights(["=3"])
+    with pytest.raises(ValueError, match="number"):
+        parse_tenant_weights(["gold=lots"])
+    with pytest.raises(ValueError, match="> 0"):
+        parse_tenant_weights(["gold=0"])
+    with pytest.raises(ValueError, match="> 0"):
+        parse_tenant_weights(["gold=-2"])
+
+
+def test_drr_service_ratio_follows_weights():
+    """Both lanes backlogged with EQUAL arrivals: service follows the
+    8:1 weights, not the 1:1 arrival ratio. With quantum=8 one full
+    round serves 64 gold rows + 8 bronze rows, so any 72-row service
+    window holds 64 gold rows (exactly, up to round phase)."""
+    from dpsvm_tpu.serving.fairqueue import drr_schedule
+    pushes = [("gold", 1)] * 160 + [("bronze", 1)] * 160
+    order = drr_schedule(pushes, {"gold": 8.0, "bronze": 1.0},
+                         quantum=8)
+    assert len(order) == 320                     # conservation
+    assert sum(r for _, r in order) == 320
+    # while BOTH lanes are backlogged (first two full rounds = 144
+    # rows), the gold share is 64 of every 72
+    first = order[:72]
+    assert sum(1 for t, _ in first if t == "gold") == 64
+    second = order[72:144]
+    assert sum(1 for t, _ in second if t == "gold") == 64
+    # once gold drains (160 rows = 2.5 rounds in), bronze gets the
+    # tail to itself — everything is eventually served
+    assert sum(1 for t, _ in order if t == "bronze") == 160
+
+
+def test_drr_rows_are_the_service_unit_not_requests():
+    """A tenant batching 16 rows per request cannot 16x its share:
+    equal weights must split ROWS evenly however requests are shaped."""
+    from dpsvm_tpu.serving.fairqueue import drr_schedule
+    pushes = [("batchy", 16)] * 10 + [("single", 1)] * 160
+    order = drr_schedule(pushes, {}, quantum=16)
+    served = {"batchy": 0, "single": 0}
+    window = []
+    for t, r in order:
+        if served["batchy"] < 160 and served["single"] < 160:
+            window.append((t, r))
+        served[t] += r
+    rows = {"batchy": sum(r for t, r in window if t == "batchy"),
+            "single": sum(r for t, r in window if t == "single")}
+    # equal weights, both backlogged: row shares within one quantum
+    assert abs(rows["batchy"] - rows["single"]) <= 16, rows
+
+
+def test_drr_starvation_freedom_bound():
+    """A weight-1 lane behind a 16x-weighted flood is served within
+    ONE round: at most quantum * sum(weights) rows go before its first
+    request — the docstring bound, pinned exactly."""
+    from dpsvm_tpu.serving.fairqueue import drr_schedule
+    q = 8
+    pushes = [("hog", 1)] * 800 + [("meek", 1)] * 4
+    order = drr_schedule(pushes, {"hog": 16.0, "meek": 1.0}, quantum=q)
+    rows_before_meek = 0
+    for t, r in order:
+        if t == "meek":
+            break
+        rows_before_meek += r
+    assert rows_before_meek <= q * (16 + 1), rows_before_meek
+    # and FIFO within the meek lane: its 4 rows keep arrival order
+    # (items are indices in drr_schedule, so order == row count here)
+    meek_positions = [i for i, (t, _) in enumerate(order)
+                      if t == "meek"]
+    assert len(meek_positions) == 4
+
+
+def test_fairqueue_lane_capacity_rejects_only_hot_tenant():
+    from dpsvm_tpu.serving.fairqueue import FairQueue, LaneFullError
+    fq = FairQueue(weights={"hot": 4.0}, lane_capacity=10)
+    fq.push("hot", "a", 6)
+    fq.push("hot", "b", 4)                       # exactly at capacity
+    with pytest.raises(LaneFullError, match="hot"):
+        fq.push("hot", "c", 1)                   # hot lane full
+    fq.push("cold", "d", 10)                     # cold lane untouched
+    with pytest.raises(ValueError):
+        fq.push("cold", "e", 0)                  # rows must be >= 1
+    assert fq.rows_queued == 20
+    assert fq.depths() == {"cold": 10, "hot": 10}
+    st = fq.stats()
+    assert st["lane_capacity_rows"] == 10
+    assert st["lanes"]["hot"]["rejected"] == 1
+    assert st["lanes"]["hot"]["pushed"] == 2
+    assert st["lanes"]["cold"]["rejected"] == 0
+    assert fq.oldest_age_s() >= 0.0
+    # drop() removes matching items and fixes the row accounting
+    assert fq.drop(lambda item: item == "a") == 6
+    assert fq.rows_queued == 14
+    order = []
+    while True:
+        got = fq.pop()
+        if got is None:
+            break
+        order.append(got[1])
+    assert sorted(order) == ["b", "d"]
+    assert fq.pop() is None
+    assert len(fq) == 0
+
+
+def test_fairqueue_oversized_request_carries_deficit():
+    """A request larger than one quantum is served after enough rounds
+    accumulate deficit — big batches are slowed, never starved."""
+    from dpsvm_tpu.serving.fairqueue import drr_schedule
+    pushes = [("big", 40)] + [("small", 1)] * 64
+    order = drr_schedule(pushes, {}, quantum=8)
+    assert ("big", 40) in order
+    assert sum(r for _, r in order) == 104
+
+
+# ---------------------------------------------------------------------
+# async front door (in-process): parity, errors, spans, cap, reload
+# ---------------------------------------------------------------------
+
+@pytest.fixture()
+def front_door(tmp_path):
+    """A threaded server and an async front door over the SAME model
+    file, in one process — the parity pair."""
+    from dpsvm_tpu.models.calibration import save_platt
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import AsyncFrontDoor, ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    model = _mk_model(seed=21)
+    path = str(tmp_path / "m.svm")
+    save_model(model, path)
+    save_platt(path, -1.0, 0.0)
+
+    reg_t = ModelRegistry()
+    reg_t.register("default", path, max_batch=8)
+    thr = ServingServer(reg_t, port=0, max_batch=8, max_delay_ms=1.0,
+                        max_queue=64).start()
+
+    reg_a = ModelRegistry()
+    reg_a.register("default", path, max_batch=8)
+    core = ServingServer(reg_a, port=0, max_batch=8, max_delay_ms=1.0,
+                         max_queue=64)
+    fd = AsyncFrontDoor(core, max_connections=64,
+                        tenant_weights={"gold": 8.0}).start()
+    yield fd, thr, model, path
+    fd.drain(timeout=10.0)
+    thr.drain(timeout=10.0)
+
+
+def test_async_bitwise_parity_with_threaded(front_door):
+    fd, thr, _model, _path = front_door
+    q = _rows(7, 5, seed=22)
+    payload = {"instances": q.tolist(),
+               "return": ["labels", "decision", "proba"]}
+    code_a, a = _post(fd.url + "/v1/predict", payload)
+    code_t, t = _post(thr.url + "/v1/predict", payload)
+    assert code_a == code_t == 200
+    assert a["labels"] == t["labels"]
+    assert a["decision"] == t["decision"]        # bitwise via json repr
+    assert a["proba"] == t["proba"]
+    assert a["model"] == "default" and a["n"] == 7
+
+
+def test_async_error_mapping_parity(front_door):
+    fd, thr, _model, _path = front_door
+    cases = [
+        ({}, None),                                       # no instances
+        ({"instances": [[1, 2, None, 4, 5]]}, None),      # non-numeric
+        ({"instances": [[float("nan")] * 5]}, None),      # non-finite
+        ({"instances": _rows(2, 3).tolist()}, None),      # wrong width
+        ({"model": "ghost", "instances": [[0] * 5]}, None),  # 404
+        ({"instances": [[0] * 5], "return": ["nope"]}, None),  # unknown
+        ({"instances": _rows(65, 5).tolist()}, None),     # > max_queue
+    ]
+    for payload, _ in cases:
+        code_a, body_a = _post(fd.url + "/v1/predict", payload)
+        code_t, body_t = _post(thr.url + "/v1/predict", payload)
+        assert code_a == code_t, (payload.keys(), body_a, body_t)
+        assert code_a in (400, 404, 413)
+        assert "error" in body_a
+    code, _ = _get(fd.url + "/nope")
+    assert code == 404
+
+
+def test_async_span_chain_includes_fair_queue_stage(front_door):
+    fd, _thr, _model, _path = front_door
+    code, body = _post(fd.url + "/v1/predict",
+                       {"instances": _rows(3, 5).tolist()},
+                       headers={"X-Trace-Spans": "1"})
+    assert code == 200
+    spans = body.get("spans")
+    assert spans, body.keys()
+    for stage in ("fair_queue", "queue_wait", "batch_form",
+                  "device_dispatch", "respond"):
+        assert stage in spans, (stage, sorted(spans))
+    assert spans["total_ms"] > 0
+
+
+def test_async_metrics_expose_front_door_and_lanes(front_door):
+    fd, thr, _model, _path = front_door
+    # traffic on two tenants so both lanes exist
+    for tenant in ("gold", "bronze"):
+        code, _ = _post(fd.url + "/v1/predict",
+                        {"instances": _rows(2, 5).tolist()},
+                        headers={"X-Tenant": tenant})
+        assert code == 200
+    code, m = _get(fd.url + "/metricsz")
+    assert code == 200
+    fdm = m["front_door"]
+    assert fdm["kind"] == "async"
+    assert fdm["max_connections"] == 64
+    assert fdm["connections_accepted"] >= 3
+    assert fdm["tenant_weights"] == {"gold": 8.0}
+    lanes = fdm["fair_queue"]["lanes"]
+    assert lanes["gold"]["weight"] == 8.0
+    assert lanes["gold"]["served"] >= 1
+    assert lanes["bronze"]["weight"] == 1.0
+    # the threaded server reports its kind too
+    code, mt = _get(thr.url + "/metricsz")
+    assert code == 200 and mt["front_door"] == {"kind": "threaded"}
+    # prometheus exposition carries the front-door gauges
+    with urllib.request.urlopen(fd.url + "/metricsz?format=prometheus",
+                                timeout=10) as r:
+        text = r.read().decode()
+    assert "dpsvm_frontdoor_open_connections" in text
+    assert 'dpsvm_frontdoor_queue_lane_rows{tenant="gold"}' in text
+
+
+def test_async_reload_swaps_generation(front_door):
+    import dataclasses
+    from dpsvm_tpu.models.io import save_model
+    fd, _thr, model, path = front_door
+    q = _rows(2, 5, seed=23)
+    _, before = _post(fd.url + "/v1/predict", {"instances": q.tolist(),
+                                               "return": ["decision"]})
+    save_model(dataclasses.replace(model, b=model.b + 2.0), path)
+    code, body = _post(fd.url + "/v1/reload", {"model": "default"})
+    assert code == 200 and body["manifest"]["generation"] == 2
+    _, after = _post(fd.url + "/v1/predict", {"instances": q.tolist(),
+                                              "return": ["decision"]})
+    np.testing.assert_allclose(after["decision"],
+                               np.asarray(before["decision"]) - 2.0,
+                               atol=1e-6)
+    code, _ = _post(fd.url + "/v1/reload", {"model": "ghost"})
+    assert code == 404
+
+
+def test_async_connection_cap_immediate_503(tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import AsyncFrontDoor, ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    path = str(tmp_path / "m.svm")
+    save_model(_mk_model(seed=24), path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    fd = AsyncFrontDoor(ServingServer(reg, port=0, max_batch=8,
+                                      max_delay_ms=1.0, max_queue=64),
+                        max_connections=3).start()
+    held = []
+    try:
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", fd.port),
+                                         timeout=10)
+            held.append(s)
+        time.sleep(0.2)                          # let accepts land
+        s4 = socket.create_connection(("127.0.0.1", fd.port),
+                                      timeout=10)
+        try:
+            s4.settimeout(10)
+            raw = s4.recv(65536)
+            assert b"503" in raw.split(b"\r\n", 1)[0], raw[:200]
+            assert b"connection limit" in raw
+        finally:
+            s4.close()
+        for s in held:
+            s.close()
+        held = []
+        # capacity frees up: normal requests work again
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            code, _ = _get(fd.url + "/healthz")
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200
+        _, m = _get(fd.url + "/metricsz")
+        assert m["front_door"]["connections_rejected"] >= 1
+    finally:
+        for s in held:
+            s.close()
+        fd.drain(timeout=10.0)
+
+
+def test_async_concurrent_multi_tenant_traffic(front_door):
+    """32 concurrent requests across 4 tenants all answered 200 with
+    per-request parity against decision_function — coalescing through
+    the fair queue changes NOTHING about any answer."""
+    from dpsvm_tpu.models.svm import decision_function
+    fd, _thr, model, _path = front_door
+    results = [None] * 32
+    lock = threading.Lock()
+
+    def fire(i):
+        q = _rows(1 + i % 5, 5, seed=100 + i)
+        code, body = _post(
+            fd.url + "/v1/predict",
+            {"instances": q.tolist(), "return": ["decision"]},
+            headers={"X-Tenant": f"t{i % 4}"})
+        with lock:
+            results[i] = (code, body, q)
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    for i, r in enumerate(results):
+        assert r is not None, f"request {i} never finished"
+        code, body, q = r
+        assert code == 200, body
+        np.testing.assert_allclose(body["decision"],
+                                   decision_function(model, q),
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------
+# process-level: SIGTERM drain on the async front end
+# ---------------------------------------------------------------------
+
+def _serve_proc(tmp_path, model_path, extra=()):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    port_file = tmp_path / "port.txt"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "dpsvm_tpu.cli", "serve", "-m",
+         model_path, "--port", "0", "--port-file", str(port_file),
+         "--max-batch", "16", *extra],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if port_file.exists() and port_file.read_text().strip():
+            break
+        if p.poll() is not None:
+            raise AssertionError(f"serve died: {p.communicate()[1]}")
+        time.sleep(0.2)
+    else:
+        p.kill()
+        raise AssertionError("serve never wrote its port file")
+    return p, int(port_file.read_text())
+
+
+def test_async_serve_sigterm_drains_and_exits_zero(tmp_path):
+    """SIGTERM mid-traffic on `serve --front-end async`: every
+    accepted request answered, rc 0 — the threaded drain contract,
+    honoured by the event-loop transport (fair queue empties BEFORE
+    the batchers close)."""
+    from dpsvm_tpu.models.io import save_model
+    path = str(tmp_path / "m.svm")
+    save_model(_mk_model(seed=25), path)
+    p, port = _serve_proc(tmp_path, path,
+                          extra=("--front-end", "async",
+                                 "--tenant-weight", "gold=8"))
+    url = f"http://127.0.0.1:{port}"
+    results, lock = [], threading.Lock()
+
+    def fire(i):
+        try:
+            code, _ = _post(url + "/v1/predict",
+                            {"instances": _rows(3, 5, seed=i).tolist()},
+                            timeout=30.0,
+                            headers={"X-Tenant":
+                                     "gold" if i % 2 else "bronze"})
+        except (urllib.error.URLError, ConnectionError, OSError):
+            code = -1                       # refused AFTER drain began
+        with lock:
+            results.append(code)
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(12)]
+    for t in threads[:6]:
+        t.start()
+    p.send_signal(signal.SIGTERM)
+    for t in threads[6:]:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    out, err = p.communicate(timeout=60)
+    assert p.returncode == 0, err[-2000:]
+    assert "drained" in err
+    assert "async front end" in err, err[-2000:]
+    assert len(results) == 12
+    assert all(c in (200, 503, -1) for c in results), results
+    assert any(c == 200 for c in results)
+
+
+# ---------------------------------------------------------------------
+# loadgen --connections and the doctor probe
+# ---------------------------------------------------------------------
+
+def test_loadgen_holds_connections_and_reports_count(front_door):
+    from dpsvm_tpu.serving.loadgen import run_loadgen
+    fd, _thr, _model, _path = front_door
+    row = run_loadgen(fd.url, _rows(64, 5), requests=40, concurrency=4,
+                      connections=12, timeout=15.0)
+    assert row["open_connections"] == 12
+    assert row["errors"] == 0
+    assert row["throughput_rps"] > 0
+    _, m = _get(fd.url + "/metricsz")
+    assert m["front_door"]["connections_accepted"] >= 12
+    # connections=0 keeps the row shape unchanged (no phantom field)
+    row0 = run_loadgen(fd.url, _rows(16, 5), requests=8, concurrency=2,
+                       timeout=15.0)
+    assert "open_connections" not in row0
+    with pytest.raises(ValueError):
+        run_loadgen(fd.url, _rows(4, 5), requests=2, connections=-1)
+
+
+def test_doctor_probe_reports_front_door(front_door):
+    from dpsvm_tpu.resilience.doctor import _serving_tenant_probe
+    fd, thr, _model, _path = front_door
+    _post(fd.url + "/v1/predict", {"instances": _rows(2, 5).tolist()},
+          headers={"X-Tenant": "gold"})
+    lines = []
+    _serving_tenant_probe(fd.url, lines.append)
+    text = "\n".join(lines)
+    assert "front end: async" in text
+    assert "/64 connections open" in text
+    assert "fair-queue lanes" in text
+    assert "gold" in text and "w=8.0" in text
+    # threaded server: the probe names the kind and the upgrade hint
+    lines_t = []
+    _serving_tenant_probe(thr.url, lines_t.append)
+    assert "front end: threaded" in "\n".join(lines_t)
+    assert "--front-end async" in "\n".join(lines_t)
+
+
+def test_doctor_probe_warns_near_connection_cap(tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.resilience.doctor import _serving_tenant_probe
+    from dpsvm_tpu.serving import AsyncFrontDoor, ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    path = str(tmp_path / "m.svm")
+    save_model(_mk_model(seed=26), path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=8)
+    fd = AsyncFrontDoor(ServingServer(reg, port=0, max_batch=8,
+                                      max_delay_ms=1.0, max_queue=64),
+                        max_connections=10).start()
+    held = []
+    try:
+        for _ in range(8):                 # probe's own conn is the 9th
+            held.append(socket.create_connection(
+                ("127.0.0.1", fd.port), timeout=10))
+        time.sleep(0.2)
+        lines = []
+        _serving_tenant_probe(fd.url, lines.append)
+        text = "\n".join(lines)
+        assert "WARNING open connections near the cap" in text
+        assert "--max-connections" in text
+    finally:
+        for s in held:
+            s.close()
+        fd.drain(timeout=10.0)
+
+
+# ---------------------------------------------------------------------
+# slow: the 2k-connection soak the subsystem exists for
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_async_two_thousand_idle_connections_soak(tmp_path):
+    """2000 idle sockets held open on ONE event loop: thread count
+    stays flat (no thread-per-connection), the gauge sees them, and a
+    predict request still round-trips underneath the idle herd."""
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import AsyncFrontDoor, ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+
+    path = str(tmp_path / "m.svm")
+    save_model(_mk_model(seed=27), path)
+    reg = ModelRegistry()
+    reg.register("default", path, max_batch=16)
+    fd = AsyncFrontDoor(ServingServer(reg, port=0, max_batch=16,
+                                      max_delay_ms=1.0, max_queue=256),
+                        max_connections=4000).start()
+    threads_before = threading.active_count()
+    held = []
+    try:
+        for _ in range(2000):
+            held.append(socket.create_connection(
+                ("127.0.0.1", fd.port), timeout=10))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            _, m = _get(fd.url + "/metricsz")
+            if m["front_door"]["open_connections"] >= 2000:
+                break
+            time.sleep(0.2)
+        assert m["front_door"]["open_connections"] >= 2000
+        # the whole point: 2000 connections did NOT cost 2000 threads
+        assert threading.active_count() <= threads_before + 10
+        q = _rows(5, 5, seed=28)
+        code, body = _post(fd.url + "/v1/predict",
+                           {"instances": q.tolist()}, timeout=30.0)
+        assert code == 200 and body["n"] == 5
+    finally:
+        for s in held:
+            s.close()
+        fd.drain(timeout=30.0)

@@ -1,0 +1,269 @@
+"""Mesh-sharded inference tests (docs/SERVING.md "Front door",
+serving/sharded.py) — run under the suite-wide 8-virtual-device CPU
+mesh (tests/conftest.py sets xla_force_host_platform_device_count=8).
+
+What must hold:
+
+* eligibility — binary SV models with real kernels and approx models
+  shard; precomputed and multiclass directories never do; the byte
+  estimate matches the model-cache arithmetic.
+* parity — the mesh psum is BITWISE equal to ``reference()`` (the
+  same blocked program folded in shard order on one device) for SV,
+  RFF and Nystrom models, and allclose (f32 reassociation only) to
+  the classic single-matmul decision_function.
+* engine — ``hbm_budget_mb`` selects the sharded path exactly when
+  the packed buffers exceed it, the manifest says so, answers stay
+  bitwise equal to the decider's reference and allclose to an
+  unsharded engine, and post-warmup traffic never retraces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def _mk_model(n_sv=40, d=5, seed=0, b=0.2, gamma=0.5, task="svc",
+              kernel="rbf"):
+    from dpsvm_tpu.models.svm import SVMModel
+    rng = np.random.default_rng(seed)
+    return SVMModel(
+        x_sv=rng.standard_normal((n_sv, d)).astype(np.float32),
+        alpha=rng.uniform(0.05, 2.0, n_sv).astype(np.float32),
+        y_sv=np.where(rng.random(n_sv) < 0.5, -1, 1).astype(np.int32),
+        b=b, gamma=gamma, task=task, kernel=kernel)
+
+
+def _mk_approx(kind, n=120, d=6, dim=64, seed=3, gamma=0.7, b=0.1):
+    from dpsvm_tpu.approx.features import build_feature_map
+    from dpsvm_tpu.approx.model import ApproxSVMModel
+    from dpsvm_tpu.ops.kernels import KernelSpec
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    fmap = build_feature_map(kind, x, dim, seed,
+                             KernelSpec(kind="rbf", gamma=gamma))
+    w = rng.standard_normal(fmap.dim).astype(np.float32)
+    return ApproxSVMModel(fmap=fmap, w=w, b=b, task="svc")
+
+
+def _rows(n, d, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, d)).astype(np.float32)
+
+
+def _need_mesh():
+    if len(jax.devices()) < 2:
+        pytest.skip("sharded path needs >= 2 devices "
+                    "(conftest provides 8 virtual CPU devices)")
+
+
+# ---------------------------------------------------------------------
+# eligibility + byte estimate
+# ---------------------------------------------------------------------
+
+def test_eligible_and_bytes_estimate():
+    from dpsvm_tpu.serving.sharded import eligible, model_bytes_est
+
+    sv = _mk_model(n_sv=48, d=7)
+    assert eligible(sv)
+    # n_sv * (d + 2) * 4 — SV rows + coef + squared norms, f32 (the
+    # model-cache resident_bytes arithmetic)
+    assert model_bytes_est(sv) == 48 * (7 + 2) * 4
+
+    assert not eligible(_mk_model(kernel="precomputed"))
+
+    class McDir:                               # multiclass directory
+        models = [object()]
+    assert not eligible(McDir())
+
+    rff = _mk_approx("rff", d=6, dim=32)
+    assert eligible(rff)
+    assert model_bytes_est(rff) > 0
+    nys = _mk_approx("nystrom", d=6, dim=32)
+    assert eligible(nys)
+    assert model_bytes_est(nys) > 0
+
+
+# ---------------------------------------------------------------------
+# ShardedDecider parity: SV / RFF / Nystrom
+# ---------------------------------------------------------------------
+
+def test_sv_sharded_bitwise_vs_reference_and_close_to_classic():
+    from dpsvm_tpu.models.svm import decision_function
+    from dpsvm_tpu.serving.sharded import ShardedDecider
+    _need_mesh()
+
+    model = _mk_model(n_sv=50, d=7, seed=5)     # 50 pads to 56 on 8
+    sd = ShardedDecider(model)
+    assert sd.axis == "sv"
+    assert sd.orig_len == 50
+    assert sd.padded_len % sd.n_shards == 0
+    assert sd.padded_len >= 50
+    q = _rows(16, 7, seed=6)
+    got = sd.decide(q)
+    ref = sd.reference(q)
+    # the parity gate: mesh psum == in-order blocked fold, BITWISE
+    assert np.array_equal(got.view(np.int32), ref.view(np.int32))
+    # the classic single-matmul differs only by f32 reassociation
+    np.testing.assert_allclose(got, decision_function(model, q),
+                               rtol=2e-5, atol=2e-5)
+    facts = sd.facts()
+    assert facts["sharded"] is True
+    assert facts["shard_axis"] == "sv"
+    assert facts["shards"] == sd.n_shards
+    assert facts["per_device_bytes_est"] <= facts["resident_bytes_est"]
+
+
+def test_sv_sharded_include_b_and_explicit_shards():
+    from dpsvm_tpu.serving.sharded import ShardedDecider
+    _need_mesh()
+    model = _mk_model(n_sv=32, d=5, seed=7, b=1.5)
+    q = _rows(8, 5, seed=8)
+    with_b = ShardedDecider(model, shards=2)
+    without = ShardedDecider(model, shards=2, include_b=False)
+    assert with_b.n_shards == 2
+    np.testing.assert_allclose(without.decide(q) - 1.5,
+                               with_b.decide(q), atol=1e-6)
+    with pytest.raises(ValueError):
+        ShardedDecider(model, shards=-1)
+
+
+@pytest.mark.parametrize("kind", ["rff", "nystrom"])
+def test_approx_sharded_bitwise_vs_reference(kind):
+    from dpsvm_tpu.approx.model import decision_function
+    from dpsvm_tpu.serving.sharded import ShardedDecider
+    _need_mesh()
+
+    model = _mk_approx(kind, d=6, dim=48, seed=9)
+    sd = ShardedDecider(model)
+    assert sd.axis == "feature"
+    assert sd.orig_len == model.fmap.dim
+    q = _rows(16, 6, seed=10)
+    got = sd.decide(q)
+    ref = sd.reference(q)
+    assert np.array_equal(got.view(np.int32), ref.view(np.int32)), kind
+    # and the unsharded approx ladder agrees to f32 tolerance
+    np.testing.assert_allclose(got, decision_function(model, q),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------
+# engine integration: the --hbm-budget-mb selection
+# ---------------------------------------------------------------------
+
+def test_engine_budget_selects_sharded_with_parity_and_no_retrace():
+    from dpsvm_tpu.observability import compilewatch
+    from dpsvm_tpu.serving.engine import PredictionEngine
+    _need_mesh()
+
+    model = _mk_model(n_sv=64, d=6, seed=11)
+    # 64*(6+2)*4 = 2048 bytes: a tiny budget forces the sharded path,
+    # a generous one keeps the single-device ladder
+    plain = PredictionEngine(model, max_batch=16)
+    tiny = PredictionEngine(model, max_batch=16, hbm_budget_mb=1e-4)
+    roomy = PredictionEngine(model, max_batch=16, hbm_budget_mb=64.0)
+    assert tiny.sharded
+    assert not plain.sharded and not roomy.sharded
+    man = tiny.manifest
+    assert man["sharded"] is True
+    assert man["hbm_budget_mb"] == 1e-4
+    assert man["sharding"]["shard_axis"] == "sv"
+    assert man["sharding"]["shards"] >= 2
+    assert "sharded" in roomy.manifest and not roomy.manifest["sharded"]
+    assert "hbm_budget_mb" not in plain.manifest
+
+    sd = tiny._sharded_deciders[0]
+    compilewatch.drain()
+    for n in (1, 3, 7, 16, 5, 12, 16, 2):
+        q = _rows(n, 6, seed=20 + n)
+        got = tiny.decision_values(q)
+        # sharded serving answers = the in-order blocked reference,
+        # bitwise, at every ladder bucket
+        np.testing.assert_allclose(got, plain.decision_values(q),
+                                   rtol=2e-5, atol=2e-5)
+        blk = np.zeros((_bucket(tiny, n), 6), np.float32)
+        blk[:n] = q
+        assert np.array_equal(
+            got.view(np.int32),
+            np.asarray(sd.reference(blk))[:n].view(np.int32)), n
+    assert compilewatch.drain() == [], \
+        "post-warmup sharded traffic must never retrace"
+
+
+def _bucket(engine, n):
+    for b in engine.buckets:
+        if n <= b:
+            return b
+    return engine.buckets[-1]
+
+
+def test_engine_budget_validation_and_precomputed_never_shards():
+    from dpsvm_tpu.serving.engine import PredictionEngine
+    with pytest.raises(ValueError, match="hbm_budget_mb"):
+        PredictionEngine(_mk_model(), hbm_budget_mb=0.0)
+    with pytest.raises(ValueError, match="hbm_budget_mb"):
+        PredictionEngine(_mk_model(), hbm_budget_mb=-1.0)
+
+
+def test_engine_load_passes_budget_and_manifest_reports(tmp_path):
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving.engine import PredictionEngine
+    _need_mesh()
+    path = str(tmp_path / "m.svm")
+    save_model(_mk_model(n_sv=64, d=6, seed=12), path)
+    eng = PredictionEngine.load(path, max_batch=16, hbm_budget_mb=1e-4)
+    assert eng.sharded
+    assert eng.manifest["sharding"]["orig_len"] == 64
+
+
+def test_registry_and_server_serve_sharded_model(tmp_path):
+    """End to end: a registry entry registered with a budget serves
+    mesh-sharded through the HTTP server, the manifest says so, and
+    the answers match an unbudgeted server bitwise (same file, same
+    ladder buckets — the selfcheck's transport-parity shape)."""
+    import json
+    import urllib.request
+
+    from dpsvm_tpu.models.io import save_model
+    from dpsvm_tpu.serving import ModelRegistry
+    from dpsvm_tpu.serving.server import ServingServer
+    _need_mesh()
+
+    path = str(tmp_path / "m.svm")
+    save_model(_mk_model(n_sv=64, d=6, seed=13), path)
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return json.loads(r.read())
+
+    reg_s = ModelRegistry()
+    reg_s.register("default", path, max_batch=8, hbm_budget_mb=1e-4)
+    reg_p = ModelRegistry()
+    reg_p.register("default", path, max_batch=8)
+    srv_s = ServingServer(reg_s, port=0, max_batch=8,
+                          max_delay_ms=1.0, max_queue=64).start()
+    srv_p = ServingServer(reg_p, port=0, max_batch=8,
+                          max_delay_ms=1.0, max_queue=64).start()
+    try:
+        with urllib.request.urlopen(srv_s.url + "/v1/models",
+                                    timeout=15) as r:
+            man = json.loads(r.read())["models"]["default"]
+        assert man["sharded"] is True
+        assert man["sharding"]["shards"] >= 2
+        q = _rows(6, 6, seed=14)
+        payload = {"instances": q.tolist(), "return": ["decision",
+                                                       "labels"]}
+        a = post(srv_s.url + "/v1/predict", payload)
+        b = post(srv_p.url + "/v1/predict", payload)
+        assert a["labels"] == b["labels"]
+        np.testing.assert_allclose(a["decision"], b["decision"],
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        srv_s.drain(timeout=10.0)
+        srv_p.drain(timeout=10.0)
