@@ -304,7 +304,11 @@ class MicroBatcher:
                 t.error = DeadlineExceededError(
                     "deadline passed while queued")
                 t.event.set()
-                self._notify(t)
+            # on_done fires for cancelled tickets too: the async front
+            # door accounts inflight rows at submit and only releases
+            # them in on_done, so a silent drop here would leak them
+            # until the dispatcher wedges at _inflight_limit.
+            self._notify(t)
 
     def _take_batch(self) -> Optional[List[_Ticket]]:
         """Block for the first request, then coalesce until max_batch

@@ -312,7 +312,7 @@ class AsyncFrontDoor:
             item.ticket = self.core.batcher(item.eff_name).submit(
                 item.x, item.ride, deadline=item.deadline,
                 spans=item.rs, on_done=on_done)
-        except BaseException as e:  # QueueFull/Closed/ValueError -> the
+        except Exception as e:      # QueueFull/Closed/ValueError -> the
             if not item.future.done():      # waiter maps it to HTTP
                 item.future.set_exception(e)
             else:
@@ -390,7 +390,13 @@ class AsyncFrontDoor:
             k, sep, v = h.decode("latin-1").partition(":")
             if sep:
                 headers[k.strip().lower()] = v.strip()
-        n = int(headers.get("content-length") or 0)
+        try:
+            n = int(headers.get("content-length") or 0)
+        except ValueError:
+            await self._respond(writer, 400,
+                                {"error": "malformed Content-Length"},
+                                keep=False)
+            return False
         if n > MAX_BODY_BYTES:
             await self._respond(
                 writer, 413,

@@ -417,6 +417,78 @@ def test_async_concurrent_multi_tenant_traffic(front_door):
                                    atol=1e-6)
 
 
+def test_async_504_while_queued_releases_inflight_rows(front_door):
+    """A ticket that was SUBMITTED to the batcher but times out while
+    still queued must hand its rows back to the front door's inflight
+    accounting: the worker prunes the cancelled ticket at
+    batch-formation time and ``on_done`` still fires. If the prune
+    were silent, the leaked rows would accumulate to _inflight_limit
+    and the dispatcher would stop submitting forever (every request
+    504s until restart)."""
+    fd, _thr, _model, _path = front_door
+    batcher = fd.core.batcher("default")
+    entered = threading.Event()
+    release = threading.Event()
+    real = batcher._infer
+
+    def slow(x, want, **kw):
+        entered.set()
+        release.wait(20.0)
+        return real(x, want, **kw)
+
+    batcher._infer = slow
+    slow_thread = threading.Thread(
+        target=_post,
+        args=(fd.url + "/v1/predict",
+              {"instances": _rows(2, 5, seed=60).tolist()}),
+        kwargs={"timeout": 30.0})
+    try:
+        # request A occupies the worker inside the (stalled) engine call
+        slow_thread.start()
+        assert entered.wait(10.0), "worker never picked up the batch"
+        # request B: submitted (inflight rows counted at submit) but
+        # stuck in the batcher queue behind A when its deadline expires
+        code, body = _post(fd.url + "/v1/predict",
+                           {"instances": _rows(3, 5, seed=61).tolist(),
+                            "timeout_ms": 200},
+                           timeout=10.0)
+        assert code == 504, body
+    finally:
+        release.set()
+        batcher._infer = real
+    slow_thread.join(30.0)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if fd.stats()["inflight_rows"] == 0:
+            break
+        time.sleep(0.05)
+    assert fd.stats()["inflight_rows"] == 0, fd.stats()
+    assert fd.core.batcher("default").stats()["expired"] >= 1
+    # the dispatcher did not wedge: the front door still answers
+    code, _ = _post(fd.url + "/v1/predict",
+                    {"instances": _rows(1, 5, seed=62).tolist()})
+    assert code == 200
+
+
+def test_async_malformed_content_length_is_400(front_door):
+    """A non-numeric Content-Length answers 400 instead of killing the
+    connection with an unhandled ValueError on the loop."""
+    fd, _thr, _model, _path = front_door
+    s = socket.create_connection(("127.0.0.1", fd.port), timeout=10)
+    try:
+        s.sendall(b"POST /v1/predict HTTP/1.1\r\n"
+                  b"Content-Length: banana\r\n\r\n")
+        s.settimeout(10)
+        raw = s.recv(65536)
+    finally:
+        s.close()
+    assert raw.split(b"\r\n", 1)[0].endswith(b"400 Bad Request"), raw[:200]
+    assert b"Content-Length" in raw
+    # the server is unharmed
+    code, _ = _get(fd.url + "/healthz")
+    assert code == 200
+
+
 # ---------------------------------------------------------------------
 # process-level: SIGTERM drain on the async front end
 # ---------------------------------------------------------------------
